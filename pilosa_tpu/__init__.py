@@ -1,0 +1,22 @@
+"""pilosa_tpu — a TPU-native distributed bitmap index.
+
+A brand-new framework with the capabilities of Pilosa (reference:
+github.com/pilosa/pilosa/v2): sharded, replicated boolean matrices queried
+with PQL set algebra — redesigned TPU-first:
+
+- Fragments are dense uint32-packed bitmap tensors resident in HBM; PQL set
+  algebra (Union/Intersect/Difference/Xor/Not/Shift) lowers to XLA bitwise
+  HLO + popcount fused by jit, instead of the reference's per-container
+  roaring loops (roaring/roaring.go:595-1023).
+- Shard fan-out runs as shard_map/pjit over a jax.sharding.Mesh with ICI
+  collectives (psum / OR-reduce), replacing the reference's HTTP
+  scatter-gather mapReduce (executor.go:2455).
+- The host-side control plane (storage hierarchy, PQL parsing, cluster
+  membership, REST API) mirrors the reference's layer map (SURVEY.md §1).
+"""
+
+__version__ = "0.1.0"
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, shard_width
+
+__all__ = ["SHARD_WIDTH", "shard_width", "__version__"]

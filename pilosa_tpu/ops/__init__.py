@@ -1,0 +1,54 @@
+"""Device kernels: packed-bitmap set algebra, popcounts, BSI, TopN.
+
+The TPU-native replacement for the reference's roaring container engine
+(roaring/roaring.go).  Everything here operates on dense uint32-packed
+bitmap tensors and is jit-compiled to XLA.
+"""
+
+from pilosa_tpu.ops.bitmap import (
+    WORD_BITS,
+    n_words,
+    pack_positions,
+    unpack_positions,
+    pack_positions_matrix,
+    b_and,
+    b_or,
+    b_xor,
+    b_andnot,
+    b_not,
+    b_shift,
+    b_flip_range,
+    popcount,
+    popcount_and,
+    row_counts,
+    row_counts_masked,
+    set_bits,
+    clear_bits,
+    get_bits,
+    reduce_or_rows,
+    reduce_and_rows,
+)
+
+__all__ = [
+    "WORD_BITS",
+    "n_words",
+    "pack_positions",
+    "unpack_positions",
+    "pack_positions_matrix",
+    "b_and",
+    "b_or",
+    "b_xor",
+    "b_andnot",
+    "b_not",
+    "b_shift",
+    "b_flip_range",
+    "popcount",
+    "popcount_and",
+    "row_counts",
+    "row_counts_masked",
+    "set_bits",
+    "clear_bits",
+    "get_bits",
+    "reduce_or_rows",
+    "reduce_and_rows",
+]

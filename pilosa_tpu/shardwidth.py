@@ -1,0 +1,31 @@
+"""Shard width configuration.
+
+The column space is split into fixed-width shards; `pos = row * SHARD_WIDTH +
+col % SHARD_WIDTH` addresses a bit inside a fragment (reference:
+fragment.go:50-53,3090 and shardwidth/*.go, where width is a build-tag in
+2^16..2^32, default 2^20).
+
+Here the width is a process-wide setting, configurable via the
+PILOSA_TPU_SHARD_WIDTH_EXP environment variable (exponent, default 20) so the
+test suite can exercise width independence the way the reference's
+SHARD_WIDTH=22 CI matrix job does (.circleci/config.yml:52-56).
+"""
+
+import os
+
+# Exponent of the shard width.  2^20 columns = 2^15 uint32 words per row: a
+# [rows, 32768] uint32 tensor per fragment — sized so row-batched bitwise ops
+# tile well onto TPU vector units.
+SHARD_WIDTH_EXP = int(os.environ.get("PILOSA_TPU_SHARD_WIDTH_EXP", "20"))
+
+if not (16 <= SHARD_WIDTH_EXP <= 32):
+    raise ValueError(
+        f"PILOSA_TPU_SHARD_WIDTH_EXP must be in [16, 32], got {SHARD_WIDTH_EXP}"
+    )
+
+SHARD_WIDTH = 1 << SHARD_WIDTH_EXP
+
+
+def shard_width() -> int:
+    """Number of columns per shard."""
+    return SHARD_WIDTH

@@ -1,0 +1,28 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported, so
+multi-chip sharding tests (the analog of the reference's in-process
+multi-node clusters, test/pilosa.go:343-399) run anywhere.  Also pins a
+small shard width so fragments stay tiny, mirroring the reference's
+SHARD_WIDTH build-tag CI matrix (.circleci/config.yml:52-56).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the image pre-sets JAX_PLATFORMS=axon
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH_EXP", "16")
+
+# jax may already be imported by a pytest plugin (the image ships an axon TPU
+# site hook), and JAX_PLATFORMS is captured at import time — so also override
+# via jax.config, which takes effect any time before backend initialization.
+# test_environment.py asserts the 8-device CPU platform stuck.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

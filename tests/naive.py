@@ -1,0 +1,46 @@
+"""Deliberately-simple bitmap oracle for differential tests.
+
+Pattern taken from the reference's roaring/naive.go: a trivially-correct
+set-based implementation every kernel result is checked against.
+"""
+
+from __future__ import annotations
+
+
+class NaiveBitmap:
+    def __init__(self, positions=(), nbits: int = 1 << 16):
+        self.nbits = nbits
+        self.bits = set(int(p) for p in positions)
+        assert all(0 <= p < nbits for p in self.bits)
+
+    def union(self, o):
+        return NaiveBitmap(self.bits | o.bits, self.nbits)
+
+    def intersect(self, o):
+        return NaiveBitmap(self.bits & o.bits, self.nbits)
+
+    def difference(self, o):
+        return NaiveBitmap(self.bits - o.bits, self.nbits)
+
+    def xor(self, o):
+        return NaiveBitmap(self.bits ^ o.bits, self.nbits)
+
+    def complement_within(self, universe):
+        return NaiveBitmap(universe.bits - self.bits, self.nbits)
+
+    def shift(self, n: int):
+        return NaiveBitmap(
+            {p + n for p in self.bits if p + n < self.nbits}, self.nbits
+        )
+
+    def flip_range(self, start: int, end: int):
+        flipped = set(self.bits)
+        for p in range(start, end):
+            flipped ^= {p}
+        return NaiveBitmap(flipped, self.nbits)
+
+    def count(self) -> int:
+        return len(self.bits)
+
+    def positions(self):
+        return sorted(self.bits)

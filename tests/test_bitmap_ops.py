@@ -1,0 +1,183 @@
+"""Differential tests: packed-bitmap kernels vs the naive set oracle.
+
+Mirrors the reference's differential-oracle strategy
+(roaring/naive_test.go) and its per-density coverage of container types:
+sparse (= array containers), dense (= bitmap containers), and runs
+(= RLE containers) all map to the same dense packed layout here, but the
+test densities are kept to shake out the same edge cases.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import (
+    b_and,
+    b_andnot,
+    b_flip_range,
+    b_not,
+    b_or,
+    b_shift,
+    b_xor,
+    clear_bits,
+    get_bits,
+    n_words,
+    pack_positions,
+    pack_positions_matrix,
+    popcount,
+    popcount_and,
+    reduce_and_rows,
+    reduce_or_rows,
+    row_counts,
+    row_counts_masked,
+    set_bits,
+    unpack_positions,
+)
+from tests.naive import NaiveBitmap
+
+NBITS = 1 << 16
+RNG = np.random.default_rng(42)
+
+
+def random_positions(density):
+    n = max(1, int(NBITS * density))
+    return RNG.choice(NBITS, size=n, replace=False)
+
+
+def to_naive(words):
+    return NaiveBitmap(unpack_positions(np.asarray(words)), NBITS)
+
+
+DENSITIES = [0.0001, 0.01, 0.3, 0.9]  # array-like .. run-like densities
+
+
+@pytest.mark.parametrize("da", DENSITIES)
+@pytest.mark.parametrize("db", DENSITIES)
+def test_binary_ops_match_oracle(da, db):
+    pa, pb = random_positions(da), random_positions(db)
+    a, b = pack_positions(pa, NBITS), pack_positions(pb, NBITS)
+    na, nb = NaiveBitmap(pa, NBITS), NaiveBitmap(pb, NBITS)
+
+    assert to_naive(b_and(a, b)).bits == na.intersect(nb).bits
+    assert to_naive(b_or(a, b)).bits == na.union(nb).bits
+    assert to_naive(b_xor(a, b)).bits == na.xor(nb).bits
+    assert to_naive(b_andnot(a, b)).bits == na.difference(nb).bits
+    assert int(popcount_and(a, b)) == na.intersect(nb).count()
+
+
+def test_pack_unpack_roundtrip():
+    for d in DENSITIES:
+        p = np.sort(random_positions(d))
+        words = pack_positions(p, NBITS)
+        assert np.array_equal(unpack_positions(words), p)
+        assert int(popcount(words)) == len(p)
+
+
+def test_pack_empty_and_bounds():
+    assert pack_positions([], NBITS).sum() == 0
+    with pytest.raises(ValueError):
+        pack_positions([NBITS], NBITS)
+    with pytest.raises(ValueError):
+        pack_positions([-1], NBITS)
+
+
+def test_not_within_existence():
+    pa = random_positions(0.1)
+    pe = np.union1d(pa, random_positions(0.2))
+    a, e = pack_positions(pa, NBITS), pack_positions(pe, NBITS)
+    na, ne = NaiveBitmap(pa, NBITS), NaiveBitmap(pe, NBITS)
+    assert to_naive(b_not(a, e)).bits == na.complement_within(ne).bits
+
+
+@pytest.mark.parametrize("n", [1, 31, 32, 33, 64, 100, 65535])
+def test_shift(n):
+    pa = random_positions(0.05)
+    a = pack_positions(pa, NBITS)
+    na = NaiveBitmap(pa, NBITS)
+    assert to_naive(b_shift(a, n)).bits == na.shift(n).bits
+
+
+def test_shift_zero_identity():
+    a = pack_positions(random_positions(0.05), NBITS)
+    assert np.array_equal(np.asarray(b_shift(a, 0)), np.asarray(a))
+
+
+@pytest.mark.parametrize(
+    "start,end",
+    [(0, NBITS), (0, 1), (31, 33), (100, 100), (5, 64), (NBITS - 1, NBITS), (7, 1000)],
+)
+def test_flip_range(start, end):
+    pa = random_positions(0.1)
+    a = pack_positions(pa, NBITS)
+    na = NaiveBitmap(pa, NBITS)
+    assert to_naive(b_flip_range(a, start, end)).bits == na.flip_range(start, end).bits
+
+
+def test_set_clear_get_bits():
+    a = pack_positions(random_positions(0.01), NBITS)
+    oracle = to_naive(a)
+
+    new_pos = RNG.choice(NBITS, size=50, replace=False)
+    delta = pack_positions(new_pos, NBITS)
+    idx = np.nonzero(delta)[0]
+    a2 = set_bits(a, idx, delta[idx])
+    assert to_naive(a2).bits == oracle.bits | set(int(p) for p in new_pos)
+
+    a3 = clear_bits(a2, idx, delta[idx])
+    assert to_naive(a3).bits == oracle.bits - set(int(p) for p in new_pos)
+
+    probe = np.concatenate([new_pos[:10], RNG.choice(NBITS, size=10)])
+    got = np.asarray(get_bits(a2, probe))
+    want = np.array([1 if int(p) in to_naive(a2).bits else 0 for p in probe])
+    assert np.array_equal(got, want)
+
+
+def test_row_matrix_ops():
+    rows = [1, 5, 9]
+    pairs = []
+    per_row = {}
+    for r in rows:
+        ps = random_positions(0.02)
+        per_row[r] = NaiveBitmap(ps, NBITS)
+        pairs += [(r, int(c)) for c in ps]
+    mat = pack_positions_matrix(pairs, rows, NBITS)
+
+    counts = np.asarray(row_counts(mat))
+    assert [int(c) for c in counts] == [per_row[r].count() for r in rows]
+
+    filt_pos = random_positions(0.1)
+    filt = pack_positions(filt_pos, NBITS)
+    nfilt = NaiveBitmap(filt_pos, NBITS)
+    mcounts = np.asarray(row_counts_masked(mat, filt))
+    assert [int(c) for c in mcounts] == [
+        per_row[r].intersect(nfilt).count() for r in rows
+    ]
+
+    union = to_naive(reduce_or_rows(mat))
+    want_u = set()
+    for r in rows:
+        want_u |= per_row[r].bits
+    assert union.bits == want_u
+
+    inter = to_naive(b_and(reduce_and_rows(mat), pack_positions(range(NBITS), NBITS)))
+    want_i = per_row[rows[0]].bits
+    for r in rows[1:]:
+        want_i &= per_row[r].bits
+    assert inter.bits == want_i
+
+
+def test_word_layout_matches_uint64_view():
+    """The uint32 device layout must reinterpret as the reference's uint64
+    LSB-first word layout (roaring bitmap containers) byte-for-byte."""
+    pos = [0, 1, 31, 32, 63, 64, 65, 127, NBITS - 1]
+    words32 = pack_positions(pos, NBITS)
+    words64 = words32.view(np.uint64)
+    want = np.zeros(NBITS // 64, dtype=np.uint64)
+    for p in pos:
+        want[p // 64] |= np.uint64(1) << np.uint64(p % 64)
+    assert np.array_equal(words64, want)
+
+
+def test_n_words_validation():
+    assert n_words(64) == 2
+    with pytest.raises(ValueError):
+        n_words(65)

@@ -1,0 +1,14 @@
+"""Sanity checks that the virtual multi-device test platform stuck."""
+
+import jax
+
+import pilosa_tpu
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() == 8
+    assert jax.devices()[0].platform == "cpu"
+
+
+def test_small_shard_width():
+    assert pilosa_tpu.SHARD_WIDTH == 1 << 16
