@@ -1,0 +1,39 @@
+"""Data model: Holder -> Index -> Field -> view -> fragment.
+
+Host-side storage hierarchy mirroring the reference's layer 2
+(SURVEY.md §2.2): the control plane that owns durable packed-bitmap state
+and hands dense tensors to the device kernels in pilosa_tpu.ops.
+"""
+
+from pilosa_tpu.models.row import Row
+from pilosa_tpu.models.fragment import Fragment
+from pilosa_tpu.models.view import View, VIEW_STANDARD, VIEW_BSI_PREFIX
+from pilosa_tpu.models.field import Field, FieldOptions, FieldType
+from pilosa_tpu.models.index import Index, IndexOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.models.timequantum import (
+    TimeQuantum,
+    views_by_time,
+    views_by_time_range,
+    parse_time,
+    TIME_FORMAT,
+)
+
+__all__ = [
+    "Row",
+    "Fragment",
+    "View",
+    "VIEW_STANDARD",
+    "VIEW_BSI_PREFIX",
+    "Field",
+    "FieldOptions",
+    "FieldType",
+    "Index",
+    "IndexOptions",
+    "Holder",
+    "TimeQuantum",
+    "views_by_time",
+    "views_by_time_range",
+    "parse_time",
+    "TIME_FORMAT",
+]

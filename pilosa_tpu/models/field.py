@@ -1,0 +1,499 @@
+"""Field: a typed sub-matrix of an index.
+
+Parity with the reference's Field (field.go:112-204): five types —
+``set`` (plain rows), ``int`` (BSI bit-sliced integers), ``time``
+(quantum-expanded views), ``mutex`` (one row per column), ``bool``
+(rows 0/1, mutex semantics) — plus per-field shard tracking
+(field.go:263-360) and BSI base/bit-depth management
+(field.go:1540-1651).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import re
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from pilosa_tpu.models.timequantum import TimeQuantum, views_by_time, views_by_time_range
+from pilosa_tpu.models.view import VIEW_BSI_PREFIX, VIEW_STANDARD, View
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class FieldType:
+    SET = "set"
+    INT = "int"
+    TIME = "time"
+    MUTEX = "mutex"
+    BOOL = "bool"
+
+
+CACHE_TYPE_RANKED = "ranked"
+CACHE_TYPE_LRU = "lru"
+CACHE_TYPE_NONE = "none"
+
+DEFAULT_CACHE_TYPE = CACHE_TYPE_RANKED
+DEFAULT_CACHE_SIZE = 50000
+
+# Row ids used by bool fields (reference fragment.go:87-88).
+FALSE_ROW_ID = 0
+TRUE_ROW_ID = 1
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
+# Internal names (the hidden existence field) carry a leading underscore and
+# bypass user-name validation, as in the reference (holder.go:46).
+_INTERNAL_NAME_RE = re.compile(r"^_[a-z0-9_-]{0,63}$")
+
+
+def validate_name(name: str) -> None:
+    if not (_NAME_RE.match(name) or _INTERNAL_NAME_RE.match(name)):
+        raise ValueError(f"invalid name: {name!r}")
+
+
+def bsi_base(lo: int, hi: int) -> int:
+    """Default base for an int field's range (reference bsiBase,
+    field.go:1551-1559)."""
+    if lo > 0:
+        return lo
+    if hi < 0:
+        return hi
+    return 0
+
+
+def bit_depth(uvalue: int) -> int:
+    """Bits needed for a magnitude, minimum 1."""
+    return max(int(uvalue).bit_length(), 1)
+
+
+@dataclass
+class FieldOptions:
+    type: str = FieldType.SET
+    cache_type: str = DEFAULT_CACHE_TYPE
+    cache_size: int = DEFAULT_CACHE_SIZE
+    min: int = 0
+    max: int = 0
+    base: int = 0
+    bit_depth: int = 1
+    time_quantum: str = ""
+    no_standard_view: bool = False
+    keys: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "type": self.type,
+            "cacheType": self.cache_type,
+            "cacheSize": self.cache_size,
+            "min": self.min,
+            "max": self.max,
+            "base": self.base,
+            "bitDepth": self.bit_depth,
+            "timeQuantum": self.time_quantum,
+            "noStandardView": self.no_standard_view,
+            "keys": self.keys,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FieldOptions":
+        return cls(
+            type=d.get("type", FieldType.SET),
+            cache_type=d.get("cacheType", DEFAULT_CACHE_TYPE),
+            cache_size=d.get("cacheSize", DEFAULT_CACHE_SIZE),
+            min=d.get("min", 0),
+            max=d.get("max", 0),
+            base=d.get("base", 0),
+            bit_depth=d.get("bitDepth", 1),
+            time_quantum=d.get("timeQuantum", ""),
+            no_standard_view=d.get("noStandardView", False),
+            keys=d.get("keys", False),
+        )
+
+    # ---- constructors matching the reference's functional options ----
+
+    @classmethod
+    def set_field(cls, cache_type=DEFAULT_CACHE_TYPE, cache_size=DEFAULT_CACHE_SIZE):
+        return cls(type=FieldType.SET, cache_type=cache_type, cache_size=cache_size)
+
+    @classmethod
+    def int_field(cls, lo: int, hi: int):
+        if lo > hi:
+            raise ValueError("int field min cannot be greater than max")
+        if lo < -(1 << 63) or hi >= (1 << 63):
+            raise ValueError("int field range must fit in int64")
+        base = bsi_base(lo, hi)
+        depth = bit_depth(max(abs(lo - base), abs(hi - base)))
+        if depth > 63:
+            raise ValueError("int field range spans more than 63 bits from base")
+        return cls(type=FieldType.INT, min=lo, max=hi, base=base, bit_depth=depth)
+
+    @classmethod
+    def time_field(cls, quantum: str, no_standard_view: bool = False):
+        return cls(
+            type=FieldType.TIME,
+            time_quantum=str(TimeQuantum(quantum)),
+            no_standard_view=no_standard_view,
+        )
+
+    @classmethod
+    def mutex_field(cls, cache_type=DEFAULT_CACHE_TYPE, cache_size=DEFAULT_CACHE_SIZE):
+        return cls(type=FieldType.MUTEX, cache_type=cache_type, cache_size=cache_size)
+
+    @classmethod
+    def bool_field(cls):
+        return cls(type=FieldType.BOOL, cache_type=CACHE_TYPE_NONE, cache_size=0)
+
+
+class Field:
+    def __init__(self, path: str | None, index: str, name: str, options: FieldOptions):
+        validate_name(name)
+        self.path = path
+        self.index = index
+        self.name = name
+        self.options = options
+        self.views: dict[str, View] = {}
+        self._shards: set[int] = set()
+        self._lock = threading.RLock()
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            self._load_meta()
+            self._open_views()
+        self._load_shards()
+
+    # ------------------------------------------------------------ metadata
+
+    @property
+    def _meta_path(self) -> str:
+        return os.path.join(self.path, ".meta")
+
+    @property
+    def _shards_path(self) -> str:
+        return os.path.join(self.path, ".shards")
+
+    def _load_meta(self) -> None:
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                self.options = FieldOptions.from_dict(json.load(f))
+        else:
+            self.save_meta()
+
+    def save_meta(self) -> None:
+        if self.path is None:
+            return
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.options.to_dict(), f)
+        os.replace(tmp, self._meta_path)
+
+    def _load_shards(self) -> None:
+        if self.path is not None and os.path.exists(self._shards_path):
+            with open(self._shards_path) as f:
+                self._shards = set(json.load(f))
+        # union in shards discovered from opened fragments
+        for view in self.views.values():
+            self._shards |= view.available_shards()
+
+    def _save_shards(self) -> None:
+        if self.path is None:
+            return
+        tmp = self._shards_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(sorted(self._shards), f)
+        os.replace(tmp, self._shards_path)
+
+    def _open_views(self) -> None:
+        views_dir = os.path.join(self.path, "views")
+        if not os.path.isdir(views_dir):
+            return
+        for name in sorted(os.listdir(views_dir)):
+            self.views[name] = View(
+                os.path.join(views_dir, name), self.index, self.name, name,
+                mutex=self._is_mutex_like,
+            )
+
+    # ------------------------------------------------------------- views
+
+    @property
+    def _is_mutex_like(self) -> bool:
+        return self.options.type in (FieldType.MUTEX, FieldType.BOOL)
+
+    @property
+    def time_quantum(self) -> TimeQuantum:
+        return TimeQuantum(self.options.time_quantum)
+
+    def view(self, name: str) -> View | None:
+        return self.views.get(name)
+
+    def create_view_if_not_exists(self, name: str) -> View:
+        with self._lock:
+            v = self.views.get(name)
+            if v is None:
+                path = (
+                    None if self.path is None
+                    else os.path.join(self.path, "views", name)
+                )
+                v = View(path, self.index, self.name, name, mutex=self._is_mutex_like)
+                self.views[name] = v
+            return v
+
+    @property
+    def bsi_view_name(self) -> str:
+        return VIEW_BSI_PREFIX + self.name
+
+    # ------------------------------------------------------------- shards
+
+    def available_shards(self) -> set[int]:
+        return set(self._shards)
+
+    def add_remote_available_shards(self, shards: set[int]) -> None:
+        """Merge shards owned by other nodes (reference
+        AddRemoteAvailableShards, field.go:263-360)."""
+        with self._lock:
+            self._shards |= shards
+            self._save_shards()
+
+    def _note_shard(self, shard: int) -> None:
+        if shard not in self._shards:
+            self._shards.add(shard)
+            self._save_shards()
+
+    # ------------------------------------------------------------ bit ops
+
+    def set_bit(self, row: int, col: int, timestamp: _dt.datetime | None = None) -> bool:
+        """Set a bit in the standard view and any time views
+        (reference Field.SetBit, field.go:927)."""
+        if self.options.type == FieldType.INT:
+            raise ValueError(f"field {self.name} is an int field; use set_value")
+        if self.options.type == FieldType.BOOL and row not in (FALSE_ROW_ID, TRUE_ROW_ID):
+            raise ValueError("bool field rows must be 0 or 1")
+        changed = False
+        if not (self.options.type == FieldType.TIME and self.options.no_standard_view):
+            changed |= self.create_view_if_not_exists(VIEW_STANDARD).set_bit(row, col)
+        if timestamp is not None:
+            if self.options.type != FieldType.TIME:
+                raise ValueError(f"field {self.name} has no time quantum")
+            for name in views_by_time(VIEW_STANDARD, timestamp, self.time_quantum):
+                changed |= self.create_view_if_not_exists(name).set_bit(row, col)
+        self._note_shard(col // SHARD_WIDTH)
+        return changed
+
+    def clear_bit(self, row: int, col: int) -> bool:
+        """Clear a bit from the standard view and all time views
+        (reference Field.ClearBit, field.go:967)."""
+        changed = False
+        for name, view in self.views.items():
+            if name == VIEW_STANDARD or name.startswith(VIEW_STANDARD + "_"):
+                changed |= view.clear_bit(row, col)
+        return changed
+
+    def row(self, row_id: int, shard: int) -> np.ndarray | None:
+        view = self.view(VIEW_STANDARD)
+        return None if view is None else view.row(row_id, shard)
+
+    def row_time(self, row_id: int, shard: int, start, end) -> np.ndarray | None:
+        """Union of time views covering [start, end) for one shard
+        (reference Field.RowTime / executor time-range Row)."""
+        if not self.time_quantum:
+            raise ValueError(f"field {self.name} has no time quantum")
+        out = None
+        for name in views_by_time_range(VIEW_STANDARD, start, end, self.time_quantum):
+            view = self.view(name)
+            if view is None:
+                continue
+            words = view.row(row_id, shard)
+            if words is None:
+                continue
+            out = words if out is None else (out | words)
+        return out
+
+    # ------------------------------------------------------------ BSI ops
+
+    def _require_int(self) -> None:
+        if self.options.type != FieldType.INT:
+            raise ValueError(f"field {self.name} is not an int field")
+
+    def set_value(self, col: int, value: int) -> bool:
+        """(reference Field.SetValue, field.go:1075)"""
+        self._require_int()
+        o = self.options
+        if value < o.min:
+            raise ValueError(f"value {value} below field minimum {o.min}")
+        if value > o.max:
+            raise ValueError(f"value {value} above field maximum {o.max}")
+        base_value = value - o.base
+        required = bit_depth(abs(base_value))
+        if required > 63:
+            raise ValueError("value is more than 63 bits from the field base")
+        if required > o.bit_depth:
+            with self._lock:
+                o.bit_depth = required
+                self.save_meta()
+        view = self.create_view_if_not_exists(self.bsi_view_name)
+        changed = view.set_value(col, o.bit_depth, base_value)
+        self._note_shard(col // SHARD_WIDTH)
+        return changed
+
+    def value(self, col: int) -> tuple[int, bool]:
+        """(reference Field.Value, field.go:1053)"""
+        self._require_int()
+        view = self.view(self.bsi_view_name)
+        if view is None:
+            return 0, False
+        v, ok = view.value(col, self.options.bit_depth)
+        if not ok:
+            return 0, False
+        return v + self.options.base, True
+
+    def clear_value(self, col: int) -> bool:
+        self._require_int()
+        view = self.view(self.bsi_view_name)
+        if view is None:
+            return False
+        frag = view.fragment(col // SHARD_WIDTH)
+        return False if frag is None else frag.clear_value(col, self.options.bit_depth)
+
+    def sum(self, filter_row, shard: int) -> tuple[int, int]:
+        """Per-shard (sum, count) with base adjustment
+        (reference Field.Sum, field.go:1121: sum + count*base)."""
+        self._require_int()
+        frag = self._bsi_fragment(shard)
+        if frag is None:
+            return 0, 0
+        fw = None if filter_row is None else filter_row.shard_segment(shard)
+        if filter_row is not None and fw is None:
+            return 0, 0
+        s, c = frag.sum(fw, self.options.bit_depth)
+        return s + c * self.options.base, c
+
+    def min(self, filter_row, shard: int):
+        self._require_int()
+        frag = self._bsi_fragment(shard)
+        if frag is None:
+            return None
+        fw = None if filter_row is None else filter_row.shard_segment(shard)
+        if filter_row is not None and fw is None:
+            return None
+        v, c = frag.min(fw, self.options.bit_depth)
+        if c == 0:
+            return None
+        return v + self.options.base, c
+
+    def max(self, filter_row, shard: int):
+        self._require_int()
+        frag = self._bsi_fragment(shard)
+        if frag is None:
+            return None
+        fw = None if filter_row is None else filter_row.shard_segment(shard)
+        if filter_row is not None and fw is None:
+            return None
+        v, c = frag.max(fw, self.options.bit_depth)
+        if c == 0:
+            return None
+        return v + self.options.base, c
+
+    def _bsi_fragment(self, shard: int):
+        view = self.view(self.bsi_view_name)
+        return None if view is None else view.fragment(shard)
+
+    @property
+    def bit_depth_min(self) -> int:
+        """(reference bitDepthMin, field.go:1636)"""
+        return self.options.base - (1 << self.options.bit_depth) + 1
+
+    @property
+    def bit_depth_max(self) -> int:
+        """(reference bitDepthMax, field.go:1641)"""
+        return self.options.base + (1 << self.options.bit_depth) - 1
+
+    def base_value(self, op: str, value: int) -> tuple[int, bool]:
+        """Translate an absolute predicate into a base-relative one, with
+        out-of-range detection (reference bsiGroup.baseValue,
+        field.go:1583-1612).  Unlike the reference, a GT predicate exactly
+        at the representable minimum keeps its true base value rather than
+        clamping to 0 (the reference's `value > min` guard silently turns
+        `> min` into `> base`, dropping every negative; untested upstream).
+        Predicates beyond the representable range are resolved by the
+        not-null fallbacks in range_op, so this only flags genuinely
+        unsatisfiable cases."""
+        lo, hi = self.bit_depth_min, self.bit_depth_max
+        base = self.options.base
+        if op in (">", ">="):
+            if value > hi:
+                return 0, True  # nothing can exceed the representable max
+            return max(value, lo) - base, False
+        if op in ("<", "<="):
+            if value < lo:
+                return 0, True  # nothing can undercut the representable min
+            return min(value, hi) - base, False
+        if op in ("==", "!="):
+            if value < lo or value > hi:
+                return 0, True
+            return value - base, False
+        raise ValueError(f"invalid range operator: {op}")
+
+    def base_value_between(self, lo_v: int, hi_v: int) -> tuple[int, int, bool]:
+        """(reference baseValueBetween, field.go:1614-1628)"""
+        lo, hi = self.bit_depth_min, self.bit_depth_max
+        if hi_v < lo or lo_v > hi:
+            return 0, 0, True
+        lo_v = max(lo_v, lo)
+        hi_v = min(hi_v, hi)
+        return lo_v - self.options.base, hi_v - self.options.base, False
+
+    def range_op(self, op: str, predicate: int, shard: int) -> np.ndarray | None:
+        """Per-shard BSI comparison in absolute value space.
+
+        Implements the executor-side predicate handling of the reference
+        (executor.go:1625-1661 executeRowBSIGroupShard): base-value
+        translation with out-of-range detection, the whole-range LT/GT
+        shortcuts against the field's declared min/max, and the
+        out-of-range NEQ -> not-null rule."""
+        self._require_int()
+        frag = self._bsi_fragment(shard)
+        if frag is None:
+            return None
+        o = self.options
+        base_pred, out_of_range = self.base_value(op, predicate)
+        if out_of_range and op != "!=":
+            return None  # empty
+        # LT[E]/GT[E] that fully encompass the declared range -> not-null.
+        if (
+            (op == "<" and predicate > o.max)
+            or (op == "<=" and predicate >= o.max)
+            or (op == ">" and predicate < o.min)
+            or (op == ">=" and predicate <= o.min)
+        ):
+            return frag.not_null(o.bit_depth)
+        if out_of_range:  # op is "!="
+            return frag.not_null(o.bit_depth)
+        return frag.range_op(op, o.bit_depth, base_pred)
+
+    def range_between(self, lo_v: int, hi_v: int, shard: int) -> np.ndarray | None:
+        self._require_int()
+        frag = self._bsi_fragment(shard)
+        if frag is None:
+            return None
+        blo, bhi, out_of_range = self.base_value_between(lo_v, hi_v)
+        if out_of_range:
+            return None
+        # Whole declared range -> not-null (executor.go:1616-1619).
+        if lo_v <= self.options.min and hi_v >= self.options.max:
+            return frag.not_null(self.options.bit_depth)
+        return frag.range_between(self.options.bit_depth, blo, bhi)
+
+    def not_null(self, shard: int) -> np.ndarray | None:
+        self._require_int()
+        frag = self._bsi_fragment(shard)
+        return None if frag is None else frag.not_null(self.options.bit_depth)
+
+    # ---------------------------------------------------------- lifecycle
+
+    def close(self) -> None:
+        for view in self.views.values():
+            view.close()
+
+    def snapshot(self) -> None:
+        for view in self.views.values():
+            view.snapshot()

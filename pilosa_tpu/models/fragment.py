@@ -1,0 +1,600 @@
+"""Fragment: the storage workhorse — one (field, view, shard) bit matrix.
+
+Parity target: the reference's fragment (fragment.go:100), redesigned for
+TPU residency.  The reference keeps a mmap'd roaring file updated in place
+with an embedded op log; here the design inverts the layout:
+
+- **Host truth**: a dict of rowID -> dense uint32-packed words (numpy).
+  Mutations apply here first, appended to a sidecar WAL for durability
+  (same recovery semantics as the reference's in-file op log,
+  fragment.go:454, roaring/roaring.go:1612).
+- **Device residency**: dense [rows, words] uint32 tensors cached in HBM,
+  invalidated by a generation counter and re-uploaded lazily — queries
+  then slice HBM directly, so steady-state reads do zero host<->device
+  transfers.  This mirrors the reference's own batching of mutations
+  (opN -> snapshot, fragment.go:84): we batch mutations onto the device.
+- **Snapshot**: when the WAL exceeds max_op_n (default 10000, matching
+  defaultFragmentMaxOpN fragment.go:84) the matrix is rewritten as one
+  atomic snapshot file and the WAL truncated (fragment.go:2296-2345).
+
+BSI fields store bit planes as rows 0..depth+1 of the same matrix
+(fragment.go:91-93) and aggregate/compare through pilosa_tpu.ops.bsi.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+
+import numpy as np
+
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+DEFAULT_MAX_OP_N = 10000
+
+_SNAP_MAGIC = b"PTSF"
+_SNAP_VERSION = 1
+_SNAP_HEADER = struct.Struct("<4sIIQ")  # magic, version, width_exp, n_rows
+_WAL_SET = 1
+_WAL_CLEAR = 2
+_WAL_BULK = 3
+_WAL_REC = struct.Struct("<BQQ")  # op, row, col-offset
+_WAL_BULK_HDR = struct.Struct("<BQQ")  # op, n_set, n_clear
+
+
+class Fragment:
+    """One shard of one view of one field."""
+
+    def __init__(
+        self,
+        path: str | None,
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        mutex: bool = False,
+        max_op_n: int = DEFAULT_MAX_OP_N,
+    ):
+        self.path = path
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.mutex = mutex
+        self.max_op_n = max_op_n
+
+        self.width = SHARD_WIDTH
+        self.n_words = bm.n_words(SHARD_WIDTH)
+
+        self._rows: dict[int, np.ndarray] = {}
+        self._gen = 0
+        self._stack_cache: tuple[int, np.ndarray, np.ndarray] | None = None
+        self._device_cache: dict = {}
+        self._lock = threading.RLock()
+
+        self._wal = None
+        self._op_n = 0
+        if path is not None:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._load()
+            self._wal = open(self._wal_path, "ab")
+
+    # ------------------------------------------------------------------ io
+
+    @property
+    def _snap_path(self) -> str:
+        return self.path + ".snap"
+
+    @property
+    def _wal_path(self) -> str:
+        return self.path + ".wal"
+
+    def _load(self) -> None:
+        if os.path.exists(self._snap_path):
+            with open(self._snap_path, "rb") as f:
+                magic, version, width_exp, n_rows = _SNAP_HEADER.unpack(
+                    f.read(_SNAP_HEADER.size)
+                )
+                if magic != _SNAP_MAGIC or version != _SNAP_VERSION:
+                    raise ValueError(f"bad fragment snapshot {self._snap_path}")
+                if (1 << width_exp) != self.width:
+                    raise ValueError(
+                        f"fragment {self._snap_path} written with shard width "
+                        f"2^{width_exp}, current width is {self.width}"
+                    )
+                row_ids = np.frombuffer(f.read(8 * n_rows), dtype=np.int64)
+                data = np.frombuffer(
+                    f.read(4 * self.n_words * n_rows), dtype=np.uint32
+                ).reshape(n_rows, self.n_words)
+                for rid, words in zip(row_ids, data):
+                    self._rows[int(rid)] = words.copy()
+        self._replay_wal()
+
+    def _replay_wal(self) -> None:
+        if not os.path.exists(self._wal_path):
+            return
+        with open(self._wal_path, "rb") as f:
+            buf = f.read()
+        off, n = 0, len(buf)
+        while off + _WAL_REC.size <= n:
+            op, a, b = _WAL_REC.unpack_from(buf, off)
+            off += _WAL_REC.size
+            if op == _WAL_SET:
+                self._apply_set(a, b)
+                self._op_n += 1
+            elif op == _WAL_CLEAR:
+                self._apply_clear(a, b)
+                self._op_n += 1
+            elif op == _WAL_BULK:
+                n_set, n_clear = a, b
+                need = 8 * (n_set + n_clear)
+                if off + need > n:
+                    break  # torn bulk record: crash mid-append; ignore tail
+                sets = np.frombuffer(buf, dtype=np.uint64, count=n_set, offset=off)
+                off += 8 * n_set
+                clears = np.frombuffer(buf, dtype=np.uint64, count=n_clear, offset=off)
+                off += 8 * n_clear
+                self._apply_bulk(sets.astype(np.int64), clears.astype(np.int64))
+                self._op_n += n_set + n_clear
+            else:
+                break  # corrupt/torn record; ignore tail (same as op-log replay stop)
+        self._gen += 1
+
+    def _wal_append(self, data: bytes) -> None:
+        if self._wal is not None:
+            self._wal.write(data)
+            self._wal.flush()
+
+    def snapshot(self) -> None:
+        """Atomically persist the full matrix and truncate the WAL
+        (reference protectedSnapshot, fragment.go:2325)."""
+        with self._lock:
+            if self.path is None:
+                return
+            row_ids, matrix = self._stacked()
+            tmp = self._snap_path + ".tmp"
+            width_exp = self.width.bit_length() - 1
+            with open(tmp, "wb") as f:
+                f.write(_SNAP_HEADER.pack(_SNAP_MAGIC, _SNAP_VERSION, width_exp, len(row_ids)))
+                f.write(row_ids.astype(np.int64).tobytes())
+                f.write(np.ascontiguousarray(matrix).tobytes())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._snap_path)
+            if self._wal is not None:
+                self._wal.close()
+            self._wal = open(self._wal_path, "wb")
+            self._op_n = 0
+
+    def close(self) -> None:
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
+
+    def _maybe_snapshot(self) -> None:
+        if self.path is not None and self._op_n > self.max_op_n:
+            self.snapshot()
+
+    # ------------------------------------------------------- host mutation
+
+    def _row_array(self, row: int, create: bool = False) -> np.ndarray | None:
+        arr = self._rows.get(row)
+        if arr is None and create:
+            arr = np.zeros(self.n_words, dtype=np.uint32)
+            self._rows[row] = arr
+        return arr
+
+    def _apply_set(self, row: int, off: int) -> bool:
+        arr = self._row_array(row, create=True)
+        w, b = off // bm.WORD_BITS, np.uint32(1) << np.uint32(off % bm.WORD_BITS)
+        changed = not (arr[w] & b)
+        arr[w] |= b
+        return changed
+
+    def _apply_clear(self, row: int, off: int) -> bool:
+        arr = self._rows.get(row)
+        if arr is None:
+            return False
+        w, b = off // bm.WORD_BITS, np.uint32(1) << np.uint32(off % bm.WORD_BITS)
+        changed = bool(arr[w] & b)
+        arr[w] &= ~b
+        return changed
+
+    def _apply_bulk(self, set_pos: np.ndarray, clear_pos: np.ndarray) -> None:
+        """Apply absolute fragment positions (pos = row*width + off)."""
+        for positions, setting in ((set_pos, True), (clear_pos, False)):
+            if len(positions) == 0:
+                continue
+            rows = positions // self.width
+            offs = positions % self.width
+            for rid in np.unique(rows):
+                sel = offs[rows == rid]
+                arr = self._row_array(int(rid), create=setting)
+                if arr is None:
+                    continue
+                vals = bm.pack_positions(sel, self.width)
+                if setting:
+                    arr |= vals
+                else:
+                    arr &= ~vals
+
+    def _offset(self, col: int) -> int:
+        off = col - self.shard * self.width
+        if not (0 <= off < self.width):
+            raise ValueError(f"column {col} out of shard {self.shard} bounds")
+        return off
+
+    def set_bit(self, row: int, col: int) -> bool:
+        """Set one bit; enforces mutex semantics when the owning field is a
+        mutex/bool field (reference handleMutex, fragment.go:670,3096)."""
+        with self._lock:
+            off = self._offset(col)
+            changed = False
+            if self.mutex:
+                for other_id, arr in self._rows.items():
+                    if other_id == row:
+                        continue
+                    w, b = off // bm.WORD_BITS, np.uint32(1) << np.uint32(off % bm.WORD_BITS)
+                    if arr[w] & b:
+                        arr[w] &= ~b
+                        self._wal_append(_WAL_REC.pack(_WAL_CLEAR, other_id, off))
+                        self._op_n += 1
+                        changed = True
+            if self._apply_set(row, off):
+                changed = True
+                self._wal_append(_WAL_REC.pack(_WAL_SET, row, off))
+                self._op_n += 1
+            if changed:
+                self._gen += 1
+            self._maybe_snapshot()
+            return changed
+
+    def clear_bit(self, row: int, col: int) -> bool:
+        with self._lock:
+            off = self._offset(col)
+            if self._apply_clear(row, off):
+                self._wal_append(_WAL_REC.pack(_WAL_CLEAR, row, off))
+                self._op_n += 1
+                self._gen += 1
+                self._maybe_snapshot()
+                return True
+            return False
+
+    def clear_row(self, row: int) -> bool:
+        """Remove all bits in a row (ClearRow support, fragment clearRow)."""
+        with self._lock:
+            arr = self._rows.pop(row, None)
+            if arr is None or not arr.any():
+                return False
+            offs = bm.unpack_positions(arr)
+            pos = (row * self.width + offs).astype(np.uint64)
+            self._wal_append(
+                _WAL_BULK_HDR.pack(_WAL_BULK, 0, len(pos)) + pos.tobytes()
+            )
+            self._op_n += len(pos)
+            self._gen += 1
+            self._maybe_snapshot()
+            return True
+
+    def set_row(self, row: int, words: np.ndarray) -> bool:
+        """Replace a row wholesale (Store() support, fragment setRow)."""
+        with self._lock:
+            old = self._rows.get(row)
+            new = np.asarray(words, dtype=np.uint32).copy()
+            if old is not None and np.array_equal(old, new):
+                return False
+            self._rows[row] = new
+            sets = (row * self.width + bm.unpack_positions(new)).astype(np.uint64)
+            clears = np.empty(0, dtype=np.uint64)
+            if old is not None:
+                gone = old & ~new
+                clears = (row * self.width + bm.unpack_positions(gone)).astype(np.uint64)
+            self._wal_append(
+                _WAL_BULK_HDR.pack(_WAL_BULK, len(sets), len(clears))
+                + sets.tobytes() + clears.tobytes()
+            )
+            self._op_n += len(sets) + len(clears)
+            self._gen += 1
+            self._maybe_snapshot()
+            return True
+
+    def import_positions(self, set_pos, clear_pos=()) -> None:
+        """Bulk import of absolute fragment positions (pos = row*width+off);
+        the fast ingest path (reference importPositions, fragment.go:2053)."""
+        with self._lock:
+            sets = np.asarray(sorted(set_pos), dtype=np.uint64)
+            clears = np.asarray(sorted(clear_pos), dtype=np.uint64)
+            if len(sets) == 0 and len(clears) == 0:
+                return
+            self._apply_bulk(sets.astype(np.int64), clears.astype(np.int64))
+            self._wal_append(
+                _WAL_BULK_HDR.pack(_WAL_BULK, len(sets), len(clears))
+                + sets.tobytes() + clears.tobytes()
+            )
+            self._op_n += len(sets) + len(clears)
+            self._gen += 1
+            self._maybe_snapshot()
+
+    # -------------------------------------------------------- host queries
+
+    def bit(self, row: int, col: int) -> bool:
+        off = self._offset(col)
+        arr = self._rows.get(row)
+        if arr is None:
+            return False
+        return bool(arr[off // bm.WORD_BITS] & (np.uint32(1) << np.uint32(off % bm.WORD_BITS)))
+
+    def row(self, row: int) -> np.ndarray:
+        """Packed words for one row (copy)."""
+        arr = self._rows.get(row)
+        if arr is None:
+            return np.zeros(self.n_words, dtype=np.uint32)
+        return arr.copy()
+
+    def row_ids(self) -> list[int]:
+        return sorted(r for r, a in self._rows.items() if a.any())
+
+    def row_count(self, row: int) -> int:
+        arr = self._rows.get(row)
+        return 0 if arr is None else int(np.bitwise_count(arr).sum())
+
+    def min_row_id(self):
+        ids = self.row_ids()
+        return ids[0] if ids else None
+
+    def max_row_id(self):
+        ids = self.row_ids()
+        return ids[-1] if ids else None
+
+    # ------------------------------------------------------ device tensors
+
+    def _stacked(self) -> tuple[np.ndarray, np.ndarray]:
+        """(row_ids int64[R], matrix uint32[R, words]) — cached per generation."""
+        with self._lock:
+            if self._stack_cache is not None and self._stack_cache[0] == self._gen:
+                return self._stack_cache[1], self._stack_cache[2]
+            ids = np.array(self.row_ids(), dtype=np.int64)
+            if len(ids) == 0:
+                matrix = np.zeros((0, self.n_words), dtype=np.uint32)
+            else:
+                matrix = np.stack([self._rows[int(r)] for r in ids]).copy()
+            self._stack_cache = (self._gen, ids, matrix)
+            return ids, matrix
+
+    def device_matrix(self):
+        """(row_ids, jax uint32[R, words]) resident in device memory."""
+        import jax
+
+        with self._lock:
+            key = "matrix"
+            hit = self._device_cache.get(key)
+            if hit is not None and hit[0] == self._gen:
+                return hit[1], hit[2]
+            ids, matrix = self._stacked()
+            dev = jax.device_put(matrix)
+            self._device_cache[key] = (self._gen, ids, dev)
+            return ids, dev
+
+    def device_row(self, row: int):
+        """One row as a device array, sliced from the resident matrix."""
+        import jax.numpy as jnp
+
+        ids, dev = self.device_matrix()
+        slot = np.searchsorted(ids, row)
+        if slot >= len(ids) or ids[slot] != row:
+            return jnp.zeros(self.n_words, dtype=jnp.uint32)
+        return dev[int(slot)]
+
+    def device_planes(self, depth: int):
+        """BSI plane stack uint32[2 + depth, words] resident on device."""
+        import jax
+
+        with self._lock:
+            key = ("planes", depth)
+            hit = self._device_cache.get(key)
+            if hit is not None and hit[0] == self._gen:
+                return hit[1]
+            P = np.zeros((bsi_ops.OFFSET_PLANE + depth, self.n_words), dtype=np.uint32)
+            for i in range(P.shape[0]):
+                arr = self._rows.get(i)
+                if arr is not None:
+                    P[i] = arr
+            dev = jax.device_put(P)
+            self._device_cache[key] = (self._gen, dev)
+            return dev
+
+    # ------------------------------------------------------------ BSI ops
+
+    def _bsi_base_rows(self, depth: int, filter_words=None):
+        """(P, exists, sign, consider) device values shared by BSI ops."""
+        import jax
+        import jax.numpy as jnp
+
+        P = self.device_planes(depth)
+        exists = P[bsi_ops.EXISTS_PLANE]
+        sign = P[bsi_ops.SIGN_PLANE]
+        consider = exists
+        if filter_words is not None:
+            consider = consider & jax.device_put(np.asarray(filter_words, dtype=np.uint32))
+        return P, exists, sign, consider
+
+    def set_value(self, col: int, depth: int, value: int) -> bool:
+        """Write a base-relative signed value as bit planes
+        (reference setValueBase, fragment.go:977)."""
+        uvalue = -value if value < 0 else value
+        changed = False
+        off = self._offset(col)
+        with self._lock:
+            for i in range(depth):
+                plane = bsi_ops.OFFSET_PLANE + i
+                if (uvalue >> i) & 1:
+                    changed |= self._apply_set(plane, off)
+                    self._wal_append(_WAL_REC.pack(_WAL_SET, plane, off))
+                else:
+                    changed |= self._apply_clear(plane, off)
+                    self._wal_append(_WAL_REC.pack(_WAL_CLEAR, plane, off))
+                self._op_n += 1
+            changed |= self._apply_set(bsi_ops.EXISTS_PLANE, off)
+            self._wal_append(_WAL_REC.pack(_WAL_SET, bsi_ops.EXISTS_PLANE, off))
+            if value < 0:
+                changed |= self._apply_set(bsi_ops.SIGN_PLANE, off)
+                self._wal_append(_WAL_REC.pack(_WAL_SET, bsi_ops.SIGN_PLANE, off))
+            else:
+                changed |= self._apply_clear(bsi_ops.SIGN_PLANE, off)
+                self._wal_append(_WAL_REC.pack(_WAL_CLEAR, bsi_ops.SIGN_PLANE, off))
+            self._op_n += 2
+            self._gen += 1
+            self._maybe_snapshot()
+        return changed
+
+    def clear_value(self, col: int, depth: int) -> bool:
+        off = self._offset(col)
+        with self._lock:
+            changed = self._apply_clear(bsi_ops.EXISTS_PLANE, off)
+            if changed:
+                self._wal_append(_WAL_REC.pack(_WAL_CLEAR, bsi_ops.EXISTS_PLANE, off))
+                self._op_n += 1
+                self._gen += 1
+        return changed
+
+    def value(self, col: int, depth: int) -> tuple[int, bool]:
+        """Read one column's base-relative value (reference fragment.value,
+        fragment.go:896)."""
+        if not self.bit(bsi_ops.EXISTS_PLANE, col):
+            return 0, False
+        v = 0
+        for i in range(depth):
+            if self.bit(bsi_ops.OFFSET_PLANE + i, col):
+                v |= 1 << i
+        if self.bit(bsi_ops.SIGN_PLANE, col):
+            v = -v
+        return v, True
+
+    def sum(self, filter_words, depth: int) -> tuple[int, int]:
+        """(base-relative sum, count) — device plane counts, exact host
+        accumulation (reference fragment.sum, fragment.go:1111)."""
+        from pilosa_tpu.ops.bitmap import popcount
+
+        P, _, _, consider = self._bsi_base_rows(depth, filter_words)
+        pos, neg = bsi_ops.plane_counts(P, consider)
+        pos, neg = np.asarray(pos), np.asarray(neg)
+        total = sum((int(p) - int(n)) << i for i, (p, n) in enumerate(zip(pos, neg)))
+        count = int(popcount(consider))
+        return total, count
+
+    def min(self, filter_words, depth: int) -> tuple[int, int]:
+        """(base-relative min, count) (reference fragment.min, fragment.go:1147)."""
+        from pilosa_tpu.ops.bitmap import popcount
+
+        P, _, sign, consider = self._bsi_base_rows(depth, filter_words)
+        if int(popcount(consider)) == 0:
+            return 0, 0
+        negs = consider & sign
+        if int(popcount(negs)) > 0:
+            taken, count = bsi_ops.extreme_max(P, negs)
+            return -bsi_ops.assemble_value(taken), int(count)
+        taken, count = bsi_ops.extreme_min(P, consider)
+        return bsi_ops.assemble_value(taken), int(count)
+
+    def max(self, filter_words, depth: int) -> tuple[int, int]:
+        """(base-relative max, count) (reference fragment.max, fragment.go:1191)."""
+        from pilosa_tpu.ops.bitmap import popcount
+
+        P, _, sign, consider = self._bsi_base_rows(depth, filter_words)
+        if int(popcount(consider)) == 0:
+            return 0, 0
+        pos = consider & ~sign
+        if int(popcount(pos)) == 0:
+            taken, count = bsi_ops.extreme_min(P, consider)
+            return -bsi_ops.assemble_value(taken), int(count)
+        taken, count = bsi_ops.extreme_max(P, pos)
+        return bsi_ops.assemble_value(taken), int(count)
+
+    def not_null(self, depth: int) -> np.ndarray:
+        """Existence row (reference notNull, fragment.go:1460)."""
+        return self.row(bsi_ops.EXISTS_PLANE)
+
+    def range_op(self, op: str, depth: int, predicate: int) -> np.ndarray:
+        """BSI comparison -> packed words for this shard.  op in
+        {'==','!=','<','<=','>','>='} (reference rangeOp, fragment.go:1273)."""
+        import jax.numpy as jnp
+
+        P, exists, sign, _ = self._bsi_base_rows(depth)
+        upred = -predicate if predicate < 0 else predicate
+        lo, hi = bsi_ops.split_predicate(upred)
+
+        def u_lt(filt, lo, hi, allow_eq):
+            lt, eq = bsi_ops.compare(P, filt, lo, hi)
+            return lt | eq if allow_eq else lt
+
+        def u_gt(filt, lo, hi, allow_eq):
+            lt, eq = bsi_ops.compare(P, filt, lo, hi)
+            gt = filt & ~lt & ~eq
+            return gt | eq if allow_eq else gt
+
+        # Sign dispatch: predicate >= 0 -> compare magnitudes among
+        # positives (negatives are all smaller); predicate < 0 -> compare
+        # among negatives with the order inverted.  NOTE: deliberate
+        # divergence from the reference here — its rangeLT/rangeGT route
+        # `predicate == -1 && !allowEquality` through the positive branch
+        # with upredicate=1 (fragment.go:1343,1412), which drops 0/±1
+        # columns from `> -1` and adds 0-columns to `< -1`; that edge is
+        # untested upstream (executor_test.go only pins the min/max
+        # shortcut paths), so we use correct integer semantics instead.
+        if op == "==":
+            base = exists & sign if predicate < 0 else exists & ~sign
+            _, eq = bsi_ops.compare(P, base, lo, hi)
+            out = eq
+        elif op == "!=":
+            base = exists & sign if predicate < 0 else exists & ~sign
+            _, eq = bsi_ops.compare(P, base, lo, hi)
+            out = exists & ~eq
+        elif op in ("<", "<="):
+            allow_eq = op == "<="
+            if predicate >= 0:
+                pos_part = u_lt(exists & ~sign, lo, hi, allow_eq)
+                out = (exists & sign) | pos_part
+            else:
+                out = u_gt(exists & sign, lo, hi, allow_eq)
+        elif op in (">", ">="):
+            allow_eq = op == ">="
+            if predicate >= 0:
+                out = u_gt(exists & ~sign, lo, hi, allow_eq)
+            else:
+                neg_part = u_lt(exists & sign, lo, hi, allow_eq)
+                out = (exists & ~sign) | neg_part
+        else:
+            raise ValueError(f"invalid range operation: {op}")
+        return np.asarray(out)
+
+    def range_between(self, depth: int, pred_min: int, pred_max: int) -> np.ndarray:
+        """BSI between [min, max] inclusive (reference rangeBetween,
+        fragment.go:1465)."""
+        P, exists, sign, _ = self._bsi_base_rows(depth)
+
+        def u_between(filt, ulo, uhi):
+            lo1, hi1 = bsi_ops.split_predicate(ulo)
+            lo2, hi2 = bsi_ops.split_predicate(uhi)
+            lt1, eq1 = bsi_ops.compare(P, filt, lo1, hi1)
+            lt2, eq2 = bsi_ops.compare(P, filt, lo2, hi2)
+            gte_lo = filt & ~lt1
+            lte_hi = lt2 | eq2
+            return gte_lo & lte_hi
+
+        if pred_min >= 0:
+            out = u_between(exists & ~sign, pred_min, pred_max)
+        elif pred_max < 0:
+            out = u_between(exists & sign, -pred_max, -pred_min)
+        else:
+            lo2, hi2 = bsi_ops.split_predicate(pred_max)
+            lt2, eq2 = bsi_ops.compare(P, exists & ~sign, lo2, hi2)
+            pos = lt2 | eq2
+            lo1, hi1 = bsi_ops.split_predicate(-pred_min)
+            lt1, eq1 = bsi_ops.compare(P, exists & sign, lo1, hi1)
+            neg = lt1 | eq1
+            out = pos | neg
+        return np.asarray(out)

@@ -1,0 +1,119 @@
+"""Holder: all data on one node — a directory of indexes.
+
+Parity with the reference's Holder (holder.go:50,137): opens every index
+directory under the data path, exposes schema, and owns node identity.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+
+from pilosa_tpu.models.index import Index, IndexOptions
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class Holder:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self.indexes: dict[str, Index] = {}
+        self._lock = threading.RLock()
+        self.node_id: str = ""
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            self._load_node_id()
+            self._open_indexes()
+        else:
+            self.node_id = uuid.uuid4().hex
+
+    def _load_node_id(self) -> None:
+        """Stable node identity in a .id file (reference holder.go:599)."""
+        idp = os.path.join(self.path, ".id")
+        if os.path.exists(idp):
+            with open(idp) as f:
+                self.node_id = f.read().strip()
+        else:
+            self.node_id = uuid.uuid4().hex
+            with open(idp, "w") as f:
+                f.write(self.node_id)
+
+    def _open_indexes(self) -> None:
+        for name in sorted(os.listdir(self.path)):
+            idir = os.path.join(self.path, name)
+            if os.path.isdir(idir) and os.path.exists(os.path.join(idir, ".meta")):
+                self.indexes[name] = Index(idir, name)
+
+    def index(self, name: str) -> Index | None:
+        return self.indexes.get(name)
+
+    def create_index(self, name: str, options: IndexOptions | None = None) -> Index:
+        with self._lock:
+            if name in self.indexes:
+                raise ValueError(f"index already exists: {name}")
+            return self._create_index(name, options)
+
+    def create_index_if_not_exists(self, name: str, options: IndexOptions | None = None) -> Index:
+        with self._lock:
+            idx = self.indexes.get(name)
+            if idx is not None:
+                return idx
+            return self._create_index(name, options)
+
+    def _create_index(self, name: str, options: IndexOptions | None) -> Index:
+        path = None if self.path is None else os.path.join(self.path, name)
+        idx = Index(path, name, options or IndexOptions())
+        self.indexes[name] = idx
+        return idx
+
+    def delete_index(self, name: str) -> None:
+        with self._lock:
+            idx = self.indexes.pop(name, None)
+            if idx is None:
+                raise KeyError(f"index not found: {name}")
+            idx.close()
+            if idx.path is not None:
+                import shutil
+
+                shutil.rmtree(idx.path, ignore_errors=True)
+
+    def schema(self) -> list[dict]:
+        """JSON-able schema description (reference Holder.Schema,
+        holder.go:284)."""
+        out = []
+        for iname, idx in sorted(self.indexes.items()):
+            fields = []
+            for f in idx.public_fields():
+                fields.append({"name": f.name, "options": f.options.to_dict()})
+            out.append(
+                {
+                    "name": iname,
+                    "options": idx.options.to_dict(),
+                    "fields": fields,
+                    "shardWidth": SHARD_WIDTH,
+                }
+            )
+        return out
+
+    def apply_schema(self, schema: list[dict]) -> None:
+        """Create any missing indexes/fields from a schema description
+        (reference applySchema, holder.go:327)."""
+        from pilosa_tpu.models.field import FieldOptions
+
+        for idesc in schema:
+            idx = self.create_index_if_not_exists(
+                idesc["name"], IndexOptions.from_dict(idesc.get("options", {}))
+            )
+            for fdesc in idesc.get("fields", []):
+                idx.create_field_if_not_exists(
+                    fdesc["name"], FieldOptions.from_dict(fdesc.get("options", {}))
+                )
+
+    def close(self) -> None:
+        for idx in self.indexes.values():
+            idx.close()
+
+    def snapshot(self) -> None:
+        for idx in self.indexes.values():
+            idx.snapshot()

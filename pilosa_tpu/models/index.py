@@ -1,0 +1,142 @@
+"""Index: a namespace of fields sharing one column space.
+
+Parity with the reference's Index (index.go:37): options ``keys`` (string
+key translation) and ``track_existence`` (maintains a hidden ``_exists``
+field recording which columns have any data, index.go:214,530).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+
+from pilosa_tpu.models.field import Field, FieldOptions, validate_name
+
+# Name of the hidden existence field (reference existenceFieldName,
+# holder.go:46).
+EXISTENCE_FIELD = "_exists"
+
+
+@dataclass
+class IndexOptions:
+    keys: bool = False
+    track_existence: bool = True
+
+    def to_dict(self) -> dict:
+        return {"keys": self.keys, "trackExistence": self.track_existence}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "IndexOptions":
+        return cls(
+            keys=d.get("keys", False),
+            track_existence=d.get("trackExistence", True),
+        )
+
+
+class Index:
+    def __init__(self, path: str | None, name: str, options: IndexOptions | None = None):
+        validate_name(name)
+        self.path = path
+        self.name = name
+        self.options = options or IndexOptions()
+        self.fields: dict[str, Field] = {}
+        self._lock = threading.RLock()
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            self._load_meta()
+            self._open_fields()
+        if self.options.track_existence and EXISTENCE_FIELD not in self.fields:
+            self._create_existence_field()
+
+    @property
+    def _meta_path(self) -> str:
+        return os.path.join(self.path, ".meta")
+
+    def _load_meta(self) -> None:
+        if os.path.exists(self._meta_path):
+            with open(self._meta_path) as f:
+                self.options = IndexOptions.from_dict(json.load(f))
+        else:
+            self.save_meta()
+
+    def save_meta(self) -> None:
+        if self.path is None:
+            return
+        tmp = self._meta_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.options.to_dict(), f)
+        os.replace(tmp, self._meta_path)
+
+    def _open_fields(self) -> None:
+        for name in sorted(os.listdir(self.path)):
+            fdir = os.path.join(self.path, name)
+            if os.path.isdir(fdir) and os.path.exists(os.path.join(fdir, ".meta")):
+                self.fields[name] = Field(fdir, self.name, name, FieldOptions())
+
+    def _create_existence_field(self) -> None:
+        path = None if self.path is None else os.path.join(self.path, EXISTENCE_FIELD)
+        self.fields[EXISTENCE_FIELD] = Field(
+            path, self.name, EXISTENCE_FIELD, FieldOptions.set_field(cache_type="none")
+        )
+
+    # -------------------------------------------------------------- fields
+
+    def field(self, name: str) -> Field | None:
+        return self.fields.get(name)
+
+    def existence_field(self) -> Field | None:
+        return self.fields.get(EXISTENCE_FIELD) if self.options.track_existence else None
+
+    def create_field(self, name: str, options: FieldOptions | None = None) -> Field:
+        with self._lock:
+            if name in self.fields:
+                raise ValueError(f"field already exists: {name}")
+            return self._create_field(name, options or FieldOptions())
+
+    def create_field_if_not_exists(self, name: str, options: FieldOptions | None = None) -> Field:
+        with self._lock:
+            f = self.fields.get(name)
+            if f is not None:
+                return f
+            return self._create_field(name, options or FieldOptions())
+
+    def _create_field(self, name: str, options: FieldOptions) -> Field:
+        validate_name(name)
+        path = None if self.path is None else os.path.join(self.path, name)
+        f = Field(path, self.name, name, options)
+        self.fields[name] = f
+        return f
+
+    def delete_field(self, name: str) -> None:
+        with self._lock:
+            f = self.fields.pop(name, None)
+            if f is None:
+                raise KeyError(f"field not found: {name}")
+            f.close()
+            if f.path is not None:
+                import shutil
+
+                shutil.rmtree(f.path, ignore_errors=True)
+
+    def public_fields(self) -> list[Field]:
+        return [f for n, f in sorted(self.fields.items()) if not n.startswith("_")]
+
+    # -------------------------------------------------------------- shards
+
+    def available_shards(self) -> set[int]:
+        """Union of per-field shard sets (reference AvailableShards,
+        index.go:292)."""
+        shards: set[int] = set()
+        for f in self.fields.values():
+            shards |= f.available_shards()
+        return shards
+
+    def close(self) -> None:
+        for f in self.fields.values():
+            f.close()
+
+    def snapshot(self) -> None:
+        for f in self.fields.values():
+            f.snapshot()

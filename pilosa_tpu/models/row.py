@@ -1,0 +1,123 @@
+"""Row: a query-result bitmap spanning shards.
+
+Parity with the reference's Row/rowSegment (row.go:27,332): results are
+kept as one packed-word segment per shard; set algebra distributes over
+segments and cross-node/cross-shard merge is a per-shard union.  Segments
+live host-side as numpy uint32 words — per-shard compute stays on device
+inside the executor and materializes here at reduce time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class Row:
+    __slots__ = ("segments", "attrs", "keys")
+
+    def __init__(self, segments: dict[int, np.ndarray] | None = None):
+        # shard -> uint32[SHARD_WIDTH/32]
+        self.segments: dict[int, np.ndarray] = segments or {}
+        self.attrs: dict = {}
+        self.keys: list[str] = []
+
+    # -- constructors -------------------------------------------------------
+
+    @classmethod
+    def from_columns(cls, columns) -> "Row":
+        row = cls()
+        for col in columns:
+            row.set(int(col))
+        return row
+
+    def set(self, col: int) -> None:
+        shard, off = divmod(col, SHARD_WIDTH)
+        seg = self.segments.get(shard)
+        if seg is None:
+            seg = np.zeros(bm.n_words(SHARD_WIDTH), dtype=np.uint32)
+            self.segments[shard] = seg
+        seg[off // bm.WORD_BITS] |= np.uint32(1) << np.uint32(off % bm.WORD_BITS)
+
+    # -- set algebra (host reduce path) -------------------------------------
+
+    def _binary(self, other: "Row", fn, keep_left=False, keep_right=False) -> "Row":
+        out: dict[int, np.ndarray] = {}
+        shards = set(self.segments)
+        if keep_right:
+            shards |= set(other.segments)
+        elif not keep_left:
+            shards &= set(other.segments)
+        zeros = None
+        for s in shards:
+            a = self.segments.get(s)
+            b = other.segments.get(s)
+            if a is None or b is None:
+                if zeros is None:
+                    zeros = np.zeros(bm.n_words(SHARD_WIDTH), dtype=np.uint32)
+                a = a if a is not None else zeros
+                b = b if b is not None else zeros
+            out[s] = fn(a, b)
+        return Row(out)
+
+    def intersect(self, other: "Row") -> "Row":
+        return self._binary(other, np.bitwise_and)
+
+    def union(self, other: "Row") -> "Row":
+        return self._binary(other, np.bitwise_or, keep_right=True, keep_left=True)
+
+    def difference(self, other: "Row") -> "Row":
+        return self._binary(
+            other, lambda a, b: a & ~b, keep_left=True
+        )
+
+    def xor(self, other: "Row") -> "Row":
+        return self._binary(other, np.bitwise_xor, keep_right=True, keep_left=True)
+
+    def merge(self, other: "Row") -> None:
+        """In-place union; cross-node reduce (row.go Merge)."""
+        for s, seg in other.segments.items():
+            mine = self.segments.get(s)
+            self.segments[s] = seg.copy() if mine is None else (mine | seg)
+
+    # -- introspection ------------------------------------------------------
+
+    def count(self) -> int:
+        return sum(int(np.bitwise_count(seg).sum()) for seg in self.segments.values())
+
+    def any(self) -> bool:
+        return any(seg.any() for seg in self.segments.values())
+
+    def columns(self) -> np.ndarray:
+        """Sorted absolute column ids."""
+        parts = []
+        for s in sorted(self.segments):
+            pos = bm.unpack_positions(self.segments[s])
+            parts.append(pos + s * SHARD_WIDTH)
+        if not parts:
+            return np.empty(0, dtype=np.int64)
+        return np.concatenate(parts)
+
+    def shard_segment(self, shard: int) -> np.ndarray | None:
+        return self.segments.get(shard)
+
+    def intersection_count(self, other: "Row") -> int:
+        total = 0
+        for s, seg in self.segments.items():
+            o = other.segments.get(s)
+            if o is not None:
+                total += int(np.bitwise_count(seg & o).sum())
+        return total
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, Row):
+            return NotImplemented
+        return np.array_equal(self.columns(), other.columns())
+
+    def __repr__(self) -> str:
+        cols = self.columns()
+        head = ", ".join(str(c) for c in cols[:8])
+        more = "..." if len(cols) > 8 else ""
+        return f"Row([{head}{more}] n={len(cols)})"

@@ -1,0 +1,107 @@
+"""View: one physical layout of a field, owning fragments by shard.
+
+Parity with the reference's view (view.go:44): a field has a "standard"
+view, time-quantum views named standard_YYYYMMDDHH etc., and BSI views
+named bsig_<field> (view.go:37-41).  The view routes bits to the fragment
+owning the column's shard and creates fragments on first write
+(view.go:263 CreateFragmentIfNotExists).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from pilosa_tpu.models.fragment import Fragment
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+VIEW_STANDARD = "standard"
+VIEW_BSI_PREFIX = "bsig_"
+
+
+class View:
+    def __init__(
+        self,
+        path: str | None,
+        index: str,
+        field: str,
+        name: str,
+        mutex: bool = False,
+    ):
+        self.path = path
+        self.index = index
+        self.field = field
+        self.name = name
+        self.mutex = mutex
+        self.fragments: dict[int, Fragment] = {}
+        if path is not None:
+            os.makedirs(self._frag_dir, exist_ok=True)
+            self._open_fragments()
+
+    @property
+    def _frag_dir(self) -> str:
+        return os.path.join(self.path, "fragments")
+
+    def _frag_path(self, shard: int) -> str:
+        return os.path.join(self._frag_dir, str(shard))
+
+    def _open_fragments(self) -> None:
+        seen = set()
+        for fn in os.listdir(self._frag_dir):
+            base = fn.rsplit(".", 1)[0]
+            if base.isdigit():
+                seen.add(int(base))
+        for shard in sorted(seen):
+            self.fragments[shard] = Fragment(
+                self._frag_path(shard), self.index, self.field, self.name,
+                shard, mutex=self.mutex,
+            )
+
+    def fragment(self, shard: int) -> Fragment | None:
+        return self.fragments.get(shard)
+
+    def create_fragment_if_not_exists(self, shard: int) -> Fragment:
+        frag = self.fragments.get(shard)
+        if frag is None:
+            path = None if self.path is None else self._frag_path(shard)
+            frag = Fragment(
+                path, self.index, self.field, self.name, shard, mutex=self.mutex
+            )
+            self.fragments[shard] = frag
+        return frag
+
+    def available_shards(self) -> set[int]:
+        return set(self.fragments)
+
+    # -- bit ops ------------------------------------------------------------
+
+    def set_bit(self, row: int, col: int) -> bool:
+        return self.create_fragment_if_not_exists(col // SHARD_WIDTH).set_bit(row, col)
+
+    def clear_bit(self, row: int, col: int) -> bool:
+        frag = self.fragment(col // SHARD_WIDTH)
+        return False if frag is None else frag.clear_bit(row, col)
+
+    def row(self, row_id: int, shard: int) -> np.ndarray | None:
+        frag = self.fragment(shard)
+        return None if frag is None else frag.row(row_id)
+
+    # -- BSI ops ------------------------------------------------------------
+
+    def set_value(self, col: int, depth: int, value: int) -> bool:
+        return self.create_fragment_if_not_exists(col // SHARD_WIDTH).set_value(
+            col, depth, value
+        )
+
+    def value(self, col: int, depth: int) -> tuple[int, bool]:
+        frag = self.fragment(col // SHARD_WIDTH)
+        return (0, False) if frag is None else frag.value(col, depth)
+
+    def close(self) -> None:
+        for frag in self.fragments.values():
+            frag.close()
+
+    def snapshot(self) -> None:
+        for frag in self.fragments.values():
+            frag.snapshot()

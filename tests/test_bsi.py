@@ -1,0 +1,173 @@
+"""BSI differential tests: device bit-sliced kernels vs a dict oracle.
+
+Covers the reference's range/aggregate semantics (fragment.go:1111-1537)
+including negatives, sign boundaries, and the LT/GT edge cases.
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.models.fragment import Fragment
+from pilosa_tpu.ops.bitmap import unpack_positions
+
+DEPTH = 12
+RNG = np.random.default_rng(11)
+
+
+@pytest.fixture(scope="module")
+def frag_and_oracle():
+    f = Fragment(None, "i", "f", "bsig_f", 0)
+    oracle = {}
+    cols = RNG.choice(5000, size=400, replace=False)
+    for c in cols:
+        v = int(RNG.integers(-(1 << DEPTH) + 1, (1 << DEPTH) - 1))
+        f.set_value(int(c), DEPTH, v)
+        oracle[int(c)] = v
+    # Pin sign-boundary values so predicate edge cases are never vacuous.
+    for c, v in zip(range(5001, 5008), (-2, -1, 0, 1, 2, -4095, 4095)):
+        f.set_value(c, DEPTH, v)
+        oracle[c] = v
+    return f, oracle
+
+
+@pytest.fixture(scope="module")
+def field_and_oracle(frag_and_oracle):
+    """Field wrapping an equivalent dataset — the real range-query surface
+    (predicates are base-translated before hitting the fragment, as in
+    executor.go:1637)."""
+    from pilosa_tpu.models.field import Field, FieldOptions
+
+    lo, hi = -(1 << DEPTH) + 1, (1 << DEPTH) - 1
+    f = Field(None, "i", "n", FieldOptions.int_field(lo, hi))
+    _, oracle = frag_and_oracle
+    for c, v in oracle.items():
+        f.set_value(c, v)
+    return f, oracle
+
+
+def cols_of(words):
+    if words is None:
+        return set()
+    return set(int(p) for p in unpack_positions(np.asarray(words)))
+
+
+def test_value_roundtrip(frag_and_oracle):
+    f, oracle = frag_and_oracle
+    for c, v in list(oracle.items())[:50]:
+        assert f.value(c, DEPTH) == (v, True)
+    missing = next(i for i in range(5000) if i not in oracle)
+    assert f.value(missing, DEPTH) == (0, False)
+
+
+def test_sum_count(frag_and_oracle):
+    f, oracle = frag_and_oracle
+    s, c = f.sum(None, DEPTH)
+    assert s == sum(oracle.values())
+    assert c == len(oracle)
+
+
+def test_sum_with_filter(frag_and_oracle):
+    f, oracle = frag_and_oracle
+    from pilosa_tpu.ops.bitmap import pack_positions
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    keep = [c for c in oracle if c % 3 == 0]
+    filt = pack_positions(keep, SHARD_WIDTH)
+    s, c = f.sum(filt, DEPTH)
+    assert s == sum(oracle[k] for k in keep)
+    assert c == len(keep)
+
+
+def test_min_max(frag_and_oracle):
+    f, oracle = frag_and_oracle
+    vals = list(oracle.values())
+    mn, mn_cnt = f.min(None, DEPTH)
+    mx, mx_cnt = f.max(None, DEPTH)
+    assert mn == min(vals)
+    assert mx == max(vals)
+    assert mn_cnt == vals.count(min(vals))
+    assert mx_cnt == vals.count(max(vals))
+
+
+@pytest.mark.parametrize("op", ["==", "!=", "<", "<=", ">", ">="])
+@pytest.mark.parametrize("pred", [-4096, -100, -1, 0, 1, 77, 4095])
+def test_range_ops(field_and_oracle, op, pred):
+    f, oracle = field_and_oracle
+    got = cols_of(f.range_op(op, pred, 0))
+    py_op = {
+        "==": lambda v: v == pred,
+        "!=": lambda v: v != pred,
+        "<": lambda v: v < pred,
+        "<=": lambda v: v <= pred,
+        ">": lambda v: v > pred,
+        ">=": lambda v: v >= pred,
+    }[op]
+    # True integer semantics, including at the sign boundary (deliberate
+    # divergence from the reference's untested `predicate == -1` quirk —
+    # see Fragment.range_op).
+    want = {c for c, v in oracle.items() if py_op(v)}
+    assert got == want, f"op={op} pred={pred}"
+
+
+@pytest.mark.parametrize(
+    "lo,hi",
+    [(-4095, 4095), (0, 100), (-100, 0), (-100, 100), (50, 49), (77, 77), (-77, -77)],
+)
+def test_range_between(field_and_oracle, lo, hi):
+    f, oracle = field_and_oracle
+    got = cols_of(f.range_between(lo, hi, 0))
+    want = {c for c, v in oracle.items() if lo <= v <= hi}
+    assert got == want, f"between {lo} {hi}"
+
+
+def test_not_null(frag_and_oracle):
+    f, oracle = frag_and_oracle
+    assert cols_of(f.not_null(DEPTH)) == set(oracle)
+
+
+def test_gt_at_exact_minimum():
+    """Regression: `> min` where min == bit_depth_min must return every
+    column except the minimum (the reference's baseValue clamps this to
+    `> base`, silently dropping all negatives)."""
+    from pilosa_tpu.models.field import Field, FieldOptions
+
+    f = Field(None, "i", "n", FieldOptions.int_field(-7, 0))
+    data = {1: -7, 2: -6, 3: -3, 4: 0}
+    for c, v in data.items():
+        f.set_value(c, v)
+    got = cols_of(f.range_op(">", -7, 0))
+    assert got == {2, 3, 4}
+    got = cols_of(f.range_op(">=", -7, 0))  # whole range -> not-null shortcut
+    assert got == {1, 2, 3, 4}
+    got = cols_of(f.range_op("<", -6, 0))
+    assert got == {1}
+
+
+def test_split_predicate_bounds():
+    from pilosa_tpu.ops.bsi import split_predicate
+
+    with pytest.raises(ValueError):
+        split_predicate(1 << 64)
+    with pytest.raises(ValueError):
+        split_predicate(-1)
+    lo, hi = split_predicate((1 << 64) - 1)
+    assert lo == 0xFFFFFFFF and hi == 0xFFFFFFFF
+
+
+def test_clear_value():
+    f = Fragment(None, "i", "f", "bsig_f", 0)
+    f.set_value(5, 8, 77)
+    assert f.value(5, 8) == (77, True)
+    assert f.clear_value(5, 8)
+    assert f.value(5, 8) == (0, False)
+    s, c = f.sum(None, 8)
+    assert (s, c) == (0, 0)
+
+
+def test_overwrite_value():
+    f = Fragment(None, "i", "f", "bsig_f", 0)
+    f.set_value(5, 8, 100)
+    f.set_value(5, 8, -3)
+    assert f.value(5, 8) == (-3, True)
+    s, c = f.sum(None, 8)
+    assert (s, c) == (-3, 1)

@@ -1,0 +1,241 @@
+"""Field/Index/Holder tests: types, time views, shards, schema, reopen.
+
+Mirrors the reference's field_test.go / index_test.go / holder_test.go
+black-box coverage and the test.Holder Reopen() durability pattern.
+"""
+
+import datetime as dt
+
+import pytest
+
+from pilosa_tpu.models import (
+    Field,
+    FieldOptions,
+    FieldType,
+    Holder,
+    Index,
+    IndexOptions,
+    TimeQuantum,
+    views_by_time,
+    views_by_time_range,
+)
+from pilosa_tpu.models.index import EXISTENCE_FIELD
+from pilosa_tpu.ops.bitmap import unpack_positions
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+# ---------------------------------------------------------------- time views
+
+
+def test_views_by_time():
+    t = dt.datetime(2018, 8, 28, 9, 30)
+    assert views_by_time("standard", t, TimeQuantum("YMDH")) == [
+        "standard_2018",
+        "standard_201808",
+        "standard_20180828",
+        "standard_2018082809",
+    ]
+    assert views_by_time("standard", t, TimeQuantum("MD")) == [
+        "standard_201808",
+        "standard_20180828",
+    ]
+
+
+def test_views_by_time_range_minimal_cover():
+    q = TimeQuantum("YMDH")
+    start = dt.datetime(2017, 12, 31, 22)
+    end = dt.datetime(2018, 1, 2, 2)
+    got = views_by_time_range("standard", start, end, q)
+    assert got == [
+        "standard_2017123122",
+        "standard_2017123123",
+        "standard_20180101",
+        "standard_2018010200",
+        "standard_2018010201",
+    ]
+
+
+def test_views_by_time_range_year_cover():
+    got = views_by_time_range(
+        "standard",
+        dt.datetime(2017, 1, 1),
+        dt.datetime(2019, 1, 1),
+        TimeQuantum("YMDH"),
+    )
+    assert got == ["standard_2017", "standard_2018"]
+
+
+def test_invalid_quantum():
+    with pytest.raises(ValueError):
+        TimeQuantum("YH")
+
+
+# ------------------------------------------------------------------- fields
+
+
+def test_set_field_rows():
+    f = Field(None, "i", "f", FieldOptions.set_field())
+    assert f.set_bit(10, 3)
+    assert not f.set_bit(10, 3)
+    f.set_bit(10, SHARD_WIDTH + 5)  # second shard
+    assert f.available_shards() == {0, 1}
+    assert list(unpack_positions(f.row(10, 0))) == [3]
+    assert list(unpack_positions(f.row(10, 1))) == [5]
+
+
+def test_bool_field_validation_and_mutex():
+    f = Field(None, "i", "b", FieldOptions.bool_field())
+    f.set_bit(1, 7)   # true
+    f.set_bit(0, 7)   # flips to false
+    assert list(unpack_positions(f.row(1, 0))) == []
+    assert list(unpack_positions(f.row(0, 0))) == [7]
+    with pytest.raises(ValueError):
+        f.set_bit(2, 7)
+
+
+def test_mutex_field():
+    f = Field(None, "i", "m", FieldOptions.mutex_field())
+    f.set_bit(4, 9)
+    f.set_bit(8, 9)
+    assert list(unpack_positions(f.row(4, 0))) == []
+    assert list(unpack_positions(f.row(8, 0))) == [9]
+
+
+def test_time_field_views_and_range_query():
+    f = Field(None, "i", "t", FieldOptions.time_field("YMD"))
+    ts = dt.datetime(2018, 3, 4, 5)
+    f.set_bit(1, 100, timestamp=ts)
+    assert set(f.views) >= {
+        "standard",
+        "standard_2018",
+        "standard_201803",
+        "standard_20180304",
+    }
+    got = f.row_time(1, 0, dt.datetime(2018, 3, 1), dt.datetime(2018, 4, 1))
+    assert list(unpack_positions(got)) == [100]
+    got = f.row_time(1, 0, dt.datetime(2018, 5, 1), dt.datetime(2018, 6, 1))
+    assert got is None or not got.any()
+
+
+def test_time_field_no_standard_view():
+    f = Field(None, "i", "t", FieldOptions.time_field("YMD", no_standard_view=True))
+    f.set_bit(1, 5, timestamp=dt.datetime(2018, 1, 1))
+    assert "standard" not in f.views
+
+
+def test_int_field_value_and_aggregates():
+    f = Field(None, "i", "n", FieldOptions.int_field(-100, 200))
+    assert f.options.base == 0
+    f.set_value(1, 50)
+    f.set_value(2, -30)
+    f.set_value(3, 200)
+    assert f.value(1) == (50, True)
+    assert f.value(2) == (-30, True)
+    assert f.value(99) == (0, False)
+    s, c = f.sum(None, 0)
+    assert (s, c) == (220, 3)
+    assert f.min(None, 0) == (-30, 1)
+    assert f.max(None, 0) == (200, 1)
+    with pytest.raises(ValueError):
+        f.set_value(1, 201)
+    with pytest.raises(ValueError):
+        f.set_value(1, -101)
+
+
+def test_int_field_nonzero_base():
+    f = Field(None, "i", "n", FieldOptions.int_field(100, 200))
+    assert f.options.base == 100
+    f.set_value(1, 150)
+    f.set_value(2, 100)
+    assert f.value(1) == (150, True)
+    s, c = f.sum(None, 0)
+    assert (s, c) == (250, 2)
+    assert f.min(None, 0) == (100, 1)
+    assert f.max(None, 0) == (150, 1)
+    got = set(unpack_positions(f.range_op(">=", 150, 0)))
+    assert got == {1}
+    # whole-range shortcut -> not-null
+    got = set(unpack_positions(f.range_op("<=", 500, 0)))
+    assert got == {1, 2}
+
+
+def test_int_field_bit_depth_growth():
+    f = Field(None, "i", "n", FieldOptions.int_field(0, 10))
+    d0 = f.options.bit_depth
+    f.options.max = 1 << 40  # widen limit, then store a big value
+    f.set_value(1, 1 << 33)
+    assert f.options.bit_depth > max(d0, 33)
+    assert f.value(1) == (1 << 33, True)
+
+
+def test_field_name_validation():
+    with pytest.raises(ValueError):
+        Field(None, "i", "UPPER", FieldOptions())
+    with pytest.raises(ValueError):
+        Field(None, "i", "9starts-with-digit", FieldOptions())
+
+
+# ------------------------------------------------------------ index/holder
+
+
+def test_index_existence_field_and_shards():
+    idx = Index(None, "myidx")
+    assert idx.field(EXISTENCE_FIELD) is not None
+    f = idx.create_field("f")
+    f.set_bit(1, 2)
+    assert idx.available_shards() == {0}
+    assert [x.name for x in idx.public_fields()] == ["f"]
+    with pytest.raises(ValueError):
+        idx.create_field("f")
+
+
+def test_holder_schema_and_reopen(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    idx = h.create_index("events", IndexOptions(track_existence=True))
+    f = idx.create_field("acts", FieldOptions.set_field())
+    n = idx.create_field("amount", FieldOptions.int_field(-1000, 1000))
+    f.set_bit(3, 42)
+    f.set_bit(3, SHARD_WIDTH * 2 + 1)
+    n.set_value(42, -5)
+    node_id = h.node_id
+    schema = h.schema()
+    h.close()
+
+    h2 = Holder(str(tmp_path / "data"))
+    assert h2.node_id == node_id
+    assert h2.schema() == schema
+    idx2 = h2.index("events")
+    assert idx2.available_shards() == {0, 2}
+    f2 = idx2.field("acts")
+    assert list(unpack_positions(f2.row(3, 0))) == [42]
+    assert list(unpack_positions(f2.row(3, 2))) == [1]
+    assert idx2.field("amount").value(42) == (-5, True)
+    # field options survived
+    assert idx2.field("amount").options.min == -1000
+    h2.close()
+
+
+def test_holder_apply_schema(tmp_path):
+    h = Holder(str(tmp_path / "d1"))
+    idx = h.create_index("a")
+    idx.create_field("x", FieldOptions.int_field(0, 10))
+    schema = h.schema()
+
+    h2 = Holder(str(tmp_path / "d2"))
+    h2.apply_schema(schema)
+    assert h2.schema() == schema
+    h.close()
+    h2.close()
+
+
+def test_delete_field_and_index(tmp_path):
+    h = Holder(str(tmp_path / "data"))
+    idx = h.create_index("a")
+    idx.create_field("x")
+    idx.delete_field("x")
+    assert idx.field("x") is None
+    h.delete_index("a")
+    assert h.index("a") is None
+    with pytest.raises(KeyError):
+        h.delete_index("a")
+    h.close()

@@ -1,0 +1,165 @@
+"""Fragment storage tests: mutation, bulk import, durability, mutex.
+
+Mirrors the reference's fragment_internal_test.go coverage tiers and the
+test.Holder Reopen() durability pattern (test/holder.go:62).
+"""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.models.fragment import Fragment
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def make_fragment(tmp_path=None, shard=0, mutex=False, max_op_n=10000):
+    path = None if tmp_path is None else str(tmp_path / "frag" / str(shard))
+    return Fragment(path, "i", "f", "standard", shard, mutex=mutex, max_op_n=max_op_n)
+
+
+def test_set_clear_bit():
+    f = make_fragment()
+    assert f.set_bit(3, 100)
+    assert not f.set_bit(3, 100)  # already set
+    assert f.bit(3, 100)
+    assert not f.bit(3, 101)
+    assert f.clear_bit(3, 100)
+    assert not f.clear_bit(3, 100)
+    assert not f.bit(3, 100)
+
+
+def test_shard_offset_bounds():
+    f = make_fragment(shard=2)
+    base = 2 * SHARD_WIDTH
+    f.set_bit(0, base)
+    f.set_bit(0, base + SHARD_WIDTH - 1)
+    with pytest.raises(ValueError):
+        f.set_bit(0, base - 1)
+    with pytest.raises(ValueError):
+        f.set_bit(0, base + SHARD_WIDTH)
+    assert f.row_count(0) == 2
+
+
+def test_row_and_counts():
+    f = make_fragment()
+    cols = [1, 5, 100, 65535]
+    for c in cols:
+        f.set_bit(7, c)
+    from pilosa_tpu.ops.bitmap import unpack_positions
+
+    assert list(unpack_positions(f.row(7))) == cols
+    assert f.row_count(7) == 4
+    assert f.row_ids() == [7]
+    assert f.min_row_id() == 7 and f.max_row_id() == 7
+
+
+def test_clear_row_and_set_row():
+    f = make_fragment()
+    for c in (1, 2, 3):
+        f.set_bit(5, c)
+    assert f.clear_row(5)
+    assert f.row_count(5) == 0
+    assert not f.clear_row(5)
+
+    words = np.zeros(f.n_words, dtype=np.uint32)
+    words[0] = 0b1011
+    assert f.set_row(9, words)
+    assert f.row_count(9) == 3
+    assert not f.set_row(9, words)  # unchanged
+
+
+def test_import_positions():
+    f = make_fragment()
+    rng = np.random.default_rng(3)
+    rows = rng.integers(0, 50, size=2000)
+    offs = rng.integers(0, SHARD_WIDTH, size=2000)
+    pos = set(int(r) * SHARD_WIDTH + int(o) for r, o in zip(rows, offs))
+    f.import_positions(sorted(pos))
+    total = sum(f.row_count(r) for r in f.row_ids())
+    assert total == len(pos)
+    # clear a subset via import
+    some = sorted(pos)[:500]
+    f.import_positions([], some)
+    total = sum(f.row_count(r) for r in f.row_ids())
+    assert total == len(pos) - 500
+
+
+def test_mutex_semantics():
+    f = make_fragment(mutex=True)
+    f.set_bit(1, 10)
+    f.set_bit(2, 10)  # must clear row 1's bit for column 10
+    assert not f.bit(1, 10)
+    assert f.bit(2, 10)
+    f.set_bit(2, 11)
+    assert f.bit(2, 10) and f.bit(2, 11)
+
+
+def test_durability_wal_replay(tmp_path):
+    f = make_fragment(tmp_path)
+    f.set_bit(1, 100)
+    f.set_bit(2, 200)
+    f.clear_bit(1, 100)
+    f.set_value(50, 8, -42)
+    f.close()
+
+    f2 = make_fragment(tmp_path)
+    assert not f2.bit(1, 100)
+    assert f2.bit(2, 200)
+    assert f2.value(50, 8) == (-42, True)
+
+
+def test_durability_snapshot_and_wal(tmp_path):
+    f = make_fragment(tmp_path, max_op_n=10)
+    for c in range(25):  # crosses the snapshot threshold twice
+        f.set_bit(0, c)
+    f.set_bit(1, 7)
+    f.close()
+
+    f2 = make_fragment(tmp_path, max_op_n=10)
+    assert f2.row_count(0) == 25
+    assert f2.bit(1, 7)
+
+
+def test_durability_torn_wal(tmp_path):
+    f = make_fragment(tmp_path)
+    f.set_bit(1, 1)
+    f.set_bit(1, 2)
+    f.close()
+    # simulate a torn final record
+    wal = str(tmp_path / "frag" / "0.wal")
+    with open(wal, "ab") as fh:
+        fh.write(b"\x01\x05")  # partial record
+    f2 = make_fragment(tmp_path)
+    assert f2.row_count(1) == 2
+
+
+def test_snapshot_width_mismatch(tmp_path):
+    f = make_fragment(tmp_path)
+    f.set_bit(0, 1)
+    f.snapshot()
+    f.close()
+    import pilosa_tpu.models.fragment as frag_mod
+
+    orig = frag_mod.SHARD_WIDTH
+    try:
+        frag_mod.SHARD_WIDTH = orig * 2
+        with pytest.raises(ValueError, match="shard width"):
+            make_fragment(tmp_path)
+    finally:
+        frag_mod.SHARD_WIDTH = orig
+
+
+def test_device_matrix_and_row():
+    f = make_fragment()
+    f.set_bit(3, 100)
+    f.set_bit(10, 200)
+    ids, dev = f.device_matrix()
+    assert list(ids) == [3, 10]
+    assert dev.shape == (2, f.n_words)
+    row = np.asarray(f.device_row(3))
+    assert row[100 // 32] == 1 << (100 % 32)
+    # missing row -> zeros
+    assert not np.asarray(f.device_row(99)).any()
+    # cache invalidation on write
+    f.set_bit(3, 101)
+    _, dev2 = f.device_matrix()
+    assert np.asarray(dev2)[0][101 // 32] & (1 << (101 % 32))
