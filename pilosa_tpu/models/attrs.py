@@ -1,0 +1,112 @@
+"""Attribute storage: arbitrary key->value metadata on rows and columns.
+
+Parity with the reference's AttrStore (attr.go:34) and its BoltDB
+implementation (boltdb/attrstore.go): merge-on-write semantics, bulk set,
+and 100-id attribute blocks with checksums for anti-entropy diffing
+(attr.go:80-120).  Backed by sqlite (stdlib) instead of BoltDB.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import sqlite3
+import threading
+
+# Attribute block size for anti-entropy diffs (reference attrBlockSize,
+# attr.go:80).
+ATTR_BLOCK_SIZE = 100
+
+
+class AttrStore:
+    def __init__(self, path: str | None = None):
+        self.path = path or ":memory:"
+        self._lock = threading.RLock()
+        # One shared connection for all threads (an in-memory sqlite DB is
+        # per-connection, so thread-local connections would each see an
+        # empty database); every access is serialized by self._lock.
+        self._db = sqlite3.connect(self.path, check_same_thread=False)
+        with self._lock, self._db as c:
+            c.execute(
+                "CREATE TABLE IF NOT EXISTS attrs (id INTEGER PRIMARY KEY, data TEXT)"
+            )
+
+    def _conn(self) -> sqlite3.Connection:
+        return self._db
+
+    def attrs(self, id_: int) -> dict:
+        with self._lock:
+            cur = self._conn().execute("SELECT data FROM attrs WHERE id=?", (id_,))
+            row = cur.fetchone()
+        return json.loads(row[0]) if row else {}
+
+    def set_attrs(self, id_: int, attrs: dict) -> None:
+        """Merge attrs into existing; None values delete keys (reference
+        SetAttrs merge semantics, boltdb/attrstore.go:120)."""
+        with self._lock:
+            cur = self.attrs(id_)
+            for k, v in attrs.items():
+                if v is None:
+                    cur.pop(k, None)
+                else:
+                    cur[k] = v
+            with self._db as c:
+                c.execute(
+                    "INSERT OR REPLACE INTO attrs (id, data) VALUES (?, ?)",
+                    (id_, json.dumps(cur, sort_keys=True)),
+                )
+
+    def set_bulk_attrs(self, attrs_by_id: dict[int, dict]) -> None:
+        for id_, attrs in sorted(attrs_by_id.items()):
+            self.set_attrs(id_, attrs)
+
+    def ids(self) -> list[int]:
+        with self._lock:
+            cur = self._conn().execute("SELECT id FROM attrs ORDER BY id")
+            return [r[0] for r in cur.fetchall()]
+
+    # ---- anti-entropy blocks (reference attr.go:80-120) ----
+
+    def blocks(self) -> list[tuple[int, bytes]]:
+        """(block id, checksum) per 100-id block of attribute data."""
+        out: list[tuple[int, bytes]] = []
+        h = None
+        cur_block = None
+        with self._lock:
+            rows = self._conn().execute(
+                "SELECT id, data FROM attrs ORDER BY id"
+            ).fetchall()
+        for id_, data in rows:
+            blk = id_ // ATTR_BLOCK_SIZE
+            if blk != cur_block:
+                if cur_block is not None:
+                    out.append((cur_block, h.digest()))
+                cur_block, h = blk, hashlib.blake2b(digest_size=16)
+            h.update(str(id_).encode())
+            h.update(data.encode())
+        if cur_block is not None:
+            out.append((cur_block, h.digest()))
+        return out
+
+    def block_data(self, block: int) -> dict[int, dict]:
+        lo, hi = block * ATTR_BLOCK_SIZE, (block + 1) * ATTR_BLOCK_SIZE
+        with self._lock:
+            rows = self._conn().execute(
+                "SELECT id, data FROM attrs WHERE id >= ? AND id < ? ORDER BY id",
+                (lo, hi),
+            ).fetchall()
+        return {r[0]: json.loads(r[1]) for r in rows}
+
+    def blocks_diff(self, other_blocks: list[tuple[int, bytes]]) -> list[int]:
+        """Block ids whose checksums differ from a peer's (reference
+        attrBlocks.Diff, attr.go:90)."""
+        mine = dict(self.blocks())
+        theirs = dict(other_blocks)
+        return sorted(
+            set(b for b in mine if mine[b] != theirs.get(b))
+            | set(b for b in theirs if theirs[b] != mine.get(b))
+        )
+
+    def close(self) -> None:
+        with self._lock:
+            self._db.close()

@@ -161,6 +161,11 @@ class Field:
             self._load_meta()
             self._open_views()
         self._load_shards()
+        from pilosa_tpu.models.attrs import AttrStore
+
+        self.row_attrs = AttrStore(
+            None if path is None else os.path.join(path, ".row_attrs.db")
+        )
 
     # ------------------------------------------------------------ metadata
 
@@ -268,12 +273,13 @@ class Field:
             raise ValueError(f"field {self.name} is an int field; use set_value")
         if self.options.type == FieldType.BOOL and row not in (FALSE_ROW_ID, TRUE_ROW_ID):
             raise ValueError("bool field rows must be 0 or 1")
+        if timestamp is not None and self.options.type != FieldType.TIME:
+            # validate before any write so a rejected call mutates nothing
+            raise ValueError(f"field {self.name} has no time quantum")
         changed = False
         if not (self.options.type == FieldType.TIME and self.options.no_standard_view):
             changed |= self.create_view_if_not_exists(VIEW_STANDARD).set_bit(row, col)
         if timestamp is not None:
-            if self.options.type != FieldType.TIME:
-                raise ValueError(f"field {self.name} has no time quantum")
             for name in views_by_time(VIEW_STANDARD, timestamp, self.time_quantum):
                 changed |= self.create_view_if_not_exists(name).set_bit(row, col)
         self._note_shard(col // SHARD_WIDTH)
@@ -493,6 +499,7 @@ class Field:
     def close(self) -> None:
         for view in self.views.values():
             view.close()
+        self.row_attrs.close()
 
     def snapshot(self) -> None:
         for view in self.views.values():

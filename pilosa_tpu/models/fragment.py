@@ -285,6 +285,8 @@ class Fragment:
         with self._lock:
             old = self._rows.get(row)
             new = np.asarray(words, dtype=np.uint32).copy()
+            if old is None and not new.any():
+                return False  # absent -> empty is a no-op
             if old is not None and np.array_equal(old, new):
                 return False
             self._rows[row] = new

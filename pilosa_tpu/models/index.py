@@ -49,6 +49,11 @@ class Index:
             self._open_fields()
         if self.options.track_existence and EXISTENCE_FIELD not in self.fields:
             self._create_existence_field()
+        from pilosa_tpu.models.attrs import AttrStore
+
+        self.column_attrs = AttrStore(
+            None if path is None else os.path.join(path, ".column_attrs.db")
+        )
 
     @property
     def _meta_path(self) -> str:
@@ -136,6 +141,7 @@ class Index:
     def close(self) -> None:
         for f in self.fields.values():
             f.close()
+        self.column_attrs.close()
 
     def snapshot(self) -> None:
         for f in self.fields.values():

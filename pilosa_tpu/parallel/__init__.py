@@ -1,0 +1,26 @@
+"""Parallel execution: per-shard query execution, map-reduce, mesh fan-out.
+
+The TPU-native replacement for the reference's distributed executor
+(executor.go): shard-level evaluation runs as fused XLA programs on device
+tensors; cross-shard reduce happens host-side single-node and via
+shard_map/ICI collectives on a mesh (pilosa_tpu.parallel.mesh).
+"""
+
+from pilosa_tpu.parallel.results import (
+    ValCount,
+    Pair,
+    PairField,
+    FieldRow,
+    GroupCount,
+)
+from pilosa_tpu.parallel.executor import Executor, ExecOptions
+
+__all__ = [
+    "ValCount",
+    "Pair",
+    "PairField",
+    "FieldRow",
+    "GroupCount",
+    "Executor",
+    "ExecOptions",
+]

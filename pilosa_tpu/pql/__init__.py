@@ -1,0 +1,11 @@
+"""PQL: the Pilosa Query Language parser and AST.
+
+Same language surface as the reference's pql/ package (grammar:
+pql/pql.peg; AST: pql/ast.go), implemented as a hand-written
+recursive-descent parser instead of a generated packrat PEG parser.
+"""
+
+from pilosa_tpu.pql.ast import Call, Condition, Query, WRITE_CALLS
+from pilosa_tpu.pql.parser import parse, ParseError
+
+__all__ = ["Call", "Condition", "Query", "WRITE_CALLS", "parse", "ParseError"]

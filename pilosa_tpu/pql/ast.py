@@ -1,0 +1,208 @@
+"""PQL AST: Query -> Call tree with typed argument accessors.
+
+Parity with the reference's pql/ast.go: Call{Name, Args, Children},
+Condition{Op, Value}, and a String() form that round-trips through the
+parser (used for node-to-node query forwarding, executor.go:2414).
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+
+# Mutating call names (reference Query.WriteCallN, pql/ast.go:116 and
+# executor write routing).
+WRITE_CALLS = frozenset(
+    ["Set", "Clear", "SetRowAttrs", "SetColumnAttrs", "ClearRow", "Store"]
+)
+
+# Condition operator tokens in canonical string form.
+COND_OPS = ("><", "<=", ">=", "==", "!=", "<", ">")
+
+
+class Condition:
+    """A comparison attached to a field argument: ``field <op> value``.
+    Op is one of <, <=, >, >=, ==, !=, >< (between)."""
+
+    __slots__ = ("op", "value")
+
+    def __init__(self, op: str, value):
+        if op not in COND_OPS:
+            raise ValueError(f"invalid condition op: {op}")
+        self.op = op
+        self.value = value
+
+    def int_slice_value(self) -> list[int]:
+        """Between bounds as ints (reference IntSliceValue, pql/ast.go:495)."""
+        if not isinstance(self.value, list):
+            raise ValueError(f"expected list value, got {self.value!r}")
+        out = []
+        for v in self.value:
+            if isinstance(v, bool) or not isinstance(v, int):
+                raise ValueError(f"unexpected value in condition list: {v!r}")
+            out.append(v)
+        return out
+
+    def __str__(self) -> str:
+        return f"{self.op} {format_value(self.value)}"
+
+    def __repr__(self) -> str:
+        return f"Condition({self.op!r}, {self.value!r})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Condition)
+            and self.op == other.op
+            and self.value == other.value
+        )
+
+
+def format_value(v) -> str:
+    if isinstance(v, str):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, list):
+        return "[" + ",".join(format_value(x) for x in v) + "]"
+    if isinstance(v, _dt.datetime):
+        return f'"{v.strftime("%Y-%m-%dT%H:%M")}"'
+    if isinstance(v, Condition):
+        return str(v)
+    if isinstance(v, Call):
+        return str(v)
+    return str(v)
+
+
+class Call:
+    """One PQL call: ``Name(child1, child2, key=value, ...)``."""
+
+    __slots__ = ("name", "args", "children")
+
+    def __init__(self, name: str, args: dict | None = None, children: list | None = None):
+        self.name = name
+        self.args: dict = args or {}
+        self.children: list[Call] = children or []
+
+    # ---- typed accessors (reference pql/ast.go:272-392) ----
+
+    def field_arg(self) -> str:
+        """The single field=row style argument's key (reference FieldArg:
+        used by Set/Clear where the arg map holds field->row)."""
+        for k in self.args:
+            if not k.startswith("_"):
+                return k
+        raise ValueError(f"{self.name}() requires a field argument")
+
+    def uint_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            raise ValueError(f"{self.name}() arg {key!r} must be a non-negative integer")
+        return v
+
+    def int_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if isinstance(v, bool) or not isinstance(v, int):
+            raise ValueError(f"{self.name}() arg {key!r} must be an integer")
+        return v
+
+    def bool_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if not isinstance(v, bool):
+            raise ValueError(f"{self.name}() arg {key!r} must be a boolean")
+        return v
+
+    def string_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if not isinstance(v, str):
+            raise ValueError(f"{self.name}() arg {key!r} must be a string")
+        return v
+
+    def uint_slice_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if not isinstance(v, list):
+            raise ValueError(f"{self.name}() arg {key!r} must be a list")
+        out = []
+        for x in v:
+            if isinstance(x, bool) or not isinstance(x, int) or x < 0:
+                raise ValueError(f"{self.name}() arg {key!r} must hold unsigned ints")
+            out.append(x)
+        return out
+
+    def call_arg(self, key: str):
+        v = self.args.get(key)
+        if v is None:
+            return None
+        if not isinstance(v, Call):
+            raise ValueError(f"{self.name}() arg {key!r} must be a call")
+        return v
+
+    def condition_arg(self):
+        """(field, Condition) for the single condition argument, if any."""
+        for k, v in self.args.items():
+            if isinstance(v, Condition):
+                return k, v
+        return None
+
+    def has_condition_arg(self) -> bool:
+        return any(isinstance(v, Condition) for v in self.args.values())
+
+    def is_write(self) -> bool:
+        return self.name in WRITE_CALLS
+
+    def clone(self) -> "Call":
+        return Call(
+            self.name,
+            dict(self.args),
+            [c.clone() for c in self.children],
+        )
+
+    def __str__(self) -> str:
+        parts = [str(c) for c in self.children]
+        for key in sorted(self.args):
+            v = self.args[key]
+            if isinstance(v, Condition):
+                parts.append(f"{key} {v}")
+            else:
+                parts.append(f"{key}={format_value(v)}")
+        return f"{self.name or '!UNNAMED'}({', '.join(parts)})"
+
+    def __repr__(self) -> str:
+        return f"Call({str(self)!r})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Call)
+            and self.name == other.name
+            and self.args == other.args
+            and self.children == other.children
+        )
+
+
+class Query:
+    """A parsed PQL query: a sequence of calls."""
+
+    __slots__ = ("calls",)
+
+    def __init__(self, calls: list[Call] | None = None):
+        self.calls = calls or []
+
+    def write_call_n(self) -> int:
+        """Number of mutating calls (reference WriteCallN, pql/ast.go:116)."""
+        return sum(1 for c in self.calls if c.is_write())
+
+    def __str__(self) -> str:
+        return "".join(str(c) for c in self.calls)
+
+    def __repr__(self) -> str:
+        return f"Query({str(self)!r})"

@@ -1,0 +1,451 @@
+"""Hand-written recursive-descent PQL parser.
+
+Accepts the same language as the reference's PEG grammar (pql/pql.peg),
+including the special call forms (Set/SetRowAttrs/SetColumnAttrs/Clear/
+ClearRow/Store/TopN/Rows/Range), conditions (``field <= 10``), the
+``a < field <= b`` conditional sugar (lowered to a BETWEEN condition with
+strict bounds adjusted by one, pql/ast.go:81-103), lists, timestamps, and
+quoted strings.  Implemented by hand instead of a generated packrat
+parser — ~10x less code and no generation step.
+"""
+
+from __future__ import annotations
+
+import re
+
+from pilosa_tpu.pql.ast import Call, Condition, Query
+
+_TIMESTAMP_RE = re.compile(r"\d{4}-[01]\d-[0-3]\dT\d\d:\d\d")
+_IDENT_RE = re.compile(r"[A-Za-z][A-Za-z0-9]*")
+_FIELD_RE = re.compile(r"[A-Za-z][A-Za-z0-9_-]*")
+_BARE_STR_RE = re.compile(r"[A-Za-z0-9:_-]+")
+_NUMBER_RE = re.compile(r"-?(?:\d+(?:\.\d*)?|\.\d+)")
+_UINT_RE = re.compile(r"\d+")
+_INT_RE = re.compile(r"-?\d+")
+
+# Reserved positional argument keys (pql.peg `reserved`).
+RESERVED = {"_row", "_col", "_start", "_end", "_timestamp", "_field"}
+
+
+class ParseError(ValueError):
+    def __init__(self, message: str, src: str, pos: int):
+        line = src.count("\n", 0, pos) + 1
+        col = pos - (src.rfind("\n", 0, pos) + 1) + 1
+        super().__init__(f"{message} at line {line}, char {col}")
+        self.pos = pos
+
+
+class _Parser:
+    def __init__(self, src: str):
+        self.src = src
+        self.pos = 0
+
+    # ------------------------------------------------------------ plumbing
+
+    def error(self, message: str) -> ParseError:
+        return ParseError(message, self.src, self.pos)
+
+    def sp(self) -> None:
+        while self.pos < len(self.src) and self.src[self.pos] in " \t\n":
+            self.pos += 1
+
+    def peek(self) -> str:
+        return self.src[self.pos] if self.pos < len(self.src) else ""
+
+    def literal(self, text: str) -> bool:
+        if self.src.startswith(text, self.pos):
+            self.pos += len(text)
+            return True
+        return False
+
+    def expect(self, text: str) -> None:
+        if not self.literal(text):
+            raise self.error(f"expected {text!r}")
+
+    def match(self, regex: re.Pattern) -> str | None:
+        m = regex.match(self.src, self.pos)
+        if m is None:
+            return None
+        self.pos = m.end()
+        return m.group(0)
+
+    def comma(self) -> bool:
+        save = self.pos
+        self.sp()
+        if self.literal(","):
+            self.sp()
+            return True
+        self.pos = save
+        return False
+
+    def open(self) -> None:
+        self.expect("(")
+        self.sp()
+
+    def close(self) -> None:
+        self.sp()
+        self.expect(")")
+        self.sp()
+
+    # ------------------------------------------------------------- strings
+
+    def quoted_string(self) -> str | None:
+        q = self.peek()
+        if q not in "'\"":
+            return None
+        self.pos += 1
+        out = []
+        while True:
+            c = self.peek()
+            if c == "":
+                raise self.error("unterminated string")
+            if c == "\\" and self.pos + 1 < len(self.src) and self.src[self.pos + 1] in (q, "\\"):
+                out.append(self.src[self.pos + 1])
+                self.pos += 2
+                continue
+            if c == q:
+                self.pos += 1
+                return "".join(out)
+            out.append(c)
+            self.pos += 1
+
+    def timestamp_fmt(self) -> str | None:
+        """Bare or quoted YYYY-MM-DDTHH:MM."""
+        save = self.pos
+        q = self.peek()
+        if q in "'\"":
+            self.pos += 1
+            ts = self.match(_TIMESTAMP_RE)
+            if ts is not None and self.literal(q):
+                return ts
+            self.pos = save
+            return None
+        ts = self.match(_TIMESTAMP_RE)
+        if ts is not None:
+            return ts
+        self.pos = save
+        return None
+
+    # -------------------------------------------------------------- values
+
+    def value(self):
+        if self.literal("["):
+            self.sp()
+            items = []
+            if not self._at_rbrack():
+                items.append(self.item())
+                while self.comma():
+                    items.append(self.item())
+            self.sp()
+            self.expect("]")
+            self.sp()
+            return items
+        return self.item()
+
+    def _at_rbrack(self) -> bool:
+        save = self.pos
+        self.sp()
+        at = self.peek() == "]"
+        self.pos = save
+        return at
+
+    def _keyword_guard_ok(self) -> bool:
+        """After null/true/false the grammar requires comma or close
+        (pql.peg `item`)."""
+        save = self.pos
+        self.sp()
+        ok = self.peek() in ",)"
+        self.pos = save
+        return ok
+
+    def item(self):
+        for kw, val in (("null", None), ("true", True), ("false", False)):
+            save = self.pos
+            if self.literal(kw):
+                if self._keyword_guard_ok():
+                    return val
+                self.pos = save
+        ts = self.timestamp_fmt()
+        if ts is not None:
+            return ts
+        # number (must not run into an identifier tail)
+        save = self.pos
+        num = self.match(_NUMBER_RE)
+        if num is not None:
+            if not (self.peek().isalnum() or self.peek() in "_:-"):
+                if "." in num:
+                    return float(num)
+                return int(num)
+            self.pos = save
+        # nested call
+        save = self.pos
+        ident = self.match(_IDENT_RE)
+        if ident is not None:
+            self.sp()
+            if self.peek() == "(":
+                self.pos = save
+                return self.call()
+            self.pos = save
+        bare = self.match(_BARE_STR_RE)
+        if bare is not None:
+            return bare
+        s = self.quoted_string()
+        if s is not None:
+            return s
+        raise self.error("expected value")
+
+    # ---------------------------------------------------------------- args
+
+    def field_name(self) -> str:
+        name = self.match(_FIELD_RE)
+        if name is None:
+            for r in RESERVED:
+                if self.literal(r):
+                    return r
+            raise self.error("expected field name")
+        return name
+
+    def cond_op(self) -> str | None:
+        for op in ("><", "<=", ">=", "==", "!=", "<", ">"):
+            if self.literal(op):
+                return op
+        return None
+
+    def arg_into(self, args: dict) -> None:
+        # conditional sugar: int <[=] field <[=] int
+        if self.peek().isdigit() or (
+            self.peek() == "-" and self.pos + 1 < len(self.src) and self.src[self.pos + 1].isdigit()
+        ):
+            low = int(self.match(_INT_RE))
+            self.sp()
+            op1 = "<=" if self.literal("<=") else ("<" if self.literal("<") else None)
+            if op1 is None:
+                raise self.error("expected < or <= in conditional")
+            self.sp()
+            field = self.field_name()
+            self.sp()
+            op2 = "<=" if self.literal("<=") else ("<" if self.literal("<") else None)
+            if op2 is None:
+                raise self.error("expected < or <= in conditional")
+            self.sp()
+            high = int(self.match(_INT_RE))
+            # strict bounds tighten by one (reference endConditional,
+            # pql/ast.go:89-95)
+            if op1 == "<":
+                low += 1
+            if op2 == "<":
+                high -= 1
+            args[field] = Condition("><", [low, high])
+            return
+        field = self.field_name()
+        self.sp()
+        # condition ops first: "==" must win over "=".
+        op = self.cond_op()
+        if op is not None:
+            self.sp()
+            args[field] = Condition(op, self.value())
+            return
+        if self.literal("="):
+            self.sp()
+            args[field] = self.value()
+            return
+        raise self.error(f"expected = or condition operator after {field!r}")
+
+    def args_into(self, args: dict) -> None:
+        self.arg_into(args)
+        while True:
+            save = self.pos
+            if not self.comma():
+                return
+            try:
+                self.arg_into(args)
+            except ParseError:
+                self.pos = save
+                return
+
+    # ---------------------------------------------------------------- calls
+
+    def _pos_uint_or_str(self, key: str, args: dict) -> None:
+        num = self.match(_UINT_RE)
+        if num is not None:
+            args[key] = int(num)
+            return
+        s = self.quoted_string()
+        if s is not None:
+            args[key] = s
+            return
+        raise self.error(f"expected integer or quoted key for {key}")
+
+    def call(self) -> Call:
+        name = self.match(_IDENT_RE)
+        if name is None:
+            raise self.error("expected call name")
+        self.sp()
+        handler = getattr(self, f"_call_{name}", None)
+        if handler is not None:
+            save = self.pos
+            try:
+                return handler()
+            except ParseError:
+                # PEG ordered choice: a special form that fails to match
+                # falls through to the generic IDENT(allargs) rule — this is
+                # how String()-serialized calls (TopN(_field="f", ...))
+                # re-parse on remote nodes (executor.go:2414).
+                self.pos = save
+        return self._generic_call(name)
+
+    def _generic_call(self, name: str) -> Call:
+        call = Call(name)
+        self.open()
+        self._allargs_into(call)
+        self.comma()  # tolerate trailing comma (grammar: comma? close)
+        self.close()
+        return call
+
+    def _call_Set(self) -> Call:
+        call = Call("Set")
+        self.open()
+        self._pos_uint_or_str("_col", call.args)
+        if not self.comma():
+            raise self.error("expected ,")
+        self.args_into(call.args)
+        save = self.pos
+        if self.comma():
+            ts = self.timestamp_fmt()
+            if ts is None:
+                self.pos = save
+            else:
+                call.args["_timestamp"] = ts
+        self.close()
+        return call
+
+    def _call_SetRowAttrs(self) -> Call:
+        call = Call("SetRowAttrs")
+        self.open()
+        call.args["_field"] = self.field_name()
+        if not self.comma():
+            raise self.error("expected ,")
+        self._pos_uint_or_str("_row", call.args)
+        if not self.comma():
+            raise self.error("expected ,")
+        self.args_into(call.args)
+        self.close()
+        return call
+
+    def _call_SetColumnAttrs(self) -> Call:
+        call = Call("SetColumnAttrs")
+        self.open()
+        self._pos_uint_or_str("_col", call.args)
+        if not self.comma():
+            raise self.error("expected ,")
+        self.args_into(call.args)
+        self.close()
+        return call
+
+    def _call_Clear(self) -> Call:
+        call = Call("Clear")
+        self.open()
+        self._pos_uint_or_str("_col", call.args)
+        if not self.comma():
+            raise self.error("expected ,")
+        self.args_into(call.args)
+        self.close()
+        return call
+
+    def _call_ClearRow(self) -> Call:
+        call = Call("ClearRow")
+        self.open()
+        self.arg_into(call.args)
+        self.close()
+        return call
+
+    def _call_Store(self) -> Call:
+        call = Call("Store")
+        self.open()
+        call.children.append(self.call())
+        if not self.comma():
+            raise self.error("expected ,")
+        self.arg_into(call.args)
+        self.close()
+        return call
+
+    def _posfield_call(self, name: str) -> Call:
+        call = Call(name)
+        self.open()
+        fe = self.match(_FIELD_RE)
+        if fe is None:
+            raise self.error("expected field name")
+        call.args["_field"] = fe
+        if self.comma():
+            self._allargs_into(call)
+        self.close()
+        return call
+
+    def _call_TopN(self) -> Call:
+        return self._posfield_call("TopN")
+
+    def _call_Rows(self) -> Call:
+        return self._posfield_call("Rows")
+
+    def _call_Range(self) -> Call:
+        """Legacy time-range form: Range(f=10, [from=]ts, [to=]ts)
+        (pql.peg Range rule); condition form falls back to generic."""
+        call = Call("Range")
+        self.open()
+        field = self.field_name()
+        self.sp()
+        self.expect("=")
+        self.sp()
+        call.args[field] = self.value()
+        if not self.comma():
+            raise self.error("expected ,")
+        self.literal("from=")
+        ts = self.timestamp_fmt()
+        if ts is None:
+            raise self.error("expected timestamp")
+        call.args["from"] = ts
+        if not self.comma():
+            raise self.error("expected ,")
+        self.literal("to=")
+        self.sp()
+        ts = self.timestamp_fmt()
+        if ts is None:
+            raise self.error("expected timestamp")
+        call.args["to"] = ts
+        self.close()
+        return call
+
+    def _allargs_into(self, call: Call) -> None:
+        while True:
+            save = self.pos
+            ident = self.match(_IDENT_RE)
+            if ident is not None:
+                self.sp()
+                if self.peek() == "(":
+                    self.pos = save
+                    call.children.append(self.call())
+                    if self.comma():
+                        continue
+                    return
+            self.pos = save
+            break
+        save = self.pos
+        self.sp()
+        if self.peek() != ")":
+            self.pos = save
+            self.args_into(call.args)
+
+    # ----------------------------------------------------------------- top
+
+    def parse(self) -> Query:
+        q = Query()
+        self.sp()
+        while self.pos < len(self.src):
+            q.calls.append(self.call())
+            self.sp()
+        return q
+
+
+def parse(src: str) -> Query:
+    """Parse a PQL string into a Query (reference pql.ParseString)."""
+    return _Parser(src).parse()

@@ -1,0 +1,181 @@
+"""PQL parser tests — grammar coverage mirroring pql/pql_test.go."""
+
+import pytest
+
+from pilosa_tpu.pql import Call, Condition, ParseError, parse
+
+
+def one(src):
+    q = parse(src)
+    assert len(q.calls) == 1
+    return q.calls[0]
+
+
+def test_empty_query():
+    assert parse("").calls == []
+    assert parse("  \n\t ").calls == []
+
+
+def test_simple_row():
+    c = one("Row(stargazer=10)")
+    assert c.name == "Row"
+    assert c.args == {"stargazer": 10}
+
+
+def test_nested_calls():
+    c = one("Count(Intersect(Row(a=10), Row(b=20)))")
+    assert c.name == "Count"
+    inner = c.children[0]
+    assert inner.name == "Intersect"
+    assert [ch.name for ch in inner.children] == ["Row", "Row"]
+    assert inner.children[0].args == {"a": 10}
+
+
+def test_multiple_top_level_calls():
+    q = parse("Set(1, f=2)Set(3, f=4) Count(Row(f=2))")
+    assert [c.name for c in q.calls] == ["Set", "Set", "Count"]
+    assert q.write_call_n() == 2
+
+
+def test_set_forms():
+    c = one("Set(10, f=1)")
+    assert c.args == {"_col": 10, "f": 1}
+    c = one('Set("col-key", f=1)')
+    assert c.args == {"_col": "col-key", "f": 1}
+    c = one("Set(10, f=1, 2017-03-02T03:00)")
+    assert c.args["_timestamp"] == "2017-03-02T03:00"
+
+
+def test_clear_and_clearrow_and_store():
+    assert one("Clear(7, f=3)").args == {"_col": 7, "f": 3}
+    assert one("ClearRow(f=5)").args == {"f": 5}
+    c = one("Store(Row(f=10), g=20)")
+    assert c.children[0].name == "Row"
+    assert c.args == {"g": 20}
+
+
+def test_attrs_forms():
+    c = one('SetRowAttrs(f, 10, color="blue", active=true)')
+    assert c.args == {"_field": "f", "_row": 10, "color": "blue", "active": True}
+    c = one('SetColumnAttrs(7, age=12.5, note=null)')
+    assert c.args == {"_col": 7, "age": 12.5, "note": None}
+
+
+def test_topn_and_rows():
+    c = one("TopN(f, n=5)")
+    assert c.args == {"_field": "f", "n": 5}
+    c = one("TopN(f)")
+    assert c.args == {"_field": "f"}
+    c = one("TopN(f, Row(other=7), n=12)")
+    assert c.children[0].name == "Row"
+    assert c.args["n"] == 12
+    c = one("Rows(f, previous=10, limit=100, column=5)")
+    assert c.args["limit"] == 100
+
+
+def test_conditions():
+    for op in ("<", "<=", ">", ">=", "==", "!="):
+        c = one(f"Row(size {op} 1000)")
+        assert c.args["size"] == Condition(op, 1000)
+    c = one("Row(size >< [10, 20])")
+    assert c.args["size"] == Condition("><", [10, 20])
+
+
+def test_conditional_sugar():
+    c = one("Row(10 < size <= 20)")
+    assert c.args["size"] == Condition("><", [11, 20])
+    c = one("Row(10 <= size < 20)")
+    assert c.args["size"] == Condition("><", [10, 19])
+    c = one("Row(-5 <= size <= 5)")
+    assert c.args["size"] == Condition("><", [-5, 5])
+
+
+def test_row_time_range_args():
+    c = one("Row(f=1, from='2017-01-01T00:00', to='2018-01-01T00:00')")
+    assert c.args["from"] == "2017-01-01T00:00"
+    assert c.args["to"] == "2018-01-01T00:00"
+
+
+def test_legacy_range_form():
+    c = one("Range(f=1, 2017-01-01T00:00, 2018-01-01T00:00)")
+    assert c.name == "Range"
+    assert c.args == {"f": 1, "from": "2017-01-01T00:00", "to": "2018-01-01T00:00"}
+    c = one("Range(f=1, from=2017-01-01T00:00, to=2018-01-01T00:00)")
+    assert c.args["to"] == "2018-01-01T00:00"
+    # condition form falls back to the generic rule
+    c = one("Range(size > 42)")
+    assert c.args["size"] == Condition(">", 42)
+
+
+def test_values():
+    c = one('Eq(a=null, b=true, c=false, d=-12, e=1.5, f="qu\\"oted", g=bare-str, h=[1,2,3])')
+    assert c.args["a"] is None
+    assert c.args["b"] is True
+    assert c.args["c"] is False
+    assert c.args["d"] == -12
+    assert c.args["e"] == 1.5
+    assert c.args["f"] == 'qu"oted'
+    assert c.args["g"] == "bare-str"
+    assert c.args["h"] == [1, 2, 3]
+
+
+def test_call_as_value():
+    c = one("Count(field=Row(f=1))")
+    assert isinstance(c.args["field"], Call)
+    assert c.args["field"].name == "Row"
+
+
+def test_string_roundtrip():
+    for src in (
+        "Count(Intersect(Row(a=10), Row(b=20)))",
+        "TopN(f, n=5)",
+        "Row(size >< [10,20])",
+        'Set(10, f=1, _timestamp="2017-03-02T03:00")'.replace("_timestamp=", "_timestamp="),
+        "GroupBy(Rows(a), Rows(b), limit=10)",
+    ):
+        q = parse(src)
+        q2 = parse(str(q))
+        assert str(q2) == str(q)
+
+
+def test_groupby_with_filter():
+    c = one("GroupBy(Rows(a), Rows(b), filter=Row(f=1), limit=10)")
+    assert [ch.name for ch in c.children] == ["Rows", "Rows"]
+    assert c.args["limit"] == 10
+    assert isinstance(c.args["filter"], Call)
+
+
+def test_parse_errors():
+    for bad in ("Row(", "Row)", "Row(f=)", "Row(1 < x)", "Count(Row(f=1)) trailing"):
+        with pytest.raises(ParseError):
+            parse(bad)
+
+
+def test_special_form_generic_fallback():
+    # A special form that doesn't match its shape falls through to the
+    # generic rule, mirroring the PEG's ordered choice (Set positional col
+    # missing -> plain args call).
+    c = one("Set(f=1)")
+    assert c.args == {"f": 1}
+
+
+def test_options_call():
+    c = one("Options(Row(f=10), excludeColumns=true, shards=[0, 2])")
+    assert c.children[0].name == "Row"
+    assert c.args["excludeColumns"] is True
+    assert c.args["shards"] == [0, 2]
+
+
+def test_not_and_shift():
+    c = one("Not(Row(f=10))")
+    assert c.children[0].name == "Row"
+    c = one("Shift(Row(f=10), n=2)")
+    assert c.args["n"] == 2
+
+
+def test_min_max_sum():
+    c = one("Sum(Row(f=10), field=size)")
+    assert c.children[0].name == "Row"
+    assert c.args["field"] == "size"
+    c = one("Min(field=size)")
+    assert c.args["field"] == "size"
