@@ -1,0 +1,287 @@
+// Roaring file codec (Pilosa 64-bit variant, cookie 12348).
+//
+// Implements the on-disk format described in the reference's
+// docs/architecture.md:9-24 and written by roaring/roaring.go:1046
+// (WriteTo) / parsed by roaring/unmarshal_binary.go:
+//
+//   [0:4)   cookie: u16 magic 12348 | u8 version (0) | u8 flags
+//   [4:8)   container count (u32)
+//   then per container, 12 bytes of descriptive header:
+//           key (u64), type (u16: 1=array, 2=bitmap, 3=run), N-1 (u16)
+//   then per container: absolute data offset (u32) as its own section
+//   then container payloads:
+//           array:  N x u16 sorted values
+//           bitmap: 1024 x u64
+//           run:    run count (u16), then (start u16, last u16) pairs
+//   all little-endian; an op log of unspecified length may follow the
+//   container section (ignored here — our fragments carry their own WAL).
+//
+// The decode side expands every container to a dense 1024-word (u64)
+// block keyed by the container key: the packed-tensor layout the TPU
+// kernels consume directly.  The encode side picks the smallest of
+// array (2N bytes), bitmap (8192), or run (2+4*runs) per container, as
+// the reference's Optimize() does.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint16_t kMagic = 12348;
+constexpr uint32_t kWordsPerContainer = 1024;  // 2^16 bits
+constexpr uint32_t kHeaderBaseSize = 8;
+constexpr uint16_t kTypeArray = 1;
+constexpr uint16_t kTypeBitmap = 2;
+constexpr uint16_t kTypeRun = 3;
+
+inline uint16_t rd16(const uint8_t* p) {
+  uint16_t v;
+  std::memcpy(&v, p, 2);
+  return v;
+}
+inline uint32_t rd32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+inline uint64_t rd64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+inline void wr16(std::vector<uint8_t>& b, uint16_t v) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
+  b.insert(b.end(), p, p + 2);
+}
+inline void wr32(std::vector<uint8_t>& b, uint32_t v) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
+  b.insert(b.end(), p, p + 4);
+}
+inline void wr64(std::vector<uint8_t>& b, uint64_t v) {
+  const uint8_t* p = reinterpret_cast<const uint8_t*>(&v);
+  b.insert(b.end(), p, p + 8);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Error codes.
+enum {
+  ROARING_OK = 0,
+  ROARING_ERR_TRUNCATED = -1,
+  ROARING_ERR_MAGIC = -2,
+  ROARING_ERR_VERSION = -3,
+  ROARING_ERR_TYPE = -4,
+  ROARING_ERR_OFFSET = -5,
+};
+
+// Decode a serialized bitmap into dense containers.
+// keys_out/words_out are malloc'd; caller frees with pilosa_roaring_free_buf.
+// words_out holds n_out * 1024 u64 words.
+int pilosa_roaring_decode(const uint8_t* data, uint64_t len,
+                          uint64_t** keys_out, uint64_t** words_out,
+                          uint64_t* n_out, uint8_t* flags_out) {
+  if (len < kHeaderBaseSize) return ROARING_ERR_TRUNCATED;
+  uint16_t magic = rd16(data);
+  if (magic != kMagic) return ROARING_ERR_MAGIC;
+  if (data[2] != 0) return ROARING_ERR_VERSION;
+  *flags_out = data[3];
+  uint64_t n = rd32(data + 4);
+  if (len < kHeaderBaseSize + n * 12ULL + n * 4ULL) return ROARING_ERR_TRUNCATED;
+
+  uint64_t* keys = static_cast<uint64_t*>(std::malloc(n * sizeof(uint64_t)));
+  uint64_t* words =
+      static_cast<uint64_t*>(std::calloc(n * kWordsPerContainer, sizeof(uint64_t)));
+  if ((n > 0 && (!keys || !words))) {
+    std::free(keys);
+    std::free(words);
+    return ROARING_ERR_TRUNCATED;
+  }
+
+  // Descriptive header entries are 12 bytes (key u64, type u16, N-1 u16);
+  // the 4-byte offsets follow as their own section (WriteTo layout:
+  // header total = 8 + 16*n).
+  const uint8_t* desc = data + kHeaderBaseSize;
+  const uint8_t* offs = desc + n * 12;
+  for (uint64_t i = 0; i < n; i++) {
+    uint64_t key = rd64(desc + i * 12);
+    uint16_t typ = rd16(desc + i * 12 + 8);
+    uint32_t card = static_cast<uint32_t>(rd16(desc + i * 12 + 10)) + 1;
+    uint32_t off = rd32(offs + i * 4);
+    keys[i] = key;
+    uint64_t* w = words + i * kWordsPerContainer;
+    switch (typ) {
+      case kTypeArray: {
+        if (static_cast<uint64_t>(off) + 2ULL * card > len) goto fail_offset;
+        const uint8_t* p = data + off;
+        for (uint32_t j = 0; j < card; j++) {
+          uint16_t v = rd16(p + 2 * j);
+          w[v >> 6] |= 1ULL << (v & 63);
+        }
+        break;
+      }
+      case kTypeBitmap: {
+        if (static_cast<uint64_t>(off) + 8192ULL > len) goto fail_offset;
+        std::memcpy(w, data + off, 8192);
+        break;
+      }
+      case kTypeRun: {
+        if (static_cast<uint64_t>(off) + 2ULL > len) goto fail_offset;
+        uint16_t run_count = rd16(data + off);
+        if (static_cast<uint64_t>(off) + 2ULL + 4ULL * run_count > len)
+          goto fail_offset;
+        const uint8_t* p = data + off + 2;
+        for (uint32_t r = 0; r < run_count; r++) {
+          uint16_t start = rd16(p + 4 * r);
+          uint16_t last = rd16(p + 4 * r + 2);
+          // set bits [start, last] inclusive, word-blasted
+          uint32_t ws = start >> 6, we = last >> 6;
+          if (ws == we) {
+            w[ws] |= (~0ULL >> (63 - (last & 63))) & (~0ULL << (start & 63));
+          } else {
+            w[ws] |= ~0ULL << (start & 63);
+            for (uint32_t k = ws + 1; k < we; k++) w[k] = ~0ULL;
+            w[we] |= ~0ULL >> (63 - (last & 63));
+          }
+        }
+        break;
+      }
+      default:
+        std::free(keys);
+        std::free(words);
+        return ROARING_ERR_TYPE;
+    }
+  }
+  *keys_out = keys;
+  *words_out = words;
+  *n_out = n;
+  return ROARING_OK;
+
+fail_offset:
+  std::free(keys);
+  std::free(words);
+  return ROARING_ERR_OFFSET;
+}
+
+void pilosa_roaring_free_buf(void* p) { std::free(p); }
+
+// Encode dense containers into the serialized format.
+// keys must be sorted ascending; words is n * 1024 u64.
+// Empty containers (no bits) are skipped, as in the reference's WriteTo.
+int pilosa_roaring_encode(const uint64_t* keys, const uint64_t* words,
+                          uint64_t n, uint8_t flags, uint8_t** buf_out,
+                          uint64_t* len_out) {
+  struct Plan {
+    uint64_t key;
+    uint32_t card;
+    uint16_t typ;
+    uint32_t runs;
+    const uint64_t* w;
+  };
+  std::vector<Plan> plans;
+  plans.reserve(n);
+  for (uint64_t i = 0; i < n; i++) {
+    const uint64_t* w = words + i * kWordsPerContainer;
+    uint32_t card = 0;
+    uint32_t runs = 0;
+    uint64_t prev_msb = 0;  // bit 63 of previous word
+    for (uint32_t k = 0; k < kWordsPerContainer; k++) {
+      uint64_t v = w[k];
+      card += static_cast<uint32_t>(__builtin_popcountll(v));
+      // runs = number of 0->1 transitions across the bit sequence
+      uint64_t starts = v & ~((v << 1) | prev_msb);
+      runs += static_cast<uint32_t>(__builtin_popcountll(starts));
+      prev_msb = v >> 63;
+    }
+    if (card == 0) continue;
+    uint64_t array_size = (card <= 4096) ? 2ULL * card : UINT64_MAX;
+    uint64_t run_size = 2ULL + 4ULL * runs;
+    uint64_t bitmap_size = 8192;
+    uint16_t typ;
+    if (run_size < array_size && run_size < bitmap_size) {
+      typ = kTypeRun;
+    } else if (array_size <= bitmap_size) {
+      typ = kTypeArray;
+    } else {
+      typ = kTypeBitmap;
+    }
+    plans.push_back({keys[i], card, typ, runs, w});
+  }
+
+  std::vector<uint8_t> buf;
+  uint64_t count = plans.size();
+  buf.reserve(kHeaderBaseSize + count * 20 + count * 512);
+  wr16(buf, kMagic);
+  buf.push_back(0);      // version
+  buf.push_back(flags);  // flags
+  wr32(buf, static_cast<uint32_t>(count));
+  for (const Plan& p : plans) {
+    wr64(buf, p.key);
+    wr16(buf, p.typ);
+    wr16(buf, static_cast<uint16_t>(p.card - 1));
+  }
+  // offset section
+  uint64_t offset = kHeaderBaseSize + count * 12 + count * 4;
+  for (const Plan& p : plans) {
+    wr32(buf, static_cast<uint32_t>(offset));
+    switch (p.typ) {
+      case kTypeArray: offset += 2ULL * p.card; break;
+      case kTypeBitmap: offset += 8192; break;
+      case kTypeRun: offset += 2ULL + 4ULL * p.runs; break;
+    }
+  }
+  // payloads
+  for (const Plan& p : plans) {
+    switch (p.typ) {
+      case kTypeArray: {
+        for (uint32_t k = 0; k < kWordsPerContainer; k++) {
+          uint64_t v = p.w[k];
+          while (v) {
+            uint32_t bit = static_cast<uint32_t>(__builtin_ctzll(v));
+            wr16(buf, static_cast<uint16_t>(k * 64 + bit));
+            v &= v - 1;
+          }
+        }
+        break;
+      }
+      case kTypeBitmap: {
+        const uint8_t* p8 = reinterpret_cast<const uint8_t*>(p.w);
+        buf.insert(buf.end(), p8, p8 + 8192);
+        break;
+      }
+      case kTypeRun: {
+        wr16(buf, static_cast<uint16_t>(p.runs));
+        bool in_run = false;
+        uint32_t start = 0;
+        for (uint32_t bitpos = 0; bitpos < 65536; bitpos++) {
+          bool set = (p.w[bitpos >> 6] >> (bitpos & 63)) & 1;
+          if (set && !in_run) {
+            in_run = true;
+            start = bitpos;
+          } else if (!set && in_run) {
+            in_run = false;
+            wr16(buf, static_cast<uint16_t>(start));
+            wr16(buf, static_cast<uint16_t>(bitpos - 1));
+          }
+        }
+        if (in_run) {
+          wr16(buf, static_cast<uint16_t>(start));
+          wr16(buf, 65535);
+        }
+        break;
+      }
+    }
+  }
+
+  uint8_t* out = static_cast<uint8_t*>(std::malloc(buf.size()));
+  if (!out && !buf.empty()) return ROARING_ERR_TRUNCATED;
+  std::memcpy(out, buf.data(), buf.size());
+  *buf_out = out;
+  *len_out = buf.size();
+  return ROARING_OK;
+}
+
+}  // extern "C"

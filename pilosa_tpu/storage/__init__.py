@@ -1,0 +1,22 @@
+"""Storage/interchange: the roaring file codec and fragment archives.
+
+Roaring's container layout survives only at this boundary (file format
+compatibility for import/export and node-to-node transfer); the compute
+path is dense packed tensors (SURVEY.md §7 design stance).
+"""
+
+from pilosa_tpu.storage.roaring import (
+    decode,
+    encode,
+    native_available,
+    positions_to_containers,
+    containers_to_positions,
+)
+
+__all__ = [
+    "decode",
+    "encode",
+    "native_available",
+    "positions_to_containers",
+    "containers_to_positions",
+]

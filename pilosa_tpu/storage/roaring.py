@@ -1,0 +1,293 @@
+"""Roaring codec: Pilosa's 64-bit roaring file format (cookie 12348).
+
+Bit-compatible with the reference's serialization (docs/architecture.md:
+9-24, roaring/roaring.go:1046 WriteTo, roaring/unmarshal_binary.go) so
+`import-roaring` payloads, exports, and fragment transfers interoperate.
+
+Two implementations with identical observable behavior:
+- **native** (default): C++ (pilosa_tpu/native/roaring_codec.cpp) via
+  ctypes, compiled on first use with the toolchain in the image.
+- **numpy fallback**: vectorized Python used when no compiler exists.
+
+Decoded form is (keys u64[n], words u64[n, 1024]) — dense 2^16-bit blocks
+keyed by position>>16, which reinterpret directly as the uint32 packed
+tensors the device kernels consume.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+WORDS_PER_CONTAINER = 1024
+CONTAINER_BITS = 1 << 16
+MAGIC = 12348
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "roaring_codec.cpp")
+_SO = os.path.join(_NATIVE_DIR, "build", "libpilosa_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _load_native():
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                os.makedirs(os.path.dirname(_SO), exist_ok=True)
+                # per-process tmp name: concurrent cold builds must not
+                # write the same file and publish a torn .so
+                tmp = f"{_SO}.tmp.{os.getpid()}"
+                try:
+                    subprocess.run(
+                        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+                        check=True,
+                        capture_output=True,
+                    )
+                    os.replace(tmp, _SO)
+                finally:
+                    if os.path.exists(tmp):
+                        os.unlink(tmp)
+            lib = ctypes.CDLL(_SO)
+            lib.pilosa_roaring_decode.restype = ctypes.c_int
+            lib.pilosa_roaring_decode.argtypes = [
+                ctypes.c_char_p,
+                ctypes.c_uint64,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint8),
+            ]
+            lib.pilosa_roaring_encode.restype = ctypes.c_int
+            lib.pilosa_roaring_encode.argtypes = [
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.POINTER(ctypes.c_uint64),
+                ctypes.c_uint64,
+                ctypes.c_uint8,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.pilosa_roaring_free_buf.argtypes = [ctypes.c_void_p]
+            _lib = lib
+        except Exception:
+            _build_failed = True
+            _lib = None
+        return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+_ERRORS = {
+    -1: "truncated roaring data",
+    -2: "bad roaring magic (want 12348)",
+    -3: "unsupported roaring file version",
+    -4: "unknown container type",
+    -5: "container offset out of bounds",
+}
+
+
+class RoaringError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------- decode
+
+
+def decode(data: bytes) -> tuple[np.ndarray, np.ndarray, int]:
+    """Parse serialized roaring -> (keys u64[n], words u64[n,1024], flags)."""
+    lib = _load_native()
+    if lib is not None:
+        keys_p = ctypes.POINTER(ctypes.c_uint64)()
+        words_p = ctypes.POINTER(ctypes.c_uint64)()
+        n = ctypes.c_uint64()
+        flags = ctypes.c_uint8()
+        rc = lib.pilosa_roaring_decode(
+            data, len(data),
+            ctypes.byref(keys_p), ctypes.byref(words_p),
+            ctypes.byref(n), ctypes.byref(flags),
+        )
+        if rc != 0:
+            raise RoaringError(_ERRORS.get(rc, f"roaring decode error {rc}"))
+        nv = n.value
+        try:
+            keys = np.ctypeslib.as_array(keys_p, shape=(nv,)).copy() if nv else np.empty(0, np.uint64)
+            words = (
+                np.ctypeslib.as_array(words_p, shape=(nv, WORDS_PER_CONTAINER)).copy()
+                if nv else np.empty((0, WORDS_PER_CONTAINER), np.uint64)
+            )
+        finally:
+            lib.pilosa_roaring_free_buf(keys_p)
+            lib.pilosa_roaring_free_buf(words_p)
+        return keys, words, flags.value
+    return _decode_py(data)
+
+
+def _decode_py(data: bytes) -> tuple[np.ndarray, np.ndarray, int]:
+    buf = np.frombuffer(data, dtype=np.uint8)
+    if len(buf) < 8:
+        raise RoaringError("truncated roaring data")
+    magic = int(buf[0]) | (int(buf[1]) << 8)
+    if magic != MAGIC:
+        raise RoaringError("bad roaring magic (want 12348)")
+    if buf[2] != 0:
+        raise RoaringError("unsupported roaring file version")
+    flags = int(buf[3])
+    n = int(np.frombuffer(data, dtype=np.uint32, count=1, offset=4)[0])
+    if len(buf) < 8 + n * 16:
+        raise RoaringError("truncated roaring data")
+    # 12-byte descriptive entries, then a separate 4-byte offset section
+    desc = np.frombuffer(data, dtype=np.uint8, count=n * 12, offset=8)
+    keys = desc.reshape(n, 12)[:, :8].copy().view(np.uint64).reshape(n)
+    typs = desc.reshape(n, 12)[:, 8:10].copy().view(np.uint16).reshape(n)
+    cards = desc.reshape(n, 12)[:, 10:12].copy().view(np.uint16).reshape(n).astype(np.int64) + 1
+    offs = np.frombuffer(data, dtype=np.uint32, count=n, offset=8 + n * 12).astype(np.int64)
+    words = np.zeros((n, WORDS_PER_CONTAINER), dtype=np.uint64)
+    for i in range(n):
+        off, typ, card = int(offs[i]), int(typs[i]), int(cards[i])
+        w8 = words[i].view(np.uint8)
+        if typ == 1:  # array
+            if off + 2 * card > len(buf):
+                raise RoaringError("container offset out of bounds")
+            vals = np.frombuffer(data, dtype=np.uint16, count=card, offset=off).astype(np.int64)
+            np.bitwise_or.at(
+                words[i], vals // 64, np.uint64(1) << (vals % 64).astype(np.uint64)
+            )
+        elif typ == 2:  # bitmap
+            if off + 8192 > len(buf):
+                raise RoaringError("container offset out of bounds")
+            w8[:] = buf[off : off + 8192]
+        elif typ == 3:  # run
+            if off + 2 > len(buf):
+                raise RoaringError("container offset out of bounds")
+            rc = int(np.frombuffer(data, dtype=np.uint16, count=1, offset=off)[0])
+            if off + 2 + 4 * rc > len(buf):
+                raise RoaringError("container offset out of bounds")
+            runs = np.frombuffer(data, dtype=np.uint16, count=2 * rc, offset=off + 2).reshape(rc, 2)
+            bits = np.zeros(CONTAINER_BITS, dtype=bool)
+            for start, last in runs.astype(np.int64):
+                bits[start : last + 1] = True
+            words[i] = np.packbits(bits, bitorder="little").view(np.uint64)
+        else:
+            raise RoaringError("unknown container type")
+    return keys, words, flags
+
+
+# --------------------------------------------------------------- encode
+
+
+def encode(keys: np.ndarray, words: np.ndarray, flags: int = 0) -> bytes:
+    """Serialize dense containers -> roaring bytes.  keys must be sorted
+    ascending and unique; empty containers are dropped."""
+    keys = np.asarray(keys, dtype=np.uint64)
+    words = np.ascontiguousarray(words, dtype=np.uint64).reshape(-1, WORDS_PER_CONTAINER)
+    if len(keys) != len(words):
+        raise ValueError("keys and words length mismatch")
+    if len(keys) > 1 and not (keys[:-1] < keys[1:]).all():
+        raise ValueError("keys must be sorted ascending and unique")
+    lib = _load_native()
+    if lib is not None:
+        buf_p = ctypes.POINTER(ctypes.c_uint8)()
+        blen = ctypes.c_uint64()
+        rc = lib.pilosa_roaring_encode(
+            keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            words.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            len(keys), flags,
+            ctypes.byref(buf_p), ctypes.byref(blen),
+        )
+        if rc != 0:
+            raise RoaringError(_ERRORS.get(rc, f"roaring encode error {rc}"))
+        try:
+            out = bytes(np.ctypeslib.as_array(buf_p, shape=(blen.value,))) if blen.value else b""
+        finally:
+            lib.pilosa_roaring_free_buf(buf_p)
+        return out
+    return _encode_py(keys, words, flags)
+
+
+def _encode_py(keys: np.ndarray, words: np.ndarray, flags: int) -> bytes:
+    plans = []
+    for i in range(len(keys)):
+        w = words[i]
+        card = int(np.bitwise_count(w).sum())
+        if card == 0:
+            continue
+        bits = np.unpackbits(w.view(np.uint8), bitorder="little")
+        starts = np.nonzero(np.diff(np.concatenate(([0], bits))) == 1)[0]
+        ends = np.nonzero(np.diff(np.concatenate((bits, [0]))) == -1)[0]
+        runs = len(starts)
+        array_size = 2 * card if card <= 4096 else 1 << 62
+        run_size = 2 + 4 * runs
+        if run_size < array_size and run_size < 8192:
+            typ = 3
+        elif array_size <= 8192:
+            typ = 1
+        else:
+            typ = 2
+        plans.append((int(keys[i]), card, typ, runs, w, bits, starts, ends))
+
+    out = bytearray()
+    out += int(MAGIC).to_bytes(2, "little")
+    out += bytes([0, flags])
+    out += len(plans).to_bytes(4, "little")
+    for key, card, typ, _, _, _, _, _ in plans:
+        out += int(key).to_bytes(8, "little")
+        out += int(typ).to_bytes(2, "little")
+        out += int(card - 1).to_bytes(2, "little")
+    offset = 8 + len(plans) * 12 + len(plans) * 4
+    for _, card, typ, runs, _, _, _, _ in plans:
+        out += int(offset).to_bytes(4, "little")
+        offset += {1: 2 * card, 2: 8192, 3: 2 + 4 * runs}[typ]
+    for _, card, typ, runs, w, bits, starts, ends in plans:
+        if typ == 1:
+            out += np.nonzero(bits)[0].astype(np.uint16).tobytes()
+        elif typ == 2:
+            out += w.tobytes()
+        else:
+            out += int(runs).to_bytes(2, "little")
+            pairs = np.empty((runs, 2), dtype=np.uint16)
+            pairs[:, 0] = starts
+            pairs[:, 1] = ends
+            out += pairs.tobytes()
+    return bytes(out)
+
+
+# ------------------------------------------------- position conversion
+
+
+def positions_to_containers(positions) -> tuple[np.ndarray, np.ndarray]:
+    """Sorted absolute bit positions -> (keys, dense words) containers."""
+    pos = np.asarray(positions, dtype=np.uint64)
+    if len(pos) == 0:
+        return np.empty(0, np.uint64), np.empty((0, WORDS_PER_CONTAINER), np.uint64)
+    keys = np.unique(pos >> np.uint64(16))
+    slot = np.searchsorted(keys, pos >> np.uint64(16))
+    words = np.zeros((len(keys), WORDS_PER_CONTAINER), dtype=np.uint64)
+    low = pos & np.uint64(0xFFFF)
+    flat_idx = slot * WORDS_PER_CONTAINER + (low >> np.uint64(6)).astype(np.int64)
+    np.bitwise_or.at(
+        words.reshape(-1), flat_idx, np.uint64(1) << (low & np.uint64(63))
+    )
+    return keys, words
+
+
+def containers_to_positions(keys: np.ndarray, words: np.ndarray) -> np.ndarray:
+    """Inverse of positions_to_containers: sorted absolute positions."""
+    out = []
+    for i in range(len(keys)):
+        bits = np.unpackbits(words[i].view(np.uint8), bitorder="little")
+        nz = np.nonzero(bits)[0].astype(np.uint64)
+        out.append((np.uint64(int(keys[i]) << 16)) + nz)
+    if not out:
+        return np.empty(0, dtype=np.uint64)
+    return np.concatenate(out)
