@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""North-star benchmark: PQL Count(Intersect(Row, Row)) QPS.
+
+Measures the fused AND+popcount+reduce kernel (the hot path of every
+Count/Intersect PQL query, reference executor.go:1790 → roaring.go:595)
+over a multi-shard packed-bitmap index on the available accelerator, and
+compares against an in-process NumPy CPU baseline evaluating the same
+query the way the reference's Go engine does (per-shard AND + popcount,
+serial map-reduce).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Benchmark shape: 256 shards x 2^20 columns = 268M columns per operand.
+# Each operand is a [shards, 2^15] uint32 tensor (32 MiB) resident in HBM.
+N_SHARDS = 256
+WORDS = (1 << 20) // 32
+DENSITY = 0.08  # fraction of bits set; typical set-field fragment occupancy
+
+
+def make_operands(seed: int):
+    rng = np.random.default_rng(seed)
+    # Bernoulli bits packed into uint32 words, identical data for both runs.
+    bits_a = rng.random((N_SHARDS, WORDS, 32)) < DENSITY
+    bits_b = rng.random((N_SHARDS, WORDS, 32)) < DENSITY
+    weights = (1 << np.arange(32, dtype=np.uint64)).astype(np.uint32)
+    a = (bits_a * weights).sum(axis=2, dtype=np.uint32)
+    b = (bits_b * weights).sum(axis=2, dtype=np.uint32)
+    return a, b
+
+
+def bench_device(a_np: np.ndarray, b_np: np.ndarray) -> tuple[float, int]:
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def count_intersect(a, b):
+        # Per-word popcounts total < 2^31 at this benchmark size, so an
+        # int32 accumulator is exact without enabling x64.
+        return jnp.sum(lax.population_count(a & b), dtype=jnp.int32)
+
+    a = jax.device_put(a_np)
+    b = jax.device_put(b_np)
+    # Warm-up: compile + one execution.
+    expect = int(count_intersect(a, b).block_until_ready())
+
+    # Closed-loop QPS: each iteration is one full query over all shards.
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = count_intersect(a, b)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    # One more timed pass with more iterations if the clock resolution is
+    # dominating (fast devices finish 50 queries in <0.2s).
+    if dt < 0.2:
+        iters = 500
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = count_intersect(a, b)
+        out.block_until_ready()
+        dt = time.perf_counter() - t0
+    return iters / dt, expect
+
+
+def bench_cpu_baseline(a: np.ndarray, b: np.ndarray) -> tuple[float, int]:
+    """Serial per-shard AND+popcount, mirroring the reference's single-node
+    map-reduce over shards (executor.go:2561 worker loop, one shard at a
+    time per worker; we grant the baseline full vectorization per shard)."""
+    def query() -> int:
+        total = 0
+        for s in range(a.shape[0]):
+            total += int(np.bitwise_count(a[s] & b[s]).sum(dtype=np.uint64))
+        return total
+
+    expect = query()  # warm-up / page-in
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        query()
+    dt = time.perf_counter() - t0
+    return iters / dt, expect
+
+
+def main():
+    a, b = make_operands(seed=12348)
+    cpu_qps, cpu_count = bench_cpu_baseline(a, b)
+    dev_qps, dev_count = bench_device(a, b)
+    assert dev_count == cpu_count, f"bit-exactness violated: {dev_count} != {cpu_count}"
+    print(json.dumps({
+        "metric": "intersect_count_qps_268M_cols",
+        "value": round(dev_qps, 2),
+        "unit": "qps",
+        "vs_baseline": round(dev_qps / cpu_qps, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -327,13 +327,15 @@ class Fragment:
         """Bulk-merge a serialized roaring bitmap in fragment position
         space (pos = row*width + off) — the fastest ingest path
         (reference fragment.importRoaring, fragment.go:2255, via
-        roaring.ImportRoaringBits).  Durability comes from an immediate
-        snapshot rather than WAL records."""
+        roaring.ImportRoaringBits).  Durability: the changed-bit deltas
+        append to the WAL as one bulk record (the reference's op-log
+        batching, fragment.go:84), so chunked streaming imports stay
+        linear instead of re-snapshotting the fragment per chunk."""
         from pilosa_tpu.storage import roaring as rcodec
 
         keys, cwords, _flags = rcodec.decode(data)
         cpr = self.width // rcodec.CONTAINER_BITS  # containers per row
-        changed = False
+        delta_pos = []  # absolute fragment positions actually flipped
         with self._lock:
             for i in range(len(keys)):
                 k = int(keys[i])
@@ -345,21 +347,36 @@ class Fragment:
                     if arr is None:
                         continue
                     w64 = arr.view(np.uint64)
-                    if (w64[lo:hi] & cwords[i]).any():
-                        changed = True
+                    gone = w64[lo:hi] & cwords[i]
+                    if gone.any():
+                        bits = np.unpackbits(gone.view(np.uint8), bitorder="little")
+                        delta_pos.append(
+                            np.uint64(k << 16) + np.nonzero(bits)[0].astype(np.uint64)
+                        )
                         w64[lo:hi] &= ~cwords[i]
                 else:
                     if not cwords[i].any():
                         continue
                     arr = self._row_array(row, create=True)
                     w64 = arr.view(np.uint64)
-                    if (cwords[i] & ~w64[lo:hi]).any():
-                        changed = True
+                    new = cwords[i] & ~w64[lo:hi]
+                    if new.any():
+                        bits = np.unpackbits(new.view(np.uint8), bitorder="little")
+                        delta_pos.append(
+                            np.uint64(k << 16) + np.nonzero(bits)[0].astype(np.uint64)
+                        )
                         w64[lo:hi] |= cwords[i]
-            if changed:
+            if delta_pos:
+                pos = np.concatenate(delta_pos)
+                sets = pos if not clear else np.empty(0, dtype=np.uint64)
+                clears = pos if clear else np.empty(0, dtype=np.uint64)
+                self._wal_append(
+                    _WAL_BULK_HDR.pack(_WAL_BULK, len(sets), len(clears))
+                    + sets.tobytes() + clears.tobytes()
+                )
+                self._op_n += len(pos)
                 self._gen += 1
-                if self.path is not None:
-                    self.snapshot()
+                self._maybe_snapshot()
 
     def to_roaring(self) -> bytes:
         """Serialize the whole fragment as one roaring bitmap in fragment

@@ -226,6 +226,7 @@ int pilosa_roaring_encode(const uint64_t* keys, const uint64_t* words,
   // offset section
   uint64_t offset = kHeaderBaseSize + count * 12 + count * 4;
   for (const Plan& p : plans) {
+    if (offset > UINT32_MAX) return -6;  // 4 GiB offset-field limit
     wr32(buf, static_cast<uint32_t>(offset));
     switch (p.typ) {
       case kTypeArray: offset += 2ULL * p.card; break;

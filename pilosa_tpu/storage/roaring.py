@@ -36,28 +36,38 @@ _lib_lock = threading.Lock()
 _build_failed = False
 
 
+def _build_so(force: bool = False) -> None:
+    if not force and os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    # per-process tmp name: concurrent cold builds must not
+    # write the same file and publish a torn .so
+    tmp = f"{_SO}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def _load_native():
     global _lib, _build_failed
     with _lib_lock:
         if _lib is not None or _build_failed:
             return _lib
         try:
-            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-                os.makedirs(os.path.dirname(_SO), exist_ok=True)
-                # per-process tmp name: concurrent cold builds must not
-                # write the same file and publish a torn .so
-                tmp = f"{_SO}.tmp.{os.getpid()}"
-                try:
-                    subprocess.run(
-                        ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
-                        check=True,
-                        capture_output=True,
-                    )
-                    os.replace(tmp, _SO)
-                finally:
-                    if os.path.exists(tmp):
-                        os.unlink(tmp)
-            lib = ctypes.CDLL(_SO)
+            _build_so()
+            try:
+                lib = ctypes.CDLL(_SO)
+            except OSError:
+                # a stale or foreign-ABI binary on disk: rebuild and retry once
+                _build_so(force=True)
+                lib = ctypes.CDLL(_SO)
             lib.pilosa_roaring_decode.restype = ctypes.c_int
             lib.pilosa_roaring_decode.argtypes = [
                 ctypes.c_char_p,
@@ -94,6 +104,7 @@ _ERRORS = {
     -3: "unsupported roaring file version",
     -4: "unknown container type",
     -5: "container offset out of bounds",
+    -6: "serialized size exceeds the format's 4 GiB offset limit",
 }
 
 
@@ -246,6 +257,8 @@ def _encode_py(keys: np.ndarray, words: np.ndarray, flags: int) -> bytes:
         out += int(card - 1).to_bytes(2, "little")
     offset = 8 + len(plans) * 12 + len(plans) * 4
     for _, card, typ, runs, _, _, _, _ in plans:
+        if offset > 0xFFFFFFFF:
+            raise RoaringError(_ERRORS[-6])
         out += int(offset).to_bytes(4, "little")
         offset += {1: 2 * card, 2: 8192, 3: 2 + 4 * runs}[typ]
     for _, card, typ, runs, w, bits, starts, ends in plans:
