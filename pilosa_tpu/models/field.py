@@ -114,8 +114,8 @@ class FieldOptions:
     # ---- constructors matching the reference's functional options ----
 
     @classmethod
-    def set_field(cls, cache_type=DEFAULT_CACHE_TYPE, cache_size=DEFAULT_CACHE_SIZE):
-        return cls(type=FieldType.SET, cache_type=cache_type, cache_size=cache_size)
+    def set_field(cls, cache_type=DEFAULT_CACHE_TYPE, cache_size=DEFAULT_CACHE_SIZE, keys=False):
+        return cls(type=FieldType.SET, cache_type=cache_type, cache_size=cache_size, keys=keys)
 
     @classmethod
     def int_field(cls, lo: int, hi: int):
@@ -166,6 +166,18 @@ class Field:
         self.row_attrs = AttrStore(
             None if path is None else os.path.join(path, ".row_attrs.db")
         )
+        self._translate_store = None
+
+    @property
+    def translate_store(self):
+        """Row-key translate store, opened lazily (reference field-level
+        TranslateStore, field.go keys option)."""
+        if self._translate_store is None:
+            from pilosa_tpu.storage.translate import open_translate_store
+
+            path = None if self.path is None else os.path.join(self.path, ".keys.db")
+            self._translate_store = open_translate_store(path)
+        return self._translate_store
 
     # ------------------------------------------------------------ metadata
 
@@ -216,6 +228,8 @@ class Field:
             self.views[name] = View(
                 os.path.join(views_dir, name), self.index, self.name, name,
                 mutex=self._is_mutex_like,
+                cache_type=self.options.cache_type,
+                cache_size=self.options.cache_size,
             )
 
     # ------------------------------------------------------------- views
@@ -239,7 +253,12 @@ class Field:
                     None if self.path is None
                     else os.path.join(self.path, "views", name)
                 )
-                v = View(path, self.index, self.name, name, mutex=self._is_mutex_like)
+                v = View(
+                    path, self.index, self.name, name,
+                    mutex=self._is_mutex_like,
+                    cache_type=self.options.cache_type,
+                    cache_size=self.options.cache_size,
+                )
                 self.views[name] = v
             return v
 
@@ -500,6 +519,8 @@ class Field:
         for view in self.views.values():
             view.close()
         self.row_attrs.close()
+        if self._translate_store is not None:
+            self._translate_store.close()
 
     def snapshot(self) -> None:
         for view in self.views.values():

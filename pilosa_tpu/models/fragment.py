@@ -57,6 +57,8 @@ class Fragment:
         shard: int,
         mutex: bool = False,
         max_op_n: int = DEFAULT_MAX_OP_N,
+        cache_type: str = "ranked",
+        cache_size: int = 50000,
     ):
         self.path = path
         self.index = index
@@ -75,12 +77,20 @@ class Fragment:
         self._device_cache: dict = {}
         self._lock = threading.RLock()
 
+        from pilosa_tpu.models.cache import TopNCache
+
+        self.topn_cache = TopNCache(cache_type, cache_size)
+
         self._wal = None
         self._op_n = 0
         if path is not None:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             self._load()
             self._wal = open(self._wal_path, "ab")
+            # A persisted .cache is exact only for a WAL-clean reopen
+            # (fragment.go:2403 .cache files).
+            if self._op_n == 0:
+                self.topn_cache.load(self._cache_path, self._gen)
 
     # ------------------------------------------------------------------ io
 
@@ -91,6 +101,10 @@ class Fragment:
     @property
     def _wal_path(self) -> str:
         return self.path + ".wal"
+
+    @property
+    def _cache_path(self) -> str:
+        return self.path + ".cache"
 
     def _load(self) -> None:
         if os.path.exists(self._snap_path):
@@ -168,6 +182,7 @@ class Fragment:
                 self._wal.close()
             self._wal = open(self._wal_path, "wb")
             self._op_n = 0
+            self.topn_cache.save(self._cache_path, self._gen)
 
     def close(self) -> None:
         with self._lock:
@@ -426,6 +441,31 @@ class Fragment:
     def row_count(self, row: int) -> int:
         arr = self._rows.get(row)
         return 0 if arr is None else int(np.bitwise_count(arr).sum())
+
+    def cached_row_counts(self, n: int = 0) -> dict[int, int] | None:
+        """Exact {row: count} from the TopN cache when valid for the
+        current generation and sufficient to answer TopN(n) exactly
+        (n=0 demands a complete cache); else None."""
+        with self._lock:
+            counts = self.topn_cache.get(self._gen)
+            if counts is None or not self.topn_cache.exact_for(n):
+                return None
+            return counts
+
+    def cache_row_counts(self, counts: dict[int, int], gen: int | None = None) -> None:
+        """Store counts computed at generation ``gen`` (defaults to the
+        current one).  If a write advanced the generation since the caller
+        read the matrix, the entry simply never hits — it must NOT be
+        stamped with the newer generation."""
+        with self._lock:
+            self.topn_cache.put(self._gen if gen is None else gen, counts)
+
+    def device_matrix_with_gen(self):
+        """(gen, row_ids, device matrix) — gen captured atomically with
+        the matrix read, for correctly-stamped downstream caching."""
+        with self._lock:
+            ids, dev = self.device_matrix()
+            return self._gen, ids, dev
 
     def min_row_id(self):
         ids = self.row_ids()

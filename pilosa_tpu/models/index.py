@@ -54,6 +54,18 @@ class Index:
         self.column_attrs = AttrStore(
             None if path is None else os.path.join(path, ".column_attrs.db")
         )
+        self._translate_store = None
+
+    @property
+    def translate_store(self):
+        """Column-key translate store, opened lazily (reference
+        index.go column translation via holder.translateFile)."""
+        if self._translate_store is None:
+            from pilosa_tpu.storage.translate import open_translate_store
+
+            path = None if self.path is None else os.path.join(self.path, ".keys.db")
+            self._translate_store = open_translate_store(path)
+        return self._translate_store
 
     @property
     def _meta_path(self) -> str:
@@ -142,6 +154,8 @@ class Index:
         for f in self.fields.values():
             f.close()
         self.column_attrs.close()
+        if self._translate_store is not None:
+            self._translate_store.close()
 
     def snapshot(self) -> None:
         for f in self.fields.values():

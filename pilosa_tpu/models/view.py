@@ -28,12 +28,16 @@ class View:
         field: str,
         name: str,
         mutex: bool = False,
+        cache_type: str = "ranked",
+        cache_size: int = 50000,
     ):
         self.path = path
         self.index = index
         self.field = field
         self.name = name
         self.mutex = mutex
+        self.cache_type = cache_type
+        self.cache_size = cache_size
         self.fragments: dict[int, Fragment] = {}
         if path is not None:
             os.makedirs(self._frag_dir, exist_ok=True)
@@ -56,6 +60,7 @@ class View:
             self.fragments[shard] = Fragment(
                 self._frag_path(shard), self.index, self.field, self.name,
                 shard, mutex=self.mutex,
+                cache_type=self.cache_type, cache_size=self.cache_size,
             )
 
     def fragment(self, shard: int) -> Fragment | None:
@@ -66,7 +71,8 @@ class View:
         if frag is None:
             path = None if self.path is None else self._frag_path(shard)
             frag = Fragment(
-                path, self.index, self.field, self.name, shard, mutex=self.mutex
+                path, self.index, self.field, self.name, shard, mutex=self.mutex,
+                cache_type=self.cache_type, cache_size=self.cache_size,
             )
             self.fragments[shard] = frag
         return frag

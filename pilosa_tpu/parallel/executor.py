@@ -55,6 +55,15 @@ class ExecutionError(ValueError):
     pass
 
 
+# Sentinel call names substituted during key translation when a read-path
+# key does not exist: _Empty evaluates as an empty bitmap, _Noop as a
+# changed=False write (reference: missing keys yield empty rows /
+# unchanged writes, executor.go:2610 translateCalls).
+_EMPTY_CALL = "_Empty"
+_NOOP_CALL = "_Noop"
+_EMPTY_ROWS_CALL = "_EmptyRows"
+
+
 class Executor:
     def __init__(self, holder, worker_pool_size: int | None = None, cluster=None):
         self.holder = holder
@@ -74,15 +83,31 @@ class Executor:
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
+        # Key translation happens once at the originating node, never on
+        # remote re-execution (reference executor.Execute, executor.go:146).
+        calls = query.calls
+        if not opt.remote:
+            calls = [self._translate_call(idx, c) for c in calls]
         results = []
-        for call in query.calls:
+        for call in calls:
             results.append(self._execute_call(idx, call, shards, opt))
+        if not opt.remote:
+            results = [
+                self._translate_result(idx, call, res)
+                for call, res in zip(calls, results)
+            ]
         return results
 
     # ----------------------------------------------------------- dispatch
 
     def _execute_call(self, idx, call: Call, shards, opt: ExecOptions):
         name = call.name
+        if name == _EMPTY_CALL:
+            return Row()
+        if name == _NOOP_CALL:
+            return False
+        if name == _EMPTY_ROWS_CALL:
+            return []
         if name == "Set":
             return self._execute_set(idx, call)
         if name == "Clear":
@@ -187,6 +212,8 @@ class Executor:
         (device or numpy) or None for empty (reference
         executeBitmapCallShard, executor.go:651)."""
         name = call.name
+        if name == _EMPTY_CALL:
+            return None
         if name == "Row" or name == "Range":
             return self._row_words_shard(idx, call, shard)
         if name == "Union":
@@ -356,12 +383,24 @@ class Executor:
         shards = self._target_shards(idx, shards, opt)
         filter_call = call.children[0] if call.children else None
 
+        # A truncated per-shard cache is only exact when there is nothing
+        # to merge with: multi-shard aggregation of per-shard top lists
+        # loses rows that rank below the truncation point in one shard
+        # but high globally.  Post-count filters likewise require the
+        # complete row set.  cache_n=0 demands a complete cache.
+        single_shard = len(shards) == 1
+        cache_n = n if single_shard and not (ids_arg or attr_name or threshold) else 0
+
         def map_fn(shard):
             view = f.view(VIEW_STANDARD)
             frag = view.fragment(shard) if view is not None else None
             if frag is None:
                 return {}
-            row_ids, matrix = frag.device_matrix()
+            if filter_call is None:
+                cached = frag.cached_row_counts(cache_n)
+                if cached is not None:
+                    return cached
+            gen, row_ids, matrix = frag.device_matrix_with_gen()
             if len(row_ids) == 0:
                 return {}
             if filter_call is not None:
@@ -372,7 +411,10 @@ class Executor:
             else:
                 counts = bm.row_counts(matrix)
             counts = np.asarray(counts)
-            return {int(r): int(c) for r, c in zip(row_ids, counts) if c > 0}
+            out = {int(r): int(c) for r, c in zip(row_ids, counts) if c > 0}
+            if filter_call is None:
+                frag.cache_row_counts(out, gen=gen)
+            return out
 
         totals: dict[int, int] = {}
         for part in self._map_shards(map_fn, shards):
@@ -735,3 +777,161 @@ class Executor:
             else:
                 raise ExecutionError(f"unknown Options() argument: {key!r}")
         return self._execute_call(idx, call.children[0], shards, new_opt)
+
+    # ----------------------------------------------------- key translation
+
+    def _translate_call(self, idx, call: Call) -> Call:
+        """Rewrite string keys to uint64 ids on a clone of the call tree
+        (reference translateCalls, executor.go:2610).  Read-path misses
+        become _Empty/_Noop sentinels; write paths create keys."""
+        call = call.clone()
+        return self._translate_call_rec(idx, call)
+
+    def _translate_col_key(self, idx, call: Call, create: bool) -> bool:
+        """Translate a string _col argument in place.  Returns False when
+        the key doesn't exist and wasn't created."""
+        v = call.args.get("_col")
+        if not isinstance(v, str):
+            return True
+        if not idx.options.keys:
+            raise ExecutionError(
+                f"index {idx.name!r} does not use string keys (option keys=true)"
+            )
+        id = idx.translate_store.translate_key(v, create=create)
+        if id is None:
+            return False
+        call.args["_col"] = id
+        return True
+
+    def _translate_row_key(self, idx, call: Call, arg_key: str, create: bool) -> bool:
+        """Translate a string row value held under args[arg_key], where
+        arg_key names the field.  Returns False on a read-path miss."""
+        v = call.args.get(arg_key)
+        if not isinstance(v, str):
+            return True
+        f = idx.field(arg_key)
+        if f is None:
+            raise ExecutionError(f"field not found: {arg_key}")
+        if not f.options.keys:
+            raise ExecutionError(
+                f"field {arg_key!r} does not use string keys (option keys=true)"
+            )
+        id = f.translate_store.translate_key(v, create=create)
+        if id is None:
+            return False
+        call.args[arg_key] = id
+        return True
+
+    def _translate_call_rec(self, idx, call: Call) -> Call:
+        name = call.name
+        if name == "Set":
+            self._translate_col_key(idx, call, create=True)
+            self._translate_row_key(idx, call, call.field_arg(), create=True)
+            return call
+        if name == "Clear":
+            if not self._translate_col_key(idx, call, create=False):
+                return Call(_NOOP_CALL)
+            if not self._translate_row_key(idx, call, call.field_arg(), create=False):
+                return Call(_NOOP_CALL)
+            return call
+        if name == "SetColumnAttrs":
+            self._translate_col_key(idx, call, create=True)
+            return call
+        if name == "SetRowAttrs":
+            fname = call.args.get("_field")
+            v = call.args.get("_row")
+            if isinstance(v, str) and fname:
+                f = idx.field(fname)
+                if f is None:
+                    raise ExecutionError(f"field not found: {fname}")
+                if not f.options.keys:
+                    raise ExecutionError(f"field {fname!r} does not use string keys")
+                call.args["_row"] = f.translate_store.translate_key(v, create=True)
+            return call
+        if name in ("Store", "ClearRow"):
+            created = name == "Store"
+            if not self._translate_row_key(idx, call, call.field_arg(), create=created):
+                return Call(_NOOP_CALL)
+            call.children = [self._translate_call_rec(idx, c) for c in call.children]
+            return call
+        if name == "Row" or name == "Range":
+            if call.has_condition_arg():
+                return call
+            fname = next(
+                (
+                    k
+                    for k in call.args
+                    if not k.startswith("_") and k not in ("from", "to")
+                ),
+                None,
+            )
+            if fname is None:
+                return call
+            if not self._translate_row_key(idx, call, fname, create=False):
+                return Call(_EMPTY_CALL)
+            return call
+        if name == "Rows":
+            fname = call.args.get("_field")
+            prev = call.args.get("previous")
+            if isinstance(prev, str) and fname:
+                f = idx.field(fname)
+                if f is None:
+                    raise ExecutionError(f"field not found: {fname}")
+                if not f.options.keys:
+                    raise ExecutionError(f"field {fname!r} does not use string keys")
+                id = f.translate_store.translate_key(prev, create=False)
+                if id is None:
+                    raise ExecutionError(f"previous key not found: {prev!r}")
+                call.args["previous"] = id
+            col = call.args.get("column")
+            if isinstance(col, str):
+                if not idx.options.keys:
+                    raise ExecutionError(
+                        f"index {idx.name!r} does not use string keys"
+                    )
+                id = idx.translate_store.translate_key(col, create=False)
+                if id is None:
+                    return Call(_EMPTY_ROWS_CALL)  # unknown column: no rows
+                call.args["column"] = id
+            return call
+        # Pure structural calls: recurse into children and the GroupBy
+        # filter argument.
+        call.children = [self._translate_call_rec(idx, c) for c in call.children]
+        filt = call.args.get("filter")
+        if isinstance(filt, Call):
+            call.args["filter"] = self._translate_call_rec(idx, filt)
+        return call
+
+    def _translate_result(self, idx, call: Call, res):
+        """Translate ids back to keys in results (reference
+        translateResults, executor.go:2781)."""
+        if isinstance(res, Row):
+            if idx.options.keys:
+                keys = idx.translate_store.translate_ids(res.columns())
+                res.keys = [k or "" for k in keys]
+            return res
+        if isinstance(res, Pair) or (
+            isinstance(res, list) and res and isinstance(res[0], Pair)
+        ):
+            fname = call.args.get("_field") or call.args.get("field")
+            f = idx.field(fname) if fname else None
+            if f is not None and f.options.keys:
+                pairs = [res] if isinstance(res, Pair) else res
+                keys = f.translate_store.translate_ids([p.id for p in pairs])
+                for p, k in zip(pairs, keys):
+                    p.key = k or ""
+            return res
+        if call.name == "Rows" and isinstance(res, list):
+            fname = call.args.get("_field")
+            f = idx.field(fname) if fname else None
+            if f is not None and f.options.keys:
+                return [k or "" for k in f.translate_store.translate_ids(res)]
+            return res
+        if call.name == "GroupBy" and isinstance(res, list):
+            for gc in res:
+                for fr in gc.group:
+                    f = idx.field(fr.field)
+                    if f is not None and f.options.keys:
+                        fr.row_key = f.translate_store.translate_id(fr.row_id) or ""
+            return res
+        return res

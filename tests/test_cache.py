@@ -1,0 +1,191 @@
+"""TopN cache semantics (reference cache.go:35,58,136 + .cache files,
+fragment.go:2403-2434) and executor integration."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.models.cache import (
+    CACHE_TYPE_LRU,
+    CACHE_TYPE_NONE,
+    CACHE_TYPE_RANKED,
+    TopNCache,
+)
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.fragment import Fragment
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.parallel.executor import Executor
+
+
+class TestTopNCache:
+    def test_complete_cache_roundtrip(self):
+        c = TopNCache(CACHE_TYPE_RANKED, size=100)
+        counts = {1: 10, 2: 20, 3: 5}
+        c.put(7, counts)
+        assert c.get(7) == counts
+        assert c.complete
+        assert c.exact_for(0) and c.exact_for(99)
+        assert c.get(8) is None  # stale generation
+
+    def test_truncated_ranked_keeps_top(self):
+        c = TopNCache(CACHE_TYPE_RANKED, size=2)
+        c.put(1, {1: 10, 2: 30, 3: 20})
+        got = c.get(1)
+        assert got == {2: 30, 3: 20}
+        assert not c.complete
+        assert c.exact_for(1) and c.exact_for(2)
+        assert not c.exact_for(3) and not c.exact_for(0)
+
+    def test_truncated_lru_never_exact(self):
+        c = TopNCache(CACHE_TYPE_LRU, size=2)
+        c.put(1, {1: 10, 2: 30, 3: 20})
+        assert not c.exact_for(1)
+
+    def test_none_type_disabled(self):
+        c = TopNCache(CACHE_TYPE_NONE, size=10)
+        c.put(1, {1: 10})
+        assert c.get(1) is None
+
+    def test_persistence(self, tmp_path):
+        path = str(tmp_path / "x.cache")
+        c = TopNCache(CACHE_TYPE_RANKED, size=10)
+        c.put(3, {5: 50, 6: 60})
+        c.save(path, 3)
+        c2 = TopNCache(CACHE_TYPE_RANKED, size=10)
+        assert c2.load(path, 9)
+        assert c2.get(9) == {5: 50, 6: 60}
+
+    def test_save_skips_stale_gen(self, tmp_path):
+        path = str(tmp_path / "x.cache")
+        c = TopNCache(CACHE_TYPE_RANKED, size=10)
+        c.put(3, {5: 50})
+        c.save(path, 4)  # gen moved on; nothing persisted
+        assert not (tmp_path / "x.cache").exists()
+
+
+class TestFragmentCache:
+    def test_cache_hit_and_invalidation(self, tmp_path):
+        frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0)
+        frag.set_bit(1, 5)
+        frag.set_bit(1, 6)
+        assert frag.cached_row_counts(1) is None
+        frag.cache_row_counts({1: 2})
+        assert frag.cached_row_counts(1) == {1: 2}
+        frag.set_bit(1, 7)  # mutation bumps generation
+        assert frag.cached_row_counts(1) is None
+
+    def test_cache_survives_clean_reopen(self, tmp_path):
+        path = str(tmp_path / "f")
+        frag = Fragment(path, "i", "f", "standard", 0)
+        frag.set_bit(1, 5)
+        frag.cache_row_counts({1: 1})
+        frag.snapshot()  # persists .cache beside .snap, truncates WAL
+        frag.close()
+
+        frag2 = Fragment(path, "i", "f", "standard", 0)
+        assert frag2.cached_row_counts(1) == {1: 1}
+        frag2.close()
+
+    def test_cache_dropped_on_dirty_reopen(self, tmp_path):
+        path = str(tmp_path / "f")
+        frag = Fragment(path, "i", "f", "standard", 0)
+        frag.set_bit(1, 5)
+        frag.cache_row_counts({1: 1})
+        frag.snapshot()
+        frag.set_bit(2, 9)  # WAL op after the snapshot -> cache is stale
+        frag.close()
+
+        frag2 = Fragment(path, "i", "f", "standard", 0)
+        assert frag2.cached_row_counts(1) is None
+        assert frag2.bit(2, 9)
+        frag2.close()
+
+
+class TestExecutorCacheIntegration:
+    @pytest.fixture
+    def ex(self, tmp_path):
+        h = Holder(str(tmp_path / "h"))
+        idx = h.create_index("i")
+        idx.create_field("f")
+        return Executor(h), h
+
+    def test_topn_uses_and_fills_cache(self, ex):
+        ex, h = ex
+        for col in range(20):
+            ex.execute("i", f"Set({col}, f={col % 3})")
+        first = ex.execute("i", "TopN(f, n=3)")[0]
+        frag = h.index("i").field("f").view("standard").fragment(0)
+        assert frag.cached_row_counts(3) is not None
+        second = ex.execute("i", "TopN(f, n=3)")[0]
+        assert [(p.id, p.count) for p in first] == [(p.id, p.count) for p in second]
+        # a write invalidates; results stay correct
+        ex.execute("i", "Set(999, f=1)")
+        third = ex.execute("i", "TopN(f, n=1)")[0]
+        assert third[0].id == 1
+
+    def test_topn_cache_correct_counts(self, ex):
+        ex, h = ex
+        rng = np.random.default_rng(3)
+        truth: dict[int, set] = {}
+        for _ in range(300):
+            r, c = int(rng.integers(0, 5)), int(rng.integers(0, 2000))
+            truth.setdefault(r, set()).add(c)
+            ex.execute("i", f"Set({c}, f={r})")
+        pairs = ex.execute("i", "TopN(f)")[0]  # complete-cache path (n=0)
+        pairs2 = ex.execute("i", "TopN(f)")[0]
+        want = sorted(((len(v), r) for r, v in truth.items()), key=lambda t: (-t[0], t[1]))
+        for got in (pairs, pairs2):
+            assert [(p.count, p.id) for p in got] == want
+
+
+class TestCacheRegressions:
+    def test_stale_cache_file_removed_on_later_snapshot(self, tmp_path):
+        """A snapshot with an invalid in-memory cache must delete the old
+        .cache file, or a clean reopen adopts outdated counts."""
+        path = str(tmp_path / "f")
+        frag = Fragment(path, "i", "f", "standard", 0)
+        frag.set_bit(1, 5)
+        frag.cache_row_counts({1: 1})
+        frag.snapshot()  # persists cache at this gen
+        frag.set_bit(1, 6)  # cache now stale
+        frag.snapshot()  # must remove the stale .cache file
+        frag.close()
+
+        frag2 = Fragment(path, "i", "f", "standard", 0)
+        assert frag2.cached_row_counts(0) is None
+        frag2.close()
+
+    def test_put_with_old_gen_never_hits(self, tmp_path):
+        frag = Fragment(str(tmp_path / "f"), "i", "f", "standard", 0)
+        frag.set_bit(1, 5)
+        gen, ids, _ = frag.device_matrix_with_gen()
+        frag.set_bit(1, 6)  # generation advances between read and put
+        frag.cache_row_counts({1: 1}, gen=gen)
+        assert frag.cached_row_counts(0) is None
+        frag.close()
+
+    def test_multi_shard_truncated_cache_not_used(self, tmp_path):
+        """Per-shard truncated top lists cannot be merged exactly: rows
+        ranking low in one shard but high globally would be lost."""
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        h = Holder(str(tmp_path / "h"))
+        idx = h.create_index("i")
+        idx.create_field("f", FieldOptions.set_field(cache_size=2))
+        ex = Executor(h)
+        # shard 0: A=10, B=9, C=8 ; shard 1: C=10, A=1, B=1
+        A, B, C = 1, 2, 3
+        for col in range(10):
+            ex.execute("i", f"Set({col}, f={A})")
+        for col in range(9):
+            ex.execute("i", f"Set({100 + col}, f={B})")
+        for col in range(8):
+            ex.execute("i", f"Set({200 + col}, f={C})")
+        base = SHARD_WIDTH
+        for col in range(10):
+            ex.execute("i", f"Set({base + col}, f={C})")
+        ex.execute("i", f"Set({base + 100}, f={A})")
+        ex.execute("i", f"Set({base + 101}, f={B})")
+        want = [(C, 18), (A, 11)]
+        for trial in range(2):  # second run must not use truncated caches
+            pairs = ex.execute("i", "TopN(f, n=2)")[0]
+            assert [(p.id, p.count) for p in pairs] == want, f"trial {trial}"

@@ -1,0 +1,194 @@
+"""Key translation: store semantics + executor integration.
+
+Reference behavior modeled: translate.go:35 (interface), translate.go:195
+(in-mem), boltdb/translate.go:48 (persistent, sequence alloc from 1),
+executor.go:2610/2781 (call/result translation)."""
+
+import pytest
+
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.models.index import IndexOptions
+from pilosa_tpu.parallel.executor import ExecutionError, Executor
+from pilosa_tpu.storage.translate import (
+    MemTranslateStore,
+    ReadOnlyError,
+    SQLiteTranslateStore,
+)
+
+
+@pytest.fixture(params=["mem", "sqlite"])
+def store(request, tmp_path):
+    if request.param == "mem":
+        s = MemTranslateStore()
+    else:
+        s = SQLiteTranslateStore(str(tmp_path / "keys.db"))
+    yield s
+    s.close()
+
+
+class TestStore:
+    def test_create_and_lookup(self, store):
+        assert store.translate_key("foo") is None
+        id1 = store.translate_key("foo", create=True)
+        assert id1 == 1  # ids allocate from 1 (boltdb/translate.go:140)
+        assert store.translate_key("bar", create=True) == 2
+        assert store.translate_key("foo", create=True) == id1
+        assert store.translate_key("foo") == id1
+        assert store.translate_id(id1) == "foo"
+        assert store.translate_id(999) is None
+
+    def test_batch(self, store):
+        ids = store.translate_keys(["a", "b", "a"], create=True)
+        assert ids == [1, 2, 1]
+        assert store.translate_ids(ids) == ["a", "b", "a"]
+
+    def test_entry_stream(self, store):
+        store.translate_key("x", create=True)
+        store.translate_key("y", create=True)
+        entries = store.entries(0)
+        assert [(e[1], e[2]) for e in entries] == [(1, "x"), (2, "y")]
+        assert store.entries(entries[-1][0]) == []
+        assert store.max_offset() == entries[-1][0]
+
+    def test_replica_apply(self, store):
+        store.translate_key("x", create=True)
+        replica = MemTranslateStore()
+        replica.set_read_only(True)
+        for off, id, key in store.entries(0):
+            replica.apply_entry(off, id, key)
+        assert replica.translate_key("x") == 1
+        with pytest.raises(ReadOnlyError):
+            replica.translate_key("new", create=True)
+
+    def test_read_only_blocks_create(self, store):
+        store.set_read_only(True)
+        with pytest.raises(ReadOnlyError):
+            store.translate_key("k", create=True)
+        assert store.translate_key("k") is None
+
+
+def test_sqlite_store_persists(tmp_path):
+    path = str(tmp_path / "keys.db")
+    s = SQLiteTranslateStore(path)
+    assert s.translate_key("alpha", create=True) == 1
+    s.close()
+    s2 = SQLiteTranslateStore(path)
+    assert s2.translate_key("alpha") == 1
+    assert s2.translate_key("beta", create=True) == 2
+    s2.close()
+
+
+@pytest.fixture
+def keyed(tmp_path):
+    h = Holder(str(tmp_path / "holder"))
+    idx = h.create_index("i", IndexOptions(keys=True))
+    idx.create_field("f", FieldOptions.set_field(keys=True))
+    return h, idx, Executor(h)
+
+
+class TestExecutorTranslation:
+    def test_set_row_with_keys(self, keyed):
+        h, idx, ex = keyed
+        assert ex.execute("i", 'Set("c1", f="r1")') == [True]
+        assert ex.execute("i", 'Set("c2", f="r1")') == [True]
+        assert ex.execute("i", 'Set("c1", f="r2")') == [True]
+        row = ex.execute("i", 'Row(f="r1")')[0]
+        assert sorted(row.keys) == ["c1", "c2"]
+        assert ex.execute("i", 'Count(Row(f="r1"))') == [2]
+
+    def test_missing_read_key_is_empty(self, keyed):
+        h, idx, ex = keyed
+        ex.execute("i", 'Set("c1", f="r1")')
+        row = ex.execute("i", 'Row(f="nope")')[0]
+        assert row.keys == [] and not row.any()
+        assert ex.execute("i", 'Count(Row(f="nope"))') == [0]
+        # union with a miss keeps the hit; intersect with a miss is empty
+        assert ex.execute("i", 'Count(Union(Row(f="r1"), Row(f="nope")))') == [1]
+        assert ex.execute("i", 'Count(Intersect(Row(f="r1"), Row(f="nope")))') == [0]
+
+    def test_clear_missing_key_is_noop(self, keyed):
+        h, idx, ex = keyed
+        ex.execute("i", 'Set("c1", f="r1")')
+        assert ex.execute("i", 'Clear("zzz", f="r1")') == [False]
+        assert ex.execute("i", 'Clear("c1", f="zzz")') == [False]
+        assert ex.execute("i", 'Clear("c1", f="r1")') == [True]
+
+    def test_topn_pairs_get_keys(self, keyed):
+        h, idx, ex = keyed
+        for c in ("a", "b", "c"):
+            ex.execute("i", f'Set("{c}", f="big")')
+        ex.execute("i", 'Set("a", f="small")')
+        pairs = ex.execute("i", "TopN(f, n=2)")[0]
+        assert [p.key for p in pairs] == ["big", "small"]
+        assert [p.count for p in pairs] == [3, 1]
+
+    def test_rows_returns_keys(self, keyed):
+        h, idx, ex = keyed
+        ex.execute("i", 'Set("c", f="r1")')
+        ex.execute("i", 'Set("c", f="r2")')
+        assert ex.execute("i", "Rows(f)") == [["r1", "r2"]]
+
+    def test_groupby_row_keys(self, keyed):
+        h, idx, ex = keyed
+        ex.execute("i", 'Set("c", f="x")')
+        groups = ex.execute("i", "GroupBy(Rows(f))")[0]
+        assert [fr.row_key for g in groups for fr in g.group] == ["x"]
+
+    def test_string_key_on_unkeyed_field_errors(self, tmp_path):
+        h = Holder(str(tmp_path / "h2"))
+        idx = h.create_index("i", IndexOptions(keys=True))
+        idx.create_field("f")  # no keys
+        ex = Executor(h)
+        with pytest.raises(ExecutionError):
+            ex.execute("i", 'Set("c", f="row")')
+
+    def test_string_col_on_unkeyed_index_errors(self, tmp_path):
+        h = Holder(str(tmp_path / "h3"))
+        idx = h.create_index("i")  # no keys
+        idx.create_field("f", FieldOptions.set_field(keys=True))
+        ex = Executor(h)
+        with pytest.raises(ExecutionError):
+            ex.execute("i", 'Set("c", f="row")')
+
+    def test_keys_persist_across_reopen(self, tmp_path):
+        path = str(tmp_path / "holder")
+        h = Holder(path)
+        idx = h.create_index("i", IndexOptions(keys=True))
+        idx.create_field("f", FieldOptions.set_field(keys=True))
+        ex = Executor(h)
+        ex.execute("i", 'Set("c1", f="r1")')
+        h.close()
+
+        h2 = Holder(path)
+        ex2 = Executor(h2)
+        row = ex2.execute("i", 'Row(f="r1")')[0]
+        assert row.keys == ["c1"]
+        # same ids, not re-allocated
+        assert ex2.execute("i", 'Set("c1", f="r1")') == [False]
+        h2.close()
+
+    def test_store_with_row_key(self, keyed):
+        h, idx, ex = keyed
+        ex.execute("i", 'Set("c1", f="src")')
+        assert ex.execute("i", 'Store(Row(f="src"), f="dst")') == [True]
+        assert ex.execute("i", 'Count(Row(f="dst"))') == [1]
+
+    def test_clear_row_missing_key_noop(self, keyed):
+        h, idx, ex = keyed
+        assert ex.execute("i", 'ClearRow(f="ghost")') == [False]
+
+
+def test_rows_unknown_column_key_empty(keyed):
+    h, idx, ex = keyed
+    ex.execute("i", 'Set("c", f="r1")')
+    assert ex.execute("i", 'Rows(f, column="missing")') == [[]]
+
+
+def test_batched_translate_ids(tmp_path):
+    s = SQLiteTranslateStore(str(tmp_path / "k.db"))
+    ids = [s.translate_key(f"k{i}", create=True) for i in range(1200)]
+    keys = s.translate_ids(ids + [99999])
+    assert keys[:3] == ["k0", "k1", "k2"]
+    assert keys[-1] is None
+    s.close()
