@@ -1,0 +1,373 @@
+"""Cluster layer: shard placement, membership state, write replication.
+
+Parity target: the reference's cluster (cluster.go).  The placement
+scheme is kept bit-compatible so operational expectations transfer
+(SURVEY.md §7 step 5):
+
+- ``partition(index, shard) = fnv64a(index || shard_le8) % partition_n``
+  (cluster.go:871, defaultPartitionN=256 cluster.go:44)
+- partition -> primary node via **jump consistent hash** over the sorted
+  node list (cluster.go:948-959)
+- replicas = the next ``replica_n - 1`` nodes on the sorted ring
+  (cluster.go:902-924)
+
+The communication fabric is pluggable (``Transport``): in-process for
+tests (the reference's DisableCluster/static mode, cluster.go:2037), HTTP
+for real deployments, with the mesh/ICI path fusing whole local shard
+batches on device (pilosa_tpu.parallel.mesh).  State machine and node
+states mirror cluster.go:46-58.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+
+# Cluster states (cluster.go:46-50)
+STATE_STARTING = "STARTING"
+STATE_NORMAL = "NORMAL"
+STATE_DEGRADED = "DEGRADED"
+STATE_RESIZING = "RESIZING"
+
+# Node states (cluster.go:52-58)
+NODE_READY = "READY"
+NODE_DOWN = "DOWN"
+
+DEFAULT_PARTITION_N = 256
+
+
+def fnv64a(data: bytes) -> int:
+    """FNV-1a 64-bit (hash/fnv used at cluster.go:873)."""
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def partition(index: str, shard: int, partition_n: int = DEFAULT_PARTITION_N) -> int:
+    """Shard -> partition (cluster.go:871): hash of index name and the
+    shard id's little-endian 8 bytes."""
+    return fnv64a(index.encode() + shard.to_bytes(8, "little")) % partition_n
+
+
+def jump_hash(key: int, n_buckets: int) -> int:
+    """Jump consistent hash (Lamping & Veach; cluster.go:948 jmphasher).
+    Maps key uniformly onto [0, n_buckets) with minimal movement as
+    buckets are added/removed."""
+    b, j = -1, 0
+    key &= 0xFFFFFFFFFFFFFFFF
+    while j < n_buckets:
+        b = j
+        key = (key * 2862933555777941757 + 1) & 0xFFFFFFFFFFFFFFFF
+        j = int((b + 1) * (float(1 << 31) / float((key >> 33) + 1)))
+    return b
+
+
+class ModHasher:
+    """Deterministic partition->node hasher for tests (test/cluster.go:18)."""
+
+    @staticmethod
+    def hash(key: int, n: int) -> int:
+        return key % n
+
+
+class JmpHasher:
+    @staticmethod
+    def hash(key: int, n: int) -> int:
+        return jump_hash(key, n)
+
+
+@dataclass
+class Node:
+    """One cluster member (pilosa.Node)."""
+
+    id: str
+    uri: str = ""
+    is_coordinator: bool = False
+    state: str = NODE_READY
+
+    def to_dict(self) -> dict:
+        return {
+            "id": self.id,
+            "uri": self.uri,
+            "isCoordinator": self.is_coordinator,
+            "state": self.state,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Node":
+        return cls(
+            id=d["id"],
+            uri=d.get("uri", ""),
+            is_coordinator=d.get("isCoordinator", False),
+            state=d.get("state", NODE_READY),
+        )
+
+
+class TransportError(RuntimeError):
+    """A node could not be reached or failed mid-request; triggers
+    replica failover in the executor (executor.go:2492)."""
+
+
+class Transport:
+    """Node-to-node fabric (the reference's InternalClient role,
+    http/client.go:37)."""
+
+    def query_node(self, node: Node, index: str, pql: str, shards: list[int]):
+        """Execute pql on the remote node restricted to `shards` with
+        remote semantics (no re-translation).  Returns the result list.
+        Raises TransportError if the node is unreachable."""
+        raise NotImplementedError
+
+    def send_message(self, node: Node, message: dict) -> dict:
+        """Control-plane RPC (schema DDL, cluster status, resize...)."""
+        raise NotImplementedError
+
+
+class LocalTransport(Transport):
+    """In-process fabric for multi-node tests: the registry maps node id
+    -> handle with .executor/.holder/.receive_message (the reference's
+    in-process test cluster, test/pilosa.go:390)."""
+
+    def __init__(self):
+        self.handles: dict[str, object] = {}
+        self.down: set[str] = set()
+
+    def register(self, node_id: str, handle) -> None:
+        self.handles[node_id] = handle
+
+    def set_down(self, node_id: str, down: bool = True) -> None:
+        (self.down.add if down else self.down.discard)(node_id)
+
+    def query_node(self, node: Node, index: str, pql: str, shards: list[int]):
+        from pilosa_tpu.parallel.executor import ExecOptions
+
+        if node.id in self.down or node.id not in self.handles:
+            raise TransportError(f"node unreachable: {node.id}")
+        h = self.handles[node.id]
+        return h.executor.execute(
+            index, pql,
+            opt=ExecOptions(
+                remote=True, shards=None if shards is None else list(shards)
+            ),
+        )
+
+    def send_message(self, node: Node, message: dict) -> dict:
+        if node.id in self.down or node.id not in self.handles:
+            raise TransportError(f"node unreachable: {node.id}")
+        return self.handles[node.id].receive_message(message)
+
+
+class Cluster:
+    """Membership + placement + replication routing for one node's view
+    of the cluster (cluster.go:186)."""
+
+    def __init__(
+        self,
+        local_id: str,
+        nodes: list[Node] | None = None,
+        replica_n: int = 1,
+        partition_n: int = DEFAULT_PARTITION_N,
+        hasher=None,
+        transport: Transport | None = None,
+        topology_path: str | None = None,
+        coordinator_id: str | None = None,
+    ):
+        self.local_id = local_id
+        self.replica_n = max(1, replica_n)
+        self.partition_n = partition_n
+        self.hasher = hasher or JmpHasher()
+        self.transport = transport
+        self.topology_path = topology_path
+        self.state = STATE_STARTING
+        self._lock = threading.RLock()
+        self._nodes: dict[str, Node] = {}
+        for n in nodes or []:
+            self._nodes[n.id] = n
+        if local_id not in self._nodes:
+            self._nodes[local_id] = Node(id=local_id)
+        self.coordinator_id = coordinator_id or sorted(self._nodes)[0]
+        self._listeners: list = []
+        if topology_path and os.path.exists(topology_path):
+            self._load_topology()
+        self.save_topology()
+
+    # ------------------------------------------------------------ topology
+
+    def _load_topology(self) -> None:
+        with open(self.topology_path) as f:
+            d = json.load(f)
+        for nd in d.get("nodes", []):
+            n = Node.from_dict(nd)
+            self._nodes.setdefault(n.id, n)
+        self.coordinator_id = d.get("coordinator", self.coordinator_id)
+
+    def save_topology(self) -> None:
+        """Persist member ids (the reference's .topology file,
+        cluster.go:1580)."""
+        if not self.topology_path:
+            return
+        tmp = self.topology_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "nodes": [n.to_dict() for n in self.sorted_nodes()],
+                    "coordinator": self.coordinator_id,
+                },
+                f,
+            )
+        os.replace(tmp, self.topology_path)
+
+    # ---------------------------------------------------------- membership
+
+    def sorted_nodes(self) -> list[Node]:
+        """Nodes sorted by id — the hash ring order (cluster.go:1017
+        Nodes are always kept sorted)."""
+        with self._lock:
+            return [self._nodes[k] for k in sorted(self._nodes)]
+
+    @property
+    def local_node(self) -> Node:
+        return self._nodes[self.local_id]
+
+    def node(self, node_id: str) -> Node | None:
+        return self._nodes.get(node_id)
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.local_id == self.coordinator_id
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            self._nodes[node.id] = node
+            self.save_topology()
+
+    def remove_node(self, node_id: str) -> None:
+        with self._lock:
+            self._nodes.pop(node_id, None)
+            self.save_topology()
+
+    def set_node_state(self, node_id: str, state: str) -> None:
+        with self._lock:
+            n = self._nodes.get(node_id)
+            if n is not None:
+                n.state = state
+            self._update_cluster_state()
+
+    def set_state(self, state: str) -> None:
+        with self._lock:
+            self.state = state
+
+    def _update_cluster_state(self) -> None:
+        """NORMAL / DEGRADED from node healths (cluster.go:571-583):
+        DEGRADED while <= replica_n - 1 nodes are down (reads can still
+        be served from replicas), unavailable semantics beyond that are
+        surfaced per-query by exhausted-failover errors."""
+        if self.state == STATE_RESIZING:
+            return
+        down = sum(1 for n in self._nodes.values() if n.state == NODE_DOWN)
+        if down == 0:
+            self.state = STATE_NORMAL
+        elif down < self.replica_n:
+            self.state = STATE_DEGRADED
+        else:
+            self.state = STATE_DEGRADED  # still degraded; queries hitting
+            # lost shards fail with exhausted-replica errors
+
+    # ----------------------------------------------------------- placement
+
+    def partition_nodes(self, p: int) -> list[Node]:
+        """Owner nodes of a partition: primary by jump hash over the
+        sorted ring, then the next replica_n-1 ring neighbors
+        (cluster.go:902-924)."""
+        nodes = self.sorted_nodes()
+        if not nodes:
+            return []
+        start = self.hasher.hash(p, len(nodes))
+        k = min(self.replica_n, len(nodes))
+        return [nodes[(start + i) % len(nodes)] for i in range(k)]
+
+    def shard_nodes(self, index: str, shard: int) -> list[Node]:
+        """All owner replicas of a shard (cluster.go:883 shardNodes)."""
+        return self.partition_nodes(partition(index, shard, self.partition_n))
+
+    def primary_shard_node(self, index: str, shard: int) -> Node:
+        return self.shard_nodes(index, shard)[0]
+
+    def owns_shard(self, node_id: str, index: str, shard: int) -> bool:
+        return any(n.id == node_id for n in self.shard_nodes(index, shard))
+
+    def local_shards(self, index: str, shards) -> set[int]:
+        """Subset of `shards` owned by this node (any replica slot)."""
+        return {s for s in shards if self.owns_shard(self.local_id, index, s)}
+
+    def shards_by_node(self, index: str, shards) -> dict[str, list[int]]:
+        """Group shards by their primary owner, preferring the local node
+        when it is any replica (the reference sends each shard to one
+        owner, preferring itself; executor.go:2435 shardsByNode)."""
+        out: dict[str, list[int]] = {}
+        for s in sorted(shards):
+            owners = self.shard_nodes(index, s)
+            ids = [n.id for n in owners]
+            target = self.local_id if self.local_id in ids else ids[0]
+            # skip DOWN primaries up front; failover handles mid-query loss
+            if target != self.local_id:
+                for nid in ids:
+                    if self._nodes[nid].state != NODE_DOWN:
+                        target = nid
+                        break
+            out.setdefault(target, []).append(s)
+        return out
+
+    def next_replica(self, index: str, shard: int, tried: set[str]) -> Node | None:
+        """First owner of `shard` not yet tried and not DOWN — query-time
+        failover target (executor.go:2492-2503)."""
+        for n in self.shard_nodes(index, shard):
+            if n.id not in tried and n.state != NODE_DOWN:
+                return n
+        return None
+
+    # ------------------------------------------------------- key ownership
+
+    def primary_for_translation(self) -> Node:
+        """Key translation is single-writer: the coordinator holds every
+        primary translate store (reference: non-primaries tail the
+        primary over HTTP, holder.go:690)."""
+        return self._nodes[self.coordinator_id]
+
+    def to_status(self) -> dict:
+        """ClusterStatus wire form (internal/private.proto ClusterStatus)."""
+        return {
+            "state": self.state,
+            "coordinator": self.coordinator_id,
+            "nodes": [n.to_dict() for n in self.sorted_nodes()],
+        }
+
+    def apply_status(self, status: dict) -> None:
+        """Adopt a coordinator-broadcast ClusterStatus (server.go:569
+        receiveMessage ClusterStatus handling)."""
+        with self._lock:
+            self.state = status.get("state", self.state)
+            self.coordinator_id = status.get("coordinator", self.coordinator_id)
+            for nd in status.get("nodes", []):
+                n = Node.from_dict(nd)
+                existing = self._nodes.get(n.id)
+                if existing is None:
+                    self._nodes[n.id] = n
+                else:
+                    existing.state = n.state
+                    existing.uri = n.uri or existing.uri
+                    existing.is_coordinator = n.is_coordinator
+            ids = {nd["id"] for nd in status.get("nodes", [])}
+            if ids:
+                for nid in list(self._nodes):
+                    # never prune ourselves on a stale status that predates
+                    # our join — the local node is always a member
+                    if nid not in ids and nid != self.local_id:
+                        del self._nodes[nid]
+            for n in self._nodes.values():
+                n.is_coordinator = n.id == self.coordinator_id
+            self.save_topology()

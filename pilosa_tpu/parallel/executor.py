@@ -18,13 +18,18 @@ XLA programs.
 from __future__ import annotations
 
 import datetime as _dt
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import (
+    FIRST_COMPLETED,
+    ThreadPoolExecutor,
+    wait as futures_wait,
+)
 from dataclasses import dataclass, replace
 
 import numpy as np
 
 from pilosa_tpu.models.field import FieldType
 from pilosa_tpu.models.row import Row
+from pilosa_tpu.parallel.cluster import TransportError
 from pilosa_tpu.models.timequantum import parse_time
 from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.ops import bitmap as bm
@@ -67,7 +72,8 @@ _EMPTY_ROWS_CALL = "_EmptyRows"
 class Executor:
     def __init__(self, holder, worker_pool_size: int | None = None, cluster=None):
         self.holder = holder
-        self.cluster = cluster  # optional cluster layer (round 1: None)
+        self.cluster = cluster  # optional cluster layer
+        self.node = None  # back-ref set by ClusterNode (shard broadcasts)
         self.pool = ThreadPoolExecutor(max_workers=worker_pool_size or 8)
 
     # ------------------------------------------------------------- public
@@ -109,17 +115,17 @@ class Executor:
         if name == _EMPTY_ROWS_CALL:
             return []
         if name == "Set":
-            return self._execute_set(idx, call)
+            return self._execute_set(idx, call, opt)
         if name == "Clear":
-            return self._execute_clear(idx, call)
+            return self._execute_clear(idx, call, opt)
         if name == "ClearRow":
-            return self._execute_clear_row(idx, call, shards)
+            return self._execute_clear_row(idx, call, shards, opt)
         if name == "Store":
             return self._execute_store(idx, call, shards, opt)
         if name == "SetRowAttrs":
-            return self._execute_set_row_attrs(idx, call)
+            return self._execute_set_row_attrs(idx, call, opt)
         if name == "SetColumnAttrs":
-            return self._execute_set_column_attrs(idx, call)
+            return self._execute_set_column_attrs(idx, call, opt)
         if name == "Count":
             return self._execute_count(idx, call, shards, opt)
         if name == "TopN":
@@ -144,17 +150,74 @@ class Executor:
             return sorted(opt.shards)
         if shards is not None:
             return sorted(shards)
-        avail = idx.available_shards()
-        if self.cluster is not None:
-            avail = self.cluster.local_shards(idx.name, avail)
-        return sorted(avail)
+        return sorted(idx.available_shards())
 
-    def _map_shards(self, fn, shards):
-        """Worker-pool map over shards (reference mapperLocal,
-        executor.go:2561)."""
+    def _cluster_active(self, opt: ExecOptions | None) -> bool:
+        return (
+            self.cluster is not None
+            and self.cluster.transport is not None
+            and (opt is None or not opt.remote)
+            and len(self.cluster.sorted_nodes()) > 1
+        )
+
+    def _local_map(self, fn, shards):
         if len(shards) <= 1:
             return [fn(s) for s in shards]
         return list(self.pool.map(fn, shards))
+
+    def _map_shards(self, fn, shards, idx=None, call=None, opt=None, adapt=None,
+                    remote_call=None):
+        """Map over shards and return the flat list of per-shard/per-node
+        partials.  Single-node: worker-pool map (reference mapperLocal,
+        executor.go:2561).  Clustered (and not already a remote
+        re-execution): group shards by owner node, run local shards on
+        the pool, forward each remote group as one PQL sub-query, and on
+        node failure re-map its shards onto replicas until owners are
+        exhausted (reference mapReduce, executor.go:2455-2514).  `adapt`
+        converts one remote result into a list of local-partial-shaped
+        values."""
+        if not (self._cluster_active(opt) and idx is not None and call is not None
+                and adapt is not None):
+            return self._local_map(fn, shards)
+        cluster = self.cluster
+        pql = str(call if remote_call is None else remote_call)
+        partials = []
+        tried: dict[int, set] = {s: set() for s in shards}
+        pending = cluster.shards_by_node(idx.name, shards)
+        inflight: dict = {}  # future -> (node_id, node_shards)
+        while pending or inflight:
+            # fan out every remote group concurrently, then run local
+            # shards inline while the remotes are in flight — distributed
+            # latency is max(per-node), not sum (executor.go:2517 mapper
+            # goroutines)
+            for node_id in [k for k in list(pending) if k != cluster.local_id]:
+                node_shards = pending.pop(node_id)
+                fut = self.pool.submit(
+                    cluster.transport.query_node,
+                    cluster.node(node_id), idx.name, pql, node_shards,
+                )
+                inflight[fut] = (node_id, node_shards)
+            if cluster.local_id in pending:
+                partials.extend(self._local_map(fn, pending.pop(cluster.local_id)))
+            if not inflight:
+                continue
+            done, _ = futures_wait(list(inflight), return_when=FIRST_COMPLETED)
+            for fut in done:
+                node_id, node_shards = inflight.pop(fut)
+                try:
+                    res = fut.result()
+                except TransportError:
+                    for s in node_shards:
+                        tried[s].add(node_id)
+                        nxt = cluster.next_replica(idx.name, s, tried[s])
+                        if nxt is None:
+                            raise ExecutionError(
+                                f"shard {s} unavailable: all replicas exhausted"
+                            )
+                        pending.setdefault(nxt.id, []).append(s)
+                    continue
+                partials.extend(adapt(res[0]))
+        return partials
 
     def _field(self, idx, name: str):
         f = idx.field(name)
@@ -189,7 +252,11 @@ class Executor:
         def map_fn(shard):
             return shard, self._bitmap_words_shard(idx, call, shard)
 
-        for shard, words in self._map_shards(map_fn, shards):
+        partials = self._map_shards(
+            map_fn, shards, idx=idx, call=call, opt=opt,
+            adapt=lambda r: list(r.segments.items()),
+        )
+        for shard, words in partials:
             w = self._np_words(words)
             if w is not None and w.any():
                 row.segments[shard] = w
@@ -362,7 +429,11 @@ class Executor:
                 return 0
             return int(bm.popcount(words))
 
-        return sum(self._map_shards(map_fn, shards))
+        return sum(
+            self._map_shards(
+                map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda v: [v]
+            )
+        )
 
     # --------------------------------------------------------------- TopN
 
@@ -416,8 +487,22 @@ class Executor:
                 frag.cache_row_counts(out, gen=gen)
             return out
 
+        # Remote sub-queries must return complete per-node counts: n and
+        # threshold truncate on *summed* counts, which only the
+        # originating reduce can compute (the reference's two-phase
+        # candidate protocol, executor.go:860-928, exists for the same
+        # reason).
+        remote_call = call.clone()
+        remote_call.args.pop("n", None)
+        remote_call.args.pop("threshold", None)
+
         totals: dict[int, int] = {}
-        for part in self._map_shards(map_fn, shards):
+        parts = self._map_shards(
+            map_fn, shards, idx=idx, call=call, opt=opt,
+            adapt=lambda pairs: [{p.id: p.count for p in pairs}],
+            remote_call=remote_call,
+        )
+        for part in parts:
             for r, c in part.items():
                 totals[r] = totals.get(r, 0) + c
 
@@ -466,7 +551,10 @@ class Executor:
             return ids
 
         merged: set[int] = set()
-        for part in self._map_shards(map_fn, shards):
+        parts = self._map_shards(
+            map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda ids: [ids]
+        )
+        for part in parts:
             merged.update(part)
         out = sorted(merged)
         if previous is not None:
@@ -539,8 +627,19 @@ class Executor:
                 groups = new_groups
             return dict(groups) if groups and isinstance(groups[0][1], int) else {}
 
+        def gc_adapt(gcs):
+            return [
+                {
+                    tuple((fr.field, fr.row_id) for fr in gc.group): gc.count
+                    for gc in gcs
+                }
+            ]
+
         totals: dict[tuple, int] = {}
-        for part in self._map_shards(map_fn, shards):
+        parts = self._map_shards(
+            map_fn, shards, idx=idx, call=call, opt=opt, adapt=gc_adapt
+        )
+        for part in parts:
             for key, c in part.items():
                 totals[key] = totals.get(key, 0) + c
 
@@ -554,15 +653,28 @@ class Executor:
 
     # --------------------------------------------------- BSI aggregates
 
+    def _local_filter_row(self, idx, call: Call, shards, opt: ExecOptions):
+        """Evaluate an aggregate's filter child for the shards this node
+        will scan itself.  In a cluster the remote nodes re-evaluate the
+        filter for their own shards when the forwarded aggregate arrives,
+        so computing it cluster-wide at the origin would be wasted work
+        (and a redundant distributed round-trip)."""
+        if not call.children:
+            return None
+        if self._cluster_active(opt):
+            local = sorted(self.cluster.local_shards(idx.name, shards))
+            return self._execute_bitmap_call(
+                idx, call.children[0], local, replace(opt, remote=True, shards=local)
+            )
+        return self._execute_bitmap_call(idx, call.children[0], shards, opt)
+
     def _execute_aggregate(self, idx, call: Call, shards, opt: ExecOptions) -> ValCount:
         fname = call.string_arg("field") or call.args.get("field")
         if not fname:
             raise ExecutionError(f"{call.name}() requires a field argument")
         f = self._field(idx, fname)
-        filter_row = None
-        if call.children:
-            filter_row = self._execute_bitmap_call(idx, call.children[0], shards, opt)
         shards = self._target_shards(idx, shards, opt)
+        filter_row = self._local_filter_row(idx, call, shards, opt)
 
         if call.name == "Sum":
             def map_fn(shard):
@@ -570,7 +682,9 @@ class Executor:
                 return ValCount(s, c)
 
             out = ValCount()
-            for vc in self._map_shards(map_fn, shards):
+            for vc in self._map_shards(
+                map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda v: [v]
+            ):
                 out = out.add(vc)
             return out
 
@@ -585,7 +699,9 @@ class Executor:
             return ValCount(r[0], r[1])
 
         out = ValCount()
-        for vc in self._map_shards(map_fn, shards):
+        for vc in self._map_shards(
+            map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda v: [v]
+        ):
             out = getattr(out, reducer)(vc)
         return out
 
@@ -596,10 +712,8 @@ class Executor:
         if not fname:
             raise ExecutionError(f"{call.name}() requires a field argument")
         f = self._field(idx, fname)
-        filter_row = None
-        if call.children:
-            filter_row = self._execute_bitmap_call(idx, call.children[0], shards, opt)
         shards = self._target_shards(idx, shards, opt)
+        filter_row = self._local_filter_row(idx, call, shards, opt)
         is_min = call.name == "MinRow"
 
         def map_fn(shard):
@@ -627,7 +741,9 @@ class Executor:
         # arbitrary shard's count on id ties, executor.go MinRow reduceFn —
         # summing is deterministic and reflects the whole row.)
         out = Pair()
-        for p in self._map_shards(map_fn, shards):
+        for p in self._map_shards(
+            map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda p: [p]
+        ):
             if p.count == 0:
                 continue
             if out.count == 0:
@@ -651,14 +767,45 @@ class Executor:
             return None
         return v
 
-    def _execute_set(self, idx, call: Call) -> bool:
+    def _replicate_to_shard_owners(self, idx, call: Call, shard: int, local_fn) -> bool:
+        """Run a single-shard write on every owner replica synchronously
+        (reference executeSetBitField, executor.go:2137-2168).  A replica
+        that cannot be reached fails the write — the reference offers the
+        same all-owners guarantee, with anti-entropy as the backstop."""
+        changed = False
+        for n in self.cluster.shard_nodes(idx.name, shard):
+            if n.id == self.cluster.local_id:
+                changed |= local_fn()
+            else:
+                try:
+                    res = self.cluster.transport.query_node(
+                        n, idx.name, str(call), [shard]
+                    )
+                except TransportError as e:
+                    raise ExecutionError(
+                        f"write replication to node {n.id} failed: {e}"
+                    )
+                changed |= bool(res[0])
+        return changed
+
+    def _note_new_shard(self, idx, f, shard: int) -> None:
+        """Record shard existence locally and broadcast it (reference
+        CreateShardMessage, view.go:263-305)."""
+        if shard in f.available_shards():
+            return
+        f._note_shard(shard)
+        if self.node is not None:
+            self.node.note_shard_created(idx.name, f.name, shard)
+
+    def _parse_set(self, idx, call: Call):
+        """Fully validate a Set before any state is touched, so a
+        rejected Set leaves no phantom column or shard behind — locally
+        or broadcast."""
         col = call.uint_arg("_col")
         if col is None:
             raise ExecutionError("Set() column argument required")
         fname = call.field_arg()
         f = self._field(idx, fname)
-        # Validate the write fully before touching the existence field so a
-        # rejected Set leaves no phantom column behind.
         if f.options.type == FieldType.INT:
             value = call.int_arg(fname)
             if value is None:
@@ -672,6 +819,9 @@ class Executor:
             timestamp = parse_time(ts) if ts is not None else None
             if timestamp is not None and f.options.type != FieldType.TIME:
                 raise ExecutionError(f"field {fname!r} does not accept timestamps")
+        return f, col, value, timestamp
+
+    def _apply_set(self, idx, f, col: int, value, timestamp) -> bool:
         ef = idx.existence_field()
         if ef is not None:
             ef.set_bit(0, col)
@@ -679,10 +829,37 @@ class Executor:
             return f.set_value(col, value)
         return f.set_bit(value, col, timestamp=timestamp)
 
-    def _execute_clear(self, idx, call: Call) -> bool:
+    def _execute_set(self, idx, call: Call, opt: ExecOptions) -> bool:
+        f, col, value, timestamp = self._parse_set(idx, call)
+        if self._cluster_active(opt):
+            shard = col // SHARD_WIDTH
+            self._note_new_shard(idx, f, shard)
+            ef = idx.existence_field()
+            if ef is not None:
+                self._note_new_shard(idx, ef, shard)
+            return self._replicate_to_shard_owners(
+                idx, call, shard,
+                lambda: self._apply_set(idx, f, col, value, timestamp),
+            )
+        return self._apply_set(idx, f, col, value, timestamp)
+
+    def _execute_set_local(self, idx, call: Call) -> bool:
+        f, col, value, timestamp = self._parse_set(idx, call)
+        return self._apply_set(idx, f, col, value, timestamp)
+
+    def _execute_clear(self, idx, call: Call, opt: ExecOptions) -> bool:
         col = call.uint_arg("_col")
         if col is None:
             raise ExecutionError("Clear() column argument required")
+        if self._cluster_active(opt):
+            return self._replicate_to_shard_owners(
+                idx, call, col // SHARD_WIDTH,
+                lambda: self._execute_clear_local(idx, call),
+            )
+        return self._execute_clear_local(idx, call)
+
+    def _execute_clear_local(self, idx, call: Call) -> bool:
+        col = call.uint_arg("_col")
         fname = call.field_arg()
         f = self._field(idx, fname)
         if f.options.type == FieldType.INT:
@@ -692,7 +869,22 @@ class Executor:
             raise ExecutionError("Clear() row argument required")
         return f.clear_bit(row_id, col)
 
-    def _execute_clear_row(self, idx, call: Call, shards) -> bool:
+    def _forward_to_all_nodes(self, idx, call: Call, changed: bool, shards=None) -> bool:
+        """Forward a whole-index write to every other node (each applies
+        it to its local fragments/stores); used by ClearRow/Store/attrs.
+        `shards` carries the caller's shard restriction (None = all)."""
+        for n in self.cluster.sorted_nodes():
+            if n.id == self.cluster.local_id:
+                continue
+            try:
+                res = self.cluster.transport.query_node(n, idx.name, str(call), shards)
+            except TransportError as e:
+                raise ExecutionError(f"write forwarding to node {n.id} failed: {e}")
+            r = res[0]
+            changed |= bool(r) if isinstance(r, bool) else False
+        return changed
+
+    def _execute_clear_row(self, idx, call: Call, shards, opt: ExecOptions) -> bool:
         fname = call.field_arg()
         f = self._field(idx, fname)
         if f.options.type not in (FieldType.SET, FieldType.TIME, FieldType.MUTEX, FieldType.BOOL):
@@ -704,6 +896,9 @@ class Executor:
         for view in list(f.views.values()):
             for frag in list(view.fragments.values()):
                 changed |= frag.clear_row(row_id)
+        # every node clears its own fragments (replicas included)
+        if self._cluster_active(opt):
+            changed = self._forward_to_all_nodes(idx, call, changed)
         return changed
 
     def _execute_store(self, idx, call: Call, shards, opt: ExecOptions) -> bool:
@@ -714,7 +909,25 @@ class Executor:
         row_id = call.uint_arg(fname)
         if row_id is None:
             raise ExecutionError("Store() row argument required")
-        src = self._execute_bitmap_call(idx, call.children[0], shards, opt)
+        if self._cluster_active(opt):
+            # each node stores the row segments for the shards it owns;
+            # the child re-evaluates per node restricted to those shards.
+            # The caller's shard restriction travels with the forward.
+            target = self._target_shards(idx, shards, opt)
+            changed = self._store_local(idx, call, f, row_id, target, opt)
+            return self._forward_to_all_nodes(idx, call, changed, shards=target)
+        return self._store_local(idx, call, f, row_id, shards, opt)
+
+    def _store_local(self, idx, call: Call, f, row_id: int, shards, opt: ExecOptions) -> bool:
+        target = self._target_shards(idx, shards, opt)
+        if self.cluster is not None and self.cluster.transport is not None:
+            # restrict to locally-owned shards; peers handle their own
+            local = sorted(self.cluster.local_shards(idx.name, target))
+            src = self._execute_bitmap_call(
+                idx, call.children[0], local, replace(opt, remote=True, shards=local)
+            )
+        else:
+            src = self._execute_bitmap_call(idx, call.children[0], target, opt)
         changed = False
         view = f.create_view_if_not_exists(VIEW_STANDARD)
         # Shards to touch: those with source bits, plus those where the
@@ -735,7 +948,7 @@ class Executor:
                     f._note_shard(shard)
         return changed
 
-    def _execute_set_row_attrs(self, idx, call: Call):
+    def _execute_set_row_attrs(self, idx, call: Call, opt: ExecOptions):
         fname = call.args.get("_field")
         if not fname:
             raise ExecutionError("SetRowAttrs() requires a field argument")
@@ -745,14 +958,20 @@ class Executor:
             raise ExecutionError("SetRowAttrs() row argument required")
         attrs = {k: v for k, v in call.args.items() if not k.startswith("_")}
         f.row_attrs.set_attrs(row_id, attrs)
+        # attrs replicate to every node (reference stores them on all
+        # nodes and reconciles with anti-entropy block diffs, attr.go:90)
+        if self._cluster_active(opt):
+            self._forward_to_all_nodes(idx, call, False)
         return None
 
-    def _execute_set_column_attrs(self, idx, call: Call):
+    def _execute_set_column_attrs(self, idx, call: Call, opt: ExecOptions):
         col = call.uint_arg("_col")
         if col is None:
             raise ExecutionError("SetColumnAttrs() column argument required")
         attrs = {k: v for k, v in call.args.items() if not k.startswith("_")}
         idx.column_attrs.set_attrs(col, attrs)
+        if self._cluster_active(opt):
+            self._forward_to_all_nodes(idx, call, False)
         return None
 
     # ------------------------------------------------------------ options

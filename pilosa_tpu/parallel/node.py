@@ -1,0 +1,136 @@
+"""ClusterNode: one node's wiring of holder + cluster + executor, with
+the control-plane message dispatch.
+
+Parity target: the broadcast bus and message dispatch of the reference
+(broadcast.go:30 broadcaster, server.go:569-704 receiveMessage /
+SendSync): schema DDL, shard creation, and cluster status propagate to
+every node; the HTTP server layer later wraps this object and exposes
+the same surface over the wire.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.index import IndexOptions
+from pilosa_tpu.parallel.cluster import Cluster, Transport, TransportError
+
+
+class ClusterNode:
+    """A holder + executor bound to a cluster and its transport."""
+
+    def __init__(self, holder, cluster: Cluster, worker_pool_size: int | None = None):
+        from pilosa_tpu.parallel.executor import Executor
+
+        self.holder = holder
+        self.cluster = cluster
+        self.executor = Executor(holder, worker_pool_size, cluster=cluster)
+        self.executor.node = self
+        if cluster.transport is not None and hasattr(cluster.transport, "register"):
+            cluster.transport.register(cluster.local_id, self)
+
+    # ------------------------------------------------------------ broadcast
+
+    def broadcast(self, message: dict) -> None:
+        """Synchronous send to every other node (reference SendSync,
+        server.go:666-704).  Unreachable nodes are skipped — anti-entropy
+        reconciles them later (the reference returns an error but has no
+        rollback either)."""
+        t = self.cluster.transport
+        if t is None:
+            return
+        for n in self.cluster.sorted_nodes():
+            if n.id == self.cluster.local_id:
+                continue
+            try:
+                t.send_message(n, message)
+            except TransportError:
+                pass
+
+    # ----------------------------------------------------- schema helpers
+
+    def create_index(self, name: str, options: IndexOptions | None = None):
+        idx = self.holder.create_index_if_not_exists(name, options)
+        self.broadcast(
+            {
+                "type": "create-index",
+                "index": name,
+                "options": (options or IndexOptions()).to_dict(),
+            }
+        )
+        return idx
+
+    def create_field(self, index: str, name: str, options: FieldOptions | None = None):
+        idx = self.holder.index(index)
+        if idx is None:
+            raise ValueError(f"index not found: {index}")
+        f = idx.create_field_if_not_exists(name, options)
+        self.broadcast(
+            {
+                "type": "create-field",
+                "index": index,
+                "field": name,
+                "options": (options or FieldOptions()).to_dict(),
+            }
+        )
+        return f
+
+    def delete_index(self, name: str) -> None:
+        self.holder.delete_index(name)
+        self.broadcast({"type": "delete-index", "index": name})
+
+    def delete_field(self, index: str, name: str) -> None:
+        idx = self.holder.index(index)
+        if idx is not None:
+            idx.delete_field(name)
+        self.broadcast({"type": "delete-field", "index": index, "field": name})
+
+    # ------------------------------------------------------------ dispatch
+
+    def receive_message(self, msg: dict) -> dict:
+        """Apply a control-plane message from a peer (reference
+        Server.receiveMessage, server.go:569-664)."""
+        t = msg.get("type")
+        if t == "create-index":
+            self.holder.create_index_if_not_exists(
+                msg["index"], IndexOptions.from_dict(msg.get("options", {}))
+            )
+        elif t == "create-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                idx.create_field_if_not_exists(
+                    msg["field"], FieldOptions.from_dict(msg.get("options", {}))
+                )
+        elif t == "delete-index":
+            try:
+                self.holder.delete_index(msg["index"])
+            except KeyError:
+                pass
+        elif t == "delete-field":
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                try:
+                    idx.delete_field(msg["field"])
+                except KeyError:
+                    pass
+        elif t == "create-shard":
+            # reference CreateShardMessage (view.go:263-305): keep every
+            # node's available-shard bitmaps global so query fan-out sees
+            # remote shards.
+            idx = self.holder.index(msg["index"])
+            if idx is not None:
+                f = idx.field(msg["field"])
+                if f is not None:
+                    f._note_shard(int(msg["shard"]))
+        elif t == "cluster-status":
+            self.cluster.apply_status(msg["status"])
+        elif t == "node-state":
+            self.cluster.set_node_state(msg["node"], msg["state"])
+        else:
+            return {"ok": False, "error": f"unknown message type: {t}"}
+        return {"ok": True}
+
+    def note_shard_created(self, index: str, field: str, shard: int) -> None:
+        """Broadcast new-shard existence after a local write created it."""
+        self.broadcast(
+            {"type": "create-shard", "index": index, "field": field, "shard": shard}
+        )

