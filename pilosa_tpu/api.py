@@ -1,0 +1,417 @@
+"""API façade: every externally triggerable action, validated against the
+cluster state machine.
+
+Parity target: the reference's ``*pilosa.API`` (api.go:42).  Each public
+method checks the cluster state against a per-method validation table
+(api.go:119 ``validate`` / api.go:1343 ``methodsNormal`` etc.) before
+touching the holder/executor, so callers — the HTTP handler, the CLI,
+tests — share one enforcement point.
+"""
+
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+
+def _ts_iso(ts):
+    return None if ts is None else ts.isoformat()
+
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.index import IndexOptions
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.version import VERSION
+
+
+class ApiError(Exception):
+    """Base API error; http layer maps subclasses to status codes."""
+
+
+class NotFoundError(ApiError):
+    pass
+
+
+class ConflictError(ApiError):
+    pass
+
+
+class ApiMethodNotAllowedError(ApiError):
+    """Method not valid for the current cluster state (api.go:114)."""
+
+
+# Per-method allowed cluster states (reference api.go:1343-end).  Methods
+# absent from this table are allowed in any state.
+_NORMAL = frozenset({"NORMAL"})
+_QUERY = frozenset({"NORMAL", "DEGRADED"})
+_RESIZE_OK = frozenset({"NORMAL", "STARTING", "RESIZING", "DEGRADED"})
+
+_METHOD_STATES = {
+    "query": _QUERY,
+    "create_index": _NORMAL,
+    "delete_index": _NORMAL,
+    "create_field": _NORMAL,
+    "delete_field": _NORMAL,
+    "delete_view": _NORMAL,
+    "import_bits": _NORMAL,
+    "import_values": _NORMAL,
+    "import_roaring": _NORMAL,
+    "export_csv": _NORMAL,
+    "apply_schema": _NORMAL,
+    "set_coordinator": _RESIZE_OK,
+    "remove_node": _NORMAL,
+    "resize_abort": frozenset({"RESIZING"}),
+}
+
+
+class API:
+    """Façade over one node's holder + cluster + executor (api.go:42)."""
+
+    def __init__(self, node):
+        """`node` is a pilosa_tpu.parallel.node.ClusterNode."""
+        self.node = node
+        self.holder = node.holder
+        self.cluster = node.cluster
+        self.executor = node.executor
+
+    # ----------------------------------------------------------- validate
+
+    def _validate(self, method: str) -> None:
+        allowed = _METHOD_STATES.get(method)
+        if allowed is None:
+            return
+        state = self.cluster.state
+        if state not in allowed:
+            raise ApiMethodNotAllowedError(
+                f"api method {method} not allowed in cluster state {state}"
+            )
+
+    # -------------------------------------------------------------- query
+
+    def query(self, index: str, pql, shards=None, remote: bool = False,
+              column_attrs: bool = False, exclude_row_attrs: bool = False,
+              exclude_columns: bool = False):
+        """Execute PQL -> list of results (api.go:135 API.Query)."""
+        from pilosa_tpu.parallel.executor import ExecOptions
+
+        self._validate("query")
+        opt = ExecOptions(
+            remote=remote,
+            column_attrs=column_attrs,
+            exclude_row_attrs=exclude_row_attrs,
+            exclude_columns=exclude_columns,
+            shards=None if shards is None else list(shards),
+        )
+        return self.executor.execute(index, pql, opt=opt)
+
+    # ------------------------------------------------------------- schema
+
+    def schema(self) -> list[dict]:
+        return self.holder.schema()
+
+    def apply_schema(self, schema: list[dict]) -> None:
+        """Idempotent schema merge (api.go ApplySchema)."""
+        self._validate("apply_schema")
+        self.holder.apply_schema(schema)
+
+    def index(self, name: str):
+        idx = self.holder.index(name)
+        if idx is None:
+            raise NotFoundError(f"index not found: {name}")
+        return idx
+
+    def create_index(self, name: str, options: IndexOptions | None = None):
+        self._validate("create_index")
+        if self.holder.index(name) is not None:
+            raise ConflictError(f"index already exists: {name}")
+        return self.node.create_index(name, options)
+
+    def delete_index(self, name: str) -> None:
+        self._validate("delete_index")
+        if self.holder.index(name) is None:
+            raise NotFoundError(f"index not found: {name}")
+        self.node.delete_index(name)
+
+    def field(self, index: str, name: str):
+        idx = self.index(index)
+        f = idx.field(name)
+        if f is None:
+            raise NotFoundError(f"field not found: {name}")
+        return f
+
+    def create_field(self, index: str, name: str,
+                     options: FieldOptions | None = None):
+        self._validate("create_field")
+        idx = self.index(index)
+        if idx.field(name) is not None:
+            raise ConflictError(f"field already exists: {name}")
+        return self.node.create_field(index, name, options)
+
+    def delete_field(self, index: str, name: str) -> None:
+        self._validate("delete_field")
+        self.field(index, name)
+        self.node.delete_field(index, name)
+
+    # ------------------------------------------------------------- import
+
+    def import_bits(self, index: str, field: str, rows, cols,
+                    timestamps=None, row_keys=None, col_keys=None,
+                    clear: bool = False, remote: bool = False) -> None:
+        """Bulk bit import: translate keys, group bits by shard, and
+        forward each group to every owner replica — local owners import
+        directly (api.go:920 API.Import; client-side shard routing
+        http/client.go:1164 GroupByShard + per-owner POST)."""
+        self._validate("import_bits")
+        idx = self.index(index)
+        f = self.field(index, field)
+        if col_keys:
+            cols = idx.translate_store.translate_keys(col_keys, create=True)
+        if row_keys:
+            cols_n = len(cols)
+            rows = f.translate_store.translate_keys(row_keys, create=True)
+            if len(rows) != cols_n:
+                raise ApiError("row keys and columns length mismatch")
+        rows, cols = list(rows), list(cols)
+        if remote or not self._clustered():
+            f.import_bits(rows, cols, timestamps, clear=clear)
+            return
+        known_shards = f.available_shards()
+        for shard, sel in self._group_by_shard(cols).items():
+            payload = {
+                "type": "import",
+                "index": index,
+                "field": field,
+                "rows": [rows[i] for i in sel],
+                "cols": [cols[i] for i in sel],
+                "timestamps": None if timestamps is None else
+                    [_ts_iso(timestamps[i]) for i in sel],
+                "clear": clear,
+            }
+            self._send_to_owners(
+                index, shard, payload,
+                local_fn=lambda sel=sel: f.import_bits(
+                    [rows[i] for i in sel], [cols[i] for i in sel],
+                    None if timestamps is None else [timestamps[i] for i in sel],
+                    clear=clear,
+                ),
+            )
+            self._note_shard_everywhere(f, index, field, shard,
+                                        known=shard in known_shards)
+
+    def import_values(self, index: str, field: str, cols, values,
+                      col_keys=None, remote: bool = False) -> None:
+        """Bulk BSI import with shard routing (api.go:1000
+        API.ImportValue)."""
+        self._validate("import_values")
+        idx = self.index(index)
+        f = self.field(index, field)
+        if col_keys:
+            cols = idx.translate_store.translate_keys(col_keys, create=True)
+        cols, values = list(cols), list(values)
+        if remote or not self._clustered():
+            f.import_values(cols, values)
+            return
+        known_shards = f.available_shards()
+        for shard, sel in self._group_by_shard(cols).items():
+            payload = {
+                "type": "import-value",
+                "index": index,
+                "field": field,
+                "cols": [cols[i] for i in sel],
+                "values": [values[i] for i in sel],
+            }
+            self._send_to_owners(
+                index, shard, payload,
+                local_fn=lambda sel=sel: f.import_values(
+                    [cols[i] for i in sel], [values[i] for i in sel]),
+            )
+            self._note_shard_everywhere(f, index, field, shard,
+                                        known=shard in known_shards)
+
+    def _clustered(self) -> bool:
+        return (self.cluster.transport is not None
+                and len(self.cluster.sorted_nodes()) > 1)
+
+    @staticmethod
+    def _group_by_shard(cols) -> dict[int, list[int]]:
+        by_shard: dict[int, list[int]] = {}
+        for i, c in enumerate(cols):
+            by_shard.setdefault(c // SHARD_WIDTH, []).append(i)
+        return by_shard
+
+    def _note_shard_everywhere(self, f, index: str, field: str,
+                               shard: int, known: bool) -> None:
+        """Record shard existence locally and broadcast it so every
+        node's available-shard bitmap includes it (reference
+        CreateShardMessage, view.go:263-305)."""
+        f._note_shard(shard)
+        if not known:
+            self.node.note_shard_created(index, field, shard)
+
+    def _send_to_owners(self, index: str, shard: int, payload: dict,
+                        local_fn) -> None:
+        """Deliver one shard's import to all owner replicas; unreachable
+        peers are skipped (anti-entropy reconciles, like the reference's
+        best-effort replication)."""
+        from pilosa_tpu.parallel.cluster import TransportError
+
+        for n in self.cluster.shard_nodes(index, shard):
+            if n.id == self.cluster.local_id:
+                local_fn()
+                continue
+            try:
+                self.cluster.transport.send_message(n, payload)
+            except TransportError:
+                pass
+
+    def import_roaring(self, index: str, field: str, shard: int,
+                       views: dict[str, bytes], clear: bool = False) -> None:
+        """Merge serialized roaring bitmaps per view into one shard's
+        fragments (api.go:368 API.ImportRoaring)."""
+        self._validate("import_roaring")
+        from pilosa_tpu.models.view import VIEW_STANDARD
+
+        f = self.field(index, field)
+        for vname, data in views.items():
+            if not vname:
+                vname = VIEW_STANDARD
+            view = f.create_view_if_not_exists(vname)
+            frag = view.create_fragment_if_not_exists(shard)
+            frag.import_roaring(data, clear=clear)
+            f._note_shard(shard)
+
+    def export_csv(self, index: str, field: str, shard: int, w: io.TextIOBase) -> None:
+        """Write `row,col` (or translated keys) CSV for one shard
+        (api.go:500 API.ExportCSV)."""
+        self._validate("export_csv")
+        from pilosa_tpu.models.view import VIEW_STANDARD
+
+        idx = self.index(index)
+        f = self.field(index, field)
+        view = f.view(VIEW_STANDARD)
+        if view is None:
+            return
+        frag = view.fragment(shard)
+        if frag is None:
+            return
+        base = shard * SHARD_WIDTH
+        for row_id in frag.row_ids():
+            words = frag.row(row_id)
+            offs = _word_bits(words)
+            row_label = row_id
+            if f.options.keys:
+                row_label = f.translate_store.translate_id(row_id) or row_id
+            for off in offs:
+                col = base + int(off)
+                col_label = col
+                if idx.options.keys:
+                    col_label = idx.translate_store.translate_id(col) or col
+                w.write(f"{row_label},{col_label}\n")
+
+    # ------------------------------------------------------------ cluster
+
+    def hosts(self) -> list[dict]:
+        return [n.to_dict() for n in self.cluster.sorted_nodes()]
+
+    def node_info(self) -> dict:
+        return self.cluster.local_node.to_dict()
+
+    def state(self) -> str:
+        return self.cluster.state
+
+    def info(self) -> dict:
+        return {
+            "shardWidth": SHARD_WIDTH,
+            "memory": None,
+            "cpuType": "tpu+host",
+            "cpuPhysicalCores": None,
+            "cpuLogicalCores": None,
+        }
+
+    def version(self) -> str:
+        return VERSION
+
+    def shards_max(self) -> dict[str, int]:
+        """index -> max shard (handler /internal/shards/max)."""
+        out = {}
+        for d in self.holder.schema():
+            idx = self.holder.index(d["name"])
+            shards = idx.available_shards()
+            if shards:
+                out[d["name"]] = max(shards)
+        return out
+
+    def shard_nodes(self, index: str, shard: int) -> list[dict]:
+        return [n.to_dict() for n in self.cluster.shard_nodes(index, shard)]
+
+    def set_coordinator(self, node_id: str) -> None:
+        self._validate("set_coordinator")
+        if self.cluster.node(node_id) is None:
+            raise NotFoundError(f"node not found: {node_id}")
+        self.cluster.set_coordinator(node_id)
+
+    def remove_node(self, node_id: str) -> dict:
+        self._validate("remove_node")
+        n = self.cluster.node(node_id)
+        if n is None:
+            raise NotFoundError(f"node not found: {node_id}")
+        removed = n.to_dict()
+        self.node.remove_node(node_id)
+        return removed
+
+    def resize_abort(self) -> None:
+        self._validate("resize_abort")
+        self.node.resize_abort()
+
+    # ------------------------------------------------------ anti-entropy
+
+    def fragment_blocks(self, index: str, field: str, view: str, shard: int):
+        f = self.field(index, field)
+        v = f.view(view)
+        if v is None:
+            raise NotFoundError(f"view not found: {view}")
+        frag = v.fragment(shard)
+        if frag is None:
+            raise NotFoundError(f"fragment not found: shard {shard}")
+        return frag.blocks()
+
+    def fragment_block_data(self, index: str, field: str, view: str,
+                            shard: int, block: int):
+        f = self.field(index, field)
+        v = f.view(view)
+        frag = None if v is None else v.fragment(shard)
+        if frag is None:
+            raise NotFoundError(f"fragment not found: shard {shard}")
+        return frag.block_data(block)
+
+    def fragment_data(self, index: str, field: str, view: str, shard: int) -> bytes:
+        """Serialized fragment (roaring) for resize transfer
+        (api.go FragmentData / fragment.go:2436 WriteTo)."""
+        f = self.field(index, field)
+        v = f.view(view)
+        frag = None if v is None else v.fragment(shard)
+        if frag is None:
+            raise NotFoundError(f"fragment not found: shard {shard}")
+        return frag.to_roaring()
+
+    # ---------------------------------------------------------- translate
+
+    def translate_data(self, index: str, field: str | None, after: int,
+                       limit: int = 10000):
+        """Tail the primary's translate entry stream
+        (api.go TranslateData / http/translator.go:30)."""
+        if field:
+            store = self.field(index, field).translate_store
+        else:
+            store = self.index(index).translate_store
+        return store.entries(after, limit)
+
+
+def _word_bits(words: np.ndarray) -> np.ndarray:
+    """Bit offsets set in a packed little-endian word array."""
+    if words is None or len(words) == 0:
+        return np.empty(0, dtype=np.int64)
+    bits = np.unpackbits(
+        np.asarray(words).view(np.uint8), bitorder="little"
+    )
+    return np.nonzero(bits)[0]

@@ -513,6 +513,91 @@ class Field:
         frag = self._bsi_fragment(shard)
         return None if frag is None else frag.not_null(self.options.bit_depth)
 
+    # --------------------------------------------------------- bulk import
+
+    def import_bits(self, rows, cols, timestamps=None, clear: bool = False) -> None:
+        """Bulk import of (row, col[, timestamp]) bits: group positions by
+        (view, shard) with time-quantum expansion, then one
+        ``import_positions`` per fragment (reference Field.Import,
+        field.go:1204-1282).  Mutex/bool fields fall back to per-bit
+        writes so single-row-per-column semantics hold (reference
+        bulkImportMutex, fragment.go:2094)."""
+        rows = list(rows)
+        cols = list(cols)
+        if len(rows) != len(cols):
+            raise ValueError("rows and columns length mismatch")
+        if timestamps is not None and len(timestamps) != len(rows):
+            raise ValueError("timestamps length mismatch")
+        if self.options.type == FieldType.INT:
+            raise ValueError(f"field {self.name} is an int field; use import_values")
+        if self._is_mutex_like and not clear:
+            for i, (r, c) in enumerate(zip(rows, cols)):
+                ts = timestamps[i] if timestamps is not None else None
+                self.set_bit(r, c, ts)
+            return
+        # (view, shard) -> positions
+        by_frag: dict[tuple[str, int], list[int]] = {}
+        has_std = not (self.options.type == FieldType.TIME and self.options.no_standard_view)
+        for i, (r, c) in enumerate(zip(rows, cols)):
+            shard = c // SHARD_WIDTH
+            pos = r * SHARD_WIDTH + (c % SHARD_WIDTH)
+            if has_std:
+                by_frag.setdefault((VIEW_STANDARD, shard), []).append(pos)
+            ts = timestamps[i] if timestamps is not None else None
+            if ts is not None:
+                for name in views_by_time(VIEW_STANDARD, ts, self.time_quantum):
+                    by_frag.setdefault((name, shard), []).append(pos)
+        for (vname, shard), positions in by_frag.items():
+            view = self.create_view_if_not_exists(vname)
+            frag = view.create_fragment_if_not_exists(shard)
+            if clear:
+                frag.import_positions((), positions)
+            else:
+                frag.import_positions(positions)
+            self._note_shard(shard)
+
+    def import_values(self, cols, values) -> None:
+        """Bulk import of BSI values (reference Field.importValue,
+        field.go:1284-1345)."""
+        self._require_int()
+        from pilosa_tpu.ops import bsi as bsi_ops
+
+        cols = list(cols)
+        values = list(values)
+        if len(cols) != len(values):
+            raise ValueError("columns and values length mismatch")
+        if not cols:
+            return
+        o = self.options
+        for v in values:
+            if v < o.min or v > o.max:
+                raise ValueError(f"value {v} outside field range [{o.min}, {o.max}]")
+        required = max(bit_depth(abs(v - o.base)) for v in values)
+        if required > o.bit_depth:
+            with self._lock:
+                o.bit_depth = required
+                self.save_meta()
+        depth = o.bit_depth
+        view = self.create_view_if_not_exists(self.bsi_view_name)
+        # shard -> (set positions, clear positions), one bulk apply per
+        # fragment (reference fragment.importValue, fragment.go:2186).
+        by_shard: dict[int, tuple[list[int], list[int]]] = {}
+        for c, v in zip(cols, values):
+            shard = c // SHARD_WIDTH
+            off = c % SHARD_WIDTH
+            sets, clears = by_shard.setdefault(shard, ([], []))
+            bv = v - o.base
+            uv = -bv if bv < 0 else bv
+            for i in range(depth):
+                pos = (bsi_ops.OFFSET_PLANE + i) * SHARD_WIDTH + off
+                (sets if (uv >> i) & 1 else clears).append(pos)
+            sets.append(bsi_ops.EXISTS_PLANE * SHARD_WIDTH + off)
+            (sets if bv < 0 else clears).append(bsi_ops.SIGN_PLANE * SHARD_WIDTH + off)
+        for shard, (sets, clears) in by_shard.items():
+            frag = view.create_fragment_if_not_exists(shard)
+            frag.import_positions(sets, clears)
+            self._note_shard(shard)
+
     # ---------------------------------------------------------- lifecycle
 
     def close(self) -> None:

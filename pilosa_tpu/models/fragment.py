@@ -34,6 +34,7 @@ from pilosa_tpu.ops import bsi as bsi_ops
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 DEFAULT_MAX_OP_N = 10000
+HASH_BLOCK_SIZE = 100  # rows per anti-entropy block (fragment.go:80)
 
 _SNAP_MAGIC = b"PTSF"
 _SNAP_VERSION = 1
@@ -441,6 +442,47 @@ class Fragment:
     def row_count(self, row: int) -> int:
         arr = self._rows.get(row)
         return 0 if arr is None else int(np.bitwise_count(arr).sum())
+
+    # ----------------------------------------------- anti-entropy blocks
+
+    def blocks(self) -> list[dict]:
+        """Per-block checksums for replica reconciliation: rows are
+        grouped into blocks of HASH_BLOCK_SIZE=100, each hashed over its
+        (rowID, packed words) content (reference FragmentBlocks,
+        fragment.go:80 HashBlockSize, :1762 Checksum/Blocks).  The hash is
+        blake2b-64 rather than the reference's xxhash — only cross-node
+        consistency matters, not format compatibility."""
+        import hashlib
+
+        out = []
+        with self._lock:
+            by_block: dict[int, list[int]] = {}
+            for r in self.row_ids():
+                by_block.setdefault(r // HASH_BLOCK_SIZE, []).append(r)
+            for block in sorted(by_block):
+                h = hashlib.blake2b(digest_size=8)
+                for r in by_block[block]:
+                    h.update(r.to_bytes(8, "little"))
+                    h.update(self._rows[r].tobytes())
+                out.append({"id": block, "checksum": h.hexdigest()})
+        return out
+
+    def block_data(self, block: int) -> tuple[list[int], list[int]]:
+        """(rowIDs, column offsets) parallel arrays for one block
+        (reference fragment.blockData, fragment.go:1829)."""
+        rows_out: list[int] = []
+        cols_out: list[int] = []
+        with self._lock:
+            lo, hi = block * HASH_BLOCK_SIZE, (block + 1) * HASH_BLOCK_SIZE
+            for r in self.row_ids():
+                if r < lo or r >= hi:
+                    continue
+                offs = np.nonzero(
+                    np.unpackbits(self._rows[r].view(np.uint8), bitorder="little")
+                )[0]
+                rows_out.extend([r] * len(offs))
+                cols_out.extend(int(o) for o in offs)
+        return rows_out, cols_out
 
     def cached_row_counts(self, n: int = 0) -> dict[int, int] | None:
         """Exact {row: count} from the TopN cache when valid for the

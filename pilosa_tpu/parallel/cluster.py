@@ -261,6 +261,16 @@ class Cluster:
         with self._lock:
             self.state = state
 
+    def set_coordinator(self, node_id: str) -> None:
+        """Move the coordinator role (api.go:1193 SetCoordinator)."""
+        with self._lock:
+            if node_id not in self._nodes:
+                raise KeyError(f"node not found: {node_id}")
+            self.coordinator_id = node_id
+            for n in self._nodes.values():
+                n.is_coordinator = n.id == node_id
+            self.save_topology()
+
     def _update_cluster_state(self) -> None:
         """NORMAL / DEGRADED from node healths (cluster.go:571-583):
         DEGRADED while <= replica_n - 1 nodes are down (reads can still

@@ -121,6 +121,40 @@ class ClusterNode:
                 f = idx.field(msg["field"])
                 if f is not None:
                     f._note_shard(int(msg["shard"]))
+        elif t == "import":
+            idx = self.holder.index(msg["index"])
+            f = None if idx is None else idx.field(msg["field"])
+            if f is None:
+                return {"ok": False, "error": "field not found"}
+            ts = msg.get("timestamps")
+            if ts is not None:
+                import datetime as _dt
+
+                ts = [None if t_ is None else _dt.datetime.fromisoformat(t_)
+                      for t_ in ts]
+            f.import_bits(msg["rows"], msg["cols"], ts,
+                          clear=bool(msg.get("clear")))
+        elif t == "import-value":
+            idx = self.holder.index(msg["index"])
+            f = None if idx is None else idx.field(msg["field"])
+            if f is None:
+                return {"ok": False, "error": "field not found"}
+            f.import_values(msg["cols"], msg["values"])
+        elif t == "node-join":
+            # Join handshake (the memberlist-join equivalent;
+            # gossip/gossip.go:65-123): the coordinator admits the node
+            # and broadcasts the new ClusterStatus to everyone.
+            from pilosa_tpu.parallel.cluster import Node as _Node
+
+            n = _Node.from_dict(msg["node"])
+            self.cluster.add_node(n)
+            status = self.cluster.to_status()
+            self.broadcast({"type": "cluster-status", "status": status})
+            return {"ok": True, "status": status}
+        elif t == "node-leave":
+            self.cluster.remove_node(msg["node"])
+            self.broadcast({"type": "cluster-status",
+                            "status": self.cluster.to_status()})
         elif t == "cluster-status":
             self.cluster.apply_status(msg["status"])
         elif t == "node-state":
@@ -128,6 +162,24 @@ class ClusterNode:
         else:
             return {"ok": False, "error": f"unknown message type: {t}"}
         return {"ok": True}
+
+    def remove_node(self, node_id: str) -> None:
+        """Remove a member and broadcast the new status (api.go:1226
+        RemoveNode).  When the resize subsystem is attached it drives a
+        removal resize job first."""
+        self.cluster.remove_node(node_id)
+        self.cluster.set_coordinator(self.cluster.coordinator_id
+                                     if self.cluster.node(self.cluster.coordinator_id)
+                                     else sorted(n.id for n in self.cluster.sorted_nodes())[0])
+        self.broadcast({"type": "cluster-status", "status": self.cluster.to_status()})
+
+    def resize_abort(self) -> None:
+        """Abort an in-flight resize job (api.go:1250 ResizeAbort);
+        overridden by the resize subsystem when attached."""
+        from pilosa_tpu.parallel.cluster import STATE_NORMAL
+
+        self.cluster.set_state(STATE_NORMAL)
+        self.broadcast({"type": "cluster-status", "status": self.cluster.to_status()})
 
     def note_shard_created(self, index: str, field: str, shard: int) -> None:
         """Broadcast new-shard existence after a local write created it."""

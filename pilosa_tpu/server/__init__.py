@@ -1,0 +1,20 @@
+"""Server assembly: HTTP handler, node-to-node client, lifecycle.
+
+Parity target: the reference's layers 7-8 (http/ package, server.go,
+server/ package).
+"""
+
+from pilosa_tpu.server.handler import (
+    Handler,
+    deserialize_results,
+    serialize_result,
+)
+from pilosa_tpu.server.client import InternalClient, HTTPTransport
+
+__all__ = [
+    "Handler",
+    "serialize_result",
+    "deserialize_results",
+    "InternalClient",
+    "HTTPTransport",
+]

@@ -1,0 +1,176 @@
+"""Node-to-node HTTP client + transport.
+
+Parity target: the reference's InternalClient (http/client.go:37) — the
+RPC used for remote query execution, control-plane messages, fragment
+block diffs/data, translate streaming, and resize transfers — plus the
+Transport adapter that plugs it into the cluster layer.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+from pilosa_tpu.parallel.cluster import Node, Transport, TransportError
+
+
+class ClientError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"http {status}: {message}")
+        self.status = status
+
+
+class InternalClient:
+    """Thin JSON/binary HTTP client against a node's Handler routes
+    (http/client.go:37)."""
+
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    # ------------------------------------------------------------- basics
+
+    def _request(self, method: str, url: str, body: bytes | None = None,
+                 ctype: str = "application/json") -> bytes:
+        req = urllib.request.Request(url, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", ctype)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                detail = json.loads(e.read()).get("error", "")
+            except Exception:
+                pass
+            raise ClientError(e.code, detail or str(e)) from e
+        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
+            raise TransportError(f"node unreachable: {url}: {e}") from e
+
+    def _json(self, method: str, url: str, obj=None):
+        body = None if obj is None else json.dumps(obj).encode()
+        return json.loads(self._request(method, url, body) or b"null")
+
+    # -------------------------------------------------------------- query
+
+    def query_node(self, uri: str, index: str, pql: str,
+                   shards: list[int] | None = None, remote: bool = True):
+        """POST /index/{i}/query with Remote semantics
+        (http/client.go:268 QueryNode).  Returns raw JSON result list."""
+        q = f"?remote={'true' if remote else 'false'}"
+        if shards is not None:
+            q += "&shards=" + ",".join(str(s) for s in shards)
+        d = self._json("POST", f"{uri}/index/{index}/query{q}",
+                       {"query": pql})
+        return d["results"]
+
+    def send_message(self, uri: str, message: dict) -> dict:
+        return self._json("POST", f"{uri}/internal/cluster/message", message)
+
+    # ------------------------------------------------------------- schema
+
+    def schema(self, uri: str) -> list[dict]:
+        return self._json("GET", f"{uri}/schema")["indexes"]
+
+    def create_index(self, uri: str, index: str, options: dict | None = None):
+        return self._json("POST", f"{uri}/index/{index}",
+                          {"options": options or {}})
+
+    def create_field(self, uri: str, index: str, field: str,
+                     options: dict | None = None):
+        return self._json("POST", f"{uri}/index/{index}/field/{field}",
+                          {"options": options or {}})
+
+    def status(self, uri: str) -> dict:
+        return self._json("GET", f"{uri}/status")
+
+    # ------------------------------------------------------------- import
+
+    def import_bits(self, uri: str, index: str, field: str, rows, cols,
+                    timestamps=None, row_keys=None, col_keys=None,
+                    clear: bool = False):
+        body = {}
+        if rows:
+            body["rowIDs"] = list(rows)
+        if cols:
+            body["columnIDs"] = list(cols)
+        if timestamps:
+            body["timestamps"] = list(timestamps)
+        if row_keys:
+            body["rowKeys"] = list(row_keys)
+        if col_keys:
+            body["columnKeys"] = list(col_keys)
+        q = "?clear=true" if clear else ""
+        return self._json("POST", f"{uri}/index/{index}/field/{field}/import{q}",
+                          body)
+
+    def import_values(self, uri: str, index: str, field: str, cols, values,
+                      col_keys=None):
+        body = {"columnIDs": list(cols), "values": list(values)}
+        if col_keys:
+            body["columnKeys"] = list(col_keys)
+        return self._json("POST",
+                          f"{uri}/index/{index}/field/{field}/import-value",
+                          body)
+
+    def import_roaring(self, uri: str, index: str, field: str, shard: int,
+                       data: bytes, clear: bool = False):
+        q = "?clear=true" if clear else ""
+        return self._request(
+            "POST",
+            f"{uri}/index/{index}/field/{field}/import-roaring/{shard}{q}",
+            data, ctype="application/octet-stream")
+
+    # ------------------------------------------------------ anti-entropy
+
+    def fragment_blocks(self, uri: str, index: str, field: str, view: str,
+                        shard: int) -> list[dict]:
+        d = self._json(
+            "GET",
+            f"{uri}/internal/fragment/blocks?index={index}&field={field}"
+            f"&view={view}&shard={shard}")
+        return d["blocks"]
+
+    def fragment_block_data(self, uri: str, index: str, field: str,
+                            view: str, shard: int, block: int):
+        d = self._json(
+            "GET",
+            f"{uri}/internal/fragment/block/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}&block={block}")
+        return d["rowIDs"], d["columnIDs"]
+
+    def retrieve_fragment(self, uri: str, index: str, field: str, view: str,
+                          shard: int) -> bytes:
+        """Serialized roaring fragment for resize transfer
+        (http/client.go:742 RetrieveShardFromURI)."""
+        return self._request(
+            "GET",
+            f"{uri}/internal/fragment/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}")
+
+    def translate_data(self, uri: str, index: str, field: str | None,
+                       offset: int):
+        q = f"?index={index}&offset={offset}"
+        if field:
+            q += f"&field={field}"
+        d = self._json("GET", f"{uri}/internal/translate/data{q}")
+        return [(e["offset"], e["id"], e["key"]) for e in d["entries"]]
+
+
+class HTTPTransport(Transport):
+    """Cluster transport over the HTTP control plane — the production
+    fabric (reference: InternalClient used by executor/cluster); tests
+    use LocalTransport instead."""
+
+    def __init__(self, client: InternalClient | None = None):
+        self.client = client or InternalClient()
+
+    def query_node(self, node: Node, index: str, pql: str, shards):
+        from pilosa_tpu.server.handler import deserialize_results  # lazy; avoids cycle
+
+        raw = self.client.query_node(node.uri, index, pql, shards)
+        return deserialize_results(raw)
+
+    def send_message(self, node: Node, message: dict) -> dict:
+        return self.client.send_message(node.uri, message)

@@ -1,0 +1,487 @@
+"""HTTP handler: the REST surface of one node.
+
+Parity target: the reference's gorilla/mux route table
+(http/handler.go:273-322) — public ``/index/...`` + ``/schema`` +
+``/status`` routes, internal ``/internal/...`` node-to-node routes, and
+infra routes (``/metrics``, ``/debug/vars``, ``/version``).  The wire
+format is JSON (the reference negotiates JSON vs protobuf,
+http/handler.go:499 handlePostQuery; JSON is its canonical public form
+and what its own docs use).
+
+Built on the stdlib ThreadingHTTPServer — the server side of the DCN
+control plane; the TPU data path never goes through HTTP.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import numpy as np
+
+from pilosa_tpu.api import (
+    API,
+    ApiError,
+    ApiMethodNotAllowedError,
+    ConflictError,
+    NotFoundError,
+)
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.index import IndexOptions
+from pilosa_tpu.models.row import Row
+from pilosa_tpu.parallel.results import GroupCount, Pair, PairField, ValCount
+
+
+def serialize_result(res):
+    """Query result -> JSON-able value, matching the reference's JSON
+    response shapes (http/handler.go handlePostQuery; pilosa.go
+    MarshalJSON impls)."""
+    if isinstance(res, Row):
+        out = {}
+        if res.keys:
+            out["keys"] = list(res.keys)
+        else:
+            out["columns"] = [int(c) for c in res.columns()]
+        if res.attrs:
+            out["attrs"] = res.attrs
+        return out
+    if isinstance(res, Pair):
+        return _pair_dict(res)
+    if isinstance(res, PairField):
+        return _pair_dict(res.pair)
+    if isinstance(res, ValCount):
+        return {"value": int(res.val), "count": int(res.count)}
+    if isinstance(res, GroupCount):
+        return {
+            "group": [_field_row_dict(fr) for fr in res.group],
+            "count": int(res.count),
+        }
+    if isinstance(res, list):
+        return [serialize_result(r) for r in res]
+    if isinstance(res, (np.integer,)):
+        return int(res)
+    if isinstance(res, (bool, int, str)) or res is None:
+        return res
+    raise TypeError(f"unserializable result type: {type(res)!r}")
+
+
+def deserialize_results(raw: list) -> list:
+    """JSON query results -> internal result types; the inverse of
+    ``serialize_result``, used by HTTPTransport so remote partials feed
+    the same reduce paths as local ones (the reference decodes protobuf
+    QueryResponse into the same structs, encoding/proto/proto.go)."""
+    return [deserialize_result(r) for r in raw]
+
+
+def deserialize_result(r):
+    if isinstance(r, dict):
+        if "columns" in r or "keys" in r:
+            row = Row.from_columns(r.get("columns") or [])
+            row.keys = list(r.get("keys") or [])
+            row.attrs = r.get("attrs") or {}
+            return row
+        if "group" in r:
+            from pilosa_tpu.parallel.results import FieldRow
+
+            return GroupCount(
+                group=[
+                    FieldRow(
+                        field=g["field"],
+                        row_id=int(g.get("rowID", 0)),
+                        row_key=g.get("rowKey", ""),
+                        value=g.get("value"),
+                    )
+                    for g in r["group"]
+                ],
+                count=int(r["count"]),
+            )
+        if "value" in r:
+            return ValCount(val=int(r["value"]), count=int(r["count"]))
+        if "count" in r:
+            return Pair(id=int(r.get("id", 0)), key=r.get("key", ""),
+                        count=int(r["count"]))
+    if isinstance(r, list):
+        return [deserialize_result(x) for x in r]
+    return r
+
+
+def _pair_dict(p: Pair) -> dict:
+    d = {"count": int(p.count)}
+    if p.key:
+        d["key"] = p.key
+    else:
+        d["id"] = int(p.id)
+    return d
+
+
+def _field_row_dict(fr) -> dict:
+    d = {"field": fr.field}
+    if fr.row_key:
+        d["rowKey"] = fr.row_key
+    else:
+        d["rowID"] = int(fr.row_id)
+    if fr.value is not None:
+        d["value"] = int(fr.value)
+    return d
+
+
+# (method, compiled path regex) -> handler-method name
+_ROUTES: list[tuple[str, re.Pattern, str]] = []
+
+
+def route(method: str, pattern: str):
+    """Register a route; `{name}` segments capture path params
+    (the gorilla/mux analog, http/handler.go:273)."""
+    rx = re.compile(
+        "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$"
+    )
+
+    def deco(fn):
+        _ROUTES.append((method, rx, fn.__name__))
+        return fn
+
+    return deco
+
+
+class Handler:
+    """Routes HTTP requests to an API instance and serves forever on a
+    background thread (http/handler.go:46)."""
+
+    def __init__(self, api: API, host: str = "127.0.0.1", port: int = 0,
+                 stats=None, tracer=None):
+        self.api = api
+        self.stats = stats
+        self.tracer = tracer
+        handler_self = self
+
+        class _Req(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _dispatch(self, method: str):
+                handler_self._handle(self, method)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
+
+        self.httpd = ThreadingHTTPServer((host, port), _Req)
+        self.port = self.httpd.server_address[1]
+        self.host = host
+        self._thread: threading.Thread | None = None
+
+    @property
+    def uri(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def serve_background(self) -> None:
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ------------------------------------------------------------ plumbing
+
+    def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        parsed = urlparse(req.path)
+        path = parsed.path.rstrip("/") or "/"
+        params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        for m, rx, name in _ROUTES:
+            if m != method:
+                continue
+            match = rx.match(path)
+            if match is None:
+                continue
+            if self.stats is not None:
+                self.stats.count_with_tags("http.request", 1, 1.0,
+                                           [f"useragent:{req.headers.get('User-Agent', '')}"])
+            try:
+                body = b""
+                length = int(req.headers.get("Content-Length") or 0)
+                if length:
+                    body = req.rfile.read(length)
+                getattr(self, name)(req, params, match.groupdict(), body)
+            except NotFoundError as e:
+                self._error(req, 404, str(e))
+            except ConflictError as e:
+                self._error(req, 409, str(e))
+            except ApiMethodNotAllowedError as e:
+                self._error(req, 405, str(e))
+            except (ApiError, ValueError, KeyError, TypeError) as e:
+                self._error(req, 400, str(e))
+            except Exception as e:  # internal error; keep serving
+                self._error(req, 500, f"{type(e).__name__}: {e}")
+            return
+        self._error(req, 404, "not found")
+
+    def _json(self, req, obj, status: int = 200) -> None:
+        data = json.dumps(obj).encode()
+        req.send_response(status)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)
+
+    def _bytes(self, req, data: bytes, ctype: str = "application/octet-stream",
+               status: int = 200) -> None:
+        req.send_response(status)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)
+
+    def _error(self, req, status: int, msg: str) -> None:
+        try:
+            self._json(req, {"error": msg}, status)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    # ------------------------------------------------------ public routes
+
+    @route("GET", "/")
+    def handle_root(self, req, params, path, body):
+        self._json(req, {
+            "name": "pilosa-tpu",
+            "version": self.api.version(),
+            "docs": "see /schema, /status, /index/{index}/query",
+        })
+
+    @route("GET", "/version")
+    def handle_version(self, req, params, path, body):
+        self._json(req, {"version": self.api.version()})
+
+    @route("GET", "/info")
+    def handle_info(self, req, params, path, body):
+        self._json(req, self.api.info())
+
+    @route("GET", "/status")
+    def handle_status(self, req, params, path, body):
+        self._json(req, {
+            "state": self.api.state(),
+            "nodes": self.api.hosts(),
+            "localID": self.api.cluster.local_id,
+        })
+
+    @route("GET", "/hosts")
+    def handle_hosts(self, req, params, path, body):
+        self._json(req, self.api.hosts())
+
+    @route("GET", "/schema")
+    def handle_get_schema(self, req, params, path, body):
+        self._json(req, {"indexes": self.api.schema()})
+
+    @route("POST", "/schema")
+    def handle_post_schema(self, req, params, path, body):
+        d = json.loads(body or b"{}")
+        self.api.apply_schema(d.get("indexes", []))
+        self._json(req, {})
+
+    @route("POST", "/index/{index}/query")
+    def handle_post_query(self, req, params, path, body):
+        pql = body.decode()
+        ctype = req.headers.get("Content-Type", "")
+        if "json" in ctype:
+            pql = json.loads(pql).get("query", "")
+        shards = None
+        if params.get("shards"):
+            shards = [int(s) for s in params["shards"].split(",")]
+        results = self.api.query(
+            path["index"], pql, shards=shards,
+            remote=params.get("remote") == "true",
+            column_attrs=params.get("columnAttrs") == "true",
+            exclude_row_attrs=params.get("excludeRowAttrs") == "true",
+            exclude_columns=params.get("excludeColumns") == "true",
+        )
+        self._json(req, {"results": [serialize_result(r) for r in results]})
+
+    @route("POST", "/index/{index}")
+    def handle_create_index(self, req, params, path, body):
+        d = json.loads(body or b"{}")
+        opts = IndexOptions.from_dict(d.get("options", {}))
+        self.api.create_index(path["index"], opts)
+        self._json(req, {})
+
+    @route("DELETE", "/index/{index}")
+    def handle_delete_index(self, req, params, path, body):
+        self.api.delete_index(path["index"])
+        self._json(req, {})
+
+    @route("GET", "/index/{index}")
+    def handle_get_index(self, req, params, path, body):
+        idx = self.api.index(path["index"])
+        self._json(req, {"name": idx.name,
+                         "options": idx.options.to_dict()})
+
+    @route("POST", "/index/{index}/field/{field}")
+    def handle_create_field(self, req, params, path, body):
+        d = json.loads(body or b"{}")
+        opts = FieldOptions.from_dict(d.get("options", {}))
+        self.api.create_field(path["index"], path["field"], opts)
+        self._json(req, {})
+
+    @route("DELETE", "/index/{index}/field/{field}")
+    def handle_delete_field(self, req, params, path, body):
+        self.api.delete_field(path["index"], path["field"])
+        self._json(req, {})
+
+    @route("POST", "/index/{index}/field/{field}/import")
+    def handle_import(self, req, params, path, body):
+        """JSON bit import: {"rowIDs": [...], "columnIDs": [...],
+        "timestamps": [...], "rowKeys": [...], "columnKeys": [...]}
+        (reference handlePostImport; wire form internal/public.proto
+        ImportRequest).  Timestamps are unix seconds or RFC3339."""
+        d = json.loads(body)
+        timestamps = d.get("timestamps")
+        if timestamps:
+            timestamps = [_parse_ts(t) for t in timestamps]
+        self.api.import_bits(
+            path["index"], path["field"],
+            d.get("rowIDs") or [], d.get("columnIDs") or [],
+            timestamps=timestamps,
+            row_keys=d.get("rowKeys"), col_keys=d.get("columnKeys"),
+            clear=params.get("clear") == "true",
+        )
+        self._json(req, {})
+
+    @route("POST", "/index/{index}/field/{field}/import-value")
+    def handle_import_value(self, req, params, path, body):
+        d = json.loads(body)
+        self.api.import_values(
+            path["index"], path["field"],
+            d.get("columnIDs") or [], d.get("values") or [],
+            col_keys=d.get("columnKeys"),
+        )
+        self._json(req, {})
+
+    @route("POST", "/index/{index}/field/{field}/import-roaring/{shard}")
+    def handle_import_roaring(self, req, params, path, body):
+        """Binary roaring import.  Body: raw roaring bytes for the
+        standard view, or JSON {"views": {name: base64}}
+        (reference handlePostImportRoaring, ImportRoaringRequest)."""
+        ctype = req.headers.get("Content-Type", "")
+        if "json" in ctype:
+            d = json.loads(body)
+            views = {k: base64.b64decode(v)
+                     for k, v in (d.get("views") or {}).items()}
+        else:
+            views = {"": body}
+        self.api.import_roaring(path["index"], path["field"],
+                                int(path["shard"]), views,
+                                clear=params.get("clear") == "true")
+        self._json(req, {})
+
+    @route("GET", "/export")
+    def handle_export(self, req, params, path, body):
+        buf = io.StringIO()
+        self.api.export_csv(params["index"], params["field"],
+                            int(params.get("shard", 0)), buf)
+        self._bytes(req, buf.getvalue().encode(), "text/csv")
+
+    # ---------------------------------------------------- internal routes
+
+    @route("POST", "/internal/cluster/message")
+    def handle_cluster_message(self, req, params, path, body):
+        resp = self.api.node.receive_message(json.loads(body))
+        self._json(req, resp)
+
+    @route("GET", "/internal/shards/max")
+    def handle_shards_max(self, req, params, path, body):
+        self._json(req, {"standard": self.api.shards_max()})
+
+    @route("GET", "/internal/fragment/nodes")
+    def handle_fragment_nodes(self, req, params, path, body):
+        self._json(req, self.api.shard_nodes(params["index"],
+                                             int(params["shard"])))
+
+    @route("GET", "/internal/fragment/blocks")
+    def handle_fragment_blocks(self, req, params, path, body):
+        blocks = self.api.fragment_blocks(
+            params["index"], params["field"], params["view"],
+            int(params["shard"]))
+        self._json(req, {"blocks": blocks})
+
+    @route("GET", "/internal/fragment/block/data")
+    def handle_fragment_block_data(self, req, params, path, body):
+        rows, cols = self.api.fragment_block_data(
+            params["index"], params["field"], params["view"],
+            int(params["shard"]), int(params["block"]))
+        self._json(req, {"rowIDs": rows, "columnIDs": cols})
+
+    @route("GET", "/internal/fragment/data")
+    def handle_fragment_data(self, req, params, path, body):
+        data = self.api.fragment_data(
+            params["index"], params["field"], params["view"],
+            int(params["shard"]))
+        self._bytes(req, data)
+
+    @route("GET", "/internal/translate/data")
+    def handle_translate_data(self, req, params, path, body):
+        entries = self.api.translate_data(
+            params["index"], params.get("field"),
+            int(params.get("offset", 0)))
+        self._json(req, {"entries": [
+            {"offset": o, "id": i, "key": k} for o, i, k in entries
+        ]})
+
+    @route("POST", "/cluster/resize/set-coordinator")
+    def handle_set_coordinator(self, req, params, path, body):
+        d = json.loads(body)
+        self.api.set_coordinator(d["id"])
+        self._json(req, {"old": None, "new": d["id"]})
+
+    @route("POST", "/cluster/resize/remove-node")
+    def handle_remove_node(self, req, params, path, body):
+        d = json.loads(body)
+        removed = self.api.remove_node(d["id"])
+        self._json(req, {"remove": removed})
+
+    @route("POST", "/cluster/resize/abort")
+    def handle_resize_abort(self, req, params, path, body):
+        self.api.resize_abort()
+        self._json(req, {})
+
+    # ------------------------------------------------------- infra routes
+
+    @route("GET", "/metrics")
+    def handle_metrics(self, req, params, path, body):
+        """Prometheus text exposition (http/handler.go:282)."""
+        if self.stats is not None and hasattr(self.stats, "prometheus_text"):
+            text = self.stats.prometheus_text()
+        else:
+            text = ""
+        self._bytes(req, text.encode(), "text/plain; version=0.0.4")
+
+    @route("GET", "/debug/vars")
+    def handle_debug_vars(self, req, params, path, body):
+        snap = {}
+        if self.stats is not None and hasattr(self.stats, "snapshot"):
+            snap = self.stats.snapshot()
+        self._json(req, snap)
+
+
+def _parse_ts(t):
+    import datetime as dt
+
+    if isinstance(t, (int, float)):
+        # reference ImportRequest carries unix nanos; accept seconds too
+        if t > 1 << 40:
+            t = t / 1e9
+        return dt.datetime.fromtimestamp(t, dt.timezone.utc).replace(tzinfo=None)
+    return dt.datetime.fromisoformat(str(t).replace("Z", ""))

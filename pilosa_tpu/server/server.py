@@ -1,0 +1,141 @@
+"""Server: wires holder + cluster + executor + API + HTTP into one node
+process.
+
+Parity target: the reference's pilosa.NewServer / Server.Open
+(server.go:297,417) and the server/ Command lifecycle
+(server/server.go:60-220): build everything from options, open the
+holder, join the cluster, start background loops, serve HTTP.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+
+from pilosa_tpu.api import API
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.parallel.cluster import (
+    Cluster,
+    Node,
+    STATE_NORMAL,
+    STATE_STARTING,
+    TransportError,
+)
+from pilosa_tpu.parallel.node import ClusterNode
+from pilosa_tpu.server.client import HTTPTransport, InternalClient
+from pilosa_tpu.server.handler import Handler
+
+
+class Server:
+    """One node: storage + cluster + HTTP (server.go:46)."""
+
+    def __init__(
+        self,
+        data_dir: str,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        name: str | None = None,
+        seeds: list[str] | None = None,
+        replica_n: int = 1,
+        partition_n: int = 256,
+        anti_entropy_interval: float = 0.0,
+        heartbeat_interval: float = 0.0,
+        logger=None,
+        stats=None,
+        tracer=None,
+    ):
+        os.makedirs(data_dir, exist_ok=True)
+        self.data_dir = data_dir
+        self.logger = logger
+        self.stats = stats
+        self.tracer = tracer
+        self.seeds = seeds or []
+        self.anti_entropy_interval = anti_entropy_interval
+        self.heartbeat_interval = heartbeat_interval
+
+        self.holder = Holder(data_dir)
+        node_id = name or self.holder.node_id or uuid.uuid4().hex[:12]
+
+        self.cluster = Cluster(
+            local_id=node_id,
+            replica_n=replica_n,
+            partition_n=partition_n,
+            transport=HTTPTransport(),
+            topology_path=os.path.join(data_dir, ".topology"),
+        )
+        self.node = ClusterNode(self.holder, self.cluster)
+        self.api = API(self.node)
+        self.handler = Handler(self.api, host=host, port=port,
+                               stats=stats, tracer=tracer)
+        self.cluster.local_node.uri = self.handler.uri
+        self._closers: list = []
+        self._stop = threading.Event()
+
+    @property
+    def uri(self) -> str:
+        return self.handler.uri
+
+    # ---------------------------------------------------------- lifecycle
+
+    def open(self) -> None:
+        """Serve, then join via seeds or become a standalone NORMAL
+        cluster (server.go:417 Open; gossip join with retry,
+        gossip/gossip.go:65-123)."""
+        self.handler.serve_background()
+        self.cluster.save_topology()
+        if self.seeds:
+            self._join_via_seeds()
+        else:
+            # single/static bootstrap: coordinator of own cluster
+            self.cluster.coordinator_id = self.cluster.local_id
+            self.cluster.local_node.is_coordinator = True
+            self.cluster.set_state(STATE_NORMAL)
+        if self.anti_entropy_interval > 0:
+            t = threading.Thread(target=self._anti_entropy_loop, daemon=True)
+            t.start()
+        if self.heartbeat_interval > 0:
+            t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+            t.start()
+
+    def _join_via_seeds(self) -> None:
+        client = InternalClient()
+        me = self.cluster.local_node.to_dict()
+        last_err: Exception | None = None
+        for attempt in range(60):  # 60 retries (gossip/gossip.go:102)
+            for seed in self.seeds:
+                try:
+                    resp = client.send_message(
+                        seed, {"type": "node-join", "node": me})
+                    if resp.get("status"):
+                        self.cluster.apply_status(resp["status"])
+                    return
+                except (TransportError, Exception) as e:
+                    last_err = e
+            self._stop.wait(0.5)
+            if self._stop.is_set():
+                return
+        raise RuntimeError(f"could not join cluster via seeds: {last_err}")
+
+    def _anti_entropy_loop(self) -> None:
+        from pilosa_tpu.parallel.syncer import HolderSyncer
+
+        while not self._stop.wait(self.anti_entropy_interval):
+            try:
+                HolderSyncer(self.node).sync_holder()
+            except Exception:
+                pass
+
+    def _heartbeat_loop(self) -> None:
+        from pilosa_tpu.parallel.membership import heartbeat_round
+
+        while not self._stop.wait(self.heartbeat_interval):
+            try:
+                heartbeat_round(self.node)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        self.handler.close()
+        self.holder.close()

@@ -1,0 +1,3 @@
+"""Framework version (reference version.go)."""
+
+VERSION = "0.1.0"
