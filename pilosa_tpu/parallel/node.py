@@ -140,6 +140,34 @@ class ClusterNode:
             if f is None:
                 return {"ok": False, "error": "field not found"}
             f.import_values(msg["cols"], msg["values"])
+        elif t == "fragment-blocks":
+            frag = self._fragment(msg, create=False)
+            return {"ok": True,
+                    "blocks": [] if frag is None else frag.blocks()}
+        elif t == "fragment-block-data":
+            frag = self._fragment(msg, create=False)
+            if frag is None:
+                return {"ok": True, "rowIDs": [], "columnIDs": []}
+            rows, cols = frag.block_data(int(msg["block"]))
+            return {"ok": True, "rowIDs": rows, "columnIDs": cols}
+        elif t == "fragment-import":
+            frag = self._fragment(msg, create=True)
+            if frag is None:
+                return {"ok": False, "error": "field not found"}
+            frag.import_positions(msg["positions"])
+        elif t == "attr-blocks":
+            store = self._attr_store(msg)
+            blocks = [] if store is None else [
+                {"id": b, "checksum": d.hex()} for b, d in store.blocks()
+            ]
+            return {"ok": True, "blocks": blocks}
+        elif t == "attr-block-data":
+            store = self._attr_store(msg)
+            attrs = {} if store is None else {
+                str(k): v
+                for k, v in store.block_data(int(msg["block"])).items()
+            }
+            return {"ok": True, "attrs": attrs}
         elif t == "node-join":
             # Join handshake (the memberlist-join equivalent;
             # gossip/gossip.go:65-123): the coordinator admits the node
@@ -155,6 +183,8 @@ class ClusterNode:
             self.cluster.remove_node(msg["node"])
             self.broadcast({"type": "cluster-status",
                             "status": self.cluster.to_status()})
+        elif t == "node-status":
+            self.apply_node_status(msg)
         elif t == "cluster-status":
             self.cluster.apply_status(msg["status"])
         elif t == "node-state":
@@ -180,6 +210,64 @@ class ClusterNode:
 
         self.cluster.set_state(STATE_NORMAL)
         self.broadcast({"type": "cluster-status", "status": self.cluster.to_status()})
+
+    def _fragment(self, msg: dict, create: bool):
+        idx = self.holder.index(msg["index"])
+        f = None if idx is None else idx.field(msg["field"])
+        if f is None:
+            return None
+        vname = msg["view"]
+        view = f.view(vname)
+        if view is None:
+            if not create:
+                return None
+            view = f.create_view_if_not_exists(vname)
+        frag = view.fragment(int(msg["shard"]))
+        if frag is None and create:
+            frag = view.create_fragment_if_not_exists(int(msg["shard"]))
+            f._note_shard(int(msg["shard"]))
+        return frag
+
+    def _attr_store(self, msg: dict):
+        idx = self.holder.index(msg["index"])
+        if idx is None:
+            return None
+        if not msg.get("field"):
+            return idx.column_attrs
+        f = idx.field(msg["field"])
+        return None if f is None else f.row_attrs
+
+    def node_status(self) -> dict:
+        """Per-field available shards (reference NodeStatus,
+        internal/private.proto; merged remotely via
+        Field.AddRemoteAvailableShards, field.go:263-360)."""
+        indexes: dict[str, dict[str, list[int]]] = {}
+        for d in self.holder.schema():
+            idx = self.holder.index(d["name"])
+            if idx is None:
+                continue
+            fields = {}
+            for f in idx.public_fields():
+                shards = sorted(f.available_shards())
+                if shards:
+                    fields[f.name] = shards
+            if fields:
+                indexes[d["name"]] = fields
+        return {"type": "node-status", "node": self.cluster.local_id,
+                "indexes": indexes}
+
+    def broadcast_node_status(self) -> None:
+        self.broadcast(self.node_status())
+
+    def apply_node_status(self, msg: dict) -> None:
+        for iname, fields in msg.get("indexes", {}).items():
+            idx = self.holder.index(iname)
+            if idx is None:
+                continue
+            for fname, shards in fields.items():
+                f = idx.field(fname)
+                if f is not None:
+                    f.add_remote_available_shards(set(shards))
 
     def note_shard_created(self, index: str, field: str, shard: int) -> None:
         """Broadcast new-shard existence after a local write created it."""

@@ -1,0 +1,210 @@
+"""Anti-entropy: periodic replica reconciliation.
+
+Parity target: the reference's holderSyncer (holder.go:880-1101) and
+fragmentSyncer (fragment.go:2840-3032): walk the schema; for every
+fragment this node owns a replica of, exchange 100-row block checksums
+with the other owners, pull block data for differing blocks, and
+converge.  Attribute stores reconcile the same way over their own block
+checksums (attr.go:80-120, holder.go:975).
+
+Merge semantics: bits converge to the **union** of all replicas
+(the reference's mergeBlock computes the union and per-node deltas,
+fragment.go:1875-1995 — a cleared bit that some replica still holds is
+resurrected there too, absent tombstones).  Deltas this node is missing
+are applied locally; deltas a peer is missing are pushed as an import
+message to that peer alone.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu.parallel.cluster import TransportError
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class FragmentSyncer:
+    """Reconcile one (index, field, view, shard) across its owner
+    replicas (fragment.go:2840 fragmentSyncer)."""
+
+    def __init__(self, node, index: str, field: str, view: str, shard: int):
+        self.node = node
+        self.cluster = node.cluster
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+
+    def _peers(self):
+        return [n for n in self.cluster.shard_nodes(self.index, self.shard)
+                if n.id != self.cluster.local_id]
+
+    def _local_fragment(self, create: bool = False):
+        idx = self.node.holder.index(self.index)
+        f = None if idx is None else idx.field(self.field)
+        if f is None:
+            return None
+        v = f.view(self.view)
+        if v is None:
+            if not create:
+                return None
+            v = f.create_view_if_not_exists(self.view)
+        frag = v.fragment(self.shard)
+        if frag is None and create:
+            frag = v.create_fragment_if_not_exists(self.shard)
+        return frag
+
+    def sync(self) -> int:
+        """Returns the number of blocks reconciled (0 = replicas agree)."""
+        frag = self._local_fragment()
+        local_blocks = {} if frag is None else {
+            b["id"]: b["checksum"] for b in frag.blocks()
+        }
+        peer_blocks: dict[str, dict[int, str]] = {}
+        for n in self._peers():
+            try:
+                resp = self.cluster.transport.send_message(n, {
+                    "type": "fragment-blocks",
+                    "index": self.index, "field": self.field,
+                    "view": self.view, "shard": self.shard,
+                })
+            except TransportError:
+                continue
+            peer_blocks[n.id] = {
+                b["id"]: b["checksum"] for b in resp.get("blocks", [])
+            }
+        # blocks needing reconciliation: checksum differs anywhere
+        dirty = set()
+        all_ids = set(local_blocks)
+        for blocks in peer_blocks.values():
+            all_ids |= set(blocks)
+        for bid in all_ids:
+            sums = {local_blocks.get(bid)}
+            for blocks in peer_blocks.values():
+                sums.add(blocks.get(bid))
+            if len(sums) > 1:
+                dirty.add(bid)
+        for bid in sorted(dirty):
+            self._sync_block(bid, list(peer_blocks))
+        return len(dirty)
+
+    def _sync_block(self, block: int, peer_ids: list[str]) -> None:
+        """Pull every replica's block data, compute the union, apply the
+        local diff, and push each peer its own missing bits
+        (fragment.go:2941 syncBlock + :1875 mergeBlock)."""
+        frag = self._local_fragment(create=True)
+        local_pairs = set(zip(*frag.block_data(block)))
+        per_peer: dict[str, set] = {}
+        for n in self._peers():
+            if n.id not in peer_ids:
+                continue
+            try:
+                resp = self.cluster.transport.send_message(n, {
+                    "type": "fragment-block-data",
+                    "index": self.index, "field": self.field,
+                    "view": self.view, "shard": self.shard, "block": block,
+                })
+            except TransportError:
+                continue
+            per_peer[n.id] = set(zip(resp.get("rowIDs", []),
+                                     resp.get("columnIDs", [])))
+        union = set(local_pairs)
+        for pairs in per_peer.values():
+            union |= pairs
+        # local diff
+        missing = union - local_pairs
+        if missing:
+            frag.import_positions(
+                [r * SHARD_WIDTH + c for r, c in missing])
+        # push per-peer diffs (view-aware fragment import so time and BSI
+        # views reconcile too, not just the standard view)
+        for n in self._peers():
+            pairs = per_peer.get(n.id)
+            if pairs is None:
+                continue
+            peer_missing = union - pairs
+            if not peer_missing:
+                continue
+            try:
+                self.cluster.transport.send_message(n, {
+                    "type": "fragment-import",
+                    "index": self.index, "field": self.field,
+                    "view": self.view, "shard": self.shard,
+                    "positions": [r * SHARD_WIDTH + c
+                                  for r, c in peer_missing],
+                })
+            except TransportError:
+                pass
+
+
+class HolderSyncer:
+    """Walk the whole schema and reconcile every locally-owned fragment
+    and attribute store (holder.go:880 holderSyncer.SyncHolder)."""
+
+    def __init__(self, node):
+        self.node = node
+        self.cluster = node.cluster
+
+    def sync_holder(self) -> int:
+        if self.cluster.replica_n < 2:
+            return 0
+        from pilosa_tpu.parallel.cluster import STATE_RESIZING
+
+        if self.cluster.state == STATE_RESIZING:
+            return 0  # skipped mid-resize (server.go:514)
+        # announce local shard availability first so peers (owners or
+        # not) fan queries out over everything this node holds
+        # (reference NodeStatus exchange, server.go:569)
+        self.node.broadcast_node_status()
+        total = 0
+        for idx_info in self.node.holder.schema():
+            iname = idx_info["name"]
+            idx = self.node.holder.index(iname)
+            if idx is None:
+                continue
+            self._sync_attrs(iname, None)
+            for f in idx.public_fields():
+                self._sync_attrs(iname, f.name)
+                for vname, view in list(f.views.items()):
+                    for shard in sorted(f.available_shards()):
+                        if not self.cluster.owns_shard(
+                                self.cluster.local_id, iname, shard):
+                            continue
+                        total += FragmentSyncer(
+                            self.node, iname, f.name, vname, shard).sync()
+        return total
+
+    def _sync_attrs(self, index: str, field: str | None) -> None:
+        """Pull attribute blocks that differ and merge them locally
+        (holder.go:975 syncIndex / :1021 syncField; attrBlocks.Diff
+        attr.go:90)."""
+        store = self._attr_store(self.node, index, field)
+        if store is None:
+            return
+        for n in self.cluster.sorted_nodes():
+            if n.id == self.cluster.local_id:
+                continue
+            try:
+                resp = self.cluster.transport.send_message(n, {
+                    "type": "attr-blocks", "index": index, "field": field,
+                })
+                peer_blocks = [(b["id"], bytes.fromhex(b["checksum"]))
+                               for b in resp.get("blocks", [])]
+                need = store.blocks_diff(peer_blocks)
+                for bid in need:
+                    data = self.cluster.transport.send_message(n, {
+                        "type": "attr-block-data", "index": index,
+                        "field": field, "block": bid,
+                    }).get("attrs", {})
+                    store.set_bulk_attrs(
+                        {int(k): v for k, v in data.items()})
+            except TransportError:
+                continue
+
+    @staticmethod
+    def _attr_store(node, index: str, field: str | None):
+        idx = node.holder.index(index)
+        if idx is None:
+            return None
+        if field is None:
+            return getattr(idx, "column_attrs", None)
+        f = idx.field(field)
+        return None if f is None else getattr(f, "row_attrs", None)

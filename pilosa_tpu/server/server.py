@@ -86,6 +86,8 @@ class Server:
         self.cluster.save_topology()
         if self.seeds:
             self._join_via_seeds()
+            # announce restored shards; pull peers' (NodeStatus exchange)
+            self.node.broadcast_node_status()
         else:
             # single/static bootstrap: coordinator of own cluster
             self.cluster.coordinator_id = self.cluster.local_id
