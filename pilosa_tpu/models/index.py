@@ -140,6 +140,11 @@ class Index:
     def public_fields(self) -> list[Field]:
         return [f for n, f in sorted(self.fields.items()) if not n.startswith("_")]
 
+    def all_fields(self) -> list[Field]:
+        """Public + internal fields (``_exists``) — storage-walking code
+        (resize, anti-entropy, cleanup) must cover both."""
+        return [f for _, f in sorted(self.fields.items())]
+
     # -------------------------------------------------------------- shards
 
     def available_shards(self) -> set[int]:

@@ -77,6 +77,23 @@ class View:
             self.fragments[shard] = frag
         return frag
 
+    def delete_fragment(self, shard: int) -> bool:
+        """Close and delete one shard's fragment and its files — the
+        post-resize cleaner path (reference holderCleaner,
+        holder.go:1126 cleanHolder; view.deleteFragment)."""
+        frag = self.fragments.pop(shard, None)
+        if frag is None:
+            return False
+        frag.close()
+        if self.path is not None:
+            base = self._frag_path(shard)
+            for suffix in (".snap", ".wal", ".cache"):
+                try:
+                    os.remove(base + suffix)
+                except FileNotFoundError:
+                    pass
+        return True
+
     def available_shards(self) -> set[int]:
         return set(self.fragments)
 

@@ -66,6 +66,22 @@ def jump_hash(key: int, n_buckets: int) -> int:
     return b
 
 
+def shard_owners(sorted_node_ids: list[str], index: str, shard: int,
+                 replica_n: int, partition_n: int = DEFAULT_PARTITION_N,
+                 hasher=None) -> list[str]:
+    """Owner node ids of a shard under a hypothetical membership —
+    placement math detached from a live Cluster, used by resize planning
+    to diff old-vs-new topologies (cluster.go:726 fragCombos)."""
+    if not sorted_node_ids:
+        return []
+    hash_fn = (hasher or JmpHasher()).hash
+    p = partition(index, shard, partition_n)
+    start = hash_fn(p, len(sorted_node_ids))
+    k = min(replica_n, len(sorted_node_ids))
+    return [sorted_node_ids[(start + i) % len(sorted_node_ids)]
+            for i in range(k)]
+
+
 class ModHasher:
     """Deterministic partition->node hasher for tests (test/cluster.go:18)."""
 

@@ -170,19 +170,72 @@ class ClusterNode:
             return {"ok": True, "attrs": attrs}
         elif t == "node-join":
             # Join handshake (the memberlist-join equivalent;
-            # gossip/gossip.go:65-123): the coordinator admits the node
-            # and broadcasts the new ClusterStatus to everyone.
+            # gossip/gossip.go:65-123, coordinator resize-on-join
+            # cluster.go:1141 listenForJoins): the coordinator runs a
+            # resize job moving this node's newly-owned fragments to it,
+            # then broadcasts the new ClusterStatus.  A non-coordinator
+            # seed forwards the join to the coordinator.
             from pilosa_tpu.parallel.cluster import Node as _Node
+            from pilosa_tpu.parallel.resize import Resizer
 
+            if not self.cluster.is_coordinator:
+                return self._forward_to_coordinator(msg)
             n = _Node.from_dict(msg["node"])
-            self.cluster.add_node(n)
-            status = self.cluster.to_status()
-            self.broadcast({"type": "cluster-status", "status": status})
-            return {"ok": True, "status": status}
-        elif t == "node-leave":
-            self.cluster.remove_node(msg["node"])
-            self.broadcast({"type": "cluster-status",
-                            "status": self.cluster.to_status()})
+            if self.cluster.node(n.id) is not None:
+                # re-join of a known member (restart): refresh uri only
+                self.cluster.node(n.id).uri = n.uri or self.cluster.node(n.id).uri
+                self.cluster.save_topology()
+            else:
+                Resizer(self).run(add=n)
+            # nodeStatus lets the (re)joiner catch up on shards created
+            # while it was away
+            return {"ok": True, "status": self.cluster.to_status(),
+                    "nodeStatus": self.node_status()}
+        elif t in ("node-leave", "remove-node"):
+            from pilosa_tpu.parallel.resize import Resizer
+
+            if not self.cluster.is_coordinator:
+                return self._forward_to_coordinator(
+                    {"type": "remove-node", "node": msg["node"]})
+            Resizer(self).run(remove_id=msg["node"])
+        elif t == "node-removed":
+            # This node was administratively removed: detach into a
+            # standalone cluster so its background loops stop touching
+            # the old members (reference: removed node receives the new
+            # ClusterStatus and shuts down its participation).
+            from pilosa_tpu.parallel.cluster import STATE_NORMAL
+
+            with self.cluster._lock:
+                me = self.cluster.local_node
+                self.cluster._nodes = {me.id: me}
+                self.cluster.coordinator_id = me.id
+                me.is_coordinator = True
+                self.cluster.state = STATE_NORMAL
+                self.cluster.save_topology()
+        elif t == "resize-instruction":
+            from pilosa_tpu.parallel.resize import follow_resize_instruction
+
+            return follow_resize_instruction(self, msg)
+        elif t == "fragment-views":
+            idx = self.holder.index(msg["index"])
+            f = None if idx is None else idx.field(msg["field"])
+            views = []
+            if f is not None:
+                shard = int(msg["shard"])
+                for vname, view in f.views.items():
+                    if view.fragment(shard) is not None:
+                        views.append(vname)
+            return {"ok": True, "views": views}
+        elif t == "fragment-data-b64":
+            import base64 as _b64
+
+            frag = self._fragment(msg, create=False)
+            if frag is None:
+                return {"ok": False, "error": "fragment not found"}
+            return {"ok": True,
+                    "data": _b64.b64encode(frag.to_roaring()).decode()}
+        elif t == "holder-cleanup":
+            self.cleanup_unowned()
         elif t == "node-status":
             self.apply_node_status(msg)
         elif t == "cluster-status":
@@ -194,14 +247,40 @@ class ClusterNode:
         return {"ok": True}
 
     def remove_node(self, node_id: str) -> None:
-        """Remove a member and broadcast the new status (api.go:1226
-        RemoveNode).  When the resize subsystem is attached it drives a
-        removal resize job first."""
-        self.cluster.remove_node(node_id)
-        self.cluster.set_coordinator(self.cluster.coordinator_id
-                                     if self.cluster.node(self.cluster.coordinator_id)
-                                     else sorted(n.id for n in self.cluster.sorted_nodes())[0])
-        self.broadcast({"type": "cluster-status", "status": self.cluster.to_status()})
+        """Remove a member via a coordinator-driven resize job that
+        re-homes its fragments first (api.go:1226 RemoveNode).  Non-
+        coordinator nodes forward to the coordinator."""
+        from pilosa_tpu.parallel.resize import Resizer
+
+        if self.cluster.is_coordinator:
+            Resizer(self).run(remove_id=node_id)
+            return
+        coord = self.cluster.node(self.cluster.coordinator_id)
+        if coord is None or self.cluster.transport is None:
+            raise RuntimeError("no coordinator reachable for remove-node")
+        resp = self.cluster.transport.send_message(
+            coord, {"type": "remove-node", "node": node_id})
+        if not resp.get("ok", True):
+            raise RuntimeError(resp.get("error", "remove-node failed"))
+
+    def cleanup_unowned(self) -> None:
+        """Delete local fragments for shards this node no longer owns
+        (reference holderCleaner, holder.go:1103-1154).  Shard
+        availability bookkeeping is left global — other nodes still hold
+        the shard."""
+        if self.cluster.transport is None or len(self.cluster.sorted_nodes()) < 2:
+            return
+        for d in self.holder.schema():
+            iname = d["name"]
+            idx = self.holder.index(iname)
+            if idx is None:
+                continue
+            for f in idx.all_fields():
+                for view in list(f.views.values()):
+                    for shard in list(view.fragments):
+                        if not self.cluster.owns_shard(
+                                self.cluster.local_id, iname, shard):
+                            view.delete_fragment(shard)
 
     def resize_abort(self) -> None:
         """Abort an in-flight resize job (api.go:1250 ResizeAbort);
@@ -211,31 +290,49 @@ class ClusterNode:
         self.cluster.set_state(STATE_NORMAL)
         self.broadcast({"type": "cluster-status", "status": self.cluster.to_status()})
 
-    def _fragment(self, msg: dict, create: bool):
-        idx = self.holder.index(msg["index"])
-        f = None if idx is None else idx.field(msg["field"])
+    def _forward_to_coordinator(self, msg: dict) -> dict:
+        coord = self.cluster.node(self.cluster.coordinator_id)
+        if coord is None or self.cluster.transport is None:
+            return {"ok": False, "error": "no coordinator reachable"}
+        try:
+            return self.cluster.transport.send_message(coord, msg)
+        except TransportError as e:
+            return {"ok": False, "error": str(e)}
+
+    def local_fragment(self, index: str, field: str, view: str, shard: int,
+                       create: bool = False):
+        """Resolve (index, field, view, shard) -> Fragment; the single
+        resolution path shared by message dispatch and the syncer."""
+        idx = self.holder.index(index)
+        f = None if idx is None else idx.field(field)
         if f is None:
             return None
-        vname = msg["view"]
-        view = f.view(vname)
-        if view is None:
+        v = f.view(view)
+        if v is None:
             if not create:
                 return None
-            view = f.create_view_if_not_exists(vname)
-        frag = view.fragment(int(msg["shard"]))
+            v = f.create_view_if_not_exists(view)
+        frag = v.fragment(shard)
         if frag is None and create:
-            frag = view.create_fragment_if_not_exists(int(msg["shard"]))
-            f._note_shard(int(msg["shard"]))
+            frag = v.create_fragment_if_not_exists(shard)
+            f._note_shard(shard)
         return frag
 
-    def _attr_store(self, msg: dict):
-        idx = self.holder.index(msg["index"])
+    def attr_store(self, index: str, field: str | None):
+        idx = self.holder.index(index)
         if idx is None:
             return None
-        if not msg.get("field"):
+        if not field:
             return idx.column_attrs
-        f = idx.field(msg["field"])
+        f = idx.field(field)
         return None if f is None else f.row_attrs
+
+    def _fragment(self, msg: dict, create: bool):
+        return self.local_fragment(msg["index"], msg["field"], msg["view"],
+                                   int(msg["shard"]), create)
+
+    def _attr_store(self, msg: dict):
+        return self.attr_store(msg["index"], msg.get("field"))
 
     def node_status(self) -> dict:
         """Per-field available shards (reference NodeStatus,
@@ -247,7 +344,7 @@ class ClusterNode:
             if idx is None:
                 continue
             fields = {}
-            for f in idx.public_fields():
+            for f in idx.all_fields():
                 shards = sorted(f.available_shards())
                 if shards:
                     fields[f.name] = shards

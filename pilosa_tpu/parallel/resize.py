@@ -1,0 +1,246 @@
+"""Elastic resize: re-shard the cluster when a node joins or leaves.
+
+Parity target: the reference's resize machinery (cluster.go:1196-1561):
+the coordinator computes, per node, the set of fragments it will own
+under the new topology but does not hold under the old one, along with a
+source node for each (fragCombos :726, fragsDiff :684, fragSources
+:784); sends every node a ResizeInstruction; nodes fetch fragment data
+from their sources and ack (followResizeInstruction :1297-1411); the
+cluster is RESIZING (writes and queries 405 at the API) for the
+duration; on completion the coordinator broadcasts the new NORMAL
+ClusterStatus and all nodes drop fragments they no longer own
+(holderCleaner, holder.go:1103-1154).
+
+TPU framing: device buffers can't be re-sharded incrementally — each
+transferred fragment moves as its serialized roaring archive
+(fragment.go:2436 WriteTo/ReadFrom) and is re-imported, which re-packs
+it into HBM-resident tensors on the new owner (SURVEY.md §7 risk
+register, checkpoint-and-reshard).
+"""
+
+from __future__ import annotations
+
+import base64
+
+from pilosa_tpu.parallel.cluster import (
+    Node,
+    STATE_NORMAL,
+    STATE_RESIZING,
+    TransportError,
+    shard_owners,
+)
+
+
+class ResizeError(RuntimeError):
+    pass
+
+
+def plan_transfers(holder, old_ids: list[str], new_ids: list[str],
+                   replica_n: int, partition_n: int,
+                   hasher=None) -> dict[str, list[dict]]:
+    """node id -> list of {index, field, shard, source} transfers
+    (cluster.go:784 fragSources).  Source preference: the old primary,
+    then old replicas, excluding nodes absent from *both* topologies."""
+    old_sorted = sorted(old_ids)
+    new_sorted = sorted(new_ids)
+    out: dict[str, list[dict]] = {nid: [] for nid in new_sorted}
+    for d in holder.schema():
+        iname = d["name"]
+        idx = holder.index(iname)
+        if idx is None:
+            continue
+        for f in idx.all_fields():
+            for shard in sorted(f.available_shards()):
+                old_owners = shard_owners(old_sorted, iname, shard,
+                                          replica_n, partition_n, hasher)
+                new_owners = shard_owners(new_sorted, iname, shard,
+                                          replica_n, partition_n, hasher)
+                for dest in new_owners:
+                    if dest in old_owners:
+                        continue
+                    sources = [s for s in old_owners if s != dest]
+                    if not sources:
+                        continue
+                    out[dest].append({
+                        "index": iname, "field": f.name,
+                        "shard": shard, "source": sources[0],
+                        "fallbacks": sources[1:],
+                    })
+    return out
+
+
+class Resizer:
+    """Coordinator-side resize job driver (cluster.go:1196 resizeJob +
+    :1141 listenForJoins).  Synchronous: instructions are dispatched over
+    the control plane and acked in-line; abort resets state."""
+
+    def __init__(self, node):
+        self.node = node
+        self.cluster = node.cluster
+        self.aborted = False
+
+    def _broadcast_status(self) -> None:
+        self.node.broadcast({"type": "cluster-status",
+                             "status": self.cluster.to_status()})
+
+    def run(self, add: Node | None = None,
+            remove_id: str | None = None) -> dict:
+        """Admit/remove a node with data movement.  Returns a summary
+        {transfers: N, nodes: [...]}."""
+        c = self.cluster
+        if not c.is_coordinator:
+            raise ResizeError("resize must run on the coordinator")
+        if c.state == STATE_RESIZING:
+            raise ResizeError("a resize job is already running")
+        old_ids = [n.id for n in c.sorted_nodes()]
+        new_ids = list(old_ids)
+        if add is not None and add.id not in new_ids:
+            new_ids.append(add.id)
+        if remove_id is not None:
+            if remove_id not in new_ids:
+                raise ResizeError(f"node not found: {remove_id}")
+            new_ids.remove(remove_id)
+        if sorted(new_ids) == sorted(old_ids):
+            return {"transfers": 0, "nodes": new_ids}
+
+        plan = plan_transfers(self.node.holder, old_ids, new_ids,
+                              c.replica_n, c.partition_n, c.hasher)
+        c.set_state(STATE_RESIZING)
+        self._broadcast_status()
+        try:
+            total = self._execute(plan, add, remove_id, old_ids)
+        except Exception:
+            # abort: revert membership-independent state, unblock writes
+            # (api.go:1250 ResizeAbort path)
+            c.set_state(STATE_NORMAL)
+            self._broadcast_status()
+            raise
+        # commit the new topology
+        if add is not None:
+            c.add_node(add)
+        removed_node = None
+        if remove_id is not None:
+            removed_node = c.node(remove_id)
+            c.remove_node(remove_id)
+            if c.coordinator_id == remove_id:
+                c.set_coordinator(sorted(new_ids)[0])
+        # tell the removed node it is out BEFORE the post-commit
+        # broadcast (which no longer reaches it), so its background
+        # loops stop pushing data at the old replicas
+        if removed_node is not None:
+            try:
+                c.transport.send_message(removed_node,
+                                         {"type": "node-removed"})
+            except TransportError:
+                pass
+        c.set_state(STATE_NORMAL)
+        c._update_cluster_state()
+        self._broadcast_status()
+        # propagate the coordinator's global shard availability so the
+        # joiner fans queries out over shards it doesn't hold locally
+        self.node.broadcast_node_status()
+        # post-resize cleanup everywhere (holder.go:1126 holderCleaner)
+        self.node.broadcast({"type": "holder-cleanup"})
+        self.node.cleanup_unowned()
+        return {"transfers": total, "nodes": new_ids}
+
+    def _execute(self, plan: dict[str, list[dict]], add: Node | None,
+                 remove_id: str | None, old_ids: list[str]) -> int:
+        """Send each node its ResizeInstruction and collect acks
+        (cluster.go:1279 sendTo / :1297 followResizeInstruction)."""
+        c = self.cluster
+        schema = self.node.holder.schema()
+        # node id -> uri for sources (the joiner isn't in the ring yet)
+        uris = {n.id: n.uri for n in c.sorted_nodes()}
+        if add is not None:
+            uris[add.id] = add.uri
+        status = c.to_status()
+        if add is not None and all(n["id"] != add.id
+                                   for n in status["nodes"]):
+            status = dict(status)
+            status["nodes"] = status["nodes"] + [add.to_dict()]
+        total = 0
+        for dest_id, transfers in plan.items():
+            if self.aborted:
+                raise ResizeError("resize aborted")
+            instruction = {
+                "type": "resize-instruction",
+                "schema": schema,
+                "transfers": transfers,
+                "status": status,
+                "uris": uris,
+            }
+            if dest_id == c.local_id:
+                resp = self.node.receive_message(instruction)
+            else:
+                dest = c.node(dest_id) or (add if add and add.id == dest_id
+                                           else None)
+                if dest is None:
+                    continue
+                resp = c.transport.send_message(dest, instruction)
+            if not resp.get("ok"):
+                raise ResizeError(
+                    f"resize instruction failed on {dest_id}: "
+                    f"{resp.get('error')}")
+            total += len(transfers)
+        return total
+
+    def abort(self) -> None:
+        self.aborted = True
+
+
+def follow_resize_instruction(node, msg: dict) -> dict:
+    """Destination-side: apply schema, fetch each assigned fragment (all
+    views) from its source, import, ack (cluster.go:1297
+    followResizeInstruction)."""
+    node.holder.apply_schema(msg.get("schema", []))
+    uris = msg.get("uris", {})
+    peer_nodes = {n["id"]: Node.from_dict(n)
+                  for n in msg.get("status", {}).get("nodes", [])}
+    for t in msg.get("transfers", []):
+        sources = [t["source"]] + list(t.get("fallbacks", []))
+        last_err = None
+        done = False
+        for src_id in sources:
+            src = peer_nodes.get(src_id) or Node(id=src_id,
+                                                 uri=uris.get(src_id, ""))
+            if src.uri == "" and src_id in uris:
+                src.uri = uris[src_id]
+            try:
+                _fetch_fragment(node, src, t["index"], t["field"],
+                                t["shard"])
+                done = True
+                break
+            except TransportError as e:
+                last_err = e
+        if not done:
+            return {"ok": False,
+                    "error": f"no reachable source for "
+                             f"{t['index']}/{t['field']}/shard "
+                             f"{t['shard']}: {last_err}"}
+    return {"ok": True}
+
+
+def _fetch_fragment(node, src: Node, index: str, field: str,
+                    shard: int) -> None:
+    """Pull every view of one fragment from `src` and import it
+    (http/client.go:742 RetrieveShardFromURI; the archive covers all
+    views, fragment.go:2436)."""
+    resp = node.cluster.transport.send_message(src, {
+        "type": "fragment-views", "index": index, "field": field,
+        "shard": shard,
+    })
+    idx = node.holder.index(index)
+    f = None if idx is None else idx.field(field)
+    if f is None:
+        raise TransportError(f"field not found locally: {field}")
+    for vname in resp.get("views", []):
+        data_resp = node.cluster.transport.send_message(src, {
+            "type": "fragment-data-b64", "index": index, "field": field,
+            "view": vname, "shard": shard,
+        })
+        data = base64.b64decode(data_resp["data"])
+        view = f.create_view_if_not_exists(vname)
+        frag = view.create_fragment_if_not_exists(shard)
+        frag.import_roaring(data)
+    f._note_shard(shard)

@@ -38,19 +38,8 @@ class FragmentSyncer:
                 if n.id != self.cluster.local_id]
 
     def _local_fragment(self, create: bool = False):
-        idx = self.node.holder.index(self.index)
-        f = None if idx is None else idx.field(self.field)
-        if f is None:
-            return None
-        v = f.view(self.view)
-        if v is None:
-            if not create:
-                return None
-            v = f.create_view_if_not_exists(self.view)
-        frag = v.fragment(self.shard)
-        if frag is None and create:
-            frag = v.create_fragment_if_not_exists(self.shard)
-        return frag
+        return self.node.local_fragment(self.index, self.field, self.view,
+                                        self.shard, create)
 
     def sync(self) -> int:
         """Returns the number of blocks reconciled (0 = replicas agree)."""
@@ -161,7 +150,7 @@ class HolderSyncer:
             if idx is None:
                 continue
             self._sync_attrs(iname, None)
-            for f in idx.public_fields():
+            for f in idx.all_fields():
                 self._sync_attrs(iname, f.name)
                 for vname, view in list(f.views.items()):
                     for shard in sorted(f.available_shards()):
@@ -170,13 +159,18 @@ class HolderSyncer:
                             continue
                         total += FragmentSyncer(
                             self.node, iname, f.name, vname, shard).sync()
+        # periodic unowned-fragment cleanup rides the AE cadence, so a
+        # node that missed the one-shot post-resize holder-cleanup
+        # broadcast still converges (reference holderCleaner loop,
+        # holder.go:1103)
+        self.node.cleanup_unowned()
         return total
 
     def _sync_attrs(self, index: str, field: str | None) -> None:
         """Pull attribute blocks that differ and merge them locally
         (holder.go:975 syncIndex / :1021 syncField; attrBlocks.Diff
         attr.go:90)."""
-        store = self._attr_store(self.node, index, field)
+        store = self.node.attr_store(index, field)
         if store is None:
             return
         for n in self.cluster.sorted_nodes():
@@ -198,13 +192,3 @@ class HolderSyncer:
                         {int(k): v for k, v in data.items()})
             except TransportError:
                 continue
-
-    @staticmethod
-    def _attr_store(node, index: str, field: str | None):
-        idx = node.holder.index(index)
-        if idx is None:
-            return None
-        if field is None:
-            return getattr(idx, "column_attrs", None)
-        f = idx.field(field)
-        return None if f is None else getattr(f, "row_attrs", None)

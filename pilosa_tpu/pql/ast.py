@@ -88,9 +88,11 @@ class Call:
 
     def field_arg(self) -> str:
         """The single field=row style argument's key (reference FieldArg:
-        used by Set/Clear where the arg map holds field->row)."""
+        used by Set/Clear where the arg map holds field->row).  Reserved
+        arg names ("from"/"to" on time-range Row) are never field args —
+        and arg order is not significant after a String() round-trip."""
         for k in self.args:
-            if not k.startswith("_"):
+            if not k.startswith("_") and k not in ("from", "to"):
                 return k
         raise ValueError(f"{self.name}() requires a field argument")
 

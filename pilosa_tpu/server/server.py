@@ -86,7 +86,8 @@ class Server:
         self.cluster.save_topology()
         if self.seeds:
             self._join_via_seeds()
-            # announce restored shards; pull peers' (NodeStatus exchange)
+            # announce restored shards (peers' status came back in the
+            # join response's nodeStatus)
             self.node.broadcast_node_status()
         else:
             # single/static bootstrap: coordinator of own cluster
@@ -111,6 +112,10 @@ class Server:
                         seed, {"type": "node-join", "node": me})
                     if resp.get("status"):
                         self.cluster.apply_status(resp["status"])
+                    # catch up on shards created while this node was
+                    # away (the coordinator's NodeStatus)
+                    if resp.get("nodeStatus"):
+                        self.node.apply_node_status(resp["nodeStatus"])
                     return
                 except (TransportError, Exception) as e:
                     last_err = e

@@ -1,0 +1,239 @@
+"""Elastic resize tests: node join/leave with fragment re-homing
+(parity: cluster.go:1196-1561 resize job, holder.go:1103 holderCleaner;
+reference tests in cluster_internal_test.go and server/cluster_test.go)."""
+
+from __future__ import annotations
+
+import pytest
+
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.parallel.cluster import (
+    Cluster,
+    LocalTransport,
+    Node,
+    shard_owners,
+)
+from pilosa_tpu.parallel.executor import ExecOptions
+from pilosa_tpu.parallel.node import ClusterNode
+from pilosa_tpu.parallel.resize import Resizer, plan_transfers
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+from tests.test_cluster import make_cluster
+
+
+def _query(node, index, pql):
+    return node.executor.execute(index, pql)[0]
+
+
+def _seed_data(node, n_shards=6):
+    node.create_index("i")
+    node.create_field("i", "f")
+    cols = [s * SHARD_WIDTH + (s % 7) for s in range(n_shards)]
+    for c in cols:
+        node.executor.execute("i", f"Set({c}, f=1)")
+    return cols
+
+
+class TestPlan:
+    def test_plan_covers_newly_owned_shards(self, tmp_path):
+        _, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        _seed_data(nodes[0], n_shards=8)
+        old = ["node0", "node1"]
+        new = ["node0", "node1", "node2"]
+        plan = plan_transfers(nodes[0].holder, old, new, 1, 256)
+        # every shard that node2 owns under the new topology appears in
+        # its transfer list, sourced from the old owner
+        f = nodes[0].holder.index("i").field("f")
+        for shard in f.available_shards():
+            new_owner = shard_owners(sorted(new), "i", shard, 1)[0]
+            old_owner = shard_owners(sorted(old), "i", shard, 1)[0]
+            if new_owner == "node2":
+                entry = [t for t in plan["node2"]
+                         if t["shard"] == shard and t["field"] == "f"]
+                assert len(entry) == 1
+                assert entry[0]["source"] == old_owner
+            else:
+                assert all(t["shard"] != shard for t in plan["node2"])
+
+    def test_plan_includes_existence_field(self, tmp_path):
+        _, nodes = make_cluster(tmp_path, n=1, replica_n=1)
+        _seed_data(nodes[0], n_shards=4)
+        plan = plan_transfers(nodes[0].holder, ["node0"],
+                              ["node0", "node1"], 1, 256)
+        fields = {t["field"] for t in plan.get("node1", [])}
+        if plan.get("node1"):
+            assert "_exists" in fields or fields  # existence field moves too
+
+
+class TestJoin:
+    def test_join_moves_data_and_queries_stay_correct(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        cols = _seed_data(nodes[0], n_shards=6)
+        total = _query(nodes[0], "i", "Count(Row(f=1))")
+        assert total == len(cols)
+
+        # boot a fresh node and join via the coordinator
+        holder2 = Holder(str(tmp_path / "node2"))
+        cluster2 = Cluster("node2", nodes=[Node(id="node2")],
+                           replica_n=1, transport=transport)
+        joiner = ClusterNode(holder2, cluster2)
+        coord = nodes[0]
+        resp = transport.send_message(
+            coord.cluster.local_node,
+            {"type": "node-join",
+             "node": {"id": "node2", "uri": ""}},
+        )
+        assert resp["ok"]
+        # all three clusters agree on membership and state
+        for nd in (*nodes, joiner):
+            assert len(nd.cluster.sorted_nodes()) == 3
+            assert nd.cluster.state == "NORMAL"
+        # node2 holds fragments for every shard it now owns
+        f2 = joiner.holder.index("i").field("f")
+        for shard in range(6):
+            owner = joiner.cluster.shard_nodes("i", shard)[0].id
+            if owner == "node2":
+                frag = f2.view("standard").fragment(shard)
+                assert frag is not None and frag.row_count(1) == 1
+        # queries from every node still see all the data
+        for nd in (*nodes, joiner):
+            assert _query(nd, "i", "Count(Row(f=1))") == len(cols)
+        cols_q = _query(joiner, "i", "Row(f=1)").columns()
+        assert sorted(int(c) for c in cols_q) == sorted(cols)
+
+    def test_join_empty_cluster_is_trivial(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=1, replica_n=1)
+        holder2 = Holder(str(tmp_path / "nodeX"))
+        cluster2 = Cluster("nodeX", nodes=[Node(id="nodeX")],
+                           replica_n=1, transport=transport)
+        ClusterNode(holder2, cluster2)
+        resp = transport.send_message(
+            nodes[0].cluster.local_node,
+            {"type": "node-join", "node": {"id": "nodeX", "uri": ""}})
+        assert resp["ok"]
+        assert len(nodes[0].cluster.sorted_nodes()) == 2
+
+    def test_join_via_non_coordinator_seed_forwards(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        holder2 = Holder(str(tmp_path / "node2"))
+        cluster2 = Cluster("node2", nodes=[Node(id="node2")],
+                           replica_n=1, transport=transport)
+        ClusterNode(holder2, cluster2)
+        # node1 is NOT the coordinator (node0 sorts first)
+        assert not nodes[1].cluster.is_coordinator
+        resp = transport.send_message(
+            nodes[1].cluster.local_node,
+            {"type": "node-join", "node": {"id": "node2", "uri": ""}})
+        assert resp["ok"]
+        assert len(nodes[0].cluster.sorted_nodes()) == 3
+
+    def test_rejoin_existing_member_is_noop(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        resp = transport.send_message(
+            nodes[0].cluster.local_node,
+            {"type": "node-join", "node": {"id": "node1", "uri": ""}})
+        assert resp["ok"]
+        assert len(nodes[0].cluster.sorted_nodes()) == 3
+
+
+class TestRemove:
+    def test_remove_rehomes_data(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        cols = _seed_data(nodes[0], n_shards=6)
+        # remove node2 via the coordinator-driven resize
+        Resizer(nodes[0]).run(remove_id="node2")
+        for nd in nodes[:2]:
+            assert len(nd.cluster.sorted_nodes()) == 2
+            assert _query(nd, "i", "Count(Row(f=1))") == len(cols)
+
+    def test_remove_via_non_coordinator_forwards(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        cols = _seed_data(nodes[0], n_shards=4)
+        nodes[1].remove_node("node2")
+        assert len(nodes[0].cluster.sorted_nodes()) == 2
+        assert _query(nodes[0], "i", "Count(Row(f=1))") == len(cols)
+
+    def test_cleanup_deletes_unowned_fragments(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        cols = _seed_data(nodes[0], n_shards=6)
+        # join node2: shards re-homed to it must eventually disappear
+        holder2 = Holder(str(tmp_path / "node2"))
+        cluster2 = Cluster("node2", nodes=[Node(id="node2")],
+                           replica_n=1, transport=transport)
+        joiner = ClusterNode(holder2, cluster2)
+        transport.send_message(
+            nodes[0].cluster.local_node,
+            {"type": "node-join", "node": {"id": "node2", "uri": ""}})
+        for nd in nodes:
+            f = nd.holder.index("i").field("f")
+            view = f.view("standard")
+            if view is None:
+                continue
+            for shard in list(view.fragments):
+                owners = [n.id for n in nd.cluster.shard_nodes("i", shard)]
+                assert nd.cluster.local_id in owners, (
+                    f"unowned fragment {shard} survived cleanup on "
+                    f"{nd.cluster.local_id}")
+
+    def test_removed_node_detaches_into_standalone(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        _seed_data(nodes[0], n_shards=3)
+        Resizer(nodes[0]).run(remove_id="node2")
+        removed = nodes[2]
+        # the removed node no longer considers itself part of the old
+        # cluster, so its AE loop cannot push stale fragments back
+        assert [n.id for n in removed.cluster.sorted_nodes()] == ["node2"]
+        assert removed.cluster.is_coordinator
+
+    def test_remove_unknown_node_errors(self, tmp_path):
+        _, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        from pilosa_tpu.parallel.resize import ResizeError
+
+        with pytest.raises(ResizeError):
+            Resizer(nodes[0]).run(remove_id="ghost")
+
+
+class TestResizeStateMachine:
+    def test_api_blocks_queries_during_resizing(self, tmp_path):
+        from pilosa_tpu.api import API, ApiMethodNotAllowedError
+
+        _, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        api = API(nodes[0])
+        nodes[0].cluster.set_state("RESIZING")
+        with pytest.raises(ApiMethodNotAllowedError):
+            api.query("i", "Count(Row(f=1))")
+        with pytest.raises(ApiMethodNotAllowedError):
+            api.create_index("j")
+        nodes[0].cluster.set_state("NORMAL")
+        assert api.query("i", "Count(Row(f=1))") == [0]
+
+    def test_bsi_and_time_views_move(self, tmp_path):
+        import datetime as dt
+
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "v", FieldOptions.int_field(0, 1000))
+        nodes[0].create_field("i", "t", FieldOptions.time_field("YMD"))
+        for s in range(4):
+            nodes[0].executor.execute("i", f"Set({s * SHARD_WIDTH + 1}, v=42)")
+            nodes[0].executor.execute(
+                "i",
+                f"Set({s * SHARD_WIDTH + 2}, t=3, 2020-01-0{s + 1}T00:00)")
+        sum_before = _query(nodes[0], "i", "Sum(field=v)")
+        holder2 = Holder(str(tmp_path / "node2"))
+        cluster2 = Cluster("node2", nodes=[Node(id="node2")],
+                           replica_n=1, transport=transport)
+        joiner = ClusterNode(holder2, cluster2)
+        transport.send_message(
+            nodes[0].cluster.local_node,
+            {"type": "node-join", "node": {"id": "node2", "uri": ""}})
+        sum_after = _query(joiner, "i", "Sum(field=v)")
+        assert (sum_after.val, sum_after.count) == (sum_before.val,
+                                                    sum_before.count)
+        got = _query(
+            joiner, "i",
+            "Row(t=3, from='2020-01-01T00:00', to='2020-01-05T00:00')")
+        assert len(got.columns()) == 4
