@@ -1,0 +1,67 @@
+"""Membership liveness: heartbeat-based failure detection.
+
+Parity target: the reference's gossip/SWIM membership (gossip/gossip.go
+memberlist delegate) and its false-down protection — a suspect node is
+dialed repeatedly before being declared DOWN (cluster.go:1724
+confirmNodeDown, 10 retries).  The TPU-native design replaces UDP gossip
+with direct heartbeats over the DCN control plane: every node pings its
+peers each round; state changes broadcast as node-state messages and the
+NORMAL/DEGRADED state machine reacts (cluster.go:571-583).
+
+Query-time replica failover (executor mapReduce re-mapping,
+executor.go:2492) is independent of this detector — it handles mid-query
+loss; the detector handles steady-state routing (DOWN primaries are
+skipped up front in shards_by_node)."""
+
+from __future__ import annotations
+
+from pilosa_tpu.parallel.cluster import (
+    NODE_DOWN,
+    NODE_READY,
+    TransportError,
+)
+
+# Dial attempts before declaring a node DOWN (cluster.go:1724 uses 10
+#×1s; the control plane here is request/response so 3 suffices).
+CONFIRM_RETRIES = 3
+
+
+def ping(node, target) -> bool:
+    try:
+        resp = node.cluster.transport.send_message(target, {"type": "ping"})
+        return bool(resp.get("ok"))
+    except TransportError:
+        return False
+
+
+def confirm_down(node, target) -> bool:
+    """True if the target is really unreachable after retries
+    (cluster.go:1724 confirmNodeDown)."""
+    for _ in range(CONFIRM_RETRIES):
+        if ping(node, target):
+            return False
+    return True
+
+
+def heartbeat_round(node) -> dict[str, str]:
+    """One liveness sweep over all peers; returns {node_id: new_state}
+    for nodes whose state changed.  State changes are applied locally
+    and broadcast (reference: memberlist events -> cluster.ReceiveEvent,
+    cluster.go:1754)."""
+    cluster = node.cluster
+    if cluster.transport is None:
+        return {}
+    changes: dict[str, str] = {}
+    for target in cluster.sorted_nodes():
+        if target.id == cluster.local_id:
+            continue
+        alive = ping(node, target)
+        if not alive and target.state != NODE_DOWN:
+            if confirm_down(node, target):
+                changes[target.id] = NODE_DOWN
+        elif alive and target.state == NODE_DOWN:
+            changes[target.id] = NODE_READY
+    for nid, state in changes.items():
+        cluster.set_node_state(nid, state)
+        node.broadcast({"type": "node-state", "node": nid, "state": state})
+    return changes
