@@ -1,0 +1,7 @@
+"""`python -m pilosa_tpu` entrypoint (reference cmd/pilosa/main.go:27)."""
+
+import sys
+
+from pilosa_tpu.cmd import main
+
+sys.exit(main())

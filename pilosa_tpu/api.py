@@ -73,6 +73,7 @@ class API:
         self.holder = node.holder
         self.cluster = node.cluster
         self.executor = node.executor
+        self.max_writes_per_request = 0  # 0 = unlimited (config wired by server)
 
     # ----------------------------------------------------------- validate
 
@@ -95,6 +96,16 @@ class API:
         from pilosa_tpu.parallel.executor import ExecOptions
 
         self._validate("query")
+        if self.max_writes_per_request > 0:
+            from pilosa_tpu.pql import Query, parse as _parse
+
+            q = _parse(pql) if isinstance(pql, str) else pql
+            if isinstance(q, Query) and (
+                    q.write_call_n() > self.max_writes_per_request):
+                raise ApiError(
+                    f"too many writes in one request "
+                    f"({q.write_call_n()} > {self.max_writes_per_request})")
+            pql = q
         opt = ExecOptions(
             remote=remote,
             column_attrs=column_attrs,

@@ -1,0 +1,332 @@
+"""CLI: the operational command surface.
+
+Parity target: the reference's cobra command tree (cmd/root.go:28) and
+ctl/ implementations — ``server`` (ctl/server.go), ``import``
+(ctl/import.go:34-350: CSV buffering, shard grouping, key-aware),
+``export`` (ctl/export.go), ``check`` (ctl/check.go: offline file
+integrity), ``inspect`` (ctl/inspect.go: fragment dump),
+``generate-config``/``config`` (ctl/generate_config.go, ctl/config.go).
+
+Run as ``python -m pilosa_tpu <command>``."""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import datetime as dt
+import os
+import signal
+import sys
+import threading
+
+from pilosa_tpu.config import Config
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="pilosa-tpu",
+        description="TPU-native distributed bitmap index")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    ps = sub.add_parser("server", help="run a node")
+    ps.add_argument("-c", "--config", help="TOML config file")
+    ps.add_argument("-d", "--data-dir")
+    ps.add_argument("-b", "--bind")
+    ps.add_argument("--name")
+    ps.add_argument("--seeds", help="comma-separated seed URIs")
+    ps.add_argument("--replicas", type=int)
+    ps.add_argument("--anti-entropy-interval", type=float)
+    ps.add_argument("--heartbeat-interval", type=float)
+    ps.add_argument("--verbose", action="store_true")
+
+    pi = sub.add_parser("import", help="bulk-import CSV bits")
+    pi.add_argument("--host", default="http://127.0.0.1:10101")
+    pi.add_argument("-i", "--index", required=True)
+    pi.add_argument("-f", "--field", required=True)
+    pi.add_argument("--create", action="store_true",
+                    help="create index/field if missing")
+    pi.add_argument("--clear", action="store_true")
+    pi.add_argument("--field-type", default="set",
+                    choices=["set", "int", "time", "mutex", "bool"])
+    pi.add_argument("--min", type=int, default=0)
+    pi.add_argument("--max", type=int, default=2**31 - 1)
+    pi.add_argument("--time-quantum", default="")
+    pi.add_argument("--batch-size", type=int, default=1_000_000,
+                    help="bits buffered per request (reference buffers 10M)")
+    pi.add_argument("files", nargs="+")
+
+    pe = sub.add_parser("export", help="export a field as CSV")
+    pe.add_argument("--host", default="http://127.0.0.1:10101")
+    pe.add_argument("-i", "--index", required=True)
+    pe.add_argument("-f", "--field", required=True)
+    pe.add_argument("-o", "--output", default="-")
+
+    pc = sub.add_parser("check", help="offline integrity check of a data dir")
+    pc.add_argument("data_dir")
+
+    pn = sub.add_parser("inspect", help="dump fragment stats from a data dir")
+    pn.add_argument("data_dir")
+    pn.add_argument("-i", "--index")
+    pn.add_argument("-f", "--field")
+
+    sub.add_parser("generate-config", help="print default TOML config")
+
+    pcfg = sub.add_parser("config", help="print effective config")
+    pcfg.add_argument("-c", "--config", help="TOML config file")
+
+    args = p.parse_args(argv)
+    return {
+        "server": cmd_server,
+        "import": cmd_import,
+        "export": cmd_export,
+        "check": cmd_check,
+        "inspect": cmd_inspect,
+        "generate-config": cmd_generate_config,
+        "config": cmd_config,
+    }[args.command](args)
+
+
+# ---------------------------------------------------------------- server
+
+def cmd_server(args) -> int:
+    overrides = {}
+    for key in ("data_dir", "bind", "name", "heartbeat_interval"):
+        v = getattr(args, key, None)
+        if v is not None:  # explicit 0 must override the config file
+            overrides[key] = v
+    if args.verbose:
+        overrides["verbose"] = True
+    cfg = Config.load(args.config, overrides=overrides)
+    if args.seeds:
+        cfg.cluster.seeds = [s for s in args.seeds.split(",") if s]
+    if args.replicas is not None:
+        cfg.cluster.replicas = args.replicas
+    if args.anti_entropy_interval is not None:
+        cfg.anti_entropy.interval = args.anti_entropy_interval
+    return run_server(cfg)
+
+
+def run_server(cfg: Config, ready_event: threading.Event | None = None,
+               stop_event: threading.Event | None = None) -> int:
+    """Build and run a node until SIGTERM/SIGINT (reference
+    server.Command.Start, server/server.go:137-220)."""
+    from pilosa_tpu import stats as _stats
+    from pilosa_tpu import tracing as _tracing
+    from pilosa_tpu.logger import StandardLogger, VerboseLogger
+    from pilosa_tpu.server.server import Server
+
+    log_stream = open(cfg.log_path, "a") if cfg.log_path else None
+    log = (VerboseLogger(log_stream) if cfg.verbose
+           else StandardLogger(log_stream))
+    stats = (_stats.NOP if cfg.metric.service == "nop"
+             else _stats.MemStatsClient())
+    if cfg.tracing.enabled:
+        _tracing.set_global_tracer(_tracing.MemTracer())
+    srv = Server(
+        cfg.expanded_data_dir(),
+        host=cfg.host,
+        port=cfg.port,
+        name=cfg.name or None,
+        seeds=cfg.cluster.seeds,
+        replica_n=cfg.cluster.replicas,
+        partition_n=cfg.cluster.partitions,
+        coordinator=cfg.cluster.coordinator,
+        anti_entropy_interval=cfg.anti_entropy.interval,
+        heartbeat_interval=cfg.heartbeat_interval,
+        long_query_time=cfg.cluster.long_query_time,
+        max_writes_per_request=cfg.max_writes_per_request,
+        logger=log,
+        stats=stats,
+    )
+    stop = stop_event or threading.Event()
+
+    def _sig(signum, frame):
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _sig)
+        signal.signal(signal.SIGINT, _sig)
+    except ValueError:
+        pass  # not the main thread (tests)
+    srv.open()
+    log.printf("listening on %s (node %s)", srv.uri, srv.cluster.local_id)
+    if ready_event is not None:
+        ready_event.set()
+    stop.wait()
+    srv.close()
+    return 0
+
+
+# ---------------------------------------------------------------- import
+
+def cmd_import(args) -> int:
+    """CSV rows are `row,col[,timestamp]` (set/time/mutex/bool) or
+    `col,value` (int) — the reference's two formats (ctl/import.go:278).
+    Bits are buffered, then sent via the bulk import API which routes by
+    shard server-side."""
+    from pilosa_tpu.server.client import InternalClient
+
+    client = InternalClient()
+    host = args.host.rstrip("/")
+    if args.create:
+        opts = {"type": args.field_type}
+        if args.field_type == "int":
+            opts.update(min=args.min, max=args.max)
+        if args.field_type == "time":
+            opts.update(timeQuantum=args.time_quantum or "YMDH")
+        try:
+            client.create_index(host, args.index, {})
+        except Exception:
+            pass
+        try:
+            client.create_field(host, args.index, args.field,
+                                {"type": args.field_type, **opts})
+        except Exception:
+            pass
+
+    is_value = args.field_type == "int"
+    rows, cols, values, timestamps = [], [], [], []
+    n_sent = 0
+
+    def flush():
+        nonlocal n_sent, rows, cols, values, timestamps
+        if is_value and cols:
+            client.import_values(host, args.index, args.field, cols, values)
+        elif cols:
+            client.import_bits(
+                host, args.index, args.field, rows, cols,
+                timestamps=[t for t in timestamps] if any(
+                    t is not None for t in timestamps) else None,
+                clear=args.clear)
+        n_sent += len(cols)
+        rows, cols, values, timestamps = [], [], [], []
+
+    import contextlib
+
+    for path in args.files:
+        stream = sys.stdin if path == "-" else open(path)
+        # never close stdin — callers (and later "-" args) still need it
+        ctx = contextlib.nullcontext(stream) if path == "-" else stream
+        with ctx:
+            for line_no, rec in enumerate(csv.reader(stream), 1):
+                if not rec or (len(rec) == 1 and not rec[0].strip()):
+                    continue
+                try:
+                    if is_value:
+                        cols.append(int(rec[0]))
+                        values.append(int(rec[1]))
+                    else:
+                        rows.append(int(rec[0]))
+                        cols.append(int(rec[1]))
+                        timestamps.append(
+                            _csv_ts(rec[2]) if len(rec) > 2 and rec[2]
+                            else None)
+                except (ValueError, IndexError) as e:
+                    print(f"{path}:{line_no}: bad record {rec!r}: {e}",
+                          file=sys.stderr)
+                    return 1
+                if len(cols) >= args.batch_size:
+                    flush()
+    flush()
+    print(f"imported {n_sent} records into "
+          f"{args.index}/{args.field}", file=sys.stderr)
+    return 0
+
+
+def _csv_ts(raw: str) -> str:
+    # reference import format uses RFC3339 (ctl/import.go:300)
+    return dt.datetime.fromisoformat(raw.replace("Z", "")).isoformat()
+
+
+# ---------------------------------------------------------------- export
+
+def cmd_export(args) -> int:
+    import urllib.request
+
+    host = args.host.rstrip("/")
+    out = sys.stdout if args.output == "-" else open(args.output, "w")
+    with urllib.request.urlopen(f"{host}/internal/shards/max",
+                                timeout=30) as resp:
+        import json
+
+        max_shards = json.loads(resp.read())["standard"]
+    max_shard = max_shards.get(args.index, 0)
+    try:
+        for shard in range(max_shard + 1):
+            with urllib.request.urlopen(
+                    f"{host}/export?index={args.index}&field={args.field}"
+                    f"&shard={shard}", timeout=120) as resp:
+                out.write(resp.read().decode())
+    finally:
+        if out is not sys.stdout:
+            out.close()
+    return 0
+
+
+# ----------------------------------------------------------------- check
+
+def cmd_check(args) -> int:
+    """Open every fragment offline and verify snapshot+WAL load, matrix
+    consistency, and roaring round-trip (reference ctl/check.go:30)."""
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.storage.roaring import decode as decode_roaring
+
+    bad = 0
+    holder = Holder(args.data_dir)
+    for d in holder.schema():
+        idx = holder.index(d["name"])
+        for f in idx.all_fields():
+            for vname, view in f.views.items():
+                for shard, frag in sorted(view.fragments.items()):
+                    label = f"{d['name']}/{f.name}/{vname}/{shard}"
+                    try:
+                        blob = frag.to_roaring()
+                        decode_roaring(blob)
+                        for r in frag.row_ids():
+                            frag.row_count(r)
+                        print(f"ok   {label}")
+                    except Exception as e:
+                        bad += 1
+                        print(f"FAIL {label}: {e}")
+    holder.close()
+    print(f"{'FAILED' if bad else 'passed'}: {bad} corrupt fragment(s)")
+    return 1 if bad else 0
+
+
+# --------------------------------------------------------------- inspect
+
+def cmd_inspect(args) -> int:
+    from pilosa_tpu.models.holder import Holder
+
+    holder = Holder(args.data_dir)
+    for d in holder.schema():
+        if args.index and d["name"] != args.index:
+            continue
+        idx = holder.index(d["name"])
+        for f in idx.all_fields():
+            if args.field and f.name != args.field:
+                continue
+            for vname, view in sorted(f.views.items()):
+                for shard, frag in sorted(view.fragments.items()):
+                    ids = frag.row_ids()
+                    bits = sum(frag.row_count(r) for r in ids)
+                    print(f"{d['name']}/{f.name}/{vname}/shard={shard}: "
+                          f"rows={len(ids)} bits={bits} opN={frag._op_n}")
+    holder.close()
+    return 0
+
+
+# ---------------------------------------------------------------- config
+
+def cmd_generate_config(args) -> int:
+    print(Config().to_toml(), end="")
+    return 0
+
+
+def cmd_config(args) -> int:
+    print(Config.load(getattr(args, "config", None)).to_toml(), end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,173 @@
+"""Configuration: one Config struct fed from three merged sources —
+defaults < TOML file < PILOSA_TPU_* environment < CLI flags.
+
+Parity target: the reference's server/config.go:48-200 Config struct
+(TOML tags) and cmd/root.go:94 viper merge order (flags ⊃ env ⊃ file).
+Every option is also settable programmatically by constructing Config
+directly — the analog of the reference's functional ServerOptions
+(server.go:86-295) used by tests and embedders."""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields
+
+
+ENV_PREFIX = "PILOSA_TPU_"
+
+
+@dataclass
+class ClusterConfig:
+    """[cluster] section (server/config.go:100-117)."""
+
+    replicas: int = 1
+    partitions: int = 256
+    seeds: list[str] = field(default_factory=list)
+    coordinator: bool = False
+    long_query_time: float = 0.0  # seconds; 0 disables slow-query log
+
+
+@dataclass
+class AntiEntropyConfig:
+    """[anti-entropy] (server/config.go:118)."""
+
+    interval: float = 600.0  # seconds (reference default 10m)
+
+
+@dataclass
+class MetricConfig:
+    """[metric] (server/config.go:125-133)."""
+
+    service: str = "mem"  # mem | nop
+    diagnostics: bool = False  # no phone-home by default
+
+
+@dataclass
+class TracingConfig:
+    """[tracing] (server/config.go:141-149)."""
+
+    enabled: bool = False
+
+
+@dataclass
+class Config:
+    data_dir: str = "~/.pilosa_tpu"
+    bind: str = "127.0.0.1:10101"
+    name: str = ""
+    verbose: bool = False
+    log_path: str = ""
+    max_writes_per_request: int = 5000
+    heartbeat_interval: float = 0.0  # seconds; 0 disables the detector
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
+    anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
+    metric: MetricConfig = field(default_factory=MetricConfig)
+    tracing: TracingConfig = field(default_factory=TracingConfig)
+
+    # ------------------------------------------------------------- access
+
+    @property
+    def host(self) -> str:
+        return self.bind.rsplit(":", 1)[0] or "127.0.0.1"
+
+    @property
+    def port(self) -> int:
+        parts = self.bind.rsplit(":", 1)
+        return int(parts[1]) if len(parts) == 2 and parts[1] else 10101
+
+    def expanded_data_dir(self) -> str:
+        return os.path.expanduser(self.data_dir)
+
+    # ------------------------------------------------------------ sources
+
+    @classmethod
+    def load(cls, toml_path: str | None = None,
+             env: dict | None = None,
+             overrides: dict | None = None) -> "Config":
+        """defaults < TOML < env < overrides (cmd/root.go:94)."""
+        cfg = cls()
+        if toml_path:
+            with open(toml_path, "rb") as f:
+                cfg._apply_dict(tomllib.load(f))
+        cfg._apply_env(env if env is not None else os.environ)
+        if overrides:
+            cfg._apply_dict(overrides)
+        return cfg
+
+    def _apply_dict(self, d: dict) -> None:
+        for k, v in d.items():
+            key = k.replace("-", "_")
+            if key in ("cluster", "anti_entropy", "metric", "tracing") and isinstance(v, dict):
+                section = getattr(self, key)
+                for sk, sv in v.items():
+                    sname = sk.replace("-", "_")
+                    if hasattr(section, sname):
+                        setattr(section, sname, sv)
+            elif hasattr(self, key) and not isinstance(getattr(self, key),
+                                                       (ClusterConfig,
+                                                        AntiEntropyConfig,
+                                                        MetricConfig,
+                                                        TracingConfig)):
+                setattr(self, key, v)
+
+    def _apply_env(self, env: dict) -> None:
+        """PILOSA_TPU_BIND=..., PILOSA_TPU_CLUSTER_REPLICAS=2, etc.
+        (the reference's PILOSA_* envs, cmd/root.go:94)."""
+        for f in fields(self):
+            if f.name in ("cluster", "anti_entropy", "metric", "tracing"):
+                section = getattr(self, f.name)
+                for sf in fields(section):
+                    key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
+                    if key in env:
+                        setattr(section, sf.name,
+                                _coerce(env[key], getattr(section, sf.name)))
+            else:
+                key = f"{ENV_PREFIX}{f.name}".upper()
+                if key in env:
+                    setattr(self, f.name,
+                            _coerce(env[key], getattr(self, f.name)))
+
+    # ------------------------------------------------------------- render
+
+    def to_toml(self) -> str:
+        """Effective config as TOML (reference `pilosa config` /
+        generate-config, ctl/config.go)."""
+        lines = [
+            f'data-dir = "{self.data_dir}"',
+            f'bind = "{self.bind}"',
+            f'name = "{self.name}"',
+            f"verbose = {str(self.verbose).lower()}",
+            f'log-path = "{self.log_path}"',
+            f"max-writes-per-request = {self.max_writes_per_request}",
+            f"heartbeat-interval = {self.heartbeat_interval}",
+            "",
+            "[cluster]",
+            f"replicas = {self.cluster.replicas}",
+            f"partitions = {self.cluster.partitions}",
+            f"seeds = [{', '.join(repr(s) for s in self.cluster.seeds)}]",
+            f"coordinator = {str(self.cluster.coordinator).lower()}",
+            f"long-query-time = {self.cluster.long_query_time}",
+            "",
+            "[anti-entropy]",
+            f"interval = {self.anti_entropy.interval}",
+            "",
+            "[metric]",
+            f'service = "{self.metric.service}"',
+            f"diagnostics = {str(self.metric.diagnostics).lower()}",
+            "",
+            "[tracing]",
+            f"enabled = {str(self.tracing.enabled).lower()}",
+        ]
+        return "\n".join(lines) + "\n"
+
+
+def _coerce(raw: str, current):
+    if isinstance(current, bool):
+        return raw.lower() in ("1", "true", "yes", "on")
+    if isinstance(current, int):
+        return int(raw)
+    if isinstance(current, float):
+        return float(raw)
+    if isinstance(current, list):
+        return [s for s in raw.split(",") if s]
+    return raw

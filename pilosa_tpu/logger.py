@@ -1,0 +1,36 @@
+"""Logger: the reference's logger.Logger interface (logger/logger.go) —
+Printf/Debugf split, nop + standard + verbose implementations."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class Logger:
+    def printf(self, fmt: str, *args) -> None:
+        pass
+
+    def debugf(self, fmt: str, *args) -> None:
+        pass
+
+
+NOP = Logger()
+
+
+class StandardLogger(Logger):
+    def __init__(self, stream=None):
+        self.stream = stream or sys.stderr
+
+    def _emit(self, fmt: str, *args) -> None:
+        ts = time.strftime("%Y-%m-%dT%H:%M:%S")
+        msg = (fmt % args) if args else fmt
+        self.stream.write(f"{ts} {msg}\n")
+
+    def printf(self, fmt, *args):
+        self._emit(fmt, *args)
+
+
+class VerboseLogger(StandardLogger):
+    def debugf(self, fmt, *args):
+        self._emit("DEBUG " + fmt, *args)

@@ -71,9 +71,14 @@ _EMPTY_ROWS_CALL = "_EmptyRows"
 
 class Executor:
     def __init__(self, holder, worker_pool_size: int | None = None, cluster=None):
+        from pilosa_tpu import stats as _stats
+
         self.holder = holder
         self.cluster = cluster  # optional cluster layer
         self.node = None  # back-ref set by ClusterNode (shard broadcasts)
+        self.stats = _stats.NOP  # injected by the server assembly
+        self.logger = None
+        self.long_query_time = 0.0  # seconds; 0 disables slow-query log
         self.pool = ThreadPoolExecutor(max_workers=worker_pool_size or 8)
 
     # ------------------------------------------------------------- public
@@ -81,6 +86,8 @@ class Executor:
     def execute(self, index_name: str, query, shards=None, opt: ExecOptions | None = None):
         """Execute a PQL query string or Query -> list of results
         (reference executor.Execute, executor.go:113)."""
+        from pilosa_tpu import tracing
+
         opt = opt or ExecOptions()
         if isinstance(query, str):
             query = parse(query)
@@ -89,19 +96,36 @@ class Executor:
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
-        # Key translation happens once at the originating node, never on
-        # remote re-execution (reference executor.Execute, executor.go:146).
-        calls = query.calls
-        if not opt.remote:
-            calls = [self._translate_call(idx, c) for c in calls]
-        results = []
-        for call in calls:
-            results.append(self._execute_call(idx, call, shards, opt))
-        if not opt.remote:
-            results = [
-                self._translate_result(idx, call, res)
-                for call, res in zip(calls, results)
-            ]
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with tracing.start_span("executor.Execute") as span:
+            span.set_tag("index", index_name)
+            # Key translation happens once at the originating node, never on
+            # remote re-execution (reference executor.Execute, executor.go:146).
+            calls = query.calls
+            if not opt.remote:
+                calls = [self._translate_call(idx, c) for c in calls]
+            results = []
+            for call in calls:
+                self.stats.count_with_tags(
+                    "query", 1, 1.0, [f"index:{index_name}",
+                                      f"call:{call.name}"])
+                with tracing.start_span(
+                        f"executor.execute{call.name}", span):
+                    results.append(self._execute_call(idx, call, shards, opt))
+            if not opt.remote:
+                results = [
+                    self._translate_result(idx, call, res)
+                    for call, res in zip(calls, results)
+                ]
+        elapsed = _time.perf_counter() - t0
+        if (self.long_query_time > 0 and elapsed > self.long_query_time
+                and self.logger is not None):
+            # slow-query log (reference cluster.long-query-time,
+            # api.go:1157)
+            self.logger.printf("slow query (%.3fs) on %s: %s",
+                               elapsed, index_name, query)
         return results
 
     # ----------------------------------------------------------- dispatch

@@ -182,9 +182,12 @@ class ClusterNode:
                 return self._forward_to_coordinator(msg)
             n = _Node.from_dict(msg["node"])
             if self.cluster.node(n.id) is not None:
-                # re-join of a known member (restart): refresh uri only
+                # re-join of a known member (restart): refresh its uri
+                # and tell everyone, or peers keep dialing the old one
                 self.cluster.node(n.id).uri = n.uri or self.cluster.node(n.id).uri
                 self.cluster.save_topology()
+                self.broadcast({"type": "cluster-status",
+                                "status": self.cluster.to_status()})
             else:
                 Resizer(self).run(add=n)
             # nodeStatus lets the (re)joiner catch up on shards created
@@ -236,6 +239,8 @@ class ClusterNode:
                     "data": _b64.b64encode(frag.to_roaring()).decode()}
         elif t == "holder-cleanup":
             self.cleanup_unowned()
+        elif t == "ping":
+            return {"ok": True, "state": self.cluster.state}
         elif t == "node-status":
             self.apply_node_status(msg)
         elif t == "cluster-status":

@@ -90,22 +90,26 @@ class Resizer:
         c = self.cluster
         if not c.is_coordinator:
             raise ResizeError("resize must run on the coordinator")
-        if c.state == STATE_RESIZING:
-            raise ResizeError("a resize job is already running")
-        old_ids = [n.id for n in c.sorted_nodes()]
-        new_ids = list(old_ids)
-        if add is not None and add.id not in new_ids:
-            new_ids.append(add.id)
-        if remove_id is not None:
-            if remove_id not in new_ids:
-                raise ResizeError(f"node not found: {remove_id}")
-            new_ids.remove(remove_id)
-        if sorted(new_ids) == sorted(old_ids):
-            return {"transfers": 0, "nodes": new_ids}
+        # atomic check-and-set: concurrent joins must serialize, or both
+        # would plan against stale membership (the reference queues join
+        # events on one coordinator goroutine, cluster.go:1141)
+        with c._lock:
+            if c.state == STATE_RESIZING:
+                raise ResizeError("a resize job is already running")
+            old_ids = [n.id for n in c.sorted_nodes()]
+            new_ids = list(old_ids)
+            if add is not None and add.id not in new_ids:
+                new_ids.append(add.id)
+            if remove_id is not None:
+                if remove_id not in new_ids:
+                    raise ResizeError(f"node not found: {remove_id}")
+                new_ids.remove(remove_id)
+            if sorted(new_ids) == sorted(old_ids):
+                return {"transfers": 0, "nodes": new_ids}
+            c.state = STATE_RESIZING
 
         plan = plan_transfers(self.node.holder, old_ids, new_ids,
                               c.replica_n, c.partition_n, c.hasher)
-        c.set_state(STATE_RESIZING)
         self._broadcast_status()
         try:
             total = self._execute(plan, add, remove_id, old_ids)
@@ -230,15 +234,27 @@ def _fetch_fragment(node, src: Node, index: str, field: str,
         "type": "fragment-views", "index": index, "field": field,
         "shard": shard,
     })
+    if not resp.get("views"):
+        # The source holds no data for this fragment: do NOT mark the
+        # transfer done, or post-resize cleanup could delete the only
+        # real copy elsewhere — fall back to another source instead.
+        raise TransportError(
+            f"source {src.id} has no data for {index}/{field}/shard "
+            f"{shard}")
     idx = node.holder.index(index)
     f = None if idx is None else idx.field(field)
     if f is None:
         raise TransportError(f"field not found locally: {field}")
-    for vname in resp.get("views", []):
+    for vname in resp["views"]:
         data_resp = node.cluster.transport.send_message(src, {
             "type": "fragment-data-b64", "index": index, "field": field,
             "view": vname, "shard": shard,
         })
+        if not data_resp.get("ok", True) or "data" not in data_resp:
+            raise TransportError(
+                f"source {src.id} failed fragment data for "
+                f"{index}/{field}/{vname}/shard {shard}: "
+                f"{data_resp.get('error')}")
         data = base64.b64decode(data_resp["data"])
         view = f.create_view_if_not_exists(vname)
         frag = view.create_fragment_if_not_exists(shard)

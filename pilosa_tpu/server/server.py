@@ -39,16 +39,22 @@ class Server:
         seeds: list[str] | None = None,
         replica_n: int = 1,
         partition_n: int = 256,
+        coordinator: bool = False,
         anti_entropy_interval: float = 0.0,
         heartbeat_interval: float = 0.0,
+        long_query_time: float = 0.0,
+        max_writes_per_request: int = 0,
         logger=None,
         stats=None,
         tracer=None,
     ):
+        from pilosa_tpu import logger as _logger
+        from pilosa_tpu import stats as _stats
+
         os.makedirs(data_dir, exist_ok=True)
         self.data_dir = data_dir
-        self.logger = logger
-        self.stats = stats
+        self.logger = logger or _logger.NOP
+        self.stats = stats if stats is not None else _stats.MemStatsClient()
         self.tracer = tracer
         self.seeds = seeds or []
         self.anti_entropy_interval = anti_entropy_interval
@@ -65,9 +71,18 @@ class Server:
             topology_path=os.path.join(data_dir, ".topology"),
         )
         self.node = ClusterNode(self.holder, self.cluster)
+        self.node.executor.stats = self.stats
+        self.node.executor.logger = self.logger
+        self.node.executor.long_query_time = long_query_time
+        if coordinator:
+            # statically designated coordinator (reference
+            # cluster.coordinator config, server/config.go:104)
+            self.cluster.coordinator_id = self.cluster.local_id
+            self.cluster.local_node.is_coordinator = True
         self.api = API(self.node)
+        self.api.max_writes_per_request = max_writes_per_request
         self.handler = Handler(self.api, host=host, port=port,
-                               stats=stats, tracer=tracer)
+                               stats=self.stats, tracer=tracer)
         self.cluster.local_node.uri = self.handler.uri
         self._closers: list = []
         self._stop = threading.Event()

@@ -1,0 +1,222 @@
+"""Stats: counters/gauges/timings with tag scoping and pluggable
+backends.
+
+Parity target: the reference's stats package (stats/stats.go:31
+StatsClient interface; :84 expvar impl; :164 multi fan-out) and the
+prometheus adapter (prometheus/prometheus.go:40) — collapsed here into
+one in-process registry that can render both the /debug/vars JSON
+snapshot and the /metrics Prometheus text exposition
+(http/handler.go:280-282)."""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class StatsClient:
+    """Interface (stats/stats.go:31).  Tag scoping via with_tags returns
+    a child client that stamps every metric."""
+
+    def count(self, name: str, value: int = 1, rate: float = 1.0) -> None:
+        pass
+
+    def count_with_tags(self, name: str, value: int, rate: float,
+                        tags: list[str]) -> None:
+        pass
+
+    def gauge(self, name: str, value: float, rate: float = 1.0) -> None:
+        pass
+
+    def histogram(self, name: str, value: float, rate: float = 1.0) -> None:
+        pass
+
+    def set(self, name: str, value: str, rate: float = 1.0) -> None:
+        pass
+
+    def timing(self, name: str, value_ns: float, rate: float = 1.0) -> None:
+        pass
+
+    def with_tags(self, *tags: str) -> "StatsClient":
+        return self
+
+    def tags(self) -> list[str]:
+        return []
+
+
+#: Shared no-op (reference NopStatsClient)
+NOP = StatsClient()
+
+
+class MemStatsClient(StatsClient):
+    """In-memory registry backend — the expvar + prometheus roles in one
+    (stats/stats.go:84, prometheus/prometheus.go:40)."""
+
+    def __init__(self, registry: "_Registry | None" = None,
+                 _tags: tuple[str, ...] = ()):
+        self._registry = registry or _Registry()
+        self._tags = tuple(sorted(_tags))
+
+    # ------------------------------------------------------------ metrics
+
+    def count(self, name, value=1, rate=1.0):
+        self._registry.add_counter(name, self._tags, value)
+
+    def count_with_tags(self, name, value, rate, tags):
+        all_tags = tuple(sorted({*self._tags, *tags}))
+        self._registry.add_counter(name, all_tags, value)
+
+    def gauge(self, name, value, rate=1.0):
+        self._registry.set_gauge(name, self._tags, value)
+
+    def histogram(self, name, value, rate=1.0):
+        self._registry.observe(name, self._tags, value)
+
+    def set(self, name, value, rate=1.0):
+        self._registry.set_gauge(f"{name}.{value}", self._tags, 1)
+
+    def timing(self, name, value_ns, rate=1.0):
+        self._registry.observe(name, self._tags, value_ns)
+
+    def with_tags(self, *tags):
+        return MemStatsClient(self._registry, (*self._tags, *tags))
+
+    def tags(self):
+        return list(self._tags)
+
+    # ----------------------------------------------------------- exports
+
+    def snapshot(self) -> dict:
+        return self._registry.snapshot()
+
+    def prometheus_text(self) -> str:
+        return self._registry.prometheus_text()
+
+
+class MultiStatsClient(StatsClient):
+    """Fan-out to several backends (stats/stats.go:164)."""
+
+    def __init__(self, clients: list[StatsClient]):
+        self.clients = list(clients)
+
+    def count(self, name, value=1, rate=1.0):
+        for c in self.clients:
+            c.count(name, value, rate)
+
+    def count_with_tags(self, name, value, rate, tags):
+        for c in self.clients:
+            c.count_with_tags(name, value, rate, tags)
+
+    def gauge(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.gauge(name, value, rate)
+
+    def histogram(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.histogram(name, value, rate)
+
+    def set(self, name, value, rate=1.0):
+        for c in self.clients:
+            c.set(name, value, rate)
+
+    def timing(self, name, value_ns, rate=1.0):
+        for c in self.clients:
+            c.timing(name, value_ns, rate)
+
+    def with_tags(self, *tags):
+        return MultiStatsClient([c.with_tags(*tags) for c in self.clients])
+
+
+class _Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = defaultdict(float)
+        self._gauges: dict[tuple, float] = {}
+        self._summaries: dict[tuple, list] = defaultdict(
+            lambda: [0, 0.0, float("inf"), float("-inf")])  # n, sum, min, max
+
+    def add_counter(self, name, tags, value):
+        with self._lock:
+            self._counters[(name, tags)] += value
+
+    def set_gauge(self, name, tags, value):
+        with self._lock:
+            self._gauges[(name, tags)] = value
+
+    def observe(self, name, tags, value):
+        with self._lock:
+            s = self._summaries[(name, tags)]
+            s[0] += 1
+            s[1] += value
+            s[2] = min(s[2], value)
+            s[3] = max(s[3], value)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {}
+            for (name, tags), v in self._counters.items():
+                out[_flat(name, tags)] = v
+            for (name, tags), v in self._gauges.items():
+                out[_flat(name, tags)] = v
+            for (name, tags), (n, total, mn, mx) in self._summaries.items():
+                out[_flat(name, tags)] = {
+                    "count": n, "sum": total, "min": mn, "max": mx}
+            return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus 0.0.4 text exposition; tag "k:v" -> label k="v"
+        (the reference's tag translation, prometheus/prometheus.go:120)."""
+        lines = []
+        with self._lock:
+            for (name, tags), v in sorted(self._counters.items()):
+                m = _prom_name(name)
+                lines.append(f"# TYPE {m} counter")
+                lines.append(f"{m}{_prom_labels(tags)} {v}")
+            for (name, tags), v in sorted(self._gauges.items()):
+                m = _prom_name(name)
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m}{_prom_labels(tags)} {v}")
+            for (name, tags), (n, total, _, _) in sorted(
+                    self._summaries.items()):
+                m = _prom_name(name)
+                lines.append(f"# TYPE {m} summary")
+                lines.append(f"{m}_count{_prom_labels(tags)} {n}")
+                lines.append(f"{m}_sum{_prom_labels(tags)} {total}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+def _flat(name: str, tags: tuple) -> str:
+    return name if not tags else f"{name}[{','.join(tags)}]"
+
+
+def _prom_name(name: str) -> str:
+    out = "".join(ch if ch.isalnum() or ch == "_" else "_" for ch in name)
+    return out if not out[:1].isdigit() else "_" + out
+
+
+def _prom_labels(tags: tuple) -> str:
+    if not tags:
+        return ""
+    pairs = []
+    for t in tags:
+        k, _, v = t.partition(":")
+        v = v.replace("\\", "\\\\").replace('"', '\\"')
+        pairs.append(f'{_prom_name(k)}="{v}"')
+    return "{" + ",".join(pairs) + "}"
+
+
+class Timer:
+    """Context manager feeding StatsClient.timing."""
+
+    def __init__(self, stats: StatsClient, name: str):
+        self.stats = stats
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        self.stats.timing(self.name, time.perf_counter_ns() - self._t0)
+        return False

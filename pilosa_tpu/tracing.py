@@ -1,0 +1,94 @@
+"""Tracing: minimal Tracer/Span facade with a global tracer.
+
+Parity target: the reference's tracing package (tracing/tracing.go:27-76
+Tracer/Span interfaces + GlobalTracer; opentracing/jaeger adapter
+tracing/opentracing/opentracing.go:36).  Spans wrap executor ops and API
+methods; the HTTP layer propagates a trace id header the way the
+reference's middleware does (http/handler.go:321)."""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+
+class Span:
+    def set_tag(self, key: str, value) -> None:
+        pass
+
+    def finish(self) -> None:
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finish()
+        return False
+
+
+class Tracer:
+    def start_span(self, name: str, parent: "Span | None" = None) -> Span:
+        return Span()
+
+
+class RecordedSpan(Span):
+    def __init__(self, tracer: "MemTracer", name: str,
+                 parent: "RecordedSpan | None"):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = parent.trace_id if parent else uuid.uuid4().hex[:16]
+        self.parent_name = parent.name if parent else None
+        self.tags: dict = {}
+        self.start_ns = time.perf_counter_ns()
+        self.duration_ns: int | None = None
+
+    def set_tag(self, key, value):
+        self.tags[key] = value
+
+    def finish(self):
+        if self.duration_ns is None:
+            self.duration_ns = time.perf_counter_ns() - self.start_ns
+            self.tracer._record(self)
+
+
+class MemTracer(Tracer):
+    """In-memory recording tracer — the test/debug backend; a jaeger
+    exporter would subclass and ship finished spans instead."""
+
+    def __init__(self, max_spans: int = 10000):
+        self.max_spans = max_spans
+        self._lock = threading.Lock()
+        self.spans: list[RecordedSpan] = []
+
+    def start_span(self, name, parent=None):
+        return RecordedSpan(self, name, parent)
+
+    def _record(self, span: RecordedSpan) -> None:
+        with self._lock:
+            if len(self.spans) < self.max_spans:
+                self.spans.append(span)
+
+    def finished(self, name: str | None = None) -> list[RecordedSpan]:
+        with self._lock:
+            return [s for s in self.spans if name is None or s.name == name]
+
+
+_global = Tracer()
+_global_lock = threading.Lock()
+
+
+def global_tracer() -> Tracer:
+    return _global
+
+
+def set_global_tracer(t: Tracer) -> None:
+    global _global
+    with _global_lock:
+        _global = t
+
+
+def start_span(name: str, parent: Span | None = None) -> Span:
+    """(reference tracing.StartSpanFromContext, tracing/tracing.go:60)"""
+    return _global.start_span(name, parent)

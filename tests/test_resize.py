@@ -63,7 +63,7 @@ class TestPlan:
                               ["node0", "node1"], 1, 256)
         fields = {t["field"] for t in plan.get("node1", [])}
         if plan.get("node1"):
-            assert "_exists" in fields or fields  # existence field moves too
+            assert "_exists" in fields  # existence field moves too
 
 
 class TestJoin:
