@@ -1,0 +1,248 @@
+"""Pallas TPU kernels for the non-trivially-XLA hot ops.
+
+SURVEY.md §7 names three ops worth hand-scheduling below XLA: the TopN
+rank scan (segmented popcount), the BSI range compare (bit-sliced ripple
+compare), and the fused intersection count.  XLA already fuses the
+elementwise chains well; what Pallas buys is (a) a single pass over HBM
+for AND+popcount+row-reduce with explicit VMEM blocking, and (b) keeping
+the D-plane ripple compare's intermediates entirely in VMEM.
+
+Every kernel has a jnp reference implementation in pilosa_tpu.ops used
+as the differential oracle (the roaring/naive.go pattern) and as the
+dispatch fallback off-TPU or for small inputs where kernel launch
+overhead dominates.  `interpret=True` runs the same kernels on CPU for
+tests.
+
+Reference analogs: roaring.IntersectionCount (roaring/roaring.go:570),
+fragment.top scan (fragment.go:1570), BSI rangeLT/GT
+(fragment.go:1111-1537).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Row-block of 128 keeps the int32 output a native (8,128)-tileable
+# [1, 128] block; 2048 uint32 words = 8KB lanes per row block.
+ROW_BLOCK = 128
+WORD_BLOCK = 2048
+
+
+def on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _pad_to(x: jnp.ndarray, axis: int, multiple: int):
+    size = x.shape[axis]
+    rem = (-size) % multiple
+    if rem == 0:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, rem)
+    return jnp.pad(x, pad)
+
+
+# ---------------------------------------------------------------------------
+# masked row counts: out[r] = sum(popcount(mat[r] & filt)) — the TopN scan
+# ---------------------------------------------------------------------------
+
+
+def _row_counts_kernel(mat_ref, filt_ref, out_ref):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    blk = lax.population_count(mat_ref[:] & filt_ref[0, :])
+    # counts broadcast across the 128 lanes — the lane dim only exists
+    # to satisfy TPU tiling; the wrapper reads lane 0
+    out_ref[:] += jnp.sum(blk, axis=1, dtype=jnp.int32)[:, None]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _row_counts_masked_pallas(mat, filt, interpret: bool = False):
+    R, W = mat.shape
+    mat = _pad_to(_pad_to(mat, 1, WORD_BLOCK), 0, ROW_BLOCK)
+    filt = _pad_to(filt.reshape(1, -1), 1, WORD_BLOCK)
+    Rp, Wp = mat.shape
+    grid = (Rp // ROW_BLOCK, Wp // WORD_BLOCK)
+    out = pl.pallas_call(
+        _row_counts_kernel,
+        out_shape=jax.ShapeDtypeStruct((Rp, 128), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_BLOCK, WORD_BLOCK), lambda i, j: (i, j)),
+            pl.BlockSpec((1, WORD_BLOCK), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((ROW_BLOCK, 128), lambda i, j: (i, 0)),
+        interpret=interpret,
+    )(mat, filt)
+    return out[:R, 0]
+
+
+def row_counts_masked(mat, filt, interpret: bool = False):
+    """Dispatching wrapper: Pallas on TPU for big matrices, fused jnp
+    otherwise (the two produce identical int32 counts)."""
+    from pilosa_tpu.ops import bitmap as bm
+
+    R, W = mat.shape
+    if (interpret or on_tpu()) and R * W >= 1 << 16:
+        return _row_counts_masked_pallas(mat, jnp.asarray(filt),
+                                         interpret=interpret)
+    return bm.row_counts_masked(mat, filt)
+
+
+# ---------------------------------------------------------------------------
+# fused intersection count: |a & b| — the north-star op
+# ---------------------------------------------------------------------------
+
+
+def _count_and_kernel(a_ref, b_ref, out_ref):
+    j = pl.program_id(0)
+
+    @pl.when(j == 0)
+    def _init():
+        out_ref[0, 0] = 0
+
+    out_ref[0, 0] += jnp.sum(
+        lax.population_count(a_ref[:] & b_ref[:]), dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _count_and_pallas(a, b, interpret: bool = False):
+    a = _pad_to(a.reshape(1, -1), 1, WORD_BLOCK)
+    b = _pad_to(b.reshape(1, -1), 1, WORD_BLOCK)
+    Wp = a.shape[1]
+    out = pl.pallas_call(
+        _count_and_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        grid=(Wp // WORD_BLOCK,),
+        in_specs=[
+            pl.BlockSpec((1, WORD_BLOCK), lambda j: (0, j)),
+            pl.BlockSpec((1, WORD_BLOCK), lambda j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1), lambda j: (0, 0), memory_space=pltpu.SMEM),
+        interpret=interpret,
+    )(a, b)
+    return out[0, 0]
+
+
+def count_and(a, b, interpret: bool = False):
+    """|a & b| with Pallas on TPU (single pass; no intermediate), jnp
+    fusion elsewhere (roaring.IntersectionCount, roaring/roaring.go:570)."""
+    from pilosa_tpu.ops import bitmap as bm
+
+    if (interpret or on_tpu()) and a.size >= 1 << 16:
+        return _count_and_pallas(jnp.asarray(a), jnp.asarray(b),
+                                 interpret=interpret)
+    return bm.popcount_and(a, b)
+
+
+# ---------------------------------------------------------------------------
+# BSI ripple compare: keep/lt/gt masks across bit planes, all in VMEM
+# ---------------------------------------------------------------------------
+
+
+def _bsi_compare_kernel(planes_ref, filt_ref, pred_ref, out_lt_ref,
+                        out_gt_ref, *, depth: int):
+    """One word-block: ripple from the MSB plane down, computing
+    columns strictly-below / strictly-above the predicate among
+    non-null, non-negative, filtered columns (the unsigned core of
+    fragment.rangeLTUnsigned/rangeGTUnsigned, fragment.go:1277-1343).
+    pred is pre-split into per-plane broadcast masks by the host."""
+    exists = planes_ref[0, :]
+    sign = planes_ref[1, :]
+    consider = exists & ~sign & filt_ref[0, :]
+    lt = jnp.zeros_like(consider)
+    gt = jnp.zeros_like(consider)
+    eq = consider
+    for i in range(depth - 1, -1, -1):
+        plane = planes_ref[2 + i, :]
+        pred_bit = pred_ref[i, 0]  # 0 or 0xFFFFFFFF broadcast mask
+        # predicate bit 1: plane-0 columns fall below; bit 0: plane-1
+        # columns rise above
+        lt = lt | (eq & pred_bit & ~plane)
+        gt = gt | (eq & ~pred_bit & plane)
+        eq = eq & ~(plane ^ pred_bit)
+    out_lt_ref[0, :] = lt
+    out_gt_ref[0, :] = gt
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "interpret"))
+def _bsi_compare_pallas(planes, filt, pred_masks, depth: int,
+                        interpret: bool = False):
+    P, W = planes.shape
+    planes = _pad_to(planes, 1, WORD_BLOCK)
+    filt = _pad_to(filt.reshape(1, -1), 1, WORD_BLOCK)
+    Wp = planes.shape[1]
+    kernel = functools.partial(_bsi_compare_kernel, depth=depth)
+    out_lt, out_gt = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((1, Wp), jnp.uint32),
+            jax.ShapeDtypeStruct((1, Wp), jnp.uint32),
+        ),
+        grid=(Wp // WORD_BLOCK,),
+        in_specs=[
+            pl.BlockSpec((P, WORD_BLOCK), lambda j: (0, j)),
+            pl.BlockSpec((1, WORD_BLOCK), lambda j: (0, j)),
+            pl.BlockSpec((depth, 1), lambda j: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, WORD_BLOCK), lambda j: (0, j)),
+            pl.BlockSpec((1, WORD_BLOCK), lambda j: (0, j)),
+        ),
+        interpret=interpret,
+    )(planes, filt, pred_masks)
+    return out_lt[0, :W], out_gt[0, :W]
+
+
+def bsi_compare_unsigned(planes, filt, upred: int, depth: int,
+                         interpret: bool = False):
+    """(strictly_lt, strictly_gt) word masks among filtered non-negative
+    columns vs an unsigned predicate.  Pallas on TPU, the shared jnp
+    ripple (pilosa_tpu.ops.bsi.compare) elsewhere — bit-identical."""
+    if upred < 0:
+        raise ValueError("predicate magnitude must be non-negative")
+    if upred >= 1 << depth:
+        # every depth-bit value is strictly below the predicate; the
+        # kernels only ripple `depth` planes, so handle this here rather
+        # than silently truncating predicate bits
+        consider = jnp.asarray(planes[0]) & ~jnp.asarray(planes[1]) \
+            & jnp.asarray(filt)
+        return consider, jnp.zeros_like(consider)
+    if (interpret or on_tpu()) and planes.shape[1] >= 1 << 12:
+        pred_masks = np.array(
+            [[0xFFFFFFFF if (upred >> i) & 1 else 0]
+             for i in range(depth)],
+            dtype=np.uint32,
+        )
+        return _bsi_compare_pallas(jnp.asarray(planes), jnp.asarray(filt),
+                                   jnp.asarray(pred_masks), depth,
+                                   interpret=interpret)
+    return _bsi_compare_jnp(planes, filt, upred, depth)
+
+
+def _bsi_compare_jnp(planes, filt, upred: int, depth: int):
+    """Fallback via the canonical jitted ripple (bsi.compare takes the
+    predicate as traced uint32 limbs — no per-predicate recompilation)."""
+    from pilosa_tpu.ops import bsi
+
+    planes = jnp.asarray(planes)
+    consider = planes[0] & ~planes[1] & jnp.asarray(filt)
+    lo, hi = bsi.split_predicate(upred)
+    lt, eq = bsi.compare(planes, consider, lo, hi)
+    return lt, consider & ~lt & ~eq

@@ -502,7 +502,11 @@ class Executor:
                 fw = self._bitmap_words_shard(idx, filter_call, shard)
                 if fw is None:
                     return {}
-                counts = bm.row_counts_masked(matrix, fw)
+                # Pallas single-pass kernel on TPU for large matrices,
+                # fused jnp otherwise (identical counts)
+                from pilosa_tpu.ops import pallas_kernels as pk
+
+                counts = pk.row_counts_masked(matrix, fw)
             else:
                 counts = bm.row_counts(matrix)
             counts = np.asarray(counts)

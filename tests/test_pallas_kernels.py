@@ -1,0 +1,132 @@
+"""Pallas kernel tests: interpret-mode runs on CPU diffed against the
+jnp reference implementations (the roaring/naive.go oracle pattern)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.ops import pallas_kernels as pk
+
+
+def _rand_words(rng, *shape):
+    return rng.integers(0, 1 << 32, size=shape, dtype=np.uint32)
+
+
+class TestRowCounts:
+    @pytest.mark.parametrize("rows,words", [(1, 64), (7, 100),
+                                            (128, 2048), (130, 2049),
+                                            (300, 4096)])
+    def test_matches_jnp(self, rows, words):
+        rng = np.random.default_rng(rows * 1000 + words)
+        mat = _rand_words(rng, rows, words)
+        filt = _rand_words(rng, words)
+        want = np.asarray(bm.row_counts_masked(mat, filt))
+        got = np.asarray(pk._row_counts_masked_pallas(mat, filt,
+                                                      interpret=True))
+        np.testing.assert_array_equal(got, want)
+
+    def test_zero_filter(self):
+        mat = _rand_words(np.random.default_rng(0), 8, 256)
+        filt = np.zeros(256, dtype=np.uint32)
+        got = np.asarray(pk._row_counts_masked_pallas(mat, filt,
+                                                      interpret=True))
+        assert got.tolist() == [0] * 8
+
+    def test_dispatch_fallback_small(self):
+        # tiny inputs use the jnp path regardless of platform
+        mat = _rand_words(np.random.default_rng(1), 2, 8)
+        filt = _rand_words(np.random.default_rng(2), 8)
+        got = np.asarray(pk.row_counts_masked(mat, filt))
+        want = np.asarray(bm.row_counts_masked(mat, filt))
+        np.testing.assert_array_equal(got, want)
+
+
+class TestCountAnd:
+    @pytest.mark.parametrize("words", [64, 2048, 4096, 5000])
+    def test_matches_jnp(self, words):
+        rng = np.random.default_rng(words)
+        a, b = _rand_words(rng, words), _rand_words(rng, words)
+        want = int(bm.popcount_and(a, b))
+        got = int(pk._count_and_pallas(a, b, interpret=True))
+        assert got == want
+
+    def test_oracle_python_sets(self):
+        rng = np.random.default_rng(7)
+        pos_a = rng.choice(1 << 16, 500, replace=False)
+        pos_b = rng.choice(1 << 16, 500, replace=False)
+        a = bm.pack_positions(pos_a, 1 << 16)
+        b = bm.pack_positions(pos_b, 1 << 16)
+        want = len(set(pos_a) & set(pos_b))
+        assert int(pk._count_and_pallas(a, b, interpret=True)) == want
+
+
+class TestBsiCompare:
+    def _planes(self, values, depth, words):
+        """Build [2+depth, words] plane stack from {col: value>=0}."""
+        P = np.zeros((2 + depth, words * 32), dtype=bool)
+        for col, v in values.items():
+            P[0, col] = True
+            for i in range(depth):
+                if (v >> i) & 1:
+                    P[2 + i, col] = True
+        return np.packbits(P, axis=1, bitorder="little").view(
+            np.uint32).reshape(2 + depth, words)
+
+    @pytest.mark.parametrize("depth,pred", [(4, 5), (8, 100), (12, 2048)])
+    def test_matches_python_oracle(self, depth, pred):
+        rng = np.random.default_rng(depth)
+        words = 160
+        values = {int(c): int(rng.integers(0, 1 << depth))
+                  for c in rng.choice(words * 32, 300, replace=False)}
+        planes = self._planes(values, depth, words)
+        filt = np.full(words, 0xFFFFFFFF, dtype=np.uint32)
+        lt, gt = pk.bsi_compare_unsigned(planes, filt, pred, depth,
+                                         interpret=True)
+        lt_cols = set(np.asarray(bm.unpack_positions(np.asarray(lt))))
+        gt_cols = set(np.asarray(bm.unpack_positions(np.asarray(gt))))
+        assert lt_cols == {c for c, v in values.items() if v < pred}
+        assert gt_cols == {c for c, v in values.items() if v > pred}
+
+    def test_jnp_fallback_identical(self):
+        rng = np.random.default_rng(3)
+        depth, words = 6, 160
+        values = {int(c): int(rng.integers(0, 1 << depth))
+                  for c in rng.choice(words * 32, 100, replace=False)}
+        planes = self._planes(values, depth, words)
+        filt = np.full(words, 0xFFFFFFFF, dtype=np.uint32)
+        lt_p, gt_p = pk._bsi_compare_pallas(
+            planes, filt,
+            np.array([[0xFFFFFFFF if (9 >> i) & 1 else 0]
+                      for i in range(depth)], dtype=np.uint32),
+            depth, interpret=True)
+        lt_j, gt_j = pk._bsi_compare_jnp(planes, filt, 9, depth)
+        np.testing.assert_array_equal(np.asarray(lt_p), np.asarray(lt_j))
+        np.testing.assert_array_equal(np.asarray(gt_p), np.asarray(gt_j))
+
+    def test_out_of_range_predicate(self):
+        # predicate above 2^depth: everything considered is strictly lt
+        depth, words = 4, 160
+        values = {10: 3, 50: 15}
+        planes = self._planes(values, depth, words)
+        filt = np.full(words, 0xFFFFFFFF, dtype=np.uint32)
+        lt, gt = pk.bsi_compare_unsigned(planes, filt, 20, depth,
+                                         interpret=True)
+        lt_cols = set(np.asarray(bm.unpack_positions(np.asarray(lt))))
+        assert lt_cols == {10, 50}
+        assert int(np.asarray(gt).sum()) == 0
+
+    def test_filter_and_sign_respected(self):
+        depth, words = 4, 160
+        planes = self._planes({10: 3, 50: 12}, depth, words)
+        # column 50 marked negative via the sign plane
+        sign = np.zeros(words * 32, dtype=bool)
+        sign[50] = True
+        planes[1] = np.packbits(sign, bitorder="little").view(
+            np.uint32)[:words]
+        filt = np.full(words, 0xFFFFFFFF, dtype=np.uint32)
+        lt, _ = pk.bsi_compare_unsigned(planes, filt, 100, depth,
+                                        interpret=True)
+        cols = set(np.asarray(bm.unpack_positions(np.asarray(lt))))
+        assert cols == {10}  # negative column excluded from unsigned path
