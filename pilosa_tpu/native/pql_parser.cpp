@@ -1,0 +1,826 @@
+// Native PQL parser (libpql): recursive-descent, mirroring the Python
+// parser in pilosa_tpu/pql/parser.py token for token, which in turn
+// accepts the reference's PEG grammar (pql/pql.peg).  SURVEY.md §7
+// calls for a C++ parser exposed to both the server and clients so
+// query parsing stays off Python in the request hot path.
+//
+// Output: a JSON AST string the Python side converts into Query/Call
+// objects.  Numbers are emitted verbatim (arbitrary precision survives);
+// conditions are {"$cond":{"op":..,"value":..}}, nested calls used as
+// argument values are {"$call": <call>}.  Errors return
+// {"error": "...", "pos": N}.
+//
+// C ABI:   char* pql_parse(const char* src);   void pql_free(char*);
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct CallNode;
+
+// ---- arbitrary-precision decimal helpers (conditional-sugar bounds
+// must not saturate at 64 bits; the Python parser has bigints) ----
+
+std::string dec_strip(const std::string& s) {
+    // canonical integer text: strip leading zeros, normalize -0 -> 0
+    bool neg = !s.empty() && s[0] == '-';
+    size_t i = neg ? 1 : 0;
+    while (i + 1 < s.size() && s[i] == '0') i++;
+    std::string mag = s.substr(i);
+    if (mag == "0") return "0";
+    return neg ? "-" + mag : mag;
+}
+
+std::string mag_incr(std::string m) {
+    int carry = 1;
+    for (size_t i = m.size(); i-- > 0 && carry;) {
+        if (m[i] == '9') { m[i] = '0'; } else { m[i]++; carry = 0; }
+    }
+    if (carry) m.insert(m.begin(), '1');
+    return m;
+}
+
+std::string mag_decr(std::string m) {  // requires m > 0
+    for (size_t i = m.size(); i-- > 0;) {
+        if (m[i] == '0') { m[i] = '9'; } else { m[i]--; break; }
+    }
+    return dec_strip(m);
+}
+
+std::string int_incr(const std::string& s0) {
+    std::string s = dec_strip(s0);
+    if (s[0] == '-') {
+        std::string r = mag_decr(s.substr(1));
+        return r == "0" ? "0" : "-" + r;
+    }
+    return mag_incr(s);
+}
+
+std::string int_decr(const std::string& s0) {
+    std::string s = dec_strip(s0);
+    if (s[0] == '-') return "-" + mag_incr(s.substr(1));
+    if (s == "0") return "-1";
+    return mag_decr(s);
+}
+
+struct Value {
+    enum Kind { NUL, BOOL_T, BOOL_F, NUMBER, STRING, LIST, COND, CALLV } kind = NUL;
+    std::string text;                 // NUMBER: verbatim token; STRING: contents
+    std::vector<Value> list;          // LIST
+    std::string op;                   // COND
+    std::unique_ptr<Value> cond_val;  // COND
+    std::unique_ptr<CallNode> call;   // CALLV
+
+    Value() = default;
+    Value(Value&&) = default;
+    Value& operator=(Value&&) = default;
+};
+
+struct Arg {
+    std::string key;
+    Value val;
+};
+
+struct CallNode {
+    std::string name;
+    std::vector<Arg> args;            // insertion order preserved
+    std::vector<CallNode> children;
+
+    void set(const std::string& key, Value v) {
+        for (auto& a : args) {
+            if (a.key == key) { a.val = std::move(v); return; }
+        }
+        args.push_back(Arg{key, std::move(v)});
+    }
+};
+
+struct ParseErr {
+    std::string message;
+    size_t pos;
+};
+
+struct Parser {
+    const std::string& src;
+    size_t pos = 0;
+
+    explicit Parser(const std::string& s) : src(s) {}
+
+    [[noreturn]] void fail(const std::string& msg) { throw ParseErr{msg, pos}; }
+
+    char peek() const { return pos < src.size() ? src[pos] : '\0'; }
+    char at(size_t i) const { return i < src.size() ? src[i] : '\0'; }
+
+    void sp() {
+        while (pos < src.size() &&
+               (src[pos] == ' ' || src[pos] == '\t' || src[pos] == '\n'))
+            pos++;
+    }
+
+    bool literal(const char* text) {
+        size_t n = std::strlen(text);
+        if (src.compare(pos, n, text) == 0) { pos += n; return true; }
+        return false;
+    }
+
+    void expect(const char* text) {
+        if (!literal(text)) fail(std::string("expected '") + text + "'");
+    }
+
+    bool comma() {
+        size_t save = pos;
+        sp();
+        if (literal(",")) { sp(); return true; }
+        pos = save;
+        return false;
+    }
+
+    void open() { expect("("); sp(); }
+    void close() { sp(); expect(")"); sp(); }
+
+    // ------------------------------------------------------------- tokens
+
+    static bool is_alpha(char c) {
+        return (c >= 'A' && c <= 'Z') || (c >= 'a' && c <= 'z');
+    }
+    static bool is_digit(char c) { return c >= '0' && c <= '9'; }
+    static bool is_alnum(char c) { return is_alpha(c) || is_digit(c); }
+
+    // [A-Za-z][A-Za-z0-9]*
+    bool ident(std::string& out) {
+        if (!is_alpha(peek())) return false;
+        size_t start = pos;
+        pos++;
+        while (is_alnum(peek())) pos++;
+        out = src.substr(start, pos - start);
+        return true;
+    }
+
+    // [A-Za-z][A-Za-z0-9_-]*
+    bool field_token(std::string& out) {
+        if (!is_alpha(peek())) return false;
+        size_t start = pos;
+        pos++;
+        while (is_alnum(peek()) || peek() == '_' || peek() == '-') pos++;
+        out = src.substr(start, pos - start);
+        return true;
+    }
+
+    // [A-Za-z0-9:_-]+
+    bool bare_string(std::string& out) {
+        size_t start = pos;
+        while (is_alnum(peek()) || peek() == ':' || peek() == '_' ||
+               peek() == '-')
+            pos++;
+        if (pos == start) return false;
+        out = src.substr(start, pos - start);
+        return true;
+    }
+
+    // -?(\d+(\.\d*)?|\.\d+)  — verbatim text
+    bool number(std::string& out) {
+        size_t start = pos;
+        size_t p = pos;
+        if (at(p) == '-') p++;
+        size_t digits = p;
+        while (is_digit(at(p))) p++;
+        if (p > digits) {               // \d+(\.\d*)?
+            if (at(p) == '.') { p++; while (is_digit(at(p))) p++; }
+        } else if (at(p) == '.') {      // \.\d+
+            p++;
+            size_t frac = p;
+            while (is_digit(at(p))) p++;
+            if (p == frac) { pos = start; return false; }
+        } else {
+            pos = start;
+            return false;
+        }
+        out = src.substr(start, p - start);
+        pos = p;
+        return true;
+    }
+
+    bool uint_token(std::string& out) {
+        size_t start = pos;
+        while (is_digit(peek())) pos++;
+        if (pos == start) return false;
+        out = src.substr(start, pos - start);
+        return true;
+    }
+
+    bool int_token(std::string& out) {
+        size_t start = pos;
+        if (peek() == '-') pos++;
+        size_t digits = pos;
+        while (is_digit(peek())) pos++;
+        if (pos == digits) { pos = start; return false; }
+        out = src.substr(start, pos - start);
+        return true;
+    }
+
+    // \d{4}-[01]\d-[0-3]\dT\d\d:\d\d
+    bool timestamp_token(std::string& out) {
+        size_t p = pos;
+        auto d = [&](size_t i) { return is_digit(at(i)); };
+        if (!(d(p) && d(p + 1) && d(p + 2) && d(p + 3) && at(p + 4) == '-' &&
+              (at(p + 5) == '0' || at(p + 5) == '1') && d(p + 6) &&
+              at(p + 7) == '-' && at(p + 8) >= '0' && at(p + 8) <= '3' &&
+              d(p + 9) && at(p + 10) == 'T' && d(p + 11) && d(p + 12) &&
+              at(p + 13) == ':' && d(p + 14) && d(p + 15)))
+            return false;
+        out = src.substr(p, 16);
+        pos = p + 16;
+        return true;
+    }
+
+    // --------------------------------------------------------------- strings
+
+    bool quoted_string(std::string& out) {
+        char q = peek();
+        if (q != '\'' && q != '"') return false;
+        pos++;
+        out.clear();
+        while (true) {
+            char c = peek();
+            if (c == '\0') fail("unterminated string");
+            if (c == '\\' && pos + 1 < src.size() &&
+                (src[pos + 1] == q || src[pos + 1] == '\\')) {
+                out.push_back(src[pos + 1]);
+                pos += 2;
+                continue;
+            }
+            if (c == q) { pos++; return true; }
+            out.push_back(c);
+            pos++;
+        }
+    }
+
+    // bare or quoted timestamp
+    bool timestamp_fmt(std::string& out) {
+        size_t save = pos;
+        char q = peek();
+        if (q == '\'' || q == '"') {
+            pos++;
+            if (timestamp_token(out)) {
+                if (peek() == q) { pos++; return true; }
+            }
+            pos = save;
+            return false;
+        }
+        if (timestamp_token(out)) return true;
+        pos = save;
+        return false;
+    }
+
+    // ---------------------------------------------------------------- values
+
+    bool at_rbrack() {
+        size_t save = pos;
+        sp();
+        bool at_it = peek() == ']';
+        pos = save;
+        return at_it;
+    }
+
+    bool keyword_guard_ok() {
+        size_t save = pos;
+        sp();
+        bool ok = peek() == ',' || peek() == ')';
+        pos = save;
+        return ok;
+    }
+
+    Value value() {
+        if (literal("[")) {
+            sp();
+            Value v;
+            v.kind = Value::LIST;
+            if (!at_rbrack()) {
+                v.list.push_back(item());
+                while (comma()) v.list.push_back(item());
+            }
+            sp();
+            expect("]");
+            sp();
+            return v;
+        }
+        return item();
+    }
+
+    Value item() {
+        static const struct { const char* kw; Value::Kind kind; } kws[] = {
+            {"null", Value::NUL}, {"true", Value::BOOL_T},
+            {"false", Value::BOOL_F}};
+        for (auto& k : kws) {
+            size_t save = pos;
+            if (literal(k.kw)) {
+                if (keyword_guard_ok()) {
+                    Value v;
+                    v.kind = k.kind;
+                    return v;
+                }
+                pos = save;
+            }
+        }
+        {
+            std::string ts;
+            if (timestamp_fmt(ts)) {
+                Value v;
+                v.kind = Value::STRING;
+                v.text = std::move(ts);
+                return v;
+            }
+        }
+        {
+            size_t save = pos;
+            std::string num;
+            if (number(num)) {
+                char c = peek();
+                if (!(is_alnum(c) || c == '_' || c == ':' || c == '-')) {
+                    Value v;
+                    v.kind = Value::NUMBER;
+                    v.text = std::move(num);
+                    return v;
+                }
+                pos = save;
+            }
+        }
+        {
+            size_t save = pos;
+            std::string id;
+            if (ident(id)) {
+                sp();
+                if (peek() == '(') {
+                    pos = save;
+                    Value v;
+                    v.kind = Value::CALLV;
+                    v.call = std::make_unique<CallNode>(call());
+                    return v;
+                }
+                pos = save;
+            }
+        }
+        {
+            std::string bare;
+            if (bare_string(bare)) {
+                Value v;
+                v.kind = Value::STRING;
+                v.text = std::move(bare);
+                return v;
+            }
+        }
+        {
+            std::string s;
+            if (quoted_string(s)) {
+                Value v;
+                v.kind = Value::STRING;
+                v.text = std::move(s);
+                return v;
+            }
+        }
+        fail("expected value");
+    }
+
+    // ------------------------------------------------------------------ args
+
+    std::string field_name() {
+        std::string name;
+        if (field_token(name)) return name;
+        static const char* reserved[] = {"_row", "_col", "_start", "_end",
+                                         "_timestamp", "_field"};
+        for (auto* r : reserved)
+            if (literal(r)) return r;
+        fail("expected field name");
+    }
+
+    bool cond_op(std::string& out) {
+        static const char* ops[] = {"><", "<=", ">=", "==", "!=", "<", ">"};
+        for (auto* op : ops)
+            if (literal(op)) { out = op; return true; }
+        return false;
+    }
+
+    void arg_into(CallNode& call_node) {
+        // conditional sugar: int <[=] field <[=] int
+        if (is_digit(peek()) ||
+            (peek() == '-' && is_digit(at(pos + 1)))) {
+            std::string low_s;
+            if (!int_token(low_s)) fail("expected integer");
+            sp();
+            bool op1_le = literal("<=");
+            bool op1_lt = !op1_le && literal("<");
+            if (!op1_le && !op1_lt) fail("expected < or <= in conditional");
+            sp();
+            std::string field = field_name();
+            sp();
+            bool op2_le = literal("<=");
+            bool op2_lt = !op2_le && literal("<");
+            if (!op2_le && !op2_lt) fail("expected < or <= in conditional");
+            sp();
+            std::string high_s;
+            if (!int_token(high_s)) fail("expected integer");
+            // strict bounds tighten by one (pql/ast.go:89-95) — in
+            // decimal string space so >64-bit bounds survive exactly
+            std::string low = op1_lt ? int_incr(low_s) : dec_strip(low_s);
+            std::string high = op2_lt ? int_decr(high_s) : dec_strip(high_s);
+            Value cond;
+            cond.kind = Value::COND;
+            cond.op = "><";
+            cond.cond_val = std::make_unique<Value>();
+            cond.cond_val->kind = Value::LIST;
+            Value lo_v; lo_v.kind = Value::NUMBER; lo_v.text = low;
+            Value hi_v; hi_v.kind = Value::NUMBER; hi_v.text = high;
+            cond.cond_val->list.push_back(std::move(lo_v));
+            cond.cond_val->list.push_back(std::move(hi_v));
+            call_node.set(field, std::move(cond));
+            return;
+        }
+        std::string field = field_name();
+        sp();
+        std::string op;
+        if (cond_op(op)) {
+            sp();
+            Value cond;
+            cond.kind = Value::COND;
+            cond.op = op;
+            cond.cond_val = std::make_unique<Value>(value());
+            call_node.set(field, std::move(cond));
+            return;
+        }
+        if (literal("=")) {
+            sp();
+            call_node.set(field, value());
+            return;
+        }
+        fail("expected = or condition operator after '" + field + "'");
+    }
+
+    void args_into(CallNode& call_node) {
+        arg_into(call_node);
+        while (true) {
+            size_t save = pos;
+            if (!comma()) return;
+            try {
+                arg_into(call_node);
+            } catch (const ParseErr&) {
+                pos = save;
+                return;
+            }
+        }
+    }
+
+    // ----------------------------------------------------------------- calls
+
+    void pos_uint_or_str(const char* key, CallNode& call_node) {
+        std::string num;
+        if (uint_token(num)) {
+            Value v;
+            v.kind = Value::NUMBER;
+            v.text = std::move(num);
+            call_node.set(key, std::move(v));
+            return;
+        }
+        std::string s;
+        if (quoted_string(s)) {
+            Value v;
+            v.kind = Value::STRING;
+            v.text = std::move(s);
+            call_node.set(key, std::move(v));
+            return;
+        }
+        fail(std::string("expected integer or quoted key for ") + key);
+    }
+
+    CallNode call() {
+        std::string name;
+        if (!ident(name)) fail("expected call name");
+        sp();
+        size_t save = pos;
+        try {
+            if (name == "Set") return call_Set();
+            if (name == "SetRowAttrs") return call_SetRowAttrs();
+            if (name == "SetColumnAttrs") return call_SetColumnAttrs();
+            if (name == "Clear") return call_Clear();
+            if (name == "ClearRow") return call_ClearRow();
+            if (name == "Store") return call_Store();
+            if (name == "TopN") return posfield_call("TopN");
+            if (name == "Rows") return posfield_call("Rows");
+            if (name == "Range") return call_Range();
+        } catch (const ParseErr&) {
+            // PEG ordered choice: special form fails -> generic rule
+            pos = save;
+        }
+        return generic_call(name);
+    }
+
+    CallNode generic_call(const std::string& name) {
+        CallNode c;
+        c.name = name;
+        open();
+        allargs_into(c);
+        comma();  // tolerate trailing comma
+        close();
+        return c;
+    }
+
+    CallNode call_Set() {
+        CallNode c;
+        c.name = "Set";
+        open();
+        pos_uint_or_str("_col", c);
+        if (!comma()) fail("expected ,");
+        args_into(c);
+        size_t save = pos;
+        if (comma()) {
+            std::string ts;
+            if (timestamp_fmt(ts)) {
+                Value v;
+                v.kind = Value::STRING;
+                v.text = std::move(ts);
+                c.set("_timestamp", std::move(v));
+            } else {
+                pos = save;
+            }
+        }
+        close();
+        return c;
+    }
+
+    CallNode call_SetRowAttrs() {
+        CallNode c;
+        c.name = "SetRowAttrs";
+        open();
+        {
+            Value v;
+            v.kind = Value::STRING;
+            v.text = field_name();
+            c.set("_field", std::move(v));
+        }
+        if (!comma()) fail("expected ,");
+        pos_uint_or_str("_row", c);
+        if (!comma()) fail("expected ,");
+        args_into(c);
+        close();
+        return c;
+    }
+
+    CallNode call_SetColumnAttrs() {
+        CallNode c;
+        c.name = "SetColumnAttrs";
+        open();
+        pos_uint_or_str("_col", c);
+        if (!comma()) fail("expected ,");
+        args_into(c);
+        close();
+        return c;
+    }
+
+    CallNode call_Clear() {
+        CallNode c;
+        c.name = "Clear";
+        open();
+        pos_uint_or_str("_col", c);
+        if (!comma()) fail("expected ,");
+        args_into(c);
+        close();
+        return c;
+    }
+
+    CallNode call_ClearRow() {
+        CallNode c;
+        c.name = "ClearRow";
+        open();
+        arg_into(c);
+        close();
+        return c;
+    }
+
+    CallNode call_Store() {
+        CallNode c;
+        c.name = "Store";
+        open();
+        c.children.push_back(call());
+        if (!comma()) fail("expected ,");
+        arg_into(c);
+        close();
+        return c;
+    }
+
+    CallNode posfield_call(const char* name) {
+        CallNode c;
+        c.name = name;
+        open();
+        std::string fe;
+        if (!field_token(fe)) fail("expected field name");
+        {
+            Value v;
+            v.kind = Value::STRING;
+            v.text = std::move(fe);
+            c.set("_field", std::move(v));
+        }
+        if (comma()) allargs_into(c);
+        close();
+        return c;
+    }
+
+    CallNode call_Range() {
+        CallNode c;
+        c.name = "Range";
+        open();
+        std::string field = field_name();
+        sp();
+        expect("=");
+        sp();
+        c.set(field, value());
+        if (!comma()) fail("expected ,");
+        literal("from=");
+        std::string ts;
+        if (!timestamp_fmt(ts)) fail("expected timestamp");
+        {
+            Value v;
+            v.kind = Value::STRING;
+            v.text = std::move(ts);
+            c.set("from", std::move(v));
+        }
+        if (!comma()) fail("expected ,");
+        literal("to=");
+        sp();
+        std::string ts2;
+        if (!timestamp_fmt(ts2)) fail("expected timestamp");
+        {
+            Value v;
+            v.kind = Value::STRING;
+            v.text = std::move(ts2);
+            c.set("to", std::move(v));
+        }
+        close();
+        return c;
+    }
+
+    void allargs_into(CallNode& c) {
+        while (true) {
+            size_t save = pos;
+            std::string id;
+            if (ident(id)) {
+                sp();
+                if (peek() == '(') {
+                    pos = save;
+                    c.children.push_back(call());
+                    if (comma()) continue;
+                    return;
+                }
+            }
+            pos = save;
+            break;
+        }
+        size_t save = pos;
+        sp();
+        if (peek() != ')') {
+            pos = save;
+            args_into(c);
+        }
+    }
+
+    std::vector<CallNode> parse() {
+        std::vector<CallNode> calls;
+        sp();
+        while (pos < src.size()) {
+            calls.push_back(call());
+            sp();
+        }
+        return calls;
+    }
+};
+
+// ------------------------------------------------------------- JSON output
+
+void json_escape(const std::string& s, std::string& out) {
+    out.push_back('"');
+    for (unsigned char c : s) {
+        switch (c) {
+            case '"': out += "\\\""; break;
+            case '\\': out += "\\\\"; break;
+            case '\n': out += "\\n"; break;
+            case '\t': out += "\\t"; break;
+            case '\r': out += "\\r"; break;
+            default:
+                if (c < 0x20) {
+                    char buf[8];
+                    std::snprintf(buf, sizeof buf, "\\u%04x", c);
+                    out += buf;
+                } else {
+                    out.push_back(static_cast<char>(c));
+                }
+        }
+    }
+    out.push_back('"');
+}
+
+void emit_call(const CallNode& c, std::string& out);
+
+void emit_value(const Value& v, std::string& out) {
+    switch (v.kind) {
+        case Value::NUL: out += "null"; break;
+        case Value::BOOL_T: out += "true"; break;
+        case Value::BOOL_F: out += "false"; break;
+        case Value::NUMBER: {
+            // normalize to valid JSON: PQL allows ".5", "1.", and
+            // leading zeros ("007"), none of which JSON accepts
+            std::string t = v.text;
+            bool neg = t[0] == '-';
+            std::string body = neg ? t.substr(1) : t;
+            size_t dot = body.find('.');
+            std::string ip = dot == std::string::npos ? body : body.substr(0, dot);
+            std::string fp = dot == std::string::npos ? "" : body.substr(dot + 1);
+            size_t i = 0;
+            while (i + 1 < ip.size() && ip[i] == '0') i++;
+            ip = ip.empty() ? "0" : ip.substr(i);
+            if (ip.empty()) ip = "0";
+            std::string norm = (neg ? "-" : "") + ip;
+            if (dot != std::string::npos)
+                norm += "." + (fp.empty() ? "0" : fp);
+            out += norm;
+            break;
+        }
+        case Value::STRING: json_escape(v.text, out); break;
+        case Value::LIST: {
+            out.push_back('[');
+            for (size_t i = 0; i < v.list.size(); i++) {
+                if (i) out.push_back(',');
+                emit_value(v.list[i], out);
+            }
+            out.push_back(']');
+            break;
+        }
+        case Value::COND: {
+            out += "{\"$cond\":{\"op\":";
+            json_escape(v.op, out);
+            out += ",\"value\":";
+            emit_value(*v.cond_val, out);
+            out += "}}";
+            break;
+        }
+        case Value::CALLV: {
+            out += "{\"$call\":";
+            emit_call(*v.call, out);
+            out.push_back('}');
+            break;
+        }
+    }
+}
+
+void emit_call(const CallNode& c, std::string& out) {
+    out += "{\"name\":";
+    json_escape(c.name, out);
+    out += ",\"args\":{";
+    for (size_t i = 0; i < c.args.size(); i++) {
+        if (i) out.push_back(',');
+        json_escape(c.args[i].key, out);
+        out.push_back(':');
+        emit_value(c.args[i].val, out);
+    }
+    out += "},\"children\":[";
+    for (size_t i = 0; i < c.children.size(); i++) {
+        if (i) out.push_back(',');
+        emit_call(c.children[i], out);
+    }
+    out += "]}";
+}
+
+}  // namespace
+
+extern "C" {
+
+char* pql_parse(const char* src_c) {
+    std::string src(src_c ? src_c : "");
+    std::string out;
+    try {
+        Parser p(src);
+        std::vector<CallNode> calls = p.parse();
+        out += "{\"calls\":[";
+        for (size_t i = 0; i < calls.size(); i++) {
+            if (i) out.push_back(',');
+            emit_call(calls[i], out);
+        }
+        out += "]}";
+    } catch (const ParseErr& e) {
+        out = "{\"error\":";
+        json_escape(e.message, out);
+        out += ",\"pos\":" + std::to_string(e.pos) + "}";
+    } catch (const std::exception& e) {
+        out = "{\"error\":";
+        json_escape(std::string("internal: ") + e.what(), out);
+        out += ",\"pos\":0}";
+    }
+    char* buf = static_cast<char*>(std::malloc(out.size() + 1));
+    std::memcpy(buf, out.c_str(), out.size() + 1);
+    return buf;
+}
+
+void pql_free(char* p) { std::free(p); }
+
+}  // extern "C"

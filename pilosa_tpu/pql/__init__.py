@@ -5,7 +5,33 @@ pql/pql.peg; AST: pql/ast.go), implemented as a hand-written
 recursive-descent parser instead of a generated packrat PEG parser.
 """
 
-from pilosa_tpu.pql.ast import Call, Condition, Query, WRITE_CALLS
-from pilosa_tpu.pql.parser import parse, ParseError
+import os as _os
 
-__all__ = ["Call", "Condition", "Query", "WRITE_CALLS", "parse", "ParseError"]
+from pilosa_tpu.pql.ast import Call, Condition, Query, WRITE_CALLS
+from pilosa_tpu.pql.parser import parse as parse_python, ParseError
+
+# The C++ parser (libpql) is preferred when its toolchain is available;
+# PILOSA_TPU_NATIVE_PQL=0 forces the Python parser.  Both accept the
+# identical language (differential-tested in tests/test_pql_native.py).
+_USE_NATIVE = _os.environ.get("PILOSA_TPU_NATIVE_PQL", "1") != "0"
+
+
+def parse(src: str) -> Query:
+    """Parse a PQL string into a Query (reference pql.ParseString)."""
+    if _USE_NATIVE:
+        from pilosa_tpu.pql import native
+
+        if native.available():
+            return native.parse_native(src)
+    return parse_python(src)
+
+
+__all__ = [
+    "Call",
+    "Condition",
+    "Query",
+    "WRITE_CALLS",
+    "parse",
+    "parse_python",
+    "ParseError",
+]

@@ -1,0 +1,81 @@
+"""ctypes binding for the native C++ PQL parser (libpql).
+
+SURVEY.md §7 native component 3: a C++ parser shared by the server and
+clients so parsing stays off Python in the query hot path.  The .so is
+built lazily from pilosa_tpu/native/pql_parser.cpp with g++ (same
+pattern as the roaring codec); when the toolchain is unavailable the
+Python parser in pilosa_tpu.pql.parser serves as the fallback — both
+accept the identical language and are differential-tested against each
+other (tests/test_pql_native.py)."""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+
+from pilosa_tpu.native_loader import NativeLib
+from pilosa_tpu.pql.ast import Call, Condition, Query
+from pilosa_tpu.pql.parser import ParseError
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+
+
+def _setup(lib) -> None:
+    lib.pql_parse.argtypes = [ctypes.c_char_p]
+    lib.pql_parse.restype = ctypes.c_void_p
+    lib.pql_free.argtypes = [ctypes.c_void_p]
+    lib.pql_free.restype = None
+
+
+_NATIVE = NativeLib(
+    src=os.path.join(_NATIVE_DIR, "pql_parser.cpp"),
+    so=os.path.join(_NATIVE_DIR, "build", "libpql.so"),
+    setup=_setup,
+)
+
+
+def available() -> bool:
+    return _NATIVE.available()
+
+
+def _load():
+    return _NATIVE.load()
+
+
+def parse_native(src: str) -> Query:
+    """Parse via libpql; raises ParseError on syntax errors and
+    RuntimeError when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native PQL parser unavailable")
+    ptr = lib.pql_parse(src.encode())
+    try:
+        raw = ctypes.string_at(ptr).decode()
+    finally:
+        lib.pql_free(ptr)
+    d = json.loads(raw)
+    if "error" in d:
+        raise ParseError(d["error"], src, d.get("pos", 0))
+    return Query([_call_from_json(c) for c in d["calls"]])
+
+
+def _call_from_json(d: dict) -> Call:
+    return Call(
+        d["name"],
+        {k: _value_from_json(v) for k, v in d["args"].items()},
+        [_call_from_json(c) for c in d["children"]],
+    )
+
+
+def _value_from_json(v):
+    if isinstance(v, dict):
+        if "$cond" in v:
+            c = v["$cond"]
+            return Condition(c["op"], _value_from_json(c["value"]))
+        if "$call" in v:
+            return _call_from_json(v["$call"])
+    if isinstance(v, list):
+        return [_value_from_json(x) for x in v]
+    return v

@@ -15,16 +15,28 @@ import re
 
 from pilosa_tpu.pql.ast import Call, Condition, Query
 
-_TIMESTAMP_RE = re.compile(r"\d{4}-[01]\d-[0-3]\dT\d\d:\d\d")
+# re.ASCII everywhere: the reference grammar is ASCII [0-9] (pql.peg);
+# without it Python's \d admits Unicode digits the native parser
+# (and the reference) reject.
+_TIMESTAMP_RE = re.compile(r"\d{4}-[01]\d-[0-3]\dT\d\d:\d\d", re.ASCII)
 _IDENT_RE = re.compile(r"[A-Za-z][A-Za-z0-9]*")
 _FIELD_RE = re.compile(r"[A-Za-z][A-Za-z0-9_-]*")
-_BARE_STR_RE = re.compile(r"[A-Za-z0-9:_-]+")
-_NUMBER_RE = re.compile(r"-?(?:\d+(?:\.\d*)?|\.\d+)")
-_UINT_RE = re.compile(r"\d+")
-_INT_RE = re.compile(r"-?\d+")
+_BARE_STR_RE = re.compile(r"[A-Za-z0-9:_-]+", re.ASCII)
+_NUMBER_RE = re.compile(r"-?(?:\d+(?:\.\d*)?|\.\d+)", re.ASCII)
+_UINT_RE = re.compile(r"\d+", re.ASCII)
+_INT_RE = re.compile(r"-?\d+", re.ASCII)
 
 # Reserved positional argument keys (pql.peg `reserved`).
 RESERVED = {"_row", "_col", "_start", "_end", "_timestamp", "_field"}
+
+
+def _is_ascii_digit(c: str) -> bool:
+    return len(c) == 1 and "0" <= c <= "9"
+
+
+def _is_ascii_alnum(c: str) -> bool:
+    return len(c) == 1 and (
+        ("a" <= c <= "z") or ("A" <= c <= "Z") or ("0" <= c <= "9"))
 
 
 class ParseError(ValueError):
@@ -172,7 +184,7 @@ class _Parser:
         save = self.pos
         num = self.match(_NUMBER_RE)
         if num is not None:
-            if not (self.peek().isalnum() or self.peek() in "_:-"):
+            if not (_is_ascii_alnum(self.peek()) or self.peek() in "_:-"):
                 if "." in num:
                     return float(num)
                 return int(num)
@@ -213,8 +225,9 @@ class _Parser:
 
     def arg_into(self, args: dict) -> None:
         # conditional sugar: int <[=] field <[=] int
-        if self.peek().isdigit() or (
-            self.peek() == "-" and self.pos + 1 < len(self.src) and self.src[self.pos + 1].isdigit()
+        if _is_ascii_digit(self.peek()) or (
+            self.peek() == "-" and self.pos + 1 < len(self.src)
+            and _is_ascii_digit(self.src[self.pos + 1])
         ):
             low = int(self.match(_INT_RE))
             self.sp()

@@ -18,10 +18,10 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 
 import numpy as np
+
+from pilosa_tpu.native_loader import NativeLib
 
 WORDS_PER_CONTAINER = 1024
 CONTAINER_BITS = 1 << 16
@@ -31,71 +31,38 @@ _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__fil
 _SRC = os.path.join(_NATIVE_DIR, "roaring_codec.cpp")
 _SO = os.path.join(_NATIVE_DIR, "build", "libpilosa_native.so")
 
-_lib = None
-_lib_lock = threading.Lock()
-_build_failed = False
+
+def _setup(lib) -> None:
+    lib.pilosa_roaring_decode.restype = ctypes.c_int
+    lib.pilosa_roaring_decode.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
+    lib.pilosa_roaring_encode.restype = ctypes.c_int
+    lib.pilosa_roaring_encode.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_uint64,
+        ctypes.c_uint8,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+        ctypes.POINTER(ctypes.c_uint64),
+    ]
+    lib.pilosa_roaring_free_buf.argtypes = [ctypes.c_void_p]
 
 
-def _build_so(force: bool = False) -> None:
-    if not force and os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return
-    os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    # per-process tmp name: concurrent cold builds must not
-    # write the same file and publish a torn .so
-    tmp = f"{_SO}.tmp.{os.getpid()}"
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
-            check=True,
-            capture_output=True,
-        )
-        os.replace(tmp, _SO)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+_NATIVE = NativeLib(src=_SRC, so=_SO, setup=_setup)
 
 
 def _load_native():
-    global _lib, _build_failed
-    with _lib_lock:
-        if _lib is not None or _build_failed:
-            return _lib
-        try:
-            _build_so()
-            try:
-                lib = ctypes.CDLL(_SO)
-            except OSError:
-                # a stale or foreign-ABI binary on disk: rebuild and retry once
-                _build_so(force=True)
-                lib = ctypes.CDLL(_SO)
-            lib.pilosa_roaring_decode.restype = ctypes.c_int
-            lib.pilosa_roaring_decode.argtypes = [
-                ctypes.c_char_p,
-                ctypes.c_uint64,
-                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
-                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.POINTER(ctypes.c_uint8),
-            ]
-            lib.pilosa_roaring_encode.restype = ctypes.c_int
-            lib.pilosa_roaring_encode.argtypes = [
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.POINTER(ctypes.c_uint64),
-                ctypes.c_uint64,
-                ctypes.c_uint8,
-                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
-                ctypes.POINTER(ctypes.c_uint64),
-            ]
-            lib.pilosa_roaring_free_buf.argtypes = [ctypes.c_void_p]
-            _lib = lib
-        except Exception:
-            _build_failed = True
-            _lib = None
-        return _lib
+    return _NATIVE.load()
 
 
 def native_available() -> bool:
-    return _load_native() is not None
+    return _NATIVE.available()
 
 
 _ERRORS = {

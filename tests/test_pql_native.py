@@ -1,0 +1,148 @@
+"""Differential tests: the native C++ PQL parser (libpql) must produce
+ASTs identical to the Python parser for the same corpus, and reject the
+same invalid inputs (the roaring/naive.go oracle pattern applied to the
+parser; reference grammar pql/pql.peg)."""
+
+from __future__ import annotations
+
+import pytest
+
+from pilosa_tpu.pql import parse_python
+from pilosa_tpu.pql.native import available, parse_native
+from pilosa_tpu.pql.parser import ParseError
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native toolchain unavailable")
+
+CORPUS = [
+    # basic reads
+    "Row(f=10)",
+    "Row(stargazer=1)Row(stargazer=2)",
+    "Count(Row(f=10))",
+    "Intersect(Row(f=1), Row(g=2))",
+    "Union(Row(f=1), Row(f=2), Row(f=3))",
+    "Difference(Row(f=1), Row(g=2))",
+    "Xor(Row(f=1), Row(g=2))",
+    "Not(Row(f=1))",
+    "Shift(Row(f=1), n=2)",
+    # writes
+    "Set(1, f=10)",
+    "Set(1, f=10, 2020-01-01T00:00)",
+    'Set("alice", f="likes")',
+    "Clear(1, f=10)",
+    "ClearRow(f=10)",
+    "Store(Row(f=10), g=20)",
+    # attrs
+    'SetRowAttrs(f, 10, color="red", weight=3)',
+    'SetColumnAttrs(99, active=true, note=null)',
+    # BSI conditions
+    "Row(v > 10)",
+    "Row(v >= -5)",
+    "Row(v == 100)",
+    "Row(v != 0)",
+    "Row(v >< [10, 20])",
+    "Row(-10 < v < 20)",
+    "Row(0 <= v <= 100)",
+    "Sum(field=v)",
+    "Sum(Row(f=1), field=v)",
+    "Min(field=v)",
+    "Max(Row(f=1), field=v)",
+    "MinRow(field=f)",
+    "MaxRow(field=f)",
+    # TopN / Rows / GroupBy
+    "TopN(f, n=5)",
+    "TopN(f, Row(g=1), n=5)",
+    "TopN(f)",
+    "Rows(f)",
+    "Rows(f, limit=10, previous=3)",
+    'Rows(f, column="c1")',
+    "GroupBy(Rows(f), Rows(g), limit=10)",
+    "GroupBy(Rows(f), filter=Row(g=1))",
+    # time ranges
+    "Row(t=3, from='2020-01-01T00:00', to='2020-02-01T00:00')",
+    "Range(t=3, 2020-01-01T00:00, 2020-02-01T00:00)",
+    # options / misc
+    "Options(Row(f=1), excludeColumns=true)",
+    "Count(Intersect(Row(f=1), Row(g=2)))",
+    "Row(f=10, from='2018-01-01T00:00')",
+    # values & quoting
+    'Set(1, f="with space \\" quote")',
+    "Set(1, f='single')",
+    "Rows(f, in=[1, 2, 3])",
+    "Rows(f, in=[\"a\", 'b', c])",
+    "Equals(f=1.5)",
+    "Equals(f=-2.25)",
+    "Equals(f=.5)",
+    "Equals(f=null, g=true, h=false)",
+    "Equals(f=bare:string-x_1)",
+    # nested call as arg value (String() round-trip forms)
+    'TopN(_field="f", n=3)',
+    "Nested(Row(f=1), Row(g=2), h=3)",
+    # whitespace robustness
+    "  Count(  Row( f = 10 ) )  ",
+    "Union(\n  Row(f=1),\n\tRow(f=2)\n)",
+    # empty-arg calls
+    "All()",
+    # huge integers survive verbatim
+    "Set(18446744073709551615, f=1)",
+]
+
+BAD = [
+    "Row(",
+    "Row)",
+    "Set(1 f=10)",
+    "Row(f=)",
+    "Row(= 10)",
+    "Set('unterminated, f=1)",
+    "123",
+    "Row(f ?? 10)",
+]
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("src", CORPUS)
+    def test_ast_identical(self, src):
+        py = parse_python(src)
+        nat = parse_native(src)
+        assert nat.calls == py.calls, (
+            f"\nnative: {nat.calls!r}\npython: {py.calls!r}")
+
+    @pytest.mark.parametrize("src", BAD)
+    def test_both_reject(self, src):
+        with pytest.raises(ParseError):
+            parse_python(src)
+        with pytest.raises(ParseError):
+            parse_native(src)
+
+    def test_roundtrip_through_string(self):
+        # String()-serialized calls re-parse identically on both parsers
+        for src in CORPUS:
+            py = parse_python(src)
+            s = str(py)
+            assert parse_native(s).calls == parse_python(s).calls
+
+    def test_number_types_preserved(self):
+        q = parse_native("Equals(a=1, b=1.5, c=-2, d=.5)")
+        args = q.calls[0].args
+        assert isinstance(args["a"], int)
+        assert isinstance(args["b"], float)
+        assert args["c"] == -2
+        assert args["d"] == 0.5
+
+    def test_dispatcher_uses_native(self, monkeypatch):
+        import pilosa_tpu.pql as pql
+
+        called = {}
+        import pilosa_tpu.pql.native as nat_mod
+
+        orig = nat_mod.parse_native
+
+        def spy(src):
+            called["hit"] = True
+            return orig(src)
+
+        monkeypatch.setattr(nat_mod, "parse_native", spy)
+        monkeypatch.setattr(pql, "_USE_NATIVE", True)
+        q = pql.parse("Count(Row(f=1))")
+        assert called.get("hit")
+        assert q.calls[0].name == "Count"
