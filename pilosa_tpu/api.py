@@ -176,10 +176,10 @@ class API:
         idx = self.index(index)
         f = self.field(index, field)
         if col_keys:
-            cols = idx.translate_store.translate_keys(col_keys, create=True)
+            cols = self._translate_keys(index, None, col_keys)
         if row_keys:
             cols_n = len(cols)
-            rows = f.translate_store.translate_keys(row_keys, create=True)
+            rows = self._translate_keys(index, field, row_keys)
             if len(rows) != cols_n:
                 raise ApiError("row keys and columns length mismatch")
         rows, cols = list(rows), list(cols)
@@ -217,7 +217,7 @@ class API:
         idx = self.index(index)
         f = self.field(index, field)
         if col_keys:
-            cols = idx.translate_store.translate_keys(col_keys, create=True)
+            cols = self._translate_keys(index, None, col_keys)
         cols, values = list(cols), list(values)
         if remote or not self._clustered():
             f.import_values(cols, values)
@@ -238,6 +238,13 @@ class API:
             )
             self._note_shard_everywhere(f, index, field, shard,
                                         known=shard in known_shards)
+
+    def _translate_keys(self, index: str, field: str | None, keys):
+        """Key creation with single-writer routing (api.go:920 import
+        key translation; holder.go:690 primary-only writes).  All
+        routing lives in node.translate_keys_cluster."""
+        return self.node.translate_keys_cluster(index, field, keys,
+                                                create=True)
 
     def _clustered(self) -> bool:
         return (self.cluster.transport is not None

@@ -133,6 +133,7 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         coordinator=cfg.cluster.coordinator,
         anti_entropy_interval=cfg.anti_entropy.interval,
         heartbeat_interval=cfg.heartbeat_interval,
+        metric_poll_interval=cfg.metric.poll_interval,
         long_query_time=cfg.cluster.long_query_time,
         max_writes_per_request=cfg.max_writes_per_request,
         logger=log,

@@ -40,6 +40,7 @@ class MetricConfig:
     """[metric] (server/config.go:125-133)."""
 
     service: str = "mem"  # mem | nop
+    poll_interval: float = 0.0  # runtime gauge sweep seconds; 0 = off
     diagnostics: bool = False  # no phone-home by default
 
 
@@ -153,6 +154,7 @@ class Config:
             "",
             "[metric]",
             f'service = "{self.metric.service}"',
+            f"poll-interval = {self.metric.poll_interval}",
             f"diagnostics = {str(self.metric.diagnostics).lower()}",
             "",
             "[tracing]",

@@ -1,0 +1,100 @@
+"""Diagnostics + runtime monitoring.
+
+Parity target: the reference's diagnostics collector (diagnostics.go:42-263
+— version, schema shape, host info) and Server.monitorRuntime
+(server.go:813-876 — goroutine/heap/FD gauges every metricInterval).
+Deviation, by design: the reference phones home to diagnostics.pilosa.com
+hourly; this build never sends anything anywhere — the same payload is
+served locally at GET /diagnostics instead (this environment has zero
+egress, and phone-home is an anti-feature for an embedded framework)."""
+
+from __future__ import annotations
+
+import gc
+import os
+import platform
+import threading
+import time
+
+from pilosa_tpu.version import VERSION
+
+
+def payload(node) -> dict:
+    """The diagnostics document (diagnostics.go CheckVersion/Flush set)."""
+    holder = node.holder
+    n_fields = 0
+    n_indexes = 0
+    field_types: dict[str, int] = {}
+    for d in holder.schema():
+        n_indexes += 1
+        for f in d.get("fields", []):
+            n_fields += 1
+            t = f.get("options", {}).get("type", "set")
+            field_types[t] = field_types.get(t, 0) + 1
+    return {
+        "version": VERSION,
+        "numIndexes": n_indexes,
+        "numFields": n_fields,
+        "fieldTypes": field_types,
+        "numNodes": len(node.cluster.sorted_nodes()),
+        "clusterState": node.cluster.state,
+        "os": platform.system(),
+        "arch": platform.machine(),
+        "pythonVersion": platform.python_version(),
+        "uptime": time.time() - _START_TIME,
+    }
+
+
+_START_TIME = time.time()
+
+
+def runtime_gauges(stats) -> None:
+    """One sweep of process gauges (server.go:813 monitorRuntime:
+    goroutines -> threads, heap -> RSS, open FDs, GC collections)."""
+    stats.gauge("threads", threading.active_count())
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        stats.gauge("memory.rss_bytes", rss_pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        stats.gauge("open_files", len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass
+    counts = gc.get_count()
+    for i, c in enumerate(counts):
+        stats.gauge(f"gc.gen{i}_count", c)
+    totals = gc.get_stats()
+    if totals:
+        stats.gauge("gc.collections",
+                    sum(s.get("collections", 0) for s in totals))
+
+
+class RuntimeMonitor:
+    """Background gauge loop (the reference's monitorRuntime goroutine +
+    GCNotifier gauge, gc.go:21)."""
+
+    def __init__(self, stats, interval: float):
+        self.stats = stats
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self.interval <= 0:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                runtime_gauges(self.stats)
+            except Exception:
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -104,9 +104,15 @@ struct ParseErr {
     size_t pos;
 };
 
+// Maximum call-nesting depth: parsing is recursive, and untrusted query
+// strings must exhaust a counter, not the C stack (the Python parser
+// enforces the same limit for parity).
+constexpr int MAX_DEPTH = 128;
+
 struct Parser {
     const std::string& src;
     size_t pos = 0;
+    int depth = 0;
 
     explicit Parser(const std::string& s) : src(s) {}
 
@@ -495,7 +501,19 @@ struct Parser {
         fail(std::string("expected integer or quoted key for ") + key);
     }
 
+    struct DepthGuard {  // RAII: depth unwinds on backtracking throws too
+        int& d;
+        explicit DepthGuard(int& d_) : d(d_) { ++d; }
+        ~DepthGuard() { --d; }
+    };
+
     CallNode call() {
+        DepthGuard g(depth);
+        if (depth > MAX_DEPTH) fail("query too deeply nested");
+        return call_inner();
+    }
+
+    CallNode call_inner() {
         std::string name;
         if (!ident(name)) fail("expected call name");
         sp();

@@ -1044,11 +1044,24 @@ class Executor:
             raise ExecutionError(
                 f"index {idx.name!r} does not use string keys (option keys=true)"
             )
-        id = idx.translate_store.translate_key(v, create=create)
+        id = self._translate_one(idx, None, v, create)
         if id is None:
             return False
         call.args["_col"] = id
         return True
+
+    def _translate_one(self, idx, field: str | None, key: str, create: bool):
+        """Key -> id; creation is single-writer via the coordinator when
+        clustered (reference holder.go:690).  All routing decisions live
+        in node.translate_keys_cluster — the local path here only covers
+        a bare Executor with no cluster node (unit tests)."""
+        node = getattr(self, "node", None)
+        if node is not None:
+            return node.translate_keys_cluster(idx.name, field, [key],
+                                               create=create)[0]
+        store = (idx.translate_store if field is None
+                 else idx.field(field).translate_store)
+        return store.translate_key(key, create=create)
 
     def _translate_row_key(self, idx, call: Call, arg_key: str, create: bool) -> bool:
         """Translate a string row value held under args[arg_key], where
@@ -1063,7 +1076,7 @@ class Executor:
             raise ExecutionError(
                 f"field {arg_key!r} does not use string keys (option keys=true)"
             )
-        id = f.translate_store.translate_key(v, create=create)
+        id = self._translate_one(idx, arg_key, v, create)
         if id is None:
             return False
         call.args[arg_key] = id

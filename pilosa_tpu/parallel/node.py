@@ -241,10 +241,31 @@ class ClusterNode:
             self.cleanup_unowned()
         elif t == "ping":
             return {"ok": True, "state": self.cluster.state}
+        elif t == "translate-keys":
+            # single-writer key allocation: only the coordinator
+            # (primary) creates ids (reference holder.go:690: non-primary
+            # stores are read-only and tail the primary)
+            if not self.cluster.is_coordinator:
+                return self._forward_to_coordinator(msg)
+            store = self._translate_store(msg["index"], msg.get("field"))
+            if store is None:
+                return {"ok": False, "error": "no translate store"}
+            ids = store.translate_keys(msg["keys"], create=True)
+            return {"ok": True,
+                    "pairs": [{"id": i, "key": k}
+                              for i, k in zip(ids, msg["keys"])]}
+        elif t == "translate-entries":
+            store = self._translate_store(msg["index"], msg.get("field"))
+            if store is None:
+                return {"ok": True, "entries": []}
+            entries = store.entries(int(msg.get("after", 0)))
+            return {"ok": True, "entries": [
+                {"offset": o, "id": i, "key": k} for o, i, k in entries]}
         elif t == "node-status":
             self.apply_node_status(msg)
         elif t == "cluster-status":
             self.cluster.apply_status(msg["status"])
+            self.update_translate_writability()
         elif t == "node-state":
             self.cluster.set_node_state(msg["node"], msg["state"])
         else:
@@ -294,6 +315,125 @@ class ClusterNode:
 
         self.cluster.set_state(STATE_NORMAL)
         self.broadcast({"type": "cluster-status", "status": self.cluster.to_status()})
+
+    def _translate_store(self, index: str, field: str | None):
+        idx = self.holder.index(index)
+        if idx is None:
+            return None
+        if field:
+            f = idx.field(field)
+            return None if f is None else f.translate_store
+        return idx.translate_store
+
+    def translate_keys_cluster(self, index: str, field: str | None, keys,
+                               create: bool = False):
+        """Key -> id with single-writer semantics: existing keys resolve
+        locally; creation routes to the coordinator and the returned
+        (id, key) pairs are applied to the local replica immediately
+        (reference executor translate + primary store, holder.go:690,
+        executor.go:2610).  This is the ONLY allocation entry point —
+        executor and API both delegate here."""
+        from pilosa_tpu.parallel.cluster import STATE_STARTING
+
+        store = self._translate_store(index, field)
+        if store is None:
+            raise ValueError(f"no translate store for {index}/{field}")
+        ids = store.translate_keys(list(keys), create=False)
+        missing = [k for k, i in zip(keys, ids) if i is None]
+        if not missing or not create:
+            return ids
+        if (self.cluster.transport is not None
+                and self.cluster.state == STATE_STARTING):
+            # membership not yet known: allocating locally here could
+            # collide with ids the coordinator hands out (split-brain);
+            # the API rejects queries in STARTING for the same reason
+            raise RuntimeError(
+                "cannot allocate keys before the cluster is joined")
+        clustered = (self.cluster.transport is not None
+                     and len(self.cluster.sorted_nodes()) > 1)
+        if not clustered or self.cluster.is_coordinator:
+            return store.translate_keys(list(keys), create=True)
+        resp = self._forward_to_coordinator({
+            "type": "translate-keys", "index": index, "field": field,
+            "keys": missing,
+        })
+        if not resp.get("ok"):
+            raise RuntimeError(
+                f"coordinator key allocation failed: {resp.get('error')}")
+        by_key = {p["key"]: p["id"] for p in resp["pairs"]}
+        # backfill the local replica in entry order (never out-of-band —
+        # offsets must stay gapless so tailing resumes correctly)
+        self._tail_store(index, field, store)
+        return [i if i is not None else by_key.get(k)
+                for k, i in zip(keys, ids)]
+
+    def update_translate_writability(self) -> None:
+        """Mark keyed stores read-only on non-coordinator members —
+        defense-in-depth under the RPC routing (reference: non-primary
+        stores ARE read-only, translate.go:35, holder.go:690).
+        apply_entry bypasses the flag, so tailing still works."""
+        clustered = (self.cluster.transport is not None
+                     and len(self.cluster.sorted_nodes()) > 1)
+        ro = clustered and not self.cluster.is_coordinator
+        for d in self.holder.schema():
+            idx = self.holder.index(d["name"])
+            if idx is None:
+                continue
+            if idx.options.keys:
+                idx.translate_store.set_read_only(ro)
+            for f in idx.public_fields():
+                if f.options.keys:
+                    f.translate_store.set_read_only(ro)
+
+    def _tail_store(self, index: str, field: str | None, store) -> int:
+        coord = self.cluster.node(self.cluster.coordinator_id)
+        if coord is None:
+            return 0
+        applied = 0
+        while True:
+            before = store.max_offset()
+            try:
+                resp = self.cluster.transport.send_message(coord, {
+                    "type": "translate-entries", "index": index,
+                    "field": field, "after": before,
+                })
+            except TransportError:
+                return applied
+            entries = resp.get("entries", [])
+            if not entries:
+                return applied
+            for e in entries:
+                store.apply_entry(e["offset"], e["id"], e["key"])
+                applied += 1
+            if store.max_offset() <= before:
+                # no forward progress (conflicting local entries were
+                # ignored by apply): bail rather than spin forever
+                return applied
+
+    def tail_translate_entries(self) -> int:
+        """Pull new key-translation entries from the coordinator for all
+        keyed indexes/fields (the reference's TranslateEntryReader tail
+        loop, holder.go:690-878).  Returns entries applied."""
+        if (self.cluster.transport is None or self.cluster.is_coordinator
+                or len(self.cluster.sorted_nodes()) < 2):
+            return 0
+        coord = self.cluster.node(self.cluster.coordinator_id)
+        if coord is None:
+            return 0
+        applied = 0
+        targets = []
+        for d in self.holder.schema():
+            idx = self.holder.index(d["name"])
+            if idx is None:
+                continue
+            if idx.options.keys:
+                targets.append((d["name"], None, idx.translate_store))
+            for f in idx.public_fields():
+                if f.options.keys:
+                    targets.append((d["name"], f.name, f.translate_store))
+        for index, field, store in targets:
+            applied += self._tail_store(index, field, store)
+        return applied
 
     def _forward_to_coordinator(self, msg: dict) -> dict:
         coord = self.cluster.node(self.cluster.coordinator_id)

@@ -164,6 +164,9 @@ class HolderSyncer:
         # broadcast still converges (reference holderCleaner loop,
         # holder.go:1103)
         self.node.cleanup_unowned()
+        # replicas tail the primary's key-translation entry stream
+        # (reference holder.go:690-878)
+        self.node.tail_translate_entries()
         return total
 
     def _sync_attrs(self, index: str, field: str | None) -> None:

@@ -47,6 +47,9 @@ def _load():
 def parse_native(src: str) -> Query:
     """Parse via libpql; raises ParseError on syntax errors and
     RuntimeError when the native library is unavailable."""
+    if "\x00" in src:
+        # NUL truncates at the c_char_p boundary — reject, like parse()
+        raise ParseError("NUL byte in query", src, src.index("\x00"))
     lib = _load()
     if lib is None:
         raise RuntimeError("native PQL parser unavailable")

@@ -47,10 +47,17 @@ class ParseError(ValueError):
         self.pos = pos
 
 
+# Maximum call-nesting depth — matches the native parser's MAX_DEPTH so
+# both reject the same pathological inputs with ParseError instead of
+# RecursionError / stack overflow.
+MAX_DEPTH = 128
+
+
 class _Parser:
     def __init__(self, src: str):
         self.src = src
         self.pos = 0
+        self.depth = 0
 
     # ------------------------------------------------------------ plumbing
 
@@ -290,6 +297,15 @@ class _Parser:
         raise self.error(f"expected integer or quoted key for {key}")
 
     def call(self) -> Call:
+        self.depth += 1
+        try:
+            if self.depth > MAX_DEPTH:
+                raise self.error("query too deeply nested")
+            return self._call_dispatch()
+        finally:
+            self.depth -= 1
+
+    def _call_dispatch(self) -> Call:
         name = self.match(_IDENT_RE)
         if name is None:
             raise self.error("expected call name")
@@ -461,4 +477,8 @@ class _Parser:
 
 def parse(src: str) -> Query:
     """Parse a PQL string into a Query (reference pql.ParseString)."""
+    if "\x00" in src:
+        # NUL would truncate at the native parser's C-string boundary;
+        # reject uniformly so both parsers accept the identical language
+        raise ParseError("NUL byte in query", src, src.index("\x00"))
     return _Parser(src).parse()

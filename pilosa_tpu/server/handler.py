@@ -468,6 +468,14 @@ class Handler:
             text = ""
         self._bytes(req, text.encode(), "text/plain; version=0.0.4")
 
+    @route("GET", "/diagnostics")
+    def handle_diagnostics(self, req, params, path, body):
+        """Local diagnostics document (the reference phones this home to
+        diagnostics.pilosa.com, diagnostics.go:42; we only serve it)."""
+        from pilosa_tpu import diagnostics
+
+        self._json(req, diagnostics.payload(self.api.node))
+
     @route("GET", "/debug/vars")
     def handle_debug_vars(self, req, params, path, body):
         snap = {}

@@ -42,6 +42,7 @@ class Server:
         coordinator: bool = False,
         anti_entropy_interval: float = 0.0,
         heartbeat_interval: float = 0.0,
+        metric_poll_interval: float = 0.0,
         long_query_time: float = 0.0,
         max_writes_per_request: int = 0,
         logger=None,
@@ -84,6 +85,10 @@ class Server:
         self.handler = Handler(self.api, host=host, port=port,
                                stats=self.stats, tracer=tracer)
         self.cluster.local_node.uri = self.handler.uri
+        from pilosa_tpu.diagnostics import RuntimeMonitor
+
+        self.runtime_monitor = RuntimeMonitor(self.stats,
+                                              metric_poll_interval)
         self._closers: list = []
         self._stop = threading.Event()
 
@@ -115,6 +120,7 @@ class Server:
         if self.heartbeat_interval > 0:
             t = threading.Thread(target=self._heartbeat_loop, daemon=True)
             t.start()
+        self.runtime_monitor.start()
 
     def _join_via_seeds(self) -> None:
         client = InternalClient()
@@ -159,5 +165,6 @@ class Server:
 
     def close(self) -> None:
         self._stop.set()
+        self.runtime_monitor.stop()
         self.handler.close()
         self.holder.close()

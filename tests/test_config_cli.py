@@ -262,6 +262,21 @@ class TestStatsAndTracing:
             snap = json.loads(resp.read())
         assert any(k.startswith("query[") for k in snap)
 
+    def test_diagnostics_endpoint_and_runtime_gauges(self, running_server):
+        srv = running_server
+        with urllib.request.urlopen(srv.uri + "/diagnostics") as resp:
+            d = json.loads(resp.read())
+        assert d["numNodes"] == 1 and d["clusterState"] == "NORMAL"
+        assert "version" in d and d["uptime"] >= 0
+        from pilosa_tpu import diagnostics
+        from pilosa_tpu.stats import MemStatsClient
+
+        s = MemStatsClient()
+        diagnostics.runtime_gauges(s)
+        snap = s.snapshot()
+        assert snap["threads"] >= 1
+        assert snap.get("memory.rss_bytes", 1) > 0
+
     def test_mem_tracer_spans(self):
         from pilosa_tpu import tracing
         from pilosa_tpu.tracing import MemTracer

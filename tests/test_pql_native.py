@@ -129,6 +129,25 @@ class TestDifferential:
         assert args["c"] == -2
         assert args["d"] == 0.5
 
+    def test_deep_nesting_rejected_not_crashed(self):
+        deep = "Not(" * 100000 + "Row(f=1)" + ")" * 100000
+        with pytest.raises(ParseError):
+            parse_python(deep)
+        with pytest.raises(ParseError):
+            parse_native(deep)
+        # nesting below the limit still parses on both
+        ok = "Not(" * 100 + "Row(f=1)" + ")" * 100
+        assert parse_native(ok).calls == parse_python(ok).calls
+
+    def test_nul_byte_rejected_by_both(self):
+        from pilosa_tpu.pql import parse as parse_dispatch
+
+        for src in ['Set(1, f=1)\x00Set(2, f=2)', 'Row(f="a\x00b")']:
+            with pytest.raises(ParseError):
+                parse_dispatch(src)
+            with pytest.raises(ParseError):
+                parse_native(src)
+
     def test_dispatcher_uses_native(self, monkeypatch):
         import pilosa_tpu.pql as pql
 

@@ -1,0 +1,99 @@
+"""Distributed key translation: single-writer allocation via the
+coordinator, replica tailing, global id uniqueness (parity:
+holder.go:690-878 translate replication, boltdb/translate.go sequence
+allocation)."""
+
+from __future__ import annotations
+
+from pilosa_tpu.models.index import IndexOptions
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.parallel.syncer import HolderSyncer
+
+from tests.test_cluster import make_cluster
+
+
+def _keyed_cluster(tmp_path, n=3):
+    transport, nodes = make_cluster(tmp_path, n=n, replica_n=2)
+    nodes[0].create_index("i", IndexOptions(keys=True))
+    nodes[0].create_field(
+        "i", "f", FieldOptions.set_field(keys=True))
+    return transport, nodes
+
+
+class TestSingleWriter:
+    def test_creation_routes_to_coordinator(self, tmp_path):
+        _, nodes = _keyed_cluster(tmp_path)
+        # create keys from a NON-coordinator node
+        assert not nodes[1].cluster.is_coordinator
+        ids = nodes[1].translate_keys_cluster("i", None,
+                                              ["a", "b"], create=True)
+        assert ids[0] != ids[1]
+        # the coordinator's (primary) store holds them
+        coord_store = nodes[0].holder.index("i").translate_store
+        assert coord_store.translate_key("a") == ids[0]
+        assert coord_store.translate_key("b") == ids[1]
+        # and the creating node resolved them locally via backfill
+        local_store = nodes[1].holder.index("i").translate_store
+        assert local_store.translate_key("a") == ids[0]
+
+    def test_no_id_collisions_across_nodes(self, tmp_path):
+        _, nodes = _keyed_cluster(tmp_path)
+        ids = []
+        for i, nd in enumerate(nodes):
+            ids.extend(nd.translate_keys_cluster(
+                "i", None, [f"k{i}-{j}" for j in range(5)], create=True))
+        assert len(set(ids)) == len(ids), "duplicate ids allocated"
+
+    def test_same_key_same_id_everywhere(self, tmp_path):
+        _, nodes = _keyed_cluster(tmp_path)
+        id_a = nodes[1].translate_keys_cluster("i", None, ["x"], True)[0]
+        id_b = nodes[2].translate_keys_cluster("i", None, ["x"], True)[0]
+        id_c = nodes[0].translate_keys_cluster("i", None, ["x"], True)[0]
+        assert id_a == id_b == id_c
+
+    def test_field_keys_route_too(self, tmp_path):
+        _, nodes = _keyed_cluster(tmp_path)
+        id1 = nodes[2].translate_keys_cluster("i", "f", ["row1"], True)[0]
+        coord = nodes[0].holder.index("i").field("f").translate_store
+        assert coord.translate_key("row1") == id1
+
+    def test_tailer_syncs_replicas(self, tmp_path):
+        _, nodes = _keyed_cluster(tmp_path)
+        # keys created directly on the coordinator (primary)
+        nodes[0].translate_keys_cluster("i", None,
+                                        ["p", "q", "r"], create=True)
+        # replicas know nothing yet
+        assert nodes[2].holder.index("i").translate_store.translate_key(
+            "p") is None
+        applied = nodes[2].tail_translate_entries()
+        assert applied == 3
+        store = nodes[2].holder.index("i").translate_store
+        for k in ("p", "q", "r"):
+            assert store.translate_key(k) == nodes[0].holder.index(
+                "i").translate_store.translate_key(k)
+        # idempotent
+        assert nodes[2].tail_translate_entries() == 0
+
+    def test_keyed_query_via_any_node(self, tmp_path):
+        _, nodes = _keyed_cluster(tmp_path)
+        nodes[1].executor.execute("i", 'Set("alice", f="likes")')
+        nodes[2].executor.execute("i", 'Set("bob", f="likes")')
+        # AE pass lets every node resolve result keys
+        for nd in nodes:
+            HolderSyncer(nd).sync_holder()
+        for nd in nodes:
+            row = nd.executor.execute("i", 'Row(f="likes")')[0]
+            assert sorted(row.keys) == ["alice", "bob"], (
+                nd.cluster.local_id, row.keys)
+
+    def test_import_keys_via_non_coordinator(self, tmp_path):
+        from pilosa_tpu.api import API
+
+        _, nodes = _keyed_cluster(tmp_path)
+        api1 = API(nodes[1])
+        api1.import_bits("i", "f", [], [], row_keys=["r1", "r1"],
+                         col_keys=["c1", "c2"])
+        for nd in nodes:
+            HolderSyncer(nd).sync_holder()
+        row = nodes[0].executor.execute("i", 'Row(f="r1")')[0]
+        assert sorted(row.keys) == ["c1", "c2"]
