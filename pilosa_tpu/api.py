@@ -185,6 +185,8 @@ class API:
         rows, cols = list(rows), list(cols)
         if remote or not self._clustered():
             f.import_bits(rows, cols, timestamps, clear=clear)
+            if not clear:
+                idx.import_existence(cols)
             return
         known_shards = f.available_shards()
         for shard, sel in self._group_by_shard(cols).items():
@@ -200,10 +202,15 @@ class API:
             }
             self._send_to_owners(
                 index, shard, payload,
-                local_fn=lambda sel=sel: f.import_bits(
-                    [rows[i] for i in sel], [cols[i] for i in sel],
-                    None if timestamps is None else [timestamps[i] for i in sel],
-                    clear=clear,
+                local_fn=lambda sel=sel: (
+                    f.import_bits(
+                        [rows[i] for i in sel], [cols[i] for i in sel],
+                        None if timestamps is None
+                        else [timestamps[i] for i in sel],
+                        clear=clear,
+                    ),
+                    None if clear else idx.import_existence(
+                        [cols[i] for i in sel]),
                 ),
             )
             self._note_shard_everywhere(f, index, field, shard,
@@ -221,6 +228,7 @@ class API:
         cols, values = list(cols), list(values)
         if remote or not self._clustered():
             f.import_values(cols, values)
+            idx.import_existence(cols)
             return
         known_shards = f.available_shards()
         for shard, sel in self._group_by_shard(cols).items():
@@ -233,8 +241,11 @@ class API:
             }
             self._send_to_owners(
                 index, shard, payload,
-                local_fn=lambda sel=sel: f.import_values(
-                    [cols[i] for i in sel], [values[i] for i in sel]),
+                local_fn=lambda sel=sel: (
+                    f.import_values([cols[i] for i in sel],
+                                    [values[i] for i in sel]),
+                    idx.import_existence([cols[i] for i in sel]),
+                ),
             )
             self._note_shard_everywhere(f, index, field, shard,
                                         known=shard in known_shards)
@@ -366,7 +377,7 @@ class API:
         self._validate("set_coordinator")
         if self.cluster.node(node_id) is None:
             raise NotFoundError(f"node not found: {node_id}")
-        self.cluster.set_coordinator(node_id)
+        self.node.set_coordinator(node_id)
 
     def remove_node(self, node_id: str) -> dict:
         self._validate("remove_node")

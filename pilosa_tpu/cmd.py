@@ -118,8 +118,19 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
     log_stream = open(cfg.log_path, "a") if cfg.log_path else None
     log = (VerboseLogger(log_stream) if cfg.verbose
            else StandardLogger(log_stream))
-    stats = (_stats.NOP if cfg.metric.service == "nop"
-             else _stats.MemStatsClient())
+    statsd = None
+    if cfg.metric.service == "nop":
+        stats = _stats.NOP
+    elif cfg.metric.service == "statsd":
+        from pilosa_tpu.statsd import StatsdClient
+
+        sd_host, _, sd_port = cfg.metric.host.partition(":")
+        statsd = StatsdClient(sd_host or "127.0.0.1",
+                              int(sd_port or 8125))
+        # fan out so /metrics and /debug/vars keep working too
+        stats = _stats.MultiStatsClient([_stats.MemStatsClient(), statsd])
+    else:
+        stats = _stats.MemStatsClient()
     if cfg.tracing.enabled:
         _tracing.set_global_tracer(_tracing.MemTracer())
     srv = Server(
@@ -136,9 +147,14 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         metric_poll_interval=cfg.metric.poll_interval,
         long_query_time=cfg.cluster.long_query_time,
         max_writes_per_request=cfg.max_writes_per_request,
+        tls_cert=cfg.tls.certificate_path or None,
+        tls_key=cfg.tls.key_path or None,
+        tls_skip_verify=cfg.tls.skip_verify,
         logger=log,
         stats=stats,
     )
+    if statsd is not None:
+        srv._closers.append(statsd.close)
     stop = stop_event or threading.Event()
 
     def _sig(signum, frame):

@@ -39,7 +39,8 @@ class AntiEntropyConfig:
 class MetricConfig:
     """[metric] (server/config.go:125-133)."""
 
-    service: str = "mem"  # mem | nop
+    service: str = "mem"  # mem | statsd | nop
+    host: str = "127.0.0.1:8125"  # statsd agent address
     poll_interval: float = 0.0  # runtime gauge sweep seconds; 0 = off
     diagnostics: bool = False  # no phone-home by default
 
@@ -49,6 +50,15 @@ class TracingConfig:
     """[tracing] (server/config.go:141-149)."""
 
     enabled: bool = False
+
+
+@dataclass
+class TLSConfig:
+    """[tls] (server/tlsconfig.go; config server/config.go:58-66)."""
+
+    certificate_path: str = ""
+    key_path: str = ""
+    skip_verify: bool = False
 
 
 @dataclass
@@ -64,6 +74,7 @@ class Config:
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    tls: TLSConfig = field(default_factory=TLSConfig)
 
     # ------------------------------------------------------------- access
 
@@ -98,7 +109,8 @@ class Config:
     def _apply_dict(self, d: dict) -> None:
         for k, v in d.items():
             key = k.replace("-", "_")
-            if key in ("cluster", "anti_entropy", "metric", "tracing") and isinstance(v, dict):
+            if key in ("cluster", "anti_entropy", "metric", "tracing",
+                       "tls") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -108,14 +120,16 @@ class Config:
                                                        (ClusterConfig,
                                                         AntiEntropyConfig,
                                                         MetricConfig,
-                                                        TracingConfig)):
+                                                        TracingConfig,
+                                                        TLSConfig)):
                 setattr(self, key, v)
 
     def _apply_env(self, env: dict) -> None:
         """PILOSA_TPU_BIND=..., PILOSA_TPU_CLUSTER_REPLICAS=2, etc.
         (the reference's PILOSA_* envs, cmd/root.go:94)."""
         for f in fields(self):
-            if f.name in ("cluster", "anti_entropy", "metric", "tracing"):
+            if f.name in ("cluster", "anti_entropy", "metric", "tracing",
+                          "tls"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -154,11 +168,17 @@ class Config:
             "",
             "[metric]",
             f'service = "{self.metric.service}"',
+            f'host = "{self.metric.host}"',
             f"poll-interval = {self.metric.poll_interval}",
             f"diagnostics = {str(self.metric.diagnostics).lower()}",
             "",
             "[tracing]",
             f"enabled = {str(self.tracing.enabled).lower()}",
+            "",
+            "[tls]",
+            f'certificate-path = "{self.tls.certificate_path}"',
+            f'key-path = "{self.tls.key_path}"',
+            f"skip-verify = {str(self.tls.skip_verify).lower()}",
         ]
         return "\n".join(lines) + "\n"
 

@@ -140,6 +140,16 @@ class Index:
     def public_fields(self) -> list[Field]:
         return [f for n, f in sorted(self.fields.items()) if not n.startswith("_")]
 
+    def import_existence(self, cols) -> None:
+        """Record imported columns in the hidden existence field —
+        bulk-import parity with the write path (reference
+        api.go:968 importExistenceColumns; executor Set updates
+        existence per bit)."""
+        f = self.existence_field()
+        if f is None or not cols:
+            return
+        f.import_bits([0] * len(cols), list(cols))
+
     def all_fields(self) -> list[Field]:
         """Public + internal fields (``_exists``) — storage-walking code
         (resize, anti-entropy, cleanup) must cover both."""

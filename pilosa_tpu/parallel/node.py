@@ -134,12 +134,15 @@ class ClusterNode:
                       for t_ in ts]
             f.import_bits(msg["rows"], msg["cols"], ts,
                           clear=bool(msg.get("clear")))
+            if not msg.get("clear"):
+                idx.import_existence(msg["cols"])
         elif t == "import-value":
             idx = self.holder.index(msg["index"])
             f = None if idx is None else idx.field(msg["field"])
             if f is None:
                 return {"ok": False, "error": "field not found"}
             f.import_values(msg["cols"], msg["values"])
+            idx.import_existence(msg["cols"])
         elif t == "fragment-blocks":
             frag = self._fragment(msg, create=False)
             return {"ok": True,
@@ -366,6 +369,15 @@ class ClusterNode:
         self._tail_store(index, field, store)
         return [i if i is not None else by_key.get(k)
                 for k, i in zip(keys, ids)]
+
+    def set_coordinator(self, node_id: str) -> None:
+        """Move the coordinator role, refresh translate writability, and
+        tell everyone (api.go:1193 SetCoordinator — the reference
+        broadcasts SetCoordinatorMessage)."""
+        self.cluster.set_coordinator(node_id)
+        self.update_translate_writability()
+        self.broadcast({"type": "cluster-status",
+                        "status": self.cluster.to_status()})
 
     def update_translate_writability(self) -> None:
         """Mark keyed stores read-only on non-coordinator members —

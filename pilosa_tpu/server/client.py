@@ -25,8 +25,18 @@ class InternalClient:
     """Thin JSON/binary HTTP client against a node's Handler routes
     (http/client.go:37)."""
 
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0,
+                 tls_skip_verify: bool = False):
         self.timeout = timeout
+        self._ssl_ctx = None
+        if tls_skip_verify:
+            # self-signed intra-cluster certs (reference tls.skip-verify,
+            # server/config.go:64)
+            import ssl
+
+            self._ssl_ctx = ssl.create_default_context()
+            self._ssl_ctx.check_hostname = False
+            self._ssl_ctx.verify_mode = ssl.CERT_NONE
 
     # ------------------------------------------------------------- basics
 
@@ -36,7 +46,8 @@ class InternalClient:
         if body is not None:
             req.add_header("Content-Type", ctype)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout,
+                                        context=self._ssl_ctx) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             detail = ""

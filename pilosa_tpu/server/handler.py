@@ -153,14 +153,26 @@ class Handler:
     background thread (http/handler.go:46)."""
 
     def __init__(self, api: API, host: str = "127.0.0.1", port: int = 0,
-                 stats=None, tracer=None):
+                 stats=None, tracer=None, tls_cert: str | None = None,
+                 tls_key: str | None = None):
         self.api = api
         self.stats = stats
         self.tracer = tracer
+        self.tls = bool(tls_cert)
         handler_self = self
 
         class _Req(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            timeout = 60  # per-connection read timeout
+
+            def setup(self):
+                # the TLS handshake runs HERE, in the per-request thread
+                # with a timeout — never inside the accept loop, where a
+                # stalled client would hang the whole node
+                self.request.settimeout(self.timeout)
+                if handler_self.tls:
+                    self.request.do_handshake()
+                super().setup()
 
             def log_message(self, fmt, *args):  # quiet by default
                 pass
@@ -178,13 +190,24 @@ class Handler:
                 self._dispatch("DELETE")
 
         self.httpd = ThreadingHTTPServer((host, port), _Req)
+        if tls_cert:
+            # TLS termination (reference server/tlsconfig.go; https
+            # scheme config server/config.go:60)
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(tls_cert, tls_key or tls_cert)
+            self.httpd.socket = ctx.wrap_socket(
+                self.httpd.socket, server_side=True,
+                do_handshake_on_connect=False)
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread: threading.Thread | None = None
 
     @property
     def uri(self) -> str:
-        return f"http://{self.host}:{self.port}"
+        scheme = "https" if self.tls else "http"
+        return f"{scheme}://{self.host}:{self.port}"
 
     def serve_background(self) -> None:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
@@ -475,6 +498,20 @@ class Handler:
         from pilosa_tpu import diagnostics
 
         self._json(req, diagnostics.payload(self.api.node))
+
+    @route("GET", "/debug/threads")
+    def handle_debug_threads(self, req, params, path, body):
+        """All thread stacks — the /debug/pprof goroutine-dump analog
+        (http/handler.go:280 mounts pprof unconditionally)."""
+        import sys
+        import traceback
+
+        out = []
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for ident, frame in sys._current_frames().items():
+            out.append(f"--- thread {names.get(ident, ident)} ---\n"
+                       + "".join(traceback.format_stack(frame)))
+        self._bytes(req, "\n".join(out).encode(), "text/plain")
 
     @route("GET", "/debug/vars")
     def handle_debug_vars(self, req, params, path, body):

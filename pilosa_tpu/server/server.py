@@ -45,6 +45,9 @@ class Server:
         metric_poll_interval: float = 0.0,
         long_query_time: float = 0.0,
         max_writes_per_request: int = 0,
+        tls_cert: str | None = None,
+        tls_key: str | None = None,
+        tls_skip_verify: bool = False,
         logger=None,
         stats=None,
         tracer=None,
@@ -64,11 +67,12 @@ class Server:
         self.holder = Holder(data_dir)
         node_id = name or self.holder.node_id or uuid.uuid4().hex[:12]
 
+        self._client = InternalClient(tls_skip_verify=tls_skip_verify)
         self.cluster = Cluster(
             local_id=node_id,
             replica_n=replica_n,
             partition_n=partition_n,
-            transport=HTTPTransport(),
+            transport=HTTPTransport(self._client),
             topology_path=os.path.join(data_dir, ".topology"),
         )
         self.node = ClusterNode(self.holder, self.cluster)
@@ -83,7 +87,8 @@ class Server:
         self.api = API(self.node)
         self.api.max_writes_per_request = max_writes_per_request
         self.handler = Handler(self.api, host=host, port=port,
-                               stats=self.stats, tracer=tracer)
+                               stats=self.stats, tracer=tracer,
+                               tls_cert=tls_cert, tls_key=tls_key)
         self.cluster.local_node.uri = self.handler.uri
         from pilosa_tpu.diagnostics import RuntimeMonitor
 
@@ -123,7 +128,7 @@ class Server:
         self.runtime_monitor.start()
 
     def _join_via_seeds(self) -> None:
-        client = InternalClient()
+        client = self._client
         me = self.cluster.local_node.to_dict()
         last_err: Exception | None = None
         for attempt in range(60):  # 60 retries (gossip/gossip.go:102)
@@ -168,3 +173,8 @@ class Server:
         self.runtime_monitor.stop()
         self.handler.close()
         self.holder.close()
+        for closer in self._closers:
+            try:
+                closer()
+            except Exception:
+                pass

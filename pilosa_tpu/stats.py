@@ -127,6 +127,18 @@ class MultiStatsClient(StatsClient):
     def with_tags(self, *tags):
         return MultiStatsClient([c.with_tags(*tags) for c in self.clients])
 
+    def snapshot(self) -> dict:
+        for c in self.clients:
+            if hasattr(c, "snapshot"):
+                return c.snapshot()
+        return {}
+
+    def prometheus_text(self) -> str:
+        for c in self.clients:
+            if hasattr(c, "prometheus_text"):
+                return c.prometheus_text()
+        return ""
+
 
 class _Registry:
     def __init__(self):

@@ -1,0 +1,131 @@
+"""StatsD backend: ships metrics to a statsd/DataDog agent over UDP.
+
+Parity target: the reference's statsd package (statsd/statsd.go:41 —
+DataDog client adapter with 1s aggregation).  Implemented on a plain
+UDP socket (dogstatsd line protocol, which plain statsd servers accept
+minus the |#tags suffix) — no third-party dependency.  Sends are
+best-effort and never block or raise into the caller."""
+
+from __future__ import annotations
+
+import random
+import socket
+import threading
+import time
+
+from pilosa_tpu.stats import StatsClient
+
+
+class StatsdClient(StatsClient):
+    """Tag-scoped statsd emitter (statsd/statsd.go:41 NewStatsClient)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "pilosa_tpu", flush_interval: float = 1.0,
+                 _shared=None, _tags: tuple[str, ...] = ()):
+        self.prefix = prefix
+        self._tags = tuple(sorted(_tags))
+        if _shared is not None:
+            self._shared = _shared
+        else:
+            self._shared = _Conn(host, port, flush_interval)
+
+    # ------------------------------------------------------------- metrics
+
+    def _send(self, name: str, value, kind: str, rate: float,
+              tags: tuple[str, ...]) -> None:
+        if rate < 1.0 and random.random() >= rate:
+            return  # actually sample — the |@rate suffix tells the
+            # agent to scale the events we DO send back up
+        line = f"{self.prefix}.{name}:{value}|{kind}"
+        if rate < 1.0:
+            line += f"|@{rate}"
+        if tags:
+            line += "|#" + ",".join(tags)
+        self._shared.enqueue(line)
+
+    def count(self, name, value=1, rate=1.0):
+        self._send(name, value, "c", rate, self._tags)
+
+    def count_with_tags(self, name, value, rate, tags):
+        self._send(name, value, "c", rate,
+                   tuple(sorted({*self._tags, *tags})))
+
+    def gauge(self, name, value, rate=1.0):
+        self._send(name, value, "g", rate, self._tags)
+
+    def histogram(self, name, value, rate=1.0):
+        self._send(name, value, "h", rate, self._tags)
+
+    def set(self, name, value, rate=1.0):
+        self._send(name, value, "s", rate, self._tags)
+
+    def timing(self, name, value_ns, rate=1.0):
+        self._send(name, value_ns / 1e6, "ms", rate, self._tags)
+
+    def with_tags(self, *tags):
+        return StatsdClient(prefix=self.prefix, _shared=self._shared,
+                            _tags=(*self._tags, *tags))
+
+    def tags(self):
+        return list(self._tags)
+
+    def close(self) -> None:
+        self._shared.close()
+
+
+class _Conn:
+    """Shared UDP socket with a 1s-aggregated send buffer (the
+    reference's DataDog client buffers similarly)."""
+
+    MAX_PACKET = 1432  # typical safe UDP payload
+
+    def __init__(self, host: str, port: int, flush_interval: float):
+        self.addr = (host, port)
+        self.flush_interval = flush_interval
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._buf: list[str] = []
+        self._buf_len = 0
+        self._lock = threading.Lock()
+        self._last_flush = time.monotonic()
+        self._stop = threading.Event()
+        # background flusher: a quiet server must still drain its tail
+        # (the DataDog client the reference wraps flushes on a timer)
+        if flush_interval > 0:
+            self._flusher = threading.Thread(target=self._flush_loop,
+                                             daemon=True)
+            self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        while not self._stop.wait(self.flush_interval):
+            self.flush()
+
+    def enqueue(self, line: str) -> None:
+        with self._lock:
+            if self._buf_len + len(line) + 1 > self.MAX_PACKET:
+                self._flush_locked()
+            self._buf.append(line)
+            self._buf_len += len(line) + 1
+            if time.monotonic() - self._last_flush >= self.flush_interval:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        if self._buf:
+            try:
+                self.sock.sendto("\n".join(self._buf).encode(), self.addr)
+            except OSError:
+                pass  # best-effort
+            self._buf = []
+            self._buf_len = 0
+        self._last_flush = time.monotonic()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.flush()
+        try:
+            self.sock.close()
+        except OSError:
+            pass

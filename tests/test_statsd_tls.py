@@ -1,0 +1,129 @@
+"""StatsD emission, TLS serving, and /debug/threads (parity:
+statsd/statsd.go, server/tlsconfig.go, http/handler.go:280 pprof)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import urllib.request
+
+import pytest
+
+
+class TestStatsd:
+    def test_lines_reach_udp_agent(self):
+        from pilosa_tpu.statsd import StatsdClient
+
+        sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        sock.bind(("127.0.0.1", 0))
+        sock.settimeout(5)
+        port = sock.getsockname()[1]
+        c = StatsdClient("127.0.0.1", port, flush_interval=0.0)
+        c.count("queries", 2)
+        tagged = c.with_tags("index:i")
+        tagged.timing("latency", 5_000_000)  # 5ms in ns
+        c.gauge("threads", 7)
+        c.close()
+        data = b""
+        sock.settimeout(5)
+        try:
+            data += sock.recv(4096) + b"\n"  # first packet: must arrive
+            sock.settimeout(0.2)
+            while True:
+                data += sock.recv(4096) + b"\n"
+        except socket.timeout:
+            pass
+        finally:
+            sock.close()
+        text = data.decode()
+        assert text, "no statsd packets received"
+        assert "pilosa_tpu.queries:2|c" in text
+        assert "pilosa_tpu.latency:5.0|ms|#index:i" in text
+        assert "pilosa_tpu.threads:7|g" in text
+
+    def test_multi_fanout_keeps_registry(self):
+        from pilosa_tpu.stats import MemStatsClient, MultiStatsClient
+        from pilosa_tpu.statsd import StatsdClient
+
+        mem = MemStatsClient()
+        sd = StatsdClient("127.0.0.1", 1)  # nothing listens; best-effort
+        multi = MultiStatsClient([mem, sd])
+        multi.count("x", 3)
+        assert multi.snapshot()["x"] == 3
+        assert "x" in multi.prometheus_text()
+        sd.close()
+
+
+@pytest.fixture(scope="module")
+def self_signed_cert(tmp_path_factory):
+    d = tmp_path_factory.mktemp("certs")
+    cert, key = str(d / "node.crt"), str(d / "node.key")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+class TestTLS:
+    def test_https_round_trip(self, tmp_path, self_signed_cert):
+        import ssl
+
+        from pilosa_tpu.server.server import Server
+
+        cert, key = self_signed_cert
+        s = Server(str(tmp_path / "n0"), tls_cert=cert, tls_key=key,
+                   tls_skip_verify=True)
+        s.open()
+        try:
+            assert s.uri.startswith("https://")
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            with urllib.request.urlopen(s.uri + "/status", timeout=10,
+                                        context=ctx) as resp:
+                st = json.loads(resp.read())
+            assert st["state"] == "NORMAL"
+            # the node's own InternalClient can talk to it (skip-verify)
+            assert s._client.status(s.uri)["state"] == "NORMAL"
+        finally:
+            s.close()
+
+    def test_tls_cluster_replication(self, tmp_path, self_signed_cert):
+        import ssl
+
+        from pilosa_tpu.server.server import Server
+
+        cert, key = self_signed_cert
+        s0 = Server(str(tmp_path / "n0"), name="node0",
+                    tls_cert=cert, tls_key=key, tls_skip_verify=True)
+        s0.open()
+        s1 = Server(str(tmp_path / "n1"), name="node1", seeds=[s0.uri],
+                    tls_cert=cert, tls_key=key, tls_skip_verify=True)
+        s1.open()
+        try:
+            assert len(s0.cluster.sorted_nodes()) == 2
+            c = s0._client
+            c.create_index(s0.uri, "i", {})
+            c.create_field(s0.uri, "i", "f", {})
+            assert s1.holder.index("i") is not None  # DDL over https
+        finally:
+            s1.close()
+            s0.close()
+
+
+class TestDebugThreads:
+    def test_stack_dump(self, tmp_path):
+        from pilosa_tpu.server.server import Server
+
+        s = Server(str(tmp_path / "n0"))
+        s.open()
+        try:
+            with urllib.request.urlopen(s.uri + "/debug/threads",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            assert "--- thread" in text and "MainThread" in text
+        finally:
+            s.close()
