@@ -56,6 +56,23 @@ class AttrStore:
                     (id_, json.dumps(cur, sort_keys=True)),
                 )
 
+    def attrs_bulk(self, ids) -> dict[int, dict]:
+        """Batched lookup: one IN-query per 500 ids (the per-id form
+        would hold the store lock once per column on columnAttrs
+        responses)."""
+        ids = [int(i) for i in ids]
+        out: dict[int, dict] = {}
+        with self._lock:
+            con = self._conn()
+            for i in range(0, len(ids), 500):
+                chunk = ids[i:i + 500]
+                cur = con.execute(
+                    "SELECT id, data FROM attrs WHERE id IN "
+                    f"({','.join('?' * len(chunk))})", chunk)
+                for id_, data in cur.fetchall():
+                    out[int(id_)] = json.loads(data)
+        return out
+
     def set_bulk_attrs(self, attrs_by_id: dict[int, dict]) -> None:
         for id_, attrs in sorted(attrs_by_id.items()):
             self.set_attrs(id_, attrs)

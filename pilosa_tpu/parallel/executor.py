@@ -1023,7 +1023,13 @@ class Executor:
                 new_opt.shards = [int(v) for v in value]
             else:
                 raise ExecutionError(f"unknown Options() argument: {key!r}")
-        return self._execute_call(idx, call.children[0], shards, new_opt)
+        res = self._execute_call(idx, call.children[0], shards, new_opt)
+        if isinstance(res, Row):
+            # serialization directives ride the result so the wire layer
+            # honors per-call Options() the same as URL params
+            res.exclude_columns = new_opt.exclude_columns
+            res.wants_column_attrs = new_opt.column_attrs
+        return res
 
     # ----------------------------------------------------- key translation
 

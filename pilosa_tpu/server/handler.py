@@ -43,7 +43,9 @@ def serialize_result(res):
     MarshalJSON impls)."""
     if isinstance(res, Row):
         out = {}
-        if res.keys:
+        if res.exclude_columns:
+            pass  # columns never materialized (Options excludeColumns)
+        elif res.keys:
             out["keys"] = list(res.keys)
         else:
             out["columns"] = [int(c) for c in res.columns()]
@@ -325,14 +327,56 @@ class Handler:
         shards = None
         if params.get("shards"):
             shards = [int(s) for s in params["shards"].split(",")]
+        exclude_columns = params.get("excludeColumns") == "true"
+        column_attrs = params.get("columnAttrs") == "true"
         results = self.api.query(
             path["index"], pql, shards=shards,
             remote=params.get("remote") == "true",
-            column_attrs=params.get("columnAttrs") == "true",
+            column_attrs=column_attrs,
             exclude_row_attrs=params.get("excludeRowAttrs") == "true",
-            exclude_columns=params.get("excludeColumns") == "true",
+            exclude_columns=exclude_columns,
         )
-        self._json(req, {"results": [serialize_result(r) for r in results]})
+        if exclude_columns:
+            for r in results:
+                if isinstance(r, Row):
+                    r.exclude_columns = True
+        resp = {"results": [serialize_result(r) for r in results]}
+        # attach column attribute sets for result columns when requested
+        # by the URL param or a per-call Options(columnAttrs=true)
+        # (reference executor.go:206 / QueryResponse.columnAttrSets)
+        if column_attrs or any(
+                isinstance(r, Row) and r.wants_column_attrs
+                for r in results):
+            resp["columnAttrs"] = self._column_attr_sets(
+                path["index"],
+                [r for r in results
+                 if isinstance(r, Row)
+                 and (column_attrs or r.wants_column_attrs)])
+        self._json(req, resp)
+
+    def _column_attr_sets(self, index: str, rows: list[Row]) -> list[dict]:
+        idx = self.api.index(index)
+        cols: set[int] = set()
+        for r in rows:
+            cols.update(int(c) for c in r.columns())
+        ordered = sorted(cols)
+        attrs_by_id = idx.column_attrs.attrs_bulk(ordered)
+        keys_by_id = {}
+        if idx.options.keys:
+            keys = idx.translate_store.translate_ids(ordered)
+            keys_by_id = dict(zip(ordered, keys))
+        out = []
+        for col in ordered:
+            attrs = attrs_by_id.get(col)
+            if not attrs:
+                continue
+            entry = {"attrs": attrs}
+            if idx.options.keys:
+                entry["key"] = keys_by_id.get(col) or ""
+            else:
+                entry["id"] = col
+            out.append(entry)
+        return out
 
     @route("POST", "/index/{index}")
     def handle_create_index(self, req, params, path, body):

@@ -125,6 +125,25 @@ class TestSingleNodeHTTP:
         nodes = _get(srv.uri, "/internal/fragment/nodes?index=i&shard=0")
         assert nodes[0]["id"] == srv.cluster.local_id
 
+    def test_column_attrs_and_exclude_columns(self, srv):
+        _post(srv.uri, "/index/i")
+        _post(srv.uri, "/index/i/field/f")
+        _post(srv.uri, "/index/i/query",
+              {"query": 'Set(1, f=10)SetColumnAttrs(1, city="ny")'})
+        r = _post(srv.uri, "/index/i/query?columnAttrs=true",
+                  {"query": "Row(f=10)"})
+        assert r["columnAttrs"] == [{"id": 1, "attrs": {"city": "ny"}}]
+        r = _post(srv.uri, "/index/i/query?excludeColumns=true",
+                  {"query": "Row(f=10)"})
+        assert "columns" not in r["results"][0]
+        # per-call Options() forms behave like the URL params
+        r = _post(srv.uri, "/index/i/query",
+                  {"query": "Options(Row(f=10), excludeColumns=true)"})
+        assert "columns" not in r["results"][0]
+        r = _post(srv.uri, "/index/i/query",
+                  {"query": "Options(Row(f=10), columnAttrs=true)"})
+        assert r["columnAttrs"] == [{"id": 1, "attrs": {"city": "ny"}}]
+
     def test_delete_index_and_field(self, srv):
         _post(srv.uri, "/index/i")
         _post(srv.uri, "/index/i/field/f")
