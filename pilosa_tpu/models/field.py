@@ -155,6 +155,7 @@ class Field:
         self.options = options
         self.views: dict[str, View] = {}
         self._shards: set[int] = set()
+        self._row_stack_cache: dict = {}  # (row, shards) -> (gens, dev)
         self._lock = threading.RLock()
         if path is not None:
             os.makedirs(path, exist_ok=True)
@@ -316,6 +317,43 @@ class Field:
     def row(self, row_id: int, shard: int) -> np.ndarray | None:
         view = self.view(VIEW_STANDARD)
         return None if view is None else view.row(row_id, shard)
+
+    def device_row_stack(self, row_id: int, shards: tuple[int, ...]):
+        """One row across many shards as a device-resident uint32
+        [n_shards, words] stack — the unit of the executor's fused
+        all-shards-in-one-dispatch path (SURVEY.md §7 step 4: whole
+        shard batches as single XLA programs).  Missing fragments
+        contribute zero rows (semantically identical to the per-shard
+        None propagation).  Cached per (row, shards) and invalidated by
+        the per-fragment mutation generations."""
+        import jax
+
+        from pilosa_tpu.ops import bitmap as bm
+
+        view = self.view(VIEW_STANDARD)
+        key = (row_id, shards)
+        # bind each fragment once: a concurrent delete_fragment between
+        # two lookups must read as "empty", not crash
+        frags = [None if view is None else view.fragment(s) for s in shards]
+        gens = tuple(0 if fr is None else fr._gen for fr in frags)
+        with self._lock:
+            hit = self._row_stack_cache.get(key)
+            if hit is not None and hit[0] == gens:
+                return hit[1]
+        n_words = bm.n_words(SHARD_WIDTH)
+        stack = np.zeros((len(shards), n_words), dtype=np.uint32)
+        for i, frag in enumerate(frags):
+            if frag is not None:
+                with frag._lock:
+                    arr = frag._rows.get(row_id)
+                    if arr is not None:
+                        stack[i] = arr
+        dev = jax.device_put(stack)
+        with self._lock:
+            if len(self._row_stack_cache) >= 64:  # bounded
+                self._row_stack_cache.pop(next(iter(self._row_stack_cache)))
+            self._row_stack_cache[key] = (gens, dev)
+        return dev
 
     def row_time(self, row_id: int, shard: int, start, end) -> np.ndarray | None:
         """Union of time views covering [start, end) for one shard

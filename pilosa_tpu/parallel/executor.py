@@ -268,22 +268,103 @@ class Executor:
         for child in call.children:
             self._validate_call_fields(idx, child)
 
+    # ------------------------------------------------ fused all-shard path
+
+    def _fused_supported(self, idx, call: Call) -> bool:
+        """True when the bitmap tree can evaluate as ONE stacked device
+        computation over all shards: plain standard-view Row leaves
+        combined with Union/Intersect/Difference/Xor/Not.  Conditions,
+        time ranges, Shift, and BSI leaves fall back to the general
+        per-shard path."""
+        name = call.name
+        if name == "Row":
+            if call.has_condition_arg():
+                return False
+            if "from" in call.args or "to" in call.args:
+                return False
+            try:
+                fname = call.field_arg()
+            except ValueError:
+                return False
+            v = call.args.get(fname)
+            if not isinstance(v, int) or isinstance(v, bool):
+                return False
+            f = idx.field(fname)
+            if f is None:
+                return False
+            o = f.options
+            return not (o.type == FieldType.INT
+                        or (o.type == FieldType.TIME and o.no_standard_view))
+        if name == "Not":
+            return (len(call.children) == 1
+                    and idx.existence_field() is not None
+                    and self._fused_supported(idx, call.children[0]))
+        if name in ("Union", "Intersect", "Difference", "Xor"):
+            return bool(call.children) and all(
+                self._fused_supported(idx, c) for c in call.children)
+        return False
+
+    def _fused_eval(self, idx, call: Call, shards: tuple[int, ...]):
+        """Evaluate a supported tree -> uint32 [n_shards, words] device
+        stack.  Replaces n_shards × tree-size dispatches with tree-size
+        dispatches over stacked operands — the dominant win when device
+        dispatch has real latency (TPU behind an RPC boundary)."""
+        name = call.name
+        if name == "Row":
+            fname = call.field_arg()
+            # arg is a plain int row id (bool literals and conditions
+            # were excluded by _fused_supported)
+            return idx.field(fname).device_row_stack(call.args[fname],
+                                                     shards)
+        kids = [self._fused_eval(idx, c, shards) for c in call.children]
+        if name == "Union":
+            out = kids[0]
+            for k in kids[1:]:
+                out = bm.b_or(out, k)
+            return out
+        if name == "Intersect":
+            out = kids[0]
+            for k in kids[1:]:
+                out = bm.b_and(out, k)
+            return out
+        if name == "Difference":
+            out = kids[0]
+            for k in kids[1:]:
+                out = bm.b_andnot(out, k)
+            return out
+        if name == "Xor":
+            out = kids[0]
+            for k in kids[1:]:
+                out = bm.b_xor(out, k)
+            return out
+        if name == "Not":
+            exist = idx.existence_field().device_row_stack(0, shards)
+            return bm.b_andnot(exist, kids[0])
+        raise ExecutionError(f"unsupported fused call: {name}")
+
     def _execute_bitmap_call(self, idx, call: Call, shards, opt: ExecOptions) -> Row:
         self._validate_call_fields(idx, call)
         shards = self._target_shards(idx, shards, opt)
         row = Row()
 
-        def map_fn(shard):
-            return shard, self._bitmap_words_shard(idx, call, shard)
+        if (len(shards) > 1 and not self._cluster_active(opt)
+                and self._fused_supported(idx, call)):
+            stack = np.asarray(self._fused_eval(idx, call, tuple(shards)))
+            for i, shard in enumerate(shards):
+                if stack[i].any():
+                    row.segments[shard] = stack[i]
+        else:
+            def map_fn(shard):
+                return shard, self._bitmap_words_shard(idx, call, shard)
 
-        partials = self._map_shards(
-            map_fn, shards, idx=idx, call=call, opt=opt,
-            adapt=lambda r: list(r.segments.items()),
-        )
-        for shard, words in partials:
-            w = self._np_words(words)
-            if w is not None and w.any():
-                row.segments[shard] = w
+            partials = self._map_shards(
+                map_fn, shards, idx=idx, call=call, opt=opt,
+                adapt=lambda r: list(r.segments.items()),
+            )
+            for shard, words in partials:
+                w = self._np_words(words)
+                if w is not None and w.any():
+                    row.segments[shard] = w
 
         # Attach row attributes for plain Row() queries (reference
         # executor.go:206 attachment; skipped when excluded).
@@ -446,6 +527,13 @@ class Executor:
             raise ExecutionError("Count() requires a single bitmap query")
         shards = self._target_shards(idx, shards, opt)
         child = call.children[0]
+        if (len(shards) > 1 and not self._cluster_active(opt)
+                and self._fused_supported(idx, child)):
+            # all shards in one fused AND/OR/popcount dispatch; reduce
+            # per shard and sum in Python ints — a single int32 reduce
+            # over the whole stack could wrap past 2^31 set bits
+            stack = self._fused_eval(idx, child, tuple(shards))
+            return int(np.asarray(bm.row_counts(stack), dtype=np.int64).sum())
 
         def map_fn(shard):
             words = self._bitmap_words_shard(idx, child, shard)

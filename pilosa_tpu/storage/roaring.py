@@ -26,6 +26,8 @@ from pilosa_tpu.native_loader import NativeLib
 WORDS_PER_CONTAINER = 1024
 CONTAINER_BITS = 1 << 16
 MAGIC = 12348
+COOKIE_OFFICIAL = 12346       # official roaring, no run containers
+COOKIE_OFFICIAL_RUNS = 12347  # official roaring + run-flag bitset
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _SRC = os.path.join(_NATIVE_DIR, "roaring_codec.cpp")
@@ -83,7 +85,18 @@ class RoaringError(ValueError):
 
 
 def decode(data: bytes) -> tuple[np.ndarray, np.ndarray, int]:
-    """Parse serialized roaring -> (keys u64[n], words u64[n,1024], flags)."""
+    """Parse serialized roaring -> (keys u64[n], words u64[n,1024], flags).
+
+    Accepts both the pilosa 64-bit format (cookie 12348) and the
+    official 32-bit roaring interchange format (cookies 12346/12347),
+    like the reference's UnmarshalBinary (roaring/unmarshal_binary.go
+    handles both; the official-format golden file is
+    roaring/testdata/bitmapcontainer.roaringbitmap)."""
+    if len(data) >= 4:
+        cookie16 = int.from_bytes(data[:2], "little")
+        cookie32 = int.from_bytes(data[:4], "little")
+        if cookie32 == COOKIE_OFFICIAL or cookie16 == COOKIE_OFFICIAL_RUNS:
+            return _decode_official(data)
     lib = _load_native()
     if lib is not None:
         keys_p = ctypes.POINTER(ctypes.c_uint64)()
@@ -159,6 +172,79 @@ def _decode_py(data: bytes) -> tuple[np.ndarray, np.ndarray, int]:
         else:
             raise RoaringError("unknown container type")
     return keys, words, flags
+
+
+def _decode_official(data: bytes) -> tuple[np.ndarray, np.ndarray, int]:
+    """Official 32-bit roaring (RoaringFormatSpec) -> dense containers
+    with 16-bit keys widened to 64 (reference readOffsets/readWithRuns,
+    roaring/unmarshal_binary.go)."""
+    buf = memoryview(data)
+    if len(buf) < 4:
+        raise RoaringError("truncated roaring data")
+    cookie16 = int.from_bytes(buf[:2], "little")
+    has_runs = cookie16 == COOKIE_OFFICIAL_RUNS
+    pos = 0
+    if has_runs:
+        n = (int.from_bytes(buf[2:4], "little")) + 1
+        pos = 4
+        run_flag_bytes = (n + 7) // 8
+        if len(buf) < pos + run_flag_bytes:
+            raise RoaringError("truncated roaring data")
+        run_flags = np.unpackbits(
+            np.frombuffer(buf[pos:pos + run_flag_bytes], dtype=np.uint8),
+            bitorder="little")[:n]
+        pos += run_flag_bytes
+    else:
+        if len(buf) < 8:
+            raise RoaringError("truncated roaring data")
+        n = int.from_bytes(buf[4:8], "little")
+        pos = 8
+        run_flags = np.zeros(n, dtype=np.uint8)
+    if len(buf) < pos + 4 * n:
+        raise RoaringError("truncated roaring data")
+    desc = np.frombuffer(buf[pos:pos + 4 * n], dtype=np.uint16).reshape(n, 2)
+    keys16 = desc[:, 0].astype(np.int64)
+    cards = desc[:, 1].astype(np.int64) + 1
+    pos += 4 * n
+    # offset header present unless (runs format and n < 4)
+    if not has_runs or n >= 4:
+        if len(buf) < pos + 4 * n:
+            raise RoaringError("truncated roaring data")
+        pos += 4 * n  # offsets unused: containers are contiguous anyway
+    keys = keys16.astype(np.uint64)
+    words = np.zeros((n, WORDS_PER_CONTAINER), dtype=np.uint64)
+    for i in range(n):
+        card = int(cards[i])
+        if run_flags[i]:
+            if len(buf) < pos + 2:
+                raise RoaringError("truncated roaring data")
+            rc = int.from_bytes(buf[pos:pos + 2], "little")
+            pos += 2
+            if len(buf) < pos + 4 * rc:
+                raise RoaringError("truncated roaring data")
+            runs = np.frombuffer(buf[pos:pos + 4 * rc],
+                                 dtype=np.uint16).reshape(rc, 2)
+            pos += 4 * rc
+            bits = np.zeros(CONTAINER_BITS, dtype=bool)
+            # official runs are (start, length-1)
+            for start, length in runs.astype(np.int64):
+                bits[start:start + length + 1] = True
+            words[i] = np.packbits(bits, bitorder="little").view(np.uint64)
+        elif card <= 4096:  # array container
+            if len(buf) < pos + 2 * card:
+                raise RoaringError("truncated roaring data")
+            vals = np.frombuffer(buf[pos:pos + 2 * card],
+                                 dtype=np.uint16).astype(np.int64)
+            pos += 2 * card
+            np.bitwise_or.at(words[i], vals // 64,
+                             np.uint64(1) << (vals % 64).astype(np.uint64))
+        else:  # bitmap container
+            if len(buf) < pos + 8192:
+                raise RoaringError("truncated roaring data")
+            words[i].view(np.uint8)[:] = np.frombuffer(
+                buf[pos:pos + 8192], dtype=np.uint8)
+            pos += 8192
+    return keys, words, 0
 
 
 # --------------------------------------------------------------- encode

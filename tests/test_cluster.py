@@ -331,6 +331,9 @@ class TestClusterRegressions:
         nodes[0].create_index("i")
         nodes[0].create_field("i", "f")
         spread_writes(nodes[0], n_shards=9)
+        # warm every node's kernels so the timing below measures fan-out
+        # concurrency, not first-compile latency
+        nodes[0].executor.execute("i", "Count(Row(f=1))")
 
         real_query = transport.query_node
         delay = 0.15
