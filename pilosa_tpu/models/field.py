@@ -147,6 +147,9 @@ class FieldOptions:
 
 
 class Field:
+    #: device-memory budget for cross-shard row-stack caching (bytes)
+    ROW_STACK_CACHE_BYTES = 512 << 20
+
     def __init__(self, path: str | None, index: str, name: str, options: FieldOptions):
         validate_name(name)
         self.path = path
@@ -349,10 +352,19 @@ class Field:
                     if arr is not None:
                         stack[i] = arr
         dev = jax.device_put(stack)
+        entry_bytes = stack.nbytes
         with self._lock:
-            if len(self._row_stack_cache) >= 64:  # bounded
-                self._row_stack_cache.pop(next(iter(self._row_stack_cache)))
-            self._row_stack_cache[key] = (gens, dev)
+            # bound by BYTES, not entries — one wide-index entry can be
+            # tens of MB of device memory
+            total = sum(e[1].nbytes for e in self._row_stack_cache.values())
+            while self._row_stack_cache and (
+                    total + entry_bytes > self.ROW_STACK_CACHE_BYTES
+                    or len(self._row_stack_cache) >= 64):
+                _, evicted = self._row_stack_cache.pop(
+                    next(iter(self._row_stack_cache)))
+                total -= evicted.nbytes
+            if entry_bytes <= self.ROW_STACK_CACHE_BYTES:
+                self._row_stack_cache[key] = (gens, dev)
         return dev
 
     def row_time(self, row_id: int, shard: int, start, end) -> np.ndarray | None:

@@ -352,7 +352,9 @@ class Executor:
             stack = np.asarray(self._fused_eval(idx, call, tuple(shards)))
             for i, shard in enumerate(shards):
                 if stack[i].any():
-                    row.segments[shard] = stack[i]
+                    # copy: a view would pin the whole stack in memory
+                    # for as long as one sparse segment lives
+                    row.segments[shard] = stack[i].copy()
         else:
             def map_fn(shard):
                 return shard, self._bitmap_words_shard(idx, call, shard)

@@ -11,7 +11,7 @@ import pytest
 from pilosa_tpu.models.field import FieldOptions
 from pilosa_tpu.models.holder import Holder
 from pilosa_tpu.models.row import Row
-from pilosa_tpu.parallel.executor import ExecOptions, Executor
+from pilosa_tpu.parallel.executor import Executor
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 from tests.test_fuzz_stress import gen_query
@@ -60,10 +60,7 @@ class TestFusedEquivalence:
     def test_matches_per_shard_path(self, ex, q):
         fused = ex.execute("i", q)[0]
         general = _general(ex, q)[0]
-        if isinstance(fused, Row):
-            assert fused == general
-        else:
-            assert fused == general
+        assert fused == general  # Row.__eq__ compares segments exactly
 
     def test_randomized_equivalence(self, ex):
         rng = random.Random(3)
