@@ -344,15 +344,32 @@ class Field:
             if hit is not None and hit[0] == gens:
                 return hit[1]
         n_words = bm.n_words(SHARD_WIDTH)
-        stack = np.zeros((len(shards), n_words), dtype=np.uint32)
+        n_dev = len(jax.devices())
+        # pad the shard axis to the device count so the stack shards
+        # evenly over the mesh; padding rows are zero (no bits)
+        n_rows = len(shards)
+        if n_dev > 1:
+            n_rows = ((n_rows + n_dev - 1) // n_dev) * n_dev
+        stack = np.zeros((n_rows, n_words), dtype=np.uint32)
         for i, frag in enumerate(frags):
             if frag is not None:
                 with frag._lock:
                     arr = frag._rows.get(row_id)
                     if arr is not None:
                         stack[i] = arr
-        dev = jax.device_put(stack)
+        if n_dev > 1:
+            # multi-chip: shard the stack over the device mesh so XLA
+            # partitions the set algebra + popcount across chips with
+            # ICI collectives for the reduction (SURVEY.md §7 step 4 —
+            # the executor's shard batch IS the mesh's data axis)
+            from pilosa_tpu.parallel import mesh as pmesh
+
+            dev = pmesh.shard_stack(pmesh.device_mesh(), stack)
+        else:
+            dev = jax.device_put(stack)
         entry_bytes = stack.nbytes
+        if entry_bytes > self.ROW_STACK_CACHE_BYTES:
+            return dev  # uncacheable; never evict the warm cache for it
         with self._lock:
             # bound by BYTES, not entries — one wide-index entry can be
             # tens of MB of device memory
@@ -363,8 +380,7 @@ class Field:
                 _, evicted = self._row_stack_cache.pop(
                     next(iter(self._row_stack_cache)))
                 total -= evicted.nbytes
-            if entry_bytes <= self.ROW_STACK_CACHE_BYTES:
-                self._row_stack_cache[key] = (gens, dev)
+            self._row_stack_cache[key] = (gens, dev)
         return dev
 
     def row_time(self, row_id: int, shard: int, start, end) -> np.ndarray | None:
