@@ -43,6 +43,17 @@ DEFAULT_CACHE_SIZE = 50000
 FALSE_ROW_ID = 0
 TRUE_ROW_ID = 1
 
+
+def _padded_rows(n: int) -> int:
+    """Pad the shard axis to the device count so stacks shard evenly
+    over the mesh; padding rows are zero (no bits)."""
+    import jax
+
+    n_dev = len(jax.devices())
+    if n_dev <= 1:
+        return n
+    return ((n + n_dev - 1) // n_dev) * n_dev
+
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
 # Internal names (the hidden existence field) carry a leading underscore and
 # bypass user-name validation, as in the reference (holder.go:46).
@@ -329,8 +340,6 @@ class Field:
         contribute zero rows (semantically identical to the per-shard
         None propagation).  Cached per (row, shards) and invalidated by
         the per-fragment mutation generations."""
-        import jax
-
         from pilosa_tpu.ops import bitmap as bm
 
         view = self.view(VIEW_STANDARD)
@@ -344,24 +353,25 @@ class Field:
             if hit is not None and hit[0] == gens:
                 return hit[1]
         n_words = bm.n_words(SHARD_WIDTH)
-        n_dev = len(jax.devices())
-        # pad the shard axis to the device count so the stack shards
-        # evenly over the mesh; padding rows are zero (no bits)
-        n_rows = len(shards)
-        if n_dev > 1:
-            n_rows = ((n_rows + n_dev - 1) // n_dev) * n_dev
-        stack = np.zeros((n_rows, n_words), dtype=np.uint32)
+        stack = np.zeros((_padded_rows(len(shards)), n_words),
+                         dtype=np.uint32)
         for i, frag in enumerate(frags):
             if frag is not None:
                 with frag._lock:
                     arr = frag._rows.get(row_id)
                     if arr is not None:
                         stack[i] = arr
-        if n_dev > 1:
-            # multi-chip: shard the stack over the device mesh so XLA
-            # partitions the set algebra + popcount across chips with
-            # ICI collectives for the reduction (SURVEY.md §7 step 4 —
-            # the executor's shard batch IS the mesh's data axis)
+        return self._place_and_cache_stack(key, gens, stack)
+
+    def _place_and_cache_stack(self, key, gens, stack: np.ndarray):
+        """Place a host stack on device — sharded over the mesh when
+        more than one chip is visible, so XLA partitions the set algebra
+        + reductions across chips with ICI collectives (SURVEY.md §7
+        step 4: the executor's shard batch IS the mesh's data axis) —
+        then cache it under a byte budget."""
+        import jax
+
+        if len(jax.devices()) > 1:
             from pilosa_tpu.parallel import mesh as pmesh
 
             dev = pmesh.shard_stack(pmesh.device_mesh(), stack)
@@ -398,6 +408,39 @@ class Field:
                 continue
             out = words if out is None else (out | words)
         return out
+
+    def device_plane_stack(self, shards: tuple[int, ...]):
+        """BSI plane stacks across shards as one device-resident uint32
+        [n_shards, planes, words] tensor (planes = exists, sign, then
+        bit_depth value planes) — the fused Sum path's operand.  Cached
+        and generation-invalidated like device_row_stack; shard axis is
+        padded and mesh-sharded the same way."""
+        from pilosa_tpu.ops import bitmap as bm
+        from pilosa_tpu.ops import bsi as bsi_ops
+
+        self._require_int()
+        depth = self.options.bit_depth
+        view = self.view(self.bsi_view_name)
+        key = ("planes", shards, depth)
+        frags = [None if view is None else view.fragment(s) for s in shards]
+        gens = tuple(0 if fr is None else fr._gen for fr in frags)
+        with self._lock:
+            hit = self._row_stack_cache.get(key)
+            if hit is not None and hit[0] == gens:
+                return hit[1]
+        n_words = bm.n_words(SHARD_WIDTH)
+        n_planes = bsi_ops.OFFSET_PLANE + depth
+        stack = np.zeros((_padded_rows(len(shards)), n_planes, n_words),
+                         dtype=np.uint32)
+        for i, frag in enumerate(frags):
+            if frag is None:
+                continue
+            with frag._lock:
+                for p in range(n_planes):
+                    arr = frag._rows.get(p)
+                    if arr is not None:
+                        stack[i, p] = arr
+        return self._place_and_cache_stack(key, gens, stack)
 
     # ------------------------------------------------------------ BSI ops
 

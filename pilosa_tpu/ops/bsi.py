@@ -91,6 +91,27 @@ def plane_counts(P, consider):
 
 
 @jax.jit
+def plane_counts_stacked(P, consider):
+    """Batched plane counts over a [shards, planes, words] stack ->
+    (pos int32[S, depth], neg int32[S, depth], count int32[S]).
+
+    Per-shard counts stay < 2^20 so int32 is exact; the caller sums
+    across shards in Python ints (the fused executor Sum path — one
+    dispatch for all shards instead of one per shard)."""
+    sign = P[:, SIGN_PLANE]
+    prow = consider & ~sign
+    nrow = consider & sign
+    planes = P[:, OFFSET_PLANE:]
+    pos = jnp.sum(lax.population_count(planes & prow[:, None, :]),
+                  axis=2, dtype=jnp.int32)
+    neg = jnp.sum(lax.population_count(planes & nrow[:, None, :]),
+                  axis=2, dtype=jnp.int32)
+    count = jnp.sum(lax.population_count(consider), axis=1,
+                    dtype=jnp.int32)
+    return pos, neg, count
+
+
+@jax.jit
 def extreme_max(P, filt):
     """Unsigned max under ``filt`` -> (taken int32[depth], count int32).
 

@@ -79,6 +79,7 @@ class Executor:
         self.stats = _stats.NOP  # injected by the server assembly
         self.logger = None
         self.long_query_time = 0.0  # seconds; 0 disables slow-query log
+        self.fuse_shards = True  # master switch for fused all-shard paths
         self.pool = ThreadPoolExecutor(max_workers=worker_pool_size or 8)
 
     # ------------------------------------------------------------- public
@@ -347,7 +348,8 @@ class Executor:
         shards = self._target_shards(idx, shards, opt)
         row = Row()
 
-        if (len(shards) > 1 and not self._cluster_active(opt)
+        if (self.fuse_shards and len(shards) > 1
+                and not self._cluster_active(opt)
                 and self._fused_supported(idx, call)):
             stack = np.asarray(self._fused_eval(idx, call, tuple(shards)))
             for i, shard in enumerate(shards):
@@ -529,7 +531,8 @@ class Executor:
             raise ExecutionError("Count() requires a single bitmap query")
         shards = self._target_shards(idx, shards, opt)
         child = call.children[0]
-        if (len(shards) > 1 and not self._cluster_active(opt)
+        if (self.fuse_shards and len(shards) > 1
+                and not self._cluster_active(opt)
                 and self._fused_supported(idx, child)):
             # all shards in one fused AND/OR/popcount dispatch; reduce
             # per shard and sum in Python ints — a single int32 reduce
@@ -792,6 +795,14 @@ class Executor:
             raise ExecutionError(f"{call.name}() requires a field argument")
         f = self._field(idx, fname)
         shards = self._target_shards(idx, shards, opt)
+
+        if (self.fuse_shards and call.name == "Sum" and len(shards) > 1
+                and not self._cluster_active(opt)
+                and f.options.type == FieldType.INT
+                and (not call.children
+                     or self._fused_supported(idx, call.children[0]))):
+            return self._fused_sum(idx, f, call, tuple(shards))
+
         filter_row = self._local_filter_row(idx, call, shards, opt)
 
         if call.name == "Sum":
@@ -822,6 +833,27 @@ class Executor:
         ):
             out = getattr(out, reducer)(vc)
         return out
+
+    def _fused_sum(self, idx, f, call: Call, shards: tuple[int, ...]) -> ValCount:
+        """Sum over all shards in one stacked dispatch: plane counts from
+        the [S, planes, W] BSI stack, exact assembly in Python ints
+        (reference fragment.sum per shard, fragment.go:1111; here the
+        shard loop is the stack's leading axis)."""
+        from pilosa_tpu.ops import bsi as bsi_ops
+
+        P = f.device_plane_stack(shards)
+        consider = P[:, bsi_ops.EXISTS_PLANE]
+        if call.children:
+            filt = self._fused_eval(idx, call.children[0], shards)
+            # the filter stack is padded to the same device multiple
+            consider = consider & filt
+        pos, neg, count = bsi_ops.plane_counts_stacked(P, consider)
+        pos = np.asarray(pos, dtype=np.int64).sum(axis=0)
+        neg = np.asarray(neg, dtype=np.int64).sum(axis=0)
+        total_count = int(np.asarray(count, dtype=np.int64).sum())
+        total = sum((1 << i) * (int(p) - int(n))
+                    for i, (p, n) in enumerate(zip(pos, neg)))
+        return ValCount(total + total_count * f.options.base, total_count)
 
     def _execute_extreme_row(self, idx, call: Call, shards, opt: ExecOptions) -> Pair:
         """MinRow/MaxRow (reference executeMinRow/executeMaxRow,

@@ -36,14 +36,12 @@ def ex(tmp_path):
 
 
 def _general(ex, q):
-    """Force the per-shard path by capping the visible shard set to a
-    per-shard loop (cluster inactive but fused disabled via monkey)."""
-    orig = ex._fused_supported
-    ex._fused_supported = lambda *a, **k: False
+    """Force the per-shard path via the executor's master fuse switch."""
+    ex.fuse_shards = False
     try:
         return ex.execute("i", q)
     finally:
-        ex._fused_supported = orig
+        ex.fuse_shards = True
 
 
 class TestFusedEquivalence:
@@ -113,6 +111,58 @@ class TestFusedEquivalence:
         fused = ex.execute("i", "Count(Row(f0=1))")[0]
         general = _general(ex, "Count(Row(f0=1))")[0]
         assert fused == general
+
+    def test_fused_sum_matches_per_shard(self, ex):
+        rng = random.Random(5)
+        idx = ex.holder.index("i")
+        ex.holder.index("i").create_field(
+            "val", FieldOptions.int_field(-500, 1000))
+        f = idx.field("val")
+        oracle = {}
+        cols, vals = [], []
+        for _ in range(400):
+            c = rng.randrange(6 * SHARD_WIDTH)
+            v = rng.randrange(-500, 1000)
+            oracle[c] = v
+            cols.append(c)
+            vals.append(v)
+        # last write wins for duplicate columns in the oracle;
+        # import per-column so the field agrees
+        for c, v in oracle.items():
+            f.set_value(c, v)
+
+        fused = ex.execute("i", "Sum(field=val)")[0]
+        assert (fused.val, fused.count) == (sum(oracle.values()),
+                                            len(oracle))
+        general = _general(ex, "Sum(field=val)")[0]
+        assert (fused.val, fused.count) == (general.val, general.count)
+
+        # filtered by a fused-supported bitmap
+        filt_cols = set(list(oracle)[::2])
+        f0 = idx.field("f0")
+        f0.import_bits([9] * len(filt_cols), sorted(filt_cols))
+        fused = ex.execute("i", "Sum(Row(f0=9), field=val)")[0]
+        want = sum(v for c, v in oracle.items() if c in filt_cols)
+        assert (fused.val, fused.count) == (want, len(filt_cols))
+        general = _general(ex, "Sum(Row(f0=9), field=val)")[0]
+        assert (general.val, general.count) == (want, len(filt_cols))
+
+    def test_fused_sum_engages(self, ex):
+        idx = ex.holder.index("i")
+        idx.create_field("v2", FieldOptions.int_field(0, 100))
+        idx.field("v2").set_value(1, 7)
+        idx.field("v2").set_value(SHARD_WIDTH + 1, 9)
+        hits = {"n": 0}
+        orig = ex._fused_sum
+
+        def spy(*a, **k):
+            hits["n"] += 1
+            return orig(*a, **k)
+
+        ex._fused_sum = spy
+        out = ex.execute("i", "Sum(field=v2)")[0]
+        assert (out.val, out.count) == (16, 2)
+        assert hits["n"] == 1
 
     def test_cache_invalidation_on_write(self, ex):
         q = "Count(Row(f0=1))"
