@@ -21,6 +21,8 @@ fragment/executor — the predicate value is query text, so no recompilation.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -109,6 +111,29 @@ def plane_counts_stacked(P, consider):
     count = jnp.sum(lax.population_count(consider), axis=1,
                     dtype=jnp.int32)
     return pos, neg, count
+
+
+@functools.partial(jax.jit, static_argnames=("want",))
+def extremes_stacked(P, consider, want: str):
+    """Batched Min/Max scan over a [shards, planes, words] stack.
+
+    `want` selects which two scans run ("min": neg-magnitude max +
+    all-magnitude min; "max": pos-magnitude max + all-magnitude min) —
+    each query needs exactly two of the three possible scans.  Returns
+    per-shard arrays (signed_cnt, all_cnt int32[S], primary_taken,
+    fallback_taken int32[S, depth], primary_n, fallback_n int32[S]) for
+    the host to apply fragment.min/max's sign-branching
+    (fragment.go:1147/1191) without a device sync per shard."""
+    sign = P[:, SIGN_PLANE]
+    selected = consider & sign if want == "min" else consider & ~sign
+    signed_cnt = jnp.sum(lax.population_count(selected), axis=1,
+                         dtype=jnp.int32)
+    all_cnt = jnp.sum(lax.population_count(consider), axis=1,
+                      dtype=jnp.int32)
+    primary_taken, primary_n = jax.vmap(extreme_max)(P, selected)
+    fallback_taken, fallback_n = jax.vmap(extreme_min)(P, consider)
+    return (signed_cnt, all_cnt, primary_taken, fallback_taken,
+            primary_n, fallback_n)
 
 
 @jax.jit

@@ -796,12 +796,14 @@ class Executor:
         f = self._field(idx, fname)
         shards = self._target_shards(idx, shards, opt)
 
-        if (self.fuse_shards and call.name == "Sum" and len(shards) > 1
+        if (self.fuse_shards and len(shards) > 1
                 and not self._cluster_active(opt)
                 and f.options.type == FieldType.INT
                 and (not call.children
                      or self._fused_supported(idx, call.children[0]))):
-            return self._fused_sum(idx, f, call, tuple(shards))
+            if call.name == "Sum":
+                return self._fused_sum(idx, f, call, tuple(shards))
+            return self._fused_extreme(idx, f, call, tuple(shards))
 
         filter_row = self._local_filter_row(idx, call, shards, opt)
 
@@ -854,6 +856,48 @@ class Executor:
         total = sum((1 << i) * (int(p) - int(n))
                     for i, (p, n) in enumerate(zip(pos, neg)))
         return ValCount(total + total_count * f.options.base, total_count)
+
+    def _fused_extreme(self, idx, f, call: Call,
+                       shards: tuple[int, ...]) -> ValCount:
+        """Min/Max over all shards from one stacked dispatch: the
+        vmapped extreme scans produce every per-shard candidate; the
+        host applies the sign-branching of fragment.min/max
+        (fragment.go:1147/1191) and folds with smaller/larger."""
+        from pilosa_tpu.ops import bsi as bsi_ops
+
+        P = f.device_plane_stack(shards)
+        consider = P[:, bsi_ops.EXISTS_PLANE]
+        if call.children:
+            consider = consider & self._fused_eval(idx, call.children[0],
+                                                   shards)
+        is_min = call.name == "Min"
+        want = "min" if is_min else "max"
+        (signed_cnt, all_cnt, primary_taken, fallback_taken,
+         primary_n, fallback_n) = [
+            np.asarray(x)
+            for x in bsi_ops.extremes_stacked(P, consider, want)]
+
+        reducer = "smaller" if is_min else "larger"
+        out = ValCount()
+        for s in range(len(shards)):
+            if all_cnt[s] == 0:
+                continue
+            if signed_cnt[s] > 0:
+                # Min: a negative exists -> largest negative magnitude;
+                # Max: a positive exists -> largest positive magnitude
+                v = bsi_ops.assemble_value(primary_taken[s])
+                if is_min:
+                    v = -v
+                c = int(primary_n[s])
+            else:
+                # fallback: smallest magnitude among what remains
+                v = bsi_ops.assemble_value(fallback_taken[s])
+                if not is_min:
+                    v = -v  # Max of all-negative = closest to zero
+                c = int(fallback_n[s])
+            out = getattr(out, reducer)(
+                ValCount(v + f.options.base, c))
+        return out
 
     def _execute_extreme_row(self, idx, call: Call, shards, opt: ExecOptions) -> Pair:
         """MinRow/MaxRow (reference executeMinRow/executeMaxRow,

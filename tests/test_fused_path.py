@@ -119,15 +119,8 @@ class TestFusedEquivalence:
             "val", FieldOptions.int_field(-500, 1000))
         f = idx.field("val")
         oracle = {}
-        cols, vals = [], []
         for _ in range(400):
-            c = rng.randrange(6 * SHARD_WIDTH)
-            v = rng.randrange(-500, 1000)
-            oracle[c] = v
-            cols.append(c)
-            vals.append(v)
-        # last write wins for duplicate columns in the oracle;
-        # import per-column so the field agrees
+            oracle[rng.randrange(6 * SHARD_WIDTH)] = rng.randrange(-500, 1000)
         for c, v in oracle.items():
             f.set_value(c, v)
 
@@ -146,6 +139,48 @@ class TestFusedEquivalence:
         assert (fused.val, fused.count) == (want, len(filt_cols))
         general = _general(ex, "Sum(Row(f0=9), field=val)")[0]
         assert (general.val, general.count) == (want, len(filt_cols))
+
+    def test_fused_min_max_matches_per_shard(self, ex):
+        rng = random.Random(13)
+        idx = ex.holder.index("i")
+        idx.create_field("m", FieldOptions.int_field(-900, 900))
+        f = idx.field("m")
+        oracle = {}
+        for _ in range(300):
+            c = rng.randrange(6 * SHARD_WIDTH)
+            oracle[c] = rng.randrange(-900, 900)
+        for c, v in oracle.items():
+            f.set_value(c, v)
+        for q, want in [("Min(field=m)", min(oracle.values())),
+                        ("Max(field=m)", max(oracle.values()))]:
+            fused = ex.execute("i", q)[0]
+            general = _general(ex, q)[0]
+            assert fused.val == want, (q, fused.val, want)
+            assert (fused.val, fused.count) == (general.val, general.count)
+        # filtered variants
+        filt_cols = set(list(oracle)[::3])
+        f0 = idx.field("f0")
+        f0.import_bits([8] * len(filt_cols), sorted(filt_cols))
+        sub = [v for c, v in oracle.items() if c in filt_cols]
+        for q, want in [("Min(Row(f0=8), field=m)", min(sub)),
+                        ("Max(Row(f0=8), field=m)", max(sub))]:
+            fused = ex.execute("i", q)[0]
+            general = _general(ex, q)[0]
+            assert fused.val == want, (q, fused.val, want)
+            assert (fused.val, fused.count) == (general.val, general.count)
+
+    def test_fused_min_max_all_negative_and_empty(self, ex):
+        idx = ex.holder.index("i")
+        idx.create_field("neg", FieldOptions.int_field(-100, 100))
+        f = idx.field("neg")
+        f.set_value(1, -5)
+        f.set_value(SHARD_WIDTH + 2, -70)
+        assert ex.execute("i", "Min(field=neg)")[0].val == -70
+        assert ex.execute("i", "Max(field=neg)")[0].val == -5
+        idx.create_field("empty", FieldOptions.int_field(0, 10))
+        # ensure multiple shards exist in the index so the fused gate opens
+        out = ex.execute("i", "Min(field=empty)")[0]
+        assert (out.val, out.count) == (0, 0)
 
     def test_fused_sum_engages(self, ex):
         idx = ex.holder.index("i")
