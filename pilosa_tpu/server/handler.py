@@ -132,6 +132,10 @@ def _field_row_dict(fr) -> dict:
     return d
 
 
+# Upper bound on accepted request bodies; large enough for bulk roaring
+# imports, small enough that one request cannot exhaust host memory.
+MAX_REQUEST_BYTES = 256 << 20
+
 # (method, compiled path regex) -> handler-method name
 _ROUTES: list[tuple[str, re.Pattern, str]] = []
 
@@ -240,6 +244,15 @@ class Handler:
             try:
                 body = b""
                 length = int(req.headers.get("Content-Length") or 0)
+                if length > MAX_REQUEST_BYTES:
+                    # the body stays unread; the keep-alive connection
+                    # must close or its bytes would parse as the next
+                    # request
+                    req.close_connection = True
+                    self._error(req, 413,
+                                f"request body exceeds "
+                                f"{MAX_REQUEST_BYTES} bytes")
+                    return
                 if length:
                     body = req.rfile.read(length)
                 getattr(self, name)(req, params, match.groupdict(), body)

@@ -144,6 +144,19 @@ class TestSingleNodeHTTP:
                   {"query": "Options(Row(f=10), columnAttrs=true)"})
         assert r["columnAttrs"] == [{"id": 1, "attrs": {"city": "ny"}}]
 
+    def test_oversized_body_rejected(self, srv):
+        import pilosa_tpu.server.handler as handler_mod
+
+        orig = handler_mod.MAX_REQUEST_BYTES
+        handler_mod.MAX_REQUEST_BYTES = 1024
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                _post(srv.uri, "/index/big", raw=b"x" * 2048,
+                      ctype="text/plain")
+            assert e.value.code == 413
+        finally:
+            handler_mod.MAX_REQUEST_BYTES = orig
+
     def test_delete_index_and_field(self, srv):
         _post(srv.uri, "/index/i")
         _post(srv.uri, "/index/i/field/f")
