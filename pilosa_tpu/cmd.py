@@ -285,11 +285,12 @@ def cmd_export(args) -> int:
 def cmd_check(args) -> int:
     """Open every fragment offline and verify snapshot+WAL load, matrix
     consistency, and roaring round-trip (reference ctl/check.go:30)."""
-    from pilosa_tpu.models.holder import Holder
     from pilosa_tpu.storage.roaring import decode as decode_roaring
 
     bad = 0
-    holder = Holder(args.data_dir)
+    holder = _open_holder_or_report(args.data_dir)
+    if holder is None:
+        return 1
     for d in holder.schema():
         idx = holder.index(d["name"])
         for f in idx.all_fields():
@@ -312,10 +313,23 @@ def cmd_check(args) -> int:
 
 # --------------------------------------------------------------- inspect
 
-def cmd_inspect(args) -> int:
+def _open_holder_or_report(data_dir: str):
+    """Open a data dir for the offline tools, reporting (instead of
+    tracebacking) when it is corrupt or locked by a live server."""
     from pilosa_tpu.models.holder import Holder
 
-    holder = Holder(args.data_dir)
+    try:
+        return Holder(data_dir)
+    except Exception as e:
+        print(f"FAIL open {data_dir}: {e}")
+        print("FAILED: holder did not open")
+        return None
+
+
+def cmd_inspect(args) -> int:
+    holder = _open_holder_or_report(args.data_dir)
+    if holder is None:
+        return 1
     for d in holder.schema():
         if args.index and d["name"] != args.index:
             continue

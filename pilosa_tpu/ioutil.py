@@ -1,0 +1,24 @@
+"""Small file-IO helpers shared across the storage tier."""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def atomic_write_json(path: str, obj) -> None:
+    """Write JSON atomically: tmp file + os.replace, cleaning the tmp on
+    failure.  Callers serialize per-file writes with their own locks, so
+    a fixed tmp name is safe and self-overwriting (no stale tmp
+    accumulation after crashes)."""
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass

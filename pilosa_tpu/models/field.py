@@ -214,10 +214,9 @@ class Field:
     def save_meta(self) -> None:
         if self.path is None:
             return
-        tmp = self._meta_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.options.to_dict(), f)
-        os.replace(tmp, self._meta_path)
+        from pilosa_tpu.ioutil import atomic_write_json
+
+        atomic_write_json(self._meta_path, self.options.to_dict())
 
     def _load_shards(self) -> None:
         if self.path is not None and os.path.exists(self._shards_path):
@@ -228,12 +227,12 @@ class Field:
             self._shards |= view.available_shards()
 
     def _save_shards(self) -> None:
+        # caller holds self._lock (serializing writers per field)
         if self.path is None:
             return
-        tmp = self._shards_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(sorted(self._shards), f)
-        os.replace(tmp, self._shards_path)
+        from pilosa_tpu.ioutil import atomic_write_json
+
+        atomic_write_json(self._shards_path, sorted(self._shards))
 
     def _open_views(self) -> None:
         views_dir = os.path.join(self.path, "views")
@@ -294,9 +293,10 @@ class Field:
             self._save_shards()
 
     def _note_shard(self, shard: int) -> None:
-        if shard not in self._shards:
-            self._shards.add(shard)
-            self._save_shards()
+        with self._lock:
+            if shard not in self._shards:
+                self._shards.add(shard)
+                self._save_shards()
 
     # ------------------------------------------------------------ bit ops
 

@@ -21,12 +21,48 @@ class Holder:
         self.indexes: dict[str, Index] = {}
         self._lock = threading.RLock()
         self.node_id: str = ""
+        self._lock_file = None
         if path is not None:
             os.makedirs(path, exist_ok=True)
-            self._load_node_id()
-            self._open_indexes()
+            self._acquire_dir_lock()
+            try:
+                self._load_node_id()
+                self._open_indexes()
+            except BaseException:
+                # a failed open must not leave the directory locked
+                self._release_dir_lock()
+                raise
         else:
             self.node_id = uuid.uuid4().hex
+
+    def _acquire_dir_lock(self) -> None:
+        """Exclusive flock on the data directory, held for the holder's
+        lifetime — a second process opening the same directory fails
+        fast instead of corrupting WALs (the reference flocks every
+        fragment file, fragment.go:311-458; one directory-level lock
+        gives the same protection with one fd)."""
+        import fcntl
+
+        self._lock_file = open(os.path.join(self.path, ".lock"), "w")
+        try:
+            fcntl.flock(self._lock_file, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError as e:
+            self._lock_file.close()
+            self._lock_file = None
+            raise RuntimeError(
+                f"data directory {self.path!r} is locked by another "
+                f"process") from e
+
+    def _release_dir_lock(self) -> None:
+        if getattr(self, "_lock_file", None) is not None:
+            import fcntl
+
+            try:
+                fcntl.flock(self._lock_file, fcntl.LOCK_UN)
+            except OSError:
+                pass
+            self._lock_file.close()
+            self._lock_file = None
 
     def _load_node_id(self) -> None:
         """Stable node identity in a .id file (reference holder.go:599)."""
@@ -111,8 +147,11 @@ class Holder:
                 )
 
     def close(self) -> None:
-        for idx in self.indexes.values():
-            idx.close()
+        try:
+            for idx in self.indexes.values():
+                idx.close()
+        finally:
+            self._release_dir_lock()
 
     def snapshot(self) -> None:
         for idx in self.indexes.values():

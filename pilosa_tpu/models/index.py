@@ -81,10 +81,9 @@ class Index:
     def save_meta(self) -> None:
         if self.path is None:
             return
-        tmp = self._meta_path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(self.options.to_dict(), f)
-        os.replace(tmp, self._meta_path)
+        from pilosa_tpu.ioutil import atomic_write_json
+
+        atomic_write_json(self._meta_path, self.options.to_dict())
 
     def _open_fields(self) -> None:
         for name in sorted(os.listdir(self.path)):
