@@ -291,22 +291,24 @@ def cmd_check(args) -> int:
     holder = _open_holder_or_report(args.data_dir)
     if holder is None:
         return 1
-    for d in holder.schema():
-        idx = holder.index(d["name"])
-        for f in idx.all_fields():
-            for vname, view in f.views.items():
-                for shard, frag in sorted(view.fragments.items()):
-                    label = f"{d['name']}/{f.name}/{vname}/{shard}"
-                    try:
-                        blob = frag.to_roaring()
-                        decode_roaring(blob)
-                        for r in frag.row_ids():
-                            frag.row_count(r)
-                        print(f"ok   {label}")
-                    except Exception as e:
-                        bad += 1
-                        print(f"FAIL {label}: {e}")
-    holder.close()
+    try:
+        for d in holder.schema():
+            idx = holder.index(d["name"])
+            for f in idx.all_fields():
+                for vname, view in f.views.items():
+                    for shard, frag in sorted(view.fragments.items()):
+                        label = f"{d['name']}/{f.name}/{vname}/{shard}"
+                        try:
+                            blob = frag.to_roaring()
+                            decode_roaring(blob)
+                            for r in frag.row_ids():
+                                frag.row_count(r)
+                            print(f"ok   {label}")
+                        except Exception as e:
+                            bad += 1
+                            print(f"FAIL {label}: {e}")
+    finally:
+        holder.close()
     print(f"{'FAILED' if bad else 'passed'}: {bad} corrupt fragment(s)")
     return 1 if bad else 0
 
@@ -330,21 +332,29 @@ def cmd_inspect(args) -> int:
     holder = _open_holder_or_report(args.data_dir)
     if holder is None:
         return 1
-    for d in holder.schema():
-        if args.index and d["name"] != args.index:
-            continue
-        idx = holder.index(d["name"])
-        for f in idx.all_fields():
-            if args.field and f.name != args.field:
+    bad = 0
+    try:
+        for d in holder.schema():
+            if args.index and d["name"] != args.index:
                 continue
-            for vname, view in sorted(f.views.items()):
-                for shard, frag in sorted(view.fragments.items()):
-                    ids = frag.row_ids()
-                    bits = sum(frag.row_count(r) for r in ids)
-                    print(f"{d['name']}/{f.name}/{vname}/shard={shard}: "
-                          f"rows={len(ids)} bits={bits} opN={frag._op_n}")
-    holder.close()
-    return 0
+            idx = holder.index(d["name"])
+            for f in idx.all_fields():
+                if args.field and f.name != args.field:
+                    continue
+                for vname, view in sorted(f.views.items()):
+                    for shard, frag in sorted(view.fragments.items()):
+                        label = f"{d['name']}/{f.name}/{vname}/shard={shard}"
+                        try:
+                            ids = frag.row_ids()
+                            bits = sum(frag.row_count(r) for r in ids)
+                            print(f"{label}: rows={len(ids)} bits={bits} "
+                                  f"opN={frag._op_n}")
+                        except Exception as e:
+                            bad += 1
+                            print(f"{label}: FAIL {e}")
+    finally:
+        holder.close()
+    return 1 if bad else 0
 
 
 # ---------------------------------------------------------------- config

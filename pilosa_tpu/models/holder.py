@@ -147,11 +147,19 @@ class Holder:
                 )
 
     def close(self) -> None:
-        try:
-            for idx in self.indexes.values():
+        # close EVERY index (continuing past failures) before releasing
+        # the flock — releasing with WAL fds still open would reopen the
+        # corruption window the lock exists to prevent
+        first_err: Exception | None = None
+        for idx in self.indexes.values():
+            try:
                 idx.close()
-        finally:
-            self._release_dir_lock()
+            except Exception as e:  # noqa: BLE001
+                if first_err is None:
+                    first_err = e
+        self._release_dir_lock()
+        if first_err is not None:
+            raise first_err
 
     def snapshot(self) -> None:
         for idx in self.indexes.values():

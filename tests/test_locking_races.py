@@ -53,8 +53,7 @@ class TestCheckDetectsCorruption:
         data = snaps[0].read_bytes()
         snaps[0].write_bytes(data[: len(data) // 2])
         rc = cli_main(["check", str(tmp_path / "d")])
-        out = capsys.readouterr().out
-        assert rc == 1 or "FAIL" in out or "0 corrupt" not in out
+        assert rc == 1
 
 
 class TestConcurrentAccess:
@@ -104,6 +103,7 @@ class TestConcurrentAccess:
             t.start()
         for t in threads:
             t.join(timeout=120)
+            assert not t.is_alive(), "worker thread hung (deadlock?)"
         assert not errors, errors[:3]
         # every write landed exactly once
         got = post("/index/i/query", {"query": "Count(Row(f=1))"})
@@ -150,4 +150,5 @@ class TestConcurrentAccess:
             t.start()
         for t in threads:
             t.join(timeout=120)
+            assert not t.is_alive(), "worker thread hung (deadlock?)"
         assert not errors, errors[:3]
