@@ -23,7 +23,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 # Cluster states (cluster.go:46-50)
 STATE_STARTING = "STARTING"

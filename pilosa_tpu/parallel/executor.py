@@ -40,7 +40,7 @@ from pilosa_tpu.parallel.results import (
     ValCount,
     sort_pairs,
 )
-from pilosa_tpu.pql import Call, Condition, Query, parse
+from pilosa_tpu.pql import Call, Query, parse
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 

@@ -17,9 +17,7 @@ from pilosa_tpu.api import API
 from pilosa_tpu.models.holder import Holder
 from pilosa_tpu.parallel.cluster import (
     Cluster,
-    Node,
     STATE_NORMAL,
-    STATE_STARTING,
     TransportError,
 )
 from pilosa_tpu.parallel.node import ClusterNode
