@@ -96,6 +96,50 @@ def gen_query(rng: random.Random, depth: int = 0) -> str:
     return f"{op}({children})"
 
 
+class TestDistributedAgreement:
+    def test_generated_queries_agree_1_vs_3_nodes(self, tmp_path):
+        """Every generated query answers identically on a single node
+        and on a 3-node replicated cluster — the reference runs its
+        whole executor suite against both (executor_test.go)."""
+        from pilosa_tpu.api import API
+        from pilosa_tpu.models.row import Row
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+        from tests.test_cluster import make_cluster
+
+        rng = random.Random(21)
+        data = {}  # (field, row) -> cols
+        for fi in range(3):
+            for row in range(5):
+                data[(f"f{fi}", row)] = sorted(
+                    {rng.randrange(5 * SHARD_WIDTH)
+                     for _ in range(rng.randrange(0, 60))})
+
+        def build(n):
+            _, nodes = make_cluster(tmp_path / f"c{n}", n=n, replica_n=2)
+            nodes[0].create_index("i")
+            api = API(nodes[0])
+            for fi in range(3):
+                nodes[0].create_field("i", f"f{fi}")
+            for (fname, row), cols in data.items():
+                if cols:
+                    api.import_bits("i", fname, [row] * len(cols), cols)
+            return nodes
+
+        single = build(1)[0]
+        cluster = build(3)
+        qrng = random.Random(22)
+        for _ in range(30):
+            q = gen_query(qrng)
+            want = single.executor.execute("i", q)[0]
+            for nd in cluster:
+                got = nd.executor.execute("i", q)[0]
+                if isinstance(want, Row):
+                    assert list(got.columns()) == list(want.columns()), (
+                        q, nd.cluster.local_id)
+                else:
+                    assert got == want, (q, nd.cluster.local_id)
+
+
 class TestQueryGeneratorStress:
     def test_generated_queries_parse_identically(self):
         rng = random.Random(7)
