@@ -139,6 +139,70 @@ class TestDistributedAgreement:
                 else:
                     assert got == want, (q, nd.cluster.local_id)
 
+    def test_aggregates_and_rankings_agree_1_vs_3_nodes(self, tmp_path):
+        """TopN / Sum / Min / Max / Rows / GroupBy / ClearRow answer
+        identically across cluster sizes."""
+        from pilosa_tpu.api import API
+        from pilosa_tpu.models.field import FieldOptions
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+        from tests.test_cluster import make_cluster
+
+        rng = random.Random(31)
+        bits = {(row): sorted({rng.randrange(4 * SHARD_WIDTH)
+                               for _ in range(rng.randrange(10, 120))})
+                for row in range(6)}
+        vals = {c: rng.randrange(-400, 400)
+                for c in rng.sample(range(4 * SHARD_WIDTH), 300)}
+
+        def build(n):
+            _, nodes = make_cluster(tmp_path / f"a{n}", n=n, replica_n=2)
+            nodes[0].create_index("i")
+            nodes[0].create_field("i", "f")
+            nodes[0].create_field("i", "v",
+                                  FieldOptions.int_field(-400, 400))
+            api = API(nodes[0])
+            for row, cols in bits.items():
+                api.import_bits("i", "f", [row] * len(cols), cols)
+            cs = sorted(vals)
+            api.import_values("i", "v", cs, [vals[c] for c in cs])
+            return nodes
+
+        single = build(1)[0]
+        cluster = build(3)
+        queries = [
+            "TopN(f, n=3)",
+            "TopN(f)",
+            "TopN(f, Row(f=0), n=2)",
+            "Sum(field=v)",
+            "Min(field=v)",
+            "Max(field=v)",
+            "Sum(Row(f=1), field=v)",
+            "MinRow(field=f)",
+            "MaxRow(field=f)",
+            "Rows(f)",
+            "Rows(f, limit=3)",
+            "GroupBy(Rows(f), limit=20)",
+            "Count(Row(v > 100))",
+            "Row(v >< [-100, 100])",
+        ]
+        from pilosa_tpu.models.row import Row as _Row
+
+        for q in queries:
+            want = single.executor.execute("i", q)[0]
+            for nd in cluster:
+                got = nd.executor.execute("i", q)[0]
+                if isinstance(want, _Row):
+                    assert list(got.columns()) == list(want.columns()), q
+                else:
+                    assert got == want, (q, nd.cluster.local_id, got, want)
+        # a write through one cluster node then re-check a ranking
+        API(cluster[1]).node.executor.execute("i", "ClearRow(f=0)")
+        single.executor.execute("i", "ClearRow(f=0)")
+        for nd in cluster:
+            got = nd.executor.execute("i", "TopN(f, n=3)")[0]
+            want = single.executor.execute("i", "TopN(f, n=3)")[0]
+            assert got == want
+
 
 class TestQueryGeneratorStress:
     def test_generated_queries_parse_identically(self):
