@@ -80,7 +80,12 @@ class Executor:
         self.logger = None
         self.long_query_time = 0.0  # seconds; 0 disables slow-query log
         self.fuse_shards = True  # master switch for fused all-shard paths
-        self.pool = ThreadPoolExecutor(max_workers=worker_pool_size or 8)
+        # pool size defaults to CPU count (reference worker pool =
+        # NumCPU, executor.go:80-104)
+        import os as _os
+
+        self.pool = ThreadPoolExecutor(
+            max_workers=worker_pool_size or _os.cpu_count() or 8)
 
     # ------------------------------------------------------------- public
 
