@@ -576,51 +576,96 @@ class Field:
         hi_v = min(hi_v, hi)
         return lo_v - self.options.base, hi_v - self.options.base, False
 
-    def range_op(self, op: str, predicate: int, shard: int) -> np.ndarray | None:
-        """Per-shard BSI comparison in absolute value space.
+    def _classify_range(self, op: str, value):
+        """Shard-independent predicate preprocessing shared by the
+        per-shard and fused range paths (executor.go:1616-1661
+        executeRowBSIGroupShard): base-value translation with
+        out-of-range detection, the whole-range LT/GT shortcuts against
+        the declared min/max, and the out-of-range NEQ -> not-null rule.
 
-        Implements the executor-side predicate handling of the reference
-        (executor.go:1625-1661 executeRowBSIGroupShard): base-value
-        translation with out-of-range detection, the whole-range LT/GT
-        shortcuts against the field's declared min/max, and the
-        out-of-range NEQ -> not-null rule."""
-        self._require_int()
-        frag = self._bsi_fragment(shard)
-        if frag is None:
-            return None
+        Returns one of: ("empty",), ("not_null",),
+        ("op", op, base_pred), ("between", blo, bhi)."""
         o = self.options
+        if op == "><":
+            lo_v, hi_v = value
+            blo, bhi, out_of_range = self.base_value_between(lo_v, hi_v)
+            if out_of_range:
+                return ("empty",)
+            if lo_v <= o.min and hi_v >= o.max:
+                return ("not_null",)
+            return ("between", blo, bhi)
+        if value is None:
+            if op == "!=":
+                return ("not_null",)
+            raise ValueError("EQ null condition is not supported")
+        predicate = value
         base_pred, out_of_range = self.base_value(op, predicate)
         if out_of_range and op != "!=":
-            return None  # empty
-        # LT[E]/GT[E] that fully encompass the declared range -> not-null.
+            return ("empty",)
         if (
             (op == "<" and predicate > o.max)
             or (op == "<=" and predicate >= o.max)
             or (op == ">" and predicate < o.min)
             or (op == ">=" and predicate <= o.min)
         ):
-            return frag.not_null(o.bit_depth)
+            return ("not_null",)
         if out_of_range:  # op is "!="
-            return frag.not_null(o.bit_depth)
-        return frag.range_op(op, o.bit_depth, base_pred)
+            return ("not_null",)
+        return ("op", op, base_pred)
+
+    def range_op(self, op: str, predicate: int, shard: int) -> np.ndarray | None:
+        """Per-shard BSI comparison in absolute value space."""
+        self._require_int()
+        frag = self._bsi_fragment(shard)
+        if frag is None:
+            return None
+        plan = self._classify_range(op, predicate)
+        if plan[0] == "empty":
+            return None
+        if plan[0] == "not_null":
+            return frag.not_null(self.options.bit_depth)
+        return frag.range_op(plan[1], self.options.bit_depth, plan[2])
 
     def range_between(self, lo_v: int, hi_v: int, shard: int) -> np.ndarray | None:
         self._require_int()
         frag = self._bsi_fragment(shard)
         if frag is None:
             return None
-        blo, bhi, out_of_range = self.base_value_between(lo_v, hi_v)
-        if out_of_range:
+        plan = self._classify_range("><", [lo_v, hi_v])
+        if plan[0] == "empty":
             return None
-        # Whole declared range -> not-null (executor.go:1616-1619).
-        if lo_v <= self.options.min and hi_v >= self.options.max:
+        if plan[0] == "not_null":
             return frag.not_null(self.options.bit_depth)
-        return frag.range_between(self.options.bit_depth, blo, bhi)
+        return frag.range_between(self.options.bit_depth, plan[1], plan[2])
 
     def not_null(self, shard: int) -> np.ndarray | None:
         self._require_int()
         frag = self._bsi_fragment(shard)
         return None if frag is None else frag.not_null(self.options.bit_depth)
+
+    def device_range_stack(self, op: str, value, shards: tuple[int, ...]):
+        """Stacked analog of range_op/range_between: one vmapped device
+        dispatch over all shards; preprocessing shared with the
+        per-shard path via _classify_range.  op '><' takes [lo, hi];
+        op '!=' with value None means not-null.  Returns uint32
+        [n_shards, words]."""
+        import jax
+        import jax.numpy as jnp
+
+        from pilosa_tpu.ops import bsi as bsi_ops
+
+        self._require_int()
+        P = self.device_plane_stack(shards)
+        plan = self._classify_range(op, value)
+        if plan[0] == "empty":
+            return jnp.zeros(P.shape[::2], dtype=jnp.uint32)
+        if plan[0] == "not_null":
+            return P[:, bsi_ops.EXISTS_PLANE]
+        if plan[0] == "between":
+            return jax.vmap(
+                lambda Ps: bsi_ops.between_words(Ps, plan[1], plan[2]))(P)
+        return jax.vmap(
+            lambda Ps: bsi_ops.range_words(Ps, plan[1], plan[2]))(P)
 
     # --------------------------------------------------------- bulk import
 

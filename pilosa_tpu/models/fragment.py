@@ -687,81 +687,15 @@ class Fragment:
 
     def range_op(self, op: str, depth: int, predicate: int) -> np.ndarray:
         """BSI comparison -> packed words for this shard.  op in
-        {'==','!=','<','<=','>','>='} (reference rangeOp, fragment.go:1273)."""
-        import jax.numpy as jnp
-
-        P, exists, sign, _ = self._bsi_base_rows(depth)
-        upred = -predicate if predicate < 0 else predicate
-        lo, hi = bsi_ops.split_predicate(upred)
-
-        def u_lt(filt, lo, hi, allow_eq):
-            lt, eq = bsi_ops.compare(P, filt, lo, hi)
-            return lt | eq if allow_eq else lt
-
-        def u_gt(filt, lo, hi, allow_eq):
-            lt, eq = bsi_ops.compare(P, filt, lo, hi)
-            gt = filt & ~lt & ~eq
-            return gt | eq if allow_eq else gt
-
-        # Sign dispatch: predicate >= 0 -> compare magnitudes among
-        # positives (negatives are all smaller); predicate < 0 -> compare
-        # among negatives with the order inverted.  NOTE: deliberate
-        # divergence from the reference here — its rangeLT/rangeGT route
-        # `predicate == -1 && !allowEquality` through the positive branch
-        # with upredicate=1 (fragment.go:1343,1412), which drops 0/±1
-        # columns from `> -1` and adds 0-columns to `< -1`; that edge is
-        # untested upstream (executor_test.go only pins the min/max
-        # shortcut paths), so we use correct integer semantics instead.
-        if op == "==":
-            base = exists & sign if predicate < 0 else exists & ~sign
-            _, eq = bsi_ops.compare(P, base, lo, hi)
-            out = eq
-        elif op == "!=":
-            base = exists & sign if predicate < 0 else exists & ~sign
-            _, eq = bsi_ops.compare(P, base, lo, hi)
-            out = exists & ~eq
-        elif op in ("<", "<="):
-            allow_eq = op == "<="
-            if predicate >= 0:
-                pos_part = u_lt(exists & ~sign, lo, hi, allow_eq)
-                out = (exists & sign) | pos_part
-            else:
-                out = u_gt(exists & sign, lo, hi, allow_eq)
-        elif op in (">", ">="):
-            allow_eq = op == ">="
-            if predicate >= 0:
-                out = u_gt(exists & ~sign, lo, hi, allow_eq)
-            else:
-                neg_part = u_lt(exists & sign, lo, hi, allow_eq)
-                out = (exists & ~sign) | neg_part
-        else:
-            raise ValueError(f"invalid range operation: {op}")
-        return np.asarray(out)
+        {'==','!=','<','<=','>','>='} (reference rangeOp, fragment.go:1273).
+        The math lives in bsi_ops.range_words — one implementation shared
+        with the executor's fused stacked path."""
+        P = self.device_planes(depth)
+        return np.asarray(bsi_ops.range_words(P, op, predicate))
 
     def range_between(self, depth: int, pred_min: int, pred_max: int) -> np.ndarray:
         """BSI between [min, max] inclusive (reference rangeBetween,
-        fragment.go:1465)."""
-        P, exists, sign, _ = self._bsi_base_rows(depth)
-
-        def u_between(filt, ulo, uhi):
-            lo1, hi1 = bsi_ops.split_predicate(ulo)
-            lo2, hi2 = bsi_ops.split_predicate(uhi)
-            lt1, eq1 = bsi_ops.compare(P, filt, lo1, hi1)
-            lt2, eq2 = bsi_ops.compare(P, filt, lo2, hi2)
-            gte_lo = filt & ~lt1
-            lte_hi = lt2 | eq2
-            return gte_lo & lte_hi
-
-        if pred_min >= 0:
-            out = u_between(exists & ~sign, pred_min, pred_max)
-        elif pred_max < 0:
-            out = u_between(exists & sign, -pred_max, -pred_min)
-        else:
-            lo2, hi2 = bsi_ops.split_predicate(pred_max)
-            lt2, eq2 = bsi_ops.compare(P, exists & ~sign, lo2, hi2)
-            pos = lt2 | eq2
-            lo1, hi1 = bsi_ops.split_predicate(-pred_min)
-            lt1, eq1 = bsi_ops.compare(P, exists & sign, lo1, hi1)
-            neg = lt1 | eq1
-            out = pos | neg
-        return np.asarray(out)
+        fragment.go:1465); math shared with the fused path via
+        bsi_ops.between_words."""
+        P = self.device_planes(depth)
+        return np.asarray(bsi_ops.between_words(P, pred_min, pred_max))

@@ -171,6 +171,80 @@ def extreme_min(P, filt):
     return jnp.stack(taken[::-1]), count
 
 
+def range_words(P, op: str, predicate: int):
+    """BSI comparison over one plane stack [planes, words] -> packed
+    words (the pure core of fragment.rangeOp, fragment.go:1273; the
+    fused executor vmaps this over [shards, planes, words]).
+
+    Sign dispatch: predicate >= 0 -> compare magnitudes among positives
+    (negatives are all smaller); predicate < 0 -> compare among
+    negatives with the order inverted.  NOTE: deliberate divergence from
+    the reference — its rangeLT/rangeGT route `predicate == -1 &&
+    !allowEquality` through the positive branch with upredicate=1
+    (fragment.go:1343,1412), which drops 0/±1 columns from `> -1` and
+    adds 0-columns to `< -1`; that edge is untested upstream, so we use
+    correct integer semantics instead."""
+    exists = P[EXISTS_PLANE]
+    sign = P[SIGN_PLANE]
+    upred = -predicate if predicate < 0 else predicate
+    lo, hi = split_predicate(upred)
+
+    def u_lt(filt, allow_eq):
+        lt, eq = compare(P, filt, lo, hi)
+        return lt | eq if allow_eq else lt
+
+    def u_gt(filt, allow_eq):
+        lt, eq = compare(P, filt, lo, hi)
+        gt = filt & ~lt & ~eq
+        return gt | eq if allow_eq else gt
+
+    if op == "==":
+        base = exists & sign if predicate < 0 else exists & ~sign
+        _, eq = compare(P, base, lo, hi)
+        return eq
+    if op == "!=":
+        base = exists & sign if predicate < 0 else exists & ~sign
+        _, eq = compare(P, base, lo, hi)
+        return exists & ~eq
+    if op in ("<", "<="):
+        allow_eq = op == "<="
+        if predicate >= 0:
+            return (exists & sign) | u_lt(exists & ~sign, allow_eq)
+        return u_gt(exists & sign, allow_eq)
+    if op in (">", ">="):
+        allow_eq = op == ">="
+        if predicate >= 0:
+            return u_gt(exists & ~sign, allow_eq)
+        return (exists & ~sign) | u_lt(exists & sign, allow_eq)
+    raise ValueError(f"invalid range operation: {op}")
+
+
+def between_words(P, pred_min: int, pred_max: int):
+    """BSI between [min, max] inclusive over one plane stack (the pure
+    core of fragment.rangeBetween, fragment.go:1465)."""
+    exists = P[EXISTS_PLANE]
+    sign = P[SIGN_PLANE]
+
+    def u_between(filt, ulo, uhi):
+        lo1, hi1 = split_predicate(ulo)
+        lo2, hi2 = split_predicate(uhi)
+        lt1, _ = compare(P, filt, lo1, hi1)
+        lt2, eq2 = compare(P, filt, lo2, hi2)
+        return (filt & ~lt1) & (lt2 | eq2)
+
+    if pred_min >= 0:
+        return u_between(exists & ~sign, pred_min, pred_max)
+    if pred_max < 0:
+        return u_between(exists & sign, -pred_max, -pred_min)
+    lo2, hi2 = split_predicate(pred_max)
+    lt2, eq2 = compare(P, exists & ~sign, lo2, hi2)
+    pos = lt2 | eq2
+    lo1, hi1 = split_predicate(-pred_min)
+    lt1, eq1 = compare(P, exists & sign, lo1, hi1)
+    neg = lt1 | eq1
+    return pos | neg
+
+
 def assemble_value(taken) -> int:
     """Host: fold per-bit takes into an exact Python int magnitude."""
     v = 0

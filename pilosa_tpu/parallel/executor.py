@@ -278,14 +278,28 @@ class Executor:
 
     def _fused_supported(self, idx, call: Call) -> bool:
         """True when the bitmap tree can evaluate as ONE stacked device
-        computation over all shards: plain standard-view Row leaves
-        combined with Union/Intersect/Difference/Xor/Not.  Conditions,
-        time ranges, Shift, and BSI leaves fall back to the general
+        computation over all shards: plain standard-view Row leaves and
+        BSI condition rows, combined with Union/Intersect/Difference/
+        Xor/Not.  Time ranges and Shift fall back to the general
         per-shard path."""
         name = call.name
         if name == "Row":
-            if call.has_condition_arg():
-                return False
+            cond = call.condition_arg()
+            if cond is not None:
+                # BSI condition rows fuse via the stacked range kernels
+                fname, condition = cond
+                f = idx.field(fname)
+                if f is None or f.options.type != FieldType.INT:
+                    return False
+                if condition.op == "><":
+                    v = condition.value
+                    return (isinstance(v, list) and len(v) == 2
+                            and all(isinstance(x, int)
+                                    and not isinstance(x, bool) for x in v))
+                if condition.value is None:
+                    return condition.op == "!="
+                return (isinstance(condition.value, int)
+                        and not isinstance(condition.value, bool))
             if "from" in call.args or "to" in call.args:
                 return False
             try:
@@ -317,9 +331,16 @@ class Executor:
         dispatch has real latency (TPU behind an RPC boundary)."""
         name = call.name
         if name == "Row":
+            cond = call.condition_arg()
+            if cond is not None:
+                fname, condition = cond
+                value = (condition.int_slice_value()
+                         if condition.op == "><" else condition.value)
+                return idx.field(fname).device_range_stack(
+                    condition.op, value, shards)
             fname = call.field_arg()
-            # arg is a plain int row id (bool literals and conditions
-            # were excluded by _fused_supported)
+            # arg is a plain int row id (bool literals were excluded by
+            # _fused_supported)
             return idx.field(fname).device_row_stack(call.args[fname],
                                                      shards)
         kids = [self._fused_eval(idx, c, shards) for c in call.children]

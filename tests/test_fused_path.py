@@ -84,15 +84,51 @@ class TestFusedEquivalence:
         assert calls["n"] > 0
 
     def test_fused_declines_unsupported(self, ex):
-        # BSI condition, time range, shift, bool literal all fall back
+        # time range and shift fall back; BSI conditions fuse
         idx = ex.holder.index("i")
         idx.create_field("v", FieldOptions.int_field(0, 100))
         idx.create_field("t", FieldOptions.time_field("YMD"))
-        for q in ["Row(v > 3)", "Shift(Row(f0=1), n=1)",
+        parse = __import__("pilosa_tpu.pql", fromlist=["parse"]).parse
+        for q in ["Shift(Row(f0=1), n=1)",
                   "Row(t=1, from='2020-01-01T00:00', to='2021-01-01T00:00')"]:
-            call = __import__("pilosa_tpu.pql", fromlist=["parse"]).parse(
-                q).calls[0]
-            assert not ex._fused_supported(idx, call), q
+            assert not ex._fused_supported(idx, parse(q).calls[0]), q
+        assert ex._fused_supported(idx, parse("Row(v > 3)").calls[0])
+        assert ex._fused_supported(idx, parse("Row(v >< [1, 5])").calls[0])
+
+    def test_fused_bsi_conditions_match_per_shard(self, ex):
+        rng = random.Random(17)
+        idx = ex.holder.index("i")
+        idx.create_field("bv", FieldOptions.int_field(-300, 300))
+        f = idx.field("bv")
+        vals = {}
+        for _ in range(250):
+            vals[rng.randrange(6 * SHARD_WIDTH)] = rng.randrange(-300, 300)
+        for c, v in vals.items():
+            f.set_value(c, v)
+        queries = [
+            ("Row(bv > 50)", {c for c, v in vals.items() if v > 50}),
+            ("Row(bv >= -10)", {c for c, v in vals.items() if v >= -10}),
+            ("Row(bv < -50)", {c for c, v in vals.items() if v < -50}),
+            ("Row(bv <= 0)", {c for c, v in vals.items() if v <= 0}),
+            ("Row(bv == 7)", {c for c, v in vals.items() if v == 7}),
+            ("Row(bv != 7)", {c for c, v in vals.items() if v != 7}),
+            ("Row(bv >< [-40, 90])",
+             {c for c, v in vals.items() if -40 <= v <= 90}),
+            ("Row(bv > 400)", set()),         # out of declared range
+            ("Row(bv < 400)", set(vals)),     # whole range -> not-null
+            ("Row(bv != null)", set(vals)),
+            ("Count(Intersect(Row(bv > 0), Row(f0=1)))", None),
+        ]
+        for q, want in queries:
+            fused = ex.execute("i", q)[0]
+            general = _general(ex, q)[0]
+            if isinstance(fused, Row):
+                got = set(int(c) for c in fused.columns())
+                if want is not None:
+                    assert got == want, q
+                assert list(fused.columns()) == list(general.columns()), q
+            else:
+                assert fused == general, q
 
     def test_stack_sharded_over_device_mesh(self, ex):
         """Under the virtual 8-device mesh, fused stacks shard across
