@@ -196,7 +196,7 @@ class Executor:
         return list(self.pool.map(fn, shards))
 
     def _map_shards(self, fn, shards, idx=None, call=None, opt=None, adapt=None,
-                    remote_call=None):
+                    remote_call=None, local_batch_fn=None):
         """Map over shards and return the flat list of per-shard/per-node
         partials.  Single-node: worker-pool map (reference mapperLocal,
         executor.go:2561).  Clustered (and not already a remote
@@ -205,7 +205,10 @@ class Executor:
         node failure re-map its shards onto replicas until owners are
         exhausted (reference mapReduce, executor.go:2455-2514).  `adapt`
         converts one remote result into a list of local-partial-shaped
-        values."""
+        values.  `local_batch_fn(shards) -> partials` replaces the
+        per-shard pool for the locally-owned group when the call has a
+        fused all-shard evaluation (remote nodes fuse on their own side,
+        since remote re-execution is non-clustered)."""
         if not (self._cluster_active(opt) and idx is not None and call is not None
                 and adapt is not None):
             return self._local_map(fn, shards)
@@ -228,7 +231,11 @@ class Executor:
                 )
                 inflight[fut] = (node_id, node_shards)
             if cluster.local_id in pending:
-                partials.extend(self._local_map(fn, pending.pop(cluster.local_id)))
+                local_shards = pending.pop(cluster.local_id)
+                if local_batch_fn is not None and len(local_shards) > 1:
+                    partials.extend(local_batch_fn(local_shards))
+                else:
+                    partials.extend(self._local_map(fn, local_shards))
             if not inflight:
                 continue
             done, _ = futures_wait(list(inflight), return_when=FIRST_COMPLETED)
@@ -374,15 +381,18 @@ class Executor:
         shards = self._target_shards(idx, shards, opt)
         row = Row()
 
-        if (self.fuse_shards and len(shards) > 1
-                and not self._cluster_active(opt)
-                and self._fused_supported(idx, call)):
-            stack = np.asarray(self._fused_eval(idx, call, tuple(shards)))
-            for i, shard in enumerate(shards):
-                if stack[i].any():
-                    # copy: a view would pin the whole stack in memory
-                    # for as long as one sparse segment lives
-                    row.segments[shard] = stack[i].copy()
+        fused_ok = (self.fuse_shards and len(shards) > 1
+                    and self._fused_supported(idx, call))
+
+        def batch_fn(group):
+            # copies: a view would pin the whole stack in memory for as
+            # long as one sparse segment lives
+            stack = np.asarray(self._fused_eval(idx, call, tuple(group)))
+            return [(s, stack[i].copy())
+                    for i, s in enumerate(group) if stack[i].any()]
+
+        if fused_ok and not self._cluster_active(opt):
+            partials = batch_fn(shards)
         else:
             def map_fn(shard):
                 return shard, self._bitmap_words_shard(idx, call, shard)
@@ -390,11 +400,12 @@ class Executor:
             partials = self._map_shards(
                 map_fn, shards, idx=idx, call=call, opt=opt,
                 adapt=lambda r: list(r.segments.items()),
+                local_batch_fn=batch_fn if fused_ok else None,
             )
-            for shard, words in partials:
-                w = self._np_words(words)
-                if w is not None and w.any():
-                    row.segments[shard] = w
+        for shard, words in partials:
+            w = self._np_words(words)
+            if w is not None and w.any():
+                row.segments[shard] = w
 
         # Attach row attributes for plain Row() queries (reference
         # executor.go:206 attachment; skipped when excluded).
@@ -557,14 +568,20 @@ class Executor:
             raise ExecutionError("Count() requires a single bitmap query")
         shards = self._target_shards(idx, shards, opt)
         child = call.children[0]
-        if (self.fuse_shards and len(shards) > 1
-                and not self._cluster_active(opt)
-                and self._fused_supported(idx, child)):
-            # all shards in one fused AND/OR/popcount dispatch; reduce
-            # per shard and sum in Python ints — a single int32 reduce
-            # over the whole stack could wrap past 2^31 set bits
-            stack = self._fused_eval(idx, child, tuple(shards))
-            return int(np.asarray(bm.row_counts(stack), dtype=np.int64).sum())
+        fused_ok = (self.fuse_shards and len(shards) > 1
+                    and self._fused_supported(idx, child))
+
+        def batch_fn(group):
+            # one fused AND/OR/popcount dispatch for the whole group;
+            # per-shard int32 counts summed in Python ints — a single
+            # int32 reduce over the stack could wrap past 2^31 set bits
+            stack = self._fused_eval(idx, child, tuple(group))
+            return [int(c) for c in
+                    np.asarray(bm.row_counts(stack),
+                               dtype=np.int64)[:len(group)]]
+
+        if fused_ok and not self._cluster_active(opt):
+            return sum(batch_fn(shards))
 
         def map_fn(shard):
             words = self._bitmap_words_shard(idx, child, shard)
@@ -574,7 +591,9 @@ class Executor:
 
         return sum(
             self._map_shards(
-                map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda v: [v]
+                map_fn, shards, idx=idx, call=call, opt=opt,
+                adapt=lambda v: [v],
+                local_batch_fn=batch_fn if fused_ok else None,
             )
         )
 
@@ -822,16 +841,22 @@ class Executor:
         f = self._field(idx, fname)
         shards = self._target_shards(idx, shards, opt)
 
-        if (self.fuse_shards and len(shards) > 1
-                and not self._cluster_active(opt)
-                and f.options.type == FieldType.INT
-                and (not call.children
-                     or self._fused_supported(idx, call.children[0]))):
-            if call.name == "Sum":
-                return self._fused_sum(idx, f, call, tuple(shards))
-            return self._fused_extreme(idx, f, call, tuple(shards))
+        fused_ok = (self.fuse_shards and len(shards) > 1
+                    and f.options.type == FieldType.INT
+                    and (not call.children
+                         or self._fused_supported(idx, call.children[0])))
+        if call.name == "Sum":
+            def batch_fn(group):
+                return [self._fused_sum(idx, f, call, tuple(group))]
+        else:
+            def batch_fn(group):
+                return [self._fused_extreme(idx, f, call, tuple(group))]
+
+        if fused_ok and not self._cluster_active(opt):
+            return batch_fn(shards)[0]
 
         filter_row = self._local_filter_row(idx, call, shards, opt)
+        local_batch_fn = batch_fn if fused_ok else None
 
         if call.name == "Sum":
             def map_fn(shard):
@@ -840,7 +865,8 @@ class Executor:
 
             out = ValCount()
             for vc in self._map_shards(
-                map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda v: [v]
+                map_fn, shards, idx=idx, call=call, opt=opt,
+                adapt=lambda v: [v], local_batch_fn=local_batch_fn,
             ):
                 out = out.add(vc)
             return out
@@ -857,7 +883,8 @@ class Executor:
 
         out = ValCount()
         for vc in self._map_shards(
-            map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda v: [v]
+            map_fn, shards, idx=idx, call=call, opt=opt,
+            adapt=lambda v: [v], local_batch_fn=local_batch_fn,
         ):
             out = getattr(out, reducer)(vc)
         return out

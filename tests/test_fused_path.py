@@ -235,6 +235,49 @@ class TestFusedEquivalence:
         assert (out.val, out.count) == (16, 2)
         assert hits["n"] == 1
 
+    def test_clustered_local_group_fuses(self, tmp_path):
+        """In a cluster, the originating node's local shard group
+        evaluates fused (remote nodes fuse on their own side)."""
+        from pilosa_tpu.api import API
+        from tests.test_cluster import make_cluster
+
+        _, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        api = API(nodes[0])
+        cols = [s * SHARD_WIDTH + s for s in range(9)]
+        api.import_bits("i", "f", [1] * len(cols), cols)
+        hits = {n.cluster.local_id: 0 for n in nodes}
+        for nd in nodes:
+            orig = nd.executor._fused_eval
+
+            def spy(idx, call, shards, _o=orig, _id=nd.cluster.local_id):
+                hits[_id] += 1
+                return _o(idx, call, shards)
+
+            nd.executor._fused_eval = spy
+        got = nodes[0].executor.execute("i", "Count(Row(f=1))")[0]
+        assert got == len(cols)
+        # the ORIGINATOR's local group must fuse (placement is
+        # deterministic: node0 owns several of the 9 shards), not just
+        # the remote nodes (which fuse via the non-clustered path)
+        n0_local = len(nodes[0].cluster.local_shards("i", range(9)))
+        assert n0_local > 1, "placement changed; pick more shards"
+        assert hits["node0"] > 0, hits
+        # aggregates use the same clustered local-group fusion
+        from pilosa_tpu.models.field import FieldOptions
+
+        nodes[0].create_field("i", "v", FieldOptions.int_field(0, 100))
+        api.import_values("i", "v", cols, [5] * len(cols))
+        sum_hits = {"n": 0}
+        orig_sum = nodes[0].executor._fused_sum
+        nodes[0].executor._fused_sum = (
+            lambda *a: (sum_hits.__setitem__("n", sum_hits["n"] + 1),
+                        orig_sum(*a))[1])
+        out = nodes[0].executor.execute("i", "Sum(field=v)")[0]
+        assert (out.val, out.count) == (5 * len(cols), len(cols))
+        assert sum_hits["n"] > 0
+
     def test_cache_invalidation_on_write(self, ex):
         q = "Count(Row(f0=1))"
         before = ex.execute("i", q)[0]
