@@ -1,0 +1,59 @@
+"""Time-quantum range semantics pinned against the reference's rule:
+viewsByTimeRange (time.go:104-180) covers whole units only, so the
+effective range floors BOTH ends to the quantum's finest unit — a
+mid-unit start includes its whole containing unit and a trailing
+partial unit drops.  Randomized over quanta/timestamps/ranges."""
+
+from __future__ import annotations
+
+import datetime as dt
+import random
+
+import pytest
+
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.parallel.executor import Executor
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def floor_unit(t: dt.datetime, unit: str) -> dt.datetime:
+    if unit == "H":
+        return t.replace(minute=0, second=0, microsecond=0)
+    if unit == "D":
+        return t.replace(hour=0, minute=0, second=0, microsecond=0)
+    if unit == "M":
+        return t.replace(day=1, hour=0, minute=0, second=0, microsecond=0)
+    return t.replace(month=1, day=1, hour=0, minute=0, second=0,
+                     microsecond=0)
+
+
+@pytest.mark.parametrize("seed,quantum", [
+    (80, "YM"), (81, "YMDH"), (82, "YMD"), (83, "Y"), (84, "MD"),
+    (85, "D"), (86, "DH"),
+])
+def test_range_floors_to_finest_unit(tmp_path, seed, quantum):
+    rng = random.Random(seed)
+    finest = quantum[-1]
+    holder = Holder(str(tmp_path / "h"))
+    idx = holder.create_index("i")
+    f = idx.create_field("t", FieldOptions.time_field(quantum))
+    events = []
+    for _ in range(120):
+        c = rng.randrange(2 * SHARD_WIDTH)
+        ts = dt.datetime(2020 + rng.randrange(3), rng.randrange(1, 13),
+                         rng.randrange(1, 28), rng.randrange(24))
+        events.append((c, ts))
+        f.set_bit(5, c, ts)
+    ex = Executor(holder)
+    for _ in range(8):
+        a = dt.datetime(2019 + rng.randrange(5), rng.randrange(1, 13),
+                        rng.randrange(1, 28), rng.randrange(24))
+        b = a + dt.timedelta(days=rng.randrange(1, 700))
+        fa, fb = floor_unit(a, finest), floor_unit(b, finest)
+        q = (f"Row(t=5, from='{a.strftime('%Y-%m-%dT%H:%M')}', "
+             f"to='{b.strftime('%Y-%m-%dT%H:%M')}')")
+        want = {c for c, ts in events if fa <= ts < fb}
+        got = set(int(x) for x in ex.execute("i", q)[0].columns())
+        assert got == want, (q, sorted(got ^ want)[:5])
+    holder.close()
