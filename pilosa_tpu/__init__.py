@@ -19,4 +19,32 @@ __version__ = "0.1.0"
 
 from pilosa_tpu.shardwidth import SHARD_WIDTH, shard_width
 
-__all__ = ["SHARD_WIDTH", "shard_width", "__version__"]
+_LAZY = {
+    # public embedding surface, loaded on first touch so `import
+    # pilosa_tpu` stays light (no jax/server imports)
+    "Server": ("pilosa_tpu.server.server", "Server"),
+    "API": ("pilosa_tpu.api", "API"),
+    "Holder": ("pilosa_tpu.models.holder", "Holder"),
+    "Executor": ("pilosa_tpu.parallel.executor", "Executor"),
+    "IndexOptions": ("pilosa_tpu.models.index", "IndexOptions"),
+    "FieldOptions": ("pilosa_tpu.models.field", "FieldOptions"),
+    "parse": ("pilosa_tpu.pql", "parse"),
+    "Config": ("pilosa_tpu.config", "Config"),
+}
+
+__all__ = ["SHARD_WIDTH", "shard_width", "__version__", *sorted(_LAZY)]
+
+
+def __getattr__(name: str):
+    target = _LAZY.get(name)
+    if target is None:
+        raise AttributeError(f"module 'pilosa_tpu' has no attribute {name!r}")
+    import importlib
+
+    value = getattr(importlib.import_module(target[0]), target[1])
+    globals()[name] = value  # cache: later accesses skip this hook
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_LAZY))
