@@ -15,9 +15,8 @@ with PQL set algebra — redesigned TPU-first:
   membership, REST API) mirrors the reference's layer map (SURVEY.md §1).
 """
 
-__version__ = "0.1.0"
-
 from pilosa_tpu.shardwidth import SHARD_WIDTH, shard_width
+from pilosa_tpu.version import VERSION as __version__
 
 _LAZY = {
     # public embedding surface, loaded on first touch so `import
