@@ -18,8 +18,10 @@ XLA programs.
 from __future__ import annotations
 
 import datetime as _dt
+import threading
 from concurrent.futures import (
     FIRST_COMPLETED,
+    Future,
     ThreadPoolExecutor,
     wait as futures_wait,
 )
@@ -190,6 +192,26 @@ class Executor:
             and len(self.cluster.sorted_nodes()) > 1
         )
 
+    @staticmethod
+    def _submit_io(fn, *args):
+        """Run a remote sub-query on its own thread and return a Future.
+        The reference bounds only local shard work by NumCPU; per-node
+        mapper goroutines are unbounded (executor.go:2517), so remote
+        fan-out must never queue behind the compute pool or behind other
+        nodes' sub-queries — distributed latency is max(per-node)."""
+        fut = Future()
+
+        def run():
+            if not fut.set_running_or_notify_cancel():
+                return
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:  # delivered via fut.result()
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
     def _local_map(self, fn, shards):
         if len(shards) <= 1:
             return [fn(s) for s in shards]
@@ -225,7 +247,7 @@ class Executor:
             # goroutines)
             for node_id in [k for k in list(pending) if k != cluster.local_id]:
                 node_shards = pending.pop(node_id)
-                fut = self.pool.submit(
+                fut = self._submit_io(
                     cluster.transport.query_node,
                     cluster.node(node_id), idx.name, pql, node_shards,
                 )
