@@ -170,6 +170,9 @@ class Field:
         self.views: dict[str, View] = {}
         self._shards: set[int] = set()
         self._row_stack_cache: dict = {}  # (row, shards) -> (gens, dev)
+        # shards-tuple -> (gens, row_ids, shard_pos, pos_dev, mat_dev):
+        # concatenated cross-shard row matrices for the fused TopN scan
+        self._matrix_stack_cache: dict = {}
         self._lock = threading.RLock()
         if path is not None:
             os.makedirs(path, exist_ok=True)
@@ -363,35 +366,106 @@ class Field:
                         stack[i] = arr
         return self._place_and_cache_stack(key, gens, stack)
 
-    def _place_and_cache_stack(self, key, gens, stack: np.ndarray):
-        """Place a host stack on device — sharded over the mesh when
-        more than one chip is visible, so XLA partitions the set algebra
-        + reductions across chips with ICI collectives (SURVEY.md §7
-        step 4: the executor's shard batch IS the mesh's data axis) —
-        then cache it under a byte budget."""
+    @staticmethod
+    def _place_on_devices(stack: np.ndarray):
+        """Place a host array on device — sharded along axis 0 over the
+        mesh when more than one chip is visible, so XLA partitions the
+        set algebra + reductions across chips with ICI collectives
+        (SURVEY.md §7 step 4: the executor's shard batch IS the mesh's
+        data axis)."""
         import jax
 
         if len(jax.devices()) > 1:
             from pilosa_tpu.parallel import mesh as pmesh
 
-            dev = pmesh.shard_stack(pmesh.device_mesh(), stack)
-        else:
-            dev = jax.device_put(stack)
+            return pmesh.shard_stack(pmesh.device_mesh(), stack)
+        return jax.device_put(stack)
+
+    def _place_and_cache_stack(self, key, gens, stack: np.ndarray):
+        dev = self._place_on_devices(stack)
         entry_bytes = stack.nbytes
         if entry_bytes > self.ROW_STACK_CACHE_BYTES:
             return dev  # uncacheable; never evict the warm cache for it
-        with self._lock:
-            # bound by BYTES, not entries — one wide-index entry can be
-            # tens of MB of device memory
-            total = sum(e[1].nbytes for e in self._row_stack_cache.values())
-            while self._row_stack_cache and (
-                    total + entry_bytes > self.ROW_STACK_CACHE_BYTES
-                    or len(self._row_stack_cache) >= 64):
-                _, evicted = self._row_stack_cache.pop(
-                    next(iter(self._row_stack_cache)))
-                total -= evicted.nbytes
-            self._row_stack_cache[key] = (gens, dev)
+        self._evict_and_insert(
+            self._row_stack_cache, key, (gens, dev), entry_bytes,
+            self.ROW_STACK_CACHE_BYTES, 64, lambda e: e[1].nbytes)
         return dev
+
+    def _evict_and_insert(self, cache: dict, key, entry, entry_bytes: int,
+                          budget: int, max_entries: int, nbytes_of) -> None:
+        """FIFO-evict until the new entry fits the byte budget (NOT an
+        entry count — one wide-index entry can be tens of MB of device
+        memory) and the entry cap, then insert."""
+        with self._lock:
+            # replace-in-place first, or the stale entry's bytes would
+            # double-count against the budget and evict warm neighbours
+            cache.pop(key, None)
+            total = sum(nbytes_of(e) for e in cache.values())
+            while cache and (total + entry_bytes > budget
+                             or len(cache) >= max_entries):
+                evicted = cache.pop(next(iter(cache)))
+                total -= nbytes_of(evicted)
+            cache[key] = entry
+
+    #: device-memory budget for concatenated matrix stacks (bytes)
+    MATRIX_STACK_CACHE_BYTES = 512 << 20
+
+    def device_matrix_stack(self, shards: tuple[int, ...]):
+        """Standard-view row matrices of many shards concatenated along
+        the row axis: (gens, row_ids int64[N], shard_pos int32
+        host[Np], shard_pos device[Np], matrix uint32 device[Np,
+        words]), where Np >= N is padded to a device-count multiple —
+        consumers must truncate against row_ids (pad entries read as
+        position 0 over all-zero matrix rows).  ``shard_pos[i]`` is the
+        POSITION of row i's shard within ``shards`` — it indexes the
+        executor's fused filter stacks, which use the same order.  This
+        is the fused TopN operand: the whole index scans in one
+        dispatch instead of one per fragment (fragment.top,
+        fragment.go:1570, batched across executor.go:2561's shard
+        loop).  Returns (gens, [], None, None, None) when every
+        fragment is empty — empty results are NOT cached (recomputing
+        them is a few dict lookups, and a 0-byte entry could FIFO-evict
+        a warm multi-MB stack via the entry cap).  Cached per shards
+        tuple; per-fragment mutation generations invalidate."""
+        view = self.view(VIEW_STANDARD)
+        frags = [None if view is None else view.fragment(s) for s in shards]
+        key = shards
+        gens = []
+        parts = []  # (pos, row_ids, host matrix) per non-empty fragment
+        for i, frag in enumerate(frags):
+            if frag is None:
+                gens.append(0)
+                continue
+            with frag._lock:
+                gens.append(frag._gen)
+                ids, mat = frag._stacked()
+            if len(ids):
+                parts.append((i, ids, mat))
+        gens = tuple(gens)
+        with self._lock:
+            hit = self._matrix_stack_cache.get(key)
+            if hit is not None and hit[0] == gens:
+                return hit
+        if not parts:
+            return (gens, np.empty(0, dtype=np.int64), None, None, None)
+        row_ids = np.concatenate([ids for _, ids, _ in parts])
+        shard_pos = np.concatenate(
+            [np.full(len(ids), pos, dtype=np.int32) for pos, ids, _ in parts])
+        big = np.concatenate([m for _, _, m in parts], axis=0)
+        pad = _padded_rows(len(row_ids)) - len(row_ids)
+        if pad:
+            big = np.pad(big, ((0, pad), (0, 0)))
+            shard_pos = np.pad(shard_pos, (0, pad))
+        mat_dev = self._place_on_devices(big)
+        pos_dev = self._place_on_devices(shard_pos)
+        entry = (gens, row_ids, shard_pos, pos_dev, mat_dev)
+        entry_bytes = big.nbytes
+        if entry_bytes > self.MATRIX_STACK_CACHE_BYTES:
+            return entry  # uncacheable; don't evict the warm cache for it
+        self._evict_and_insert(
+            self._matrix_stack_cache, key, entry, entry_bytes,
+            self.MATRIX_STACK_CACHE_BYTES, 8, lambda e: e[4].nbytes)
+        return entry
 
     def row_time(self, row_id: int, shard: int, start, end) -> np.ndarray | None:
         """Union of time views covering [start, end) for one shard

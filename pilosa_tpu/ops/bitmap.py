@@ -198,6 +198,44 @@ def row_counts_masked(mat, filt):
     )
 
 
+@jax.jit
+def row_counts_gathered(mat, filt_stack, shard_pos):
+    """Per-row |mat[r] & filt_stack[shard_pos[r]]| -> int32[rows].
+
+    The fused cross-shard TopN scan: row matrices from many fragments
+    concatenate along axis 0 (each row tagged with its shard's position
+    in the query's shard tuple) and the whole filtered scan runs as one
+    dispatch instead of one per shard (fragment.top over shards,
+    fragment.go:1570 × executor.go:2561)."""
+    filt = jnp.take(filt_stack, shard_pos, axis=0)
+    return jnp.sum(
+        lax.population_count(jnp.bitwise_and(mat, filt)),
+        axis=-1,
+        dtype=jnp.int32,
+    )
+
+
+@jax.jit
+def masked_matrix_counts(mat, masks):
+    """counts[g, r] = |mat[r] & masks[g]| -> int32[G, rows].
+
+    The GroupBy inner product (groupByIterator, executor.go:3058): every
+    group mask against every child row in ONE dispatch.  lax.map keeps
+    the [G, rows, words] intermediate out of memory — each step is a
+    fused row_counts_masked."""
+    return lax.map(lambda m: row_counts_masked(mat, m), masks)
+
+
+@jax.jit
+def and_pairs(mat, masks, slots, group_idx):
+    """out[p] = mat[slots[p]] & masks[group_idx[p]] -> uint32[P, words].
+
+    Builds the next GroupBy level's group masks for every surviving
+    (group, row) pair in one dispatch."""
+    return jnp.bitwise_and(
+        jnp.take(mat, slots, axis=0), jnp.take(masks, group_idx, axis=0))
+
+
 # ---------------------------------------------------------------------------
 # Point mutations — delta application from the host write path.  The host
 # pre-ORs colliding bits into unique (word index, value) pairs; on device

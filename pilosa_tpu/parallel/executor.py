@@ -46,6 +46,12 @@ from pilosa_tpu.pql import Call, Query, parse
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
+def _next_pow2(n: int) -> int:
+    """Smallest power of two >= n (shape padding so batched kernels
+    compile O(log) distinct programs, not one per group count)."""
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
 @dataclass
 class ExecOptions:
     """Per-request execution options (reference execOptions,
@@ -353,6 +359,15 @@ class Executor:
                 self._fused_supported(idx, c) for c in call.children)
         return False
 
+    def _fuse_eligible(self, idx, shards, call: Call | None = None,
+                       extra: bool = True) -> bool:
+        """The shared precondition of every fused all-shard dispatch:
+        fusion enabled, a real multi-shard batch, any op-specific
+        `extra` condition, and (when the op carries a bitmap tree) the
+        tree being stack-evaluable."""
+        return (self.fuse_shards and len(shards) > 1 and extra
+                and (call is None or self._fused_supported(idx, call)))
+
     def _fused_eval(self, idx, call: Call, shards: tuple[int, ...]):
         """Evaluate a supported tree -> uint32 [n_shards, words] device
         stack.  Replaces n_shards × tree-size dispatches with tree-size
@@ -403,8 +418,7 @@ class Executor:
         shards = self._target_shards(idx, shards, opt)
         row = Row()
 
-        fused_ok = (self.fuse_shards and len(shards) > 1
-                    and self._fused_supported(idx, call))
+        fused_ok = self._fuse_eligible(idx, shards, call)
 
         def batch_fn(group):
             # copies: a view would pin the whole stack in memory for as
@@ -590,8 +604,7 @@ class Executor:
             raise ExecutionError("Count() requires a single bitmap query")
         shards = self._target_shards(idx, shards, opt)
         child = call.children[0]
-        fused_ok = (self.fuse_shards and len(shards) > 1
-                    and self._fused_supported(idx, child))
+        fused_ok = self._fuse_eligible(idx, shards, child)
 
         def batch_fn(group):
             # one fused AND/OR/popcount dispatch for the whole group;
@@ -684,12 +697,24 @@ class Executor:
         remote_call.args.pop("n", None)
         remote_call.args.pop("threshold", None)
 
-        totals: dict[int, int] = {}
-        parts = self._map_shards(
-            map_fn, shards, idx=idx, call=call, opt=opt,
-            adapt=lambda pairs: [{p.id: p.count for p in pairs}],
-            remote_call=remote_call,
-        )
+        fused_ok = self._fuse_eligible(idx, shards, filter_call)
+
+        def batch_fn(group):
+            # same hook shape as the Count/Row fused paths: one stacked
+            # dispatch for the whole locally-owned group
+            return [self._fused_topn_counts(idx, f, filter_call,
+                                            tuple(group))]
+
+        if fused_ok and not self._cluster_active(opt):
+            parts = batch_fn(shards)
+        else:
+            parts = self._map_shards(
+                map_fn, shards, idx=idx, call=call, opt=opt,
+                adapt=lambda pairs: [{p.id: p.count for p in pairs}],
+                remote_call=remote_call,
+                local_batch_fn=batch_fn if fused_ok else None,
+            )
+        totals = {}
         for part in parts:
             for r, c in part.items():
                 totals[r] = totals.get(r, 0) + c
@@ -713,6 +738,66 @@ class Executor:
         if n:
             pairs = pairs[:n]
         return pairs
+
+    def _fused_topn_counts(self, idx, f, filter_call,
+                           shards: tuple[int, ...]) -> dict[int, int]:
+        """All shards' TopN row counts in ONE device dispatch over the
+        field's concatenated matrix stack (vs one scan per fragment).
+        Unfiltered results also warm every fragment's TopN cache, so
+        repeat queries skip the device entirely."""
+        view = f.view(VIEW_STANDARD)
+        totals: dict[int, int] = {}
+        if view is None:
+            return totals
+        if filter_call is None:
+            # whole-scan short-circuit: every fragment's cache complete
+            cached_parts = []
+            for s in shards:
+                frag = view.fragment(s)
+                if frag is None:
+                    continue
+                c = frag.cached_row_counts(0)
+                if c is None:
+                    cached_parts = None
+                    break
+                cached_parts.append(c)
+            if cached_parts is not None:
+                for part in cached_parts:
+                    for r, c in part.items():
+                        totals[r] = totals.get(r, 0) + c
+                return totals
+
+        gens, row_ids, shard_pos, pos_dev, mat_dev = \
+            f.device_matrix_stack(shards)
+        if mat_dev is None:
+            return totals
+        if filter_call is not None:
+            filt = self._fused_eval(idx, filter_call, shards)
+            counts = bm.row_counts_gathered(mat_dev, filt, pos_dev)
+        else:
+            counts = bm.row_counts(mat_dev)
+        n_rows = len(row_ids)
+        counts = np.asarray(counts, dtype=np.int64)[:n_rows]
+        if filter_call is not None:
+            for rid, c in zip(row_ids, counts):
+                if c > 0:
+                    rid = int(rid)
+                    totals[rid] = totals.get(rid, 0) + int(c)
+            return totals
+
+        per_shard: dict[int, dict[int, int]] = {}
+        for rid, pos, c in zip(row_ids, shard_pos, counts):
+            if c > 0:
+                rid, c = int(rid), int(c)
+                totals[rid] = totals.get(rid, 0) + c
+                per_shard.setdefault(int(pos), {})[rid] = c
+        # warm every fragment's cache — including ones whose rows all
+        # counted zero, whose complete answer is "no rows"
+        for pos, s in enumerate(shards):
+            frag = view.fragment(s)
+            if frag is not None:
+                frag.cache_row_counts(per_shard.get(pos, {}), gen=gens[pos])
+        return totals
 
     # --------------------------------------------------------------- Rows
 
@@ -774,6 +859,8 @@ class Executor:
             child_fields.append(self._field(idx, fname))
 
         def map_fn(shard):
+            import jax.numpy as jnp
+
             mats = []
             for f in child_fields:
                 view = f.view(VIEW_STANDARD)
@@ -784,36 +871,57 @@ class Executor:
                 if len(row_ids) == 0:
                     return {}
                 mats.append((f.name, row_ids, matrix))
-            base = None
+            # Batched cartesian walk: at each level ONE dispatch counts
+            # every (group, child-row) pair and one more builds the
+            # surviving groups' masks — vs the reference's per-group
+            # iterator (groupByIterator, executor.go:3058).  Pair counts
+            # are padded to powers of two so XLA compiles O(log) shapes,
+            # not one program per group-count.
+            prefixes: list[tuple] = [()]
+            # masks stays PADDED (power-of-two rows) across levels; the
+            # live-group count is len(prefixes).  Padded garbage rows are
+            # never read — counts are host-sliced to the live range.
+            masks = None  # device [G_padded, words]; None = unconstrained
             if filter_call is not None:
                 base = self._bitmap_words_shard(idx, filter_call, shard)
                 if base is None:
                     return {}
-            groups = [((), base)]
+                masks = jnp.asarray(base)[None, :]
             for level, (fname, row_ids, matrix) in enumerate(mats):
                 last = level == len(mats) - 1
-                new_groups = []
-                for prefix, words in groups:
-                    if words is None:
-                        counts = np.asarray(bm.row_counts(matrix))
-                    else:
-                        counts = np.asarray(bm.row_counts_masked(matrix, words))
-                    for slot, rid in enumerate(row_ids):
-                        c = int(counts[slot])
-                        if c == 0:
-                            continue
-                        key = prefix + ((fname, int(rid)),)
-                        if last:
-                            new_groups.append((key, c))
-                        else:
-                            gw = (
-                                matrix[slot]
-                                if words is None
-                                else bm.b_and(matrix[slot], words)
-                            )
-                            new_groups.append((key, gw))
-                groups = new_groups
-            return dict(groups) if groups and isinstance(groups[0][1], int) else {}
+                if masks is None:
+                    cnts = np.asarray(bm.row_counts(matrix))[None, :]
+                else:
+                    cnts = np.asarray(
+                        bm.masked_matrix_counts(matrix,
+                                                masks))[:len(prefixes)]
+                nz_g, nz_r = np.nonzero(cnts)
+                if len(nz_g) == 0:
+                    return {}
+                if last:
+                    return {
+                        prefixes[g] + ((fname, int(row_ids[r])),):
+                            int(cnts[g, r])
+                        for g, r in zip(nz_g, nz_r)
+                    }
+                new_prefixes = [
+                    prefixes[g] + ((fname, int(row_ids[r])),)
+                    for g, r in zip(nz_g, nz_r)
+                ]
+                p = len(nz_g)
+                pp = _next_pow2(p)
+                slots = np.zeros(pp, dtype=np.int32)
+                slots[:p] = nz_r
+                if masks is None:
+                    new_masks = jnp.take(matrix, jnp.asarray(slots), axis=0)
+                else:
+                    gsel = np.zeros(pp, dtype=np.int32)
+                    gsel[:p] = nz_g
+                    new_masks = bm.and_pairs(matrix, masks,
+                                             jnp.asarray(slots),
+                                             jnp.asarray(gsel))
+                prefixes, masks = new_prefixes, new_masks
+            return {}
 
         def gc_adapt(gcs):
             return [
@@ -863,10 +971,9 @@ class Executor:
         f = self._field(idx, fname)
         shards = self._target_shards(idx, shards, opt)
 
-        fused_ok = (self.fuse_shards and len(shards) > 1
-                    and f.options.type == FieldType.INT
-                    and (not call.children
-                         or self._fused_supported(idx, call.children[0])))
+        fused_ok = self._fuse_eligible(
+            idx, shards, call.children[0] if call.children else None,
+            extra=f.options.type == FieldType.INT)
         if call.name == "Sum":
             def batch_fn(group):
                 return [self._fused_sum(idx, f, call, tuple(group))]

@@ -287,3 +287,162 @@ class TestFusedEquivalence:
         # and the new bit is visible in the fused Row too
         row = ex.execute("i", "Row(f0=1)")[0]
         assert 3 * SHARD_WIDTH + 7 in set(int(c) for c in row.columns())
+
+
+class TestFusedTopNGroupBy:
+    """The cross-shard fused TopN scan and the batched GroupBy walk must
+    match the per-shard path bit for bit."""
+
+    def test_fused_topn_matches_per_shard(self, ex):
+        for q in [
+            "TopN(f0)",
+            "TopN(f0, n=3)",
+            "TopN(f0, n=2, threshold=100)",
+            "TopN(f0, ids=[1, 3])",
+            "TopN(f0, Row(f1=2), n=4)",
+            "TopN(f1, Intersect(Row(f0=1), Row(f2=3)))",
+        ]:
+            # per-shard oracle FIRST, then invalidate the TopN caches it
+            # warmed: either order of warm caches would let one path
+            # answer from the other's output — the comparison must pit
+            # two INDEPENDENT computations against each other
+            general = _general(ex, q)[0]
+            for f in ex.holder.index("i").fields.values():
+                view = f.view("standard")
+                for frag in (view.fragments.values() if view else ()):
+                    frag.topn_cache.invalidate()
+            fused = ex.execute("i", q)[0]
+            assert [(p.id, p.count) for p in fused] == \
+                [(p.id, p.count) for p in general], q
+
+    def test_fused_topn_engages_and_warms_caches(self, ex):
+        calls = {"n": 0}
+        orig = ex._fused_topn_counts
+
+        def spy(*a, **k):
+            calls["n"] += 1
+            return orig(*a, **k)
+
+        ex._fused_topn_counts = spy
+        first = ex.execute("i", "TopN(f0)")[0]
+        assert calls["n"] == 1
+        # second run answers from the fragment caches: the fused counter
+        # still runs but must not touch the device matrix stack
+        stack_calls = {"n": 0}
+        f = ex.holder.index("i").field("f0")
+        orig_stack = f.device_matrix_stack
+
+        def stack_spy(shards):
+            stack_calls["n"] += 1
+            return orig_stack(shards)
+
+        f.device_matrix_stack = stack_spy
+        second = ex.execute("i", "TopN(f0)")[0]
+        assert stack_calls["n"] == 0
+        assert [(p.id, p.count) for p in first] == \
+            [(p.id, p.count) for p in second]
+
+    def test_fused_topn_bsi_filter(self, ex):
+        idx = ex.holder.index("i")
+        idx.create_field("fv", FieldOptions.int_field(0, 1000))
+        fv = idx.field("fv")
+        rng = random.Random(5)
+        for c in range(0, 6 * SHARD_WIDTH, 997):
+            fv.set_value(c, rng.randrange(1000))
+        q = "TopN(f0, Row(fv > 500))"
+        fused = ex.execute("i", q)[0]
+        general = _general(ex, q)[0]
+        assert [(p.id, p.count) for p in fused] == \
+            [(p.id, p.count) for p in general]
+
+    def test_fused_topn_after_write_invalidation(self, ex):
+        q = "TopN(f0, n=5)"
+        before = ex.execute("i", q)[0]
+        ex.execute("i", f"Set({4 * SHARD_WIDTH + 11}, f0=0)")
+        after = {p.id: p.count for p in ex.execute("i", q)[0]}
+        want = {p.id: p.count for p in _general(ex, q)[0]}
+        assert after == want
+        assert after != {p.id: p.count for p in before} or \
+            0 not in {p.id for p in before}
+
+    def test_groupby_batched_matches_oracle(self, ex):
+        for q in [
+            "GroupBy(Rows(f0))",
+            "GroupBy(Rows(f0), Rows(f1))",
+            "GroupBy(Rows(f0), Rows(f1), Rows(f2))",
+            "GroupBy(Rows(f0), Rows(f1), limit=4)",
+            "GroupBy(Rows(f0), Rows(f1), filter=Row(f2=2))",
+        ]:
+            fused = ex.execute("i", q)[0]
+            general = _general(ex, q)[0]
+            assert [([(fr.field, fr.row_id) for fr in gc.group], gc.count)
+                    for gc in fused] == \
+                [([(fr.field, fr.row_id) for fr in gc.group], gc.count)
+                 for gc in general], q
+
+    def test_groupby_python_set_oracle(self, ex, tmp_path):
+        """Independent oracle: recompute one GroupBy from raw sets."""
+        from pilosa_tpu.models.holder import Holder
+
+        holder = Holder(str(tmp_path / "g"))
+        idx = holder.create_index("g")
+        rng = random.Random(9)
+        sets = {"a": {}, "b": {}}
+        for fname in sets:
+            f = idx.create_field(fname)
+            rows, cols = [], []
+            for row in range(4):
+                members = {rng.randrange(3 * SHARD_WIDTH)
+                           for _ in range(150)}
+                sets[fname][row] = members
+                for c in members:
+                    rows.append(row)
+                    cols.append(c)
+            f.import_bits(rows, cols)
+        ex2 = Executor(holder)
+        got = {
+            tuple((fr.field, fr.row_id) for fr in gc.group): gc.count
+            for gc in ex2.execute("g", "GroupBy(Rows(a), Rows(b))")[0]
+        }
+        want = {}
+        for ra, sa in sets["a"].items():
+            for rb, sb in sets["b"].items():
+                c = len(sa & sb)
+                if c:
+                    want[(("a", ra), ("b", rb))] = c
+        assert got == want
+        holder.close()
+
+    def test_clustered_topn_local_group_fuses(self, tmp_path):
+        """Clustered TopN: the originator's local shard group goes
+        through the fused stacked scan, and the distributed result is
+        exact."""
+        from pilosa_tpu.api import API
+        from tests.test_cluster import make_cluster
+
+        _, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        api = API(nodes[0])
+        rng = random.Random(13)
+        counts = {}
+        rows, cols = [], []
+        for row in range(5):
+            want = rng.randrange(20, 80)
+            members = set()
+            while len(members) < want:
+                members.add(rng.randrange(9 * SHARD_WIDTH))
+            counts[row] = len(members)
+            rows.extend([row] * len(members))
+            cols.extend(members)
+        api.import_bits("i", "f", rows, cols)
+        n0_local = len(nodes[0].cluster.local_shards("i", range(9)))
+        assert n0_local > 1, "placement changed; pick more shards"
+        hits = {"n": 0}
+        orig = nodes[0].executor._fused_topn_counts
+        nodes[0].executor._fused_topn_counts = (
+            lambda *a: (hits.__setitem__("n", hits["n"] + 1), orig(*a))[1])
+        got = nodes[0].executor.execute("i", "TopN(f)")[0]
+        assert hits["n"] > 0, "local group did not use the fused TopN scan"
+        want = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+        assert [(p.id, p.count) for p in got] == want
