@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Measure the five BASELINE.md benchmark configs through the product
+paths (PQL -> executor -> fused device dispatch), printing one JSON line
+per config.
+
+Run on the default backend (TPU when the axon relay is up, CPU
+otherwise):
+
+    PYTHONPATH=/root/repo:$PYTHONPATH python benchmarks/measure.py
+
+Configs (BASELINE.md "North-star target"):
+  1. single-shard Count(Intersect(Row,Row)) QPS
+  2. Union/Intersect/Difference latency over a multi-shard set field
+  3. TopN(n=100) with BSI Range filter, p50 latency
+  4. GroupBy + Sum over BSI int fields, p50 latency
+  5. 3-node HTTP cluster Count QPS (scatter-gather over the wire)
+
+Shapes scale DOWN off-TPU so the script stays interactive; the recorded
+BASELINE.md numbers come from TPU runs at the stated shapes.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import statistics
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def _now() -> float:
+    return time.perf_counter()
+
+
+def timed_qps(fn, min_iters: int = 20, min_time: float = 1.0):
+    fn()  # warm-up / compile
+    iters, t0 = 0, _now()
+    while iters < min_iters or _now() - t0 < min_time:
+        fn()
+        iters += 1
+    return iters / (_now() - t0)
+
+
+def timed_p50_ms(fn, iters: int = 30):
+    fn()  # warm-up / compile
+    samples = []
+    for _ in range(iters):
+        t0 = _now()
+        fn()
+        samples.append((_now() - t0) * 1e3)
+    return statistics.median(samples)
+
+
+def build_index(holder, name: str, n_shards: int, rows_per_field: int,
+                density_cols: int, seed: int):
+    """An index with two set fields (f, g), an int field (v) and a
+    time-quantum field (t), populated across n_shards shards."""
+    from pilosa_tpu.models.field import FieldOptions
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    idx = holder.create_index(name)
+    rng = random.Random(seed)
+    for fname in ("f", "g"):
+        f = idx.create_field(fname)
+        rows, cols = [], []
+        for row in range(rows_per_field):
+            for _ in range(density_cols):
+                s = rng.randrange(n_shards)
+                cols.append(s * SHARD_WIDTH + rng.randrange(SHARD_WIDTH))
+                rows.append(row)
+        f.import_bits(rows, cols)
+    v = idx.create_field("v", FieldOptions.int_field(0, 1 << 20))
+    vcols = sorted({s * SHARD_WIDTH + rng.randrange(SHARD_WIDTH)
+                    for s in range(n_shards) for _ in range(density_cols)})
+    v.import_values(vcols, [rng.randrange(1 << 20) for _ in vcols])
+    from pilosa_tpu.models.timequantum import parse_time
+
+    t = idx.create_field("t", FieldOptions.time_field("YMDH"))
+    trows, tcols, times = [], [], []
+    for row in range(4):
+        for _ in range(density_cols):
+            s = rng.randrange(n_shards)
+            trows.append(row)
+            tcols.append(s * SHARD_WIDTH + rng.randrange(SHARD_WIDTH))
+            times.append(parse_time(
+                f"2019-0{1 + rng.randrange(9)}-15T0{rng.randrange(10)}:00"))
+    t.import_bits(trows, tcols, timestamps=times)
+    return idx
+
+
+def main():
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    n_shards = 64 if on_tpu else 16
+    rows_per_field = 512 if on_tpu else 64
+    density = 4096 if on_tpu else 512
+
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.parallel.executor import Executor
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    out = []
+
+    holder = Holder(tempfile.mkdtemp() + "/bench")
+    build_index(holder, "b", n_shards, rows_per_field, density, seed=1)
+    ex = Executor(holder)
+
+    # ---- config 1: single-shard Count(Intersect) QPS
+    q1 = "Count(Intersect(Row(f=1), Row(g=2)))"
+    qps1 = timed_qps(lambda: ex.execute("b", q1, shards=[0]))
+    out.append({"config": 1, "metric": "intersect_count_qps_1shard",
+                "value": round(qps1, 1), "unit": "qps"})
+
+    # ---- config 2: multi-shard set algebra latency
+    q2 = "Count(Union(Row(f=1), Intersect(Row(f=2), Row(g=3)), Difference(Row(f=4), Row(g=5))))"
+    p2 = timed_p50_ms(lambda: ex.execute("b", q2))
+    out.append({"config": 2, "metric": "set_algebra_p50_ms",
+                "value": round(p2, 2), "unit": "ms",
+                "cols": n_shards * SHARD_WIDTH})
+
+    # ---- config 3: TopN(n=100) with BSI range filter p50
+    q3 = "TopN(f, Row(v > 524288), n=100)"
+    p3 = timed_p50_ms(lambda: ex.execute("b", q3))
+    out.append({"config": 3, "metric": "topn_bsi_filter_p50_ms",
+                "value": round(p3, 2), "unit": "ms",
+                "rows": rows_per_field})
+    # time-quantum range form
+    q3b = "TopN(t, n=100)"
+    p3b = timed_p50_ms(lambda: ex.execute("b", q3b))
+    out.append({"config": 3, "metric": "topn_time_field_p50_ms",
+                "value": round(p3b, 2), "unit": "ms"})
+
+    # ---- config 4: GroupBy + Sum p50
+    q4 = "GroupBy(Rows(f), Rows(g), filter=Row(v > 262144))"
+    # cap the walk: rows_per_field^2 groups is the worst case
+    p4 = timed_p50_ms(lambda: ex.execute("b", q4, shards=None), iters=10)
+    out.append({"config": 4, "metric": "groupby_filtered_p50_ms",
+                "value": round(p4, 2), "unit": "ms",
+                "groups_max": rows_per_field * rows_per_field})
+    q4b = "Sum(Row(f=1), field=v)"
+    p4b = timed_p50_ms(lambda: ex.execute("b", q4b))
+    out.append({"config": 4, "metric": "sum_filtered_p50_ms",
+                "value": round(p4b, 2), "unit": "ms"})
+
+    holder.close()
+
+    # ---- config 5: 3-node HTTP cluster Count QPS
+    import urllib.request
+
+    from pilosa_tpu.server.server import Server
+
+    base = tempfile.mkdtemp()
+    s0 = Server(data_dir=f"{base}/n0", coordinator=True); s0.open()
+    s1 = Server(data_dir=f"{base}/n1", seeds=[s0.uri]); s1.open()
+    s2 = Server(data_dir=f"{base}/n2", seeds=[s0.uri]); s2.open()
+
+    def post(path, obj):
+        r = urllib.request.Request(s0.uri + path,
+                                   data=json.dumps(obj).encode(),
+                                   method="POST")
+        r.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(r, timeout=120) as resp:
+            return json.loads(resp.read() or b"null")
+
+    post("/index/c", {})
+    post("/index/c/field/f", {})
+    rng = random.Random(2)
+    rows, cols = [], []
+    for row in range(8):
+        for _ in range(density):
+            s = rng.randrange(9)
+            rows.append(row)
+            cols.append(s * SHARD_WIDTH + rng.randrange(SHARD_WIDTH))
+    post("/index/c/field/f/import", {"rowIDs": rows, "columnIDs": cols})
+    q5 = {"query": "Count(Intersect(Row(f=1), Row(f=2)))"}
+    qps5 = timed_qps(lambda: post("/index/c/query", q5), min_iters=10)
+    out.append({"config": 5, "metric": "cluster3_count_qps_http",
+                "value": round(qps5, 1), "unit": "qps"})
+    s0.close(); s1.close(); s2.close()
+
+    platform = jax.devices()[0].platform
+    for rec in out:
+        rec["platform"] = platform
+        print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
