@@ -41,10 +41,17 @@ class InternalClient:
     # ------------------------------------------------------------- basics
 
     def _request(self, method: str, url: str, body: bytes | None = None,
-                 ctype: str = "application/json") -> bytes:
+                 ctype: str = "application/json",
+                 accept: str | None = None,
+                 error_decoder=None) -> bytes:
+        """One transport path for JSON and protobuf requests;
+        ``error_decoder(raw) -> str`` extracts the error detail from a
+        non-2xx body (default: JSON {"error": ...})."""
         req = urllib.request.Request(url, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", ctype)
+        if accept:
+            req.add_header("Accept", accept)
         try:
             with urllib.request.urlopen(req, timeout=self.timeout,
                                         context=self._ssl_ctx) as resp:
@@ -52,7 +59,11 @@ class InternalClient:
         except urllib.error.HTTPError as e:
             detail = ""
             try:
-                detail = json.loads(e.read()).get("error", "")
+                raw = e.read()
+                if error_decoder is not None:
+                    detail = error_decoder(raw)
+                else:
+                    detail = json.loads(raw).get("error", "")
             except Exception:
                 pass
             raise ClientError(e.code, detail or str(e)) from e
@@ -67,14 +78,27 @@ class InternalClient:
 
     def query_node(self, uri: str, index: str, pql: str,
                    shards: list[int] | None = None, remote: bool = True):
-        """POST /index/{i}/query with Remote semantics
-        (http/client.go:268 QueryNode).  Returns raw JSON result list."""
-        q = f"?remote={'true' if remote else 'false'}"
-        if shards is not None:
-            q += "&shards=" + ",".join(str(s) for s in shards)
-        d = self._json("POST", f"{uri}/index/{index}/query{q}",
-                       {"query": pql})
-        return d["results"]
+        """POST /index/{i}/query with Remote semantics over the
+        protobuf wire — node-to-node RPC speaks protobuf like the
+        reference's InternalClient (http/client.go:268 QueryNode;
+        external clients may still POST JSON).  Returns decoded result
+        objects."""
+        from pilosa_tpu import proto
+
+        body = proto.encode(proto.QUERY_REQUEST, {
+            "query": pql,
+            "shards": [int(s) for s in (shards or [])],
+            "remote": remote,
+        })
+        raw = self._request(
+            "POST", f"{uri}/index/{index}/query", body,
+            ctype="application/x-protobuf",
+            accept="application/x-protobuf",
+            error_decoder=lambda b: proto.decode(proto.QUERY_RESPONSE,
+                                                 b)["err"],
+        )
+        d = proto.decode(proto.QUERY_RESPONSE, raw)
+        return [proto.proto_to_result(r) for r in d["results"]]
 
     def send_message(self, uri: str, message: dict) -> dict:
         return self._json("POST", f"{uri}/internal/cluster/message", message)
@@ -178,10 +202,8 @@ class HTTPTransport(Transport):
         self.client = client or InternalClient()
 
     def query_node(self, node: Node, index: str, pql: str, shards):
-        from pilosa_tpu.server.handler import deserialize_results  # lazy; avoids cycle
-
-        raw = self.client.query_node(node.uri, index, pql, shards)
-        return deserialize_results(raw)
+        # the protobuf client already returns decoded result objects
+        return self.client.query_node(node.uri, index, pql, shards)
 
     def send_message(self, node: Node, message: dict) -> dict:
         return self.client.send_message(node.uri, message)

@@ -3,10 +3,10 @@
 Parity target: the reference's gorilla/mux route table
 (http/handler.go:273-322) — public ``/index/...`` + ``/schema`` +
 ``/status`` routes, internal ``/internal/...`` node-to-node routes, and
-infra routes (``/metrics``, ``/debug/vars``, ``/version``).  The wire
-format is JSON (the reference negotiates JSON vs protobuf,
-http/handler.go:499 handlePostQuery; JSON is its canonical public form
-and what its own docs use).
+infra routes (``/metrics``, ``/debug/vars``, ``/version``).  The query
+and import endpoints negotiate JSON vs protobuf like the reference
+(http/handler.go:499 handlePostQuery, :1002 content negotiation;
+wire schemas in ``pilosa_tpu.proto``); the control plane speaks JSON.
 
 Built on the stdlib ThreadingHTTPServer — the server side of the DCN
 control plane; the TPU data path never goes through HTTP.
@@ -333,39 +333,94 @@ class Handler:
 
     @route("POST", "/index/{index}/query")
     def handle_post_query(self, req, params, path, body):
-        pql = body.decode()
+        """PQL query with content negotiation: raw-PQL or JSON bodies
+        answered in JSON, ``application/x-protobuf`` QueryRequest bodies
+        answered in protobuf when Accept asks for it (reference
+        handlePostQuery, http/handler.go:499,1002)."""
+        from pilosa_tpu import proto
+
         ctype = req.headers.get("Content-Type", "")
-        if "json" in ctype:
-            pql = json.loads(pql).get("query", "")
+        proto_accept = "protobuf" in req.headers.get("Accept", "")
         shards = None
+        if "protobuf" in ctype:
+            preq = proto.decode(proto.QUERY_REQUEST, body)
+            pql = preq["query"]
+            shards = [int(s) for s in preq["shards"]] or None
+            remote = preq["remote"]
+            column_attrs = preq["columnAttrs"]
+            exclude_row_attrs = preq["excludeRowAttrs"]
+            exclude_columns = preq["excludeColumns"]
+        else:
+            pql = body.decode()
+            if "json" in ctype:
+                pql = json.loads(pql).get("query", "")
+            remote = params.get("remote") == "true"
+            column_attrs = params.get("columnAttrs") == "true"
+            exclude_row_attrs = params.get("excludeRowAttrs") == "true"
+            exclude_columns = params.get("excludeColumns") == "true"
         if params.get("shards"):
             shards = [int(s) for s in params["shards"].split(",")]
-        exclude_columns = params.get("excludeColumns") == "true"
-        column_attrs = params.get("columnAttrs") == "true"
-        results = self.api.query(
-            path["index"], pql, shards=shards,
-            remote=params.get("remote") == "true",
-            column_attrs=column_attrs,
-            exclude_row_attrs=params.get("excludeRowAttrs") == "true",
-            exclude_columns=exclude_columns,
-        )
+        try:
+            results = self.api.query(
+                path["index"], pql, shards=shards, remote=remote,
+                column_attrs=column_attrs,
+                exclude_row_attrs=exclude_row_attrs,
+                exclude_columns=exclude_columns,
+            )
+        except Exception as e:
+            if not proto_accept:
+                raise
+            # protobuf clients get errors as QueryResponse.Err with 400
+            # (reference writeProtobufQueryResponse)
+            self._proto(req, proto.encode(proto.QUERY_RESPONSE,
+                                          {"err": str(e)}), status=400)
+            return
         if exclude_columns:
             for r in results:
                 if isinstance(r, Row):
                     r.exclude_columns = True
-        resp = {"results": [serialize_result(r) for r in results]}
+        want_attr_rows = [r for r in results
+                          if isinstance(r, Row)
+                          and (column_attrs or r.wants_column_attrs)]
         # attach column attribute sets for result columns when requested
-        # by the URL param or a per-call Options(columnAttrs=true)
+        # by the URL param or a per-call Options(columnAttrs=true) —
+        # present (possibly empty) whenever requested, so clients can
+        # index the key unconditionally
         # (reference executor.go:206 / QueryResponse.columnAttrSets)
-        if column_attrs or any(
-                isinstance(r, Row) and r.wants_column_attrs
-                for r in results):
-            resp["columnAttrs"] = self._column_attr_sets(
-                path["index"],
-                [r for r in results
-                 if isinstance(r, Row)
-                 and (column_attrs or r.wants_column_attrs)])
+        attr_sets = (self._column_attr_sets(path["index"], want_attr_rows)
+                     if column_attrs or want_attr_rows else None)
+        if proto_accept:
+            pb = {"results": [proto.result_to_proto(r) for r in results]}
+            if attr_sets is not None:
+                pb["columnAttrSets"] = [
+                    {"id": a.get("id", 0), "key": a.get("key", ""),
+                     "attrs": proto.attrs_to_proto(a["attrs"])}
+                    for a in attr_sets
+                ]
+            self._proto(req, proto.encode(proto.QUERY_RESPONSE, pb))
+            return
+        resp = {"results": [serialize_result(r) for r in results]}
+        if attr_sets is not None:
+            resp["columnAttrs"] = attr_sets
         self._json(req, resp)
+
+    def _import_ok(self, req) -> None:
+        """Success response for import endpoints: an empty protobuf
+        ImportResponse for protobuf clients (reference handlePostImport,
+        http/handler.go:1161), JSON {} otherwise."""
+        if "protobuf" in req.headers.get("Accept", ""):
+            from pilosa_tpu import proto
+
+            self._proto(req, proto.encode(proto.IMPORT_RESPONSE, {}))
+        else:
+            self._json(req, {})
+
+    def _proto(self, req, payload: bytes, status: int = 200) -> None:
+        try:
+            self._bytes(req, payload, ctype="application/protobuf",
+                        status=status)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
 
     def _column_attr_sets(self, index: str, rows: list[Row]) -> list[dict]:
         idx = self.api.index(index)
@@ -423,14 +478,29 @@ class Handler:
 
     @route("POST", "/index/{index}/field/{field}/import")
     def handle_import(self, req, params, path, body):
-        """JSON bit import: {"rowIDs": [...], "columnIDs": [...],
-        "timestamps": [...], "rowKeys": [...], "columnKeys": [...]}
-        (reference handlePostImport; wire form internal/public.proto
-        ImportRequest).  Timestamps are unix seconds or RFC3339."""
-        d = json.loads(body)
+        """Bit import: JSON {"rowIDs": [...], "columnIDs": [...],
+        "timestamps": [...], "rowKeys": [...], "columnKeys": [...]} or a
+        protobuf ImportRequest body (reference handlePostImport; wire
+        form internal/public.proto ImportRequest).  Timestamps are unix
+        seconds or RFC3339 in JSON, unix NANOseconds in protobuf (the
+        reference encodes time.Time.UnixNano)."""
+        if "protobuf" in req.headers.get("Content-Type", ""):
+            from pilosa_tpu import proto
+
+            d = proto.decode(proto.IMPORT_REQUEST, body)
+            if d.get("timestamps"):
+                # 0 = "no timestamp" in the reference's wire form
+                d["timestamps"] = [t or None for t in d["timestamps"]]
+            # empty repeated fields mean "unkeyed", like absent JSON keys
+            for k in ("rowKeys", "columnKeys", "timestamps"):
+                if not d.get(k):
+                    d[k] = None
+        else:
+            d = json.loads(body)
         timestamps = d.get("timestamps")
         if timestamps:
-            timestamps = [_parse_ts(t) for t in timestamps]
+            timestamps = [None if t is None else _parse_ts(t)
+                          for t in timestamps]
         self.api.import_bits(
             path["index"], path["field"],
             d.get("rowIDs") or [], d.get("columnIDs") or [],
@@ -438,17 +508,24 @@ class Handler:
             row_keys=d.get("rowKeys"), col_keys=d.get("columnKeys"),
             clear=params.get("clear") == "true",
         )
-        self._json(req, {})
+        self._import_ok(req)
 
     @route("POST", "/index/{index}/field/{field}/import-value")
     def handle_import_value(self, req, params, path, body):
-        d = json.loads(body)
+        if "protobuf" in req.headers.get("Content-Type", ""):
+            from pilosa_tpu import proto
+
+            d = proto.decode(proto.IMPORT_VALUE_REQUEST, body)
+            if not d.get("columnKeys"):
+                d["columnKeys"] = None
+        else:
+            d = json.loads(body)
         self.api.import_values(
             path["index"], path["field"],
             d.get("columnIDs") or [], d.get("values") or [],
             col_keys=d.get("columnKeys"),
         )
-        self._json(req, {})
+        self._import_ok(req)
 
     @route("POST", "/index/{index}/field/{field}/import-roaring/{shard}")
     def handle_import_roaring(self, req, params, path, body):
@@ -456,7 +533,14 @@ class Handler:
         standard view, or JSON {"views": {name: base64}}
         (reference handlePostImportRoaring, ImportRoaringRequest)."""
         ctype = req.headers.get("Content-Type", "")
-        if "json" in ctype:
+        clear = params.get("clear") == "true"
+        if "protobuf" in ctype:
+            from pilosa_tpu import proto
+
+            d = proto.decode(proto.IMPORT_ROARING_REQUEST, body)
+            views = {v["name"]: v["data"] for v in d["views"]}
+            clear = clear or d["clear"]
+        elif "json" in ctype:
             d = json.loads(body)
             views = {k: base64.b64decode(v)
                      for k, v in (d.get("views") or {}).items()}
@@ -464,8 +548,8 @@ class Handler:
             views = {"": body}
         self.api.import_roaring(path["index"], path["field"],
                                 int(path["shard"]), views,
-                                clear=params.get("clear") == "true")
-        self._json(req, {})
+                                clear=clear)
+        self._import_ok(req)
 
     @route("GET", "/export")
     def handle_export(self, req, params, path, body):

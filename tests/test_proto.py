@@ -1,0 +1,253 @@
+"""Protobuf wire format: codec round-trips, differential JSON-vs-proto
+responses from a live server, proto imports, and malformed-input
+robustness (reference: internal/public.proto message set +
+handlePostQuery content negotiation, http/handler.go:499,1002)."""
+
+from __future__ import annotations
+
+import json
+import random
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import proto
+from pilosa_tpu.models.row import Row
+from pilosa_tpu.parallel.results import FieldRow, GroupCount, Pair, ValCount
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class TestWireCodec:
+    def test_varint_boundaries(self):
+        for n in [0, 1, 127, 128, 300, (1 << 32) - 1, 1 << 32,
+                  (1 << 64) - 1]:
+            enc = proto._varint(n)
+            dec, i = proto._read_varint(enc, 0)
+            assert dec == n and i == len(enc)
+
+    def test_signed_int64(self):
+        for v in [0, -1, 1, -(1 << 63), (1 << 63) - 1, -123456789]:
+            enc = proto.encode(proto.VAL_COUNT, {"val": v, "count": 1})
+            assert proto.decode(proto.VAL_COUNT, enc)["val"] == v
+
+    def test_double(self):
+        enc = proto.encode(proto.ATTR, {"key": "x", "type": proto.ATTR_FLOAT,
+                                        "floatValue": -2.5})
+        d = proto.decode(proto.ATTR, enc)
+        assert d["floatValue"] == -2.5
+
+    def test_packed_and_unpacked_repeated(self):
+        vals = [0, 1, 127, 128, 1 << 40]
+        enc = proto.encode(proto.ROW, {"columns": vals})
+        assert proto.decode(proto.ROW, enc)["columns"] == vals
+        # unpacked form (one varint field per element) must also decode
+        unpacked = b"".join(proto._key(1, 0) + proto._varint(v)
+                            for v in vals)
+        assert proto.decode(proto.ROW, unpacked)["columns"] == vals
+
+    def test_unknown_fields_skipped(self):
+        # append an unknown varint field 15 and an unknown LEN field 14
+        enc = proto.encode(proto.PAIR, {"id": 3, "count": 7})
+        enc += proto._key(15, 0) + proto._varint(999)
+        enc += proto._key(14, 2) + proto._varint(3) + b"abc"
+        d = proto.decode(proto.PAIR, enc)
+        assert (d["id"], d["count"]) == (3, 7)
+
+    def test_truncated_blobs_raise(self):
+        enc = proto.encode(proto.QUERY_REQUEST,
+                           {"query": "Count(Row(f=1))", "shards": [1, 2]})
+        for cut in range(1, len(enc)):
+            try:
+                proto.decode(proto.QUERY_REQUEST, enc[:cut])
+            except ValueError:
+                pass  # must raise cleanly, never crash
+
+    def test_query_result_type_codes(self):
+        # the reference's tagging (encoding/proto/proto.go:1057)
+        assert proto.result_to_proto(None)["type"] == 0
+        assert proto.result_to_proto(Row())["type"] == 1
+        assert proto.result_to_proto([Pair(id=1, count=1)])["type"] == 2
+        assert proto.result_to_proto(ValCount())["type"] == 3
+        assert proto.result_to_proto(5)["type"] == 4
+        assert proto.result_to_proto(True)["type"] == 5
+        assert proto.result_to_proto(
+            [GroupCount(group=[FieldRow(field="f", row_id=1)],
+                        count=1)])["type"] == 7
+        assert proto.result_to_proto([1, 2])["type"] == 8
+
+    def test_attr_round_trip(self):
+        attrs = {"s": "hello", "i": -42, "b": True, "f": 1.5}
+        back = proto.proto_to_attrs(proto.attrs_to_proto(attrs))
+        assert back == attrs
+
+
+@pytest.fixture
+def srv(tmp_path):
+    s = Server(str(tmp_path / "node0"))
+    s.open()
+    yield s
+    s.close()
+
+
+def _post(uri, path, data, ctype, accept=None):
+    req = urllib.request.Request(uri + path, data=data, method="POST")
+    req.add_header("Content-Type", ctype)
+    if accept:
+        req.add_header("Accept", accept)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.read(), resp.headers.get("Content-Type")
+
+
+class TestProtoHTTP:
+    def _seed(self, srv):
+        _post(srv.uri, "/index/i", b"{}", "application/json")
+        _post(srv.uri, "/index/i/field/f", b"{}", "application/json")
+        rng = random.Random(5)
+        sets = {r: set() for r in range(4)}
+        rows, cols = [], []
+        for r in sets:
+            for _ in range(200):
+                c = rng.randrange(3 * SHARD_WIDTH)
+                sets[r].add(c)
+                rows.append(r)
+                cols.append(c)
+        body = json.dumps({"rowIDs": rows, "columnIDs": cols}).encode()
+        _post(srv.uri, "/index/i/field/f/import", body, "application/json")
+        return sets
+
+    def _q_json(self, srv, q):
+        raw, _ = _post(srv.uri, "/index/i/query",
+                       json.dumps({"query": q}).encode(),
+                       "application/json")
+        return json.loads(raw)["results"]
+
+    def _q_proto(self, srv, q, shards=None):
+        body = proto.encode(proto.QUERY_REQUEST,
+                            {"query": q, "shards": shards or []})
+        raw, ctype = _post(srv.uri, "/index/i/query", body,
+                           "application/x-protobuf",
+                           accept="application/x-protobuf")
+        assert "protobuf" in ctype
+        d = proto.decode(proto.QUERY_RESPONSE, raw)
+        assert d["err"] == ""
+        return [proto.proto_to_result(r) for r in d["results"]]
+
+    def test_differential_json_vs_proto(self, srv):
+        sets = self._seed(srv)
+        # Count
+        jr = self._q_json(srv, "Count(Row(f=1))")
+        pr = self._q_proto(srv, "Count(Row(f=1))")
+        assert jr[0] == pr[0] == len(sets[1])
+        # Row
+        jr = self._q_json(srv, "Row(f=2)")
+        pr = self._q_proto(srv, "Row(f=2)")
+        assert jr[0]["columns"] == list(map(int, pr[0].columns())) \
+            == sorted(sets[2])
+        # TopN
+        jr = self._q_json(srv, "TopN(f)")
+        pr = self._q_proto(srv, "TopN(f)")
+        assert [(p["id"], p["count"]) for p in jr[0]] == \
+            [(p.id, p.count) for p in pr[0]]
+        # Set (bool result)
+        pr = self._q_proto(srv, f"Set({5 * 7}, f=9)")
+        assert pr[0] is True
+
+    def test_proto_shard_restriction(self, srv):
+        sets = self._seed(srv)
+        want = len([c for c in sets[1] if c // SHARD_WIDTH == 0])
+        pr = self._q_proto(srv, "Count(Row(f=1))", shards=[0])
+        assert pr[0] == want
+
+    def test_proto_error_response(self, srv):
+        self._seed(srv)
+        body = proto.encode(proto.QUERY_REQUEST, {"query": "Bogus("})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.uri, "/index/i/query", body,
+                  "application/x-protobuf",
+                  accept="application/x-protobuf")
+        assert e.value.code == 400
+        d = proto.decode(proto.QUERY_RESPONSE, e.value.read())
+        assert d["err"] != ""
+
+    def test_proto_garbage_body_is_400_not_crash(self, srv):
+        self._seed(srv)
+        for garbage in [b"\xff\xff\xff\xff", b"\x0a", b"\x9a" * 40]:
+            try:
+                _post(srv.uri, "/index/i/query", garbage,
+                      "application/x-protobuf",
+                      accept="application/x-protobuf")
+            except urllib.error.HTTPError as e:
+                assert e.code in (400, 500)
+        # server still answers
+        assert self._q_json(srv, "Count(Row(f=1))")[0] >= 0
+
+    def test_proto_import_paths(self, srv):
+        _post(srv.uri, "/index/i", b"{}", "application/json")
+        _post(srv.uri, "/index/i/field/f", b"{}", "application/json")
+        _post(srv.uri, "/index/i/field/v",
+              json.dumps({"options": {"type": "int", "min": -100,
+                                      "max": 100}}).encode(),
+              "application/json")
+        body = proto.encode(proto.IMPORT_REQUEST, {
+            "index": "i", "field": "f", "shard": 0,
+            "rowIDs": [1, 1, 2], "columnIDs": [3, 4, 5],
+        })
+        _post(srv.uri, "/index/i/field/f/import", body,
+              "application/x-protobuf")
+        assert self._q_json(srv, "Row(f=1)")[0]["columns"] == [3, 4]
+        vbody = proto.encode(proto.IMPORT_VALUE_REQUEST, {
+            "index": "i", "field": "v", "shard": 0,
+            "columnIDs": [3, 4], "values": [-7, 50],
+        })
+        _post(srv.uri, "/index/i/field/v/import-value", vbody,
+              "application/x-protobuf")
+        out = self._q_json(srv, "Sum(field=v)")
+        assert out[0]["value"] == 43
+
+    def test_proto_time_import(self, srv):
+        _post(srv.uri, "/index/i", b"{}", "application/json")
+        _post(srv.uri, "/index/i/field/t",
+              json.dumps({"options": {"type": "time",
+                                      "timeQuantum": "YMD"}}).encode(),
+              "application/json")
+        ts = 1555555200 * 10**9  # 2019-04-18 in unix nanos
+        body = proto.encode(proto.IMPORT_REQUEST, {
+            "index": "i", "field": "t", "shard": 0,
+            "rowIDs": [1, 1], "columnIDs": [3, 4],
+            "timestamps": [ts, 0],  # 0 = no timestamp
+        })
+        _post(srv.uri, "/index/i/field/t/import", body,
+              "application/x-protobuf")
+        raw = self._q_json(
+            srv, "Row(t=1, from='2019-04-01T00:00', to='2019-05-01T00:00')")
+        assert raw[0]["columns"] == [3]
+        assert self._q_json(srv, "Row(t=1)")[0]["columns"] == [3, 4]
+
+    def test_proto_import_response_negotiated(self, srv):
+        _post(srv.uri, "/index/i", b"{}", "application/json")
+        _post(srv.uri, "/index/i/field/f", b"{}", "application/json")
+        body = proto.encode(proto.IMPORT_REQUEST, {
+            "index": "i", "field": "f", "shard": 0,
+            "rowIDs": [1], "columnIDs": [2],
+        })
+        raw, ctype = _post(srv.uri, "/index/i/field/f/import", body,
+                           "application/x-protobuf",
+                           accept="application/x-protobuf")
+        assert "protobuf" in ctype
+        assert proto.decode(proto.IMPORT_RESPONSE, raw)["err"] == ""
+        # JSON clients still get JSON {}
+        body2 = json.dumps({"rowIDs": [1], "columnIDs": [9]}).encode()
+        raw, ctype = _post(srv.uri, "/index/i/field/f/import", body2,
+                           "application/json")
+        assert "json" in ctype and json.loads(raw) == {}
+
+    def test_column_attrs_key_present_when_requested(self, srv):
+        _post(srv.uri, "/index/i", b"{}", "application/json")
+        _post(srv.uri, "/index/i/field/f", b"{}", "application/json")
+        raw, _ = _post(srv.uri, "/index/i/query?columnAttrs=true",
+                       json.dumps({"query": "Count(Row(f=1))"}).encode(),
+                       "application/json")
+        d = json.loads(raw)
+        assert d["columnAttrs"] == []  # requested -> key always present
