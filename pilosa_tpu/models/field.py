@@ -354,6 +354,7 @@ class Field:
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if hit is not None and hit[0] == gens:
+                self._touch(self._row_stack_cache, key)
                 return hit[1]
         n_words = bm.n_words(SHARD_WIDTH)
         stack = np.zeros((_padded_rows(len(shards)), n_words),
@@ -365,6 +366,12 @@ class Field:
                     if arr is not None:
                         stack[i] = arr
         return self._place_and_cache_stack(key, gens, stack)
+
+    @staticmethod
+    def _touch(cache: dict, key) -> None:
+        from pilosa_tpu.runtime import residency
+
+        residency.manager().touch(cache, key)
 
     @staticmethod
     def _place_on_devices(stack: np.ndarray):
@@ -388,24 +395,35 @@ class Field:
             return dev  # uncacheable; never evict the warm cache for it
         self._evict_and_insert(
             self._row_stack_cache, key, (gens, dev), entry_bytes,
-            self.ROW_STACK_CACHE_BYTES, 64, lambda e: e[1].nbytes)
+            max_entries=64)
         return dev
 
     def _evict_and_insert(self, cache: dict, key, entry, entry_bytes: int,
-                          budget: int, max_entries: int, nbytes_of) -> None:
-        """FIFO-evict until the new entry fits the byte budget (NOT an
-        entry count — one wide-index entry can be tens of MB of device
-        memory) and the entry cap, then insert."""
+                          max_entries: int) -> None:
+        """Insert under the entry cap; BYTE budgeting is global — the
+        process-wide residency manager sees every owner's device caches
+        and LRU-evicts across all of them, so the true device total is
+        bounded even when several caches hold views of the same field
+        (runtime/residency.py).  The manager may concurrently pop
+        entries from this dict under its own lock, so every removal
+        here tolerates a vanished key, and admit happens inside
+        self._lock so the inserted entry can't be popped before it is
+        tracked."""
+        from pilosa_tpu.runtime import residency
+
+        mgr = residency.manager()
         with self._lock:
-            # replace-in-place first, or the stale entry's bytes would
-            # double-count against the budget and evict warm neighbours
-            cache.pop(key, None)
-            total = sum(nbytes_of(e) for e in cache.values())
-            while cache and (total + entry_bytes > budget
-                             or len(cache) >= max_entries):
-                evicted = cache.pop(next(iter(cache)))
-                total -= nbytes_of(evicted)
+            if cache.pop(key, None) is not None:
+                mgr.forget(cache, key)
+            while len(cache) >= max_entries:
+                try:
+                    k = next(iter(cache))
+                except StopIteration:
+                    break
+                cache.pop(k, None)
+                mgr.forget(cache, k)
             cache[key] = entry
+            mgr.admit(cache, key, entry_bytes)
 
     #: device-memory budget for concatenated matrix stacks (bytes)
     MATRIX_STACK_CACHE_BYTES = 512 << 20
@@ -445,6 +463,7 @@ class Field:
         with self._lock:
             hit = self._matrix_stack_cache.get(key)
             if hit is not None and hit[0] == gens:
+                self._touch(self._matrix_stack_cache, key)
                 return hit
         if not parts:
             return (gens, np.empty(0, dtype=np.int64), None, None, None)
@@ -464,7 +483,7 @@ class Field:
             return entry  # uncacheable; don't evict the warm cache for it
         self._evict_and_insert(
             self._matrix_stack_cache, key, entry, entry_bytes,
-            self.MATRIX_STACK_CACHE_BYTES, 8, lambda e: e[4].nbytes)
+            max_entries=8)
         return entry
 
     def row_time(self, row_id: int, shard: int, start, end) -> np.ndarray | None:
@@ -501,6 +520,7 @@ class Field:
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if hit is not None and hit[0] == gens:
+                self._touch(self._row_stack_cache, key)
                 return hit[1]
         n_words = bm.n_words(SHARD_WIDTH)
         n_planes = bsi_ops.OFFSET_PLANE + depth
@@ -829,11 +849,23 @@ class Field:
     # ---------------------------------------------------------- lifecycle
 
     def close(self) -> None:
+        from pilosa_tpu.runtime import residency
+
         for view in self.views.values():
             view.close()
         self.row_attrs.close()
         if self._translate_store is not None:
             self._translate_store.close()
+        # release device residency accounting for the field-level stack
+        # caches (the manager holds strong refs to these dicts; without
+        # this a deleted field's tensors stay budgeted until pressure
+        # happens to evict them), mirroring Fragment.close
+        mgr = residency.manager()
+        with self._lock:
+            for cache in (self._row_stack_cache, self._matrix_stack_cache):
+                for k in list(cache):
+                    mgr.forget(cache, k)
+                cache.clear()
 
     def snapshot(self) -> None:
         for view in self.views.values():

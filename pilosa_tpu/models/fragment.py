@@ -186,10 +186,18 @@ class Fragment:
             self.topn_cache.save(self._cache_path, self._gen)
 
     def close(self) -> None:
+        from pilosa_tpu.runtime import residency
+
         with self._lock:
             if self._wal is not None:
                 self._wal.close()
                 self._wal = None
+            # release device residency accounting (drops the cache refs;
+            # the jax buffers free once no computation holds them)
+            mgr = residency.manager()
+            for k in list(self._device_cache):
+                mgr.forget(self._device_cache, k)
+            self._device_cache.clear()
 
     def _maybe_snapshot(self) -> None:
         if self.path is not None and self._op_n > self.max_op_n:
@@ -533,17 +541,23 @@ class Fragment:
             return ids, matrix
 
     def device_matrix(self):
-        """(row_ids, jax uint32[R, words]) resident in device memory."""
+        """(row_ids, jax uint32[R, words]) resident in device memory;
+        accounted by the process-wide residency manager."""
         import jax
+
+        from pilosa_tpu.runtime import residency
 
         with self._lock:
             key = "matrix"
             hit = self._device_cache.get(key)
             if hit is not None and hit[0] == self._gen:
+                residency.manager().touch(self._device_cache, key)
                 return hit[1], hit[2]
             ids, matrix = self._stacked()
             dev = jax.device_put(matrix)
             self._device_cache[key] = (self._gen, ids, dev)
+            residency.manager().admit(self._device_cache, key,
+                                      matrix.nbytes)
             return ids, dev
 
     def device_row(self, row: int):
@@ -557,13 +571,17 @@ class Fragment:
         return dev[int(slot)]
 
     def device_planes(self, depth: int):
-        """BSI plane stack uint32[2 + depth, words] resident on device."""
+        """BSI plane stack uint32[2 + depth, words] resident on device;
+        accounted by the process-wide residency manager."""
         import jax
+
+        from pilosa_tpu.runtime import residency
 
         with self._lock:
             key = ("planes", depth)
             hit = self._device_cache.get(key)
             if hit is not None and hit[0] == self._gen:
+                residency.manager().touch(self._device_cache, key)
                 return hit[1]
             P = np.zeros((bsi_ops.OFFSET_PLANE + depth, self.n_words), dtype=np.uint32)
             for i in range(P.shape[0]):
@@ -572,6 +590,7 @@ class Fragment:
                     P[i] = arr
             dev = jax.device_put(P)
             self._device_cache[key] = (self._gen, dev)
+            residency.manager().admit(self._device_cache, key, P.nbytes)
             return dev
 
     # ------------------------------------------------------------ BSI ops

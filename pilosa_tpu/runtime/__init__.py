@@ -1,0 +1,1 @@
+"""Host runtime services: device-memory residency management."""

@@ -1,0 +1,141 @@
+"""Unified device-memory residency manager.
+
+Every cached device tensor — per-fragment row matrices and BSI planes
+(`Fragment._device_cache`), cross-shard row stacks and concatenated
+matrix stacks (`Field._row_stack_cache` / `_matrix_stack_cache`) — is
+registered here under ONE process-wide byte budget with LRU eviction
+across owners.  Before this layer each cache byte-budgeted itself, so
+mixed workloads could hold a field's matrices on device several times
+over without any cap seeing the total (the SURVEY.md §7 risk-register
+item: the "fragment heap manager" half of the C++ PJRT host runtime —
+host-side accounting here; the tensors themselves live in HBM and are
+freed by dropping the owning cache reference, which releases the jax
+buffer once no computation holds it).
+
+Reference analog: the mmap budget caps of syswrap (syswrap/os.go:41,
+syswrap/mmap.go:27) — a global guard over per-object storage residency.
+
+Eviction only drops CACHE references.  Owners rebuild evicted entries
+from host state on the next query (every registered tensor is a cache
+of host-resident data by construction), so eviction can never lose
+data — only warmth.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+def _default_budget() -> int:
+    env = os.environ.get("PILOSA_TPU_DEVICE_BUDGET_BYTES")
+    if env:
+        return int(env)
+    # Probe the backend for real memory limits (works on TPU); fall
+    # back to a conservative figure that keeps CPU test runs light.
+    try:
+        import jax
+
+        stats = jax.devices()[0].memory_stats()
+        if stats and "bytes_limit" in stats:
+            # leave headroom for executables, collectives and live
+            # intermediates; caches may take at most 60%
+            return int(stats["bytes_limit"] * 0.6) * len(jax.devices())
+    except Exception:
+        pass
+    return 2 << 30
+
+
+class ResidencyManager:
+    """LRU accounting of cached device tensors across all owners.
+
+    Owners call ``admit(cache_dict, key, nbytes)`` AFTER inserting the
+    entry into their own dict; the manager may synchronously evict
+    other entries (possibly from other owners) by deleting them from
+    their owner dicts.  Owners must therefore treat a missing key as a
+    cold cache and rebuild — which they already do, since generation
+    mismatches produce exactly the same miss."""
+
+    def __init__(self, budget_bytes: int | None = None):
+        self.budget = budget_bytes or _default_budget()
+        self._lock = threading.Lock()
+        # (owner dict id, key) -> (owner dict, key, nbytes); dict
+        # preserves insertion order = LRU order (move-to-end on touch)
+        self._entries: dict[tuple, tuple[dict, object, int]] = {}
+        self.total = 0
+        self.evictions = 0
+
+    @staticmethod
+    def _id(cache: dict, key) -> tuple:
+        return (id(cache), key)
+
+    def admit(self, cache: dict, key, nbytes: int) -> None:
+        """Track an entry just inserted into ``cache`` under ``key``;
+        evict least-recently-used entries (from any owner) until the
+        total fits the budget.  The entry being admitted is never its
+        own victim, so the total is bounded by max(budget, largest
+        single entry) even when individual entries exceed the whole
+        budget — an unconditional reclaim, like the reference's global
+        syswrap caps (syswrap/os.go:41)."""
+        eid = self._id(cache, key)
+        with self._lock:
+            old = self._entries.pop(eid, None)
+            if old is not None:
+                self.total -= old[2]
+            self._entries[eid] = (cache, key, nbytes)
+            self.total += nbytes
+            while self.total > self.budget and len(self._entries) > 1:
+                victim_id = next(iter(self._entries))
+                if victim_id == eid:
+                    # never evict the entry being admitted
+                    self._entries[eid] = self._entries.pop(eid)
+                    continue
+                vcache, vkey, vbytes = self._entries.pop(victim_id)
+                self.total -= vbytes
+                self.evictions += 1
+                vcache.pop(vkey, None)
+
+    def touch(self, cache: dict, key) -> None:
+        """Mark an entry recently used (cache hit)."""
+        eid = self._id(cache, key)
+        with self._lock:
+            e = self._entries.pop(eid, None)
+            if e is not None:
+                self._entries[eid] = e
+
+    def forget(self, cache: dict, key) -> None:
+        """Stop tracking an entry the owner removed itself (overwrite,
+        invalidation, fragment delete)."""
+        eid = self._id(cache, key)
+        with self._lock:
+            e = self._entries.pop(eid, None)
+            if e is not None:
+                self.total -= e[2]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"budget": self.budget, "total": self.total,
+                    "entries": len(self._entries),
+                    "evictions": self.evictions}
+
+
+_global: ResidencyManager | None = None
+_global_lock = threading.Lock()
+
+
+def manager() -> ResidencyManager:
+    """The process-wide manager (one budget per process, like the
+    reference's global syswrap caps)."""
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = ResidencyManager()
+        return _global
+
+
+def reset(budget_bytes: int | None = None) -> ResidencyManager:
+    """Replace the global manager (tests; budget reconfiguration)."""
+    global _global
+    with _global_lock:
+        _global = ResidencyManager(budget_bytes)
+        return _global
