@@ -62,6 +62,16 @@ def runtime_gauges(stats) -> None:
         stats.gauge("open_files", len(os.listdir("/proc/self/fd")))
     except OSError:
         pass
+    try:
+        from pilosa_tpu.runtime import residency
+
+        r = residency.manager().stats()
+        stats.gauge("device.cache_bytes", r["total"])
+        stats.gauge("device.cache_budget_bytes", r["budget"])
+        stats.gauge("device.cache_entries", r["entries"])
+        stats.gauge("device.cache_evictions", r["evictions"])
+    except Exception:
+        pass  # gauges must never take the monitor loop down
     counts = gc.get_count()
     for i, c in enumerate(counts):
         stats.gauge(f"gc.gen{i}_count", c)

@@ -276,6 +276,9 @@ class TestStatsAndTracing:
         snap = s.snapshot()
         assert snap["threads"] >= 1
         assert snap.get("memory.rss_bytes", 1) > 0
+        # device residency gauges come from the global manager
+        assert snap["device.cache_budget_bytes"] > 0
+        assert snap["device.cache_bytes"] >= 0
 
     def test_mem_tracer_spans(self):
         from pilosa_tpu import tracing
