@@ -218,31 +218,89 @@ def cmd_import(args) -> int:
         rows, cols, values, timestamps = [], [], [], []
 
     import contextlib
+    import io
+
+    from pilosa_tpu import csvload
+
+    def consume_python(stream, path, line_base=0):
+        """General path: full CSV semantics incl. timestamps/quoting
+        (reference bufferBits, ctl/import.go:173)."""
+        reader = csv.reader(stream)
+        while True:
+            try:
+                rec = next(reader)
+            except StopIteration:
+                return True
+            except csv.Error as e:
+                print(f"{path}:{line_base + reader.line_num}: "
+                      f"bad record: {e}", file=sys.stderr)
+                return False
+            line_no = line_base + reader.line_num
+            if not rec or (len(rec) == 1 and not rec[0].strip()):
+                continue
+            try:
+                if is_value:
+                    cols.append(int(rec[0]))
+                    values.append(int(rec[1]))
+                else:
+                    rows.append(int(rec[0]))
+                    cols.append(int(rec[1]))
+                    timestamps.append(
+                        _csv_ts(rec[2]) if len(rec) > 2 and rec[2]
+                        else None)
+            except (ValueError, IndexError) as e:
+                print(f"{path}:{line_no}: bad record {rec!r}: {e}",
+                      file=sys.stderr)
+                return False
+            if len(cols) >= args.batch_size:
+                flush()
+
+    def consume_native(stream, path) -> bool:
+        """Fast path: the C++ loader parses all-integer two-column
+        chunks straight into int64 buffers.  Chunks it declines —
+        timestamps, quoting, malformed records — re-parse through the
+        Python path (line numbers preserved), which alone decides what
+        is actually an error."""
+        line_base = 0
+        for buf in csvload.read_complete_lines(stream, 32 << 20):
+            try:
+                a, b = csvload.parse_pairs(buf)
+                # top up to the batch size exactly — one POST must
+                # never exceed it, even with records already buffered
+                i = 0
+                while i < len(a):
+                    take = max(1, args.batch_size - len(cols))
+                    sa = a[i:i + take].tolist()
+                    sb = b[i:i + take].tolist()
+                    if is_value:
+                        cols.extend(sa)
+                        values.extend(sb)
+                    else:
+                        rows.extend(sa)
+                        cols.extend(sb)
+                        timestamps.extend([None] * len(sa))
+                    i += take
+                    if len(cols) >= args.batch_size:
+                        flush()
+            except csvload.NeedsFallback:
+                # universal-newline translation, matching what open()
+                # did before the bytes detour (lone-\r files must parse
+                # identically with or without the native library)
+                text = buf.decode().replace("\r\n", "\n").replace("\r", "\n")
+                if not consume_python(io.StringIO(text), path, line_base):
+                    return False
+            line_base += buf.count(b"\n")
+        return True
 
     for path in args.files:
         stream = sys.stdin if path == "-" else open(path)
         # never close stdin — callers (and later "-" args) still need it
         ctx = contextlib.nullcontext(stream) if path == "-" else stream
         with ctx:
-            for line_no, rec in enumerate(csv.reader(stream), 1):
-                if not rec or (len(rec) == 1 and not rec[0].strip()):
-                    continue
-                try:
-                    if is_value:
-                        cols.append(int(rec[0]))
-                        values.append(int(rec[1]))
-                    else:
-                        rows.append(int(rec[0]))
-                        cols.append(int(rec[1]))
-                        timestamps.append(
-                            _csv_ts(rec[2]) if len(rec) > 2 and rec[2]
-                            else None)
-                except (ValueError, IndexError) as e:
-                    print(f"{path}:{line_no}: bad record {rec!r}: {e}",
-                          file=sys.stderr)
-                    return 1
-                if len(cols) >= args.batch_size:
-                    flush()
+            ok = (consume_native(stream, path) if csvload.available()
+                  else consume_python(stream, path))
+            if not ok:
+                return 1
     flush()
     print(f"imported {n_sent} records into "
           f"{args.index}/{args.field}", file=sys.stderr)

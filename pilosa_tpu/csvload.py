@@ -1,0 +1,95 @@
+"""ctypes binding for the native bulk CSV parser (libcsvload).
+
+The native data-loader behind ``pilosa-tpu import`` (reference
+bufferBits, ctl/import.go:173): the all-integer two-column forms
+("row,col[,]" and "col,value") parse in C++ straight into numpy int64
+buffers; anything else — timestamps, quoting, non-integer fields —
+falls back to the Python csv path, which remains the semantics oracle
+(differential-tested in tests/test_csvload.py)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from pilosa_tpu.native_loader import NativeLib
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native")
+
+
+def _setup(lib) -> None:
+    lib.csvload_parse2.argtypes = [
+        ctypes.c_char_p, ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.POINTER(ctypes.c_longlong),
+        ctypes.c_longlong,
+        ctypes.POINTER(ctypes.c_longlong),
+    ]
+    lib.csvload_parse2.restype = ctypes.c_longlong
+
+
+_NATIVE = NativeLib(
+    src=os.path.join(_NATIVE_DIR, "csv_loader.cpp"),
+    so=os.path.join(_NATIVE_DIR, "build", "libcsvload.so"),
+    setup=_setup,
+)
+
+
+def available() -> bool:
+    return _NATIVE.available()
+
+
+class NeedsFallback(Exception):
+    """The chunk contains records the native fast path does not handle
+    (timestamps, quoting, malformed or overflowing fields, or the
+    library is unavailable); parse it with the Python csv path, whose
+    accept/reject verdict is authoritative."""
+
+
+def parse_pairs(data: bytes):
+    """Parse a buffer of "A,B" integer lines -> (int64 array, int64
+    array).  Raises NeedsFallback whenever the buffer needs the general
+    path — the native parser never decides validity itself."""
+    lib = _NATIVE.load()
+    if lib is None:
+        raise NeedsFallback("native loader unavailable")
+    # every record is >= 4 bytes ("a,b\n"), so len/4+1 rows always fit
+    cap = len(data) // 4 + 2
+    a = np.empty(cap, dtype=np.int64)
+    b = np.empty(cap, dtype=np.int64)
+    err = ctypes.c_longlong(0)
+    n = lib.csvload_parse2(
+        data, len(data),
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        b.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+        cap, ctypes.byref(err),
+    )
+    if n < 0:
+        raise NeedsFallback(
+            f"general path needed at line {err.value} (code {n})")
+    return a[:n], b[:n]
+
+
+def read_complete_lines(stream, chunk_bytes: int):
+    """Yield byte buffers of whole lines from a (text or binary) stream
+    — chunks never split a record."""
+    raw = getattr(stream, "buffer", stream)  # text streams wrap a buffer
+    tail = b""
+    while True:
+        chunk = raw.read(chunk_bytes)
+        if not chunk:
+            if tail:
+                yield tail
+            return
+        if isinstance(chunk, str):  # StringIO-style test streams
+            chunk = chunk.encode()
+        buf = tail + chunk
+        cut = buf.rfind(b"\n")
+        if cut < 0:
+            tail = buf
+            continue
+        yield buf[:cut + 1]
+        tail = buf[cut + 1:]

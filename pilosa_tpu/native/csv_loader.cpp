@@ -1,0 +1,101 @@
+// Fast bulk CSV parser for the import CLI (libcsvload).
+//
+// The reference's importer (ctl/import.go:173 bufferBits) reads CSV
+// records of "row,col[,timestamp]" or "col,value", buffers millions of
+// bits and ships them via the bulk import API.  Python's csv module is
+// the bottleneck at that scale, so this parser handles the dominant
+// all-integer two-column form natively: one pass over the byte buffer,
+// no allocation, results written straight into caller-provided int64
+// arrays (numpy buffers on the Python side).
+//
+// The native path NEVER judges validity: any record it cannot read —
+// timestamps, quoting, non-integer syntax, 64-bit overflow — returns
+// the fallback sentinel and the caller re-parses the chunk with the
+// Python csv path, which remains the single semantics oracle.  A file
+// therefore imports (or fails, with Python's full error detail)
+// identically whether or not the native library is built.
+
+#include <cstdint>
+
+namespace {
+
+inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
+
+inline void skip_ws(const char *&p, const char *end) {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+}
+
+// Parse a (possibly signed) 64-bit integer; advances p past the
+// digits.  Fails (-> fallback) on overflow rather than wrapping, so
+// out-of-range ids reach Python's arbitrary-precision path instead of
+// silently corrupting.
+inline bool parse_ll(const char *&p, const char *end, long long &out) {
+    bool neg = false;
+    if (p < end && (*p == '-' || *p == '+')) {
+        neg = (*p == '-');
+        ++p;
+    }
+    if (p >= end || !is_digit(*p)) return false;
+    unsigned long long v = 0;
+    while (p < end && is_digit(*p)) {
+        unsigned long long d = (unsigned long long)(*p - '0');
+        if (v > (0xFFFFFFFFFFFFFFFFull - d) / 10ull) return false;
+        v = v * 10ull + d;
+        ++p;
+    }
+    out = neg ? -(long long)v : (long long)v;
+    return true;
+}
+
+} // namespace
+
+extern "C" {
+
+// Parse "A,B" integer pairs, one record per line.  Blank lines are
+// skipped.  A record may carry a trailing comma with an EXACTLY empty
+// third field (the reference emits "row,col," for no-timestamp
+// records); anything else after the second integer falls back.
+//
+// Returns the number of records parsed, or:
+//   -2  a record needs the general path  (*err_line = 1-based line)
+//   -3  cap exceeded                     (*err_line set)
+long long csvload_parse2(const char *data, long long len,
+                         long long *a, long long *b, long long cap,
+                         long long *err_line) {
+    const char *p = data;
+    const char *end = data + len;
+    long long n = 0, line = 0;
+    while (p < end) {
+        ++line;
+        const char *eol = p;
+        while (eol < end && *eol != '\n') ++eol;
+        const char *q = p;
+        skip_ws(q, eol);
+        if (q == eol) {
+            p = eol + 1;
+            continue;
+        }
+        long long va, vb;
+        if (!parse_ll(q, eol, va)) { *err_line = line; return -2; }
+        skip_ws(q, eol);
+        if (q >= eol || *q != ',') { *err_line = line; return -2; }
+        ++q;
+        skip_ws(q, eol);
+        if (!parse_ll(q, eol, vb)) { *err_line = line; return -2; }
+        skip_ws(q, eol);
+        if (q < eol) {
+            if (*q != ',') { *err_line = line; return -2; }
+            ++q;
+            while (q < eol && *q == '\r') ++q;  // bare CRLF tail only
+            if (q < eol) { *err_line = line; return -2; }
+        }
+        if (n >= cap) { *err_line = line; return -3; }
+        a[n] = va;
+        b[n] = vb;
+        ++n;
+        p = eol + 1;
+    }
+    return n;
+}
+
+} // extern "C"

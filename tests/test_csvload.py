@@ -1,0 +1,210 @@
+"""Native bulk CSV loader: differential against the Python csv path,
+fallback triggers, malformed input, and the end-to-end import CLI
+(reference bufferBits, ctl/import.go:173-350)."""
+
+from __future__ import annotations
+
+import io
+import random
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import csvload
+
+pytestmark = pytest.mark.skipif(not csvload.available(),
+                                reason="native toolchain unavailable")
+
+
+class TestParsePairs:
+    def test_differential_random(self):
+        rng = random.Random(7)
+        recs = [(rng.randrange(1 << 45), rng.randrange(1 << 45))
+                for _ in range(5000)]
+        buf = "".join(f"{a},{b}\n" for a, b in recs).encode()
+        a, b = csvload.parse_pairs(buf)
+        assert list(zip(a.tolist(), b.tolist())) == recs
+
+    def test_whitespace_blank_lines_signs_trailing_comma(self):
+        buf = b"1,2\n\n  3 , -4 \r\n5,6,\n   \n+7,8"
+        a, b = csvload.parse_pairs(buf)
+        assert a.tolist() == [1, 3, 5, 7]
+        assert b.tolist() == [2, -4, 6, 8]
+
+    def test_anything_unparseable_falls_back(self):
+        """The native path never judges validity — timestamps, quotes,
+        malformed fields, whitespace-only third fields, and 64-bit
+        overflow ALL defer to the Python oracle, so a file's fate never
+        depends on whether the toolchain built the library."""
+        for needs_python in [
+            b"1,2,2019-01-01T00:00\n",   # timestamp
+            b'"3","7"\n',                 # quoting (valid in Python)
+            b"1,2,  \n",                  # whitespace third field
+            b"18446744073709551617,5\n",  # > 2^64: must not wrap
+            b"1\n", b",2\n", b"a,b\n", b"1;2\n", b"1,2 3\n",
+            b"1,2\n3,x\n5,6\n",
+        ]:
+            with pytest.raises(csvload.NeedsFallback):
+                csvload.parse_pairs(needs_python)
+
+    def test_empty(self):
+        a, b = csvload.parse_pairs(b"")
+        assert len(a) == 0 and len(b) == 0
+
+    def test_no_trailing_newline(self):
+        a, b = csvload.parse_pairs(b"9,10")
+        assert a.tolist() == [9] and b.tolist() == [10]
+
+
+class TestChunking:
+    def test_chunks_never_split_records(self):
+        recs = [(i, i * 3) for i in range(2000)]
+        text = "".join(f"{a},{b}\n" for a, b in recs)
+        out = []
+        for chunk_bytes in (7, 64, 1 << 20):
+            stream = io.BytesIO(text.encode())
+            got = []
+            for buf in csvload.read_complete_lines(stream, chunk_bytes):
+                a, b = csvload.parse_pairs(buf)
+                got.extend(zip(a.tolist(), b.tolist()))
+            out.append(got)
+        assert all(o == recs for o in out)
+
+    def test_text_stream_buffer_unwrap(self, tmp_path):
+        p = tmp_path / "x.csv"
+        p.write_text("1,2\n3,4\n")
+        with open(p) as f:  # text mode: read via the .buffer underneath
+            bufs = list(csvload.read_complete_lines(f, 1 << 20))
+        a, b = csvload.parse_pairs(b"".join(bufs))
+        assert a.tolist() == [1, 3]
+
+
+class TestImportCLI:
+    def _serve(self, tmp_path):
+        from pilosa_tpu.server.server import Server
+
+        srv = Server(str(tmp_path / "srv"))
+        srv.open()
+        return srv
+
+    def test_end_to_end_native_import(self, tmp_path, capsys):
+        from pilosa_tpu.cmd import main
+
+        srv = self._serve(tmp_path)
+        rng = random.Random(3)
+        recs = sorted({(rng.randrange(4), rng.randrange(200000))
+                       for _ in range(3000)})
+        f = tmp_path / "bits.csv"
+        f.write_text("".join(f"{r},{c}\n" for r, c in recs))
+        rc = main(["import", "--host", srv.uri, "-i", "i", "-f", "f",
+                   "--create", str(f)])
+        assert rc == 0
+        import json
+        import urllib.request
+
+        def q(pql):
+            req = urllib.request.Request(
+                srv.uri + "/index/i/query",
+                data=json.dumps({"query": pql}).encode(), method="POST")
+            req.add_header("Content-Type", "application/json")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())["results"][0]
+
+        for row in range(4):
+            want = sorted(c for r, c in recs if r == row)
+            assert q(f"Row(f={row})")["columns"] == want
+        srv.close()
+
+    def test_end_to_end_with_timestamps_falls_back(self, tmp_path):
+        from pilosa_tpu.cmd import main
+
+        srv = self._serve(tmp_path)
+        f = tmp_path / "t.csv"
+        f.write_text("1,10,2019-04-18T00:00\n1,11\n")
+        rc = main(["import", "--host", srv.uri, "-i", "i", "-f", "t",
+                   "--create", "--field-type", "time",
+                   "--time-quantum", "YMD", str(f)])
+        assert rc == 0
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            srv.uri + "/index/i/query",
+            data=json.dumps({
+                "query": "Row(t=1, from='2019-04-01T00:00',"
+                         " to='2019-05-01T00:00')"}).encode(),
+            method="POST")
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())["results"][0]
+        assert out["columns"] == [10]
+        srv.close()
+
+    def test_bad_record_fails_with_location(self, tmp_path, capsys):
+        from pilosa_tpu.cmd import main
+
+        srv = self._serve(tmp_path)
+        f = tmp_path / "bad.csv"
+        f.write_text("1,2\noops\n")
+        rc = main(["import", "--host", srv.uri, "-i", "i", "-f", "f",
+                   "--create", str(f)])
+        assert rc == 1
+        assert ":2:" in capsys.readouterr().err
+        srv.close()
+
+    def test_quoted_csv_same_result_either_path(self, tmp_path):
+        """Differential: a file with quoted fields imports identically
+        through the native-present CLI path and pure Python."""
+        from pilosa_tpu.cmd import main
+
+        srv = self._serve(tmp_path)
+        f = tmp_path / "q.csv"
+        f.write_text('1,5\n"2","6"\n3,7\n')
+        rc = main(["import", "--host", srv.uri, "-i", "i", "-f", "f",
+                   "--create", str(f)])
+        assert rc == 0
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            srv.uri + "/index/i/query",
+            data=json.dumps({"query": "Row(f=2)"}).encode(),
+            method="POST")
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read())["results"][0]["columns"] == [6]
+        srv.close()
+
+    def test_classic_mac_line_endings(self, tmp_path):
+        """Lone-\r files must import identically with or without the
+        native library (open() used universal newlines before)."""
+        from pilosa_tpu.cmd import main
+
+        srv = self._serve(tmp_path)
+        f = tmp_path / "mac.csv"
+        f.write_bytes(b"1,2\r1,3\r1,4\r")
+        rc = main(["import", "--host", srv.uri, "-i", "i", "-f", "f",
+                   "--create", str(f)])
+        assert rc == 0
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            srv.uri + "/index/i/query",
+            data=json.dumps({"query": "Row(f=1)"}).encode(),
+            method="POST")
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            assert json.loads(resp.read())["results"][0]["columns"] == [2, 3, 4]
+        srv.close()
+
+    def test_batch_size_zero_terminates(self, tmp_path):
+        from pilosa_tpu.cmd import main
+
+        srv = self._serve(tmp_path)
+        f = tmp_path / "z.csv"
+        f.write_text("1,2\n1,3\n")
+        rc = main(["import", "--host", srv.uri, "-i", "i", "-f", "f",
+                   "--create", "--batch-size", "0", str(f)])
+        assert rc == 0
+        srv.close()
