@@ -175,6 +175,10 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
 
 # ---------------------------------------------------------------- import
 
+# native-path read granularity; tests shrink it to exercise boundaries
+_IMPORT_CHUNK_BYTES = 32 << 20
+
+
 def cmd_import(args) -> int:
     """CSV rows are `row,col[,timestamp]` (set/time/mutex/bool) or
     `col,value` (int) — the reference's two formats (ctl/import.go:278).
@@ -218,8 +222,6 @@ def cmd_import(args) -> int:
         rows, cols, values, timestamps = [], [], [], []
 
     import contextlib
-    import io
-
     from pilosa_tpu import csvload
 
     def consume_python(stream, path, line_base=0):
@@ -257,40 +259,57 @@ def cmd_import(args) -> int:
 
     def consume_native(stream, path) -> bool:
         """Fast path: the C++ loader parses all-integer two-column
-        chunks straight into int64 buffers.  Chunks it declines —
-        timestamps, quoting, malformed records — re-parse through the
-        Python path (line numbers preserved), which alone decides what
-        is actually an error."""
+        chunks straight into int64 buffers.  The FIRST chunk it cannot
+        own outright — quotes anywhere (a quoted record may span chunk
+        boundaries), a chunk with no newline (pathological line
+        lengths, lone-CR files), or any record the parser declines —
+        permanently hands the rest of the stream to the streaming
+        Python path, which alone decides what is an error.  A file
+        therefore parses identically with or without the native
+        library."""
+        raw = csvload.raw_stream(stream)
         line_base = 0
-        for buf in csvload.read_complete_lines(stream, 32 << 20):
+        tail = b""
+        while True:
+            chunk = csvload.read_chunk(raw, _IMPORT_CHUNK_BYTES)
+            buf = tail + chunk
+            if not buf:
+                return True
+            if chunk:
+                cut = buf.rfind(b"\n")
+                if b'"' in buf or cut < 0:
+                    return consume_python(csvload.chain_text(buf, raw),
+                                          path, line_base)
+                complete, tail = buf[:cut + 1], buf[cut + 1:]
+            else:
+                complete, tail = buf, b""  # final partial record
             try:
-                a, b = csvload.parse_pairs(buf)
-                # top up to the batch size exactly — one POST must
-                # never exceed it, even with records already buffered
-                i = 0
-                while i < len(a):
-                    take = max(1, args.batch_size - len(cols))
-                    sa = a[i:i + take].tolist()
-                    sb = b[i:i + take].tolist()
-                    if is_value:
-                        cols.extend(sa)
-                        values.extend(sb)
-                    else:
-                        rows.extend(sa)
-                        cols.extend(sb)
-                        timestamps.extend([None] * len(sa))
-                    i += take
-                    if len(cols) >= args.batch_size:
-                        flush()
+                a, b = csvload.parse_pairs(complete)
             except csvload.NeedsFallback:
-                # universal-newline translation, matching what open()
-                # did before the bytes detour (lone-\r files must parse
-                # identically with or without the native library)
-                text = buf.decode().replace("\r\n", "\n").replace("\r", "\n")
-                if not consume_python(io.StringIO(text), path, line_base):
-                    return False
-            line_base += buf.count(b"\n")
-        return True
+                # (complete, tail) is a split of buf — hand back the
+                # original buffer, no re-concatenation
+                return consume_python(csvload.chain_text(buf, raw),
+                                      path, line_base)
+            # top up to the batch size exactly — one POST must never
+            # exceed it, even with records already buffered
+            i = 0
+            while i < len(a):
+                take = max(1, args.batch_size - len(cols))
+                sa = a[i:i + take].tolist()
+                sb = b[i:i + take].tolist()
+                if is_value:
+                    cols.extend(sa)
+                    values.extend(sb)
+                else:
+                    rows.extend(sa)
+                    cols.extend(sb)
+                    timestamps.extend([None] * len(sa))
+                i += take
+                if len(cols) >= args.batch_size:
+                    flush()
+            line_base += complete.count(b"\n")
+            if not chunk:
+                return True
 
     for path in args.files:
         stream = sys.stdin if path == "-" else open(path)

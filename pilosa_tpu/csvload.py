@@ -10,6 +10,7 @@ falls back to the Python csv path, which remains the semantics oracle
 from __future__ import annotations
 
 import ctypes
+import io
 import os
 
 import numpy as np
@@ -73,23 +74,43 @@ def parse_pairs(data: bytes):
     return a[:n], b[:n]
 
 
-def read_complete_lines(stream, chunk_bytes: int):
-    """Yield byte buffers of whole lines from a (text or binary) stream
-    — chunks never split a record."""
-    raw = getattr(stream, "buffer", stream)  # text streams wrap a buffer
-    tail = b""
-    while True:
-        chunk = raw.read(chunk_bytes)
-        if not chunk:
-            if tail:
-                yield tail
-            return
-        if isinstance(chunk, str):  # StringIO-style test streams
-            chunk = chunk.encode()
-        buf = tail + chunk
-        cut = buf.rfind(b"\n")
-        if cut < 0:
-            tail = buf
-            continue
-        yield buf[:cut + 1]
-        tail = buf[cut + 1:]
+def raw_stream(stream):
+    """The byte source under a possibly-text stream."""
+    return getattr(stream, "buffer", stream)
+
+
+def read_chunk(raw, chunk_bytes: int) -> bytes:
+    chunk = raw.read(chunk_bytes)
+    if isinstance(chunk, str):  # StringIO-style test streams
+        chunk = chunk.encode()
+    return chunk or b""
+
+
+def chain_text(head: bytes, raw):
+    """A universal-newlines TEXT stream reading ``head`` then the rest
+    of ``raw`` — hands the un-consumed remainder of a chunked byte
+    stream back to the streaming Python csv path in one piece, so
+    quoted records spanning chunk boundaries are never torn."""
+
+    class _Raw(io.RawIOBase):
+        def __init__(self):
+            # pending bytes: the head, then any excess a str-returning
+            # source produced (N characters can encode to > N bytes)
+            self._pending = memoryview(bytes(head))
+            self._pos = 0
+
+        def readable(self):
+            return True
+
+        def readinto(self, b):
+            if self._pos >= len(self._pending):
+                self._pending = memoryview(read_chunk(raw, len(b)))
+                self._pos = 0
+            n = min(len(b), len(self._pending) - self._pos)
+            b[:n] = self._pending[self._pos:self._pos + n]
+            self._pos += n
+            return n
+
+    # newline=None: universal-newline translation, matching what
+    # open(path) did before the bytes detour
+    return io.TextIOWrapper(io.BufferedReader(_Raw()), newline=None)

@@ -21,14 +21,17 @@ namespace {
 
 inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
 
+// NOTE: '\r' is NOT whitespace — a CR may appear only as a trailing
+// CRLF tail (trimmed per record below).  Skipping it mid-field would
+// accept records the Python universal-newlines oracle rejects.
 inline void skip_ws(const char *&p, const char *end) {
-    while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
+    while (p < end && (*p == ' ' || *p == '\t')) ++p;
 }
 
-// Parse a (possibly signed) 64-bit integer; advances p past the
-// digits.  Fails (-> fallback) on overflow rather than wrapping, so
-// out-of-range ids reach Python's arbitrary-precision path instead of
-// silently corrupting.
+// Parse a signed 64-bit integer; advances p past the digits.  Fails
+// (-> fallback) on anything outside [-2^63+1, 2^63-1] rather than
+// wrapping, so out-of-range ids reach Python's arbitrary-precision
+// path instead of silently corrupting.
 inline bool parse_ll(const char *&p, const char *end, long long &out) {
     bool neg = false;
     if (p < end && (*p == '-' || *p == '+')) {
@@ -39,7 +42,7 @@ inline bool parse_ll(const char *&p, const char *end, long long &out) {
     unsigned long long v = 0;
     while (p < end && is_digit(*p)) {
         unsigned long long d = (unsigned long long)(*p - '0');
-        if (v > (0xFFFFFFFFFFFFFFFFull - d) / 10ull) return false;
+        if (v > (0x7FFFFFFFFFFFFFFFull - d) / 10ull) return false;
         v = v * 10ull + d;
         ++p;
     }
@@ -69,25 +72,28 @@ long long csvload_parse2(const char *data, long long len,
         ++line;
         const char *eol = p;
         while (eol < end && *eol != '\n') ++eol;
+        // trim ONE CRLF tail CR; further CRs fall back — Python's
+        // universal newlines would count "\r\r\n" as two lines, so the
+        // native path must not absorb them
+        const char *eot = eol;
+        if (eot > p && eot[-1] == '\r') --eot;
         const char *q = p;
-        skip_ws(q, eol);
-        if (q == eol) {
+        skip_ws(q, eot);
+        if (q == eot) {
             p = eol + 1;
             continue;
         }
         long long va, vb;
-        if (!parse_ll(q, eol, va)) { *err_line = line; return -2; }
-        skip_ws(q, eol);
-        if (q >= eol || *q != ',') { *err_line = line; return -2; }
+        if (!parse_ll(q, eot, va)) { *err_line = line; return -2; }
+        skip_ws(q, eot);
+        if (q >= eot || *q != ',') { *err_line = line; return -2; }
         ++q;
-        skip_ws(q, eol);
-        if (!parse_ll(q, eol, vb)) { *err_line = line; return -2; }
-        skip_ws(q, eol);
-        if (q < eol) {
-            if (*q != ',') { *err_line = line; return -2; }
-            ++q;
-            while (q < eol && *q == '\r') ++q;  // bare CRLF tail only
-            if (q < eol) { *err_line = line; return -2; }
+        skip_ws(q, eot);
+        if (!parse_ll(q, eot, vb)) { *err_line = line; return -2; }
+        skip_ws(q, eot);
+        if (q < eot) {
+            // only an exactly-empty third field is the no-timestamp form
+            if (*q != ',' || q + 1 != eot) { *err_line = line; return -2; }
         }
         if (n >= cap) { *err_line = line; return -3; }
         a[n] = va;

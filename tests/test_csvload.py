@@ -56,27 +56,19 @@ class TestParsePairs:
         assert a.tolist() == [9] and b.tolist() == [10]
 
 
-class TestChunking:
-    def test_chunks_never_split_records(self):
-        recs = [(i, i * 3) for i in range(2000)]
-        text = "".join(f"{a},{b}\n" for a, b in recs)
-        out = []
-        for chunk_bytes in (7, 64, 1 << 20):
-            stream = io.BytesIO(text.encode())
-            got = []
-            for buf in csvload.read_complete_lines(stream, chunk_bytes):
-                a, b = csvload.parse_pairs(buf)
-                got.extend(zip(a.tolist(), b.tolist()))
-            out.append(got)
-        assert all(o == recs for o in out)
+class TestChainText:
+    def test_head_then_rest_universal_newlines(self):
+        raw = io.BytesIO(b"3,4\r\n5,6\r")
+        t = csvload.chain_text(b"1,2\r\n", raw)
+        assert t.read() == "1,2\n3,4\n5,6\n"
 
-    def test_text_stream_buffer_unwrap(self, tmp_path):
-        p = tmp_path / "x.csv"
-        p.write_text("1,2\n3,4\n")
-        with open(p) as f:  # text mode: read via the .buffer underneath
-            bufs = list(csvload.read_complete_lines(f, 1 << 20))
-        a, b = csvload.parse_pairs(b"".join(bufs))
-        assert a.tolist() == [1, 3]
+    def test_quoted_newline_survives_handoff(self):
+        import csv as _csv
+
+        raw = io.BytesIO(b'b\ny",7\n8,9\n')
+        t = csvload.chain_text(b'1,2\n"a\r\n', raw)
+        recs = list(_csv.reader(t))
+        assert recs == [["1", "2"], ["a\nb\ny", "7"], ["8", "9"]]
 
 
 class TestImportCLI:
@@ -208,3 +200,81 @@ class TestImportCLI:
                    "--create", "--batch-size", "0", str(f)])
         assert rc == 0
         srv.close()
+
+
+class TestChunkBoundaries:
+    """Shrink the native chunk size so every boundary case exercises:
+    records split across chunks, quotes forcing permanent fallback,
+    lone-CR files with no newline in a whole chunk."""
+
+    @pytest.fixture(autouse=True)
+    def tiny_chunks(self, monkeypatch):
+        from pilosa_tpu import cmd
+        monkeypatch.setattr(cmd, "_IMPORT_CHUNK_BYTES", 16)
+
+    def _roundtrip(self, tmp_path, payload: bytes, want_cols_row1):
+        from pilosa_tpu.cmd import main
+        from pilosa_tpu.server.server import Server
+
+        srv = Server(str(tmp_path / "srv"))
+        srv.open()
+        f = tmp_path / "in.csv"
+        f.write_bytes(payload)
+        rc = main(["import", "--host", srv.uri, "-i", "i", "-f", "f",
+                   "--create", str(f)])
+        assert rc == 0
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            srv.uri + "/index/i/query",
+            data=json.dumps({"query": "Row(f=1)"}).encode(),
+            method="POST")
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            got = json.loads(resp.read())["results"][0]["columns"]
+        srv.close()
+        assert got == want_cols_row1
+
+    def test_records_split_across_many_chunks(self, tmp_path):
+        cols = list(range(100, 160))
+        payload = "".join(f"1,{c}\n" for c in cols).encode()
+        self._roundtrip(tmp_path, payload, cols)
+
+    def test_quote_in_later_chunk_falls_back_permanently(self, tmp_path):
+        # quote appears well past the first 16-byte chunk
+        payload = b"1,5\n1,6\n1,7\n1,8\n" + b'"1","9"\n1,10\n'
+        self._roundtrip(tmp_path, payload, [5, 6, 7, 8, 9, 10])
+
+    def test_lone_cr_only_file(self, tmp_path):
+        # no \n anywhere: first full chunk has no newline -> python path
+        payload = b"1,21\r1,22\r1,23\r1,24\r1,25\r"
+        self._roundtrip(tmp_path, payload, [21, 22, 23, 24, 25])
+
+    def test_mixed_endings_error_line_number(self, tmp_path, capsys):
+        from pilosa_tpu.cmd import main
+        from pilosa_tpu.server.server import Server
+
+        srv = Server(str(tmp_path / "srv"))
+        srv.open()
+        f = tmp_path / "bad.csv"
+        f.write_bytes(b"1,2\r1,3\r1,4\roops,zzz\r")  # bad record line 4
+        rc = main(["import", "--host", srv.uri, "-i", "i", "-f", "f",
+                   "--create", str(f)])
+        srv.close()
+        assert rc == 1
+        assert ":4:" in capsys.readouterr().err
+
+    def test_double_cr_line_falls_back(self):
+        # Python universal newlines sees "1,2\r\r\n" as TWO lines; the
+        # native path must not absorb the extra CR
+        with pytest.raises(csvload.NeedsFallback):
+            csvload.parse_pairs(b"1,2\r\r\n3,4\n")
+
+    def test_chain_text_str_source_multibyte(self):
+        # str-returning sources can encode N chars to > N bytes; the
+        # chain must carry the excess instead of overflowing readinto
+        s = io.StringIO("é" * 100000 + "\n1,2\n")
+        t = csvload.chain_text(b"", s)
+        lines = t.read().splitlines()
+        assert lines[0] == "é" * 100000 and lines[1] == "1,2"

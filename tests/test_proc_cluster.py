@@ -1,0 +1,154 @@
+"""Process-level cluster test: real ``python -m pilosa_tpu server``
+OS processes, joined over real sockets, with SIGKILL fault injection
+and restart-recovery — the analog of the reference's docker-compose
+clustertests with pumba pauses (internal/clustertests/cluster_test.go:
+69-80, §4 tier 4).  In-process clusters (test_cluster.py, test_http.py)
+cover logic; this tier proves the real binary survives process death."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env() -> dict:
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        PILOSA_TPU_SHARD_WIDTH_EXP=os.environ.get(
+            "PILOSA_TPU_SHARD_WIDTH_EXP", "16"),
+        PYTHONPATH=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep
+        + env.get("PYTHONPATH", ""),
+    )
+    return env
+
+
+def _spawn(data_dir: str, port: int, seeds: list[int] | None = None,
+           replicas: int = 2):
+    cmd = [sys.executable, "-m", "pilosa_tpu", "server",
+           "-d", data_dir, "-b", f"127.0.0.1:{port}",
+           "--replicas", str(replicas),
+           "--heartbeat-interval", "0.5",
+           "--anti-entropy-interval", "2.0"]
+    if seeds:
+        cmd += ["--seeds", ",".join(f"http://127.0.0.1:{p}" for p in seeds)]
+    return subprocess.Popen(cmd, env=_env(),
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+
+
+def _get(port: int, path: str, timeout: float = 5.0):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _post(port: int, path: str, obj, timeout: float = 60.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(obj).encode(), method="POST")
+    req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read() or b"null")
+
+
+def _wait_status(port: int, state: str, n_nodes: int | None = None,
+                 deadline: float = 60.0) -> dict:
+    t0 = time.time()
+    last = None
+    while time.time() - t0 < deadline:
+        try:
+            st = _get(port, "/status", timeout=3)
+            last = st
+            if st["state"] == state and (
+                    n_nodes is None or len(st["nodes"]) == n_nodes):
+                return st
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+        time.sleep(0.5)
+    raise AssertionError(
+        f"node :{port} never reached {state}/{n_nodes}; last={last}")
+
+
+def test_three_process_cluster_kill_and_recover(tmp_path):
+    ports = [_free_port() for _ in range(3)]
+    procs: list[subprocess.Popen | None] = [None, None, None]
+    try:
+        procs[0] = _spawn(str(tmp_path / "n0"), ports[0])
+        _wait_status(ports[0], "NORMAL", 1)
+        procs[1] = _spawn(str(tmp_path / "n1"), ports[1], seeds=[ports[0]])
+        procs[2] = _spawn(str(tmp_path / "n2"), ports[2], seeds=[ports[0]])
+        for p in ports:
+            _wait_status(p, "NORMAL", 3)
+
+        # schema + data spread over 9 shards, replicas=2
+        _post(ports[0], "/index/i", {})
+        _post(ports[0], "/index/i/field/f", {})
+        rng = random.Random(6)
+        sets = {r: set() for r in range(4)}
+        rows, cols = [], []
+        for r in sets:
+            for _ in range(400):
+                c = rng.randrange(9 * SHARD_WIDTH)
+                sets[r].add(c)
+                rows.append(r)
+                cols.append(c)
+        _post(ports[0], "/index/i/field/f/import",
+              {"rowIDs": rows, "columnIDs": cols})
+
+        def check_exact(port):
+            got = _post(port, "/index/i/query",
+                        {"query": "Count(Union(Row(f=0), Row(f=1)))"})
+            assert got["results"][0] == len(sets[0] | sets[1]), port
+            topn = _post(port, "/index/i/query", {"query": "TopN(f)"})
+            want = sorted(((len(s), r) for r, s in sets.items()),
+                          key=lambda t: (-t[0], t[1]))
+            assert [(p["count"], p["id"])
+                    for p in topn["results"][0]] == want, port
+
+        for p in ports:
+            check_exact(p)
+
+        # SIGKILL one node: reads must stay exact from the survivors
+        # (replica failover, executor.go:2492 analog) and the cluster
+        # must notice the death (DEGRADED via heartbeats)
+        procs[2].send_signal(signal.SIGKILL)
+        procs[2].wait(timeout=30)
+        _wait_status(ports[0], "DEGRADED")
+        for p in ports[:2]:
+            check_exact(p)
+
+        # restart from the same data dir: rejoin, repair, NORMAL again
+        procs[2] = _spawn(str(tmp_path / "n2"), ports[2], seeds=[ports[0]])
+        for p in ports:
+            _wait_status(p, "NORMAL", 3)
+        for p in ports:
+            check_exact(p)
+    finally:
+        for pr in procs:
+            if pr is not None and pr.poll() is None:
+                pr.terminate()
+        for pr in procs:
+            if pr is not None:
+                try:
+                    pr.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    pr.kill()
