@@ -61,6 +61,7 @@ _METHOD_STATES = {
     "set_coordinator": _RESIZE_OK,
     "remove_node": _NORMAL,
     "resize_abort": frozenset({"RESIZING"}),
+    "recalculate_caches": _QUERY,
 }
 
 
@@ -341,6 +342,15 @@ class API:
 
     def hosts(self) -> list[dict]:
         return [n.to_dict() for n in self.cluster.sorted_nodes()]
+
+    def recalculate_caches(self, remote: bool = False) -> None:
+        """Force every node's TopN caches up to date (reference
+        API.RecalculateCaches, api.go:1139: local recalc + broadcast;
+        used by clients that need fresh ranks immediately)."""
+        self._validate("recalculate_caches")
+        self.node.recalculate_caches()
+        if not remote:
+            self.node.broadcast({"type": "recalculate-caches"})
 
     def node_info(self) -> dict:
         return self.cluster.local_node.to_dict()

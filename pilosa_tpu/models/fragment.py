@@ -502,6 +502,22 @@ class Fragment:
                 return None
             return counts
 
+    def recalculate_cache(self) -> None:
+        """Recompute exact row counts into the TopN cache (reference
+        fragment.RecalculateCache via holder.RecalculateCaches,
+        api.go:1139 /recalculate-caches)."""
+        from pilosa_tpu.models.cache import CACHE_TYPE_NONE
+
+        if self.topn_cache.cache_type == CACHE_TYPE_NONE:
+            return  # put() would discard the counts unread
+        with self._lock:
+            counts = {}
+            for r, arr in self._rows.items():
+                c = int(np.bitwise_count(arr).sum(dtype=np.uint64))
+                if c:
+                    counts[int(r)] = c
+            self.topn_cache.put(self._gen, counts)
+
     def cache_row_counts(self, counts: dict[int, int], gen: int | None = None) -> None:
         """Store counts computed at generation ``gen`` (defaults to the
         current one).  If a write advanced the generation since the caller

@@ -1417,6 +1417,16 @@ class Executor:
                  else idx.field(field).translate_store)
         return store.translate_key(key, create=create)
 
+    def _ids_to_keys(self, idx, field: str | None, ids) -> list[str | None]:
+        """Id -> key for result translation; read-through via the
+        cluster node when present (stale replicas tail the primary)."""
+        node = getattr(self, "node", None)
+        if node is not None:
+            return node.translate_ids_cluster(idx.name, field, ids)
+        store = (idx.translate_store if field is None
+                 else idx.field(field).translate_store)
+        return store.translate_ids(list(ids))
+
     def _translate_row_key(self, idx, call: Call, arg_key: str, create: bool) -> bool:
         """Translate a string row value held under args[arg_key], where
         arg_key names the field.  Returns False on a read-path miss."""
@@ -1460,7 +1470,8 @@ class Executor:
                     raise ExecutionError(f"field not found: {fname}")
                 if not f.options.keys:
                     raise ExecutionError(f"field {fname!r} does not use string keys")
-                call.args["_row"] = f.translate_store.translate_key(v, create=True)
+                call.args["_row"] = self._translate_one(
+                    idx, fname, v, create=True)
             return call
         if name in ("Store", "ClearRow"):
             created = name == "Store"
@@ -1493,7 +1504,7 @@ class Executor:
                     raise ExecutionError(f"field not found: {fname}")
                 if not f.options.keys:
                     raise ExecutionError(f"field {fname!r} does not use string keys")
-                id = f.translate_store.translate_key(prev, create=False)
+                id = self._translate_one(idx, fname, prev, create=False)
                 if id is None:
                     raise ExecutionError(f"previous key not found: {prev!r}")
                 call.args["previous"] = id
@@ -1503,7 +1514,7 @@ class Executor:
                     raise ExecutionError(
                         f"index {idx.name!r} does not use string keys"
                     )
-                id = idx.translate_store.translate_key(col, create=False)
+                id = self._translate_one(idx, None, col, create=False)
                 if id is None:
                     return Call(_EMPTY_ROWS_CALL)  # unknown column: no rows
                 call.args["column"] = id
@@ -1521,7 +1532,7 @@ class Executor:
         translateResults, executor.go:2781)."""
         if isinstance(res, Row):
             if idx.options.keys:
-                keys = idx.translate_store.translate_ids(res.columns())
+                keys = self._ids_to_keys(idx, None, res.columns())
                 res.keys = [k or "" for k in keys]
             return res
         if isinstance(res, Pair) or (
@@ -1531,7 +1542,8 @@ class Executor:
             f = idx.field(fname) if fname else None
             if f is not None and f.options.keys:
                 pairs = [res] if isinstance(res, Pair) else res
-                keys = f.translate_store.translate_ids([p.id for p in pairs])
+                keys = self._ids_to_keys(idx, f.name,
+                                         [p.id for p in pairs])
                 for p, k in zip(pairs, keys):
                     p.key = k or ""
             return res
@@ -1539,13 +1551,26 @@ class Executor:
             fname = call.args.get("_field")
             f = idx.field(fname) if fname else None
             if f is not None and f.options.keys:
-                return [k or "" for k in f.translate_store.translate_ids(res)]
+                return [k or ""
+                        for k in self._ids_to_keys(idx, f.name, res)]
             return res
         if call.name == "GroupBy" and isinstance(res, list):
+            # batch per field: one translation call (possibly one
+            # read-through RPC) per keyed field, not one per group row
+            by_field: dict[str, set[int]] = {}
             for gc in res:
                 for fr in gc.group:
                     f = idx.field(fr.field)
                     if f is not None and f.options.keys:
-                        fr.row_key = f.translate_store.translate_id(fr.row_id) or ""
+                        by_field.setdefault(f.name, set()).add(fr.row_id)
+            keymaps = {
+                fname: dict(zip(sorted(ids),
+                                self._ids_to_keys(idx, fname, sorted(ids))))
+                for fname, ids in by_field.items()
+            }
+            for gc in res:
+                for fr in gc.group:
+                    if fr.field in keymaps:
+                        fr.row_key = keymaps[fr.field].get(fr.row_id) or ""
             return res
         return res

@@ -25,6 +25,7 @@ class ClusterNode:
         self.cluster = cluster
         self.executor = Executor(holder, worker_pool_size, cluster=cluster)
         self.executor.node = self
+        self._tail_last: dict = {}  # (index, field) -> last tail time
         if cluster.transport is not None and hasattr(cluster.transport, "register"):
             cluster.transport.register(cluster.local_id, self)
 
@@ -244,6 +245,8 @@ class ClusterNode:
             self.cleanup_unowned()
         elif t == "ping":
             return {"ok": True, "state": self.cluster.state}
+        elif t == "recalculate-caches":
+            self.recalculate_caches()
         elif t == "translate-keys":
             # single-writer key allocation: only the coordinator
             # (primary) creates ids (reference holder.go:690: non-primary
@@ -328,6 +331,22 @@ class ClusterNode:
             return None if f is None else f.translate_store
         return idx.translate_store
 
+    def recalculate_caches(self) -> None:
+        """Recompute every fragment's TopN cache on this node
+        (reference holder.RecalculateCaches; broadcast by the API so
+        all nodes refresh, api.go:1139).  Dicts are snapshotted —
+        concurrent schema/import requests mutate them.  BSI plane views
+        have no TopN semantics and are skipped."""
+        from pilosa_tpu.models.view import VIEW_BSI_PREFIX
+
+        for idx in list(self.holder.indexes.values()):
+            for f in list(idx.fields.values()):
+                for vname, view in list(f.views.items()):
+                    if vname.startswith(VIEW_BSI_PREFIX):
+                        continue
+                    for frag in list(view.fragments.values()):
+                        frag.recalculate_cache()
+
     def translate_keys_cluster(self, index: str, field: str | None, keys,
                                create: bool = False):
         """Key -> id with single-writer semantics: existing keys resolve
@@ -343,7 +362,19 @@ class ClusterNode:
             raise ValueError(f"no translate store for {index}/{field}")
         ids = store.translate_keys(list(keys), create=False)
         missing = [k for k, i in zip(keys, ids) if i is None]
-        if not missing or not create:
+        if not missing:
+            return ids
+        if not create:
+            # read-through: the primary may have allocated keys this
+            # replica hasn't tailed yet — catching up NOW keeps keyed
+            # reads exact on every node, not just after the next
+            # anti-entropy sweep (the reference's replicas tail the
+            # primary's entry stream continuously, holder.go:690-878)
+            if (self.cluster.transport is not None
+                    and len(self.cluster.sorted_nodes()) > 1
+                    and not self.cluster.is_coordinator
+                    and self._tail_throttled(index, field, store)):
+                return store.translate_keys(list(keys), create=False)
             return ids
         if (self.cluster.transport is not None
                 and self.cluster.state == STATE_STARTING):
@@ -369,6 +400,24 @@ class ClusterNode:
         self._tail_store(index, field, store)
         return [i if i is not None else by_key.get(k)
                 for k, i in zip(keys, ids)]
+
+    def translate_ids_cluster(self, index: str, field: str | None, ids):
+        """Id -> key with the same read-through as key lookups: a miss
+        on a non-coordinator replica tails the primary's entry stream
+        once and retries, so result translation is exact on every node
+        immediately after a write (reference holder.go:690-878)."""
+        store = self._translate_store(index, field)
+        if store is None:
+            return [None] * len(list(ids))
+        ids = list(ids)
+        keys = store.translate_ids(ids)
+        if (any(k is None for k in keys)
+                and self.cluster.transport is not None
+                and len(self.cluster.sorted_nodes()) > 1
+                and not self.cluster.is_coordinator
+                and self._tail_throttled(index, field, store)):
+            keys = store.translate_ids(ids)
+        return keys
 
     def set_coordinator(self, node_id: str) -> None:
         """Move the coordinator role, refresh translate writability, and
@@ -396,6 +445,28 @@ class ClusterNode:
             for f in idx.public_fields():
                 if f.options.keys:
                     f.translate_store.set_read_only(ro)
+
+    #: minimum seconds between read-through tail RPCs per store; bounds
+    #: the coordinator round-trip rate when clients probe keys that
+    #: never resolve, at the cost of a (tiny) staleness window for
+    #: brand-new keys — still far fresher than the reference's
+    #: background tail loop
+    TAIL_THROTTLE_S = 0.1
+
+    def _tail_throttled(self, index: str, field: str | None, store) -> int:
+        import time
+
+        key = (index, field)
+        now = time.monotonic()
+        last = self._tail_last.get(key, 0.0)
+        if now - last < self.TAIL_THROTTLE_S:
+            return 0
+        self._tail_last[key] = now
+        applied = self._tail_store(index, field, store)
+        if applied:
+            # progress was made; allow an immediate follow-up
+            self._tail_last.pop(key, None)
+        return applied
 
     def _tail_store(self, index: str, field: str | None, store) -> int:
         coord = self.cluster.node(self.cluster.coordinator_id)

@@ -321,6 +321,45 @@ class Handler:
     def handle_hosts(self, req, params, path, body):
         self._json(req, self.api.hosts())
 
+    @route("GET", "/internal/nodes")
+    def handle_internal_nodes(self, req, params, path, body):
+        # reference /internal/nodes (http/handler.go handleGetNodes)
+        self._json(req, self.api.hosts())
+
+    @route("POST", "/recalculate-caches")
+    def handle_recalculate_caches(self, req, params, path, body):
+        """Force TopN caches up to date cluster-wide (reference
+        handleRecalculateCaches, http/handler.go)."""
+        self.api.recalculate_caches(
+            remote=params.get("remote") == "true")
+        self._json(req, {})
+
+    @route("POST", "/internal/translate/keys")
+    def handle_translate_keys(self, req, params, path, body):
+        """Key -> id translation RPC (reference handlePostTranslateKeys;
+        wire form TranslateKeysRequest/Response).  Accepts protobuf or
+        JSON {"index", "field", "keys"}; ids are allocated via the
+        single-writer path."""
+        from pilosa_tpu import proto
+
+        if "protobuf" in req.headers.get("Content-Type", ""):
+            d = proto.decode(proto.TRANSLATE_KEYS_REQUEST, body)
+        else:
+            d = json.loads(body)
+        ids = self.api.node.translate_keys_cluster(
+            d["index"], d.get("field") or None, d.get("keys") or [],
+            create=True)
+        if "protobuf" in req.headers.get("Accept", ""):
+            self._proto(req, proto.encode(
+                proto.TRANSLATE_KEYS_RESPONSE, {"ids": [int(i) for i in ids]}))
+        else:
+            self._json(req, {"ids": [int(i) for i in ids]})
+
+    @route("GET", "/index")
+    def handle_get_indexes(self, req, params, path, body):
+        # reference handleGetIndexes: same shape as /schema
+        self._json(req, {"indexes": self.api.schema()})
+
     @route("GET", "/schema")
     def handle_get_schema(self, req, params, path, body):
         self._json(req, {"indexes": self.api.schema()})

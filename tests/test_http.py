@@ -225,3 +225,71 @@ class TestHTTPCluster:
                             remote=False) == [2]
         st = c.status(s0.uri)
         assert st["state"] == "NORMAL"
+
+
+class TestRouteParityAdditions:
+    """Routes mirroring the reference's remaining public surface:
+    /internal/nodes, /recalculate-caches, /internal/translate/keys,
+    GET /index (http/handler.go:273-322)."""
+
+    def test_internal_nodes_and_get_index(self, srv):
+        nodes = _get(srv.uri, "/internal/nodes")
+        assert len(nodes) == 1 and nodes[0]["uri"]
+        _post(srv.uri, "/index/i")
+        _post(srv.uri, "/index/i/field/f")
+        assert _get(srv.uri, "/index")["indexes"][0]["name"] == "i"
+
+    def test_recalculate_caches(self, srv):
+        _post(srv.uri, "/index/i")
+        _post(srv.uri, "/index/i/field/f")
+        _post(srv.uri, "/index/i/field/f/import",
+              {"rowIDs": [1, 1, 2], "columnIDs": [5, 6, 7]})
+        _post(srv.uri, "/recalculate-caches")
+        # caches now answer TopN without touching the device matrices
+        f = srv.node.holder.index("i").field("f")
+        frag = f.view("standard").fragment(0)
+        assert frag.cached_row_counts(0) == {1: 2, 2: 1}
+        r = _post(srv.uri, "/index/i/query", {"query": "TopN(f)"})
+        assert [(p["id"], p["count"]) for p in r["results"][0]] == \
+            [(1, 2), (2, 1)]
+
+    def test_translate_keys_route(self, tmp_path):
+        s = Server(str(tmp_path / "kt"))
+        s.open()
+        try:
+            _post(s.uri, "/index/k", {"options": {"keys": True}})
+            _post(s.uri, "/index/k/field/f")
+            out = _post(s.uri, "/internal/translate/keys",
+                        {"index": "k", "keys": ["alpha", "beta"]})
+            assert len(out["ids"]) == 2 and all(i > 0 for i in out["ids"])
+            # same keys resolve to the same ids; protobuf form agrees
+            from pilosa_tpu import proto
+
+            body = proto.encode(proto.TRANSLATE_KEYS_REQUEST,
+                                {"index": "k", "keys": ["beta", "alpha"]})
+            req = urllib.request.Request(
+                s.uri + "/internal/translate/keys", data=body,
+                method="POST")
+            req.add_header("Content-Type", "application/x-protobuf")
+            req.add_header("Accept", "application/x-protobuf")
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                ids = proto.decode(proto.TRANSLATE_KEYS_RESPONSE,
+                                   resp.read())["ids"]
+            assert ids == [out["ids"][1], out["ids"][0]]
+        finally:
+            s.close()
+
+    def test_recalc_propagates_in_cluster(self, cluster3):
+        s0, s1, _ = cluster3
+        _post(s0.uri, "/index/i")
+        _post(s0.uri, "/index/i/field/f")
+        _post(s0.uri, "/index/i/field/f/import",
+              {"rowIDs": [3, 3], "columnIDs": [1, 2]})
+        _post(s0.uri, "/recalculate-caches")
+        # every node that owns shard 0 has warm caches now
+        for s in (s0, s1):
+            f = s.node.holder.index("i").field("f")
+            view = f.view("standard")
+            frag = view.fragment(0) if view else None
+            if frag is not None and frag.row_ids():
+                assert frag.cached_row_counts(0) == {3: 2}

@@ -97,3 +97,44 @@ class TestSingleWriter:
             HolderSyncer(nd).sync_holder()
         row = nodes[0].executor.execute("i", 'Row(f="r1")')[0]
         assert sorted(row.keys) == ["c1", "c2"]
+
+
+class TestReplicaReadThrough:
+    """A replica that has not yet tailed the primary's key entries must
+    still answer keyed reads exactly — the miss triggers an immediate
+    tail of the coordinator's entry stream (read-through), instead of
+    waiting for the next anti-entropy sweep (holder.go:690-878)."""
+
+    def _keyed_cluster(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=2)
+        nodes[0].create_index("k", IndexOptions(keys=True))
+        nodes[0].create_field("k", "f", FieldOptions(keys=True))
+        return transport, nodes
+
+    def test_replica_row_and_reverse_translation(self, tmp_path):
+        _, nodes = self._keyed_cluster(tmp_path)
+        # all allocations happen via node0 (coordinator)
+        nodes[0].executor.execute("k", "Set('colA', f='x')")
+        nodes[0].executor.execute("k", "Set('colB', f='x')")
+        # replica answers BOTH directions without any AE sweep:
+        # key->id for the row lookup, id->key for the result columns
+        row = nodes[1].executor.execute("k", "Row(f='x')")[0]
+        assert row.keys == ["colA", "colB"]
+        pairs = nodes[1].executor.execute("k", "TopN(f)")[0]
+        assert [(p.key, p.count) for p in pairs] == [("x", 2)]
+
+    def test_replica_set_row_attrs_string_row(self, tmp_path):
+        _, nodes = self._keyed_cluster(tmp_path)
+        # allocation for a NEW key initiated on the replica must route
+        # through the coordinator (single-writer), not fail on the
+        # replica's read-only store
+        nodes[1].executor.execute(
+            "k", 'SetRowAttrs(f, \'newrow\', color="green")')
+        row = nodes[0].executor.execute("k", "Row(f='newrow')")[0]
+        assert row.attrs.get("color") == "green"
+
+    def test_unknown_key_still_empty(self, tmp_path):
+        _, nodes = self._keyed_cluster(tmp_path)
+        nodes[0].executor.execute("k", "Set('colA', f='x')")
+        row = nodes[1].executor.execute("k", "Row(f='never-set')")[0]
+        assert list(row.columns()) == []
