@@ -173,6 +173,7 @@ class Field:
         # shards-tuple -> (gens, row_ids, shard_pos, pos_dev, mat_dev):
         # concatenated cross-shard row matrices for the fused TopN scan
         self._matrix_stack_cache: dict = {}
+        self._view_times_memo = None  # (view names, parsed times)
         self._lock = threading.RLock()
         if path is not None:
             os.makedirs(path, exist_ok=True)
@@ -336,10 +337,11 @@ class Field:
         return None if view is None else view.row(row_id, shard)
 
     def device_row_stack(self, row_id: int, shards: tuple[int, ...]):
-        """One row across many shards as a device-resident uint32
-        [n_shards, words] stack — the unit of the executor's fused
-        all-shards-in-one-dispatch path (SURVEY.md §7 step 4: whole
-        shard batches as single XLA programs).  Missing fragments
+        """One standard-view row across many shards as a
+        device-resident uint32 [n_shards, words] stack — the unit of
+        the executor's fused all-shards-in-one-dispatch path (SURVEY.md
+        §7 step 4: whole shard batches as single XLA programs; time
+        ranges use device_time_row_stack).  Missing fragments
         contribute zero rows (semantically identical to the per-shard
         None propagation).  Cached per (row, shards) and invalidated by
         the per-fragment mutation generations."""
@@ -387,6 +389,45 @@ class Field:
 
             return pmesh.shard_stack(pmesh.device_mesh(), stack)
         return jax.device_put(stack)
+
+    def device_time_row_stack(self, row_id: int, shards: tuple[int, ...],
+                              view_names: tuple[str, ...]):
+        """One row UNIONED across a set of time views, as a device
+        [n_shards, words] stack — the fused time-range Row operand
+        (f.row_time's per-shard union, batched).  The union happens
+        host-side (numpy OR over the fragments' host rows), so a wide
+        cover costs ONE cache entry and one device transfer, not one
+        per view.  Cached per (row, shards, views); every contributing
+        fragment's generation invalidates."""
+        from pilosa_tpu.ops import bitmap as bm
+
+        key = ("time", row_id, shards, view_names)
+        frag_grid = []
+        gens = []
+        views = [self.view(vn) for vn in view_names]
+        for s in shards:
+            frags = [None if v is None else v.fragment(s) for v in views]
+            frag_grid.append(frags)
+            gens.append(tuple(0 if fr is None else fr._gen
+                              for fr in frags))
+        gens = tuple(gens)
+        with self._lock:
+            hit = self._row_stack_cache.get(key)
+            if hit is not None and hit[0] == gens:
+                self._touch(self._row_stack_cache, key)
+                return hit[1]
+        n_words = bm.n_words(SHARD_WIDTH)
+        stack = np.zeros((_padded_rows(len(shards)), n_words),
+                         dtype=np.uint32)
+        for i, frags in enumerate(frag_grid):
+            for fr in frags:
+                if fr is None:
+                    continue
+                with fr._lock:
+                    arr = fr._rows.get(row_id)
+                    if arr is not None:
+                        np.bitwise_or(stack[i], arr, out=stack[i])
+        return self._place_and_cache_stack(key, gens, stack)
 
     def _place_and_cache_stack(self, key, gens, stack: np.ndarray):
         dev = self._place_on_devices(stack)
@@ -485,6 +526,26 @@ class Field:
             self._matrix_stack_cache, key, entry, entry_bytes,
             max_entries=8)
         return entry
+
+    def time_view_times(self) -> list:
+        """The timestamps encoded in this field's time-view names,
+        memoized per view-name set (the executor's range clamping scans
+        these on every time-range query; reference minMaxViews)."""
+        with self._lock:
+            names = tuple(self.views)
+            cached = self._view_times_memo
+            if cached is not None and cached[0] == names:
+                return cached[1]
+            times = []
+            for name in names:
+                part = name.rsplit("_", 1)[-1]
+                if part.isdigit():
+                    fmt = {4: "%Y", 6: "%Y%m", 8: "%Y%m%d",
+                           10: "%Y%m%d%H"}.get(len(part))
+                    if fmt:
+                        times.append(_dt.datetime.strptime(part, fmt))
+            self._view_times_memo = (names, times)
+            return times
 
     def row_time(self, row_id: int, shard: int, start, end) -> np.ndarray | None:
         """Union of time views covering [start, end) for one shard

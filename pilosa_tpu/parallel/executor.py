@@ -335,8 +335,6 @@ class Executor:
                     return condition.op == "!="
                 return (isinstance(condition.value, int)
                         and not isinstance(condition.value, bool))
-            if "from" in call.args or "to" in call.args:
-                return False
             try:
                 fname = call.field_arg()
             except ValueError:
@@ -347,6 +345,14 @@ class Executor:
             f = idx.field(fname)
             if f is None:
                 return False
+            if "from" in call.args or "to" in call.args:
+                # time-range Row: the cover unions host-side into one
+                # cached stack, so the cap only bounds the generation
+                # tuple the cache must compare per hit
+                if not f.time_quantum:
+                    return False
+                views = self._time_range_views(f, call)
+                return views is not None and len(views) <= 256
             o = f.options
             return not (o.type == FieldType.INT
                         or (o.type == FieldType.TIME and o.no_standard_view))
@@ -368,6 +374,28 @@ class Executor:
         return (self.fuse_shards and len(shards) > 1 and extra
                 and (call is None or self._fused_supported(idx, call)))
 
+    def _time_range_views(self, f, call: Call) -> list[str] | None:
+        """The time views covering a Row(from=, to=) query — the same
+        cover and clamping as the per-shard path (f.row_time /
+        _clamp_to_views); None when the range is malformed.  Runs once
+        for the support check and once per evaluation; the expensive
+        part (the view-name scan) is memoized on the field."""
+        from pilosa_tpu.models.timequantum import views_by_time_range
+
+        from_arg = call.args.get("from")
+        to_arg = call.args.get("to")
+        try:
+            start = (parse_time(from_arg) if from_arg is not None
+                     else _dt.datetime(1, 1, 1))
+            end = (parse_time(to_arg) if to_arg is not None
+                   else _dt.datetime(9999, 1, 1))
+        except (ValueError, TypeError):
+            return None
+        start, end = self._clamp_to_views(f, start, end)
+        return ([] if start >= end
+                else list(views_by_time_range(VIEW_STANDARD, start, end,
+                                              f.time_quantum)))
+
     def _fused_eval(self, idx, call: Call, shards: tuple[int, ...]):
         """Evaluate a supported tree -> uint32 [n_shards, words] device
         stack.  Replaces n_shards × tree-size dispatches with tree-size
@@ -383,10 +411,17 @@ class Executor:
                 return idx.field(fname).device_range_stack(
                     condition.op, value, shards)
             fname = call.field_arg()
+            f = idx.field(fname)
+            if "from" in call.args or "to" in call.args:
+                # time-range Row: ONE cached stack holding the
+                # host-side union over the covering views (f.row_time's
+                # union, batched across shards)
+                views = self._time_range_views(f, call) or []
+                return f.device_time_row_stack(call.args[fname], shards,
+                                               tuple(views))
             # arg is a plain int row id (bool literals were excluded by
             # _fused_supported)
-            return idx.field(fname).device_row_stack(call.args[fname],
-                                                     shards)
+            return f.device_row_stack(call.args[fname], shards)
         kids = [self._fused_eval(idx, c, shards) for c in call.children]
         if name == "Union":
             out = kids[0]
@@ -583,14 +618,9 @@ class Executor:
     def _clamp_to_views(f, start, end):
         """Clamp an open-ended time range to the span actually covered by
         existing time views (mirrors minMaxViews clamping in
-        executeRowsShard, executor.go)."""
-        times = []
-        for name in f.views:
-            part = name.rsplit("_", 1)[-1]
-            if part.isdigit():
-                fmt = {4: "%Y", 6: "%Y%m", 8: "%Y%m%d", 10: "%Y%m%d%H"}.get(len(part))
-                if fmt:
-                    times.append(_dt.datetime.strptime(part, fmt))
+        executeRowsShard, executor.go); the view-name scan is memoized
+        by Field.time_view_times."""
+        times = f.time_view_times()
         if not times:
             return start, start  # no time views -> empty
         lo = min(times)

@@ -84,14 +84,16 @@ class TestFusedEquivalence:
         assert calls["n"] > 0
 
     def test_fused_declines_unsupported(self, ex):
-        # time range and shift fall back; BSI conditions fuse
+        # shift falls back; BSI conditions and time ranges fuse
         idx = ex.holder.index("i")
         idx.create_field("v", FieldOptions.int_field(0, 100))
         idx.create_field("t", FieldOptions.time_field("YMD"))
         parse = __import__("pilosa_tpu.pql", fromlist=["parse"]).parse
-        for q in ["Shift(Row(f0=1), n=1)",
-                  "Row(t=1, from='2020-01-01T00:00', to='2021-01-01T00:00')"]:
-            assert not ex._fused_supported(idx, parse(q).calls[0]), q
+        assert not ex._fused_supported(
+            idx, parse("Shift(Row(f0=1), n=1)").calls[0])
+        assert ex._fused_supported(idx, parse(
+            "Row(t=1, from='2020-01-01T00:00', to='2021-01-01T00:00')"
+        ).calls[0])
         assert ex._fused_supported(idx, parse("Row(v > 3)").calls[0])
         assert ex._fused_supported(idx, parse("Row(v >< [1, 5])").calls[0])
 
@@ -446,3 +448,66 @@ class TestFusedTopNGroupBy:
         assert hits["n"] > 0, "local group did not use the fused TopN scan"
         want = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
         assert [(p.id, p.count) for p in got] == want
+
+    def test_fused_time_range_matches_per_shard(self, ex):
+        """Time-range Rows now fuse: per-view stacks OR on device; the
+        result must match the per-shard row_time union bit for bit."""
+        import datetime as dt
+        import random as _random
+
+        idx = ex.holder.index("i")
+        idx.create_field("tt", FieldOptions.time_field("YMDH"))
+        tt = idx.field("tt")
+        rng = _random.Random(23)
+        rows, cols, stamps = [], [], []
+        oracle = {}
+        for _ in range(600):
+            c = rng.randrange(6 * SHARD_WIDTH)
+            ts = dt.datetime(2019, rng.randrange(1, 13),
+                             rng.randrange(1, 28), rng.randrange(24))
+            rows.append(1)
+            cols.append(c)
+            stamps.append(ts)
+            oracle.setdefault(c, []).append(ts)
+        tt.import_bits(rows, cols, timestamps=stamps)
+        queries = [
+            ("2019-03-01T00:00", "2019-07-15T12:00"),
+            ("2019-01-01T00:00", "2020-01-01T00:00"),
+            ("2019-06-02T03:00", "2019-06-02T04:00"),
+            (None, "2019-05-01T00:00"),
+            ("2019-10-01T00:00", None),
+        ]
+        for frm, to in queries:
+            args = ["tt=1"]
+            if frm:
+                args.append(f"from='{frm}'")
+            if to:
+                args.append(f"to='{to}'")
+            q = f"Row({', '.join(args)})"
+            fused = ex.execute("i", q)[0]
+            general = _general(ex, q)[0]
+            assert list(fused.columns()) == list(general.columns()), q
+            # independent set oracle
+            lo = dt.datetime.fromisoformat(frm) if frm else dt.datetime(1, 1, 1)
+            hi = dt.datetime.fromisoformat(to) if to else dt.datetime(9999, 1, 1)
+            want = sorted(c for c, tss in oracle.items()
+                          if any(lo <= t < hi for t in tss))
+            got = [int(c) for c in fused.columns()]
+            assert got == want, (q, len(got), len(want))
+
+    def test_fused_time_range_in_algebra(self, ex):
+        import datetime as dt
+        import random as _random
+
+        idx = ex.holder.index("i")
+        idx.create_field("tt", FieldOptions.time_field("YMD"))
+        tt = idx.field("tt")
+        rng = _random.Random(8)
+        cols = [rng.randrange(6 * SHARD_WIDTH) for _ in range(300)]
+        tt.import_bits([1] * len(cols), cols,
+                       timestamps=[dt.datetime(2019, 1 + i % 12, 5)
+                                   for i in range(len(cols))])
+        q = ("Count(Intersect(Row(tt=1, from='2019-01-01T00:00', "
+             "to='2019-07-01T00:00'), Row(f0=1)))")
+        got = ex.execute("i", q)[0]
+        assert got == _general(ex, q)[0]
