@@ -150,6 +150,10 @@ def b_shift(a, n: int = 1):
         return a
     w, s = n // WORD_BITS, n % WORD_BITS
     nw = a.shape[-1]
+    if w >= nw:
+        # every bit shifts past the shard edge; computing it would pad
+        # an O(n)-word intermediate and compile per distinct n
+        return jnp.zeros_like(a)
     pad = [(0, 0)] * (a.ndim - 1)
     # words move up by w: out_word[i] = a[i - w]
     shifted = jnp.pad(a, pad + [(w, 0)])[..., :nw]

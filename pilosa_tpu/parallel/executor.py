@@ -313,10 +313,9 @@ class Executor:
 
     def _fused_supported(self, idx, call: Call) -> bool:
         """True when the bitmap tree can evaluate as ONE stacked device
-        computation over all shards: plain standard-view Row leaves and
-        BSI condition rows, combined with Union/Intersect/Difference/
-        Xor/Not.  Time ranges and Shift fall back to the general
-        per-shard path."""
+        computation over all shards: plain standard-view Row leaves,
+        time-range Rows, and BSI condition rows, combined with
+        Union/Intersect/Difference/Xor/Not/Shift."""
         name = call.name
         if name == "Row":
             cond = call.condition_arg()
@@ -359,6 +358,11 @@ class Executor:
         if name == "Not":
             return (len(call.children) == 1
                     and idx.existence_field() is not None
+                    and self._fused_supported(idx, call.children[0]))
+        if name == "Shift":
+            n = call.int_arg("n")
+            return (len(call.children) == 1
+                    and (n is None or n >= 0)
                     and self._fused_supported(idx, call.children[0]))
         if name in ("Union", "Intersect", "Difference", "Xor"):
             return bool(call.children) and all(
@@ -446,6 +450,12 @@ class Executor:
         if name == "Not":
             exist = idx.existence_field().device_row_stack(0, shards)
             return bm.b_andnot(exist, kids[0])
+        if name == "Shift":
+            n = call.int_arg("n")
+            # per-shard semantics batch directly: bits shift within
+            # each shard's row and drop at the shard edge, exactly as
+            # the per-shard path does (executor.go:1730)
+            return bm.b_shift(kids[0], 1 if n is None else n)
         raise ExecutionError(f"unsupported fused call: {name}")
 
     def _execute_bitmap_call(self, idx, call: Call, shards, opt: ExecOptions) -> Row:

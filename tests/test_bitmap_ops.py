@@ -181,3 +181,20 @@ def test_n_words_validation():
     assert n_words(64) == 2
     with pytest.raises(ValueError):
         n_words(65)
+
+
+def test_shift_past_width_is_empty_without_padding():
+    """A shift >= the bitmap width returns zeros directly — no O(n)
+    padded intermediate, no per-n compile."""
+    import jax.numpy as jnp
+
+    from pilosa_tpu.ops import bitmap as bm
+
+    a = jnp.full((4, 8), 0xFFFFFFFF, dtype=jnp.uint32)
+    for n in (8 * 32, 8 * 32 + 1, 10**9):
+        out = bm.b_shift(a, n)
+        assert out.shape == a.shape
+        assert int(jnp.sum(out)) == 0
+    # one word below the edge still shifts normally
+    out = bm.b_shift(a, 8 * 32 - 32)
+    assert int(out[0, -1]) == 0xFFFFFFFF and int(out[0, 0]) == 0

@@ -83,19 +83,31 @@ class TestFusedEquivalence:
         ex.execute("i", "Count(Intersect(Row(f0=1), Row(f1=2)))")
         assert calls["n"] > 0
 
-    def test_fused_declines_unsupported(self, ex):
-        # shift falls back; BSI conditions and time ranges fuse
+    def test_fused_support_surface(self, ex):
+        # BSI conditions, time ranges, and Shift all fuse now
         idx = ex.holder.index("i")
         idx.create_field("v", FieldOptions.int_field(0, 100))
         idx.create_field("t", FieldOptions.time_field("YMD"))
         parse = __import__("pilosa_tpu.pql", fromlist=["parse"]).parse
-        assert not ex._fused_supported(
+        assert ex._fused_supported(
             idx, parse("Shift(Row(f0=1), n=1)").calls[0])
         assert ex._fused_supported(idx, parse(
             "Row(t=1, from='2020-01-01T00:00', to='2021-01-01T00:00')"
         ).calls[0])
         assert ex._fused_supported(idx, parse("Row(v > 3)").calls[0])
         assert ex._fused_supported(idx, parse("Row(v >< [1, 5])").calls[0])
+
+    def test_fused_shift_matches_per_shard(self, ex):
+        for q in ["Shift(Row(f0=1), n=1)",
+                  "Shift(Row(f0=2), n=40)",
+                  "Count(Shift(Union(Row(f0=1), Row(f1=2)), n=3))",
+                  "Count(Intersect(Shift(Row(f0=1)), Row(f1=1)))"]:
+            fused = ex.execute("i", q)[0]
+            general = _general(ex, q)[0]
+            if isinstance(fused, Row):
+                assert list(fused.columns()) == list(general.columns()), q
+            else:
+                assert fused == general, q
 
     def test_fused_bsi_conditions_match_per_shard(self, ex):
         rng = random.Random(17)
