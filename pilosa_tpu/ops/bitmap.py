@@ -146,6 +146,10 @@ def b_shift(a, n: int = 1):
     """Shift all bits toward higher columns by ``n`` (roaring.Shift,
     roaring/roaring.go:946).  Bits shifted past the shard width are dropped,
     matching per-shard Shift execution (executor.go:1730)."""
+    if n < 0:
+        # a clean error instead of a cryptic negative-pad failure from
+        # inside jit tracing; surfaces as a 400 at the query layer
+        raise ValueError("shift distance must be non-negative")
     if n == 0:
         return a
     w, s = n // WORD_BITS, n % WORD_BITS

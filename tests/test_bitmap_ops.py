@@ -198,3 +198,14 @@ def test_shift_past_width_is_empty_without_padding():
     # one word below the edge still shifts normally
     out = bm.b_shift(a, 8 * 32 - 32)
     assert int(out[0, -1]) == 0xFFFFFFFF and int(out[0, 0]) == 0
+
+
+def test_negative_shift_raises_cleanly():
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    from pilosa_tpu.ops import bitmap as bm
+
+    a = jnp.zeros((2, 8), dtype=jnp.uint32)
+    with _pytest.raises(ValueError, match="non-negative"):
+        bm.b_shift(a, -1)

@@ -1,0 +1,258 @@
+"""Model-based differential stress: a random interleaving of writes and
+reads runs against BOTH the product (PQL through the executor, fused
+paths engaged) and a pure-Python dictionary/set model; every read must
+agree exactly.  This generalizes the reference's query-generator stress
+(internal/test/querygenerator.go) to the full op surface: Set/Clear,
+value writes, bulk imports, nested set algebra with Shift, BSI
+conditions, time ranges, TopN (filtered), Sum/Min/Max, and GroupBy.
+
+Time-range semantics use the product's own view-cover functions
+(views_by_time / views_by_time_range) as the membership rule — those
+are pinned independently against reference rules in
+test_time_semantics.py, so the stress composes them rather than
+re-deriving the calendar math."""
+
+from __future__ import annotations
+
+import datetime as dt
+import random
+
+import pytest
+
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.models.row import Row
+from pilosa_tpu.models.timequantum import (TimeQuantum, views_by_time,
+                                           views_by_time_range)
+from pilosa_tpu.parallel.executor import Executor
+from pilosa_tpu.parallel.results import GroupCount, Pair, ValCount
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+N_SHARDS = 4
+VMIN, VMAX = -500, 1000
+
+
+class Model:
+    """The trivially-correct mirror (the roaring/naive.go pattern,
+    lifted to the whole index)."""
+
+    def __init__(self):
+        self.sets: dict[str, dict[int, set]] = {"f0": {}, "f1": {}}
+        self.vals: dict[int, int] = {}
+        self.time: dict[int, dict[int, list]] = {}  # row -> col -> [ts]
+        self.exists: set[int] = set()
+
+    # ---- writes
+    def set_bit(self, f, row, col):
+        self.sets[f].setdefault(row, set()).add(col)
+        self.exists.add(col)
+
+    def clear_bit(self, f, row, col):
+        self.sets[f].get(row, set()).discard(col)
+
+    def set_value(self, col, v):
+        self.vals[col] = v
+        self.exists.add(col)
+
+    def set_time_bit(self, row, col, ts):
+        self.time.setdefault(row, {}).setdefault(col, []).append(ts)
+        self.exists.add(col)
+
+    # ---- reads
+    def row(self, f, row):
+        return set(self.sets[f].get(row, set()))
+
+    def bsi(self, op, k):
+        ops = {
+            ">": lambda v: v > k, ">=": lambda v: v >= k,
+            "<": lambda v: v < k, "<=": lambda v: v <= k,
+            "==": lambda v: v == k, "!=": lambda v: v != k,
+        }[op]
+        return {c for c, v in self.vals.items() if ops(v)}
+
+    def time_range(self, row, start, end, quantum="YMDH"):
+        q = TimeQuantum(quantum)
+        cover = set(views_by_time_range("standard", start, end, q))
+        out = set()
+        for col, tss in self.time.get(row, {}).items():
+            for ts in tss:
+                if cover & set(views_by_time("standard", ts, q)):
+                    out.add(col)
+                    break
+        return out
+
+
+def _gen_expr(rng, model, depth=0):
+    """(pql string, oracle set) for a random bitmap expression."""
+    if depth > 2 or rng.random() < 0.4:
+        kind = rng.random()
+        if kind < 0.45:
+            f = rng.choice(("f0", "f1"))
+            row = rng.randrange(5)
+            return f"Row({f}={row})", model.row(f, row)
+        if kind < 0.7:
+            op = rng.choice((">", ">=", "<", "<=", "==", "!="))
+            k = rng.randrange(VMIN, VMAX)
+            return f"Row(v {op} {k})", model.bsi(op, k)
+        if kind < 0.85:
+            lo = rng.randrange(VMIN, VMAX - 10)
+            hi = lo + rng.randrange(1, 200)
+            return (f"Row(v >< [{lo}, {hi}])",
+                    {c for c, v in model.vals.items() if lo <= v <= hi})
+        start = dt.datetime(2019, rng.randrange(1, 12), rng.randrange(1, 28))
+        end = start + dt.timedelta(days=rng.randrange(1, 90),
+                                   hours=rng.randrange(24))
+        row = rng.randrange(3)
+        return (f"Row(t={row}, from='{start.isoformat(timespec='minutes')}'"
+                f", to='{end.isoformat(timespec='minutes')}')",
+                model.time_range(row, start, end))
+    op = rng.choice(("Union", "Intersect", "Difference", "Xor", "Not",
+                     "Shift"))
+    if op == "Not":
+        q, s = _gen_expr(rng, model, depth + 1)
+        return f"Not({q})", model.exists - s
+    if op == "Shift":
+        q, s = _gen_expr(rng, model, depth + 1)
+        n = rng.randrange(0, 100)
+        return (f"Shift({q}, n={n})",
+                {c + n for c in s if (c % SHARD_WIDTH) + n < SHARD_WIDTH})
+    n = rng.randrange(2, 4)
+    parts = [_gen_expr(rng, model, depth + 1) for _ in range(n)]
+    qs = ", ".join(p[0] for p in parts)
+    sets = [p[1] for p in parts]
+    if op == "Union":
+        out = set().union(*sets)
+    elif op == "Intersect":
+        out = sets[0]
+        for s_ in sets[1:]:
+            out = out & s_
+    elif op == "Difference":
+        out = sets[0]
+        for s_ in sets[1:]:
+            out = out - s_
+    else:
+        out = sets[0]
+        for s_ in sets[1:]:
+            out = out ^ s_
+    return f"{op}({qs})", out
+
+
+def _rand_col(rng):
+    return rng.randrange(N_SHARDS * SHARD_WIDTH)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_interleaved_ops_match_model(tmp_path, seed):
+    holder = Holder(str(tmp_path / f"m{seed}"))
+    idx = holder.create_index("i")
+    for f in ("f0", "f1"):
+        idx.create_field(f)
+    idx.create_field("v", FieldOptions.int_field(VMIN, VMAX))
+    idx.create_field("t", FieldOptions.time_field("YMDH"))
+    ex = Executor(holder)
+    model = Model()
+    rng = random.Random(seed)
+
+    def apply_write():
+        k = rng.random()
+        if k < 0.35:
+            f, row, col = rng.choice(("f0", "f1")), rng.randrange(5), _rand_col(rng)
+            ex.execute("i", f"Set({col}, {f}={row})")
+            model.set_bit(f, row, col)
+        elif k < 0.45:
+            f, row = rng.choice(("f0", "f1")), rng.randrange(5)
+            have = sorted(model.row(f, row))
+            if have:
+                col = rng.choice(have)
+                ex.execute("i", f"Clear({col}, {f}={row})")
+                model.clear_bit(f, row, col)
+        elif k < 0.6:
+            col, v = _rand_col(rng), rng.randrange(VMIN, VMAX)
+            ex.execute("i", f"Set({col}, v={v})")
+            model.set_value(col, v)
+        elif k < 0.75:
+            row, col = rng.randrange(3), _rand_col(rng)
+            ts = dt.datetime(2019, rng.randrange(1, 13),
+                             rng.randrange(1, 28), rng.randrange(24))
+            ex.execute(
+                "i", f"Set({col}, t={row}, "
+                     f"{ts.isoformat(timespec='minutes')!r})")
+            model.set_time_bit(row, col, ts)
+        else:
+            # bulk import
+            f = rng.choice(("f0", "f1"))
+            rows, cols = [], []
+            for _ in range(rng.randrange(5, 60)):
+                r, c = rng.randrange(5), _rand_col(rng)
+                rows.append(r)
+                cols.append(c)
+                model.set_bit(f, r, c)
+            idx.field(f).import_bits(rows, cols)
+            idx.import_existence(cols)
+
+    def check_read():
+        k = rng.random()
+        if k < 0.4:
+            q, want = _gen_expr(rng, model)
+            if rng.random() < 0.5:
+                got = ex.execute("i", f"Count({q})")[0]
+                assert got == len(want), q
+            else:
+                got = ex.execute("i", q)[0]
+                assert set(int(c) for c in got.columns()) == want, q
+        elif k < 0.6:
+            f = rng.choice(("f0", "f1"))
+            if rng.random() < 0.5:
+                q = f"TopN({f})"
+                counts = {r: len(s) for r, s in model.sets[f].items() if s}
+            else:
+                fq, fset = _gen_expr(rng, model, depth=2)
+                q = f"TopN({f}, {fq})"
+                counts = {r: len(s & fset)
+                          for r, s in model.sets[f].items() if s & fset}
+            got = ex.execute("i", q)[0]
+            want = sorted(((c, r) for r, c in counts.items()),
+                          key=lambda x: (-x[0], x[1]))
+            assert [(p.count, p.id) for p in got] == want, q
+        elif k < 0.85:
+            agg = rng.choice(("Sum", "Min", "Max"))
+            fq, fset = _gen_expr(rng, model, depth=2)
+            use_filter = rng.random() < 0.6
+            q = (f"{agg}({fq}, field=v)" if use_filter
+                 else f"{agg}(field=v)")
+            sel = {c: v for c, v in model.vals.items()
+                   if not use_filter or c in fset}
+            got = ex.execute("i", q)[0]
+            if not sel:
+                assert got.count == 0, q
+            elif agg == "Sum":
+                assert (got.val, got.count) == (sum(sel.values()),
+                                                len(sel)), q
+            elif agg == "Min":
+                mn = min(sel.values())
+                assert (got.val, got.count) == (
+                    mn, sum(1 for v in sel.values() if v == mn)), q
+            else:
+                mx = max(sel.values())
+                assert (got.val, got.count) == (
+                    mx, sum(1 for v in sel.values() if v == mx)), q
+        else:
+            got = ex.execute("i", "GroupBy(Rows(f0), Rows(f1))")[0]
+            want = {}
+            for ra, sa in model.sets["f0"].items():
+                for rb, sb in model.sets["f1"].items():
+                    c = len(sa & sb)
+                    if c:
+                        want[(ra, rb)] = c
+            gotd = {(g.group[0].row_id, g.group[1].row_id): g.count
+                    for g in got}
+            assert gotd == want
+
+    for step in range(120):
+        apply_write()
+        if step % 3 == 0:
+            check_read()
+    # closing sweep: a batch of pure reads over the final state
+    for _ in range(25):
+        check_read()
+    holder.close()
