@@ -842,7 +842,9 @@ class Executor:
     # --------------------------------------------------------------- Rows
 
     def _execute_rows(self, idx, call: Call, shards, opt: ExecOptions) -> list[int]:
-        fname = call.args.get("_field")
+        # "field=" is the reference's backwards-compat spelling of the
+        # positional field (executor.go:1090-1093)
+        fname = call.args.get("_field") or call.args.get("field")
         if not fname:
             raise ExecutionError("Rows() requires a field argument")
         f = self._field(idx, fname)
@@ -892,22 +894,45 @@ class Executor:
         filter_call = call.call_arg("filter")
         shards = self._target_shards(idx, shards, opt)
         child_fields = []
+        child_allowed: list[set | None] = []
         for child in call.children:
-            fname = child.args.get("_field")
+            fname = child.args.get("_field") or child.args.get("field")
             if not fname:
                 raise ExecutionError("Rows() requires a field argument")
             child_fields.append(self._field(idx, fname))
+            # Rows children with limit/column/previous constraints
+            # pre-execute CLUSTER-WIDE once at the originating node and
+            # restrict the walk (reference executeGroupBy,
+            # executor.go:1084-1117 — except the reference lets each
+            # remote node recompute its own LOCAL truncation, which can
+            # disagree with the global one; here remotes run the
+            # unconstrained walk and the origin filters at reduce, so
+            # the restriction is globally consistent)
+            if (child.uint_arg("limit") is not None
+                    or child.uint_arg("column") is not None
+                    or child.uint_arg("previous") is not None):
+                allowed = self._execute_rows(idx, child, shards, opt)
+                if not allowed:
+                    return []
+                child_allowed.append(set(allowed))
+            else:
+                child_allowed.append(None)
 
         def map_fn(shard):
             import jax.numpy as jnp
 
             mats = []
-            for f in child_fields:
+            for f, allowed in zip(child_fields, child_allowed):
                 view = f.view(VIEW_STANDARD)
                 frag = view.fragment(shard) if view is not None else None
                 if frag is None:
                     return {}
                 row_ids, matrix = frag.device_matrix()
+                if allowed is not None and len(row_ids):
+                    keep = np.flatnonzero(np.isin(
+                        row_ids, np.fromiter(allowed, dtype=np.int64)))
+                    row_ids = row_ids[keep]
+                    matrix = matrix[keep] if len(keep) else matrix[:0]
                 if len(row_ids) == 0:
                     return {}
                 mats.append((f.name, row_ids, matrix))
@@ -971,12 +996,31 @@ class Executor:
                 }
             ]
 
+        # Remote nodes run the UNCONSTRAINED walk (child limit/column/
+        # previous stripped) so the origin's cluster-wide allowed sets
+        # are the single source of truth; group keys outside them are
+        # dropped at reduce.  Counts are unaffected: a group's count
+        # never depends on which other rows were walked.
+        remote_call = call
+        if any(a is not None for a in child_allowed):
+            remote_call = call.clone()
+            for child in remote_call.children:
+                child.args.pop("limit", None)
+                child.args.pop("column", None)
+                child.args.pop("previous", None)
+
         totals: dict[tuple, int] = {}
         parts = self._map_shards(
-            map_fn, shards, idx=idx, call=call, opt=opt, adapt=gc_adapt
+            map_fn, shards, idx=idx, call=call, opt=opt, adapt=gc_adapt,
+            remote_call=remote_call,
         )
         for part in parts:
             for key, c in part.items():
+                if any(
+                    allowed is not None and key[i][1] not in allowed
+                    for i, allowed in enumerate(child_allowed)
+                ):
+                    continue
                 totals[key] = totals.get(key, 0) + c
 
         out = [
@@ -1536,7 +1580,7 @@ class Executor:
                 return Call(_EMPTY_CALL)
             return call
         if name == "Rows":
-            fname = call.args.get("_field")
+            fname = call.args.get("_field") or call.args.get("field")
             prev = call.args.get("previous")
             if isinstance(prev, str) and fname:
                 f = idx.field(fname)
@@ -1588,7 +1632,7 @@ class Executor:
                     p.key = k or ""
             return res
         if call.name == "Rows" and isinstance(res, list):
-            fname = call.args.get("_field")
+            fname = call.args.get("_field") or call.args.get("field")
             f = idx.field(fname) if fname else None
             if f is not None and f.options.keys:
                 return [k or ""

@@ -349,3 +349,46 @@ class TestClusterRegressions:
         transport.query_node = real_query
         # two remote nodes -> sequential would be >= 2*delay
         assert dt < 2 * delay, f"fan-out not concurrent: {dt:.3f}s"
+
+
+class TestClusteredGroupByConstraints:
+    def test_child_limit_is_globally_consistent(self, tmp_path):
+        """A GroupBy child's limit must restrict to the CLUSTER-WIDE
+        lowest rows.  Remote nodes run unconstrained and the origin
+        filters — a remote recomputing its own local truncation (the
+        reference's behavior) would emit groups for rows that are not
+        in the global top-N when the low rows live only on the origin's
+        shards."""
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "a")
+        nodes[0].create_field("i", "b")
+        # find one shard owned by each node
+        own = {0: None, 1: None}
+        for s in range(16):
+            nid = nodes[0].cluster.shard_nodes("i", s)[0].id
+            i = 0 if nid == nodes[0].cluster.local_id else 1
+            if own[i] is None:
+                own[i] = s
+            if all(v is not None for v in own.values()):
+                break
+        assert all(v is not None for v in own.values())
+        from pilosa_tpu.api import API
+
+        api = API(nodes[0])
+        # rows 0,1 of 'a' exist ONLY on the origin-owned shard; rows
+        # 2,3 exist only on the remote-owned shard
+        base0 = own[0] * SHARD_WIDTH
+        base1 = own[1] * SHARD_WIDTH
+        api.import_bits("i", "a", [0, 1], [base0 + 1, base0 + 2])
+        api.import_bits("i", "a", [2, 3], [base1 + 1, base1 + 2])
+        api.import_bits("i", "b",
+                        [7, 7, 7, 7],
+                        [base0 + 1, base0 + 2, base1 + 1, base1 + 2])
+        got = nodes[0].executor.execute(
+            "i", "GroupBy(Rows(a, limit=2), Rows(b))")[0]
+        gotd = {(g.group[0].row_id, g.group[1].row_id): g.count
+                for g in got}
+        # global lowest two rows of 'a' are 0 and 1 — rows 2,3 must NOT
+        # appear even though the remote node only sees rows 2,3 locally
+        assert gotd == {(0, 7): 1, (1, 7): 1}, gotd

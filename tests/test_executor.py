@@ -5,6 +5,8 @@ per-op tests against 1- and 3-node clusters; round 1 covers the
 single-node paths here, cluster paths under tests/test_cluster*).
 """
 
+import random
+
 import numpy as np
 import pytest
 
@@ -383,3 +385,72 @@ def test_multiple_calls_one_query(ex, holder):
 def test_unknown_call(ex):
     with pytest.raises(Exception):
         q(ex, "Frobnicate(Row(f=1))")
+
+
+class TestGroupByChildConstraints:
+    """GroupBy children with limit/column pre-execute cluster-wide and
+    restrict the walk (reference executeGroupBy, executor.go:1084-1117),
+    and 'field=' spells the Rows field (back-compat)."""
+
+    @pytest.fixture
+    def gex(self, tmp_path):
+        holder = Holder(str(tmp_path / "g"))
+        idx = holder.create_index("g")
+        self.sets = {"a": {}, "b": {}}
+        rng = random.Random(2)
+        for fname in self.sets:
+            f = idx.create_field(fname)
+            rows, cols = [], []
+            for row in range(6):
+                members = {rng.randrange(3 * SHARD_WIDTH)
+                           for _ in range(120)}
+                self.sets[fname][row] = members
+                rows.extend([row] * len(members))
+                cols.extend(members)
+            f.import_bits(rows, cols)
+        yield Executor(holder)
+        holder.close()
+
+    def _want(self, a_rows, b_rows):
+        out = {}
+        for ra in a_rows:
+            for rb in b_rows:
+                c = len(self.sets["a"][ra] & self.sets["b"][rb])
+                if c:
+                    out[(ra, rb)] = c
+        return out
+
+    def test_child_limit(self, gex):
+        got = gex.execute("g", "GroupBy(Rows(a, limit=2), Rows(b))")[0]
+        want = self._want([0, 1], range(6))
+        assert {(g.group[0].row_id, g.group[1].row_id): g.count
+                for g in got} == want
+
+    def test_child_column(self, gex):
+        col = next(iter(self.sets["a"][3]))
+        a_rows = [r for r, s in self.sets["a"].items() if col in s]
+        got = gex.execute("g", f"GroupBy(Rows(a, column={col}), Rows(b))")[0]
+        want = self._want(a_rows, range(6))
+        assert {(g.group[0].row_id, g.group[1].row_id): g.count
+                for g in got} == want
+
+    def test_child_column_unset_means_no_groups(self, gex):
+        # a column provably outside every generated set (fixture draws
+        # from [0, 3*SHARD_WIDTH)): no rows contain it -> no groups
+        col = 3 * SHARD_WIDTH + 1
+        assert all(col not in s for s in self.sets["a"].values())
+        got = gex.execute("g", f"GroupBy(Rows(a, column={col}), Rows(b))")
+        assert got[0] == []
+
+    def test_child_previous(self, gex):
+        got = gex.execute("g", "GroupBy(Rows(a, previous=2), Rows(b))")[0]
+        want = self._want([3, 4, 5], range(6))
+        assert {(g.group[0].row_id, g.group[1].row_id): g.count
+                for g in got} == want
+
+    def test_field_arg_spelling(self, gex):
+        a = gex.execute("g", "GroupBy(Rows(a), Rows(b))")[0]
+        b = gex.execute("g", "GroupBy(Rows(field=a), Rows(field=b))")[0]
+        assert [(g.group[0].row_id, g.group[1].row_id, g.count)
+                for g in a] == \
+            [(g.group[0].row_id, g.group[1].row_id, g.count) for g in b]
