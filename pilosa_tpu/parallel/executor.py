@@ -688,6 +688,9 @@ class Executor:
         threshold = call.uint_arg("threshold") or 0
         attr_name = call.string_arg("attrName")
         attr_values = call.args.get("attrValues")
+        tanimoto = call.uint_arg("tanimotoThreshold") or 0
+        if tanimoto > 100:
+            raise ExecutionError("Tanimoto Threshold is from 1 to 100 only")
         shards = self._target_shards(idx, shards, opt)
         filter_call = call.children[0] if call.children else None
 
@@ -736,6 +739,7 @@ class Executor:
         remote_call = call.clone()
         remote_call.args.pop("n", None)
         remote_call.args.pop("threshold", None)
+        remote_call.args.pop("tanimotoThreshold", None)
 
         fused_ok = self._fuse_eligible(idx, shards, filter_call)
 
@@ -771,7 +775,35 @@ class Executor:
                 for r, c in totals.items()
                 if f.row_attrs.attrs(r).get(attr_name) in allowed_vals
             }
-        if threshold:
+        if tanimoto and filter_call is not None:
+            # Tanimoto similarity (reference fragment.top): the count
+            # pre-window — full row count strictly inside
+            # (|src|*T/100, |src|*100/T), fragment.go:1588-1617 — then
+            # the exact coefficient ceil(100*|A∩src| /
+            # (|A|+|src|-|A∩src|)) > T, fragment.go:1649-1652.  The
+            # reference applies both per shard with per-shard counts;
+            # here counts are global — consistent with this executor's
+            # exact (non-rank-cache) TopN.
+            import math
+
+            src_count = self._execute_count(
+                idx, Call("Count", children=[filter_call]), shards, opt)
+            full = self._execute_topn(
+                idx, Call("TopN", {"_field": fname}), shards, opt)
+            full_counts = {p.id: p.count for p in full}
+            lo = src_count * tanimoto / 100.0
+            hi = src_count * 100.0 / tanimoto
+            kept = {}
+            for r, inter in totals.items():
+                cnt = full_counts.get(r, 0)
+                if not (lo < cnt < hi) or inter == 0:
+                    continue
+                coeff = math.ceil(inter * 100.0
+                                  / (cnt + src_count - inter))
+                if coeff > tanimoto:
+                    kept[r] = inter
+            totals = kept
+        elif threshold:
             totals = {r: c for r, c in totals.items() if c >= threshold}
 
         pairs = sort_pairs([Pair(id=r, count=c) for r, c in totals.items()])
@@ -996,18 +1028,21 @@ class Executor:
                 }
             ]
 
-        # Remote nodes run the UNCONSTRAINED walk (child limit/column/
-        # previous stripped) so the origin's cluster-wide allowed sets
-        # are the single source of truth; group keys outside them are
-        # dropped at reduce.  Counts are unaffected: a group's count
-        # never depends on which other rows were walked.
-        remote_call = call
-        if any(a is not None for a in child_allowed):
-            remote_call = call.clone()
-            for child in remote_call.children:
-                child.args.pop("limit", None)
-                child.args.pop("column", None)
-                child.args.pop("previous", None)
+        # Remote nodes run the UNCONSTRAINED walk: child limit/column/
+        # previous are stripped (the origin's cluster-wide allowed sets
+        # are the single source of truth; group keys outside them drop
+        # at reduce), and so are the top-level limit/offset — a remote
+        # truncating its OWN sorted groups would lose partial counts
+        # for group keys that span nodes.  Counts are unaffected by the
+        # stripping: a group's count never depends on which other rows
+        # were walked.
+        remote_call = call.clone()
+        remote_call.args.pop("limit", None)
+        remote_call.args.pop("offset", None)
+        for child in remote_call.children:
+            child.args.pop("limit", None)
+            child.args.pop("column", None)
+            child.args.pop("previous", None)
 
         totals: dict[tuple, int] = {}
         parts = self._map_shards(
@@ -1027,6 +1062,11 @@ class Executor:
             GroupCount(group=[FieldRow(field=f, row_id=r) for f, r in key], count=c)
             for key, c in sorted(totals.items())
         ]
+        # offset before limit (reference executeGroupBy,
+        # executor.go:1135-1149)
+        offset = call.uint_arg("offset")
+        if offset is not None:
+            out = out[offset:] if offset < len(out) else out
         if limit is not None:
             out = out[:limit]
         return out

@@ -392,3 +392,46 @@ class TestClusteredGroupByConstraints:
         # global lowest two rows of 'a' are 0 and 1 — rows 2,3 must NOT
         # appear even though the remote node only sees rows 2,3 locally
         assert gotd == {(0, 7): 1, (1, 7): 1}, gotd
+
+    def test_groupby_limit_does_not_drop_cross_node_counts(self, tmp_path):
+        """A top-level GroupBy limit must apply AFTER the cluster-wide
+        merge: a remote node truncating its own sorted groups would
+        lose its partial count for a group key that also exists on the
+        origin."""
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "a")
+        nodes[0].create_field("i", "b")
+        own = {0: None, 1: None}
+        for s in range(16):
+            nid = nodes[0].cluster.shard_nodes("i", s)[0].id
+            i = 0 if nid == nodes[0].cluster.local_id else 1
+            if own[i] is None:
+                own[i] = s
+            if all(v is not None for v in own.values()):
+                break
+        from pilosa_tpu.api import API
+
+        api = API(nodes[0])
+        b0, b1 = own[0] * SHARD_WIDTH, own[1] * SHARD_WIDTH
+        # remote node owns groups (0,7),(1,7),(5,7); origin owns (5,7)
+        # too.  A remote-side limit=3 would keep only its sorted-first
+        # groups; the (5,7) partial count must still reach the origin.
+        api.import_bits("i", "a", [0, 1, 5], [b1 + 1, b1 + 2, b1 + 3])
+        api.import_bits("i", "a", [5, 5], [b0 + 1, b0 + 2])
+        api.import_bits("i", "b", [7] * 5,
+                        [b0 + 1, b0 + 2, b1 + 1, b1 + 2, b1 + 3])
+        got = nodes[0].executor.execute(
+            "i", "GroupBy(Rows(a), Rows(b), limit=3)")[0]
+        gotd = {(g.group[0].row_id, g.group[1].row_id): g.count
+                for g in got}
+        assert gotd == {(0, 7): 1, (1, 7): 1, (5, 7): 3}, gotd
+        # offset is the discriminating case: a remote applying offset
+        # to ITS OWN sorted groups drops (0,7)/(1,7) — which exist only
+        # remotely — so the origin would see one group and the
+        # offset>=len quirk would return the wrong set
+        got = nodes[0].executor.execute(
+            "i", "GroupBy(Rows(a), Rows(b), offset=1)")[0]
+        gotd = {(g.group[0].row_id, g.group[1].row_id): g.count
+                for g in got}
+        assert gotd == {(1, 7): 1, (5, 7): 3}, gotd

@@ -454,3 +454,65 @@ class TestGroupByChildConstraints:
         assert [(g.group[0].row_id, g.group[1].row_id, g.count)
                 for g in a] == \
             [(g.group[0].row_id, g.group[1].row_id, g.count) for g in b]
+
+    def test_groupby_offset(self, tmp_path):
+        holder = Holder(str(tmp_path / "o"))
+        idx = holder.create_index("o")
+        # dense overlap: every (a-row, b-row) pair intersects
+        for fname in ("a", "b"):
+            f = idx.create_field(fname)
+            rows, cols = [], []
+            for row in range(4):
+                for c in range(0, 200, 2):
+                    rows.append(row)
+                    cols.append(c)
+            f.import_bits(rows, cols)
+        ex = Executor(holder)
+        full = ex.execute("o", "GroupBy(Rows(a), Rows(b))")[0]
+        assert len(full) == 16
+        key = lambda g: tuple((fr.field, fr.row_id) for fr in g.group)
+        off = ex.execute("o", "GroupBy(Rows(a), Rows(b), offset=3)")[0]
+        assert [key(g) for g in off] == [key(g) for g in full][3:]
+        both = ex.execute(
+            "o", "GroupBy(Rows(a), Rows(b), offset=2, limit=4)")[0]
+        assert [key(g) for g in both] == [key(g) for g in full][2:6]
+        # reference quirk: offset >= len leaves results unchanged
+        # (executor.go:1138 only slices when offset < len)
+        huge = ex.execute(
+            "o", f"GroupBy(Rows(a), Rows(b), offset={len(full) + 5})")[0]
+        assert [key(g) for g in huge] == [key(g) for g in full]
+        holder.close()
+
+
+class TestTopNTanimoto:
+    def test_tanimoto_window(self, tmp_path):
+        """tanimotoThreshold keeps rows whose full count lies strictly
+        inside (|src|*T/100, |src|*100/T), ranked by intersection count
+        (reference fragment.top, fragment.go:1588-1617, applied to
+        global counts here)."""
+        holder = Holder(str(tmp_path / "t"))
+        idx = holder.create_index("t")
+        f = idx.create_field("f")
+        src_field = idx.create_field("s")
+        # src: 10 columns
+        src_cols = list(range(0, 1000, 100))
+        src_field.import_bits([1] * 10, src_cols)
+        # rows with controlled full counts and overlaps
+        layouts = {
+            0: list(range(0, 2000, 100)),   # count 20 = hi -> window excludes (strict)
+            1: list(range(0, 900, 100)),    # count 9, inter 9: coeff ceil(900/10)=90 > 50
+            2: list(range(0, 400, 100)),    # count 4 < lo=5 -> window excludes
+            3: ([c + 1 for c in range(0, 1000, 100)]
+                + list(range(0, 500, 100))),  # count 15, inter 5:
+            # coeff ceil(500/(15+10-5)) = 25 <= 50 -> coefficient excludes
+        }
+        for r, cols in layouts.items():
+            f.import_bits([r] * len(cols), cols)
+        ex = Executor(holder)
+        got = ex.execute("t", "TopN(f, Row(s=1), tanimotoThreshold=50)")[0]
+        # |src| = 10 -> window (5, 20); then the exact coefficient
+        # check: only row 1 survives both
+        assert [(p.count, p.id) for p in got] == [(9, 1)]
+        with pytest.raises(Exception):
+            ex.execute("t", "TopN(f, Row(s=1), tanimotoThreshold=101)")
+        holder.close()
