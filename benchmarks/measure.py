@@ -149,8 +149,7 @@ def main():
     holder.close()
 
     # ---- config 5: 3-node HTTP cluster Count QPS
-    import urllib.request
-
+    from pilosa_tpu.server.client import InternalClient
     from pilosa_tpu.server.server import Server
 
     base = tempfile.mkdtemp()
@@ -158,13 +157,12 @@ def main():
     s1 = Server(data_dir=f"{base}/n1", seeds=[s0.uri]); s1.open()
     s2 = Server(data_dir=f"{base}/n2", seeds=[s0.uri]); s2.open()
 
+    # a keep-alive client, like any real driver (and the reference's
+    # closed-loop benchmark clients)
+    client = InternalClient(timeout=120)
+
     def post(path, obj):
-        r = urllib.request.Request(s0.uri + path,
-                                   data=json.dumps(obj).encode(),
-                                   method="POST")
-        r.add_header("Content-Type", "application/json")
-        with urllib.request.urlopen(r, timeout=120) as resp:
-            return json.loads(resp.read() or b"null")
+        return client.post_json(s0.uri + path, obj)
 
     post("/index/c", {})
     post("/index/c/field/f", {})
@@ -180,6 +178,7 @@ def main():
     qps5 = timed_qps(lambda: post("/index/c/query", q5), min_iters=10)
     out.append({"config": 5, "metric": "cluster3_count_qps_http",
                 "value": round(qps5, 1), "unit": "qps"})
+    client.close()
     s0.close(); s1.close(); s2.close()
 
     platform = jax.devices()[0].platform

@@ -9,8 +9,7 @@ Transport adapter that plugs it into the cluster layer.
 from __future__ import annotations
 
 import json
-import urllib.error
-import urllib.request
+import threading
 
 from pilosa_tpu.parallel.cluster import Node, Transport, TransportError
 
@@ -25,6 +24,9 @@ class InternalClient:
     """Thin JSON/binary HTTP client against a node's Handler routes
     (http/client.go:37)."""
 
+    #: idle keep-alive connections retained per (scheme, host)
+    MAX_IDLE_PER_HOST = 8
+
     def __init__(self, timeout: float = 30.0,
                  tls_skip_verify: bool = False):
         self.timeout = timeout
@@ -37,42 +39,143 @@ class InternalClient:
             self._ssl_ctx = ssl.create_default_context()
             self._ssl_ctx.check_hostname = False
             self._ssl_ctx.verify_mode = ssl.CERT_NONE
+        # keep-alive pool: (scheme, netloc) -> idle HTTPConnections.
+        # The reference's InternalClient rides net/http's pooled
+        # transport (http/client.go:55); without reuse every RPC pays a
+        # TCP (+TLS) handshake, which dominates small-query latency.
+        self._pool: dict[tuple[str, str], list] = {}
+        self._pool_lock = threading.Lock()
+        self._closed = False
 
     # ------------------------------------------------------------- basics
+
+    def _connect(self, scheme: str, netloc: str):
+        import http.client
+        import socket
+        import ssl as _ssl
+
+        if scheme == "https":
+            ctx = self._ssl_ctx or _ssl.create_default_context()
+            conn = http.client.HTTPSConnection(netloc,
+                                               timeout=self.timeout,
+                                               context=ctx)
+        else:
+            conn = http.client.HTTPConnection(netloc,
+                                              timeout=self.timeout)
+        conn.connect()
+        # Nagle + delayed-ACK stalls kill keep-alive RPC latency (the
+        # header and body go out as separate small segments); urllib
+        # never noticed because closing the connection flushed it
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return conn
+
+    def _acquire(self, scheme: str, netloc: str):
+        """-> (connection, came_from_pool)"""
+        with self._pool_lock:
+            idle = self._pool.get((scheme, netloc))
+            if idle:
+                return idle.pop(), True
+        return self._connect(scheme, netloc), False
+
+    def close(self) -> None:
+        """Drop every pooled connection and refuse re-pooling from
+        in-flight requests (deterministic FD release; the server's
+        close path calls this so peers' sockets don't linger)."""
+        with self._pool_lock:
+            self._closed = True
+            pools, self._pool = list(self._pool.values()), {}
+        for idle in pools:
+            for conn in idle:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+    def _release(self, scheme: str, netloc: str, conn) -> None:
+        with self._pool_lock:
+            if not self._closed:
+                idle = self._pool.setdefault((scheme, netloc), [])
+                if len(idle) < self.MAX_IDLE_PER_HOST:
+                    idle.append(conn)
+                    return
+        conn.close()
 
     def _request(self, method: str, url: str, body: bytes | None = None,
                  ctype: str = "application/json",
                  accept: str | None = None,
                  error_decoder=None) -> bytes:
-        """One transport path for JSON and protobuf requests;
-        ``error_decoder(raw) -> str`` extracts the error detail from a
-        non-2xx body (default: JSON {"error": ...})."""
-        req = urllib.request.Request(url, data=body, method=method)
+        """One transport path for JSON and protobuf requests over
+        pooled keep-alive connections; ``error_decoder(raw) -> str``
+        extracts the error detail from a non-2xx body (default: JSON
+        {"error": ...})."""
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(url)
+        path = parts.path + (f"?{parts.query}" if parts.query else "")
+        headers = {}
         if body is not None:
-            req.add_header("Content-Type", ctype)
+            headers["Content-Type"] = ctype
         if accept:
-            req.add_header("Accept", accept)
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout,
-                                        context=self._ssl_ctx) as resp:
-                return resp.read()
-        except urllib.error.HTTPError as e:
-            detail = ""
+            headers["Accept"] = accept
+        import http.client as _hc
+
+        # Disconnect-class failures on a POOLED connection retry on the
+        # next connection (the pool drains toward a fresh one, so a
+        # node that idled out ALL pooled sockets still answers on the
+        # first request).  A retried request MAY have reached the
+        # server when the drop happened at the response stage — safe
+        # here because this wire's writes are idempotent by design
+        # (Set/import are set-semantics, DDL and attrs are upserts, key
+        # allocation returns existing ids); timeouts never retry.
+        _stale = (_hc.RemoteDisconnected, _hc.BadStatusLine,
+                  _hc.CannotSendRequest, BrokenPipeError,
+                  ConnectionResetError, ConnectionAbortedError)
+        while True:
+            conn = None
+            pooled = False
             try:
-                raw = e.read()
-                if error_decoder is not None:
-                    detail = error_decoder(raw)
-                else:
-                    detail = json.loads(raw).get("error", "")
-            except Exception:
-                pass
-            raise ClientError(e.code, detail or str(e)) from e
-        except (urllib.error.URLError, ConnectionError, TimeoutError, OSError) as e:
-            raise TransportError(f"node unreachable: {url}: {e}") from e
+                # _acquire may CONNECT (refused/unreachable raises here,
+                # inside the same error mapping as request IO)
+                conn, pooled = self._acquire(parts.scheme, parts.netloc)
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                raw = resp.read()
+            except (ConnectionError, TimeoutError, OSError,
+                    _hc.HTTPException) as e:
+                if conn is not None:
+                    conn.close()
+                if pooled and isinstance(e, _stale):
+                    # each failed pooled conn was closed, not re-pooled,
+                    # so this loop reaches a fresh connection within
+                    # MAX_IDLE_PER_HOST iterations
+                    continue
+                raise TransportError(
+                    f"node unreachable: {url}: {e}") from e
+            if resp.will_close:
+                conn.close()
+            else:
+                self._release(parts.scheme, parts.netloc, conn)
+            if resp.status >= 400:
+                detail = ""
+                try:
+                    if error_decoder is not None:
+                        detail = error_decoder(raw)
+                    else:
+                        detail = json.loads(raw).get("error", "")
+                except Exception:
+                    pass
+                raise ClientError(resp.status,
+                                  detail or f"http {resp.status}")
+            return raw
 
     def _json(self, method: str, url: str, obj=None):
         body = None if obj is None else json.dumps(obj).encode()
         return json.loads(self._request(method, url, body) or b"null")
+
+    def post_json(self, url: str, obj=None):
+        """Public JSON POST over the pooled transport (benchmarks and
+        embedding clients)."""
+        return self._json("POST", url, obj)
 
     # -------------------------------------------------------------- query
 

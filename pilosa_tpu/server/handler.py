@@ -169,6 +169,9 @@ class Handler:
 
         class _Req(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # keep-alive responses must not sit in Nagle's buffer
+            # waiting for the client's delayed ACK
+            disable_nagle_algorithm = True
             timeout = 60  # per-connection read timeout
 
             def setup(self):
@@ -196,6 +199,10 @@ class Handler:
                 self._dispatch("DELETE")
 
         self.httpd = ThreadingHTTPServer((host, port), _Req)
+        # close() must not block on handler threads parked in idle
+        # keep-alive reads (daemon threads die with the process; bounded
+        # by the per-connection timeout otherwise)
+        self.httpd.block_on_close = False
         if tls_cert:
             # TLS termination (reference server/tlsconfig.go; https
             # scheme config server/config.go:60)

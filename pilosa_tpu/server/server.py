@@ -170,6 +170,7 @@ class Server:
         self._stop.set()
         self.runtime_monitor.stop()
         self.handler.close()
+        self._client.close()  # drop pooled keep-alive sockets
         self.holder.close()
         for closer in self._closers:
             try:
