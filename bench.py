@@ -18,6 +18,11 @@ import time
 
 import numpy as np
 
+
+from pilosa_tpu.axon_guard import guard_dead_relay
+
+guard_dead_relay()
+
 # Benchmark shape: 256 shards x 2^20 columns = 268M columns per operand.
 # Each operand is a [shards, 2^15] uint32 tensor (32 MiB) resident in HBM.
 N_SHARDS = 256

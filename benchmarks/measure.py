@@ -92,6 +92,9 @@ def build_index(holder, name: str, n_shards: int, rows_per_field: int,
 
 
 def main():
+    from pilosa_tpu.axon_guard import guard_dead_relay
+
+    guard_dead_relay()
     import jax
 
     on_tpu = jax.devices()[0].platform == "tpu"

@@ -1,0 +1,43 @@
+"""Dead-relay fallback for axon-tunneled TPU environments.
+
+On axon hosts the TPU is reached through a relay process; if the relay
+dies, ANY jax backend init hangs forever on the registered PJRT plugin
+(even with JAX_PLATFORMS=cpu in the environment — the site hook
+registered the plugin at interpreter start).  `jax.config` wins any
+time before backend init, so entry points that must always complete
+(bench.py, __graft_entry__, benchmarks/measure.py) call
+``guard_dead_relay()`` before touching devices.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def guard_dead_relay() -> bool:
+    """When this process targets the axon backend but the relay is
+    gone, pin jax to CPU (announced on stderr) so the run completes
+    instead of hanging.  Returns True when the fallback engaged.  Does
+    nothing unless JAX_PLATFORMS is EXPLICITLY "axon" — on ordinary
+    TPU/GPU hosts the guard must never hide the real accelerator."""
+    if os.environ.get("JAX_PLATFORMS") != "axon":
+        return False
+    try:
+        out = subprocess.run(["pgrep", "-f", r"\.relay\.py"],
+                             capture_output=True, timeout=5)
+        alive = bool(out.stdout.strip())
+    except Exception as e:
+        print(f"axon_guard: pgrep failed ({e}); assuming relay dead",
+              file=sys.stderr)
+        alive = False
+    if alive:
+        return False
+    print("axon_guard: axon relay is not running; falling back to the "
+          "CPU backend (results are exact, timings are NOT chip "
+          "numbers)", file=sys.stderr)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True
