@@ -950,6 +950,25 @@ class Executor:
             else:
                 child_allowed.append(None)
 
+        # Fused-supported filters evaluate ONCE as a stacked device
+        # computation over the shards THIS node will scan (all of them
+        # single-node; the locally-owned group when clustered — the
+        # same local-group fusion Count/TopN get via local_batch_fn);
+        # map_fn slices its shard's row out of the stack instead of
+        # re-evaluating the filter tree per shard.
+        filt_stack = None
+        shard_pos: dict[int, int] = {}
+        if (filter_call is not None
+                and self._fuse_eligible(idx, shards, filter_call)):
+            if self._cluster_active(opt):
+                group = sorted(self.cluster.local_shards(idx.name, shards))
+            else:
+                group = list(shards)
+            if len(group) > 1:
+                shard_pos = {s: i for i, s in enumerate(group)}
+                filt_stack = self._fused_eval(idx, filter_call,
+                                              tuple(group))
+
         def map_fn(shard):
             import jax.numpy as jnp
 
@@ -979,7 +998,9 @@ class Executor:
             # live-group count is len(prefixes).  Padded garbage rows are
             # never read — counts are host-sliced to the live range.
             masks = None  # device [G_padded, words]; None = unconstrained
-            if filter_call is not None:
+            if filt_stack is not None and shard in shard_pos:
+                masks = filt_stack[shard_pos[shard]][None, :]
+            elif filter_call is not None:
                 base = self._bitmap_words_shard(idx, filter_call, shard)
                 if base is None:
                     return {}
