@@ -54,6 +54,12 @@ def _padded_rows(n: int) -> int:
         return n
     return ((n + n_dev - 1) // n_dev) * n_dev
 
+def _live(dev) -> bool:
+    from pilosa_tpu.runtime import residency
+
+    return residency.live(dev)
+
+
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
 # Internal names (the hidden existence field) carry a leading underscore and
 # bypass user-name validation, as in the reference (holder.go:46).
@@ -355,7 +361,7 @@ class Field:
         gens = tuple(0 if fr is None else fr._gen for fr in frags)
         with self._lock:
             hit = self._row_stack_cache.get(key)
-            if hit is not None and hit[0] == gens:
+            if hit is not None and hit[0] == gens and _live(hit[1]):
                 self._touch(self._row_stack_cache, key)
                 return hit[1]
         n_words = bm.n_words(SHARD_WIDTH)
@@ -413,7 +419,7 @@ class Field:
         gens = tuple(gens)
         with self._lock:
             hit = self._row_stack_cache.get(key)
-            if hit is not None and hit[0] == gens:
+            if hit is not None and hit[0] == gens and _live(hit[1]):
                 self._touch(self._row_stack_cache, key)
                 return hit[1]
         n_words = bm.n_words(SHARD_WIDTH)
@@ -503,7 +509,8 @@ class Field:
         gens = tuple(gens)
         with self._lock:
             hit = self._matrix_stack_cache.get(key)
-            if hit is not None and hit[0] == gens:
+            if (hit is not None and hit[0] == gens
+                    and (hit[4] is None or _live(hit[4]))):
                 self._touch(self._matrix_stack_cache, key)
                 return hit
         if not parts:
@@ -580,7 +587,7 @@ class Field:
         gens = tuple(0 if fr is None else fr._gen for fr in frags)
         with self._lock:
             hit = self._row_stack_cache.get(key)
-            if hit is not None and hit[0] == gens:
+            if hit is not None and hit[0] == gens and _live(hit[1]):
                 self._touch(self._row_stack_cache, key)
                 return hit[1]
         n_words = bm.n_words(SHARD_WIDTH)

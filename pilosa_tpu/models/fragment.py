@@ -566,7 +566,8 @@ class Fragment:
         with self._lock:
             key = "matrix"
             hit = self._device_cache.get(key)
-            if hit is not None and hit[0] == self._gen:
+            if (hit is not None and hit[0] == self._gen
+                    and residency.live(hit[2])):
                 residency.manager().touch(self._device_cache, key)
                 return hit[1], hit[2]
             ids, matrix = self._stacked()
@@ -596,7 +597,8 @@ class Fragment:
         with self._lock:
             key = ("planes", depth)
             hit = self._device_cache.get(key)
-            if hit is not None and hit[0] == self._gen:
+            if (hit is not None and hit[0] == self._gen
+                    and residency.live(hit[1])):
                 residency.manager().touch(self._device_cache, key)
                 return hit[1]
             P = np.zeros((bsi_ops.OFFSET_PLANE + depth, self.n_words), dtype=np.uint32)

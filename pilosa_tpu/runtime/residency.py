@@ -27,6 +27,17 @@ import os
 import threading
 
 
+def live(dev) -> bool:
+    """A cached device array can outlive its backend (jax
+    clear_backends — e.g. __graft_entry__'s virtual-mesh reset); a
+    deleted array must read as a cache miss, not a RuntimeError.
+    Shared by every device-tensor cache this manager accounts."""
+    try:
+        return not dev.is_deleted()
+    except Exception:
+        return True
+
+
 def _default_budget() -> int:
     env = os.environ.get("PILOSA_TPU_DEVICE_BUDGET_BYTES")
     if env:

@@ -95,3 +95,48 @@ def test_graft_dryrun_multichip(n):
 def test_mesh_too_many_devices():
     with pytest.raises(ValueError):
         pmesh.device_mesh(512)
+
+
+def test_row_stack_cache_survives_backend_reset(tmp_path):
+    """A backend reset (jax clear_backends — what dryrun_multichip does
+    when the live backend is incompatible) deletes every live device
+    array; the field stack caches must treat those as misses, not hand
+    back dead arrays."""
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.parallel.executor import Executor
+
+    holder = Holder(str(tmp_path / "h"))
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1, 1, 2], [3, 70000, 3])
+    ex = Executor(holder)
+    q = "Count(Intersect(Row(f=1), Row(f=2)))"
+    assert ex.execute("i", q)[0] == 1
+
+    # warm the fragment-level device caches too (TopN → device_matrix,
+    # and a BSI field → device_planes)
+    fi = idx.create_field("v", options=__import__(
+        "pilosa_tpu.models.field", fromlist=["FieldOptions"]
+    ).FieldOptions.int_field(0, 100))
+    fi.set_value(3, 7)
+    assert ex.execute("i", "Sum(field=v)")[0].val == 7
+    ex.execute("i", "TopN(f, n=2)")
+
+    # simulate the reset: delete every cached device buffer in place —
+    # field stack caches AND per-fragment device caches, as a real
+    # clear_backends would
+    caches = [f._row_stack_cache, f._matrix_stack_cache]
+    for fld in (f, fi):
+        for view in fld.views.values():
+            for frag in view.fragments.values():
+                caches.append(frag._device_cache)
+    for cache in caches:
+        for entry in cache.values():
+            for part in entry if isinstance(entry, tuple) else [entry]:
+                if hasattr(part, "is_deleted"):
+                    part.delete()
+
+    assert ex.execute("i", q)[0] == 1  # recomputes, no RuntimeError
+    assert ex.execute("i", "Sum(field=v)")[0].val == 7
+    ex.execute("i", "TopN(f, n=2)")
+    holder.close()
