@@ -303,6 +303,7 @@ class Field:
             self._save_shards()
 
     def _note_shard(self, shard: int) -> None:
+        shard = int(shard)  # numpy ints would poison the JSON .shards file
         with self._lock:
             if shard not in self._shards:
                 self._shards.add(shard)
@@ -387,9 +388,16 @@ class Field:
         mesh when more than one chip is visible, so XLA partitions the
         set algebra + reductions across chips with ICI collectives
         (SURVEY.md §7 step 4: the executor's shard batch IS the mesh's
-        data axis)."""
+        data axis).  On a single CPU device the stack stays a host
+        numpy array: every bm op dispatches host arrays to numpy + the
+        native popcount kernels (ops/hostkernels.py), which beat
+        XLA:CPU codegen ~8x at query shapes."""
         import jax
 
+        from pilosa_tpu.ops import bitmap as bm
+
+        if bm.host_mode():
+            return np.ascontiguousarray(stack)
         if len(jax.devices()) > 1:
             from pilosa_tpu.parallel import mesh as pmesh
 

@@ -571,7 +571,10 @@ class Fragment:
                 residency.manager().touch(self._device_cache, key)
                 return hit[1], hit[2]
             ids, matrix = self._stacked()
-            dev = jax.device_put(matrix)
+            from pilosa_tpu.ops import bitmap as bm
+
+            dev = (np.ascontiguousarray(matrix) if bm.host_mode()
+                   else jax.device_put(matrix))
             self._device_cache[key] = (self._gen, ids, dev)
             residency.manager().admit(self._device_cache, key,
                                       matrix.nbytes)
@@ -579,11 +582,13 @@ class Fragment:
 
     def device_row(self, row: int):
         """One row as a device array, sliced from the resident matrix."""
-        import jax.numpy as jnp
-
         ids, dev = self.device_matrix()
         slot = np.searchsorted(ids, row)
         if slot >= len(ids) or ids[slot] != row:
+            if isinstance(dev, np.ndarray):
+                return np.zeros(self.n_words, dtype=np.uint32)
+            import jax.numpy as jnp
+
             return jnp.zeros(self.n_words, dtype=jnp.uint32)
         return dev[int(slot)]
 
@@ -606,7 +611,9 @@ class Fragment:
                 arr = self._rows.get(i)
                 if arr is not None:
                     P[i] = arr
-            dev = jax.device_put(P)
+            from pilosa_tpu.ops import bitmap as bm
+
+            dev = P if bm.host_mode() else jax.device_put(P)
             self._device_cache[key] = (self._gen, dev)
             residency.manager().admit(self._device_cache, key, P.nbytes)
             return dev

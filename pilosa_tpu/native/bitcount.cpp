@@ -1,0 +1,87 @@
+// Host-side fused popcount kernels for the CPU execution engine.
+//
+// On TPU the set-algebra hot path is XLA (ops/bitmap.py jit kernels);
+// when the framework runs on a plain CPU host (relay down, laptop dev,
+// CI) the same ops dispatch here instead: single-pass AND+popcount with
+// no materialized intermediates, compiled -march=native so gcc lowers
+// __builtin_popcountll to POPCNT / AVX-512 VPOPCNTDQ where available.
+// This is the moral analog of the reference's hand-tuned container
+// fast paths (roaring/roaring.go:570 intersectionCount*) — the exact
+// counting loop a CPU should run, where XLA:CPU's generic codegen loses
+// to vectorized popcount by ~8x at bench shapes.
+//
+// Buffers arrive as raw bytes from numpy uint32 arrays (C-contiguous,
+// little-endian), processed as uint64 lanes with a uint32 tail — the
+// same reinterpret-cast equivalence the file codec relies on
+// (storage/roaring.py layout note).
+
+#include <cstdint>
+
+namespace {
+
+// Alias- and alignment-safe 8-byte load: row pointers into a [rows, n32]
+// uint32 matrix are only 4-byte aligned for odd n32 x odd row, and a
+// uint32->uint64 pointer pun is UB regardless; __builtin_memcpy folds to
+// a single unaligned vector load under -O3.
+inline uint64_t load64(const uint32_t* p) {
+    uint64_t v;
+    __builtin_memcpy(&v, p, 8);
+    return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Popcount of one buffer of n32 uint32 words.
+long long pt_count(const uint32_t* a, long long n32) {
+    long long n64 = n32 / 2, t = 0;
+    for (long long i = 0; i < n64; i++)
+        t += __builtin_popcountll(load64(a + 2 * i));
+    if (n32 & 1) t += __builtin_popcount(a[n32 - 1]);
+    return t;
+}
+
+// |a & b| fused: the north-star IntersectionCount.
+long long pt_count_and(const uint32_t* a, const uint32_t* b, long long n32) {
+    long long n64 = n32 / 2, t = 0;
+    for (long long i = 0; i < n64; i++)
+        t += __builtin_popcountll(load64(a + 2 * i) & load64(b + 2 * i));
+    if (n32 & 1) t += __builtin_popcount(a[n32 - 1] & b[n32 - 1]);
+    return t;
+}
+
+// out[r] = popcount(mat[r]) over a [rows, n32] matrix.
+void pt_row_counts(const uint32_t* mat, long long rows, long long n32,
+                   int32_t* out) {
+    for (long long r = 0; r < rows; r++)
+        out[r] = (int32_t)pt_count(mat + r * n32, n32);
+}
+
+// out[r] = |mat[r] & filt| (TopN/GroupBy inner loop).
+void pt_row_counts_masked(const uint32_t* mat, const uint32_t* filt,
+                          long long rows, long long n32, int32_t* out) {
+    for (long long r = 0; r < rows; r++)
+        out[r] = (int32_t)pt_count_and(mat + r * n32, filt, n32);
+}
+
+// out[r] = |mat[r] & filt_stack[pos[r]]| (fused cross-shard TopN scan).
+void pt_row_counts_gathered(const uint32_t* mat, const uint32_t* filt_stack,
+                            const int32_t* pos, long long rows, long long n32,
+                            int32_t* out) {
+    for (long long r = 0; r < rows; r++)
+        out[r] = (int32_t)pt_count_and(mat + r * n32,
+                                       filt_stack + (long long)pos[r] * n32,
+                                       n32);
+}
+
+// out[g*rows + r] = |mat[r] & masks[g]| (GroupBy cartesian product).
+void pt_masked_matrix_counts(const uint32_t* mat, const uint32_t* masks,
+                             long long groups, long long rows, long long n32,
+                             int32_t* out) {
+    for (long long g = 0; g < groups; g++)
+        pt_row_counts_masked(mat, masks + g * n32, rows, n32,
+                             out + g * rows);
+}
+
+}  // extern "C"

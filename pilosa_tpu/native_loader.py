@@ -19,10 +19,12 @@ class NativeLib:
     """Lazily-built shared library.  `setup(lib)` declares the ctypes
     signatures after a successful load."""
 
-    def __init__(self, src: str, so: str, setup):
+    def __init__(self, src: str, so: str, setup,
+                 extra_flags: tuple[str, ...] = ()):
         self.src = src
         self.so = so
         self.setup = setup
+        self.extra_flags = tuple(extra_flags)
         self._lib = None
         self._failed = False
         self._lock = threading.Lock()
@@ -38,7 +40,7 @@ class NativeLib:
         try:
             subprocess.run(
                 ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-                 "-o", tmp, self.src],
+                 *self.extra_flags, "-o", tmp, self.src],
                 check=True, capture_output=True)
             os.replace(tmp, self.so)
         finally:

@@ -42,6 +42,25 @@ def n_words(nbits: int) -> int:
     return nbits // WORD_BITS
 
 
+def host_mode() -> bool:
+    """True when compute should stay host-resident: a single CPU device
+    means XLA buys no parallelism here, and the native popcount kernels
+    (ops/hostkernels.py) beat XLA:CPU codegen by ~8x at query shapes.
+    Placement (Field._place_on_devices, Fragment.device_*) consults this
+    once per stack build; every op below then dispatches on operand
+    type, so host stacks flow through numpy + native C++ and device
+    stacks through the jit kernels."""
+    import jax
+
+    devs = jax.devices()
+    return len(devs) == 1 and devs[0].platform == "cpu"
+
+
+def _host(*xs) -> bool:
+    """Dispatch predicate: all array operands are host numpy arrays."""
+    return all(isinstance(x, np.ndarray) for x in xs)
+
+
 # ---------------------------------------------------------------------------
 # Host-side packing (numpy) — the boundary between sparse positions arriving
 # over the wire and dense device tensors.
@@ -92,34 +111,64 @@ def pack_positions_matrix(rows_cols, row_ids, nbits: int) -> np.ndarray:
 
 
 @jax.jit
-def b_and(a, b):
-    """Intersect (roaring.Intersect, roaring/roaring.go:595)."""
+def _jit_and(a, b):
     return jnp.bitwise_and(a, b)
 
 
+def b_and(a, b):
+    """Intersect (roaring.Intersect, roaring/roaring.go:595)."""
+    if _host(a, b):
+        return np.bitwise_and(a, b)
+    return _jit_and(a, b)
+
+
 @jax.jit
-def b_or(a, b):
-    """Union (roaring.Union, roaring/roaring.go:620)."""
+def _jit_or(a, b):
     return jnp.bitwise_or(a, b)
 
 
+def b_or(a, b):
+    """Union (roaring.Union, roaring/roaring.go:620)."""
+    if _host(a, b):
+        return np.bitwise_or(a, b)
+    return _jit_or(a, b)
+
+
 @jax.jit
-def b_xor(a, b):
-    """Symmetric difference (roaring.Xor, roaring/roaring.go:918)."""
+def _jit_xor(a, b):
     return jnp.bitwise_xor(a, b)
 
 
+def b_xor(a, b):
+    """Symmetric difference (roaring.Xor, roaring/roaring.go:918)."""
+    if _host(a, b):
+        return np.bitwise_xor(a, b)
+    return _jit_xor(a, b)
+
+
 @jax.jit
-def b_andnot(a, b):
-    """Difference a \\ b (roaring.Difference, roaring/roaring.go:891)."""
+def _jit_andnot(a, b):
     return jnp.bitwise_and(a, jnp.bitwise_not(b))
 
 
+def b_andnot(a, b):
+    """Difference a \\ b (roaring.Difference, roaring/roaring.go:891)."""
+    if _host(a, b):
+        return np.bitwise_and(a, np.bitwise_not(b))
+    return _jit_andnot(a, b)
+
+
 @jax.jit
+def _jit_not(a, existence):
+    return jnp.bitwise_and(jnp.bitwise_not(a), existence)
+
+
 def b_not(a, existence):
     """Complement within an existence mask (executor Not uses the index's
     existence row as the universe, executor.go:1708)."""
-    return jnp.bitwise_and(jnp.bitwise_not(a), existence)
+    if _host(a, existence):
+        return np.bitwise_and(np.bitwise_not(a), existence)
+    return _jit_not(a, existence)
 
 
 @functools.lru_cache(maxsize=256)
@@ -138,14 +187,35 @@ def _range_mask_np(nwords: int, start: int, end: int) -> np.ndarray:
 def b_flip_range(a, start: int, end: int):
     """Flip bits in [start, end) (roaring.Flip, roaring/roaring.go:1683)."""
     mask = _range_mask_np(a.shape[-1], start, end)
+    if _host(a):
+        return np.bitwise_xor(a, mask)
     return b_xor(a, jnp.asarray(mask))
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
 def b_shift(a, n: int = 1):
     """Shift all bits toward higher columns by ``n`` (roaring.Shift,
     roaring/roaring.go:946).  Bits shifted past the shard width are dropped,
     matching per-shard Shift execution (executor.go:1730)."""
+    if n < 0:
+        raise ValueError("shift distance must be non-negative")
+    if _host(a):
+        if n == 0:
+            return a
+        w, sh = n // WORD_BITS, n % WORD_BITS
+        nw = a.shape[-1]
+        if w >= nw:
+            return np.zeros_like(a)
+        pad = [(0, 0)] * (a.ndim - 1)
+        shifted = np.pad(a, pad + [(w, 0)])[..., :nw]
+        if sh == 0:
+            return shifted
+        prev = np.pad(shifted, pad + [(1, 0)])[..., :nw]
+        return (shifted << np.uint32(sh)) | (prev >> np.uint32(WORD_BITS - sh))
+    return _jit_shift(a, n)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _jit_shift(a, n: int = 1):
     if n < 0:
         # a clean error instead of a cryptic negative-pad failure from
         # inside jit tracing; surfaces as a 400 at the query layer
@@ -173,32 +243,56 @@ def b_shift(a, n: int = 1):
 
 
 @jax.jit
-def popcount(a):
-    """Total set bits, int32 scalar (roaring.Count, roaring/roaring.go:478)."""
+def _jit_popcount(a):
     return jnp.sum(lax.population_count(a), dtype=jnp.int32)
 
 
+def popcount(a):
+    """Total set bits (roaring.Count, roaring/roaring.go:478) — int32
+    scalar on device, Python int on host stacks (native kernel)."""
+    if _host(a):
+        from pilosa_tpu.ops import hostkernels as hk
+
+        return hk.count(a)
+    return _jit_popcount(a)
+
+
 @jax.jit
-def popcount_and(a, b):
-    """Fused |a & b| — the north-star IntersectionCount fast path
-    (roaring.IntersectionCount, roaring/roaring.go:570) as one XLA kernel:
-    AND + popcount + reduce, no intermediate materialized."""
+def _jit_popcount_and(a, b):
     return jnp.sum(lax.population_count(jnp.bitwise_and(a, b)), dtype=jnp.int32)
 
 
+def popcount_and(a, b):
+    """Fused |a & b| — the north-star IntersectionCount fast path
+    (roaring.IntersectionCount, roaring/roaring.go:570): one XLA kernel
+    on device (AND + popcount + reduce, no intermediate materialized),
+    one C++ pass on host stacks."""
+    if _host(a, b):
+        from pilosa_tpu.ops import hostkernels as hk
+
+        return hk.count_and(a, b)
+    return _jit_popcount_and(a, b)
+
+
 @jax.jit
+def _jit_row_counts(mat):
+    return jnp.sum(lax.population_count(mat), axis=-1, dtype=jnp.int32)
+
+
 def row_counts(mat):
     """Per-row popcounts of a [rows, words] matrix -> int32[rows].
 
     The batched scan under TopN (fragment.top, fragment.go:1570) — one
     device-wide reduction instead of a per-row heap walk."""
-    return jnp.sum(lax.population_count(mat), axis=-1, dtype=jnp.int32)
+    if _host(mat):
+        from pilosa_tpu.ops import hostkernels as hk
+
+        return hk.row_counts(mat)
+    return _jit_row_counts(mat)
 
 
 @jax.jit
-def row_counts_masked(mat, filt):
-    """Per-row |row & filter| -> int32[rows]; TopN-with-filter / GroupBy
-    inner loop (fragment.go:1600, groupByIterator executor.go:3058)."""
+def _jit_row_counts_masked(mat, filt):
     return jnp.sum(
         lax.population_count(jnp.bitwise_and(mat, filt[None, :])),
         axis=-1,
@@ -206,8 +300,28 @@ def row_counts_masked(mat, filt):
     )
 
 
-@jax.jit
+def row_counts_masked(mat, filt):
+    """Per-row |row & filter| -> int32[rows]; TopN-with-filter / GroupBy
+    inner loop (fragment.go:1600, groupByIterator executor.go:3058)."""
+    if _host(mat, filt):
+        from pilosa_tpu.ops import hostkernels as hk
+
+        return hk.row_counts_masked(mat, filt)
+    return _jit_row_counts_masked(mat, filt)
+
+
 def row_counts_gathered(mat, filt_stack, shard_pos):
+    """Per-row |mat[r] & filt_stack[shard_pos[r]]| -> int32[rows]; see
+    _jit_row_counts_gathered for the device story."""
+    if _host(mat, filt_stack):
+        from pilosa_tpu.ops import hostkernels as hk
+
+        return hk.row_counts_gathered(mat, filt_stack, np.asarray(shard_pos))
+    return _jit_row_counts_gathered(mat, filt_stack, shard_pos)
+
+
+@jax.jit
+def _jit_row_counts_gathered(mat, filt_stack, shard_pos):
     """Per-row |mat[r] & filt_stack[shard_pos[r]]| -> int32[rows].
 
     The fused cross-shard TopN scan: row matrices from many fragments
@@ -223,19 +337,37 @@ def row_counts_gathered(mat, filt_stack, shard_pos):
     )
 
 
-@jax.jit
 def masked_matrix_counts(mat, masks):
+    """counts[g, r] = |mat[r] & masks[g]| -> int32[G, rows]; see
+    _jit_masked_matrix_counts for the device story."""
+    if _host(mat, masks):
+        from pilosa_tpu.ops import hostkernels as hk
+
+        return hk.masked_matrix_counts(mat, masks)
+    return _jit_masked_matrix_counts(mat, masks)
+
+
+@jax.jit
+def _jit_masked_matrix_counts(mat, masks):
     """counts[g, r] = |mat[r] & masks[g]| -> int32[G, rows].
 
     The GroupBy inner product (groupByIterator, executor.go:3058): every
     group mask against every child row in ONE dispatch.  lax.map keeps
     the [G, rows, words] intermediate out of memory — each step is a
     fused row_counts_masked."""
-    return lax.map(lambda m: row_counts_masked(mat, m), masks)
+    return lax.map(lambda m: _jit_row_counts_masked(mat, m), masks)
+
+
+def and_pairs(mat, masks, slots, group_idx):
+    """out[p] = mat[slots[p]] & masks[group_idx[p]]; see _jit_and_pairs."""
+    if _host(mat, masks):
+        return np.bitwise_and(np.take(mat, np.asarray(slots), axis=0),
+                              np.take(masks, np.asarray(group_idx), axis=0))
+    return _jit_and_pairs(mat, masks, slots, group_idx)
 
 
 @jax.jit
-def and_pairs(mat, masks, slots, group_idx):
+def _jit_and_pairs(mat, masks, slots, group_idx):
     """out[p] = mat[slots[p]] & masks[group_idx[p]] -> uint32[P, words].
 
     Builds the next GroupBy level's group masks for every surviving
@@ -252,23 +384,47 @@ def and_pairs(mat, masks, slots, group_idx):
 
 
 @jax.jit
-def set_bits(words, idx, or_vals):
-    """OR ``or_vals`` into ``words`` at unique ``idx`` (fragment setBit batch
-    apply; mirrors the opN batch design of fragment.go:84,2296)."""
+def _jit_set_bits(words, idx, or_vals):
     return words.at[idx].set(words[idx] | or_vals)
 
 
+def set_bits(words, idx, or_vals):
+    """OR ``or_vals`` into ``words`` at unique ``idx`` (fragment setBit batch
+    apply; mirrors the opN batch design of fragment.go:84,2296)."""
+    if _host(words):
+        out = words.copy()
+        out[np.asarray(idx)] |= np.asarray(or_vals)
+        return out
+    return _jit_set_bits(words, idx, or_vals)
+
+
 @jax.jit
-def clear_bits(words, idx, andnot_vals):
-    """Clear bits given per-word masks of bits to remove."""
+def _jit_clear_bits(words, idx, andnot_vals):
     return words.at[idx].set(words[idx] & ~andnot_vals)
 
 
+def clear_bits(words, idx, andnot_vals):
+    """Clear bits given per-word masks of bits to remove."""
+    if _host(words):
+        out = words.copy()
+        out[np.asarray(idx)] &= ~np.asarray(andnot_vals)
+        return out
+    return _jit_clear_bits(words, idx, andnot_vals)
+
+
 @jax.jit
-def get_bits(words, positions):
-    """Read individual bits -> int32[len(positions)] of 0/1."""
+def _jit_get_bits(words, positions):
     w = words[positions // WORD_BITS]
     return ((w >> (positions % WORD_BITS).astype(jnp.uint32)) & 1).astype(jnp.int32)
+
+
+def get_bits(words, positions):
+    """Read individual bits -> int32[len(positions)] of 0/1."""
+    if _host(words):
+        pos = np.asarray(positions)
+        w = words[pos // WORD_BITS]
+        return ((w >> (pos % WORD_BITS).astype(np.uint32)) & 1).astype(np.int32)
+    return _jit_get_bits(words, positions)
 
 
 # ---------------------------------------------------------------------------
@@ -278,12 +434,24 @@ def get_bits(words, positions):
 
 
 @jax.jit
-def reduce_or_rows(mat):
-    """OR-reduce a [rows, words] matrix -> [words]."""
+def _jit_reduce_or_rows(mat):
     return lax.reduce(mat, np.uint32(0), lax.bitwise_or, (0,))
 
 
+def reduce_or_rows(mat):
+    """OR-reduce a [rows, words] matrix -> [words]."""
+    if _host(mat):
+        return np.bitwise_or.reduce(mat, axis=0)
+    return _jit_reduce_or_rows(mat)
+
+
 @jax.jit
+def _jit_reduce_and_rows(mat):
+    return lax.reduce(mat, np.uint32(0xFFFFFFFF), lax.bitwise_and, (0,))
+
+
 def reduce_and_rows(mat):
     """AND-reduce a [rows, words] matrix -> [words]."""
-    return lax.reduce(mat, np.uint32(0xFFFFFFFF), lax.bitwise_and, (0,))
+    if _host(mat):
+        return np.bitwise_and.reduce(mat, axis=0)
+    return _jit_reduce_and_rows(mat)

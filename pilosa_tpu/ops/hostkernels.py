@@ -1,0 +1,168 @@
+"""Native host popcount kernels — the CPU half of the execution engine.
+
+When the framework runs without an accelerator (relay down, CI, laptop)
+the fused query pipeline keeps operand stacks host-resident as numpy
+arrays and counts them here: single-pass AND+popcount in C++
+(native/bitcount.cpp, compiled -march=native → AVX-512 VPOPCNTDQ on
+capable hosts), no intermediates.  The role the reference's per-container
+fast paths play on CPU (roaring/roaring.go:570 intersectionCount*).
+
+Every function falls back to vectorized numpy (np.bitwise_count) when
+the native library is unavailable, so behavior is identical everywhere —
+only speed differs.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from pilosa_tpu.native_loader import NativeLib
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+
+
+def _isa_tag() -> str:
+    """Short hash of the host's CPU feature flags, embedded in the .so
+    name.  -march=native binaries are host-specific; a checkout reused
+    on a different CPU (NFS, baked image) must rebuild rather than
+    SIGILL on the first AVX-512 instruction — dlopen alone can't catch
+    an ISA mismatch."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    return hashlib.sha1(line.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform
+
+    return hashlib.sha1(platform.processor().encode()).hexdigest()[:8]
+
+
+def _setup(lib) -> None:
+    LL, VP, IP = ctypes.c_longlong, ctypes.c_void_p, ctypes.c_void_p
+    lib.pt_count.restype = LL
+    lib.pt_count.argtypes = [VP, LL]
+    lib.pt_count_and.restype = LL
+    lib.pt_count_and.argtypes = [VP, VP, LL]
+    lib.pt_row_counts.restype = None
+    lib.pt_row_counts.argtypes = [VP, LL, LL, IP]
+    lib.pt_row_counts_masked.restype = None
+    lib.pt_row_counts_masked.argtypes = [VP, VP, LL, LL, IP]
+    lib.pt_row_counts_gathered.restype = None
+    lib.pt_row_counts_gathered.argtypes = [VP, VP, IP, LL, LL, IP]
+    lib.pt_masked_matrix_counts.restype = None
+    lib.pt_masked_matrix_counts.argtypes = [VP, VP, LL, LL, LL, IP]
+
+
+_NATIVE = NativeLib(
+    src=os.path.join(_NATIVE_DIR, "bitcount.cpp"),
+    so=os.path.join(_NATIVE_DIR, "build",
+                    f"libpilosa_bitcount.{_isa_tag()}.so"),
+    setup=_setup,
+    # -march=native: built lazily on the host that runs it; the ISA tag
+    # in the filename forces a rebuild on any other CPU
+    extra_flags=("-march=native", "-funroll-loops"),
+)
+
+
+def native_available() -> bool:
+    return _NATIVE.available()
+
+
+def _c(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a)
+
+
+def count(a: np.ndarray) -> int:
+    """Total set bits of a uint32 array (any shape)."""
+    lib = _NATIVE.load()
+    if lib is None:
+        return int(np.bitwise_count(a).sum(dtype=np.uint64))
+    a = _c(a)
+    return int(lib.pt_count(a.ctypes.data, a.size))
+
+
+def count_and(a: np.ndarray, b: np.ndarray) -> int:
+    """|a & b| without materializing the intersection."""
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch: {a.shape} vs {b.shape}")
+    lib = _NATIVE.load()
+    if lib is None:
+        return int(np.bitwise_count(a & b).sum(dtype=np.uint64))
+    a, b = _c(a), _c(b)
+    return int(lib.pt_count_and(a.ctypes.data, b.ctypes.data, a.size))
+
+
+def row_counts(mat: np.ndarray) -> np.ndarray:
+    """int32[rows] popcounts of a [rows, words] matrix (stacks flatten
+    leading dims: a [shards, rows, words] input counts per (shard,row))."""
+    lead = mat.shape[:-1]
+    rows = int(np.prod(lead)) if lead else 1
+    words = mat.shape[-1]
+    lib = _NATIVE.load()
+    if lib is None:
+        return np.bitwise_count(mat).sum(axis=-1).astype(np.int32)
+    mat = _c(mat)
+    out = np.empty(lead, dtype=np.int32)
+    lib.pt_row_counts(mat.ctypes.data, rows, words, out.ctypes.data)
+    return out
+
+
+def row_counts_masked(mat: np.ndarray, filt: np.ndarray) -> np.ndarray:
+    """int32[rows] of |mat[r] & filt|."""
+    if mat.shape[-1] != filt.shape[-1]:
+        raise ValueError(f"word-count mismatch: {mat.shape} vs {filt.shape}")
+    lib = _NATIVE.load()
+    if lib is None:
+        return np.bitwise_count(mat & filt[None, :]).sum(axis=-1).astype(np.int32)
+    mat, filt = _c(mat), _c(filt)
+    rows, words = mat.shape
+    out = np.empty(rows, dtype=np.int32)
+    lib.pt_row_counts_masked(mat.ctypes.data, filt.ctypes.data,
+                             rows, words, out.ctypes.data)
+    return out
+
+
+def row_counts_gathered(mat: np.ndarray, filt_stack: np.ndarray,
+                        shard_pos: np.ndarray) -> np.ndarray:
+    """int32[rows] of |mat[r] & filt_stack[shard_pos[r]]|."""
+    pos = np.ascontiguousarray(shard_pos, dtype=np.int32)
+    if mat.shape[-1] != filt_stack.shape[-1]:
+        raise ValueError(
+            f"word-count mismatch: {mat.shape} vs {filt_stack.shape}")
+    if pos.size and (pos.min() < 0 or pos.max() >= len(filt_stack)):
+        raise IndexError("shard_pos out of range")
+    lib = _NATIVE.load()
+    if lib is None:
+        filt = filt_stack[pos]
+        return np.bitwise_count(mat & filt).sum(axis=-1).astype(np.int32)
+    mat, filt_stack = _c(mat), _c(filt_stack)
+    rows, words = mat.shape
+    out = np.empty(rows, dtype=np.int32)
+    lib.pt_row_counts_gathered(mat.ctypes.data, filt_stack.ctypes.data,
+                               pos.ctypes.data, rows, words, out.ctypes.data)
+    return out
+
+
+def masked_matrix_counts(mat: np.ndarray, masks: np.ndarray) -> np.ndarray:
+    """int32[groups, rows] of |mat[r] & masks[g]|."""
+    if mat.shape[-1] != masks.shape[-1]:
+        raise ValueError(f"word-count mismatch: {mat.shape} vs {masks.shape}")
+    lib = _NATIVE.load()
+    if lib is None:
+        return np.bitwise_count(
+            mat[None, :, :] & masks[:, None, :]).sum(axis=-1).astype(np.int32)
+    mat, masks = _c(mat), _c(masks)
+    rows, words = mat.shape
+    groups = masks.shape[0]
+    out = np.empty((groups, rows), dtype=np.int32)
+    lib.pt_masked_matrix_counts(mat.ctypes.data, masks.ctypes.data,
+                                groups, rows, words, out.ctypes.data)
+    return out

@@ -998,13 +998,19 @@ class Executor:
             # live-group count is len(prefixes).  Padded garbage rows are
             # never read — counts are host-sliced to the live range.
             masks = None  # device [G_padded, words]; None = unconstrained
+            host = isinstance(mats[0][2], np.ndarray) if mats else False
             if filt_stack is not None and shard in shard_pos:
                 masks = filt_stack[shard_pos[shard]][None, :]
             elif filter_call is not None:
                 base = self._bitmap_words_shard(idx, filter_call, shard)
                 if base is None:
                     return {}
-                masks = jnp.asarray(base)[None, :]
+                # keep the filter on the same engine as the child
+                # matrices: numpy in host mode (so masked_matrix_counts
+                # / and_pairs dispatch to the native kernels), jax on
+                # device
+                masks = (np.asarray(base)[None, :] if host
+                         else jnp.asarray(base)[None, :])
             for level, (fname, row_ids, matrix) in enumerate(mats):
                 last = level == len(mats) - 1
                 if masks is None:
@@ -1031,13 +1037,13 @@ class Executor:
                 slots = np.zeros(pp, dtype=np.int32)
                 slots[:p] = nz_r
                 if masks is None:
-                    new_masks = jnp.take(matrix, jnp.asarray(slots), axis=0)
+                    new_masks = (np.take(matrix, slots, axis=0) if host
+                                 else jnp.take(matrix, jnp.asarray(slots),
+                                               axis=0))
                 else:
                     gsel = np.zeros(pp, dtype=np.int32)
                     gsel[:p] = nz_g
-                    new_masks = bm.and_pairs(matrix, masks,
-                                             jnp.asarray(slots),
-                                             jnp.asarray(gsel))
+                    new_masks = bm.and_pairs(matrix, masks, slots, gsel)
                 prefixes, masks = new_prefixes, new_masks
             return {}
 
