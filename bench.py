@@ -42,10 +42,16 @@ DENSITY = 0.08  # fraction of bits set; typical set-field fragment occupancy
 # Peak HBM bandwidth by TPU generation, GB/s (public figures; used only
 # for the utilization ratio on real chips).
 _PEAK_GBPS = {
-    "v4": 1228.0,
+    # order matters: first match wins, most specific first.  JAX reports
+    # v5e as "TPU v5 lite" and v6e as "TPU v6 lite" (normalized below to
+    # "tpuv5lite"/"tpuv6lite"), hence the *lite aliases.
+    "v5lite": 819.0,
+    "v6lite": 1640.0,
     "v5e": 819.0,
-    "v5p": 2765.0,
     "v6e": 1640.0,
+    "v5p": 2765.0,
+    "v5": 2765.0,   # bare "TPU v5" = v5p
+    "v4": 1228.0,
 }
 
 

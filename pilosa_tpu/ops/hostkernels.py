@@ -157,8 +157,13 @@ def masked_matrix_counts(mat: np.ndarray, masks: np.ndarray) -> np.ndarray:
         raise ValueError(f"word-count mismatch: {mat.shape} vs {masks.shape}")
     lib = _NATIVE.load()
     if lib is None:
-        return np.bitwise_count(
-            mat[None, :, :] & masks[:, None, :]).sum(axis=-1).astype(np.int32)
+        # per-mask loop bounds memory at O(rows*words), like the native
+        # kernel and the jit lax.map — a broadcast would materialize a
+        # [groups, rows, words] intermediate
+        return np.stack([
+            np.bitwise_count(mat & m).sum(axis=-1).astype(np.int32)
+            for m in masks]) if len(masks) else np.empty(
+                (0, mat.shape[0]), dtype=np.int32)
     mat, masks = _c(mat), _c(masks)
     rows, words = mat.shape
     groups = masks.shape[0]
