@@ -125,6 +125,58 @@ def main():
                 "value": round(p2, 2), "unit": "ms",
                 "cols": n_shards * SHARD_WIDTH})
 
+    # ---- config 2b: ≥1B-column index through the product path, with
+    # the residency manager under genuine pressure.  1024 shards at the
+    # default 2^20 shard width = 1.07B columns; each row stack is a
+    # [1024, 32768] uint32 (128 MiB), and the budget below holds ~3 of
+    # them, so cycling 6 rows evicts constantly while every count must
+    # stay exact (the two-tier residency design of SURVEY.md §7's risk
+    # register: eviction may cost warmth, never correctness).
+    from pilosa_tpu.runtime import residency
+
+    scale_shards = max(1024, -(-(1 << 30) // SHARD_WIDTH))  # >= 1.07B cols
+    scale_cols = scale_shards * SHARD_WIDTH
+    srng = random.Random(7)
+    scale_bits: dict[int, set] = {}
+    sidx = holder.create_index("scale")
+    sf = sidx.create_field("f")
+    rows_l: list[int] = []
+    cols_l: list[int] = []
+    prev: list[int] = []
+    for row in range(6):
+        cs = [srng.randrange(scale_cols) for _ in range(30_000)]
+        cs += prev[:6_000]  # overlap with the previous row
+        prev = cs
+        scale_bits[row] = set(cs)
+        rows_l += [row] * len(cs)
+        cols_l += cs
+    sf.import_bits(rows_l, cols_l)
+
+    stack_bytes = scale_shards * (SHARD_WIDTH // 8)
+    # shrink the budget on the LIVE manager: a reset() would orphan the
+    # entries configs 1-2 already admitted (they would become untracked
+    # and unevictable for the rest of the run)
+    mgr = residency.manager()
+    old_budget = mgr.budget
+    mgr.budget = 3 * stack_bytes + stack_bytes // 2
+    ev0 = mgr.evictions
+    lat = []
+    for i in range(8):
+        a, b = i % 5, i % 5 + 1
+        t0 = _now()
+        got = ex.execute("scale", f"Count(Intersect(Row(f={a}), Row(f={b})))")[0]
+        lat.append((_now() - t0) * 1e3)
+        want = len(scale_bits[a] & scale_bits[b])
+        assert got == want, f"scale mismatch r{a}&r{b}: {got} != {want}"
+    evictions = mgr.evictions - ev0
+    assert evictions > 0, "budget never forced an eviction — not a thrash run"
+    mgr.budget = old_budget  # restore for the configs below
+    out.append({"config": 2, "metric": "intersect_count_p50_ms_1B_cols",
+                "value": round(statistics.median(lat), 1), "unit": "ms",
+                "cols": scale_cols, "evictions": evictions,
+                "exact": True})
+    holder.delete_index("scale")
+
     # ---- config 3: TopN(n=100) with BSI range filter p50
     q3 = "TopN(f, Row(v > 524288), n=100)"
     p3 = timed_p50_ms(lambda: ex.execute("b", q3))

@@ -828,9 +828,19 @@ class Field:
         P = self.device_plane_stack(shards)
         plan = self._classify_range(op, value)
         if plan[0] == "empty":
+            if isinstance(P, np.ndarray):
+                return np.zeros(P.shape[::2], dtype=np.uint32)
             return jnp.zeros(P.shape[::2], dtype=jnp.uint32)
         if plan[0] == "not_null":
             return P[:, bsi_ops.EXISTS_PLANE]
+        if isinstance(P, np.ndarray):
+            # host engine: the per-shard loop stays in numpy + native
+            # kernels — a vmap here would ship the whole plane stack
+            # into XLA on every query
+            fn = ((lambda Ps: bsi_ops.between_words(Ps, plan[1], plan[2]))
+                  if plan[0] == "between" else
+                  (lambda Ps: bsi_ops.range_words(Ps, plan[1], plan[2])))
+            return np.stack([fn(P[i]) for i in range(P.shape[0])])
         if plan[0] == "between":
             return jax.vmap(
                 lambda Ps: bsi_ops.between_words(Ps, plan[1], plan[2]))(P)

@@ -56,8 +56,26 @@ def _pred_bit_mask(lo, hi, i: int):
     return jnp.uint32(0) - bit  # 0xFFFFFFFF or 0
 
 
-@jax.jit
 def compare(P, filt, lo, hi):
+    """Dispatcher: host numpy plane stacks run the loop in numpy (the
+    CPU engine — no per-query host->device copy), device stacks jit."""
+    if isinstance(P, np.ndarray) and isinstance(filt, np.ndarray):
+        depth = P.shape[0] - OFFSET_PLANE
+        lt = np.zeros_like(filt)
+        eq = filt
+        for i in range(depth - 1, -1, -1):
+            plane = P[OFFSET_PLANE + i]
+            limb, off = (lo, i) if i < 32 else (hi, i - 32)
+            bit = (np.uint32(limb) >> np.uint32(off)) & np.uint32(1)
+            bmask = np.uint32(0xFFFFFFFF) if bit else np.uint32(0)
+            lt = lt | (eq & ~plane & bmask)
+            eq = eq & (plane ^ ~bmask)
+        return lt, eq
+    return _jit_compare(P, filt, lo, hi)
+
+
+@jax.jit
+def _jit_compare(P, filt, lo, hi):
     """One pass down the planes -> (lt, eq) masks within ``filt``.
 
     lt = columns whose magnitude < predicate; eq = columns equal to it.
@@ -77,8 +95,22 @@ def compare(P, filt, lo, hi):
     return lt, eq
 
 
-@jax.jit
 def plane_counts(P, consider):
+    """Dispatcher (see compare)."""
+    if isinstance(P, np.ndarray) and isinstance(consider, np.ndarray):
+        from pilosa_tpu.ops import hostkernels as hk
+
+        sign = P[SIGN_PLANE]
+        prow = consider & ~sign
+        nrow = consider & sign
+        planes = np.ascontiguousarray(P[OFFSET_PLANE:])
+        return (hk.row_counts_masked(planes, prow),
+                hk.row_counts_masked(planes, nrow))
+    return _jit_plane_counts(P, consider)
+
+
+@jax.jit
+def _jit_plane_counts(P, consider):
     """Per-plane intersection counts split by sign -> (pos, neg) int32[depth].
 
     Sum = sum_i (1<<i) * (pos_i - neg_i), assembled host-side with exact
@@ -92,8 +124,31 @@ def plane_counts(P, consider):
     return pos, neg
 
 
-@jax.jit
 def plane_counts_stacked(P, consider):
+    """Dispatcher (see compare)."""
+    if isinstance(P, np.ndarray) and isinstance(consider, np.ndarray):
+        from pilosa_tpu.ops import hostkernels as hk
+
+        S, nplanes, _words = P.shape
+        depth = nplanes - OFFSET_PLANE
+        sign = P[:, SIGN_PLANE]
+        prow = consider & ~sign
+        nrow = consider & sign
+        pos = np.empty((S, depth), dtype=np.int32)
+        neg = np.empty((S, depth), dtype=np.int32)
+        # per-shard slices of a C-contiguous P are themselves contiguous,
+        # so this loop is copy-free (a flattened P[:, OFFSET_PLANE:]
+        # would memcpy the whole magnitude stack every query)
+        for i in range(S):
+            planes = P[i, OFFSET_PLANE:]
+            pos[i] = hk.row_counts_masked(planes, prow[i])
+            neg[i] = hk.row_counts_masked(planes, nrow[i])
+        return pos, neg, hk.row_counts(consider)
+    return _jit_plane_counts_stacked(P, consider)
+
+
+@jax.jit
+def _jit_plane_counts_stacked(P, consider):
     """Batched plane counts over a [shards, planes, words] stack ->
     (pos int32[S, depth], neg int32[S, depth], count int32[S]).
 
@@ -113,8 +168,60 @@ def plane_counts_stacked(P, consider):
     return pos, neg, count
 
 
-@functools.partial(jax.jit, static_argnames=("want",))
 def extremes_stacked(P, consider, want: str):
+    """Dispatcher (see compare)."""
+    if isinstance(P, np.ndarray) and isinstance(consider, np.ndarray):
+        from pilosa_tpu.ops import hostkernels as hk
+
+        S = P.shape[0]
+        sign = P[:, SIGN_PLANE]
+        selected = consider & sign if want == "min" else consider & ~sign
+        signed_cnt = hk.row_counts(selected)
+        all_cnt = hk.row_counts(consider)
+        pt, pn, ft, fn = [], [], [], []
+        for s_i in range(S):
+            t, n = _np_extreme_max(P[s_i], selected[s_i])
+            pt.append(t)
+            pn.append(n)
+            t, n = _np_extreme_min(P[s_i], consider[s_i])
+            ft.append(t)
+            fn.append(n)
+        return (signed_cnt, all_cnt, np.stack(pt), np.stack(ft),
+                np.array(pn, dtype=np.int32), np.array(fn, dtype=np.int32))
+    return _jit_extremes_stacked(P, consider, want)
+
+
+def _np_extreme_max(P, filt):
+    """Host mirror of extreme_max: keep filt when a plane has no bits."""
+    from pilosa_tpu.ops import hostkernels as hk
+
+    depth = P.shape[0] - OFFSET_PLANE
+    taken = np.zeros(depth, dtype=np.int32)
+    for i in range(depth - 1, -1, -1):
+        row = P[OFFSET_PLANE + i] & filt
+        if hk.count(row) > 0:
+            taken[i] = 1
+            filt = row
+    return taken, np.int32(hk.count(filt))
+
+
+def _np_extreme_min(P, filt):
+    """Host mirror of extreme_min."""
+    from pilosa_tpu.ops import hostkernels as hk
+
+    depth = P.shape[0] - OFFSET_PLANE
+    taken = np.zeros(depth, dtype=np.int32)
+    for i in range(depth - 1, -1, -1):
+        without = filt & ~P[OFFSET_PLANE + i]
+        if hk.count(without) > 0:
+            filt = without
+        else:
+            taken[i] = 1
+    return taken, np.int32(hk.count(filt))
+
+
+@functools.partial(jax.jit, static_argnames=("want",))
+def _jit_extremes_stacked(P, consider, want: str):
     """Batched Min/Max scan over a [shards, planes, words] stack.
 
     `want` selects which two scans run ("min": neg-magnitude max +
