@@ -74,6 +74,8 @@ class Fragment:
 
         self._rows: dict[int, np.ndarray] = {}
         self._gen = 0
+        self._closed = False
+        self._snapshotting = False
         self._stack_cache: tuple[int, np.ndarray, np.ndarray] | None = None
         self._device_cache: dict = {}
         self._lock = threading.RLock()
@@ -107,6 +109,13 @@ class Fragment:
     def _cache_path(self) -> str:
         return self.path + ".cache"
 
+    @property
+    def _wal_new_path(self) -> str:
+        """Overflow WAL segment: writes land here while a background
+        snapshot's file I/O runs outside the fragment lock; the segment
+        is renamed over the truncated WAL when the snapshot commits."""
+        return self.path + ".wal.new"
+
     def _load(self) -> None:
         if os.path.exists(self._snap_path):
             with open(self._snap_path, "rb") as f:
@@ -127,11 +136,24 @@ class Fragment:
                 for rid, words in zip(row_ids, data):
                     self._rows[int(rid)] = words.copy()
         self._replay_wal()
+        # Heal a crash mid-snapshot: fold the overflow segment into the
+        # main WAL so the single-file invariant holds again.  Replaying
+        # the old WAL against a snapshot that already incorporates it is
+        # safe — set/clear replay is last-writer-wins per position.
+        if os.path.exists(self._wal_new_path):
+            with open(self._wal_path, "ab") as w, \
+                    open(self._wal_new_path, "rb") as nf:
+                w.write(nf.read())
+            os.remove(self._wal_new_path)
 
     def _replay_wal(self) -> None:
-        if not os.path.exists(self._wal_path):
-            return
-        with open(self._wal_path, "rb") as f:
+        for path in (self._wal_path, self._wal_new_path):
+            if os.path.exists(path):
+                self._replay_wal_file(path)
+        self._gen += 1
+
+    def _replay_wal_file(self, path: str) -> None:
+        with open(path, "rb") as f:
             buf = f.read()
         off, n = 0, len(buf)
         while off + _WAL_REC.size <= n:
@@ -156,7 +178,6 @@ class Fragment:
                 self._op_n += n_set + n_clear
             else:
                 break  # corrupt/torn record; ignore tail (same as op-log replay stop)
-        self._gen += 1
 
     def _wal_append(self, data: bytes) -> None:
         if self._wal is not None:
@@ -165,30 +186,72 @@ class Fragment:
 
     def snapshot(self) -> None:
         """Atomically persist the full matrix and truncate the WAL
-        (reference protectedSnapshot, fragment.go:2325)."""
+        (reference protectedSnapshot, fragment.go:2325).
+
+        Two-phase so writers only block for the in-memory matrix copy,
+        never the file I/O + fsync: phase 1 (under the lock) copies the
+        matrix and redirects the WAL handle to an overflow segment;
+        phase 2 (lock released) writes + fsyncs the snapshot; phase 3
+        (under the lock) renames the overflow segment over the old WAL
+        — the open handle follows the inode, so concurrent appends are
+        seamless.  Every crash window replays losslessly: the old WAL
+        is incorporated into the snapshot (re-replaying it is
+        last-writer-wins idempotent) and `_load` folds a leftover
+        overflow segment back into the WAL."""
         with self._lock:
-            if self.path is None:
+            if self.path is None or self._closed or self._snapshotting:
                 return
+            self._snapshotting = True
             row_ids, matrix = self._stacked()
+            matrix = np.ascontiguousarray(matrix)
+            gen = self._gen
+            ops_at_swap = self._op_n
+            if self._wal is not None:
+                self._wal.close()
+            self._wal = open(self._wal_new_path, "wb")
+        ok = False
+        try:
             tmp = self._snap_path + ".tmp"
             width_exp = self.width.bit_length() - 1
             with open(tmp, "wb") as f:
-                f.write(_SNAP_HEADER.pack(_SNAP_MAGIC, _SNAP_VERSION, width_exp, len(row_ids)))
+                f.write(_SNAP_HEADER.pack(
+                    _SNAP_MAGIC, _SNAP_VERSION, width_exp, len(row_ids)))
                 f.write(row_ids.astype(np.int64).tobytes())
-                f.write(np.ascontiguousarray(matrix).tobytes())
+                f.write(matrix.tobytes())
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._snap_path)
-            if self._wal is not None:
-                self._wal.close()
-            self._wal = open(self._wal_path, "wb")
-            self._op_n = 0
-            self.topn_cache.save(self._cache_path, self._gen)
+            ok = True
+        finally:
+            with self._lock:
+                if ok:
+                    # commit the overflow segment as the new WAL (the
+                    # snapshot incorporated everything before it); valid
+                    # even if close() ran during phase 2 — only a file
+                    # rename, the open handle follows the inode
+                    os.replace(self._wal_new_path, self._wal_path)
+                    self._op_n -= ops_at_swap
+                    if not self._closed:
+                        self.topn_cache.save(self._cache_path, gen)
+                else:
+                    # snapshot failed: the old WAL is still the only
+                    # durable copy of its ops — fold the overflow
+                    # segment back into it and resume appending there
+                    if self._wal is not None:
+                        self._wal.close()
+                    with open(self._wal_path, "ab") as w, \
+                            open(self._wal_new_path, "rb") as nf:
+                        w.write(nf.read())
+                    os.remove(self._wal_new_path)
+                    if not self._closed:
+                        self._wal = open(self._wal_path, "ab")
+                self._snapshotting = False
 
     def close(self) -> None:
         from pilosa_tpu.runtime import residency
 
         with self._lock:
+            self._closed = True  # a queued background snapshot becomes a no-op
             if self._wal is not None:
                 self._wal.close()
                 self._wal = None
@@ -200,8 +263,14 @@ class Fragment:
             self._device_cache.clear()
 
     def _maybe_snapshot(self) -> None:
+        """Past the opN threshold, queue a background compaction — the
+        writing thread never stalls on it (reference holder.go:163
+        snapshot queue; was inline here until round 2).  Durability is
+        WAL-carried either way."""
         if self.path is not None and self._op_n > self.max_op_n:
-            self.snapshot()
+            from pilosa_tpu.runtime import snapqueue
+
+            snapqueue.enqueue(self)
 
     # ------------------------------------------------------- host mutation
 

@@ -147,6 +147,18 @@ class Holder:
                 )
 
     def close(self) -> None:
+        # Let queued background compactions finish first (the queue is
+        # process-wide, so this may also wait on another holder's
+        # fragments).  A timeout is safe to proceed past: durability is
+        # WAL-carried and reopen heals any leftover overflow segment —
+        # only the compaction itself is deferred to the next open.
+        from pilosa_tpu.runtime import snapqueue
+
+        if not snapqueue.drain(timeout=60.0):
+            import sys
+
+            print("holder.close: snapshot queue drain timed out; "
+                  "WAL compaction deferred to next open", file=sys.stderr)
         # close EVERY index (continuing past failures) before releasing
         # the flock — releasing with WAL fds still open would reopen the
         # corruption window the lock exists to prevent

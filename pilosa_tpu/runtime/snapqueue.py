@@ -1,0 +1,112 @@
+"""Background snapshot queue: writes never stall on compaction.
+
+The reference queues fragment snapshots on a 100-deep channel drained by
+2 workers (holder.go:163, fragment.go:187-208) so a write that trips the
+opN threshold enqueues the compaction and returns.  Same design here,
+process-wide (one holder per process in practice, like the residency
+manager): ``enqueue(frag)`` marks the fragment pending and hands it to a
+worker; a full queue degrades to an inline snapshot (bounded memory, the
+write that overflows pays the cost); ``drain()`` blocks until the queue
+is empty — holder close and tests use it as a barrier.
+
+Durability does not depend on the queue at all: every mutation is in the
+WAL until ``snapshot()`` itself truncates it, so a crash at ANY point
+before/during/after the background compaction replays losslessly (the
+same guarantee as the reference's in-file op-log, roaring.go:1612).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+QUEUE_DEPTH = 100
+N_WORKERS = 2
+
+_queue: queue.Queue | None = None
+_workers: list[threading.Thread] = []
+_lock = threading.Lock()
+_pending: set[int] = set()  # id(fragment) currently queued
+_inflight = 0  # fragments popped but not yet snapshotted
+_idle = threading.Condition(_lock)
+
+
+def _worker() -> None:
+    global _inflight
+    while True:
+        frag = _queue.get()
+        try:
+            frag.snapshot()
+        except Exception:
+            pass  # a failed compaction is retried at the next threshold
+        finally:
+            with _lock:
+                _pending.discard(id(frag))
+                _inflight -= 1
+                _idle.notify_all()
+            _queue.task_done()
+
+
+def _ensure_workers() -> None:
+    global _queue
+    if _queue is not None:
+        return
+    with _lock:
+        if _queue is not None:
+            return
+        _queue = queue.Queue(maxsize=QUEUE_DEPTH)
+        for i in range(N_WORKERS):
+            t = threading.Thread(target=_worker, daemon=True,
+                                 name=f"snapshot-worker-{i}")
+            t.start()
+            _workers.append(t)
+
+
+def enqueue(frag) -> None:
+    """Queue a fragment for background compaction; de-duplicates (a
+    fragment already queued is skipped) and degrades to inline when the
+    queue is full."""
+    global _inflight
+    _ensure_workers()
+    with _lock:
+        if id(frag) in _pending:
+            return
+        _pending.add(id(frag))
+        _inflight += 1
+    try:
+        _queue.put_nowait(frag)
+    except queue.Full:
+        # backpressure: the overflowing write pays for one compaction
+        # inline rather than queueing unbounded work
+        try:
+            frag.snapshot()
+        finally:
+            with _lock:
+                _pending.discard(id(frag))
+                _inflight -= 1
+                _idle.notify_all()
+
+
+def drain(timeout: float | None = 30.0) -> bool:
+    """Block until every queued snapshot has completed.  Returns False
+    on timeout; ``timeout=None`` blocks indefinitely."""
+    if _queue is None:
+        return True
+    import time
+
+    deadline = None if timeout is None else time.monotonic() + timeout
+    with _idle:
+        while _inflight > 0:
+            if deadline is None:
+                _idle.wait(timeout=1.0)  # re-check; no deadline to miss
+                continue
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return False
+            _idle.wait(timeout=remaining)
+    return True
+
+
+def pending_count() -> int:
+    with _lock:
+        return len(_pending)
