@@ -202,13 +202,26 @@ class Fragment:
             if self.path is None or self._closed or self._snapshotting:
                 return
             self._snapshotting = True
-            row_ids, matrix = self._stacked()
-            matrix = np.ascontiguousarray(matrix)
-            gen = self._gen
-            ops_at_swap = self._op_n
-            if self._wal is not None:
-                self._wal.close()
-            self._wal = open(self._wal_new_path, "wb")
+            try:
+                row_ids, matrix = self._stacked()
+                matrix = np.ascontiguousarray(matrix)
+                gen = self._gen
+                ops_at_swap = self._op_n
+                if self._wal is not None:
+                    self._wal.close()
+                    self._wal = None
+                self._wal = open(self._wal_new_path, "wb")
+            except BaseException:
+                # phase-1 failure (ENOSPC/EMFILE/MemoryError) must not
+                # wedge the fragment: restore an appendable WAL handle
+                # and clear the in-progress flag
+                if self._wal is None:
+                    try:
+                        self._wal = open(self._wal_path, "ab")
+                    except OSError:
+                        pass
+                self._snapshotting = False
+                raise
         ok = False
         try:
             tmp = self._snap_path + ".tmp"

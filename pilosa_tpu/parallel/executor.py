@@ -788,9 +788,17 @@ class Executor:
 
             src_count = self._execute_count(
                 idx, Call("Count", children=[filter_call]), shards, opt)
-            full = self._execute_topn(
-                idx, Call("TopN", {"_field": fname}), shards, opt)
-            full_counts = {p.id: p.count for p in full}
+            if fused_ok and not self._cluster_active(opt):
+                # reuse the stacked scan directly — the filtered totals
+                # above already warmed the matrix stack, so the
+                # unfiltered pass is one more dispatch (and fragment
+                # caches make repeats free); no Pair-sort detour
+                full_counts = self._fused_topn_counts(idx, f, None,
+                                                      tuple(shards))
+            else:
+                full = self._execute_topn(
+                    idx, Call("TopN", {"_field": fname}), shards, opt)
+                full_counts = {p.id: p.count for p in full}
             lo = src_count * tanimoto / 100.0
             hi = src_count * 100.0 / tanimoto
             kept = {}
@@ -892,10 +900,18 @@ class Executor:
             frag = view.fragment(shard) if view is not None else None
             if frag is None:
                 return []
-            ids = frag.row_ids()
             if column is not None:
-                ids = [r for r in ids if frag.bit(r, column)]
-            return ids
+                # one vectorized read of the column's word down the row
+                # matrix (reference rowFilter ColumnFilter,
+                # fragment.go:2618) — not a per-row bit probe
+                ids_arr, matrix = frag._stacked()
+                if len(ids_arr) == 0:
+                    return []
+                off = column % SHARD_WIDTH
+                w, b = off // bm.WORD_BITS, off % bm.WORD_BITS
+                mask = (matrix[:, w] >> np.uint32(b)) & np.uint32(1)
+                return [int(r) for r in ids_arr[mask.astype(bool)]]
+            return frag.row_ids()
 
         merged: set[int] = set()
         parts = self._map_shards(
@@ -1240,37 +1256,60 @@ class Executor:
             raise ExecutionError(f"{call.name}() requires a field argument")
         f = self._field(idx, fname)
         shards = self._target_shards(idx, shards, opt)
-        filter_row = self._local_filter_row(idx, call, shards, opt)
         is_min = call.name == "MinRow"
+        filter_call = call.children[0] if call.children else None
+        fused_ok = self._fuse_eligible(idx, shards, filter_call)
 
-        def map_fn(shard):
-            view = f.view(VIEW_STANDARD)
-            frag = view.fragment(shard) if view is not None else None
-            if frag is None:
+        def batch_fn(group):
+            # ONE stacked dispatch for the whole group (the TopN scan),
+            # then a host argmin/argmax over the row totals — replaces
+            # the per-row device round-trips of the old walk
+            totals = self._fused_topn_counts(idx, f, filter_call,
+                                             tuple(group))
+            live = [r for r, c in totals.items() if c > 0]
+            if not live:
+                return [Pair()]
+            rid = min(live) if is_min else max(live)
+            return [Pair(id=rid, count=totals[rid])]
+
+        if fused_ok and not self._cluster_active(opt):
+            parts = batch_fn(shards)
+        else:
+            filter_row = self._local_filter_row(idx, call, shards, opt)
+
+            def map_fn(shard):
+                view = f.view(VIEW_STANDARD)
+                frag = view.fragment(shard) if view is not None else None
+                if frag is None:
+                    return Pair()
+                ids = frag.row_ids()
+                if not is_min:
+                    ids = list(reversed(ids))
+                fw = (None if filter_row is None
+                      else filter_row.shard_segment(shard))
+                if filter_row is not None and fw is None:
+                    return Pair()
+                for rid in ids:
+                    words = frag.row(rid)
+                    if fw is not None:
+                        words = words & fw
+                    c = int(np.bitwise_count(words).sum())
+                    if c > 0:
+                        return Pair(id=rid, count=c)
                 return Pair()
-            ids = frag.row_ids()
-            if not is_min:
-                ids = list(reversed(ids))
-            fw = None if filter_row is None else filter_row.shard_segment(shard)
-            if filter_row is not None and fw is None:
-                return Pair()
-            for rid in ids:
-                words = frag.row(rid)
-                if fw is not None:
-                    words = words & fw
-                c = int(np.bitwise_count(words).sum())
-                if c > 0:
-                    return Pair(id=rid, count=c)
-            return Pair()
+
+            parts = self._map_shards(
+                map_fn, shards, idx=idx, call=call, opt=opt,
+                adapt=lambda p: [p],
+                local_batch_fn=batch_fn if fused_ok else None,
+            )
 
         # Reduce: smallest/largest row id wins; counts for the winning row
         # are summed across shards.  (The reference's reduce keeps one
         # arbitrary shard's count on id ties, executor.go MinRow reduceFn —
         # summing is deterministic and reflects the whole row.)
         out = Pair()
-        for p in self._map_shards(
-            map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda p: [p]
-        ):
+        for p in parts:
             if p.count == 0:
                 continue
             if out.count == 0:

@@ -31,14 +31,26 @@ _inflight = 0  # fragments popped but not yet snapshotted
 _idle = threading.Condition(_lock)
 
 
+def _snapshot_swallowing(frag) -> None:
+    """Run one compaction; a failure is survivable (durability is
+    WAL-carried, the next threshold retries) but never silent — a
+    persistently failing disk must not starve compaction invisibly."""
+    try:
+        frag.snapshot()
+    except Exception as e:
+        import sys
+
+        print(f"snapshot queue: compaction of {frag.path!r} failed "
+              f"({e!r}); WAL keeps growing until a retry succeeds",
+              file=sys.stderr)
+
+
 def _worker() -> None:
     global _inflight
     while True:
         frag = _queue.get()
         try:
-            frag.snapshot()
-        except Exception:
-            pass  # a failed compaction is retried at the next threshold
+            _snapshot_swallowing(frag)
         finally:
             with _lock:
                 _pending.discard(id(frag))
@@ -77,9 +89,11 @@ def enqueue(frag) -> None:
         _queue.put_nowait(frag)
     except queue.Full:
         # backpressure: the overflowing write pays for one compaction
-        # inline rather than queueing unbounded work
+        # inline rather than queueing unbounded work.  Failures are
+        # swallowed exactly like the worker path — the triggering write
+        # already succeeded durably (bit applied + WAL appended)
         try:
-            frag.snapshot()
+            _snapshot_swallowing(frag)
         finally:
             with _lock:
                 _pending.discard(id(frag))

@@ -523,3 +523,55 @@ class TestFusedTopNGroupBy:
              "to='2019-07-01T00:00'), Row(f0=1)))")
         got = ex.execute("i", q)[0]
         assert got == _general(ex, q)[0]
+
+
+class TestFusedExtremeRowAndRows:
+    def test_fused_minrow_maxrow_matches_per_shard(self, ex):
+        for q in ("MinRow(field=f0)", "MaxRow(field=f0)",
+                  "MinRow(Row(f1=1), field=f0)",
+                  "MaxRow(Row(f1=1), field=f0)"):
+            assert ex.execute("i", q)[0] == _general(ex, q)[0], q
+
+    def test_fused_minrow_engages(self, ex, monkeypatch):
+        calls = []
+        orig = Executor._fused_topn_counts
+
+        def spy(self, idx, f, filter_call, shards):
+            calls.append(shards)
+            return orig(self, idx, f, filter_call, shards)
+
+        monkeypatch.setattr(Executor, "_fused_topn_counts", spy)
+        ex.execute("i", "MinRow(field=f0)")
+        assert calls and len(calls[0]) > 1  # one batch over all shards
+
+    def test_rows_column_vectorized_matches_probe(self, ex):
+        # find a column that actually has bits in several rows
+        holder = ex.holder
+        f = holder.index("i").field("f0")
+        view = f.view("standard")
+        col = None
+        for s, frag in view.fragments.items():
+            ids, matrix = frag._stacked()
+            if len(ids) == 0:
+                continue
+            import numpy as np
+
+            hit = np.flatnonzero(matrix.any(axis=0))
+            if len(hit):
+                w = int(hit[0])
+                # pick the first set bit in that word from any row
+                word_or = 0
+                for r in range(len(ids)):
+                    word_or |= int(matrix[r, w])
+                b = (word_or & -word_or).bit_length() - 1
+                col = s * SHARD_WIDTH + w * 32 + b
+                break
+        assert col is not None
+        got = ex.execute("i", f"Rows(f0, column={col})")[0]
+        # oracle: per-row bit probe
+        want = [r for r in frag.row_ids() if frag.bit(r, col)]
+        assert got == want
+
+    def test_tanimoto_fused_matches_general(self, ex):
+        q = "TopN(f0, Row(f1=1), tanimotoThreshold=10)"
+        assert ex.execute("i", q)[0] == _general(ex, q)[0]
