@@ -202,24 +202,26 @@ class Fragment:
             if self.path is None or self._closed or self._snapshotting:
                 return
             self._snapshotting = True
+            old_wal = self._wal
             try:
                 row_ids, matrix = self._stacked()
                 matrix = np.ascontiguousarray(matrix)
                 gen = self._gen
                 ops_at_swap = self._op_n
-                if self._wal is not None:
-                    self._wal.close()
-                    self._wal = None
+                if old_wal is not None:
+                    old_wal.close()
                 self._wal = open(self._wal_new_path, "wb")
             except BaseException:
                 # phase-1 failure (ENOSPC/EMFILE/MemoryError) must not
                 # wedge the fragment: restore an appendable WAL handle
                 # and clear the in-progress flag
-                if self._wal is None:
-                    try:
-                        self._wal = open(self._wal_path, "ab")
-                    except OSError:
-                        pass
+                try:
+                    self._wal = open(self._wal_path, "ab")
+                except OSError:
+                    # reopen failed too — keep the CLOSED old handle so
+                    # the next write fails LOUDLY (ValueError) instead
+                    # of being acknowledged without a WAL record
+                    self._wal = old_wal
                 self._snapshotting = False
                 raise
         ok = False

@@ -1275,7 +1275,13 @@ class Executor:
         if fused_ok and not self._cluster_active(opt):
             parts = batch_fn(shards)
         else:
-            filter_row = self._local_filter_row(idx, call, shards, opt)
+            # when fused_ok the local group goes through batch_fn, which
+            # evaluates the filter itself — map_fn only runs on this
+            # node when fusion is off, so the eager evaluation (which
+            # must happen OUTSIDE the worker pool: it fans out itself)
+            # is skipped entirely in the fused case
+            filter_row = (None if fused_ok
+                          else self._local_filter_row(idx, call, shards, opt))
 
             def map_fn(shard):
                 view = f.view(VIEW_STANDARD)

@@ -1,0 +1,245 @@
+"""Deep fault-injection tier (round-2): node death mid-resize with
+abort + recovery, anti-entropy convergence from bidirectional replica
+divergence under concurrent writes, and a server restart over a torn
+WAL.  Parity: internal/clustertests/cluster_test.go:69-80 (pumba
+container pauses), cluster.go:1250 (resize abort), AE §3.5."""
+
+from __future__ import annotations
+
+import threading
+
+import pytest
+
+from pilosa_tpu.api import API
+from pilosa_tpu.parallel.cluster import Node, TransportError
+from pilosa_tpu.parallel.membership import heartbeat_round
+from pilosa_tpu.parallel.resize import ResizeError, Resizer
+from pilosa_tpu.parallel.syncer import HolderSyncer
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+from tests.test_cluster import make_cluster
+
+
+def _seed(node, n_shards=6, row=1):
+    cols = [s * SHARD_WIDTH + 11 * s for s in range(n_shards)]
+    node.create_index("i")
+    node.create_field("i", "f")
+    API(node).import_bits("i", "f", [row] * len(cols), cols)
+    return cols
+
+
+class TestNodeDiesMidResize:
+    def test_source_dies_mid_resize_aborts_then_recovers(self, tmp_path):
+        from pilosa_tpu.models.holder import Holder
+        from pilosa_tpu.parallel.cluster import Cluster
+        from pilosa_tpu.parallel.node import ClusterNode
+
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=2)
+        cols = _seed(nodes[0])
+        want = len(cols)
+
+        joiner_holder = Holder(str(tmp_path / "node2"))
+        joiner = ClusterNode(
+            joiner_holder,
+            Cluster("node2", nodes=[Node(id="node2")], replica_n=1,
+                    transport=transport))
+
+        # kill node1 the moment the first resize instruction is
+        # dispatched: fragment fetches from it fail mid-job
+        real_send = transport.send_message
+        state = {"instructions": 0}
+
+        def chaotic_send(node, message):
+            if message.get("type") == "resize-instruction":
+                state["instructions"] += 1
+                transport.set_down("node1")
+            return real_send(node, message)
+
+        transport.send_message = chaotic_send
+        try:
+            with pytest.raises((ResizeError, TransportError)):
+                Resizer(nodes[0]).run(add=Node(id="node2"))
+        finally:
+            transport.send_message = real_send
+
+        # abort path: coordinator back to NORMAL, membership unchanged,
+        # reads exact from the surviving replica set
+        assert nodes[0].cluster.state == "NORMAL"
+        assert len(nodes[0].cluster.sorted_nodes()) == 2
+        assert nodes[0].executor.execute("i", "Count(Row(f=1))")[0] == want
+        # writes unblocked after abort (node1 still dark: best-effort)
+        API(nodes[0]).import_bits("i", "f", [1], [3 * SHARD_WIDTH + 999])
+        want += 1
+
+        # node1 comes back; AE repairs the write it missed, then the
+        # retried resize completes and every node (including the
+        # joiner) answers the full result
+        transport.set_down("node1", False)
+        HolderSyncer(nodes[0]).sync_holder()
+        HolderSyncer(nodes[1]).sync_holder()
+        summary = Resizer(nodes[0]).run(add=Node(id="node2"))
+        assert summary["transfers"] > 0
+        for nd in (*nodes, joiner):
+            assert nd.executor.execute("i", "Count(Row(f=1))")[0] == want
+
+    def test_resize_abort_flag_mid_job(self, tmp_path):
+        """Explicit abort (api.go:1250): the flag set between
+        instructions stops the job and restores NORMAL."""
+        from pilosa_tpu.models.holder import Holder
+        from pilosa_tpu.parallel.cluster import Cluster
+        from pilosa_tpu.parallel.node import ClusterNode
+
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        _seed(nodes[0])
+        Holder(str(tmp_path / "node2"))  # dir exists for the joiner
+        r = Resizer(nodes[0])
+
+        real_send = transport.send_message
+
+        def abort_after_first(node, message):
+            resp = real_send(node, message)
+            if message.get("type") == "resize-instruction":
+                r.abort()
+            return resp
+
+        transport.send_message = abort_after_first
+        try:
+            # abort only raises if a later instruction existed; either
+            # way the job must leave the cluster NORMAL and writable
+            try:
+                r.run(add=Node(id="node2"))
+            except ResizeError:
+                pass
+        finally:
+            transport.send_message = real_send
+        assert nodes[0].cluster.state == "NORMAL"
+        API(nodes[0]).import_bits("i", "f", [1], [42])
+
+
+class TestBidirectionalDivergence:
+    def test_ae_converges_both_directions_under_concurrent_writes(
+            self, tmp_path):
+        """Replica set {node0, node1} diverges BOTH ways (each holds
+        bits the other missed), a writer keeps importing during repair,
+        and anti-entropy still converges every replica to the union."""
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=2)
+        n0, n1 = nodes
+        n0.create_index("i")
+        n0.create_field("i", "f")
+        api0, api1 = API(n0), API(n1)
+
+        base = [s * SHARD_WIDTH + s for s in range(4)]
+        api0.import_bits("i", "f", [1] * len(base), base)
+
+        # direction 1: node1 dark, bits land only on node0
+        transport.set_down("node1")
+        only0 = [s * SHARD_WIDTH + 1000 + s for s in range(4)]
+        api0.import_bits("i", "f", [1] * len(only0), only0)
+        transport.set_down("node1", False)
+
+        # direction 2: node0 dark, bits land only on node1
+        transport.set_down("node0")
+        only1 = [s * SHARD_WIDTH + 2000 + s for s in range(4)]
+        api1.import_bits("i", "f", [1] * len(only1), only1)
+        transport.set_down("node0", False)
+
+        # concurrent writer hammers a second row while AE repairs row 1
+        stop = threading.Event()
+        written: list[int] = []
+
+        def writer():
+            i = 0
+            while not stop.is_set() and i < 200:
+                col = (i % 4) * SHARD_WIDTH + 5000 + i
+                api0.import_bits("i", "f", [2], [col])
+                written.append(col)
+                i += 1
+
+        t = threading.Thread(target=writer)
+        t.start()
+        try:
+            for _ in range(3):  # repeated passes, as the AE loop would
+                HolderSyncer(n0).sync_holder()
+                HolderSyncer(n1).sync_holder()
+        finally:
+            stop.set()
+            t.join()
+        # one final quiesced pass picks up anything written mid-repair
+        HolderSyncer(n0).sync_holder()
+        HolderSyncer(n1).sync_holder()
+
+        want1 = sorted(base + only0 + only1)
+        want2 = sorted(set(written))
+        for nd in nodes:
+            row1 = nd.executor.execute("i", "Row(f=1)")[0]
+            assert sorted(int(c) for c in row1.columns()) == want1, nd
+            row2 = nd.executor.execute("i", "Row(f=2)")[0]
+            assert sorted(int(c) for c in row2.columns()) == want2, nd
+        # per-node LOCAL fragments agree too (not just fan-out results):
+        # both replicas of every shard hold the union
+        for nd in nodes:
+            f = nd.holder.index("i").field("f")
+            for s in range(4):
+                frag = f.view("standard").fragment(s)
+                assert frag is not None
+                import numpy as np
+
+                row_words = frag.row(1)
+                bits = (np.flatnonzero(np.unpackbits(
+                    row_words.view(np.uint8), bitorder="little"))
+                    if row_words is not None else [])
+                local = sorted(s * SHARD_WIDTH + int(p) for p in bits)
+                assert local == [c for c in want1
+                                 if c // SHARD_WIDTH == s], (nd, s)
+
+
+class TestRestartOverTornWal:
+    def test_server_restarts_over_truncated_wal(self, tmp_path):
+        """SIGKILL-style stop, torn WAL tail, restart: the server must
+        boot and serve every complete record (fragment-level torn-tail
+        test, lifted to the full server lifecycle)."""
+        import glob
+        import os
+
+        from pilosa_tpu.server.server import Server
+
+        d = str(tmp_path / "n0")
+        s = Server(data_dir=d, coordinator=True)
+        s.open()
+        from pilosa_tpu.server.client import InternalClient
+
+        c = InternalClient(timeout=30)
+        c.post_json(s.uri + "/index/i", {})
+        c.post_json(s.uri + "/index/i/field/f", {})
+        # 20 separate batches -> 20 bulk WAL records; tearing the file
+        # tail can only lose the LAST record (batch of 10)
+        for b in range(20):
+            cols = list(range(b * 10, b * 10 + 10))
+            c.post_json(s.uri + "/index/i/field/f/import",
+                        {"rowIDs": [1] * 10, "columnIDs": cols})
+        # simulate SIGKILL: release only the dir lock + sockets, no
+        # holder close, no WAL flush beyond what writes already did
+        s._stop.set()
+        s.handler.close()
+        s._client.close()
+        s.holder._release_dir_lock()
+        c.close()
+
+        wals = [p for p in glob.glob(d + "/**/*.wal", recursive=True)
+                if os.path.getsize(p) > 0 and "/f/" in p]  # field f's WAL,
+                # not the auto-created _exists field's (glob order varies)
+        assert wals, "expected a live field WAL after an unclean stop"
+        torn = wals[0]
+        os.truncate(torn, os.path.getsize(torn) - 3)
+
+        s2 = Server(data_dir=d, coordinator=True)
+        s2.open()
+        c2 = InternalClient(timeout=30)
+        r = c2.post_json(s2.uri + "/index/i/query",
+                         {"query": "Count(Row(f=1))"})
+        got = r["results"][0]
+        # the torn last bulk record loses exactly its batch of 10;
+        # every complete record replays
+        assert got == 190, got
+        c2.close()
+        s2.close()
