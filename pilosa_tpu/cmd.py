@@ -130,7 +130,12 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         stats = _stats.MultiStatsClient([_stats.MemStatsClient(), statsd])
     else:
         stats = _stats.MemStatsClient()
-    if cfg.tracing.enabled:
+    exporter = None
+    if cfg.tracing.endpoint:
+        exporter = _tracing.OtlpExporter(cfg.tracing.endpoint,
+                                         service=cfg.name or "pilosa-tpu")
+        _tracing.set_global_tracer(exporter)
+    elif cfg.tracing.enabled:
         _tracing.set_global_tracer(_tracing.MemTracer())
     srv = Server(
         cfg.expanded_data_dir(),
@@ -154,6 +159,9 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
     )
     if statsd is not None:
         srv._closers.append(statsd.close)
+    if exporter is not None:
+        # final flush + thread join on shutdown (trailing spans ship)
+        srv._closers.append(exporter.close)
     stop = stop_event or threading.Event()
 
     def _sig(signum, frame):

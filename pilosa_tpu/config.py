@@ -47,9 +47,12 @@ class MetricConfig:
 
 @dataclass
 class TracingConfig:
-    """[tracing] (server/config.go:141-149)."""
+    """[tracing] (server/config.go:141-149).  ``endpoint`` points at an
+    OTLP/HTTP collector (…/v1/traces is appended); empty with
+    enabled=true records in-memory only."""
 
     enabled: bool = False
+    endpoint: str = ""
 
 
 @dataclass
@@ -174,6 +177,7 @@ class Config:
             "",
             "[tracing]",
             f"enabled = {str(self.tracing.enabled).lower()}",
+            f'endpoint = "{self.tracing.endpoint}"',
             "",
             "[tls]",
             f'certificate-path = "{self.tls.certificate_path}"',

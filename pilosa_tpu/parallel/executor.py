@@ -44,6 +44,7 @@ from pilosa_tpu.parallel.results import (
 )
 from pilosa_tpu.pql import Call, Query, parse
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu import tracing
 
 
 def _next_pow2(n: int) -> int:
@@ -206,12 +207,20 @@ class Executor:
         fan-out must never queue behind the compute pool or behind other
         nodes' sub-queries — distributed latency is max(per-node)."""
         fut = Future()
+        # carry the caller's active span into the IO thread so the
+        # outbound RPC injects the right trace context
+        parent_span = tracing.current_span()
 
         def run():
             if not fut.set_running_or_notify_cancel():
                 return
             try:
-                fut.set_result(fn(*args))
+                if parent_span is not None:
+                    with tracing.start_span("executor.remoteExec",
+                                            parent=parent_span):
+                        fut.set_result(fn(*args))
+                else:
+                    fut.set_result(fn(*args))
             except BaseException as e:  # delivered via fut.result()
                 fut.set_exception(e)
 

@@ -117,6 +117,9 @@ class InternalClient:
             headers["Content-Type"] = ctype
         if accept:
             headers["Accept"] = accept
+        from pilosa_tpu import tracing
+
+        headers.update(tracing.inject_headers())  # trace follows the RPC
         import http.client as _hc
 
         # Disconnect-class failures on a POOLED connection retry on the

@@ -262,7 +262,18 @@ class Handler:
                     return
                 if length:
                     body = req.rfile.read(length)
-                getattr(self, name)(req, params, match.groupdict(), body)
+                # trace-context extract + a server span per route (the
+                # reference's tracing middleware, http/handler.go:321);
+                # entering the span makes it the parent of every span
+                # the handler starts (api.*, executor.*)
+                from pilosa_tpu import tracing
+
+                parent = tracing.extract_headers(req.headers)
+                with tracing.start_span(f"http.{name}",
+                                        parent=parent) as span:
+                    span.set_tag("http.path", path)
+                    getattr(self, name)(req, params, match.groupdict(),
+                                        body)
             except NotFoundError as e:
                 self._error(req, 404, str(e))
             except ConflictError as e:

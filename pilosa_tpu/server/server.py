@@ -58,6 +58,14 @@ class Server:
         self.logger = logger or _logger.NOP
         self.stats = stats if stats is not None else _stats.MemStatsClient()
         self.tracer = tracer
+        if tracer is not None:
+            # an injected tracer IS the process tracer: the middleware,
+            # executor, and outbound RPC all consult the global (the
+            # reference wires its jaeger tracer globally the same way,
+            # tracing/tracing.go:27 GlobalTracer)
+            from pilosa_tpu import tracing as _tracing
+
+            _tracing.set_global_tracer(tracer)
         self.seeds = seeds or []
         self.anti_entropy_interval = anti_entropy_interval
         self.heartbeat_interval = heartbeat_interval

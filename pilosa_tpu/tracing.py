@@ -1,19 +1,57 @@
-"""Tracing: minimal Tracer/Span facade with a global tracer.
+"""Tracing: Tracer/Span facade, context propagation, OTLP export.
 
 Parity target: the reference's tracing package (tracing/tracing.go:27-76
 Tracer/Span interfaces + GlobalTracer; opentracing/jaeger adapter
 tracing/opentracing/opentracing.go:36).  Spans wrap executor ops and API
-methods; the HTTP layer propagates a trace id header the way the
-reference's middleware does (http/handler.go:321)."""
+methods; the HTTP layer extracts/injects W3C ``traceparent`` headers the
+way the reference's middleware does (http/handler.go:321), so a trace
+follows a query across the scatter-gather fan-out to remote nodes.
+
+Span parentage is implicit via a per-thread active-span stack (the
+moral equivalent of context.Context threading in Go): ``start_span``
+parents to the innermost active span unless an explicit parent is
+given; cross-thread and cross-process boundaries re-attach via
+``current_span()`` capture and ``inject_headers``/``extract_headers``.
+
+Export: ``MemTracer`` records in-process (tests, /debug); ``OtlpExporter``
+ships finished spans as OTLP/HTTP JSON to a collector endpoint from a
+background thread.
+"""
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 import uuid
 
+_active = threading.local()  # .stack: list of active spans (innermost last)
+
+
+def current_span() -> "Span | None":
+    stack = getattr(_active, "stack", None)
+    return stack[-1] if stack else None
+
+
+def _push(span) -> None:
+    if not hasattr(_active, "stack"):
+        _active.stack = []
+    _active.stack.append(span)
+
+
+def _pop(span) -> None:
+    stack = getattr(_active, "stack", None)
+    if stack and stack[-1] is span:
+        stack.pop()
+
 
 class Span:
+    """No-op span; also the base for recorded spans.  Entering a span
+    makes it the thread's active span (the default parent)."""
+
+    trace_id: str | None = None
+    span_id: str | None = None
+
     def set_tag(self, key: str, value) -> None:
         pass
 
@@ -21,11 +59,53 @@ class Span:
         pass
 
     def __enter__(self):
+        _push(self)
         return self
 
     def __exit__(self, *exc):
+        _pop(self)
         self.finish()
         return False
+
+
+class RemoteParent(Span):
+    """A span handle reconstructed from a traceparent header — parent
+    for server-side spans of a propagated trace."""
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.name = "remote"
+
+
+def inject_headers(span: Span | None = None) -> dict[str, str]:
+    """W3C trace-context header for an outgoing request (reference
+    middleware inject, http/handler.go:321).  Empty when no recorded
+    span is active (nop tracer: nothing to propagate)."""
+    span = span or current_span()
+    if span is None or not span.trace_id:
+        return {}
+    return {"traceparent":
+            f"00-{span.trace_id:0>32}-{span.span_id:0>16}-01"}
+
+
+def extract_headers(headers) -> RemoteParent | None:
+    """Parse a traceparent header (mapping or http.client-style
+    getter) into a RemoteParent, or None."""
+    get = headers.get if hasattr(headers, "get") else None
+    raw = get("traceparent") if get else None
+    if not raw:
+        return None
+    parts = raw.strip().split("-")
+    if len(parts) != 4 or len(parts[1]) != 32 or len(parts[2]) != 16:
+        return None
+    trace_id, span_id = parts[1], parts[2]
+    hexdigits = set("0123456789abcdef")
+    if not (set(trace_id) <= hexdigits and set(span_id) <= hexdigits):
+        return None  # W3C: non-hex ids are invalid
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None  # W3C: all-zero ids mean "absent"
+    return RemoteParent(trace_id, span_id)
 
 
 class Tracer:
@@ -35,12 +115,17 @@ class Tracer:
 
 class RecordedSpan(Span):
     def __init__(self, tracer: "MemTracer", name: str,
-                 parent: "RecordedSpan | None"):
+                 parent: "Span | None"):
         self.tracer = tracer
         self.name = name
-        self.trace_id = parent.trace_id if parent else uuid.uuid4().hex[:16]
-        self.parent_name = parent.name if parent else None
+        self.trace_id = (parent.trace_id if parent is not None
+                         and parent.trace_id else uuid.uuid4().hex)
+        self.span_id = uuid.uuid4().hex[:16]
+        self.parent_span_id = (parent.span_id if parent is not None
+                               else None)
+        self.parent_name = getattr(parent, "name", None)
         self.tags: dict = {}
+        self.start_unix_ns = time.time_ns()
         self.start_ns = time.perf_counter_ns()
         self.duration_ns: int | None = None
 
@@ -54,8 +139,8 @@ class RecordedSpan(Span):
 
 
 class MemTracer(Tracer):
-    """In-memory recording tracer — the test/debug backend; a jaeger
-    exporter would subclass and ship finished spans instead."""
+    """In-memory recording tracer — the test/debug backend; exporters
+    subclass and ship finished spans instead."""
 
     def __init__(self, max_spans: int = 10000):
         self.max_spans = max_spans
@@ -63,6 +148,8 @@ class MemTracer(Tracer):
         self.spans: list[RecordedSpan] = []
 
     def start_span(self, name, parent=None):
+        if parent is None:
+            parent = current_span()
         return RecordedSpan(self, name, parent)
 
     def _record(self, span: RecordedSpan) -> None:
@@ -73,6 +160,86 @@ class MemTracer(Tracer):
     def finished(self, name: str | None = None) -> list[RecordedSpan]:
         with self._lock:
             return [s for s in self.spans if name is None or s.name == name]
+
+
+def _otlp_json(spans, service: str) -> bytes:
+    def attrs(d):
+        return [{"key": str(k), "value": {"stringValue": str(v)}}
+                for k, v in d.items()]
+
+    out = []
+    for s in spans:
+        rec = {
+            "traceId": f"{s.trace_id:0>32}",
+            "spanId": f"{s.span_id:0>16}",
+            "name": s.name,
+            "kind": 1,
+            "startTimeUnixNano": str(s.start_unix_ns),
+            "endTimeUnixNano": str(s.start_unix_ns + (s.duration_ns or 0)),
+            "attributes": attrs(s.tags),
+        }
+        if s.parent_span_id:
+            rec["parentSpanId"] = f"{s.parent_span_id:0>16}"
+        out.append(rec)
+    return json.dumps({"resourceSpans": [{
+        "resource": {"attributes": attrs({"service.name": service})},
+        "scopeSpans": [{"scope": {"name": "pilosa_tpu"}, "spans": out}],
+    }]}).encode()
+
+
+class OtlpExporter(MemTracer):
+    """Ships finished spans to an OTLP/HTTP collector (`/v1/traces`)
+    in batches from a daemon thread — the jaeger-adapter slot of the
+    reference (tracing/opentracing/opentracing.go:36), speaking the
+    open standard instead."""
+
+    def __init__(self, endpoint: str, service: str = "pilosa-tpu",
+                 flush_interval: float = 2.0, max_batch: int = 512):
+        super().__init__(max_spans=1 << 30)
+        self.endpoint = endpoint.rstrip("/")
+        self.service = service
+        self.flush_interval = flush_interval
+        self.max_batch = max_batch
+        self._buf: list[RecordedSpan] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="otlp-exporter")
+        self._thread.start()
+
+    MAX_BUFFER = 16384  # spans; beyond this the oldest drop (outage cap)
+
+    def _record(self, span: RecordedSpan) -> None:
+        with self._lock:
+            self._buf.append(span)
+            if len(self._buf) > self.MAX_BUFFER:
+                del self._buf[: len(self._buf) - self.MAX_BUFFER]
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.flush_interval):
+            self.flush()
+        self.flush()
+
+    def flush(self) -> None:
+        while True:
+            with self._lock:
+                batch = self._buf[:self.max_batch]
+                self._buf = self._buf[self.max_batch:]
+            if not batch:
+                return
+            import urllib.request
+
+            body = _otlp_json(batch, self.service)
+            req = urllib.request.Request(
+                self.endpoint + "/v1/traces", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                urllib.request.urlopen(req, timeout=5).read()
+            except Exception:
+                return  # collector outage never affects serving
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
 
 
 _global = Tracer()
