@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""On-chip Pallas validation: run every Pallas kernel NON-interpreted on
+the real TPU against its jnp/numpy oracle and record pass/fail.
+
+CI exercises the kernels with interpret=True only (no chip in the test
+environment), which cannot catch Mosaic lowering bugs — this script is
+the relay-up-only complement (VERDICT round-1 weak #3).  Run whenever
+the chip is reachable:
+
+    PYTHONPATH=/root/repo:$PYTHONPATH python benchmarks/validate_tpu.py
+
+Writes PALLAS_TPU_VALIDATION.json at the repo root: one entry per
+kernel with ok/detail, plus the platform and device kind.  Exits 0 with
+status "skipped" when no TPU is reachable (never blocks CI).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+from pilosa_tpu.axon_guard import guard_dead_relay
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "PALLAS_TPU_VALIDATION.json")
+
+
+def main() -> int:
+    guard_dead_relay()
+    import jax
+
+    from pilosa_tpu.ops import pallas_kernels as pk
+
+    dev = jax.devices()[0]
+    if dev.platform not in ("tpu", "axon"):
+        json.dump({"status": "skipped",
+                   "reason": f"no TPU (platform={dev.platform})"},
+                  open(OUT, "w"), indent=1)
+        print(f"skipped: platform={dev.platform}")
+        return 0
+
+    rng = np.random.default_rng(12348)
+    results = {}
+
+    def check(name, fn):
+        try:
+            fn()
+            results[name] = {"ok": True}
+            print(f"PASS {name}")
+        except Exception as e:
+            results[name] = {"ok": False, "detail": f"{type(e).__name__}: {e}"}
+            print(f"FAIL {name}: {e}")
+
+    def words(*shape):
+        return rng.integers(0, 1 << 32, size=shape, dtype=np.uint32)
+
+    def _row_counts():
+        mat, filt = words(300, 4096), words(4096)
+        got = np.asarray(pk._row_counts_masked_pallas(mat, filt))
+        want = np.bitwise_count(mat & filt).sum(axis=-1).astype(np.int32)
+        np.testing.assert_array_equal(got, want)
+
+    def _count_and():
+        a, b = words(1 << 18), words(1 << 18)
+        got = int(pk._count_and_pallas(a, b))
+        want = int(np.bitwise_count(a & b).sum(dtype=np.uint64))
+        assert got == want, (got, want)
+
+    def _bsi_compare():
+        depth = 21
+        planes, filt = words(2 + depth, 8192), words(8192)
+        upred = int(rng.integers(0, 1 << depth))
+        lt, gt = pk.bsi_compare_unsigned(planes, filt, upred, depth)
+        wlt, wgt = pk._bsi_compare_jnp(planes, filt, upred, depth)
+        np.testing.assert_array_equal(np.asarray(lt), np.asarray(wlt))
+        np.testing.assert_array_equal(np.asarray(gt), np.asarray(wgt))
+
+    def _mmc():
+        import jax.numpy as jnp
+
+        mat, masks = words(200, 1024), words(17, 1024)
+        got = np.asarray(pk._mmc_pallas(jnp.asarray(mat),
+                                        jnp.asarray(masks)))
+        want = np.bitwise_count(
+            mat[None, :, :] & masks[:, None, :]).sum(axis=-1)
+        np.testing.assert_array_equal(got, want.astype(np.int32))
+
+    check("row_counts_masked", _row_counts)
+    check("count_and", _count_and)
+    check("bsi_compare_unsigned", _bsi_compare)
+    check("masked_matrix_counts", _mmc)
+
+    payload = {
+        "status": "ran",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "kernels": results,
+        "all_ok": all(r["ok"] for r in results.values()),
+    }
+    json.dump(payload, open(OUT, "w"), indent=1)
+    print(json.dumps({"all_ok": payload["all_ok"]}))
+    return 0 if payload["all_ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

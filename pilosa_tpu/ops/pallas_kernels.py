@@ -151,6 +151,73 @@ def count_and(a, b, interpret: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# GroupBy cartesian counts: out[g, r] = |mat[r] & masks[g]| — one pass
+# over the row matrix per mask block, [GB, RB, WB] intermediate in VMEM
+# (SURVEY §7's third Pallas target; groupByIterator, executor.go:3058)
+# ---------------------------------------------------------------------------
+
+MMC_GROUP_BLOCK = 8
+MMC_ROW_BLOCK = 128
+MMC_WORD_BLOCK = 256
+
+
+def _mmc_kernel(mat_ref, masks_ref, out_ref):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    m = mat_ref[:]        # [RB, WB]
+    g = masks_ref[:]      # [GB, WB]
+    cnt = lax.population_count(g[:, None, :] & m[None, :, :])  # [GB,RB,WB]
+    out_ref[:] += jnp.sum(cnt, axis=2, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _mmc_pallas(mat, masks, interpret: bool = False):
+    R, W = mat.shape
+    G = masks.shape[0]
+    mat = _pad_to(_pad_to(mat, 1, MMC_WORD_BLOCK), 0, MMC_ROW_BLOCK)
+    masks = _pad_to(_pad_to(masks, 1, MMC_WORD_BLOCK), 0, MMC_GROUP_BLOCK)
+    Rp, Wp = mat.shape
+    Gp = masks.shape[0]
+    grid = (Gp // MMC_GROUP_BLOCK, Rp // MMC_ROW_BLOCK,
+            Wp // MMC_WORD_BLOCK)
+    out = pl.pallas_call(
+        _mmc_kernel,
+        out_shape=jax.ShapeDtypeStruct((Gp, Rp), jnp.int32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((MMC_ROW_BLOCK, MMC_WORD_BLOCK),
+                         lambda i, j, k: (j, k)),
+            pl.BlockSpec((MMC_GROUP_BLOCK, MMC_WORD_BLOCK),
+                         lambda i, j, k: (i, k)),
+        ],
+        out_specs=pl.BlockSpec((MMC_GROUP_BLOCK, MMC_ROW_BLOCK),
+                               lambda i, j, k: (i, j)),
+        interpret=interpret,
+    )(mat, masks)
+    return out[:G, :R]
+
+
+def masked_matrix_counts(mat, masks, interpret: bool = False):
+    """counts[g, r] = |mat[r] & masks[g]| — the GroupBy inner product.
+    Pallas on TPU for big products (single HBM pass per block, VMEM
+    accumulation); the bm dispatcher elsewhere (native C++ on host
+    stacks, lax.map of fused row counts on other devices)."""
+    from pilosa_tpu.ops import bitmap as bm
+
+    R, W = mat.shape
+    G = masks.shape[0]
+    if ((interpret or on_tpu()) and not isinstance(mat, np.ndarray)
+            and G * R * W >= 1 << 18):
+        return _mmc_pallas(jnp.asarray(mat), jnp.asarray(masks),
+                           interpret=interpret)
+    return bm.masked_matrix_counts(mat, masks)
+
+
+# ---------------------------------------------------------------------------
 # BSI ripple compare: keep/lt/gt masks across bit planes, all in VMEM
 # ---------------------------------------------------------------------------
 

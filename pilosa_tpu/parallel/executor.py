@@ -101,8 +101,6 @@ class Executor:
     def execute(self, index_name: str, query, shards=None, opt: ExecOptions | None = None):
         """Execute a PQL query string or Query -> list of results
         (reference executor.Execute, executor.go:113)."""
-        from pilosa_tpu import tracing
-
         opt = opt or ExecOptions()
         if isinstance(query, str):
             query = parse(query)
@@ -1041,8 +1039,13 @@ class Executor:
                 if masks is None:
                     cnts = np.asarray(bm.row_counts(matrix))[None, :]
                 else:
+                    # Pallas single-pass kernel on TPU for large
+                    # products, bm dispatch (native host / jit)
+                    # otherwise — identical counts
+                    from pilosa_tpu.ops import pallas_kernels as pk
+
                     cnts = np.asarray(
-                        bm.masked_matrix_counts(matrix,
+                        pk.masked_matrix_counts(matrix,
                                                 masks))[:len(prefixes)]
                 nz_g, nz_r = np.nonzero(cnts)
                 if len(nz_g) == 0:

@@ -101,6 +101,9 @@ def extract_headers(headers) -> RemoteParent | None:
         return None
     trace_id, span_id = parts[1], parts[2]
     hexdigits = set("0123456789abcdef")
+    if (len(parts[0]) != 2 or not set(parts[0]) <= hexdigits
+            or parts[0] == "ff"):
+        return None  # W3C: malformed or explicitly-invalid version
     if not (set(trace_id) <= hexdigits and set(span_id) <= hexdigits):
         return None  # W3C: non-hex ids are invalid
     if trace_id == "0" * 32 or span_id == "0" * 16:
