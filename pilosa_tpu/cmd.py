@@ -383,6 +383,7 @@ def cmd_check(args) -> int:
                     for shard, frag in sorted(view.fragments.items()):
                         label = f"{d['name']}/{f.name}/{vname}/{shard}"
                         try:
+                            frag.check()  # structural invariants
                             blob = frag.to_roaring()
                             decode_roaring(blob)
                             for r in frag.row_ids():

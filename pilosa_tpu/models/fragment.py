@@ -277,6 +277,48 @@ class Fragment:
                 mgr.forget(self._device_cache, k)
             self._device_cache.clear()
 
+    def check(self) -> None:
+        """Invariant validator (reference roaring.Bitmap.Check,
+        roaring/roaring.go:1664): raises ValueError on the first
+        violated structural invariant.  Run by ``pilosa-tpu check``,
+        the paranoia gate after mutations, and tests."""
+        with self._lock:
+            for rid, arr in self._rows.items():
+                if not isinstance(rid, int) or rid < 0:
+                    raise ValueError(f"invalid row id {rid!r}")
+                if not isinstance(arr, np.ndarray):
+                    raise ValueError(f"row {rid}: not an ndarray")
+                if arr.dtype != np.uint32:
+                    raise ValueError(f"row {rid}: dtype {arr.dtype}")
+                if arr.shape != (self.n_words,):
+                    raise ValueError(
+                        f"row {rid}: shape {arr.shape} != ({self.n_words},)")
+            if self._stack_cache is not None:
+                gen, ids, matrix = self._stack_cache
+                if gen == self._gen:
+                    # row_ids() (not len(_rows)): rows cleared to
+                    # all-zero stay in _rows but are excluded from the
+                    # stack, by design
+                    if len(ids) != len(self.row_ids()):
+                        raise ValueError(
+                            "stack cache row count diverged from rows")
+                    if not np.all(ids[:-1] < ids[1:]):
+                        raise ValueError("stack cache ids not sorted")
+            if self._op_n < 0:
+                raise ValueError(f"negative op count {self._op_n}")
+            if self.path is not None and not self._closed:
+                if self._wal is None and not self._snapshotting:
+                    raise ValueError("open durable fragment without a WAL")
+
+    #: process-wide paranoia gate (reference build-tag paranoia checks,
+    #: roaring/roaring_paranoia.go): when PILOSA_TPU_PARANOIA=1, every
+    #: mutation re-validates invariants before returning
+    PARANOIA = os.environ.get("PILOSA_TPU_PARANOIA", "") == "1"
+
+    def _paranoia_check(self) -> None:
+        if Fragment.PARANOIA:
+            self.check()
+
     def _maybe_snapshot(self) -> None:
         """Past the opN threshold, queue a background compaction — the
         writing thread never stalls on it (reference holder.go:163
@@ -359,6 +401,7 @@ class Fragment:
             if changed:
                 self._gen += 1
             self._maybe_snapshot()
+            self._paranoia_check()
             return changed
 
     def clear_bit(self, row: int, col: int) -> bool:
@@ -369,6 +412,7 @@ class Fragment:
                 self._op_n += 1
                 self._gen += 1
                 self._maybe_snapshot()
+                self._paranoia_check()
                 return True
             return False
 
@@ -386,6 +430,7 @@ class Fragment:
             self._op_n += len(pos)
             self._gen += 1
             self._maybe_snapshot()
+            self._paranoia_check()
             return True
 
     def set_row(self, row: int, words: np.ndarray) -> bool:
@@ -410,6 +455,7 @@ class Fragment:
             self._op_n += len(sets) + len(clears)
             self._gen += 1
             self._maybe_snapshot()
+            self._paranoia_check()
             return True
 
     def import_positions(self, set_pos, clear_pos=()) -> None:
@@ -428,6 +474,7 @@ class Fragment:
             self._op_n += len(sets) + len(clears)
             self._gen += 1
             self._maybe_snapshot()
+            self._paranoia_check()
 
     # ------------------------------------------------- roaring interchange
 
@@ -485,6 +532,7 @@ class Fragment:
                 self._op_n += len(pos)
                 self._gen += 1
                 self._maybe_snapshot()
+            self._paranoia_check()
 
     def to_roaring(self) -> bytes:
         """Serialize the whole fragment as one roaring bitmap in fragment
@@ -744,6 +792,7 @@ class Fragment:
             self._op_n += 2
             self._gen += 1
             self._maybe_snapshot()
+            self._paranoia_check()
         return changed
 
     def clear_value(self, col: int, depth: int) -> bool:

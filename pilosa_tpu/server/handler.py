@@ -711,6 +711,49 @@ class Handler:
                        + "".join(traceback.format_stack(frame)))
         self._bytes(req, "\n".join(out).encode(), "text/plain")
 
+    @route("GET", "/debug/pprof/profile")
+    def handle_debug_profile(self, req, params, path, body):
+        """Statistical wall-clock profile over ?seconds=N (default 2,
+        max 30): samples every thread's stack at ~100Hz and returns
+        collapsed stacks ("frame;frame;frame count" lines, flamegraph
+        format) — the CPU-profile analog of /debug/pprof/profile
+        (http/handler.go:280).  Wall-clock (not CPU-time) sampling also
+        surfaces lock waits, covering the block/mutex profile role
+        (server/config.go:151-156)."""
+        import sys
+        import time as _time
+        from collections import Counter
+
+        import math
+
+        try:
+            seconds = float(params.get("seconds", 2))
+        except ValueError:
+            raise ApiError("invalid seconds parameter")
+        if not math.isfinite(seconds):  # nan/inf defeat the clamp
+            raise ApiError("invalid seconds parameter")
+        seconds = min(max(seconds, 0.1), 30.0)
+        interval = 0.01
+        me = threading.get_ident()
+        counts: Counter = Counter()
+        deadline = _time.monotonic() + seconds
+        while _time.monotonic() < deadline:
+            for ident, frame in sys._current_frames().items():
+                if ident == me:
+                    continue  # the sampler itself is noise
+                stack = []
+                f = frame
+                while f is not None:
+                    code = f.f_code
+                    stack.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                                 f"{code.co_name}")
+                    f = f.f_back
+                counts[";".join(reversed(stack))] += 1
+            _time.sleep(interval)
+        out = "\n".join(f"{stack} {n}"
+                        for stack, n in counts.most_common())
+        self._bytes(req, out.encode(), "text/plain")
+
     @route("GET", "/debug/vars")
     def handle_debug_vars(self, req, params, path, body):
         snap = {}

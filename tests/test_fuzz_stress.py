@@ -17,6 +17,19 @@ from pilosa_tpu.pql import parse, parse_python
 from pilosa_tpu.pql.parser import ParseError
 
 
+@pytest.fixture(autouse=True)
+def _paranoia_on():
+    """The fuzz/stress tier runs with the paranoia gate enabled: every
+    fragment mutation re-validates invariants (the reference's
+    build-tag paranoia, roaring/roaring_paranoia.go)."""
+    from pilosa_tpu.models.fragment import Fragment
+
+    orig = Fragment.PARANOIA
+    Fragment.PARANOIA = True
+    yield
+    Fragment.PARANOIA = orig
+
+
 class TestRoaringFuzz:
     """Decode must reject malformed input with RoaringError — never
     segfault, hang, or return garbage silently (roaring/fuzzer.go)."""

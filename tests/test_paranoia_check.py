@@ -1,0 +1,139 @@
+"""Invariant validator + paranoia gate + profile endpoint (closing the
+reference parity gaps: roaring.Bitmap.Check roaring/roaring.go:1664,
+build-tag paranoia roaring/roaring_paranoia.go, /debug/pprof
+http/handler.go:280)."""
+
+from __future__ import annotations
+
+import urllib.error
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.models.fragment import Fragment
+
+
+def _mk(path):
+    return Fragment(str(path), "i", "f", "standard", 0)
+
+
+def test_check_passes_on_healthy_fragment(tmp_path):
+    frag = _mk(tmp_path / "frag")
+    for i in range(100):
+        frag.set_bit(i % 5, i * 31)
+    frag.check()
+    frag.close()
+
+
+def test_check_catches_corruptions(tmp_path):
+    frag = _mk(tmp_path / "frag")
+    frag.set_bit(1, 5)
+
+    frag._rows[2] = np.zeros(3, dtype=np.uint32)  # wrong shape
+    with pytest.raises(ValueError, match="shape"):
+        frag.check()
+    del frag._rows[2]
+
+    frag._rows[3] = np.zeros(frag.n_words, dtype=np.uint64)  # wrong dtype
+    with pytest.raises(ValueError, match="dtype"):
+        frag.check()
+    del frag._rows[3]
+
+    frag._rows[-1] = np.zeros(frag.n_words, dtype=np.uint32)  # bad id
+    with pytest.raises(ValueError, match="row id"):
+        frag.check()
+    del frag._rows[-1]
+
+    frag._op_n = -5
+    with pytest.raises(ValueError, match="op count"):
+        frag.check()
+    frag._op_n = 0
+    frag.close()
+
+
+def test_check_catches_missing_wal(tmp_path):
+    frag = _mk(tmp_path / "frag")
+    frag._wal.close()
+    frag._wal = None
+    with pytest.raises(ValueError, match="WAL"):
+        frag.check()
+    frag._closed = True  # skip the close-path WAL handling
+    frag._device_cache.clear()
+
+
+def test_paranoia_gate_validates_every_mutation(tmp_path):
+    orig = Fragment.PARANOIA
+    Fragment.PARANOIA = True
+    try:
+        frag = _mk(tmp_path / "frag")
+        for i in range(50):
+            frag.set_bit(i % 3, i * 17)
+        frag.clear_bit(0, 0)
+        frag.import_positions([7 * frag.width + 3, 8 * frag.width + 9])
+        # a violated invariant now surfaces AT the mutation
+        frag._rows[99] = np.zeros(1, dtype=np.uint32)
+        with pytest.raises(ValueError, match="shape"):
+            frag.set_bit(1, 1)
+        del frag._rows[99]
+        frag.close()
+    finally:
+        Fragment.PARANOIA = orig
+
+
+def test_cli_check_uses_validator(tmp_path):
+    from pilosa_tpu import cmd
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.parallel.executor import Executor
+
+    d = str(tmp_path / "h")
+    h = Holder(d)
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    f.import_bits([1, 2], [3, 4])
+    h.close()
+
+    class A:
+        data_dir = d
+
+    assert cmd.cmd_check(A()) == 0
+
+
+def test_debug_profile_endpoint(tmp_path):
+    import threading
+    import time
+    import urllib.request
+
+    from pilosa_tpu.server.server import Server
+
+    s = Server(data_dir=str(tmp_path / "n0"), coordinator=True)
+    s.open()
+    try:
+        # a busy background thread so the sampler has something to see
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                sum(range(1000))
+
+        t = threading.Thread(target=spin, name="spinner")
+        t.start()
+        try:
+            raw = urllib.request.urlopen(
+                s.uri + "/debug/pprof/profile?seconds=0.3",
+                timeout=30).read().decode()
+        finally:
+            stop.set()
+            t.join()
+        lines = [ln for ln in raw.splitlines() if ln.strip()]
+        assert lines, "no samples collected"
+        # collapsed format: 'frame;frame;... N'
+        stack, n = lines[0].rsplit(" ", 1)
+        assert int(n) >= 1 and ";" in stack
+        assert any("spin" in ln for ln in lines)
+        # bad parameter -> 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                s.uri + "/debug/pprof/profile?seconds=bogus", timeout=10)
+        assert ei.value.code == 400
+    finally:
+        s.close()
