@@ -443,10 +443,26 @@ class Field:
                         np.bitwise_or(stack[i], arr, out=stack[i])
         return self._place_and_cache_stack(key, gens, stack)
 
+    @staticmethod
+    def _entry_cap(fixed_cap: int) -> int:
+        """Per-entry cacheability cap: the fixed default, or a quarter
+        of the residency budget when the OPERATOR sized the budget for
+        a bigger working set (a 10B-column row stack is ~1.25 GB — it
+        must be cacheable on a machine provisioned for it).  A probed
+        default budget never relaxes the cap: on a big device a giant
+        one-off stack must stay uncacheable rather than evict the
+        whole warm cache."""
+        from pilosa_tpu.runtime import residency
+
+        mgr = residency.manager()
+        if not mgr.operator_sized:
+            return fixed_cap
+        return max(fixed_cap, mgr.budget // 4)
+
     def _place_and_cache_stack(self, key, gens, stack: np.ndarray):
         dev = self._place_on_devices(stack)
         entry_bytes = stack.nbytes
-        if entry_bytes > self.ROW_STACK_CACHE_BYTES:
+        if entry_bytes > self._entry_cap(self.ROW_STACK_CACHE_BYTES):
             return dev  # uncacheable; never evict the warm cache for it
         self._evict_and_insert(
             self._row_stack_cache, key, (gens, dev), entry_bytes,
@@ -535,7 +551,7 @@ class Field:
         pos_dev = self._place_on_devices(shard_pos)
         entry = (gens, row_ids, shard_pos, pos_dev, mat_dev)
         entry_bytes = big.nbytes
-        if entry_bytes > self.MATRIX_STACK_CACHE_BYTES:
+        if entry_bytes > self._entry_cap(self.MATRIX_STACK_CACHE_BYTES):
             return entry  # uncacheable; don't evict the warm cache for it
         self._evict_and_insert(
             self._matrix_stack_cache, key, entry, entry_bytes,

@@ -58,6 +58,15 @@ void pt_row_counts(const uint32_t* mat, long long rows, long long n32,
         out[r] = (int32_t)pt_count(mat + r * n32, n32);
 }
 
+// out[r] = |a[r] & b[r]| — pairwise per-row intersection counts with no
+// materialized intermediate (the Count(Intersect(Row,Row)) hot path on
+// stacked shard operands).
+void pt_row_counts_and(const uint32_t* a, const uint32_t* b,
+                       long long rows, long long n32, int32_t* out) {
+    for (long long r = 0; r < rows; r++)
+        out[r] = (int32_t)pt_count_and(a + r * n32, b + r * n32, n32);
+}
+
 // out[r] = |mat[r] & filt| (TopN/GroupBy inner loop).
 void pt_row_counts_masked(const uint32_t* mat, const uint32_t* filt,
                           long long rows, long long n32, int32_t* out) {

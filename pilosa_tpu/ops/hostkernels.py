@@ -53,6 +53,8 @@ def _setup(lib) -> None:
     lib.pt_count_and.argtypes = [VP, VP, LL]
     lib.pt_row_counts.restype = None
     lib.pt_row_counts.argtypes = [VP, LL, LL, IP]
+    lib.pt_row_counts_and.restype = None
+    lib.pt_row_counts_and.argtypes = [VP, VP, LL, LL, IP]
     lib.pt_row_counts_masked.restype = None
     lib.pt_row_counts_masked.argtypes = [VP, VP, LL, LL, IP]
     lib.pt_row_counts_gathered.restype = None
@@ -112,6 +114,21 @@ def row_counts(mat: np.ndarray) -> np.ndarray:
     mat = _c(mat)
     out = np.empty(lead, dtype=np.int32)
     lib.pt_row_counts(mat.ctypes.data, rows, words, out.ctypes.data)
+    return out
+
+
+def row_counts_and(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """int32[rows] of |a[r] & b[r]| — no materialized intersection."""
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch: {a.shape} vs {b.shape}")
+    lib = _NATIVE.load()
+    if lib is None:
+        return np.bitwise_count(a & b).sum(axis=-1).astype(np.int32)
+    a, b = _c(a), _c(b)
+    rows, words = a.shape
+    out = np.empty(rows, dtype=np.int32)
+    lib.pt_row_counts_and(a.ctypes.data, b.ctypes.data,
+                          rows, words, out.ctypes.data)
     return out
 
 

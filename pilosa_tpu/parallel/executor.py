@@ -657,10 +657,18 @@ class Executor:
             # one fused AND/OR/popcount dispatch for the whole group;
             # per-shard int32 counts summed in Python ints — a single
             # int32 reduce over the stack could wrap past 2^31 set bits
-            stack = self._fused_eval(idx, child, tuple(group))
+            if child.name == "Intersect" and len(child.children) == 2:
+                # pairwise fast path: count |a & b| per shard without
+                # materializing the intersection stack (at 10B columns
+                # that intermediate alone is ~1.25 GB per query)
+                a = self._fused_eval(idx, child.children[0], tuple(group))
+                b = self._fused_eval(idx, child.children[1], tuple(group))
+                counts = bm.row_counts_and(a, b)
+            else:
+                stack = self._fused_eval(idx, child, tuple(group))
+                counts = bm.row_counts(stack)
             return [int(c) for c in
-                    np.asarray(bm.row_counts(stack),
-                               dtype=np.int64)[:len(group)]]
+                    np.asarray(counts, dtype=np.int64)[:len(group)]]
 
         if fused_ok and not self._cluster_active(opt):
             return sum(batch_fn(shards))

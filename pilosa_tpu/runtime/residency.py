@@ -38,6 +38,10 @@ def live(dev) -> bool:
         return True
 
 
+def _operator_sized() -> bool:
+    return bool(os.environ.get("PILOSA_TPU_DEVICE_BUDGET_BYTES"))
+
+
 def _default_budget() -> int:
     env = os.environ.get("PILOSA_TPU_DEVICE_BUDGET_BYTES")
     if env:
@@ -69,6 +73,10 @@ class ResidencyManager:
 
     def __init__(self, budget_bytes: int | None = None):
         self.budget = budget_bytes or _default_budget()
+        # True when the budget was chosen by an operator (explicit
+        # constructor arg or env var) rather than probed; cache-entry
+        # caps only relax for deliberately-sized deployments
+        self.operator_sized = budget_bytes is not None or _operator_sized()
         self._lock = threading.Lock()
         # (owner dict id, key) -> (owner dict, key, nbytes); dict
         # preserves insertion order = LRU order (move-to-end on touch)

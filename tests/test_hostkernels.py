@@ -126,3 +126,21 @@ def test_host_mode_gate():
     # under the 8-device conftest mesh host_mode is off; the dispatchers
     # engage purely on operand type (numpy in, numpy out)
     assert not bm.host_mode()
+
+
+def test_row_counts_and_matches_oracle():
+    a, b = rand(6, 129), rand(6, 129)
+    got = hk.row_counts_and(a, b)
+    assert np.array_equal(got, np.bitwise_count(a & b).sum(axis=-1))
+    with pytest.raises(ValueError):
+        hk.row_counts_and(a, b[:3])
+
+
+def test_bm_row_counts_and_dispatch():
+    import jax
+
+    a, b = rand(4, 64), rand(4, 64)
+    host = bm.row_counts_and(a, b)
+    assert isinstance(host, np.ndarray)
+    dev = bm.row_counts_and(jax.device_put(a), jax.device_put(b))
+    assert np.array_equal(host, np.asarray(dev))

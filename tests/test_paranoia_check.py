@@ -83,7 +83,6 @@ def test_paranoia_gate_validates_every_mutation(tmp_path):
 def test_cli_check_uses_validator(tmp_path):
     from pilosa_tpu import cmd
     from pilosa_tpu.models.holder import Holder
-    from pilosa_tpu.parallel.executor import Executor
 
     d = str(tmp_path / "h")
     h = Holder(d)
