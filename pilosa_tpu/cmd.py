@@ -109,6 +109,13 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
                stop_event: threading.Event | None = None) -> int:
     """Build and run a node until SIGTERM/SIGINT (reference
     server.Command.Start, server/server.go:137-220)."""
+    # Multi-host data plane joins FIRST: jax.distributed must see a
+    # fresh runtime, before any import triggers backend init (no-op
+    # unless JAX_NUM_PROCESSES/JAX_COORDINATOR_ADDRESS are set).
+    from pilosa_tpu.parallel import multihost
+
+    multihost.initialize()
+
     from pilosa_tpu import stats as _stats
     from pilosa_tpu import tracing as _tracing
     from pilosa_tpu.logger import StandardLogger, VerboseLogger

@@ -44,6 +44,7 @@ from pilosa_tpu.parallel.results import (
 )
 from pilosa_tpu.pql import Call, Query, parse
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu import stats as _stats
 from pilosa_tpu import tracing
 
 
@@ -80,8 +81,6 @@ _EMPTY_ROWS_CALL = "_EmptyRows"
 
 class Executor:
     def __init__(self, holder, worker_pool_size: int | None = None, cluster=None):
-        from pilosa_tpu import stats as _stats
-
         self.holder = holder
         self.cluster = cluster  # optional cluster layer
         self.node = None  # back-ref set by ClusterNode (shard broadcasts)
@@ -124,8 +123,11 @@ class Executor:
                 self.stats.count_with_tags(
                     "query", 1, 1.0, [f"index:{index_name}",
                                       f"call:{call.name}"])
-                with tracing.start_span(
-                        f"executor.execute{call.name}", span):
+                # per-op latency via the shared timing surface
+                # (exception-safe: failed calls record too)
+                with _stats.Timer(self.stats, f"execute.{call.name}"), \
+                        tracing.start_span(
+                            f"executor.execute{call.name}", span):
                     results.append(self._execute_call(idx, call, shards, opt))
             if not opt.remote:
                 results = [

@@ -1,0 +1,110 @@
+"""Multi-host bootstrap: one SPMD mesh spanning TPU pods over ICI/DCN.
+
+The reference scales across machines with its NCCL-free HTTP
+scatter-gather (executor.go:2455); the TPU-native equivalent keeps TWO
+planes, per SURVEY.md §5:
+
+- **data plane**: `jax.distributed` + a `Mesh` over every chip of every
+  host — XLA routes `psum`/all-reduce over ICI within a slice and DCN
+  between slices.  The same `parallel/mesh.py` programs run unchanged;
+  only device enumeration differs (``jax.devices()`` is global after
+  `initialize`).
+- **control plane**: the HTTP cluster (membership, DDL, AE, resize)
+  stays as-is — one `pilosa_tpu` server process per TPU host, each
+  owning the shards whose stacks live on its local chips.
+
+``initialize`` wraps `jax.distributed.initialize` with the env-var
+conventions used by TPU launchers; ``global_mesh`` builds the shard
+mesh over all processes' devices.  A single-process call (the default)
+is a no-op bootstrap over local devices, so every code path here is
+exercised by ordinary CI (`tests/test_multihost.py`); real multi-pod
+runs only change the env vars.
+"""
+
+from __future__ import annotations
+
+import os
+
+_initialized = False
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join the global jax runtime.  Arguments fall back to the
+    standard launcher env vars (JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID); with one process (or no
+    configuration at all) this is a local no-op bootstrap, so the same
+    server entry point works on a laptop and on a pod slice."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = (coordinator_address
+                           or os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    if num_processes <= 1 and coordinator_address is None:
+        _initialized = True  # single host: local devices are the world
+        return
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        if xla_bridge._backends:
+            raise RuntimeError(
+                "multihost.initialize() must run before any JAX "
+                "computation — call it first thing in the launcher "
+                "(cmd.run_server does) so jax.distributed can join the "
+                "global runtime before backends initialize")
+    except ImportError:  # private module moved: let jax raise its own
+        pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+
+
+def global_mesh(axis_name: str = "shards"):
+    """The shard mesh over EVERY process's devices.  After
+    ``initialize`` on n hosts, ``jax.devices()`` enumerates all chips;
+    the 1-D shard axis therefore spans hosts and XLA places collectives
+    on ICI within a slice and DCN across slices (the scaling-book
+    recipe: pick the mesh, annotate shardings, let XLA insert the
+    collectives)."""
+    from pilosa_tpu.parallel import mesh as pmesh
+
+    initialize()
+    return pmesh.device_mesh(axis_name=axis_name)
+
+
+def process_info() -> dict:
+    """(process_index, process_count, local/global device counts) — the
+    node-identity surface a launcher or /status endpoint reports."""
+    import jax
+
+    initialize()
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(jax.devices()),
+    }
+
+
+def local_shard_slice(n_shards: int) -> range:
+    """The contiguous block of the shard space this process's chips
+    own under the global mesh layout — the multi-host analog of the
+    cluster's jump-hash ownership (data-plane placement is
+    block-contiguous so stacks shard evenly; the HTTP control plane
+    keeps its own hash placement for fragment storage)."""
+    import jax
+
+    initialize()
+    per = -(-n_shards // jax.process_count())
+    start = jax.process_index() * per
+    return range(start, min(start + per, n_shards))

@@ -1,0 +1,36 @@
+"""Multi-host bootstrap: the single-process paths every CI run can
+exercise (real pods only change env vars — SURVEY.md §5 comm backend)."""
+
+import numpy as np
+
+from pilosa_tpu.parallel import mesh as pmesh
+from pilosa_tpu.parallel import multihost
+
+
+def test_initialize_single_process_noop():
+    multihost.initialize()  # no coordinator configured: local world
+    info = multihost.process_info()
+    assert info["process_count"] == 1
+    assert info["process_index"] == 0
+    assert info["global_devices"] == info["local_devices"] == 8
+
+
+def test_global_mesh_runs_collectives():
+    mesh = multihost.global_mesh()
+    assert mesh.devices.size == 8
+    rng = np.random.default_rng(3)
+    a = rng.integers(0, 1 << 32, size=(16, 64), dtype=np.uint32)
+    b = rng.integers(0, 1 << 32, size=(16, 64), dtype=np.uint32)
+    got = pmesh.count_intersect(mesh, pmesh.shard_stack(mesh, a),
+                                pmesh.shard_stack(mesh, b))
+    assert got == int(np.bitwise_count(a & b).sum())
+
+
+def test_local_shard_slice_partitions_cleanly():
+    sl = multihost.local_shard_slice(100)
+    assert sl == range(0, 100)  # single process owns everything
+    # the partition math: across k processes the blocks tile the space
+    import jax
+
+    per = -(-100 // jax.process_count())
+    assert per * jax.process_count() >= 100
