@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 
 _initialized = False
+_initialized_distributed = False
 
 
 def initialize(coordinator_address: str | None = None,
@@ -33,19 +34,31 @@ def initialize(coordinator_address: str | None = None,
                process_id: int | None = None) -> None:
     """Join the global jax runtime.  Arguments fall back to the
     standard launcher env vars (JAX_COORDINATOR_ADDRESS /
-    JAX_NUM_PROCESSES / JAX_PROCESS_ID); with one process (or no
-    configuration at all) this is a local no-op bootstrap, so the same
-    server entry point works on a laptop and on a pod slice."""
-    global _initialized
-    if _initialized:
-        return
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID); values absent everywhere stay
+    ``None`` so `jax.distributed.initialize` auto-detects them from
+    the platform (Cloud TPU metadata sets process count/id itself).
+    With no configuration at all this is a local no-op bootstrap, so
+    the same server entry point works on a laptop and on a pod
+    slice."""
+    global _initialized, _initialized_distributed
     coordinator_address = (coordinator_address
                            or os.environ.get("JAX_COORDINATOR_ADDRESS"))
     if num_processes is None:
-        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+        env_np = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(env_np) if env_np else None
     if process_id is None:
-        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
-    if num_processes <= 1 and coordinator_address is None:
+        env_pid = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(env_pid) if env_pid else None
+    wants_distributed = (coordinator_address is not None
+                         or (num_processes or 1) > 1)
+    if _initialized:
+        if wants_distributed and not _initialized_distributed:
+            raise RuntimeError(
+                "multihost.initialize() was already completed as a "
+                "single-host bootstrap (an argless helper ran first); "
+                "the distributed join must be the FIRST call")
+        return
+    if not wants_distributed:
         _initialized = True  # single host: local devices are the world
         return
     import jax
@@ -53,7 +66,7 @@ def initialize(coordinator_address: str | None = None,
     try:
         from jax._src import xla_bridge
 
-        if xla_bridge._backends:
+        if getattr(xla_bridge, "_backends", None):
             raise RuntimeError(
                 "multihost.initialize() must run before any JAX "
                 "computation — call it first thing in the launcher "
@@ -67,9 +80,10 @@ def initialize(coordinator_address: str | None = None,
         process_id=process_id,
     )
     _initialized = True
+    _initialized_distributed = True
 
 
-def global_mesh(axis_name: str = "shards"):
+def global_mesh(axis_name: str | None = None):
     """The shard mesh over EVERY process's devices.  After
     ``initialize`` on n hosts, ``jax.devices()`` enumerates all chips;
     the 1-D shard axis therefore spans hosts and XLA places collectives
@@ -79,7 +93,8 @@ def global_mesh(axis_name: str = "shards"):
     from pilosa_tpu.parallel import mesh as pmesh
 
     initialize()
-    return pmesh.device_mesh(axis_name=axis_name)
+    return pmesh.device_mesh(
+        axis_name=pmesh.SHARD_AXIS if axis_name is None else axis_name)
 
 
 def process_info() -> dict:

@@ -322,3 +322,21 @@ class TestStatsAndTracing:
         finally:
             tracing.set_global_tracer(old)
         holder.close()
+
+
+def test_tracing_endpoint_config_roundtrip(tmp_path):
+    """[tracing] endpoint parses from TOML and survives the
+    generate-config round-trip (env pinned so ambient PILOSA_TPU_*
+    variables cannot leak in)."""
+    from pilosa_tpu.config import Config
+
+    cfg_path = tmp_path / "c.toml"
+    cfg_path.write_text(
+        '[tracing]\nenabled = true\nendpoint = "http://collector:4318"\n')
+    cfg = Config.load(str(cfg_path), env={})
+    assert cfg.tracing.enabled is True
+    assert cfg.tracing.endpoint == "http://collector:4318"
+    dumped = cfg.to_toml()
+    assert 'endpoint = "http://collector:4318"' in dumped
+    cfg2 = Config.load(None, env={})
+    assert cfg2.tracing.endpoint == ""
