@@ -1,0 +1,41 @@
+# Developer surface, mirroring the reference's Makefile targets
+# (test / bench / clustertests) and its CI matrix (-race runs and the
+# SHARD_WIDTH build-tag job, .circleci/config.yml:52-64) adapted to
+# this build: the paranoia gate is our sanitizer tier and the shard
+# width is env-configurable rather than a build tag.
+
+PY ?= python
+
+.PHONY: test test-paranoia test-shard22 test-matrix bench measure validate-tpu check clean
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+# sanitizer tier: every fragment mutation re-validates invariants
+test-paranoia:
+	PILOSA_TPU_PARANOIA=1 $(PY) -m pytest tests/ -x -q
+
+# shard-width independence (reference SHARD_WIDTH=22 matrix job)
+test-shard22:
+	PILOSA_TPU_SHARD_WIDTH_EXP=22 $(PY) -m pytest tests/ -x -q
+
+test-matrix: test test-paranoia test-shard22
+
+# north-star benchmark: one JSON line (driver artifact)
+bench:
+	$(PY) bench.py
+
+# all BASELINE.md configs, one JSON line each
+measure:
+	$(PY) benchmarks/measure.py
+
+# on-chip Pallas validation (no-op skip without a TPU)
+validate-tpu:
+	$(PY) benchmarks/validate_tpu.py
+
+# offline data-dir integrity (usage: make check DIR=/path/to/data)
+check:
+	$(PY) -m pilosa_tpu check $(DIR)
+
+clean:
+	rm -rf pilosa_tpu/native/build __pycache__ **/__pycache__
