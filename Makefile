@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: test test-paranoia test-shard22 test-matrix bench measure validate-tpu check clean
+.PHONY: test test-paranoia test-shard22 test-matrix bench measure validate-tpu soak check clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -32,6 +32,11 @@ measure:
 # on-chip Pallas validation (no-op skip without a TPU)
 validate-tpu:
 	$(PY) benchmarks/validate_tpu.py
+
+# long randomized differential soak (usage: make soak SOAK_SECONDS=1500)
+SOAK_SECONDS ?= 300
+soak:
+	$(PY) tools/soak.py --seconds $(SOAK_SECONDS)
 
 # offline data-dir integrity (usage: make check DIR=/path/to/data)
 check:

@@ -366,14 +366,22 @@ class Field:
                 self._touch(self._row_stack_cache, key)
                 return hit[1]
         n_words = bm.n_words(SHARD_WIDTH)
-        stack = np.zeros((_padded_rows(len(shards)), n_words),
+        # np.empty, zeroing only rows no fragment fills: at north-star
+        # scale the stack is ~1.25 GB and a full memset is a whole
+        # extra memory pass before the copies even start
+        stack = np.empty((_padded_rows(len(shards)), n_words),
                          dtype=np.uint32)
         for i, frag in enumerate(frags):
+            copied = False
             if frag is not None:
-                with frag._lock:
+                with frag._lock:  # consistent snapshot of a live row
                     arr = frag._rows.get(row_id)
                     if arr is not None:
                         stack[i] = arr
+                        copied = True
+            if not copied:
+                stack[i] = 0
+        stack[len(shards):] = 0  # device-count padding rows
         return self._place_and_cache_stack(key, gens, stack)
 
     @staticmethod

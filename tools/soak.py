@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Randomized differential soak: hours-scale stress beyond the CI tier.
+
+Drives a replicated in-process 3-node cluster with an interleaved
+random workload — bulk imports, PQL Set/Clear, BSI writes, nested set
+algebra, BSI ranges, TopN, GroupBy — checking EVERY read against
+Python-set/dict oracles, while randomly dropping a node (reads must
+fail over exactly) and running anti-entropy repair cycles.
+
+    PYTHONPATH=/root/repo:$PYTHONPATH python tools/soak.py --seconds 600
+
+Exit code 0 = no divergence.  Deterministic per --seed.  The CI-tier
+equivalents are tests/test_fuzz_stress.py and tests/test_model_stress.py;
+this harness exists to run 100x longer (the reference's long-running
+clustertests tier, internal/clustertests/).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pathlib
+import random
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH_EXP", "16")
+os.environ.setdefault("PILOSA_TPU_PARANOIA", "1")  # sanitizer on
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=300.0)
+    ap.add_argument("--seed", type=int, default=12348)
+    ap.add_argument("--progress-every", type=float, default=30.0)
+    args = ap.parse_args()
+
+    # pin jax before anything touches a backend
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from pilosa_tpu.api import API
+    from pilosa_tpu.parallel.syncer import HolderSyncer
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from tests.test_cluster import make_cluster
+    from tests.test_fuzz_stress import gen_query
+
+    rng = random.Random(args.seed)
+    tmp = pathlib.Path(tempfile.mkdtemp(prefix="soak-"))
+    transport, nodes = make_cluster(tmp, n=3, replica_n=2)
+    coord = nodes[0]
+    coord.create_index("i")
+    api = API(coord)
+
+    n_shards = 4
+    fields = [f"f{i}" for i in range(3)]
+    for f in fields:
+        coord.create_field("i", f)
+    from pilosa_tpu.models.field import FieldOptions
+
+    coord.create_field("i", "v", options=FieldOptions.int_field(-1000, 1000))
+
+    bits: dict[tuple[str, int], set] = {
+        (f, r): set() for f in fields for r in range(5)}
+    vals: dict[int, int] = {}
+    universe: set[int] = set()
+
+    def col():
+        return rng.randrange(n_shards * SHARD_WIDTH)
+
+    def eval_call(c):
+        if c.name == "Row":
+            fname = c.field_arg()
+            return set(bits.get((fname, c.args[fname]), set()))
+        subs = [eval_call(ch) for ch in c.children]
+        name = c.name
+        if name == "Union":
+            return set().union(*subs)
+        if name == "Intersect":
+            out = subs[0]
+            for s in subs[1:]:
+                out &= s
+            return out
+        if name == "Difference":
+            out = subs[0]
+            for s in subs[1:]:
+                out -= s
+            return out
+        if name == "Xor":
+            out = subs[0]
+            for s in subs[1:]:
+                out ^= s
+            return out
+        if name == "Not":
+            return universe - subs[0]
+        if name == "Count":
+            return subs[0]
+        raise AssertionError(name)
+
+    from pilosa_tpu.pql import parse_python
+
+    downed: str | None = None
+    iters = 0
+    checks = 0
+    t_end = time.monotonic() + args.seconds
+    t_report = time.monotonic() + args.progress_every
+    ex = coord.executor
+
+    while time.monotonic() < t_end:
+        iters += 1
+        action = rng.random()
+
+        if action < 0.18:  # bulk import
+            f = rng.choice(fields)
+            row = rng.randrange(5)
+            cs = sorted({col() for _ in range(rng.randrange(1, 120))})
+            if downed is None:  # writes only with all replicas up
+                api.import_bits("i", f, [row] * len(cs), cs)
+                bits[(f, row)].update(cs)
+                universe.update(cs)
+        elif action < 0.28:  # single Set / Clear via PQL
+            f = rng.choice(fields)
+            row = rng.randrange(5)
+            c = col()
+            if downed is None:
+                if rng.random() < 0.7:
+                    ex.execute("i", f"Set({c}, {f}={row})")
+                    bits[(f, row)].add(c)
+                    universe.add(c)
+                else:
+                    ex.execute("i", f"Clear({c}, {f}={row})")
+                    bits[(f, row)].discard(c)
+        elif action < 0.36:  # BSI write
+            c = col()
+            v = rng.randrange(-1000, 1001)
+            if downed is None:
+                ex.execute("i", f"Set({c}, v={v})")
+                vals[c] = v
+                universe.add(c)
+        elif action < 0.70:  # nested algebra vs oracle (any node)
+            q = gen_query(rng)
+            want = eval_call(parse_python(q).calls[0])
+            node = rng.choice(nodes)
+            if downed is not None and node.cluster.local_id == downed:
+                node = coord
+            res = node.executor.execute("i", q)[0]
+            got = (set(int(x) for x in res.columns())
+                   if hasattr(res, "columns") else None)
+            if got is not None:
+                assert got == want, f"divergence on {q}"
+            else:
+                assert int(res) == len(want), f"count divergence on {q}"
+            checks += 1
+        elif action < 0.80:  # BSI range vs oracle
+            op = rng.choice(["<", "<=", ">", ">=", "==", "!="])
+            p = rng.randrange(-1000, 1001)
+            got = ex.execute("i", f"Count(Row(v {op} {p}))")[0]
+            import operator as _op
+
+            cmp = {"<": _op.lt, "<=": _op.le, ">": _op.gt,
+                   ">=": _op.ge, "==": _op.eq, "!=": _op.ne}[op]
+            want = sum(1 for v in vals.values() if cmp(v, p))
+            assert int(got) == want, f"BSI divergence v {op} {p}"
+            checks += 1
+        elif action < 0.88:  # TopN vs oracle
+            f = rng.choice(fields)
+            pairs = ex.execute("i", f"TopN({f}, n=5)")[0]
+            want = sorted((len(cs) for (fn, r), cs in bits.items()
+                           if fn == f and cs), reverse=True)[:5]
+            assert [p.count for p in pairs] == want, f"TopN divergence {f}"
+            checks += 1
+        elif action < 0.93:  # GroupBy vs oracle (both directions)
+            fa, fb = rng.sample(fields, 2)
+            gcs = ex.execute("i", f"GroupBy(Rows({fa}), Rows({fb}))")[0]
+            got = {tuple((fr.field, fr.row_id) for fr in gc.group): gc.count
+                   for gc in gcs}
+            want = {}
+            for ra in range(5):
+                for rb in range(5):
+                    n = len(bits[(fa, ra)] & bits[(fb, rb)])
+                    if n:
+                        want[((fa, ra), (fb, rb))] = n
+            assert got == want, (
+                f"GroupBy divergence {fa}x{fb}: "
+                f"missing={set(want) - set(got)} "
+                f"extra={set(got) - set(want)}")
+            checks += 1
+        elif action < 0.97:  # fault injection: drop / restore a node
+            if downed is None:
+                downed = rng.choice(["node1", "node2"])
+                transport.set_down(downed)
+            else:
+                transport.set_down(downed, False)
+                downed = None
+        else:  # anti-entropy repair pass
+            if downed is None:
+                for nd in nodes:
+                    HolderSyncer(nd).sync_holder()
+
+        if time.monotonic() >= t_report:
+            t_report = time.monotonic() + args.progress_every
+            print(f"soak: {iters} iters, {checks} oracle checks, "
+                  f"downed={downed}", flush=True)
+
+    if downed is not None:
+        transport.set_down(downed, False)
+    for nd in nodes:
+        HolderSyncer(nd).sync_holder()
+    # final convergence: every node answers every row exactly
+    for f in fields:
+        for r in range(5):
+            want = bits[(f, r)]
+            for nd in nodes:
+                res = nd.executor.execute("i", f"Row({f}={r})")[0]
+                got = set(int(x) for x in res.columns())
+                assert got == want, f"final divergence {f}={r} on " \
+                    f"{nd.cluster.local_id}"
+    print(f"soak PASSED: {iters} iters, {checks} oracle checks")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
