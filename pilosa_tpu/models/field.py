@@ -309,6 +309,16 @@ class Field:
                 self._shards.add(shard)
                 self._save_shards()
 
+    def _note_shards(self, shards) -> None:
+        """Record many shards with ONE .shards write (bulk-import path;
+        the per-shard variant would rewrite the file per fragment)."""
+        shards = {int(s) for s in shards}
+        with self._lock:
+            new = shards - self._shards
+            if new:
+                self._shards |= new
+                self._save_shards()
+
     # ------------------------------------------------------------ bit ops
 
     def set_bit(self, row: int, col: int, timestamp: _dt.datetime | None = None) -> bool:
@@ -439,16 +449,26 @@ class Field:
                 self._touch(self._row_stack_cache, key)
                 return hit[1]
         n_words = bm.n_words(SHARD_WIDTH)
-        stack = np.zeros((_padded_rows(len(shards)), n_words),
+        # np.empty + first-contributor copy: no whole-stack memset (see
+        # device_row_stack); later contributors OR-accumulate
+        stack = np.empty((_padded_rows(len(shards)), n_words),
                          dtype=np.uint32)
         for i, frags in enumerate(frag_grid):
+            wrote = False
             for fr in frags:
                 if fr is None:
                     continue
                 with fr._lock:
                     arr = fr._rows.get(row_id)
                     if arr is not None:
-                        np.bitwise_or(stack[i], arr, out=stack[i])
+                        if wrote:
+                            np.bitwise_or(stack[i], arr, out=stack[i])
+                        else:
+                            stack[i] = arr
+                            wrote = True
+            if not wrote:
+                stack[i] = 0
+        stack[len(shards):] = 0
         return self._place_and_cache_stack(key, gens, stack)
 
     @staticmethod
@@ -624,16 +644,22 @@ class Field:
                 return hit[1]
         n_words = bm.n_words(SHARD_WIDTH)
         n_planes = bsi_ops.OFFSET_PLANE + depth
-        stack = np.zeros((_padded_rows(len(shards)), n_planes, n_words),
+        # np.empty + per-plane copy-or-zero: no whole-stack memset (see
+        # device_row_stack) — the plane stack is the largest builder
+        stack = np.empty((_padded_rows(len(shards)), n_planes, n_words),
                          dtype=np.uint32)
         for i, frag in enumerate(frags):
             if frag is None:
+                stack[i] = 0
                 continue
             with frag._lock:
                 for p in range(n_planes):
                     arr = frag._rows.get(p)
                     if arr is not None:
                         stack[i, p] = arr
+                    else:
+                        stack[i, p] = 0
+        stack[len(shards):] = 0
         return self._place_and_cache_stack(key, gens, stack)
 
     # ------------------------------------------------------------ BSI ops
@@ -905,14 +931,22 @@ class Field:
             if ts is not None:
                 for name in views_by_time(VIEW_STANDARD, ts, self.time_quantum):
                     by_frag.setdefault((name, shard), []).append(pos)
-        for (vname, shard), positions in by_frag.items():
-            view = self.create_view_if_not_exists(vname)
-            frag = view.create_fragment_if_not_exists(shard)
-            if clear:
-                frag.import_positions((), positions)
-            else:
-                frag.import_positions(positions)
-            self._note_shard(shard)
+        # one .shards write for the whole batch — per-fragment saves
+        # rewrite a growing JSON file O(n^2) times on wide imports.
+        # finally: a mid-batch failure must still register the shards
+        # already written, or their data goes invisible to queries
+        done: set[int] = set()
+        try:
+            for (vname, shard), positions in by_frag.items():
+                view = self.create_view_if_not_exists(vname)
+                frag = view.create_fragment_if_not_exists(shard)
+                if clear:
+                    frag.import_positions((), positions)
+                else:
+                    frag.import_positions(positions)
+                done.add(shard)
+        finally:
+            self._note_shards(done)
 
     def import_values(self, cols, values) -> None:
         """Bulk import of BSI values (reference Field.importValue,
@@ -951,10 +985,14 @@ class Field:
                 (sets if (uv >> i) & 1 else clears).append(pos)
             sets.append(bsi_ops.EXISTS_PLANE * SHARD_WIDTH + off)
             (sets if bv < 0 else clears).append(bsi_ops.SIGN_PLANE * SHARD_WIDTH + off)
-        for shard, (sets, clears) in by_shard.items():
-            frag = view.create_fragment_if_not_exists(shard)
-            frag.import_positions(sets, clears)
-            self._note_shard(shard)
+        done: set[int] = set()
+        try:
+            for shard, (sets, clears) in by_shard.items():
+                frag = view.create_fragment_if_not_exists(shard)
+                frag.import_positions(sets, clears)
+                done.add(shard)
+        finally:
+            self._note_shards(done)
 
     # ---------------------------------------------------------- lifecycle
 

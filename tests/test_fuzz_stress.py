@@ -109,6 +109,42 @@ def gen_query(rng: random.Random, depth: int = 0) -> str:
     return f"{op}({children})"
 
 
+def eval_set_algebra(call, row_sets, universe):
+    """Oracle evaluator for gen_query's surface: row_sets maps
+    (field, row) -> set of columns; Not complements against
+    ``universe`` (the existence column set).  Shared by the CI stress
+    tests and tools/soak.py — one oracle to keep in sync with
+    gen_query."""
+    if call.name == "Row":
+        fname = call.field_arg()
+        return set(row_sets.get((fname, call.args[fname]), set()))
+    subs = [eval_set_algebra(ch, row_sets, universe)
+            for ch in call.children]
+    name = call.name
+    if name == "Union":
+        return set().union(*subs)
+    if name == "Intersect":
+        out = subs[0]
+        for s_ in subs[1:]:
+            out = out & s_
+        return out
+    if name == "Difference":
+        out = subs[0]
+        for s_ in subs[1:]:
+            out = out - s_
+        return out
+    if name == "Xor":
+        out = subs[0]
+        for s_ in subs[1:]:
+            out = out ^ s_
+        return out
+    if name == "Not":
+        return universe - subs[0]
+    if name == "Count":
+        return subs[0]
+    raise AssertionError(name)
+
+
 class TestDistributedAgreement:
     def test_generated_queries_agree_1_vs_3_nodes(self, tmp_path):
         """Every generated query answers identically on a single node
@@ -252,37 +288,8 @@ class TestQueryGeneratorStress:
         ex = node.executor
 
         def eval_oracle(q: str):
-            node = parse_python(q).calls[0]
-            return eval_call(node)
-
-        def eval_call(c):
-            if c.name == "Row":
-                fname = c.field_arg()
-                return oracle[(fname, c.args[fname])]
-            subs = [eval_call(ch) for ch in c.children]
-            if c.name == "Union":
-                return set().union(*subs)
-            if c.name == "Intersect":
-                out = subs[0]
-                for s_ in subs[1:]:
-                    out = out & s_
-                return out
-            if c.name == "Difference":
-                out = subs[0]
-                for s_ in subs[1:]:
-                    out = out - s_
-                return out
-            if c.name == "Xor":
-                out = subs[0]
-                for s_ in subs[1:]:
-                    out = out ^ s_
-                return out
-            if c.name == "Not":
-                # executor Not is against the index existence column set
-                return universe - subs[0]
-            if c.name == "Count":
-                return subs[0]
-            raise AssertionError(c.name)
+            return eval_set_algebra(parse_python(q).calls[0], oracle,
+                                    universe)
 
         for _ in range(60):
             q = gen_query(rng)

@@ -47,7 +47,7 @@ def main() -> int:
     from pilosa_tpu.parallel.syncer import HolderSyncer
     from pilosa_tpu.shardwidth import SHARD_WIDTH
     from tests.test_cluster import make_cluster
-    from tests.test_fuzz_stress import gen_query
+    from tests.test_fuzz_stress import eval_set_algebra, gen_query
 
     rng = random.Random(args.seed)
     tmp = pathlib.Path(tempfile.mkdtemp(prefix="soak-"))
@@ -71,35 +71,6 @@ def main() -> int:
 
     def col():
         return rng.randrange(n_shards * SHARD_WIDTH)
-
-    def eval_call(c):
-        if c.name == "Row":
-            fname = c.field_arg()
-            return set(bits.get((fname, c.args[fname]), set()))
-        subs = [eval_call(ch) for ch in c.children]
-        name = c.name
-        if name == "Union":
-            return set().union(*subs)
-        if name == "Intersect":
-            out = subs[0]
-            for s in subs[1:]:
-                out &= s
-            return out
-        if name == "Difference":
-            out = subs[0]
-            for s in subs[1:]:
-                out -= s
-            return out
-        if name == "Xor":
-            out = subs[0]
-            for s in subs[1:]:
-                out ^= s
-            return out
-        if name == "Not":
-            return universe - subs[0]
-        if name == "Count":
-            return subs[0]
-        raise AssertionError(name)
 
     from pilosa_tpu.pql import parse_python
 
@@ -143,7 +114,8 @@ def main() -> int:
                 universe.add(c)
         elif action < 0.70:  # nested algebra vs oracle (any node)
             q = gen_query(rng)
-            want = eval_call(parse_python(q).calls[0])
+            want = eval_set_algebra(parse_python(q).calls[0],
+                                    bits, universe)
             node = rng.choice(nodes)
             if downed is not None and node.cluster.local_id == downed:
                 node = coord
