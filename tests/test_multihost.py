@@ -34,3 +34,72 @@ def test_local_shard_slice_partitions_cleanly():
 
     per = -(-100 // jax.process_count())
     assert per * jax.process_count() >= 100
+
+
+def test_two_process_distributed_collective(tmp_path):
+    """A REAL multi-process jax.distributed run over localhost: two
+    OS processes join via multihost.initialize (env-var path), build
+    the global mesh spanning both processes' devices, and one psum
+    crosses the process boundary with an exact result — the DCN
+    data-plane story in miniature (SURVEY.md §5 comm backend)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text("""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+from pilosa_tpu.parallel import multihost, mesh as pmesh
+
+multihost.initialize()  # env-var path: coordinator/count/id from env
+info = multihost.process_info()
+assert info["process_count"] == 2, info
+assert info["global_devices"] == 4, info
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+mesh = multihost.global_mesh()
+rng = np.random.default_rng(0)
+a = rng.integers(0, 1 << 32, size=(8, 64), dtype=np.uint32)
+b = rng.integers(0, 1 << 32, size=(8, 64), dtype=np.uint32)
+sharding = NamedSharding(mesh, P(pmesh.SHARD_AXIS, None))
+a_g = jax.make_array_from_callback((8, 64), sharding, lambda i: a[i])
+b_g = jax.make_array_from_callback((8, 64), sharding, lambda i: b[i])
+got = pmesh.count_intersect(mesh, a_g, b_g)
+want = int(np.bitwise_count(a & b).sum())
+assert got == want, (got, want)
+sl = multihost.local_shard_slice(8)
+assert len(sl) == 4  # half the shard space per process
+print(f"OK {got}")
+""")
+
+    env = dict(os.environ)
+    env.update(
+        PALLAS_AXON_POOL_IPS="",
+        JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+        JAX_NUM_PROCESSES="2",
+        PYTHONPATH=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep
+        + env.get("PYTHONPATH", ""),
+    )
+    procs = []
+    for pid in (0, 1):
+        e = dict(env, JAX_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=e,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    counts = {out.strip().splitlines()[-1] for out in outs}
+    assert len(counts) == 1 and next(iter(counts)).startswith("OK ")
