@@ -1,0 +1,183 @@
+#!/usr/bin/env python
+"""Relay watcher: capture TPU evidence during ANY relay up-window.
+
+The axon relay (the only path to the v5e chip) has been down for whole
+rounds at a time; VERDICT round-2 item 1 requires that a mid-round
+ten-minute up-window is enough to produce chip artifacts.  This watcher
+runs for the whole round:
+
+  - polls for the relay process (``pgrep -f \.relay\.py``) every
+    POLL_S seconds, logging every state transition and an hourly
+    heartbeat to tools/relay_watcher.log (committed evidence that the
+    relay never came up, if it never does);
+  - on an up-transition runs the capture sequence serially:
+      1. benchmarks/validate_tpu.py  -> PALLAS_TPU_VALIDATION.json
+      2. bench.py                    -> tools/tpu_captures/bench_<ts>.json
+      3. benchmarks/measure.py       -> tools/tpu_captures/measure_<ts>.jsonl
+    each with a generous timeout (a jax-on-axon process killed mid-init
+    wedges the tunnel for good, so the budgets err long and a timeout is
+    logged as evidence of a wedged tunnel, not retried in a tight loop);
+  - commits the artifacts with a path-scoped ``git commit --`` so a
+    concurrently-staged index is never swept into the capture commit;
+  - while the relay stays up, re-captures bench.py hourly (cheap) and
+    the full sequence every 4 h.
+
+Single-client tunnel: the capture steps run strictly serially, and the
+watcher writes tools/relay_watcher.capturing while a capture is running
+so an interactive operator knows not to start a second jax-on-axon
+process.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "tools", "relay_watcher.log")
+CAPTURE_DIR = os.path.join(REPO, "tools", "tpu_captures")
+CAPTURING_FLAG = os.path.join(REPO, "tools", "relay_watcher.capturing")
+
+POLL_S = 30
+HEARTBEAT_S = 3600
+BENCH_RECAPTURE_S = 3600
+FULL_RECAPTURE_S = 4 * 3600
+
+# Generous per-step budgets: first compile through the relay is 20-40 s,
+# measure.py's 10B config ~2-3 min on-chip, but a wedged tunnel hangs
+# forever — these bound the watcher without risking a mid-init kill of a
+# healthy run.
+VALIDATE_TIMEOUT = 1800
+BENCH_TIMEOUT = 1800
+MEASURE_TIMEOUT = 5400
+
+
+def log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+    line = f"{stamp} {msg}"
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def relay_up() -> bool:
+    try:
+        out = subprocess.run(["pgrep", "-f", r"\.relay\.py"],
+                             capture_output=True, timeout=5)
+        return bool(out.stdout.strip())
+    except Exception:
+        return False
+
+
+def run_step(name: str, argv: list[str], timeout: int,
+             out_path: str | None) -> bool:
+    """Run one capture step; returns True on rc==0.  stdout+stderr go to
+    out_path (or the log on failure)."""
+    log(f"capture step {name}: {' '.join(argv)}")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(argv, capture_output=True, text=True,
+                              timeout=timeout, cwd=REPO, env=env)
+    except subprocess.TimeoutExpired:
+        log(f"capture step {name}: TIMEOUT after {timeout}s — tunnel "
+            f"likely wedged; will keep polling but captures may hang "
+            f"until the harness restarts the relay")
+        return False
+    dt = time.monotonic() - t0
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(proc.stdout)
+            if proc.stderr:
+                f.write("\n--- stderr ---\n" + proc.stderr)
+    if proc.returncode != 0:
+        log(f"capture step {name}: rc={proc.returncode} after {dt:.0f}s; "
+            f"stderr tail: {proc.stderr[-500:]!r}")
+        return False
+    log(f"capture step {name}: ok in {dt:.0f}s")
+    return True
+
+
+def git_commit(paths: list[str], msg: str) -> None:
+    try:
+        subprocess.run(["git", "add", "--"] + paths, cwd=REPO,
+                       capture_output=True, timeout=30)
+        proc = subprocess.run(
+            ["git", "commit", "-m", msg, "--"] + paths,
+            cwd=REPO, capture_output=True, text=True, timeout=30)
+        log(f"git commit rc={proc.returncode}: "
+            f"{(proc.stdout or proc.stderr).strip().splitlines()[:1]}")
+    except Exception as e:
+        log(f"git commit failed: {e}")
+
+
+def capture(full: bool) -> bool:
+    """Run the capture sequence; returns True if bench succeeded."""
+    os.makedirs(CAPTURE_DIR, exist_ok=True)
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+    open(CAPTURING_FLAG, "w").write(ts)
+    py = sys.executable
+    paths = [os.path.relpath(LOG, REPO)]
+    ok_bench = False
+    try:
+        if full:
+            if run_step("validate_tpu", [py, "-u", "benchmarks/validate_tpu.py"],
+                        VALIDATE_TIMEOUT,
+                        os.path.join(CAPTURE_DIR, f"validate_{ts}.log")):
+                paths += ["PALLAS_TPU_VALIDATION.json",
+                          f"tools/tpu_captures/validate_{ts}.log"]
+        bench_out = os.path.join(CAPTURE_DIR, f"bench_{ts}.json")
+        if run_step("bench", [py, "-u", "bench.py"], BENCH_TIMEOUT, bench_out):
+            paths.append(f"tools/tpu_captures/bench_{ts}.json")
+            ok_bench = True
+        if full:
+            meas_out = os.path.join(CAPTURE_DIR, f"measure_{ts}.jsonl")
+            if run_step("measure", [py, "-u", "benchmarks/measure.py"],
+                        MEASURE_TIMEOUT, meas_out):
+                paths.append(f"tools/tpu_captures/measure_{ts}.jsonl")
+        git_commit(paths, f"TPU capture {ts} (relay up-window)")
+    finally:
+        try:
+            os.remove(CAPTURING_FLAG)
+        except OSError:
+            pass
+    return ok_bench
+
+
+def main() -> None:
+    log(f"relay_watcher start pid={os.getpid()} poll={POLL_S}s")
+    was_up = False
+    last_heartbeat = 0.0
+    last_bench = 0.0
+    last_full = 0.0
+    while True:
+        now = time.monotonic()
+        up = relay_up()
+        if up != was_up:
+            log(f"relay state change: {'UP' if up else 'DOWN'}")
+            was_up = up
+        if now - last_heartbeat >= HEARTBEAT_S:
+            log(f"heartbeat: relay {'UP' if up else 'DOWN'}")
+            last_heartbeat = now
+        if up:
+            full_due = now - last_full >= FULL_RECAPTURE_S
+            bench_due = now - last_bench >= BENCH_RECAPTURE_S
+            if full_due or bench_due:
+                if capture(full=full_due):
+                    last_bench = time.monotonic()
+                    if full_due:
+                        last_full = time.monotonic()
+                else:
+                    # Failed capture: back off a full bench interval so a
+                    # wedged tunnel doesn't spin the log.
+                    last_bench = time.monotonic()
+        time.sleep(POLL_S)
+
+
+if __name__ == "__main__":
+    main()
