@@ -43,4 +43,5 @@ check:
 	$(PY) -m pilosa_tpu check $(DIR)
 
 clean:
-	rm -rf pilosa_tpu/native/build __pycache__ **/__pycache__
+	rm -rf pilosa_tpu/native/build
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

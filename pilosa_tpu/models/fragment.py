@@ -26,6 +26,7 @@ from __future__ import annotations
 import os
 import struct
 import threading
+import time
 
 import numpy as np
 
@@ -79,6 +80,7 @@ class Fragment:
         self._stack_cache: tuple[int, np.ndarray, np.ndarray] | None = None
         self._device_cache: dict = {}
         self._lock = threading.RLock()
+        self._snap_done = threading.Condition(self._lock)
 
         from pilosa_tpu.models.cache import TopNCache
 
@@ -223,6 +225,7 @@ class Fragment:
                     # of being acknowledged without a WAL record
                     self._wal = old_wal
                 self._snapshotting = False
+                self._snap_done.notify_all()
                 raise
         ok = False
         try:
@@ -261,11 +264,23 @@ class Fragment:
                     if not self._closed:
                         self._wal = open(self._wal_path, "ab")
                 self._snapshotting = False
+                self._snap_done.notify_all()
 
     def close(self) -> None:
         from pilosa_tpu.runtime import residency
 
         with self._lock:
+            # Wait out an in-flight snapshot (bounded): its phase 3
+            # renames .wal.new over the WAL, and proceeding past it
+            # lets holder.close release the dir flock while that rename
+            # is pending — a reopening process could heal/remove the
+            # overflow segment under the worker's feet.  The bound
+            # keeps a hung disk from wedging close; past it we accept
+            # the (recoverable — WAL replay is idempotent) race rather
+            # than never closing.
+            deadline = time.monotonic() + 60.0
+            while self._snapshotting and time.monotonic() < deadline:
+                self._snap_done.wait(timeout=deadline - time.monotonic())
             self._closed = True  # a queued background snapshot becomes a no-op
             if self._wal is not None:
                 self._wal.close()

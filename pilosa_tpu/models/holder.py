@@ -155,10 +155,11 @@ class Holder:
         from pilosa_tpu.runtime import snapqueue
 
         if not snapqueue.drain(timeout=60.0):
-            import sys
-
-            print("holder.close: snapshot queue drain timed out; "
-                  "WAL compaction deferred to next open", file=sys.stderr)
+            snapqueue.log.printf(
+                "holder.close: snapshot queue drain timed out; WAL "
+                "compaction deferred to next open (drain_timeouts "
+                "counter bumped); fragment close waits out any still-"
+                "in-flight snapshot before the dir flock is released")
         # close EVERY index (continuing past failures) before releasing
         # the flock — releasing with WAL fds still open would reopen the
         # corruption window the lock exists to prevent

@@ -17,7 +17,9 @@ XLA programs.
 
 from __future__ import annotations
 
+import bisect
 import datetime as _dt
+import heapq
 import threading
 from concurrent.futures import (
     FIRST_COMPLETED,
@@ -910,6 +912,18 @@ class Executor:
         column = call.uint_arg("column")
         shards = self._target_shards(idx, shards, opt)
 
+        def push_down(ids: list[int]) -> list[int]:
+            # previous/limit apply inside the shard scan (reference
+            # executeRowsShard pushes the filter into the row iterator,
+            # executor.go:1040-1071): a shard never ships more than
+            # ``limit`` ids past ``previous``, so the host-side merge is
+            # bounded by shards*limit, not total row cardinality
+            if previous is not None:
+                ids = ids[bisect.bisect_right(ids, previous):]
+            if limit is not None:
+                ids = ids[:limit]
+            return ids
+
         def map_fn(shard):
             if column is not None and shard != column // SHARD_WIDTH:
                 return []
@@ -927,20 +941,22 @@ class Executor:
                 off = column % SHARD_WIDTH
                 w, b = off // bm.WORD_BITS, off % bm.WORD_BITS
                 mask = (matrix[:, w] >> np.uint32(b)) & np.uint32(1)
-                return [int(r) for r in ids_arr[mask.astype(bool)]]
-            return frag.row_ids()
+                return push_down([int(r) for r in ids_arr[mask.astype(bool)]])
+            return push_down(frag.row_ids())
 
-        merged: set[int] = set()
         parts = self._map_shards(
             map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda ids: [ids]
         )
-        for part in parts:
-            merged.update(part)
-        out = sorted(merged)
-        if previous is not None:
-            out = [r for r in out if r > previous]
-        if limit is not None:
-            out = out[:limit]
+        # bounded k-way merge of the per-shard sorted lists (reference
+        # mergeRowIDs, executor.go:1062-1071): dedup on the fly and stop
+        # at ``limit`` — never a full union across shards
+        out: list[int] = []
+        for r in heapq.merge(*parts):
+            if out and r == out[-1]:
+                continue
+            out.append(r)
+            if limit is not None and len(out) >= limit:
+                break
         return out
 
     # ------------------------------------------------------------ GroupBy

@@ -20,6 +20,8 @@ from __future__ import annotations
 import queue
 import threading
 
+from pilosa_tpu import logger as _logger
+
 QUEUE_DEPTH = 100
 N_WORKERS = 2
 
@@ -30,6 +32,41 @@ _pending: set[int] = set()  # id(fragment) currently queued
 _inflight = 0  # fragments popped but not yet snapshotted
 _idle = threading.Condition(_lock)
 
+#: Queue health counters, process-wide like the queue itself.  Exposed
+#: at every server's /metrics (handler appends ``prometheus_lines()``)
+#: so compaction starvation is alert-able, not stderr-only (the
+#: reference surfaces the analogous state via expvar, stats/stats.go:84).
+_counters = {
+    "snapshot_failures": 0,   # compactions that raised (worker or inline)
+    "snapshot_completed": 0,  # compactions that succeeded
+    "drain_timeouts": 0,      # drain() callers that gave up waiting
+    "queue_overflows": 0,     # enqueues that degraded to inline
+}
+
+#: Failures must never be silent even with a NOP server logger, so the
+#: module default is a real stderr logger; a server may swap in its own.
+log: _logger.Logger = _logger.StandardLogger()
+
+
+def bump(name: str, value: int = 1) -> None:
+    with _lock:
+        _counters[name] += value
+
+
+def counters() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
+def prometheus_lines() -> str:
+    """Counters as Prometheus 0.0.4 text, for appending to /metrics."""
+    out = []
+    for name, v in sorted(counters().items()):
+        m = f"pilosa_snapqueue_{name}_total"
+        out.append(f"# TYPE {m} counter")
+        out.append(f"{m} {v}")
+    return "\n".join(out) + "\n"
+
 
 def _snapshot_swallowing(frag) -> None:
     """Run one compaction; a failure is survivable (durability is
@@ -37,12 +74,12 @@ def _snapshot_swallowing(frag) -> None:
     persistently failing disk must not starve compaction invisibly."""
     try:
         frag.snapshot()
+        bump("snapshot_completed")
     except Exception as e:
-        import sys
-
-        print(f"snapshot queue: compaction of {frag.path!r} failed "
-              f"({e!r}); WAL keeps growing until a retry succeeds",
-              file=sys.stderr)
+        bump("snapshot_failures")
+        log.printf("snapshot queue: compaction of %r failed (%r); "
+                   "WAL keeps growing until a retry succeeds",
+                   frag.path, e)
 
 
 def _worker() -> None:
@@ -92,6 +129,7 @@ def enqueue(frag) -> None:
         # inline rather than queueing unbounded work.  Failures are
         # swallowed exactly like the worker path — the triggering write
         # already succeeded durably (bit applied + WAL appended)
+        bump("queue_overflows")
         try:
             _snapshot_swallowing(frag)
         finally:
@@ -116,6 +154,9 @@ def drain(timeout: float | None = 30.0) -> bool:
                 continue
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                # _idle wraps _lock (non-reentrant) and we're inside
+                # `with _idle:` — bump() would self-deadlock here
+                _counters["drain_timeouts"] += 1
                 return False
             _idle.wait(timeout=remaining)
     return True

@@ -687,6 +687,13 @@ class Handler:
             text = self.stats.prometheus_text()
         else:
             text = ""
+        # Snapshot-queue health is process-wide (the queue is shared by
+        # every holder in the process), so append it here rather than
+        # routing through any one server's registry — compaction
+        # starvation must be alert-able from any node's /metrics.
+        from pilosa_tpu.runtime import snapqueue
+
+        text += snapqueue.prometheus_lines()
         self._bytes(req, text.encode(), "text/plain; version=0.0.4")
 
     @route("GET", "/diagnostics")

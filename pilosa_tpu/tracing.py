@@ -238,7 +238,15 @@ class OtlpExporter(MemTracer):
             try:
                 urllib.request.urlopen(req, timeout=5).read()
             except Exception:
-                return  # collector outage never affects serving
+                # collector outage never affects serving — but a
+                # transient error must not LOSE the popped batch: put
+                # it back for the next tick (MAX_BUFFER still caps
+                # memory during a long outage)
+                with self._lock:
+                    self._buf[:0] = batch
+                    if len(self._buf) > self.MAX_BUFFER:
+                        del self._buf[: len(self._buf) - self.MAX_BUFFER]
+                return
 
     def close(self) -> None:
         self._stop.set()
