@@ -161,6 +161,8 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         tls_cert=cfg.tls.certificate_path or None,
         tls_key=cfg.tls.key_path or None,
         tls_skip_verify=cfg.tls.skip_verify,
+        heap_profile=cfg.profile.heap,
+        heap_profile_frames=cfg.profile.heap_frames,
         logger=log,
         stats=stats,
     )

@@ -56,6 +56,21 @@ class TracingConfig:
 
 
 @dataclass
+class ProfileConfig:
+    """[profile] (server/config.go:151-156 — the reference's
+    block/mutex profile rate knobs).  ``heap`` starts tracemalloc at
+    server open, feeding ``GET /debug/pprof/heap``; ``heap_frames`` is
+    the retained traceback depth per allocation — tracemalloc's cost
+    knob (it has no sampling rate; depth is its dial, deeper = more
+    useful stacks, more overhead).  Documented deviation: Python has no
+    block/mutex profile; the wall-clock sampler at /debug/pprof/profile
+    covers lock waits."""
+
+    heap: bool = False
+    heap_frames: int = 4
+
+
+@dataclass
 class TLSConfig:
     """[tls] (server/tlsconfig.go; config server/config.go:58-66)."""
 
@@ -77,6 +92,7 @@ class Config:
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
+    profile: ProfileConfig = field(default_factory=ProfileConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
 
     # ------------------------------------------------------------- access
@@ -113,7 +129,7 @@ class Config:
         for k, v in d.items():
             key = k.replace("-", "_")
             if key in ("cluster", "anti_entropy", "metric", "tracing",
-                       "tls") and isinstance(v, dict):
+                       "profile", "tls") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -124,6 +140,7 @@ class Config:
                                                         AntiEntropyConfig,
                                                         MetricConfig,
                                                         TracingConfig,
+                                                        ProfileConfig,
                                                         TLSConfig)):
                 setattr(self, key, v)
 
@@ -132,7 +149,7 @@ class Config:
         (the reference's PILOSA_* envs, cmd/root.go:94)."""
         for f in fields(self):
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
-                          "tls"):
+                          "profile", "tls"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -178,6 +195,10 @@ class Config:
             "[tracing]",
             f"enabled = {str(self.tracing.enabled).lower()}",
             f'endpoint = "{self.tracing.endpoint}"',
+            "",
+            "[profile]",
+            f"heap = {str(self.profile.heap).lower()}",
+            f"heap-frames = {self.profile.heap_frames}",
             "",
             "[tls]",
             f'certificate-path = "{self.tls.certificate_path}"',

@@ -180,6 +180,7 @@ class Field:
         # concatenated cross-shard row matrices for the fused TopN scan
         self._matrix_stack_cache: dict = {}
         self._view_times_memo = None  # (view names, parsed times)
+        self._index_ref = None  # weakref to owning Index (set by Index._adopt)
         self._lock = threading.RLock()
         if path is not None:
             os.makedirs(path, exist_ok=True)
@@ -947,6 +948,16 @@ class Field:
                 done.add(shard)
         finally:
             self._note_shards(done)
+        if not clear:
+            # warm the fused-path stacks for the imported rows in the
+            # background, hottest first — the first query after a bulk
+            # import must not pay the whole stack assembly (prewarm.py)
+            from collections import Counter
+
+            from pilosa_tpu.runtime import prewarm
+
+            self._prewarm([r for r, _ in
+                           Counter(rows).most_common(prewarm.ROW_CAP)])
 
     def import_values(self, cols, values) -> None:
         """Bulk import of BSI values (reference Field.importValue,
@@ -993,6 +1004,16 @@ class Field:
                 done.add(shard)
         finally:
             self._note_shards(done)
+        self._prewarm(())  # int field: warms the BSI plane stack
+
+    def _prewarm(self, rows) -> None:
+        """Enqueue a background stack prewarm for this field (no-op
+        without an owning index or with PILOSA_TPU_PREWARM=0)."""
+        idx = self._index_ref() if self._index_ref is not None else None
+        if idx is not None:
+            from pilosa_tpu.runtime import prewarm
+
+            prewarm.enqueue(idx, self, rows)
 
     # ---------------------------------------------------------- lifecycle
 

@@ -28,6 +28,7 @@ class Holder:
             try:
                 self._load_node_id()
                 self._open_indexes()
+                self._prewarm_all()
             except BaseException:
                 # a failed open must not leave the directory locked
                 self._release_dir_lock()
@@ -131,6 +132,17 @@ class Holder:
                 }
             )
         return out
+
+    def _prewarm_all(self) -> None:
+        """Queue a background stack prewarm for every reopened field —
+        the restart analog of the reference's eager fragment open
+        (holder.go:137 -> view.go:117-177): a restarted server's first
+        query finds warm stacks instead of paying the full assembly."""
+        from pilosa_tpu.runtime import prewarm
+
+        for idx in self.indexes.values():
+            for f in idx.fields.values():
+                prewarm.enqueue(idx, f)
 
     def apply_schema(self, schema: list[dict]) -> None:
         """Create any missing indexes/fields from a schema description

@@ -89,13 +89,24 @@ class Index:
         for name in sorted(os.listdir(self.path)):
             fdir = os.path.join(self.path, name)
             if os.path.isdir(fdir) and os.path.exists(os.path.join(fdir, ".meta")):
-                self.fields[name] = Field(fdir, self.name, name, FieldOptions())
+                self.fields[name] = self._adopt(
+                    Field(fdir, self.name, name, FieldOptions()))
 
     def _create_existence_field(self) -> None:
         path = None if self.path is None else os.path.join(self.path, EXISTENCE_FIELD)
-        self.fields[EXISTENCE_FIELD] = Field(
+        self.fields[EXISTENCE_FIELD] = self._adopt(Field(
             path, self.name, EXISTENCE_FIELD, FieldOptions.set_field(cache_type="none")
-        )
+        ))
+
+    def _adopt(self, f: Field) -> Field:
+        """Give the field a weak back-reference to its index — the
+        prewarm worker needs the INDEX shard set (the fused executor
+        keys stacks by ``sorted(index.available_shards())``,
+        executor.py _target_shards), which the field alone can't see."""
+        import weakref
+
+        f._index_ref = weakref.ref(self)
+        return f
 
     # -------------------------------------------------------------- fields
 
@@ -121,7 +132,7 @@ class Index:
     def _create_field(self, name: str, options: FieldOptions) -> Field:
         validate_name(name)
         path = None if self.path is None else os.path.join(self.path, name)
-        f = Field(path, self.name, name, options)
+        f = self._adopt(Field(path, self.name, name, options))
         self.fields[name] = f
         return f
 

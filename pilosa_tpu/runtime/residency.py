@@ -137,6 +137,16 @@ class ResidencyManager:
                     "entries": len(self._entries),
                     "evictions": self.evictions}
 
+    def top_entries(self, n: int = 20) -> list[dict]:
+        """Largest tracked device/host cache entries, for the heap
+        profile endpoint — on a framework whose risk register is memory
+        layout, 'which stacks hold the bytes' is the first question a
+        10B-scale operator asks."""
+        with self._lock:
+            entries = sorted(self._entries.values(), key=lambda e: -e[2])[:n]
+        return [{"key": repr(key)[:160], "bytes": nbytes}
+                for _, key, nbytes in entries]
+
 
 _global: ResidencyManager | None = None
 _global_lock = threading.Lock()

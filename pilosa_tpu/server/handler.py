@@ -160,10 +160,11 @@ class Handler:
 
     def __init__(self, api: API, host: str = "127.0.0.1", port: int = 0,
                  stats=None, tracer=None, tls_cert: str | None = None,
-                 tls_key: str | None = None):
+                 tls_key: str | None = None, heap_frames: int = 4):
         self.api = api
         self.stats = stats
         self.tracer = tracer
+        self.heap_frames = heap_frames  # ?start=1 tracemalloc depth
         self.tls = bool(tls_cert)
         handler_self = self
 
@@ -691,9 +692,10 @@ class Handler:
         # every holder in the process), so append it here rather than
         # routing through any one server's registry — compaction
         # starvation must be alert-able from any node's /metrics.
-        from pilosa_tpu.runtime import snapqueue
+        from pilosa_tpu.runtime import prewarm, snapqueue
 
         text += snapqueue.prometheus_lines()
+        text += prewarm.prometheus_lines()
         self._bytes(req, text.encode(), "text/plain; version=0.0.4")
 
     @route("GET", "/diagnostics")
@@ -717,6 +719,59 @@ class Handler:
             out.append(f"--- thread {names.get(ident, ident)} ---\n"
                        + "".join(traceback.format_stack(frame)))
         self._bytes(req, "\n".join(out).encode(), "text/plain")
+
+    @route("GET", "/debug/pprof/heap")
+    def handle_debug_heap(self, req, params, path, body):
+        """Heap/allocation profile — the pprof heap analog
+        (http/handler.go:280-281; rates configured like
+        server/config.go:151-156, here ``[profile] heap`` starting
+        tracemalloc).  Reports top allocation sites (tracemalloc, which
+        also tracks numpy buffers), process RSS, and the residency
+        manager's device/host cache entries — the buffers that dominate
+        at the 10B-column scale.
+
+        ``?topn=N`` bounds the site list (default 25); ``?start=1``
+        begins tracing at runtime when the config didn't (allocations
+        before that point are invisible — restart-free but partial);
+        ``?cumulative=traceback`` groups by full stack instead of
+        allocation line."""
+        import tracemalloc
+
+        from pilosa_tpu.runtime import residency
+
+        try:
+            topn = int(params.get("topn", 25))
+        except ValueError:
+            raise ApiError("invalid topn parameter")
+        if topn < 1:
+            raise ApiError("topn must be >= 1")
+        if params.get("start") == "1" and not tracemalloc.is_tracing():
+            tracemalloc.start(self.heap_frames)
+        out = {"tracing": tracemalloc.is_tracing()}
+        if tracemalloc.is_tracing():
+            current, peak = tracemalloc.get_traced_memory()
+            out["traced_bytes"] = current
+            out["traced_peak_bytes"] = peak
+            group = ("traceback" if params.get("cumulative") == "traceback"
+                     else "lineno")
+            stats = tracemalloc.take_snapshot().statistics(group)[:topn]
+            out["top_allocations"] = [
+                {"site": ";".join(f"{fr.filename}:{fr.lineno}"
+                                  for fr in st.traceback),
+                 "bytes": st.size, "count": st.count}
+                for st in stats]
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS"):
+                        out["rss_bytes"] = int(line.split()[1]) * 1024
+                        break
+        except OSError:
+            pass
+        mgr = residency.manager()
+        out["residency"] = mgr.stats()
+        out["residency_top"] = mgr.top_entries(topn)
+        self._json(req, out)
 
     @route("GET", "/debug/pprof/profile")
     def handle_debug_profile(self, req, params, path, body):

@@ -49,6 +49,8 @@ class Server:
         logger=None,
         stats=None,
         tracer=None,
+        heap_profile: bool = False,
+        heap_profile_frames: int = 4,
     ):
         from pilosa_tpu import logger as _logger
         from pilosa_tpu import stats as _stats
@@ -66,6 +68,14 @@ class Server:
             from pilosa_tpu import tracing as _tracing
 
             _tracing.set_global_tracer(tracer)
+        if heap_profile:
+            # start tracemalloc before the holder opens so startup
+            # allocations (fragment loads, stack builds) are captured —
+            # the [profile] heap config (reference server/config.go:151)
+            import tracemalloc
+
+            if not tracemalloc.is_tracing():
+                tracemalloc.start(heap_profile_frames)
         self.seeds = seeds or []
         self.anti_entropy_interval = anti_entropy_interval
         self.heartbeat_interval = heartbeat_interval
@@ -94,7 +104,8 @@ class Server:
         self.api.max_writes_per_request = max_writes_per_request
         self.handler = Handler(self.api, host=host, port=port,
                                stats=self.stats, tracer=tracer,
-                               tls_cert=tls_cert, tls_key=tls_key)
+                               tls_cert=tls_cert, tls_key=tls_key,
+                               heap_frames=heap_profile_frames)
         self.cluster.local_node.uri = self.handler.uri
         from pilosa_tpu.diagnostics import RuntimeMonitor
 

@@ -97,6 +97,81 @@ def test_cli_check_uses_validator(tmp_path):
     assert cmd.cmd_check(A()) == 0
 
 
+def test_debug_heap_endpoint(tmp_path):
+    """/debug/pprof/heap (reference pprof heap, http/handler.go:280-281):
+    tracemalloc top allocation sites + RSS + residency-manager device
+    cache entries, enabled via the [profile] heap config."""
+    import json
+    import tracemalloc
+    import urllib.request
+
+    from pilosa_tpu.server.client import InternalClient
+    from pilosa_tpu.server.server import Server
+
+    s = Server(data_dir=str(tmp_path / "n0"), heap_profile=True)
+    s.open()
+    c = InternalClient()
+    try:
+        post = lambda p, o: c.post_json(s.uri + p, o)
+        post("/index/i", {})
+        post("/index/i/field/f", {})
+        post("/index/i/field/f/import",
+             {"rowIDs": [0] * 512, "columnIDs": list(range(512))})
+        post("/index/i/query", {"query": "Count(Row(f=0))"})
+        out = json.loads(urllib.request.urlopen(
+            s.uri + "/debug/pprof/heap?topn=10", timeout=30).read())
+        assert out["tracing"] is True
+        assert out["traced_bytes"] > 0
+        assert out["traced_peak_bytes"] >= out["traced_bytes"]
+        assert out["top_allocations"] and all(
+            st["bytes"] > 0 and ":" in st["site"]
+            for st in out["top_allocations"])
+        assert out["rss_bytes"] > 0
+        assert out["residency"]["budget"] > 0
+        # the import warmed a row stack: the residency manager knows
+        # which buffers hold the bytes
+        assert isinstance(out["residency_top"], list)
+        # full-stack grouping variant
+        out2 = json.loads(urllib.request.urlopen(
+            s.uri + "/debug/pprof/heap?topn=5&cumulative=traceback",
+            timeout=30).read())
+        assert out2["top_allocations"]
+        # bad parameter -> 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                s.uri + "/debug/pprof/heap?topn=bogus", timeout=10)
+        assert ei.value.code == 400
+    finally:
+        c.close()
+        s.close()
+        tracemalloc.stop()  # don't tax the rest of the suite
+
+
+def test_debug_heap_endpoint_runtime_start(tmp_path):
+    """Without the config, ?start=1 begins tracing restart-free (the
+    response says so; allocations before that point are invisible)."""
+    import json
+    import tracemalloc
+    import urllib.request
+
+    from pilosa_tpu.server.server import Server
+
+    s = Server(data_dir=str(tmp_path / "n0"))
+    s.open()
+    try:
+        out = json.loads(urllib.request.urlopen(
+            s.uri + "/debug/pprof/heap", timeout=30).read())
+        assert out["tracing"] is False
+        assert "top_allocations" not in out
+        assert out["residency"]["budget"] > 0  # residency always reports
+        out = json.loads(urllib.request.urlopen(
+            s.uri + "/debug/pprof/heap?start=1", timeout=30).read())
+        assert out["tracing"] is True
+    finally:
+        s.close()
+        tracemalloc.stop()
+
+
 def test_debug_profile_endpoint(tmp_path):
     import threading
     import time

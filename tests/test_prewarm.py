@@ -1,0 +1,160 @@
+"""Background stack prewarm tests (VERDICT round-2 missing #3; the
+reference's analog is the eager fragment open at startup, holder.go:137
+-> view.go:117-177).
+
+Guarantees: a bulk import leaves the fused-path stacks warm before the
+first query; a reopened holder warms in the background; the worker
+respects the residency budget; PILOSA_TPU_PREWARM=0 disables it all."""
+
+import os
+import tempfile
+
+import pytest
+
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.runtime import prewarm, residency
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def holder(tmp_path):
+    h = Holder(str(tmp_path / "h"))
+    yield h
+    prewarm.drain(timeout=30)
+    h.close()
+
+
+def _import_two_rows(holder, n_shards=4):
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    rows, cols = [], []
+    for row in (3, 9):
+        for s in range(n_shards):
+            rows.append(row)
+            cols.append(s * SHARD_WIDTH + row)
+    f.import_bits(rows, cols)
+    return idx, f
+
+
+def test_import_prewarms_fused_stacks(holder):
+    idx, f = _import_two_rows(holder)
+    assert prewarm.drain(timeout=30)
+    shards = tuple(sorted(idx.available_shards()))
+    # the exact cache keys the fused executor path looks up
+    assert (3, shards) in f._row_stack_cache
+    assert (9, shards) in f._row_stack_cache
+
+    # and the first query is a pure cache hit: no new stack build
+    from unittest import mock
+
+    from pilosa_tpu.parallel.executor import Executor
+
+    with mock.patch.object(
+            type(f), "_place_and_cache_stack",
+            side_effect=AssertionError("first query rebuilt a stack")):
+        got = Executor(holder).execute(
+            "i", "Count(Intersect(Row(f=3), Row(f=9)))")[0]
+    assert got == 0  # rows 3 and 9 share no columns
+
+
+def test_reopen_prewarms_in_background(tmp_path):
+    path = str(tmp_path / "h")
+    h = Holder(path)
+    idx, f = _import_two_rows(h)
+    assert prewarm.drain(timeout=30)
+    h.close()
+
+    h2 = Holder(path)
+    try:
+        assert prewarm.drain(timeout=30)
+        idx2 = h2.index("i")
+        f2 = idx2.field("f")
+        shards = tuple(sorted(idx2.available_shards()))
+        assert any(key == (3, shards) for key in f2._row_stack_cache)
+    finally:
+        h2.close()
+
+
+def test_int_field_prewarms_plane_stack(holder):
+    idx = holder.create_index("i")
+    v = idx.create_field("v", FieldOptions.int_field(0, 1000))
+    v.import_values([1, SHARD_WIDTH + 2], [17, 400])
+    assert prewarm.drain(timeout=30)
+    assert any(k[0] == "planes" for k in v._row_stack_cache)
+
+
+def test_budget_bounds_prewarm(holder):
+    mgr = residency.manager()
+    old_budget = mgr.budget
+    mgr.budget = 1  # nothing fits
+    try:
+        before = prewarm.counters()["rows_skipped_budget"]
+        idx, f = _import_two_rows(holder)
+        assert prewarm.drain(timeout=30)
+        assert prewarm.counters()["rows_skipped_budget"] > before
+        shards = tuple(sorted(idx.available_shards()))
+        assert (3, shards) not in f._row_stack_cache
+    finally:
+        mgr.budget = old_budget
+
+
+def test_env_disables_prewarm(tmp_path, monkeypatch):
+    monkeypatch.setenv("PILOSA_TPU_PREWARM", "0")
+    h = Holder(str(tmp_path / "h"))
+    try:
+        idx, f = _import_two_rows(h)
+        assert prewarm.drain(timeout=10)
+        shards = tuple(sorted(idx.available_shards()))
+        assert (3, shards) not in f._row_stack_cache
+    finally:
+        h.close()
+
+
+def test_prewarm_skips_deleted_field(holder):
+    """A delete landing before the worker drains must not rebuild and
+    re-admit stacks into a closed field's cache (nothing would ever
+    forget them)."""
+    import threading
+    from unittest import mock
+
+    idx, f = _import_two_rows(holder)
+    assert prewarm.drain(timeout=30)
+    for key in list(f._row_stack_cache):
+        residency.manager().forget(f._row_stack_cache, key)
+    f._row_stack_cache.clear()
+
+    # hold the worker at the job boundary while the delete lands
+    release = threading.Event()
+    orig_shards = type(idx).available_shards
+
+    def slow_shards(self):
+        release.wait(timeout=30)
+        return orig_shards(self)
+
+    before = prewarm.counters()["stacks_built"]
+    with mock.patch.object(type(idx), "available_shards", slow_shards):
+        prewarm.enqueue(idx, f, [3, 9])
+        idx.delete_field("f")
+        release.set()
+        assert prewarm.drain(timeout=30)
+    assert prewarm.counters()["stacks_built"] == before
+    assert not f._row_stack_cache
+
+
+def test_prewarm_failure_is_survivable_and_counted(holder):
+    """A prewarm job that dies must only mean a cold first query —
+    counted, logged, never raised into the caller."""
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+
+    class _BoomIndex:
+        fields = {f.name: f}  # passes the liveness check
+
+        def available_shards(self):
+            raise RuntimeError("injected")
+
+    before = prewarm.counters()["jobs_failed"]
+    prewarm.enqueue(_BoomIndex(), f, [1])
+    assert prewarm.drain(timeout=10)
+    assert prewarm.counters()["jobs_failed"] == before + 1
