@@ -97,6 +97,18 @@ class API:
         from pilosa_tpu.parallel.executor import ExecOptions
 
         self._validate("query")
+        if (not remote and shards is None and isinstance(pql, str)):
+            # multi-process runtime: the coordinator upgrades supported
+            # reads to one collective SPMD program over the global mesh
+            # (parallel/spmd.py); None falls through to scatter-gather.
+            # This check runs BEFORE the write-limit branch below, which
+            # rebinds pql to a parsed Query and would otherwise make the
+            # upgrade unreachable on config-launched servers.
+            from pilosa_tpu.parallel import spmd
+
+            res = spmd.try_collective(self.node, index, pql)
+            if res is not None:
+                return res
         if self.max_writes_per_request > 0:
             from pilosa_tpu.pql import Query, parse as _parse
 

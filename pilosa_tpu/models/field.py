@@ -417,6 +417,19 @@ class Field:
 
         if bm.host_mode():
             return np.ascontiguousarray(stack)
+        if jax.process_count() > 1:
+            # multi-process: this stack holds NODE-LOCAL fragments, so
+            # it must live on node-local devices — the global mesh is
+            # spmd.py's (collective plans feed each process's blocks
+            # from its own fragments); a device_put here against
+            # jax.devices() would trip the same-value-on-every-process
+            # rule and imply collectives no peer is entering
+            from pilosa_tpu.parallel import mesh as pmesh
+
+            local = jax.local_devices()
+            if len(local) > 1:
+                return pmesh.shard_stack(pmesh.local_device_mesh(), stack)
+            return jax.device_put(stack, local[0])
         if len(jax.devices()) > 1:
             from pilosa_tpu.parallel import mesh as pmesh
 

@@ -51,6 +51,16 @@ def device_mesh(n_devices: int | None = None, axis_name: str = SHARD_AXIS) -> Me
     return Mesh(np.array(devs), (axis_name,))
 
 
+def local_device_mesh(axis_name: str = SHARD_AXIS) -> Mesh:
+    """A 1-D mesh over THIS process's devices only — the per-node fused
+    executor path in a multi-process deployment.  Per-node stacks hold
+    node-local fragments, so placing them on the global mesh would both
+    violate jax's same-value-everywhere rule for host arrays and imply
+    collectives nobody else is entering; node-local work stays local,
+    and only parallel/spmd.py plans span processes."""
+    return Mesh(np.array(jax.local_devices()), (axis_name,))
+
+
 def shard_stack(mesh: Mesh, stack: np.ndarray):
     """Place a [shards, ...] host array sharded over the mesh axis."""
     spec = P(SHARD_AXIS, *([None] * (stack.ndim - 1)))

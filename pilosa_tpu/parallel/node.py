@@ -245,6 +245,24 @@ class ClusterNode:
             self.cleanup_unowned()
         elif t == "ping":
             return {"ok": True, "state": self.cluster.state}
+        elif t == "collective-prepare":
+            # phase 1 of a coordinator-initiated collective: validate
+            # and promise without entering (parallel/spmd.py)
+            from pilosa_tpu.parallel import spmd
+
+            return spmd.prepare_collective(self, msg["index"], msg["query"])
+        elif t == "collective-execute":
+            # join a coordinator-initiated SPMD collective query: every
+            # process must enter the same program (parallel/spmd.py);
+            # the replicated result is discarded here — the coordinator
+            # answers the client
+            from pilosa_tpu.parallel import spmd
+
+            try:
+                spmd.join_collective(self, msg["index"], msg["query"])
+            except Exception as e:  # noqa: BLE001 — report, don't crash the bus
+                return {"ok": False, "error": repr(e)}
+            return {"ok": True}
         elif t == "recalculate-caches":
             self.recalculate_caches()
         elif t == "translate-keys":

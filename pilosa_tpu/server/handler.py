@@ -692,10 +692,12 @@ class Handler:
         # every holder in the process), so append it here rather than
         # routing through any one server's registry — compaction
         # starvation must be alert-able from any node's /metrics.
+        from pilosa_tpu.parallel import spmd
         from pilosa_tpu.runtime import prewarm, snapqueue
 
         text += snapqueue.prometheus_lines()
         text += prewarm.prometheus_lines()
+        text += spmd.prometheus_lines()
         self._bytes(req, text.encode(), "text/plain; version=0.0.4")
 
     @route("GET", "/diagnostics")

@@ -1,0 +1,378 @@
+"""Collective (SPMD) query execution tests — the multi-host data plane
+(VERDICT round-2 missing #2; reference scatter-gather analog
+executor.go:2455, here replaced by global-mesh collectives).
+
+Two tiers: a single-process tier on the 8-virtual-device CPU mesh
+(parity of the collective evaluator against the product executor and a
+Python-set oracle), and a REAL two-process jax.distributed tier where
+two full pilosa_tpu servers form an HTTP cluster, fragments land by
+jump hash, and collective queries run with stacks genuinely spanning
+both processes' devices."""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from pilosa_tpu.models.field import FieldOptions
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.parallel import spmd
+from pilosa_tpu.parallel.cluster import Cluster, Node
+from pilosa_tpu.parallel.executor import Executor
+from pilosa_tpu.parallel.results import Pair
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def _build(holder, n_shards=5, seed=11):
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    rng = random.Random(seed)
+    bits: dict[int, set[int]] = {}
+    rows_l, cols_l = [], []
+    for row in range(4):
+        cols = {rng.randrange(n_shards * SHARD_WIDTH) for _ in range(300)}
+        bits[row] = cols
+        rows_l += [row] * len(cols)
+        cols_l += list(cols)
+    f.import_bits(rows_l, cols_l)
+    v = idx.create_field("v", FieldOptions.int_field(-500, 1 << 18))
+    vcols = sorted({rng.randrange(n_shards * SHARD_WIDTH)
+                    for _ in range(400)})
+    vals = {c: rng.randrange(-500, 1 << 18) for c in vcols}
+    v.import_values(vcols, [vals[c] for c in vcols])
+    return idx, bits, vals
+
+
+@pytest.fixture
+def single(tmp_path):
+    h = Holder(str(tmp_path / "h"))
+    idx, bits, vals = _build(h)
+    cluster = Cluster(local_id="n0")
+    cluster.add_node(Node(id="n0", uri="local"))
+    ce = spmd.CollectiveExecutor(h, cluster, "i")
+    yield h, ce, Executor(h), bits, vals
+    h.close()
+
+
+class TestSingleProcessCollective:
+    def test_count_tree_parity(self, single):
+        h, ce, ex, bits, vals = single
+        for pql, want in [
+            ("Count(Row(f=0))", len(bits[0])),
+            ("Count(Intersect(Row(f=0), Row(f=1)))",
+             len(bits[0] & bits[1])),
+            ("Count(Union(Row(f=0), Row(f=1), Row(f=2)))",
+             len(bits[0] | bits[1] | bits[2])),
+            ("Count(Difference(Row(f=0), Row(f=3)))",
+             len(bits[0] - bits[3])),
+            ("Count(Xor(Row(f=1), Row(f=2)))",
+             len(bits[1] ^ bits[2])),
+        ]:
+            got = ce.execute(pql)
+            assert got == want, (pql, got, want)
+            assert got == ex.execute("i", pql)[0], pql
+
+    def test_range_count_parity(self, single):
+        h, ce, ex, bits, vals = single
+        for pql, pred in [
+            ("Count(Row(v > 100000))", lambda x: x > 100000),
+            ("Count(Row(v <= 0))", lambda x: x <= 0),
+            ("Count(Row(v == -5))", lambda x: x == -5),
+            ("Count(Row(v >< [-100, 50000]))",
+             lambda x: -100 <= x <= 50000),
+            ("Count(Row(v != null))", lambda x: True),
+        ]:
+            want = sum(1 for x in vals.values() if pred(x))
+            got = ce.execute(pql)
+            assert got == want, (pql, got, want)
+            assert got == ex.execute("i", pql)[0], pql
+
+    def test_sum_parity(self, single):
+        h, ce, ex, bits, vals = single
+        got = ce.execute("Sum(field=v)")
+        assert got.val == sum(vals.values())
+        assert got.count == len(vals)
+        assert got == ex.execute("i", "Sum(field=v)")[0]
+        got = ce.execute("Sum(Row(f=1), field=v)")
+        want = [v for c, v in vals.items() if c in bits[1]]
+        assert got.val == sum(want) and got.count == len(want)
+        assert got == ex.execute("i", "Sum(Row(f=1), field=v)")[0]
+
+    def test_topn_parity(self, single):
+        h, ce, ex, bits, vals = single
+        want = sorted(
+            (Pair(id=r, count=len(c)) for r, c in bits.items() if c),
+            key=lambda p: (-p.count, p.id))
+        assert ce.execute("TopN(f)") == want
+        assert ce.execute("TopN(f, n=2)") == want[:2]
+        filt = ce.execute("TopN(f, Row(f=0), n=3)")
+        wantf = sorted(
+            ((r, len(c & bits[0])) for r, c in bits.items()),
+            key=lambda rc: (-rc[1], rc[0]))
+        wantf = [Pair(id=r, count=c) for r, c in wantf if c > 0][:3]
+        assert filt == wantf
+        assert filt == ex.execute("i", "TopN(f, Row(f=0), n=3)")[0]
+
+    def test_unsupported_calls_refused(self, single):
+        h, ce, ex, bits, vals = single
+        for pql in ("Row(f=0)", "GroupBy(Rows(f))", "Min(field=v)",
+                    "Count(Row(f=0, from='2019-01-01T00:00'))",
+                    # args the executor honors but this evaluator
+                    # doesn't — silently changed semantics is worse
+                    # than the scatter path
+                    "TopN(f, n=2, threshold=100)",
+                    "TopN(f, ids=[0,1])",
+                    'TopN(f, attrName="x", attrValues=["y"])',
+                    "TopN(f, tanimotoThreshold=50)"):
+            with pytest.raises(spmd.CollectiveError):
+                ce.execute(pql)
+
+    def test_keyed_fields_refused(self, single):
+        h, ce, ex, bits, vals = single
+        h.index("i").create_field(
+            "kf", FieldOptions.set_field(keys=True))
+        for pql in ('Count(Row(kf="alice"))', "TopN(kf)",
+                    'Count(Intersect(Row(f=0), Row(kf="x")))'):
+            with pytest.raises(spmd.CollectiveError):
+                ce.execute(pql)
+
+    def test_rank_convention_checker(self, single):
+        h, ce, ex, bits, vals = single
+        # single process: rank 0 must be the sorted position of "n0"
+        spmd.verify_rank_convention(ce.cluster)
+        bad = Cluster(local_id="zz")
+        bad.add_node(Node(id="aa", uri="x"))
+        bad.add_node(Node(id="zz", uri="y"))
+        with pytest.raises(spmd.CollectiveError):
+            spmd.verify_rank_convention(bad)  # "zz" sorts to rank 1
+
+
+WORKER = '''
+import json, os, random, sys, time, urllib.request
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+
+from pilosa_tpu.parallel import multihost, spmd
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.server.client import InternalClient
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+multihost.initialize()
+pid = jax.process_index()
+p0, p1 = int(os.environ["T_PORT0"]), int(os.environ["T_PORT1"])
+data = os.environ["T_DATA"]
+
+# node ids in sorted order == process ids (the documented convention)
+if pid == 0:
+    srv = Server(data + "/n0", port=p0, name="n0", coordinator=True)
+else:
+    srv = Server(data + "/n1", port=p1, name="n1",
+                 seeds=[f"http://127.0.0.1:{p0}"])
+srv.open()
+c = InternalClient(timeout=30)
+
+# barrier: both servers joined the HTTP cluster
+deadline = time.monotonic() + 60
+while len(srv.cluster.sorted_nodes()) < 2:
+    if time.monotonic() > deadline:
+        raise SystemExit("join timeout")
+    time.sleep(0.05)
+spmd.verify_rank_convention(srv.cluster)
+
+# deterministic dataset, generated identically in both workers for the
+# oracle; written once through node 0's HTTP API so fragments land by
+# jump hash
+N_SHARDS = 6
+rng = random.Random(4242)
+bits = {}
+rows_l, cols_l = [], []
+for row in range(3):
+    cols = {rng.randrange(N_SHARDS * SHARD_WIDTH) for _ in range(250)}
+    bits[row] = cols
+    rows_l += [row] * len(cols); cols_l += sorted(cols)
+vcols = sorted({rng.randrange(N_SHARDS * SHARD_WIDTH) for _ in range(300)})
+vals = {c: rng.randrange(-1000, 100000) for c in vcols}
+
+if pid == 0:
+    post = lambda p, o: c.post_json(srv.uri + p, o)
+    post("/index/i", {})
+    post("/index/i/field/f", {})
+    post("/index/i/field/v",
+         {"options": {"type": "int", "min": -1000, "max": 100000}})
+    post("/index/i/field/f/import", {"rowIDs": rows_l, "columnIDs": cols_l})
+    post("/index/i/field/v/import-value",
+         {"columnIDs": vcols, "values": [vals[c] for c in vcols]})
+
+# barrier: every process waits until the scatter-gather plane sees all
+# data, then signals readiness over the CONTROL plane (a file), never a
+# jax collective — a global sync enqueued while a peer still drives
+# local device work through HTTP deadlocks (the collective parks on
+# this process's devices, the peer's HTTP poll needs those devices,
+# the peer never reaches the sync: learned the hard way)
+want0 = len(bits[0])
+deadline = time.monotonic() + 60
+while True:
+    try:
+        got = c.post_json(srv.uri + "/index/i/query",
+                          {"query": "Count(Row(f=0))"})["results"][0]
+        if got == want0:
+            break
+    except Exception:
+        pass
+    if time.monotonic() > deadline:
+        raise SystemExit("data visibility timeout")
+    time.sleep(0.1)
+
+open(f"{data}/ready.{pid}", "w").write("1")
+deadline = time.monotonic() + 120
+while not all(os.path.exists(f"{data}/ready.{p}") for p in (0, 1)):
+    if time.monotonic() > deadline:
+        raise SystemExit("ready barrier timeout")
+    time.sleep(0.05)
+
+# sanity: this process owns only PART of the shard space (stacks must
+# genuinely span processes)
+plan = spmd.make_plan(
+    sorted(srv.holder.index("i").available_shards()),
+    spmd.owner_rank_fn(srv.cluster, "i"))
+owned = [s for i, s in enumerate(plan.order) if s >= 0 and i in plan.local]
+total = [s for s in plan.order if s >= 0]
+assert 0 < len(owned) < len(total), (owned, total)
+
+ce = spmd.CollectiveExecutor(srv.holder, srv.cluster, "i")
+out = []
+queries = [
+    "Count(Row(f=0))",
+    "Count(Intersect(Row(f=0), Row(f=1)))",
+    "Count(Union(Row(f=0), Row(f=1), Row(f=2)))",
+    "Count(Row(v > 50000))",
+    "Count(Row(v >< [-500, 0]))",
+    "Sum(field=v)",
+    "Sum(Row(f=1), field=v)",
+    "TopN(f)",
+    "TopN(f, Row(f=0), n=2)",
+]
+oracle = {
+    queries[0]: len(bits[0]),
+    queries[1]: len(bits[0] & bits[1]),
+    queries[2]: len(bits[0] | bits[1] | bits[2]),
+    queries[3]: sum(1 for x in vals.values() if x > 50000),
+    queries[4]: sum(1 for x in vals.values() if -500 <= x <= 0),
+}
+for q in queries:
+    got = ce.execute(q)
+    if q in oracle:
+        assert got == oracle[q], (q, got, oracle[q])
+    out.append((q, repr(got)))
+
+# Sum/TopN oracles
+sv = ce.execute("Sum(field=v)")
+assert sv.val == sum(vals.values()) and sv.count == len(vals)
+sf = ce.execute("Sum(Row(f=1), field=v)")
+wantf = [v for cc, v in vals.items() if cc in bits[1]]
+assert sf.val == sum(wantf) and sf.count == len(wantf)
+tn = ce.execute("TopN(f)")
+want_tn = sorted(((r, len(cc)) for r, cc in bits.items()),
+                 key=lambda rc: (-rc[1], rc[0]))
+assert [(p.id, p.count) for p in tn] == want_tn, (tn, want_tn)
+
+# cross-check the collective data plane against the HTTP control plane.
+# Two phases with a control-plane barrier between: an HTTP scatter-
+# gather needs the PEER's devices, so it must never run while the peer
+# sits in a collective (same deadlock as the ready barrier)
+http_res = [c.post_json(srv.uri + "/index/i/query",
+                        {"query": q})["results"][0] for q in queries[:5]]
+open(f"{data}/xcheck.{pid}", "w").write("1")
+deadline = time.monotonic() + 120
+while not all(os.path.exists(f"{data}/xcheck.{p}") for p in (0, 1)):
+    if time.monotonic() > deadline:
+        raise SystemExit("xcheck barrier timeout")
+    time.sleep(0.05)
+for q, http in zip(queries[:5], http_res):
+    coll = ce.execute(q)
+    assert http == coll, (q, http, coll)
+
+# PRODUCT path: a plain HTTP query on the coordinator transparently
+# upgrades to a collective — the peer joins via the broadcast bus while
+# idling in a pure file-poll loop (no device work, no deadlock)
+open(f"{data}/product.{pid}", "w").write("1")
+deadline = time.monotonic() + 120
+while not all(os.path.exists(f"{data}/product.{p}") for p in (0, 1)):
+    if time.monotonic() > deadline:
+        raise SystemExit("product barrier timeout")
+    time.sleep(0.05)
+if pid == 0:
+    before = spmd.counters()["collective_initiated"]
+    got = c.post_json(srv.uri + "/index/i/query",
+                      {"query": queries[1]})["results"][0]
+    assert got == oracle[queries[1]], got
+    assert spmd.counters()["collective_initiated"] == before + 1, \
+        "HTTP query did not run collectively"
+else:
+    deadline = time.monotonic() + 120
+    while spmd.counters()["collective_joined"] < 1:
+        if time.monotonic() > deadline:
+            raise SystemExit("peer never joined the HTTP collective")
+        time.sleep(0.05)
+
+# exit barrier on the control plane too: a process must not close its
+# server while the peer's last collective still needs both sides
+open(f"{data}/done.{pid}", "w").write("1")
+deadline = time.monotonic() + 120
+while not all(os.path.exists(f"{data}/done.{p}") for p in (0, 1)):
+    if time.monotonic() > deadline:
+        raise SystemExit("done barrier timeout")
+    time.sleep(0.05)
+c.close(); srv.close()
+print("RESULT " + json.dumps(out))
+'''
+
+
+def test_two_process_collective_executor(tmp_path):
+    """Two OS processes, each a full pilosa_tpu server in one HTTP
+    cluster; fragments placed by jump hash; Count/Range/Sum/TopN run
+    collectively with global stacks spanning both processes' devices,
+    bit-identical to the Python oracle AND to the HTTP scatter-gather
+    plane (the reconciled two-plane story, parallel/spmd.py)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    socks = [socket.socket() for _ in range(3)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        coord_port, p0, p1 = (s.getsockname()[1] for s in socks)
+    finally:
+        for s in socks:
+            s.close()
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    env = dict(os.environ)
+    env.update(
+        PALLAS_AXON_POOL_IPS="",
+        JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{coord_port}",
+        JAX_NUM_PROCESSES="2",
+        T_PORT0=str(p0), T_PORT1=str(p1), T_DATA=str(tmp_path),
+        PYTHONPATH=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep
+        + env.get("PYTHONPATH", ""),
+    )
+    procs = []
+    for pid in (0, 1):
+        e = dict(env, JAX_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=e,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = [p.communicate(timeout=540)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+    results = {ln for out in outs for ln in out.splitlines()
+               if ln.startswith("RESULT ")}
+    # both processes computed identical (replicated) results
+    assert len(results) == 1, results
