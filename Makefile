@@ -29,6 +29,10 @@ bench:
 measure:
 	$(PY) benchmarks/measure.py
 
+# elastic resize at 1.07B columns (join + leave, one JSON line each)
+measure-resize:
+	$(PY) benchmarks/measure_resize.py
+
 # on-chip Pallas validation (no-op skip without a TPU)
 validate-tpu:
 	$(PY) benchmarks/validate_tpu.py

@@ -44,6 +44,15 @@ FALSE_ROW_ID = 0
 TRUE_ROW_ID = 1
 
 
+def _frag_gen(fr):
+    """Cache-invalidation token for one fragment slot: (uid, gen), or 0
+    for an absent fragment.  The uid half guards against object
+    replacement — a fragment deleted by resize cleanup and re-fetched
+    later is a new object whose _gen can collide with a cached tuple,
+    which a bare-gen comparison would treat as a (stale) hit."""
+    return 0 if fr is None else (fr._uid, fr._gen)
+
+
 def _padded_rows(n: int) -> int:
     """Pad the shard axis to the device count so stacks shard evenly
     over the mesh; padding rows are zero (no bits)."""
@@ -370,7 +379,7 @@ class Field:
         # bind each fragment once: a concurrent delete_fragment between
         # two lookups must read as "empty", not crash
         frags = [None if view is None else view.fragment(s) for s in shards]
-        gens = tuple(0 if fr is None else fr._gen for fr in frags)
+        gens = tuple(_frag_gen(fr) for fr in frags)
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if hit is not None and hit[0] == gens and _live(hit[1]):
@@ -454,8 +463,7 @@ class Field:
         for s in shards:
             frags = [None if v is None else v.fragment(s) for v in views]
             frag_grid.append(frags)
-            gens.append(tuple(0 if fr is None else fr._gen
-                              for fr in frags))
+            gens.append(tuple(_frag_gen(fr) for fr in frags))
         gens = tuple(gens)
         with self._lock:
             hit = self._row_stack_cache.get(key)
@@ -568,7 +576,7 @@ class Field:
                 gens.append(0)
                 continue
             with frag._lock:
-                gens.append(frag._gen)
+                gens.append(_frag_gen(frag))
                 ids, mat = frag._stacked()
             if len(ids):
                 parts.append((i, ids, mat))
@@ -650,7 +658,7 @@ class Field:
         view = self.view(self.bsi_view_name)
         key = ("planes", shards, depth)
         frags = [None if view is None else view.fragment(s) for s in shards]
-        gens = tuple(0 if fr is None else fr._gen for fr in frags)
+        gens = tuple(_frag_gen(fr) for fr in frags)
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if hit is not None and hit[0] == gens and _live(hit[1]):

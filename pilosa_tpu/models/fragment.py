@@ -23,6 +23,7 @@ BSI fields store bit planes as rows 0..depth+1 of the same matrix
 
 from __future__ import annotations
 
+import itertools
 import os
 import struct
 import threading
@@ -50,6 +51,8 @@ _WAL_BULK_HDR = struct.Struct("<BQQ")  # op, n_set, n_clear
 class Fragment:
     """One shard of one view of one field."""
 
+    _UID = itertools.count(1)
+
     def __init__(
         self,
         path: str | None,
@@ -75,6 +78,11 @@ class Fragment:
 
         self._rows: dict[int, np.ndarray] = {}
         self._gen = 0
+        # process-unique identity for cache keys: a fragment deleted
+        # (resize cleanup) and later re-fetched is a NEW object whose
+        # _gen can collide with a stale cached tuple — uid makes a
+        # false cache hit impossible (found by the resize soak leg)
+        self._uid = next(Fragment._UID)
         self._closed = False
         self._snapshotting = False
         self._stack_cache: tuple[int, np.ndarray, np.ndarray] | None = None

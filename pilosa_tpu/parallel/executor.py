@@ -891,11 +891,17 @@ class Executor:
                 totals[rid] = totals.get(rid, 0) + c
                 per_shard.setdefault(int(pos), {})[rid] = c
         # warm every fragment's cache — including ones whose rows all
-        # counted zero, whose complete answer is "no rows"
+        # counted zero, whose complete answer is "no rows".  gens slots
+        # are (uid, gen) tokens (field._frag_gen): stamp the cache with
+        # the bare gen, and only when the token's uid still matches the
+        # live object — a fragment replaced mid-query (resize re-fetch)
+        # must not have a fresh object's cache validated by a stale scan
         for pos, s in enumerate(shards):
             frag = view.fragment(s)
-            if frag is not None:
-                frag.cache_row_counts(per_shard.get(pos, {}), gen=gens[pos])
+            tok = gens[pos]
+            if (frag is not None and isinstance(tok, tuple)
+                    and tok[0] == frag._uid):
+                frag.cache_row_counts(per_shard.get(pos, {}), gen=tok[1])
         return totals
 
     # --------------------------------------------------------------- Rows

@@ -5,7 +5,11 @@ Drives a replicated in-process 3-node cluster with an interleaved
 random workload — bulk imports, PQL Set/Clear, BSI writes, nested set
 algebra, BSI ranges, TopN, GroupBy — checking EVERY read against
 Python-set/dict oracles, while randomly dropping a node (reads must
-fail over exactly) and running anti-entropy repair cycles.
+fail over exactly), running anti-entropy repair cycles, and (round 3)
+driving coordinator-led elastic RESIZE events: a fourth node joins
+(fragments re-home by jump hash) and later leaves, with the oracle
+exact across every ownership change — the reference's
+internal/clustertests/ tier including its resize legs.
 
     PYTHONPATH=/root/repo:$PYTHONPATH python tools/soak.py --seconds 600
 
@@ -77,9 +81,15 @@ def main() -> int:
     downed: str | None = None
     iters = 0
     checks = 0
+    resizes = 0
+    extra: list = []  # nodes joined beyond the base 3, newest last
+    next_extra_id = 3
     t_end = time.monotonic() + args.seconds
     t_report = time.monotonic() + args.progress_every
     ex = coord.executor
+
+    def live_nodes():
+        return [*nodes, *extra]
 
     while time.monotonic() < t_end:
         iters += 1
@@ -116,7 +126,7 @@ def main() -> int:
             q = gen_query(rng)
             want = eval_set_algebra(parse_python(q).calls[0],
                                     bits, universe)
-            node = rng.choice(nodes)
+            node = rng.choice(live_nodes())
             if downed is not None and node.cluster.local_id == downed:
                 node = coord
             res = node.executor.execute("i", q)[0]
@@ -161,7 +171,46 @@ def main() -> int:
                 f"missing={set(want) - set(got)} "
                 f"extra={set(got) - set(want)}")
             checks += 1
-        elif action < 0.97:  # fault injection: drop / restore a node
+        elif action < 0.945:  # elastic resize: join or leave
+            # ownership moves under live traffic; the oracle must stay
+            # exact across every re-homing (reference clustertests
+            # resize legs, cluster.go:1196-1561)
+            if downed is None:
+                from pilosa_tpu.models.holder import Holder
+                from pilosa_tpu.parallel.cluster import Cluster, Node
+                from pilosa_tpu.parallel.node import ClusterNode
+                from pilosa_tpu.parallel.resize import Resizer
+
+                if not extra:
+                    # fixed node ID (placement + transport handle are
+                    # overwritten on re-join, no per-cycle leak), fresh
+                    # dir per cycle (a removed node keeps its detached
+                    # data; rejoining on it would resurrect stale bits)
+                    dirname = f"node3-epoch{next_extra_id}"
+                    next_extra_id += 1
+                    h = Holder(str(tmp / dirname))
+                    cl = Cluster("node3", nodes=[Node(id="node3")],
+                                 replica_n=2, transport=transport)
+                    jn = ClusterNode(h, cl)
+                    resp = transport.send_message(
+                        coord.cluster.local_node,
+                        {"type": "node-join",
+                         "node": {"id": "node3", "uri": ""}})
+                    assert resp.get("ok"), f"join failed: {resp}"
+                    extra.append(jn)
+                else:
+                    import shutil
+
+                    jn = extra.pop()
+                    Resizer(coord).run(remove_id=jn.cluster.local_id)
+                    path = jn.holder.path
+                    jn.holder.close()
+                    shutil.rmtree(path, ignore_errors=True)
+                resizes += 1
+                for nd in live_nodes():
+                    assert nd.cluster.state == "NORMAL", (
+                        f"{nd.cluster.local_id} not NORMAL after resize")
+        elif action < 0.975:  # fault injection: drop / restore a node
             if downed is None:
                 downed = rng.choice(["node1", "node2"])
                 transport.set_down(downed)
@@ -170,28 +219,30 @@ def main() -> int:
                 downed = None
         else:  # anti-entropy repair pass
             if downed is None:
-                for nd in nodes:
+                for nd in live_nodes():
                     HolderSyncer(nd).sync_holder()
 
         if time.monotonic() >= t_report:
             t_report = time.monotonic() + args.progress_every
             print(f"soak: {iters} iters, {checks} oracle checks, "
+                  f"{resizes} resizes, nodes={len(live_nodes())}, "
                   f"downed={downed}", flush=True)
 
     if downed is not None:
         transport.set_down(downed, False)
-    for nd in nodes:
+    for nd in live_nodes():
         HolderSyncer(nd).sync_holder()
     # final convergence: every node answers every row exactly
     for f in fields:
         for r in range(5):
             want = bits[(f, r)]
-            for nd in nodes:
+            for nd in live_nodes():
                 res = nd.executor.execute("i", f"Row({f}={r})")[0]
                 got = set(int(x) for x in res.columns())
                 assert got == want, f"final divergence {f}={r} on " \
                     f"{nd.cluster.local_id}"
-    print(f"soak PASSED: {iters} iters, {checks} oracle checks")
+    print(f"soak PASSED: {iters} iters, {checks} oracle checks, "
+          f"{resizes} resizes")
     return 0
 
 
