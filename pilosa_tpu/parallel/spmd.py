@@ -63,6 +63,18 @@ class CollectiveError(RuntimeError):
     pass
 
 
+#: Row-cardinality ceilings for the dense collective operands.  The
+#: matrix paths build [G, R, words] globals and (GroupBy) an [G, Ra, Rb]
+#: gather — fine for the dimensional-field shapes they serve, hostile at
+#: high cardinality where the scatter path's pruning level walk already
+#: answers well.  The guards raise AFTER agreed_row_ids, which is
+#: deterministic and symmetric (same data on every process), so every
+#: participant refuses together and the coordinator falls back — nobody
+#: is left parked in a half-entered collective.
+MAX_COLLECTIVE_ROWS = 4096
+MAX_COLLECTIVE_PAIRS = 1 << 22
+
+
 @dataclass(frozen=True)
 class Plan:
     """One query's agreed global layout — identical on every process."""
@@ -346,6 +358,47 @@ def _jit_plane_counts(mesh):
 
 
 @functools.cache
+def _jit_pair_counts(mesh, filtered: bool):
+    """GroupBy(2 children) pair counts: [G, Ra, Rb] per-shard int32,
+    gathered replicated (host sums shards in int64).  The cartesian
+    broadcast fuses into the popcount reduction — nothing materializes
+    at [G, Ra, Rb, W].  Collective v1 serves the common 1-2 child
+    shapes; deeper nests use the scatter path's padded level walk."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if filtered:
+        def f(mat_a, mat_b, filt):
+            inter = (mat_a[:, :, None, :] & mat_b[:, None, :, :]
+                     & filt[:, None, None, :])
+            return jnp.sum(lax.population_count(inter), axis=3,
+                           dtype=jnp.int32)
+    else:
+        def f(mat_a, mat_b):
+            inter = mat_a[:, :, None, :] & mat_b[:, None, :, :]
+            return jnp.sum(lax.population_count(inter), axis=3,
+                           dtype=jnp.int32)
+    return jax.jit(f, out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.cache
+def _jit_extremes(mesh, want: str):
+    """Batched Min/Max scan over the global plane stack, all six
+    per-shard outputs gathered replicated — the host applies the same
+    sign branching as the fused executor path (fragment.min/max
+    semantics, fragment.go:1147/1191)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def f(planes, consider):
+        return bsi_ops.extremes_stacked(planes, consider, want)
+
+    return jax.jit(f, out_shardings=NamedSharding(mesh, P()))
+
+
+@functools.cache
 def _jit_range_stack(mesh, op: str, p1: int, p2: int):
     """BSI compare -> [G, words] sharded row stack (stays sharded; the
     caller counts or combines it).  Static predicates: query text
@@ -580,7 +633,7 @@ class CollectiveExecutor:
         if call.name == "Count":
             return (len(call.children) == 1
                     and self._tree_ok(call.children[0]))
-        if call.name == "Sum":
+        if call.name in ("Sum", "Min", "Max"):
             fname = call.string_arg("field") or call.string_arg("_field")
             if not fname or not self._plain_field(fname):
                 return False
@@ -597,6 +650,24 @@ class CollectiveExecutor:
                     "tanimotoThreshold")):
                 return False
             return not call.children or self._tree_ok(call.children[0])
+        if call.name == "GroupBy":
+            if not 1 <= len(call.children) <= 2:
+                return False  # deeper nests: scatter path's level walk
+            if any(a in call.args for a in ("previous", "aggregate",
+                                            "having")):
+                return False
+            for child in call.children:
+                if child.name != "Rows":
+                    return False
+                fname = (child.args.get("_field")
+                         or child.args.get("field"))
+                if not fname or not self._plain_field(fname):
+                    return False
+                if any(a in child.args for a in
+                       ("limit", "column", "previous", "from", "to")):
+                    return False  # constrained children: scatter path
+            filt = call.call_arg("filter")
+            return filt is None or self._tree_ok(filt)
         return False
 
     def _plain_field(self, name: str) -> bool:
@@ -639,8 +710,12 @@ class CollectiveExecutor:
             return int(per_shard.sum())
         if call.name == "Sum":
             return self._sum(call, plan)
+        if call.name in ("Min", "Max"):
+            return self._extreme(call, plan)
         if call.name == "TopN":
             return self._topn(call, plan)
+        if call.name == "GroupBy":
+            return self._group_by(call, plan)
         raise CollectiveError(call.name)
 
     def _field(self, name: str):
@@ -705,6 +780,107 @@ class CollectiveExecutor:
                     for i, (p, n) in enumerate(zip(pos, neg)))
         return ValCount(total + total_count * f.options.base, total_count)
 
+    def _extreme(self, call, plan: Plan):
+        """Min/Max: one collective extremes scan, host sign-branching
+        per shard + smaller/larger fold — the collective twin of the
+        fused executor's _fused_extreme (same semantics, global mesh)."""
+        from pilosa_tpu.parallel.results import ValCount
+
+        fname = call.string_arg("field") or call.string_arg("_field")
+        f = self._field(fname)
+        P = global_plane_stack(f, plan)
+        consider = _jit_exists(plan.mesh)(P)
+        if call.children:
+            consider = bm.b_and(consider,
+                                self._eval_stack(call.children[0], plan))
+        is_min = call.name == "Min"
+        want = "min" if is_min else "max"
+        (signed_cnt, all_cnt, primary_taken, fallback_taken,
+         primary_n, fallback_n) = [
+            np.asarray(x) for x in _jit_extremes(plan.mesh, want)(P, consider)]
+        reducer = "smaller" if is_min else "larger"
+        out = ValCount()
+        for s in range(len(plan.order)):  # padding blocks count zero
+            if all_cnt[s] == 0:
+                continue
+            if signed_cnt[s] > 0:
+                v = bsi_ops.assemble_value(primary_taken[s])
+                if is_min:
+                    v = -v
+                c = int(primary_n[s])
+            else:
+                v = bsi_ops.assemble_value(fallback_taken[s])
+                if not is_min:
+                    v = -v  # Max of all-negative = closest to zero
+                c = int(fallback_n[s])
+            out = getattr(out, reducer)(ValCount(v + f.options.base, c))
+        return out
+
+    def _group_by(self, call, plan: Plan):
+        """GroupBy over 1-2 Rows children: agreed row-id lists per
+        child, one collective cartesian-counts program, host assembly
+        in the executor's sorted-group order with offset-then-limit
+        (executor.go:1135-1149)."""
+        from pilosa_tpu.parallel.results import FieldRow, GroupCount
+
+        fields = []
+        row_lists = []
+        for child in call.children:
+            fname = child.args.get("_field") or child.args.get("field")
+            f = self._field(fname)
+            ids = agreed_row_ids(f)
+            if not ids:
+                return []
+            if len(ids) > MAX_COLLECTIVE_ROWS:
+                raise CollectiveError(
+                    f"field {fname!r} has {len(ids)} rows > "
+                    f"{MAX_COLLECTIVE_ROWS}; dense collective GroupBy "
+                    f"declines (scatter path's level walk handles it)")
+            fields.append(f)
+            row_lists.append(ids)
+        if (len(row_lists) == 2 and
+                len(row_lists[0]) * len(row_lists[1]) > MAX_COLLECTIVE_PAIRS):
+            raise CollectiveError("GroupBy pair space too large for the "
+                                  "dense collective path")
+        filt_call = call.call_arg("filter")
+        filt = (self._eval_stack(filt_call, plan)
+                if filt_call is not None else None)
+        if len(fields) == 1:
+            mat = global_matrix_stack(fields[0], row_lists[0], plan)
+            if filt is not None:
+                per_shard = _jit_row_counts(plan.mesh, True)(mat, filt)
+            else:
+                per_shard = _jit_row_counts(plan.mesh, False)(mat)
+            counts = np.asarray(per_shard, dtype=np.int64).sum(axis=0)
+            totals = {((fields[0].name, r),): int(c)
+                      for r, c in zip(row_lists[0], counts) if c > 0}
+        else:
+            mat_a = global_matrix_stack(fields[0], row_lists[0], plan)
+            mat_b = global_matrix_stack(fields[1], row_lists[1], plan)
+            if filt is not None:
+                per_shard = _jit_pair_counts(plan.mesh, True)(
+                    mat_a, mat_b, filt)
+            else:
+                per_shard = _jit_pair_counts(plan.mesh, False)(mat_a, mat_b)
+            counts = np.asarray(per_shard, dtype=np.int64).sum(axis=0)
+            ra_ids = np.asarray(row_lists[0])
+            rb_ids = np.asarray(row_lists[1])
+            totals = {}
+            for i, j in np.argwhere(counts > 0):
+                totals[((fields[0].name, int(ra_ids[i])),
+                        (fields[1].name, int(rb_ids[j])))] = \
+                    int(counts[i, j])
+        out = [GroupCount(group=[FieldRow(field=fn, row_id=r)
+                                 for fn, r in key], count=c)
+               for key, c in sorted(totals.items())]
+        offset = call.uint_arg("offset")
+        if offset is not None:
+            out = out[offset:] if offset < len(out) else out
+        limit = call.uint_arg("limit")
+        if limit is not None:
+            out = out[:limit]
+        return out
+
     def _topn(self, call, plan: Plan):
         from pilosa_tpu.parallel.results import Pair
 
@@ -714,6 +890,10 @@ class CollectiveExecutor:
         row_ids = agreed_row_ids(f)
         if not row_ids:
             return []
+        if len(row_ids) > MAX_COLLECTIVE_ROWS:
+            raise CollectiveError(
+                f"TopN over {len(row_ids)} rows exceeds the dense "
+                f"collective ceiling {MAX_COLLECTIVE_ROWS}")
         mat = global_matrix_stack(f, row_ids, plan)
         if call.children:
             filt = self._eval_stack(call.children[0], plan)

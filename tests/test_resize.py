@@ -66,6 +66,41 @@ class TestPlan:
             assert "_exists" in fields  # existence field moves too
 
 
+class TestStackCacheAcrossReplacement:
+    def test_replaced_fragment_invalidates_stack_caches(self, tmp_path):
+        """Resize cleanup deletes a Fragment and a later re-fetch
+        creates a NEW object whose generation counter can collide with
+        a cached stack's token.  The (uid, gen) tokens (field._frag_gen)
+        must treat the replacement as a miss — a bare-gen comparison
+        false-hit here and served stale counts (caught by the soak's
+        resize leg, round 3)."""
+        from pilosa_tpu.models.fragment import Fragment
+        from pilosa_tpu.parallel.executor import Executor
+
+        holder = Holder(str(tmp_path / "h"))
+        idx = holder.create_index("i")
+        f = idx.create_field("f")
+        for c in range(50):
+            f.set_bit(1, c)
+        ex = Executor(holder)
+        assert ex.execute("i", "Count(Row(f=1))")[0] == 50  # warms caches
+        assert ex.execute("i", "TopN(f)")[0][0].count == 50
+
+        view = f.view("standard")
+        old = view.fragments[0]
+        # replacement with IDENTICAL generation but different content —
+        # exactly what a resize re-fetch can produce
+        new = Fragment(None, "i", "f", "standard", 0)
+        for c in range(70):
+            new.set_bit(1, c)
+        new._gen = old._gen
+        view.fragments[0] = new
+
+        assert ex.execute("i", "Count(Row(f=1))")[0] == 70
+        assert ex.execute("i", "TopN(f)")[0][0].count == 70
+        holder.close()
+
+
 class TestJoin:
     def test_join_moves_data_and_queries_stay_correct(self, tmp_path):
         transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)

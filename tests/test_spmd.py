@@ -99,6 +99,21 @@ class TestSingleProcessCollective:
         assert got.val == sum(want) and got.count == len(want)
         assert got == ex.execute("i", "Sum(Row(f=1), field=v)")[0]
 
+    def test_min_max_parity(self, single):
+        h, ce, ex, bits, vals = single
+        for pql in ("Min(field=v)", "Max(field=v)",
+                    "Min(Row(f=1), field=v)", "Max(Row(f=1), field=v)"):
+            got = ce.execute(pql)
+            assert got == ex.execute("i", pql)[0], pql
+        lo = min(vals.values())
+        got = ce.execute("Min(field=v)")
+        assert got.val == lo
+        assert got.count == sum(1 for x in vals.values() if x == lo)
+        hi = max(vals.values())
+        got = ce.execute("Max(field=v)")
+        assert got.val == hi
+        assert got.count == sum(1 for x in vals.values() if x == hi)
+
     def test_topn_parity(self, single):
         h, ce, ex, bits, vals = single
         want = sorted(
@@ -114,9 +129,29 @@ class TestSingleProcessCollective:
         assert filt == wantf
         assert filt == ex.execute("i", "TopN(f, Row(f=0), n=3)")[0]
 
+    def test_group_by_parity(self, single):
+        h, ce, ex, bits, vals = single
+        # second field so the 2-child walk crosses field boundaries
+        g = h.index("i").create_field("g")
+        rows_l, cols_l = [], []
+        for row in range(3):
+            for c in sorted(bits[row])[: 120]:
+                rows_l.append(row)
+                cols_l.append(c)
+        g.import_bits(rows_l, cols_l)
+        for pql in ("GroupBy(Rows(f))",
+                    "GroupBy(Rows(f), Rows(g))",
+                    "GroupBy(Rows(f), Rows(g), filter=Row(f=0))",
+                    "GroupBy(Rows(f), Rows(g), limit=3)",
+                    "GroupBy(Rows(f), Rows(g), offset=2, limit=4)"):
+            assert ce.execute(pql) == ex.execute("i", pql)[0], pql
+
     def test_unsupported_calls_refused(self, single):
         h, ce, ex, bits, vals = single
-        for pql in ("Row(f=0)", "GroupBy(Rows(f))", "Min(field=v)",
+        for pql in ("Row(f=0)", "MinRow(field=f)",
+                    "GroupBy(Rows(f), Rows(f), Rows(f))",  # >2 children
+                    "GroupBy(Rows(f, limit=2))",  # constrained child
+                    "GroupBy(Rows(f), previous=1)",
                     "Count(Row(f=0, from='2019-01-01T00:00'))",
                     # args the executor honors but this evaluator
                     # doesn't — silently changed semantics is worse
@@ -252,6 +287,8 @@ queries = [
     "Count(Row(v >< [-500, 0]))",
     "Sum(field=v)",
     "Sum(Row(f=1), field=v)",
+    "Min(field=v)",
+    "Max(field=v)",
     "TopN(f)",
     "TopN(f, Row(f=0), n=2)",
 ]
@@ -278,6 +315,17 @@ tn = ce.execute("TopN(f)")
 want_tn = sorted(((r, len(cc)) for r, cc in bits.items()),
                  key=lambda rc: (-rc[1], rc[0]))
 assert [(p.id, p.count) for p in tn] == want_tn, (tn, want_tn)
+mn = ce.execute("Min(field=v)")
+lo = min(vals.values())
+assert mn.val == lo and mn.count == sum(
+    1 for x in vals.values() if x == lo), mn
+mx = ce.execute("Max(field=v)")
+hi = max(vals.values())
+assert mx.val == hi and mx.count == sum(
+    1 for x in vals.values() if x == hi), mx
+gb = ce.execute("GroupBy(Rows(f))")
+want_gb = sorted((r, len(cc)) for r, cc in bits.items() if cc)
+assert [(g.group[0].row_id, g.count) for g in gb] == want_gb, gb
 
 # cross-check the collective data plane against the HTTP control plane.
 # Two phases with a control-plane barrier between: an HTTP scatter-
