@@ -404,7 +404,8 @@ class Executor:
                      else _dt.datetime(1, 1, 1))
             end = (parse_time(to_arg) if to_arg is not None
                    else _dt.datetime(9999, 1, 1))
-        except (ValueError, TypeError):
+        except (ValueError, TypeError, OverflowError, OSError):
+            # int timestamps can overflow fromtimestamp (platform time_t)
             return None
         start, end = self._clamp_to_views(f, start, end)
         return ([] if start >= end

@@ -188,6 +188,33 @@ def global_row_stack(field, row_id: int, plan: Plan):
         _fill_blocks(plan, (n_words,), fill))
 
 
+def global_time_row_stack(field, row_id: int, view_names, plan: Plan):
+    """[G, words] operand for a time-range Row: each block is the OR of
+    the covering views' rows from the LOCAL fragments.  The view list
+    must be identical on every process — the collective path derives it
+    UNCLAMPED from query text + the field's (replicated) quantum, never
+    from locally-present views (processes hold different view subsets;
+    a local clamp would diverge the programs)."""
+    import jax
+
+    views = [field.view(vn) for vn in view_names]
+    n_words = bm.n_words(SHARD_WIDTH)
+
+    def fill(buf, s):
+        for v in views:
+            frag = v.fragment(s) if v is not None else None
+            if frag is None:
+                continue
+            with frag._lock:  # OR under the lock: rows mutate in place
+                arr = frag._rows.get(row_id)
+                if arr is not None:
+                    np.bitwise_or(buf, arr, out=buf)
+
+    return jax.make_array_from_callback(
+        (len(plan.order), n_words), _sharding(plan, 1),
+        _fill_blocks(plan, (n_words,), fill))
+
+
 def global_plane_stack(field, plan: Plan):
     """[G, planes, words] BSI operand (exists, sign, magnitudes)."""
     import jax
@@ -630,6 +657,14 @@ class CollectiveExecutor:
     # -- eval
 
     def supported(self, call) -> bool:
+        try:
+            return self._supported(call)
+        except Exception:  # noqa: BLE001 — malformed args are simply
+            # not collectively supported; the scatter path owns the
+            # user-facing error (try_collective must never raise)
+            return False
+
+    def _supported(self, call) -> bool:
         if call.name == "Count":
             return (len(call.children) == 1
                     and self._tree_ok(call.children[0]))
@@ -677,7 +712,12 @@ class CollectiveExecutor:
     def _tree_ok(self, call) -> bool:
         if call.name == "Row":
             if "from" in call.args or "to" in call.args:
-                return False  # time ranges: scatter-gather path (v1)
+                fname = call.field_arg()
+                if not fname or not self._plain_field(fname):
+                    return False
+                if type(call.args.get(fname)) is not int:
+                    return False
+                return self._time_views(call) is not None
             cond = call.condition_arg()
             if cond is not None:
                 return self._plain_field(cond[0])
@@ -688,9 +728,51 @@ class CollectiveExecutor:
             # plain integer row ids run collectively (bool is an int
             # subclass, hence the exact type check)
             return type(call.args.get(fname)) is int
+        if call.name == "Not":
+            return (len(call.children) == 1
+                    and self.idx.existence_field() is not None
+                    and self._tree_ok(call.children[0]))
+        if call.name == "Shift":
+            n = call.int_arg("n")
+            return (len(call.children) == 1 and (n is None or n >= 0)
+                    and self._tree_ok(call.children[0]))
         if call.name in ("Union", "Intersect", "Difference", "Xor"):
             return all(self._tree_ok(c) for c in call.children)
         return False
+
+    #: time-range covers beyond this are declined to the scatter path
+    #: (an unclamped multi-century cover would compile huge programs)
+    MAX_TIME_VIEWS = 256
+
+    def _time_views(self, call) -> list[str] | None:
+        """The covering view names for a Row(from=, to=), derived ONLY
+        from query text + the field's replicated quantum — every
+        process computes the identical list (a clamp against locally
+        present views, as the per-node fused path does, would diverge
+        the SPMD programs).  None = not collectively evaluable (bad
+        range, open-ended, or cover too wide)."""
+        from pilosa_tpu.models.timequantum import (parse_time,
+                                                   views_by_time_range)
+
+        fname = call.field_arg()
+        f = self._field(fname)
+        if not str(f.time_quantum):
+            return None
+        from_arg = call.args.get("from")
+        to_arg = call.args.get("to")
+        if from_arg is None or to_arg is None:
+            return None  # open-ended: needs the local clamp, scatter path
+        try:
+            start = parse_time(from_arg)
+            end = parse_time(to_arg)
+        except (ValueError, TypeError, OverflowError, OSError):
+            # int timestamps can overflow fromtimestamp (platform time_t)
+            return None
+        if start >= end:
+            return []
+        views = list(views_by_time_range(VIEW_STANDARD, start, end,
+                                         f.time_quantum))
+        return views if len(views) <= self.MAX_TIME_VIEWS else None
 
     def execute(self, pql: str):
         from pilosa_tpu.pql import parse
@@ -724,9 +806,27 @@ class CollectiveExecutor:
             raise CollectiveError(f"unknown field {name!r}")
         return f
 
+    def _zero_stack(self, plan: Plan):
+        import jax
+
+        return jax.device_put(
+            np.zeros((len(plan.order), bm.n_words(SHARD_WIDTH)),
+                     np.uint32), _sharding(plan, 1))
+
     def _eval_stack(self, call, plan: Plan):
         name = call.name
         if name == "Row":
+            if "from" in call.args or "to" in call.args:
+                views = self._time_views(call)
+                if views is None:
+                    raise CollectiveError("time range not collectively "
+                                          "evaluable")
+                if not views:
+                    return self._zero_stack(plan)
+                fname = call.field_arg()
+                return global_time_row_stack(
+                    self._field(fname), call.args[fname],
+                    tuple(views), plan)
             cond = call.condition_arg()
             if cond is not None:
                 fname, condition = cond
@@ -737,6 +837,14 @@ class CollectiveExecutor:
             fname = call.field_arg()
             return global_row_stack(self._field(fname),
                                     call.args[fname], plan)
+        if name == "Not":
+            exist = global_row_stack(self.idx.existence_field(), 0, plan)
+            return bm.b_andnot(exist,
+                               self._eval_stack(call.children[0], plan))
+        if name == "Shift":
+            n = call.int_arg("n")
+            return bm.b_shift(self._eval_stack(call.children[0], plan),
+                              1 if n is None else n)
         kids = [self._eval_stack(c, plan) for c in call.children]
         op = {"Union": bm.b_or, "Intersect": bm.b_and,
               "Difference": bm.b_andnot, "Xor": bm.b_xor}[name]
@@ -746,14 +854,9 @@ class CollectiveExecutor:
         return out
 
     def _range_stack(self, f, op: str, value, plan: Plan):
-        import jax
-
         rplan = f._classify_range(op, value)
         if rplan[0] == "empty":
-            n_words = bm.n_words(SHARD_WIDTH)
-            return jax.device_put(
-                np.zeros((len(plan.order), n_words), np.uint32),
-                _sharding(plan, 1))
+            return self._zero_stack(plan)
         P = global_plane_stack(f, plan)
         if rplan[0] == "not_null":
             return _jit_exists(plan.mesh)(P)

@@ -129,6 +129,43 @@ class TestSingleProcessCollective:
         assert filt == wantf
         assert filt == ex.execute("i", "TopN(f, Row(f=0), n=3)")[0]
 
+    def test_not_shift_time_parity(self, single):
+        h, ce, ex, bits, vals = single
+        idx = h.index("i")
+        # existence bits via the executor's write path (maintains _exists)
+        for c in sorted(bits[0])[:50]:
+            ex.execute("i", f"Set({c}, f=7)")
+        for pql in ("Count(Not(Row(f=0)))",
+                    "Count(Union(Row(f=1), Not(Row(f=2))))",
+                    "Count(Shift(Row(f=0), n=3))",
+                    "Count(Shift(Row(f=1)))"):
+            got = ce.execute(pql)
+            assert got == ex.execute("i", pql)[0], pql
+
+        from pilosa_tpu.models.field import FieldOptions
+        from pilosa_tpu.models.timequantum import parse_time
+
+        t = idx.create_field("t", FieldOptions.time_field("YMD"))
+        rng = random.Random(2)
+        trows, tcols, times = [], [], []
+        for _ in range(200):
+            trows.append(4)
+            tcols.append(rng.randrange(3 * SHARD_WIDTH))
+            times.append(parse_time(
+                f"2019-0{1 + rng.randrange(9)}-{1 + rng.randrange(27):02d}T00:00"))
+        t.import_bits(trows, tcols, timestamps=times)
+        for pql in (
+            "Count(Row(t=4, from='2019-02-01T00:00', to='2019-05-01T00:00'))",
+            "Count(Row(t=4, from='2019-01-01T00:00', to='2020-01-01T00:00'))",
+            "Count(Intersect(Row(f=0), Row(t=4, from='2019-01-01T00:00', "
+            "to='2019-07-01T00:00')))",
+        ):
+            got = ce.execute(pql)
+            assert got == ex.execute("i", pql)[0], pql
+        # open-ended ranges need the local clamp: scatter path only
+        with pytest.raises(spmd.CollectiveError):
+            ce.execute("Count(Row(t=4, from='2019-01-01T00:00'))")
+
     def test_group_by_parity(self, single):
         h, ce, ex, bits, vals = single
         # second field so the 2-child walk crosses field boundaries
