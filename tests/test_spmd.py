@@ -209,6 +209,58 @@ class TestSingleProcessCollective:
             with pytest.raises(spmd.CollectiveError):
                 ce.execute(pql)
 
+    def test_fuzz_collective_vs_scatter_vs_oracle(self, tmp_path):
+        """Randomized differential sweep: every collective-supported
+        query shape must agree with BOTH the product executor and a
+        Python-set oracle — the three-way check that caught the resize
+        cache bug, applied to the whole collective surface."""
+        import contextlib
+
+        with contextlib.closing(Holder(str(tmp_path / "h"))) as h:
+            self._run_fuzz(h)
+
+    def _run_fuzz(self, h):
+        from pilosa_tpu.pql import parse_python
+        from tests.test_fuzz_stress import eval_set_algebra, gen_query
+
+        idx = h.create_index("i")
+        rng = random.Random(777)
+        n_shards = 4
+        row_sets: dict[tuple[str, int], set] = {}
+        universe: set[int] = set()
+        for fi in range(3):
+            f = idx.create_field(f"f{fi}")
+            rows_l, cols_l = [], []
+            for row in range(5):
+                cols = {rng.randrange(n_shards * SHARD_WIDTH)
+                        for _ in range(rng.randrange(50, 250))}
+                row_sets[(f"f{fi}", row)] = cols
+                rows_l += [row] * len(cols)
+                cols_l += list(cols)
+                universe |= cols
+            f.import_bits(rows_l, cols_l)
+        # existence rows for Not: both planes complement against _exists
+        ex = Executor(h)
+        idx.existence_field().import_bits([0] * len(universe),
+                                          sorted(universe))
+
+        cluster = Cluster(local_id="n0")
+        cluster.add_node(Node(id="n0", uri="local"))
+        ce = spmd.CollectiveExecutor(h, cluster, "i")
+        checked = 0
+        for _ in range(120):
+            q = f"Count({gen_query(rng, depth=1)})"
+            calls = parse_python(q).calls
+            if not ce.supported(calls[0]):
+                continue
+            want = len(eval_set_algebra(calls[0].children[0],
+                                        row_sets, universe))
+            got_c = ce.execute(q)
+            got_x = ex.execute("i", q)[0]
+            assert got_c == want == got_x, (q, got_c, got_x, want)
+            checked += 1
+        assert checked >= 60, f"only {checked} shapes exercised"
+
     def test_rank_convention_checker(self, single):
         h, ce, ex, bits, vals = single
         # single process: rank 0 must be the sorted position of "n0"
