@@ -26,3 +26,29 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _hermetic_residency_accounting():
+    """Reset the process-wide residency manager after every test.
+
+    Tests that don't close their holders leak accounting entries into
+    the global manager; individually harmless, but the accumulated
+    total eventually trips budget gates in later tests (first seen:
+    prewarm declining work at the shard-width-22 matrix leg, where
+    stacks are 4x bigger).  Real servers close their holders on
+    shutdown; per-test reset restores that hermeticity.  Orphaned cache
+    entries stay functional (generation checks still validate) — they
+    merely stop being tracked/evictable, which is fine for test
+    lifetimes."""
+    yield
+    from pilosa_tpu.runtime import prewarm, residency
+
+    # drain BEFORE reset: an in-flight background prewarm from the
+    # finished test would otherwise admit into the next test's fresh
+    # manager (the cross-test leak this fixture exists to stop, made
+    # timing-dependent)
+    prewarm.drain(timeout=30)
+    residency.reset()

@@ -17,10 +17,8 @@ from pilosa_tpu.runtime import residency
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
-@pytest.fixture(autouse=True)
-def fresh_manager():
-    yield
-    residency.reset()  # restore the default budget for other tests
+# (per-test residency reset now lives in conftest.py's
+# _hermetic_residency_accounting, applied suite-wide)
 
 
 class TestManagerUnit:
