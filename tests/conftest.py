@@ -49,6 +49,8 @@ def _hermetic_residency_accounting():
     # drain BEFORE reset: an in-flight background prewarm from the
     # finished test would otherwise admit into the next test's fresh
     # manager (the cross-test leak this fixture exists to stop, made
-    # timing-dependent)
-    prewarm.drain(timeout=30)
+    # timing-dependent).  A timeout must fail HERE, pinned to the
+    # offending test, not surface as a nondeterministic budget trip
+    # three tests later.
+    assert prewarm.drain(timeout=30), "prewarm drain timed out in teardown"
     residency.reset()
