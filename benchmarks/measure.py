@@ -22,7 +22,9 @@ BASELINE.md numbers come from TPU runs at the stated shapes.
 from __future__ import annotations
 
 import json
+import os
 import random
+import shutil
 import statistics
 import sys
 import tempfile
@@ -138,7 +140,8 @@ def main():
 
     out = []
 
-    holder = Holder(tempfile.mkdtemp() + "/bench")
+    bench_dir = tempfile.mkdtemp()
+    holder = Holder(bench_dir + "/bench")
     build_index(holder, "b", n_shards, rows_per_field, density, seed=1)
     ex = Executor(holder)
 
@@ -347,6 +350,14 @@ def main():
                 "value": round(p4b, 2), "unit": "ms"})
 
     holder.close()
+    # Quiesce before the latency benchmark: the scale configs above
+    # wrote multi-GB of snapshots whose dirty pages would otherwise
+    # write back DURING config 5's closed loop and collapse a run on a
+    # one-core box (observed: 442 -> 12.7 QPS across runs).  Deleting
+    # the tree drops the dirty pages instead of flushing them; sync
+    # settles whatever remains.
+    shutil.rmtree(bench_dir, ignore_errors=True)
+    os.sync()
 
     # ---- config 5: 3-node HTTP cluster Count QPS
     from pilosa_tpu.server.client import InternalClient
