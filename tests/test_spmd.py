@@ -24,22 +24,24 @@ from pilosa_tpu.parallel.results import Pair
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
-def _build(holder, n_shards=5, seed=11):
+def _build(holder, n_shards=5, seed=11, cols_per_row=(300, 301),
+           n_vals=400, val_range=(-500, 1 << 18)):
     idx = holder.create_index("i")
     f = idx.create_field("f")
     rng = random.Random(seed)
     bits: dict[int, set[int]] = {}
     rows_l, cols_l = [], []
     for row in range(4):
-        cols = {rng.randrange(n_shards * SHARD_WIDTH) for _ in range(300)}
+        cols = {rng.randrange(n_shards * SHARD_WIDTH)
+                for _ in range(rng.randrange(*cols_per_row))}
         bits[row] = cols
         rows_l += [row] * len(cols)
         cols_l += list(cols)
     f.import_bits(rows_l, cols_l)
-    v = idx.create_field("v", FieldOptions.int_field(-500, 1 << 18))
+    v = idx.create_field("v", FieldOptions.int_field(*val_range))
     vcols = sorted({rng.randrange(n_shards * SHARD_WIDTH)
-                    for _ in range(400)})
-    vals = {c: rng.randrange(-500, 1 << 18) for c in vcols}
+                    for _ in range(n_vals)})
+    vals = {c: rng.randrange(*val_range) for c in vcols}
     v.import_values(vcols, [vals[c] for c in vcols])
     return idx, bits, vals
 
@@ -260,6 +262,81 @@ class TestSingleProcessCollective:
             assert got_c == want == got_x, (q, got_c, got_x, want)
             checked += 1
         assert checked >= 60, f"only {checked} shapes exercised"
+
+    def test_fuzz_aggregates_and_conditions(self, tmp_path):
+        """Randomized aggregate surface: Sum/Min/Max with random filter
+        trees, BSI-condition counts with random ops/predicates, TopN
+        and GroupBy with random filters — collective vs executor vs
+        dict/set oracles."""
+        import contextlib
+
+        with contextlib.closing(Holder(str(tmp_path / "h"))) as h:
+            self._run_agg_fuzz(h)
+
+    def _run_agg_fuzz(self, h):
+        rng = random.Random(4040)
+        idx, bits, vals = _build(h, n_shards=3, seed=4040,
+                                 cols_per_row=(80, 300), n_vals=500,
+                                 val_range=(-3000, 90000))
+        cluster = Cluster(local_id="n0")
+        cluster.add_node(Node(id="n0", uri="local"))
+        ce = spmd.CollectiveExecutor(h, cluster, "i")
+        ex = Executor(h)
+        import operator as op
+
+        cmps = {"<": op.lt, "<=": op.le, ">": op.gt, ">=": op.ge,
+                "==": op.eq, "!=": op.ne}
+        for i in range(80):
+            kind = rng.randrange(5)
+            if kind == 0:  # BSI condition count
+                o = rng.choice(list(cmps))
+                p = rng.randrange(-4000, 95000)
+                q = f"Count(Row(v {o} {p}))"
+                want = sum(1 for x in vals.values() if cmps[o](x, p))
+                assert ce.execute(q) == want == ex.execute("i", q)[0], q
+            elif kind == 1:  # between
+                a = rng.randrange(-4000, 95000)
+                b = a + rng.randrange(0, 50000)
+                q = f"Count(Row(v >< [{a}, {b}]))"
+                want = sum(1 for x in vals.values() if a <= x <= b)
+                assert ce.execute(q) == want == ex.execute("i", q)[0], q
+            elif kind == 2:  # Sum with random row filter
+                r = rng.randrange(4)
+                q = f"Sum(Row(f={r}), field=v)"
+                sel = [x for c, x in vals.items() if c in bits[r]]
+                got = ce.execute(q)
+                assert (got.val, got.count) == (sum(sel), len(sel)), q
+                assert got == ex.execute("i", q)[0], q
+            elif kind == 3:  # Min/Max with random filter
+                name = rng.choice(["Min", "Max"])
+                r = rng.randrange(4)
+                q = f"{name}(Row(f={r}), field=v)"
+                got = ce.execute(q)
+                assert got == ex.execute("i", q)[0], q
+                sel = [x for c, x in vals.items() if c in bits[r]]
+                if sel:
+                    best = min(sel) if name == "Min" else max(sel)
+                    assert (got.val, got.count) == \
+                        (best, sel.count(best)), q
+            else:  # TopN / GroupBy with random filter
+                r = rng.randrange(4)
+                if rng.random() < 0.5:
+                    q = f"TopN(f, Row(f={r}), n=3)"
+                    got = ce.execute(q)
+                    want = sorted(((rid, len(c & bits[r]))
+                                   for rid, c in bits.items()),
+                                  key=lambda rc: (-rc[1], rc[0]))
+                    want = [(rid, c) for rid, c in want if c > 0][:3]
+                    assert [(p.id, p.count) for p in got] == want, q
+                else:
+                    q = f"GroupBy(Rows(f), filter=Row(f={r}))"
+                    got = ce.execute(q)
+                    want = {rid: len(c & bits[r])
+                            for rid, c in bits.items()
+                            if len(c & bits[r])}
+                    assert {g.group[0].row_id: g.count
+                            for g in got} == want, q
+                assert got == ex.execute("i", q)[0], q
 
     def test_rank_convention_checker(self, single):
         h, ce, ex, bits, vals = single
