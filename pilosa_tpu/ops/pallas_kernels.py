@@ -250,8 +250,13 @@ def _bsi_compare_kernel(planes_ref, filt_ref, pred_ref, out_lt_ref,
 @functools.partial(jax.jit, static_argnames=("depth", "interpret"))
 def _bsi_compare_pallas(planes, filt, pred_masks, depth: int,
                         interpret: bool = False):
-    P, W = planes.shape
-    planes = _pad_to(planes, 1, WORD_BLOCK)
+    W = planes.shape[1]
+    # pad the PLANE axis to the uint32 sublane tile (8): a block whose
+    # second-minor dim is the raw depth+2 (e.g. 19) risks a Mosaic
+    # lowering rejection; padded planes are zeros the kernel never
+    # indexes (it reads exactly [0], [1], [2..2+depth))
+    planes = _pad_to(_pad_to(planes, 1, WORD_BLOCK), 0, 8)
+    P = planes.shape[0]
     filt = _pad_to(filt.reshape(1, -1), 1, WORD_BLOCK)
     Wp = planes.shape[1]
     kernel = functools.partial(_bsi_compare_kernel, depth=depth)

@@ -278,6 +278,18 @@ class TestSingleProcessCollective:
         idx, bits, vals = _build(h, n_shards=3, seed=4040,
                                  cols_per_row=(80, 300), n_vals=500,
                                  val_range=(-3000, 90000))
+        # densify the row/value overlap: uniform draws over the column
+        # space make filtered aggregates almost always empty (the fuzz
+        # would rubber-stamp (0,0)==(0,0)); giving ~60% of each row's
+        # columns a BSI value makes every filter branch non-trivial
+        overlap = sorted({c for cols in bits.values()
+                          for c in rng.sample(sorted(cols),
+                                              int(len(cols) * 0.6))})
+        v = idx.field("v")
+        new_vals = {c: rng.randrange(-3000, 90000) for c in overlap}
+        v.import_values(list(new_vals), list(new_vals.values()))
+        vals.update(new_vals)
+        assert any(vals.keys() & cols for cols in bits.values())
         cluster = Cluster(local_id="n0")
         cluster.add_node(Node(id="n0", uri="local"))
         ce = spmd.CollectiveExecutor(h, cluster, "i")
