@@ -527,6 +527,7 @@ for q, http in zip(queries[:5], http_res):
 # PRODUCT path: a plain HTTP query on the coordinator transparently
 # upgrades to a collective — the peer joins via the broadcast bus while
 # idling in a pure file-poll loop (no device work, no deadlock)
+joined_before = spmd.counters()["collective_joined"]  # pre-barrier snapshot
 open(f"{data}/product.{pid}", "w").write("1")
 deadline = time.monotonic() + 120
 while not all(os.path.exists(f"{data}/product.{p}") for p in range(NPROC)):
@@ -534,18 +535,70 @@ while not all(os.path.exists(f"{data}/product.{p}") for p in range(NPROC)):
         raise SystemExit("product barrier timeout")
     time.sleep(0.05)
 if pid == 0:
+    # a loaded box can time out one prepare round (legal fallback, the
+    # result is exact either way) — require that SOME attempt runs
+    # collectively, every attempt stays exact
     before = spmd.counters()["collective_initiated"]
+    for attempt in range(5):
+        got = c.post_json(srv.uri + "/index/i/query",
+                          {"query": queries[1]})["results"][0]
+        assert got == oracle[queries[1]], got
+        if spmd.counters()["collective_initiated"] > before:
+            break
+    assert spmd.counters()["collective_initiated"] > before, \
+        "no HTTP query ran collectively in 5 attempts"
+    assert spmd.counters()["collective_joined"] == 0  # only peers join
+    open(f"{data}/product_done.ok", "w").write("1")
+else:
+    # wait on the coordinator's explicit signal, NOT the joined
+    # counter: the xcheck phase's coordinator HTTP queries already ran
+    # bus collectives, so the counter is non-zero before this phase —
+    # waiting on it let peers race ahead into the refusal drill and
+    # poison the coordinator's product attempts (learned from a flake)
+    deadline = time.monotonic() + 240
+    while not os.path.exists(f"{data}/product_done.ok"):
+        if time.monotonic() > deadline:
+            raise SystemExit("coordinator product phase timeout")
+        time.sleep(0.05)
+    # strictly-greater vs the pre-phase snapshot: this phase's
+    # collective must have joined THIS peer (poll: the peer's bump can
+    # lag the coordinator's return by a bus response)
+    deadline = time.monotonic() + 60
+    while spmd.counters()["collective_joined"] <= joined_before:
+        if time.monotonic() > deadline:
+            raise SystemExit("peer never joined the product collective")
+        time.sleep(0.05)
+
+# refusal drill: a peer that declines the collective plane (prepare
+# returns not-ok) must degrade the coordinator to the scatter-gather
+# plane with exact results — the all-or-hang property is handled BEFORE
+# anyone enters a device collective
+orig_avail = spmd.collective_available
+if pid == 1:
+    spmd.collective_available = lambda: False  # this peer refuses
+# patch BEFORE signaling: the coordinator queries the moment the
+# barrier opens, and an unpatched peer would let the collective win
+open(f"{data}/refuse.{pid}", "w").write("1")
+deadline = time.monotonic() + 120
+while not all(os.path.exists(f"{data}/refuse.{p}") for p in range(NPROC)):
+    if time.monotonic() > deadline:
+        raise SystemExit("refuse barrier timeout")
+    time.sleep(0.05)
+if pid == 0:
+    fb0 = spmd.counters()["collective_fallbacks"]
     got = c.post_json(srv.uri + "/index/i/query",
                       {"query": queries[1]})["results"][0]
     assert got == oracle[queries[1]], got
-    assert spmd.counters()["collective_initiated"] == before + 1, \
-        "HTTP query did not run collectively"
+    assert spmd.counters()["collective_fallbacks"] == fb0 + 1, \
+        "refusal did not route through the fallback path"
+    open(f"{data}/refused.ok", "w").write("1")
 else:
     deadline = time.monotonic() + 120
-    while spmd.counters()["collective_joined"] < 1:
+    while not os.path.exists(f"{data}/refused.ok"):
         if time.monotonic() > deadline:
-            raise SystemExit("peer never joined the HTTP collective")
+            raise SystemExit("refusal drill timeout")
         time.sleep(0.05)
+spmd.collective_available = orig_avail
 
 # exit barrier on the control plane too: a process must not close its
 # server while the peer's last collective still needs both sides
