@@ -493,35 +493,69 @@ def collective_available() -> bool:
     return jax.process_count() > 1
 
 
-def _check_collective(node, index_name: str, pql: str) -> str | None:
-    """Shared pre-flight validation (no locks, no device work): the
-    reason this process can NOT run the query collectively, or None.
-    Run on the coordinator before initiating AND on every peer during
-    the prepare round — a collective must only start once every
-    participant has proven it will enter the same program."""
+def _has_sentinel(call) -> bool:
+    """True when translation produced an internal sentinel call
+    (_Empty/_EmptyRows/_Noop) anywhere in the tree — those have no PQL
+    spelling, so the query cannot ship to peers as text."""
+    if call.name.startswith("_"):
+        return True
+    filt = call.args.get("filter")
+    from pilosa_tpu.pql import Call as _Call
+
+    if isinstance(filt, _Call) and _has_sentinel(filt):
+        return True
+    return any(_has_sentinel(c) for c in call.children)
+
+
+def _check_collective(node, index_name: str, pql: str,
+                      translate: bool = False):
+    """Shared pre-flight validation (no locks, no device work).
+    Returns ``(reason, translated_pql, translated_call)``: reason is
+    the string explaining why this process can NOT run the query
+    collectively (None = it can).  With ``translate=True`` (the
+    coordinator) string keys rewrite to ids ONCE at the origin —
+    exactly the reference's origin-only translation (executor.go:146)
+    — and the translated text is what ships to peers, so the prepare
+    round and every participant evaluate an id-only program."""
     if not collective_available():
-        return "not a multi-process runtime"
+        return "not a multi-process runtime", None, None
     idx = node.holder.index(index_name)
     if idx is None:
-        return f"unknown index {index_name!r}"
-    if idx.options.keys:
-        return "keyed index (translation happens on the scatter path)"
+        return f"unknown index {index_name!r}", None, None
     from pilosa_tpu.pql import parse
 
     try:
         calls = parse(pql).calls
     except Exception as e:  # noqa: BLE001
-        return f"parse error: {e!r}"
+        return f"parse error: {e!r}", None, None
     if len(calls) != 1:
-        return "multi-call query"
+        return "multi-call query", None, None
+    call = calls[0]
+    if call.name not in ("Count", "Sum", "Min", "Max", "TopN", "GroupBy"):
+        # cheap refusal BEFORE any translation: writes and other
+        # non-collective calls must not pay a cloned translate (with
+        # create=True key allocation for Set) that the scatter path
+        # immediately repeats
+        return f"unsupported call {call.name}", None, None
+    if translate:
+        try:
+            call = node.executor._translate_call(idx, call)
+        except Exception as e:  # noqa: BLE001 — scatter path owns the error
+            return f"translation failed: {e!r}", None, None
+        if _has_sentinel(call):
+            # a missing key translated to an _Empty/_Noop sentinel,
+            # which has no PQL spelling to ship to peers — the scatter
+            # path handles sentinels natively
+            return "missing-key sentinel in translated query", None, None
+        pql = str(call)
     ce = CollectiveExecutor(node.holder, node.cluster, index_name)
-    if not ce.supported(calls[0]):
-        return f"unsupported call {calls[0].name}"
+    if not ce.supported(call):
+        return f"unsupported call {call.name}", None, None
     try:
         verify_rank_convention(node.cluster)
     except CollectiveError as e:
-        return str(e)
-    return None
+        return str(e), None, None
+    return None, pql, call
 
 
 def try_collective(node, index_name: str, pql: str):
@@ -554,7 +588,9 @@ def try_collective(node, index_name: str, pql: str):
         return None
     if not cluster.is_coordinator or cluster.state != STATE_NORMAL:
         return None
-    if _check_collective(node, index_name, pql) is not None:
+    reason, pql, tcall = _check_collective(node, index_name, pql,
+                                           translate=True)
+    if reason is not None:
         return None
     with _collective_lock:
         peers = [n for n in cluster.sorted_nodes()
@@ -606,14 +642,28 @@ def try_collective(node, index_name: str, pql: str):
             return None
         for t in threads:
             t.join(timeout=60)
+        # ids -> keys in the result, at the origin only (the reference's
+        # translateResults, executor.go:2781).  Guarded: a concurrent
+        # index delete or a transient read-through translate failure
+        # must fall back, never 500 an answerable query.
+        try:
+            idx = node.holder.index(index_name)
+            result = node.executor._translate_result(idx, tcall, result)
+        except Exception as e:  # noqa: BLE001
+            _bump("collective_fallbacks")
+            node.executor.logger.printf(
+                "collective result translation failed (%r); falling "
+                "back to scatter-gather", e)
+            return None
         _bump("collective_initiated")
         return [result]
 
 
 def prepare_collective(node, index_name: str, pql: str) -> dict:
     """Peer-side prepare: validate without entering (no lock, no device
-    work) and promise to join."""
-    reason = _check_collective(node, index_name, pql)
+    work) and promise to join.  The query text arrives PRE-TRANSLATED
+    by the coordinator (origin-only translation)."""
+    reason, _, _ = _check_collective(node, index_name, pql)
     if reason is not None:
         return {"ok": False, "error": reason}
     return {"ok": True}
@@ -623,7 +673,7 @@ def join_collective(node, index_name: str, pql: str) -> None:
     """Peer-side entry: re-validate (state may have moved since the
     promise), then run the same collective program; the replicated
     result is discarded (the coordinator answers the client)."""
-    reason = _check_collective(node, index_name, pql)
+    reason, _, _ = _check_collective(node, index_name, pql)
     if reason is not None:
         raise CollectiveError(reason)
     with _collective_lock:
@@ -706,8 +756,11 @@ class CollectiveExecutor:
         return False
 
     def _plain_field(self, name: str) -> bool:
-        f = self.idx.field(name)
-        return f is not None and not f.options.keys
+        # keyed fields are fine HERE: the coordinator translates keys
+        # to ids before any collective text ships (try_collective), so
+        # every arg this evaluator sees is id-space; _translate_result
+        # re-keys the answer at the origin
+        return self.idx.field(name) is not None
 
     def _tree_ok(self, call) -> bool:
         if call.name == "Row":

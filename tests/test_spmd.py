@@ -202,12 +202,16 @@ class TestSingleProcessCollective:
             with pytest.raises(spmd.CollectiveError):
                 ce.execute(pql)
 
-    def test_keyed_fields_refused(self, single):
+    def test_untranslated_key_args_refused(self, single):
+        """The evaluator is id-space only: STRING row args (keys that
+        never went through the coordinator's translation) are refused —
+        the translated forms are covered by the keyed-query test."""
         h, ce, ex, bits, vals = single
         h.index("i").create_field(
             "kf", FieldOptions.set_field(keys=True))
-        for pql in ('Count(Row(kf="alice"))', "TopN(kf)",
-                    'Count(Intersect(Row(f=0), Row(kf="x")))'):
+        for pql in ('Count(Row(kf="alice"))',
+                    'Count(Intersect(Row(f=0), Row(kf="x")))',
+                    'Count(Row(f="stringy"))'):
             with pytest.raises(spmd.CollectiveError):
                 ce.execute(pql)
 
@@ -349,6 +353,44 @@ class TestSingleProcessCollective:
                     assert {g.group[0].row_id: g.count
                             for g in got} == want, q
                 assert got == ex.execute("i", q)[0], q
+
+    def test_keyed_queries_translate_then_run_collectively(
+            self, tmp_path, monkeypatch):
+        """try_collective translates string keys to ids ONCE at the
+        origin (executor.go:146 semantics), ships id-only text, and
+        re-keys the result; missing keys produce sentinel trees that
+        fall back to the scatter path."""
+        from pilosa_tpu.parallel.node import ClusterNode
+
+        h = Holder(str(tmp_path / "h"))
+        cluster = Cluster(local_id="n0")
+        cluster.add_node(Node(id="n0", uri="local"))
+        cluster.coordinator_id = "n0"
+        cluster.set_state("NORMAL")
+        node = ClusterNode(h, cluster)
+        idx = h.create_index("i")
+        idx.create_field("kf", FieldOptions.set_field(keys=True))
+        for col, key in [(1, "alice"), (2, "alice"), (3, "bob"),
+                         (2, "bob"), (9, "carol")]:
+            node.executor.execute("i", f'Set({col}, kf="{key}")')
+
+        monkeypatch.setattr(spmd, "collective_available", lambda: True)
+        try:
+            res = spmd.try_collective(node, "i",
+                                      'Count(Row(kf="alice"))')
+            assert res == [2], res
+            assert spmd.try_collective(node, "i", 'TopN(kf)') is not None
+            pairs = spmd.try_collective(node, "i", "TopN(kf)")[0]
+            assert [(p.key, p.count) for p in pairs] == \
+                [("alice", 2), ("bob", 2), ("carol", 1)]
+            # missing key -> sentinel tree -> scatter path (None)
+            assert spmd.try_collective(
+                node, "i", 'Count(Row(kf="ghost"))') is None
+            # and the scatter path answers it with the proper semantics
+            assert node.executor.execute(
+                "i", 'Count(Row(kf="ghost"))')[0] == 0
+        finally:
+            h.close()
 
     def test_rank_convention_checker(self, single):
         h, ce, ex, bits, vals = single
