@@ -615,6 +615,40 @@ else:
 # returns not-ok) must degrade the coordinator to the scatter-gather
 # plane with exact results — the all-or-hang property is handled BEFORE
 # anyone enters a device collective
+# dynamic phase: interleaved writes and collective reads — every write
+# replicates synchronously over the control plane, and the next
+# collective must see it (operands build fresh from fragments; no
+# cross-query caching to go stale).  Peers serve the bus passively.
+open(f"{data}/dynamic.{pid}", "w").write("1")
+deadline = time.monotonic() + 120
+while not all(os.path.exists(f"{data}/dynamic.{p}") for p in range(NPROC)):
+    if time.monotonic() > deadline:
+        raise SystemExit("dynamic barrier timeout")
+    time.sleep(0.05)
+if pid == 0:
+    drng = random.Random(7171)
+    for it in range(12):
+        row = drng.randrange(3)
+        col = drng.randrange(N_SHARDS * SHARD_WIDTH)
+        if drng.random() < 0.75:
+            c.post_json(srv.uri + "/index/i/query",
+                        {"query": f"Set({col}, f={row})"})
+            bits[row].add(col)
+        else:
+            c.post_json(srv.uri + "/index/i/query",
+                        {"query": f"Clear({col}, f={row})"})
+            bits[row].discard(col)
+        got = c.post_json(srv.uri + "/index/i/query",
+                          {"query": f"Count(Row(f={row}))"})["results"][0]
+        assert got == len(bits[row]), (it, got, len(bits[row]))
+    open(f"{data}/dynamic_done.ok", "w").write("1")
+else:
+    deadline = time.monotonic() + 240
+    while not os.path.exists(f"{data}/dynamic_done.ok"):
+        if time.monotonic() > deadline:
+            raise SystemExit("dynamic phase timeout")
+        time.sleep(0.05)
+
 orig_avail = spmd.collective_available
 if pid == 1:
     spmd.collective_available = lambda: False  # this peer refuses
