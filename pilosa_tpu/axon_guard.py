@@ -7,6 +7,16 @@ registered the plugin at interpreter start).  `jax.config` wins any
 time before backend init, so entry points that must always complete
 (bench.py, __graft_entry__, benchmarks/measure.py) call
 ``guard_dead_relay()`` before touching devices.
+
+A live relay PROCESS is not a live TUNNEL: the relay is a dumb stdio
+multiplexer whose far end (the orchestrator owning the chip) can stop
+responding while the local process sits healthy (observed round 3:
+devices enumerated, then the first compile hung >28 min).  So when the
+process is up, the guard additionally runs a tiny end-to-end jax probe
+in a KILLABLE subprocess with a deadline — a hang costs one timeout,
+not the whole run — and falls back to CPU when the probe dies.  Probe
+successes are cached on disk for a few minutes so bench.py +
+measure.py back-to-back pay for one probe, not two.
 """
 
 from __future__ import annotations
@@ -14,6 +24,27 @@ from __future__ import annotations
 import os
 import subprocess
 import sys
+import time
+
+# End-to-end probe: allow a full cold compile through the relay
+# (~30-60 s healthy) plus margin.
+_PROBE_TIMEOUT_S = float(os.environ.get(
+    "PILOSA_TPU_AXON_PROBE_TIMEOUT_S", "240"))
+_PROBE_TTL_S = 600.0
+_PROBE_STAMP = "/tmp/pilosa_axon_probe_ok"
+
+# The relay tunnel is single-client: while the relay watcher is
+# mid-capture (tools/relay_watcher.capturing exists) a second jax
+# process would stall behind it and misread the stall as a dead tunnel.
+# A full capture can legitimately hold the tunnel for hours (validate
+# 1800 s + bench 1800 s + measure 5400 s budgets), so after the bounded
+# wait the guard falls back WITHOUT probing — "busy with capture" is
+# not "dead", and the watcher is already producing the chip artifacts.
+_CAPTURE_WAIT_S = float(os.environ.get(
+    "PILOSA_TPU_AXON_CAPTURE_WAIT_S", "2700"))
+_CAPTURING_FLAG = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "relay_watcher.capturing")
 
 
 def _relay_alive() -> bool:
@@ -27,19 +58,68 @@ def _relay_alive() -> bool:
         return False
 
 
+def tunnel_responsive(timeout_s: float = _PROBE_TIMEOUT_S,
+                      use_cache: bool = True) -> bool:
+    """True when a trivial jax computation completes through the relay
+    within ``timeout_s``.  Runs in a subprocess so a wedged tunnel only
+    costs the deadline.  Callers must already know the relay process is
+    up (a dead process would make the subprocess hang the full
+    deadline for a foregone conclusion)."""
+    if use_cache:
+        try:
+            if time.time() - os.path.getmtime(_PROBE_STAMP) < _PROBE_TTL_S:
+                return True
+        except OSError:
+            pass
+    code = ("import jax, jax.numpy as jnp\n"
+            "print('probe', int(jnp.arange(9, dtype=jnp.uint32).sum()))\n")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+        ok = out.returncode == 0 and "probe 36" in out.stdout
+    except Exception:
+        ok = False
+    if ok:
+        try:
+            with open(_PROBE_STAMP, "w") as f:
+                f.write(str(time.time()))
+        except OSError:
+            pass
+    return ok
+
+
+def _wait_out_capture() -> bool:
+    """Block (bounded) while the relay watcher holds the tunnel.
+    Returns True when the tunnel is free to probe; False when the
+    capture still holds it at the deadline (probing a busy
+    single-client tunnel would misdiagnose it as dead)."""
+    if os.environ.get("PILOSA_TPU_AXON_CAPTURING"):
+        return True  # we ARE the capture — never wait on our own flag
+    deadline = time.monotonic() + _CAPTURE_WAIT_S
+    announced = False
+    while os.path.exists(_CAPTURING_FLAG) and time.monotonic() < deadline:
+        if not announced:
+            print("axon_guard: relay watcher capture in progress; "
+                  "waiting for the tunnel ...", file=sys.stderr)
+            announced = True
+        time.sleep(10.0)
+    return not os.path.exists(_CAPTURING_FLAG)
+
+
 def guard_dead_relay(wait_s: float = 0.0) -> bool:
     """When this process targets the axon backend but the relay is
-    gone, pin jax to CPU (announced on stderr) so the run completes
-    instead of hanging.  Returns True when the fallback engaged.  Does
-    nothing unless JAX_PLATFORMS is EXPLICITLY "axon" — on ordinary
-    TPU/GPU hosts the guard must never hide the real accelerator.
+    gone — process dead OR tunnel unresponsive end-to-end — pin jax to
+    CPU (announced on stderr) so the run completes instead of hanging.
+    Returns True when the fallback engaged.  Does nothing unless
+    JAX_PLATFORMS is EXPLICITLY "axon" — on ordinary TPU/GPU hosts the
+    guard must never hide the real accelerator.
 
-    ``wait_s`` > 0 polls for the relay to (re)appear before giving up —
-    benchmark entry points use this so a briefly-restarting relay still
-    yields a chip number instead of a CPU fallback."""
+    ``wait_s`` > 0 polls for the relay process to (re)appear before
+    giving up — benchmark entry points use this so a briefly-restarting
+    relay still yields a chip number instead of a CPU fallback."""
     if os.environ.get("JAX_PLATFORMS") != "axon":
         return False
-    import time
 
     deadline = time.monotonic() + wait_s
     alive = _relay_alive()
@@ -50,10 +130,25 @@ def guard_dead_relay(wait_s: float = 0.0) -> bool:
         time.sleep(min(5.0, max(remaining, 0.1)))
         alive = _relay_alive()
     if alive:
-        return False
-    print("axon_guard: axon relay is not running; falling back to the "
-          "CPU backend (results are exact, timings are NOT chip "
-          "numbers)", file=sys.stderr)
+        if not _wait_out_capture():
+            print("axon_guard: relay watcher capture still holds the "
+                  f"single-client tunnel after {_CAPTURE_WAIT_S:.0f}s "
+                  "(chip artifacts are being produced by the watcher); "
+                  "falling back to the CPU backend for this run "
+                  "(results are exact, timings are NOT chip numbers)",
+                  file=sys.stderr)
+        elif tunnel_responsive():
+            return False
+        else:
+            print("axon_guard: relay process is up but the tunnel is "
+                  "unresponsive end-to-end (probe exceeded "
+                  f"{_PROBE_TIMEOUT_S:.0f}s); falling back to the CPU "
+                  "backend (results are exact, timings are NOT chip "
+                  "numbers)", file=sys.stderr)
+    else:
+        print("axon_guard: axon relay is not running; falling back to the "
+              "CPU backend (results are exact, timings are NOT chip "
+              "numbers)", file=sys.stderr)
     import jax
 
     jax.config.update("jax_platforms", "cpu")

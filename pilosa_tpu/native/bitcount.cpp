@@ -10,12 +10,24 @@
 // counting loop a CPU should run, where XLA:CPU's generic codegen loses
 // to vectorized popcount by ~8x at bench shapes.
 //
+// Large inputs fan out over std::thread (the analog of the reference's
+// per-shard worker pool, executor.go:2561, collapsed to one kernel):
+// the ctypes caller has already released the GIL, so the threads own
+// the cores.  Auto mode (pt_set_threads(0), the default) uses
+// hardware_concurrency capped so each thread gets >= 4 MiB of operand —
+// below that, spawn cost and memory-bandwidth saturation make threading
+// a wash and the loops stay serial.  An explicit pt_set_threads(n>0)
+// is honored exactly (tests force threading on tiny inputs with it).
+//
 // Buffers arrive as raw bytes from numpy uint32 arrays (C-contiguous,
 // little-endian), processed as uint64 lanes with a uint32 tail — the
 // same reinterpret-cast equivalence the file codec relies on
 // (storage/roaring.py layout note).
 
+#include <algorithm>
 #include <cstdint>
+#include <thread>
+#include <vector>
 
 namespace {
 
@@ -29,12 +41,63 @@ inline uint64_t load64(const uint32_t* p) {
     return v;
 }
 
-}  // namespace
+int g_threads = 0;  // 0 = auto; >0 = exact count (1 = always serial)
 
-extern "C" {
+// 4 MiB of uint32 operand per extra thread before auto mode fans out.
+constexpr long long kMinWordsPerThread = 1LL << 20;
 
-// Popcount of one buffer of n32 uint32 words.
-long long pt_count(const uint32_t* a, long long n32) {
+// Thread count for a kernel touching `words` uint32s of operand total.
+int effective_threads(long long words) {
+    if (g_threads > 0) return g_threads;
+    int t = (int)std::thread::hardware_concurrency();
+    if (t < 2) return 1;
+    long long cap = words / kMinWordsPerThread;
+    if (cap < (long long)t) t = (int)(cap < 1 ? 1 : cap);
+    return t;
+}
+
+// Split `total` items into contiguous chunks, each a multiple of
+// `align` items (except the final chunk, which absorbs the tail).
+std::vector<std::pair<long long, long long>> make_chunks(long long total,
+                                                         long long align,
+                                                         int t) {
+    long long chunk = ((total / t) / align) * align;
+    if (chunk < align) chunk = align;
+    std::vector<std::pair<long long, long long>> chunks;
+    for (long long lo = 0; lo < total; lo += chunk) {
+        long long hi = std::min(total, lo + chunk);
+        if (hi + chunk > total) hi = total;  // fold the tail into the last
+        chunks.emplace_back(lo, hi);
+        if (hi == total) break;
+    }
+    return chunks;
+}
+
+template <class F>
+void run_chunks(const std::vector<std::pair<long long, long long>>& chunks,
+                F fn) {
+    std::vector<std::thread> ths;
+    ths.reserve(chunks.size());
+    for (size_t i = 0; i < chunks.size(); i++)
+        ths.emplace_back(
+            [&chunks, &fn, i] { fn(chunks[i].first, chunks[i].second, (int)i); });
+    for (auto& th : ths) th.join();
+}
+
+// Run fn(lo, hi, slot) over `total` items; serial fast path when one
+// thread suffices for `total * work_per_item` uint32s of operand.
+template <class F>
+void parallel_chunks(long long total, long long align, long long work_per_item,
+                     F fn) {
+    int t = effective_threads(total * work_per_item);
+    if (t <= 1 || total < 2) {
+        fn(0, total, 0);
+        return;
+    }
+    run_chunks(make_chunks(total, align, t), fn);
+}
+
+long long count_serial(const uint32_t* a, long long n32) {
     long long n64 = n32 / 2, t = 0;
     for (long long i = 0; i < n64; i++)
         t += __builtin_popcountll(load64(a + 2 * i));
@@ -42,8 +105,8 @@ long long pt_count(const uint32_t* a, long long n32) {
     return t;
 }
 
-// |a & b| fused: the north-star IntersectionCount.
-long long pt_count_and(const uint32_t* a, const uint32_t* b, long long n32) {
+long long count_and_serial(const uint32_t* a, const uint32_t* b,
+                           long long n32) {
     long long n64 = n32 / 2, t = 0;
     for (long long i = 0; i < n64; i++)
         t += __builtin_popcountll(load64(a + 2 * i) & load64(b + 2 * i));
@@ -51,11 +114,56 @@ long long pt_count_and(const uint32_t* a, const uint32_t* b, long long n32) {
     return t;
 }
 
+// Scatter-reduce over word-range chunks: each thread counts its slice
+// into a private slot (no false sharing at this granularity — one write
+// per thread), summed after the join.  align=2 keeps every non-tail
+// chunk on a uint64 lane boundary.
+template <class Body>
+long long chunked_count(long long n32, Body body) {
+    int t = effective_threads(n32);
+    if (t <= 1 || n32 < 2) return body(0, n32);
+    auto chunks = make_chunks(n32, /*align=*/2, t);
+    std::vector<long long> part(chunks.size(), 0);
+    run_chunks(chunks, [&](long long lo, long long hi, int slot) {
+        part[slot] = body(lo, hi);
+    });
+    long long total = 0;
+    for (long long v : part) total += v;
+    return total;
+}
+
+}  // namespace
+
+extern "C" {
+
+// 0 = auto (hardware_concurrency, >=4 MiB/thread); n>0 = exactly n.
+void pt_set_threads(int n) { g_threads = n < 0 ? 0 : n; }
+
+// The thread count a kernel touching `words` uint32s would use —
+// exported so tests can pin the auto-mode cap arithmetic on any box.
+int pt_effective_threads(long long words) { return effective_threads(words); }
+
+// Popcount of one buffer of n32 uint32 words.
+long long pt_count(const uint32_t* a, long long n32) {
+    return chunked_count(n32, [a](long long lo, long long hi) {
+        return count_serial(a + lo, hi - lo);
+    });
+}
+
+// |a & b| fused: the north-star IntersectionCount.
+long long pt_count_and(const uint32_t* a, const uint32_t* b, long long n32) {
+    return chunked_count(n32, [a, b](long long lo, long long hi) {
+        return count_and_serial(a + lo, b + lo, hi - lo);
+    });
+}
+
 // out[r] = popcount(mat[r]) over a [rows, n32] matrix.
 void pt_row_counts(const uint32_t* mat, long long rows, long long n32,
                    int32_t* out) {
-    for (long long r = 0; r < rows; r++)
-        out[r] = (int32_t)pt_count(mat + r * n32, n32);
+    parallel_chunks(rows, 1, n32, [=](long long lo, long long hi, int) {
+        for (long long r = lo; r < hi; r++)
+            out[r] = (int32_t)count_serial(mat + r * n32, n32);
+    });
 }
 
 // out[r] = |a[r] & b[r]| — pairwise per-row intersection counts with no
@@ -63,34 +171,46 @@ void pt_row_counts(const uint32_t* mat, long long rows, long long n32,
 // stacked shard operands).
 void pt_row_counts_and(const uint32_t* a, const uint32_t* b,
                        long long rows, long long n32, int32_t* out) {
-    for (long long r = 0; r < rows; r++)
-        out[r] = (int32_t)pt_count_and(a + r * n32, b + r * n32, n32);
+    parallel_chunks(rows, 1, n32, [=](long long lo, long long hi, int) {
+        for (long long r = lo; r < hi; r++)
+            out[r] = (int32_t)count_and_serial(a + r * n32, b + r * n32, n32);
+    });
 }
 
 // out[r] = |mat[r] & filt| (TopN/GroupBy inner loop).
 void pt_row_counts_masked(const uint32_t* mat, const uint32_t* filt,
                           long long rows, long long n32, int32_t* out) {
-    for (long long r = 0; r < rows; r++)
-        out[r] = (int32_t)pt_count_and(mat + r * n32, filt, n32);
+    parallel_chunks(rows, 1, n32, [=](long long lo, long long hi, int) {
+        for (long long r = lo; r < hi; r++)
+            out[r] = (int32_t)count_and_serial(mat + r * n32, filt, n32);
+    });
 }
 
 // out[r] = |mat[r] & filt_stack[pos[r]]| (fused cross-shard TopN scan).
 void pt_row_counts_gathered(const uint32_t* mat, const uint32_t* filt_stack,
                             const int32_t* pos, long long rows, long long n32,
                             int32_t* out) {
-    for (long long r = 0; r < rows; r++)
-        out[r] = (int32_t)pt_count_and(mat + r * n32,
-                                       filt_stack + (long long)pos[r] * n32,
-                                       n32);
+    parallel_chunks(rows, 1, n32, [=](long long lo, long long hi, int) {
+        for (long long r = lo; r < hi; r++)
+            out[r] = (int32_t)count_and_serial(
+                mat + r * n32, filt_stack + (long long)pos[r] * n32, n32);
+    });
 }
 
 // out[g*rows + r] = |mat[r] & masks[g]| (GroupBy cartesian product).
+// Parallel over rows (not groups): every thread streams the same
+// mat rows for all masks, so the split stays balanced when groups
+// is small and rows is large (the common GroupBy shape).
 void pt_masked_matrix_counts(const uint32_t* mat, const uint32_t* masks,
                              long long groups, long long rows, long long n32,
                              int32_t* out) {
-    for (long long g = 0; g < groups; g++)
-        pt_row_counts_masked(mat, masks + g * n32, rows, n32,
-                             out + g * rows);
+    parallel_chunks(rows, 1, groups * n32,
+                    [=](long long lo, long long hi, int) {
+                        for (long long g = 0; g < groups; g++)
+                            for (long long r = lo; r < hi; r++)
+                                out[g * rows + r] = (int32_t)count_and_serial(
+                                    mat + r * n32, masks + g * n32, n32);
+                    });
 }
 
 }  // extern "C"

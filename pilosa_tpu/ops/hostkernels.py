@@ -47,6 +47,10 @@ def _isa_tag() -> str:
 
 def _setup(lib) -> None:
     LL, VP, IP = ctypes.c_longlong, ctypes.c_void_p, ctypes.c_void_p
+    lib.pt_set_threads.restype = None
+    lib.pt_set_threads.argtypes = [ctypes.c_int]
+    lib.pt_effective_threads.restype = ctypes.c_int
+    lib.pt_effective_threads.argtypes = [LL]
     lib.pt_count.restype = LL
     lib.pt_count.argtypes = [VP, LL]
     lib.pt_count_and.restype = LL
@@ -61,6 +65,11 @@ def _setup(lib) -> None:
     lib.pt_row_counts_gathered.argtypes = [VP, VP, IP, LL, LL, IP]
     lib.pt_masked_matrix_counts.restype = None
     lib.pt_masked_matrix_counts.argtypes = [VP, VP, LL, LL, LL, IP]
+    # 0 (default) = auto: hardware_concurrency capped at >=4 MiB of
+    # operand per thread; ctypes releases the GIL for the call, so the
+    # kernel threads own the cores (the reference's per-shard worker
+    # pool, executor.go:2561, collapsed into the kernel).
+    lib.pt_set_threads(int(os.environ.get("PILOSA_TPU_HOST_THREADS", "0")))
 
 
 _NATIVE = NativeLib(
@@ -70,8 +79,28 @@ _NATIVE = NativeLib(
     setup=_setup,
     # -march=native: built lazily on the host that runs it; the ISA tag
     # in the filename forces a rebuild on any other CPU
-    extra_flags=("-march=native", "-funroll-loops"),
+    extra_flags=("-march=native", "-funroll-loops", "-pthread"),
 )
+
+
+def set_threads(n: int) -> bool:
+    """Override the kernel thread count (0 = auto).  Returns False when
+    the native library is unavailable (numpy fallback is serial)."""
+    lib = _NATIVE.load()
+    if lib is None:
+        return False
+    lib.pt_set_threads(int(n))
+    return True
+
+
+def effective_threads(words: int) -> int:
+    """Thread count a kernel touching `words` uint32s would use under
+    the current setting (test/diagnostic hook; 1 when the native
+    library is unavailable — the numpy fallback is serial)."""
+    lib = _NATIVE.load()
+    if lib is None:
+        return 1
+    return int(lib.pt_effective_threads(int(words)))
 
 
 def native_available() -> bool:

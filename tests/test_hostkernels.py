@@ -128,6 +128,86 @@ def test_host_mode_gate():
     assert not bm.host_mode()
 
 
+# ---------------------------------------------------------------- threading
+
+
+@pytest.fixture
+def forced_threads():
+    """Force the native kernels onto 4 threads for the test body (the
+    CI box may have one core; pt_set_threads(n>0) is honored exactly,
+    so the chunk/tail split logic runs regardless)."""
+    if not hk.set_threads(4):
+        pytest.skip("native library unavailable")
+    yield
+    hk.set_threads(0)
+
+
+# shapes straddle the chunk boundaries: fewer items than threads, odd
+# uint32 tails, non-divisible row counts, rows smaller than threads
+@pytest.mark.parametrize("words", [1, 3, 7, 8, 9, 129, 1 << 13])
+def test_threaded_count_kernels_match_oracle(forced_threads, words):
+    a, b = rand(words), rand(words)
+    assert hk.count(a) == int(np.bitwise_count(a).sum())
+    assert hk.count_and(a, b) == int(np.bitwise_count(a & b).sum())
+
+
+@pytest.mark.parametrize("rows,words", [(1, 129), (3, 65), (5, 64),
+                                        (17, 33), (64, 127)])
+def test_threaded_row_kernels_match_oracle(forced_threads, rows, words):
+    mat, filt = rand(rows, words), rand(words)
+    assert np.array_equal(hk.row_counts(mat),
+                          np.bitwise_count(mat).sum(axis=-1))
+    assert np.array_equal(hk.row_counts_masked(mat, filt),
+                          np.bitwise_count(mat & filt).sum(axis=-1))
+    b = rand(rows, words)
+    assert np.array_equal(hk.row_counts_and(mat, b),
+                          np.bitwise_count(mat & b).sum(axis=-1))
+    stack = rand(4, words)
+    pos = RNG.integers(0, 4, size=rows).astype(np.int32)
+    assert np.array_equal(hk.row_counts_gathered(mat, stack, pos),
+                          np.bitwise_count(mat & stack[pos]).sum(axis=-1))
+    masks = rand(3, words)
+    assert np.array_equal(
+        hk.masked_matrix_counts(mat, masks),
+        np.bitwise_count(mat[None] & masks[:, None]).sum(axis=-1))
+
+
+def test_threaded_large_operand_fuzz(forced_threads):
+    # 20 random small-shape trials (chunk/tail edge cases) plus one
+    # operand big enough (8 MiB) that auto mode would also thread on a
+    # multicore box, across both count entry points
+    for _ in range(20):
+        n = int(RNG.integers(1, 1 << 16))
+        a, b = rand(n), rand(n)
+        assert hk.count(a) == int(np.bitwise_count(a).sum())
+        assert hk.count_and(a, b) == int(np.bitwise_count(a & b).sum())
+    n = (1 << 21) + 3
+    a, b = rand(n), rand(n)
+    assert hk.count(a) == int(np.bitwise_count(a).sum())
+    assert hk.count_and(a, b) == int(np.bitwise_count(a & b).sum())
+
+
+def test_effective_threads_cap_arithmetic():
+    if not hk.native_available():
+        pytest.skip("native library unavailable")
+    min_words = 1 << 20  # kMinWordsPerThread in bitcount.cpp
+    try:
+        # explicit setting is honored exactly, any size
+        hk.set_threads(5)
+        assert hk.effective_threads(16) == 5
+        assert hk.effective_threads(64 * min_words) == 5
+        # auto mode: below 2x the per-thread floor stays serial ...
+        hk.set_threads(0)
+        assert hk.effective_threads(0) == 1
+        assert hk.effective_threads(2 * min_words - 1) == 1
+        # ... and above it never exceeds words / floor (nor, trivially,
+        # hardware_concurrency — on a 1-core CI box it stays 1)
+        for words in (2 * min_words, 3 * min_words, 64 * min_words):
+            assert 1 <= hk.effective_threads(words) <= words // min_words
+    finally:
+        hk.set_threads(0)
+
+
 def test_row_counts_and_matches_oracle():
     a, b = rand(6, 129), rand(6, 129)
     got = hk.row_counts_and(a, b)

@@ -37,6 +37,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 LOG = os.path.join(REPO, "tools", "relay_watcher.log")
 CAPTURE_DIR = os.path.join(REPO, "tools", "tpu_captures")
 CAPTURING_FLAG = os.path.join(REPO, "tools", "relay_watcher.capturing")
@@ -73,6 +74,20 @@ def relay_up() -> bool:
         return False
 
 
+def tunnel_ok() -> bool:
+    """End-to-end probe, run only when a capture is due.
+
+    A live relay process is not a live tunnel: a wedged far end leaves
+    the local mux healthy while every jax op hangs (observed round 3) —
+    captures fired at a wedged tunnel each burn their full timeout, so
+    a ~4-min killable-subprocess probe first is cheap insurance.  Probe
+    successes are disk-cached (axon_guard), so a healthy steady state
+    pays one real probe per TTL."""
+    from pilosa_tpu.axon_guard import tunnel_responsive
+
+    return tunnel_responsive()
+
+
 def run_step(name: str, argv: list[str], timeout: int,
              out_path: str | None) -> bool:
     """Run one capture step; returns True on rc==0.  stdout+stderr go to
@@ -80,6 +95,9 @@ def run_step(name: str, argv: list[str], timeout: int,
     log(f"capture step {name}: {' '.join(argv)}")
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+    # capture steps own the tunnel: their axon_guard must not wait on
+    # our own relay_watcher.capturing flag
+    env["PILOSA_TPU_AXON_CAPTURING"] = "1"
     t0 = time.monotonic()
     try:
         proc = subprocess.run(argv, capture_output=True, text=True,
@@ -167,7 +185,17 @@ def main() -> None:
         if up:
             full_due = now - last_full >= FULL_RECAPTURE_S
             bench_due = now - last_bench >= BENCH_RECAPTURE_S
-            if full_due or bench_due:
+            if (full_due or bench_due) and not tunnel_ok():
+                log("relay process up but tunnel unresponsive end-to-end "
+                    "(probe timed out); deferring capture")
+                # back off BOTH timers one bench interval — otherwise a
+                # pending full_due re-triggers the 4-min probe every
+                # 30 s poll
+                now = time.monotonic()
+                last_bench = now
+                last_full = max(last_full,
+                                now - FULL_RECAPTURE_S + BENCH_RECAPTURE_S)
+            elif full_due or bench_due:
                 if capture(full=full_due):
                     last_bench = time.monotonic()
                     if full_due:
