@@ -245,6 +245,24 @@ class ClusterNode:
             self.cleanup_unowned()
         elif t == "ping":
             return {"ok": True, "state": self.cluster.state}
+        elif t == "collective-time-bounds":
+            # open-ended time-range resolution: report this process's
+            # local view time span per field so the coordinator can
+            # write the GLOBAL clamp into the collective query text
+            # (parallel/spmd.py _resolve_open_time_ranges)
+            from pilosa_tpu.models.timequantum import TIME_FORMAT
+
+            idx = self.holder.index(msg["index"])
+            if idx is None:
+                return {"ok": False, "error": f"unknown index {msg['index']!r}"}
+            out = {}
+            for fname in msg["fields"]:
+                f = idx.field(fname)
+                times = f.time_view_times() if f is not None else []
+                out[fname] = ([min(times).strftime(TIME_FORMAT),
+                               max(times).strftime(TIME_FORMAT)]
+                              if times else None)
+            return {"ok": True, "bounds": out}
         elif t == "collective-prepare":
             # phase 1 of a coordinator-initiated collective: validate
             # and promise without entering (parallel/spmd.py)

@@ -493,6 +493,133 @@ def collective_available() -> bool:
     return jax.process_count() > 1
 
 
+def _open_time_fields(idx, call) -> set:
+    """Field names of time-range Rows in the tree carrying an
+    open-ended bound (exactly one of from=/to=).  Only fields that
+    exist with a time quantum count — anything else is the supported()
+    check's problem."""
+    from pilosa_tpu.pql import Call as _Call
+
+    out = set()
+
+    def walk(c):
+        if not isinstance(c, _Call):
+            return
+        if c.name == "Row" and (("from" in c.args) != ("to" in c.args)):
+            try:
+                fname = c.field_arg()
+            except Exception:  # noqa: BLE001 — malformed: supported() refuses
+                fname = None
+            if fname:
+                f = idx.field(fname)
+                if f is not None and str(f.time_quantum):
+                    out.add(fname)
+        filt = c.args.get("filter")
+        if isinstance(filt, _Call):
+            walk(filt)
+        for ch in c.children:
+            walk(ch)
+
+    walk(call)
+    return out
+
+
+#: rewrite target when NO process holds any time view: a concrete
+#: empty range (start == end), so every program agrees on "no cover"
+_EMPTY_RANGE_TS = "1970-01-01T00:00"
+
+
+def _resolve_open_time_ranges(node, idx, index_name: str, call):
+    """Rewrite open-ended time-range bounds to concrete global values
+    IN THE QUERY TEXT, so the SPMD programs stay identical everywhere.
+
+    The scatter path clamps open-ended ranges per node against locally
+    present views (executor._clamp_to_views, mirroring the reference's
+    minMaxViews in executeRowsShard) — but processes hold different
+    view subsets, so a local clamp would diverge the collective
+    programs.  Instead the coordinator gathers every process's view
+    time bounds over the control plane (one `collective-time-bounds`
+    round) and writes the GLOBAL clamp into the call args; the
+    rewritten text ships to peers, and clamping to the global view
+    span is result-identical to the per-node clamp (views outside a
+    node's span contribute nothing anywhere).
+
+    Mutates and returns `call` (origin-private: parsed from text by
+    the caller).  Raises CollectiveError when a peer cannot answer —
+    the caller falls back to the scatter path."""
+    import datetime as _dt
+
+    from pilosa_tpu.models.timequantum import TIME_FORMAT
+
+    fields = _open_time_fields(idx, call)
+    if not fields:
+        return call
+
+    bounds: dict = {}
+
+    def merge(fname, lo, hi):
+        cur = bounds.get(fname)
+        bounds[fname] = ((lo, hi) if cur is None
+                         else (min(cur[0], lo), max(cur[1], hi)))
+
+    for fname in fields:
+        f = idx.field(fname)
+        times = f.time_view_times()
+        bounds[fname] = None
+        if times:
+            merge(fname, min(times), max(times))
+    peers = [n for n in node.cluster.sorted_nodes()
+             if n.id != node.cluster.local_id]
+    for n in peers:
+        r = node.cluster.transport.send_message(
+            n, {"type": "collective-time-bounds", "index": index_name,
+                "fields": sorted(fields)})
+        if not r.get("ok"):
+            raise CollectiveError(
+                f"peer {n.id} time bounds: {r.get('error')}")
+        for fname, pair in (r.get("bounds") or {}).items():
+            if pair is not None:
+                merge(fname,
+                      _dt.datetime.strptime(pair[0], TIME_FORMAT),
+                      _dt.datetime.strptime(pair[1], TIME_FORMAT))
+
+    from pilosa_tpu.pql import Call as _Call
+
+    def rewrite(c):
+        if not isinstance(c, _Call):
+            return
+        if c.name == "Row" and (("from" in c.args) != ("to" in c.args)):
+            fname = None
+            try:
+                fname = c.field_arg()
+            except Exception:  # noqa: BLE001
+                pass
+            if fname in bounds:
+                span = bounds[fname]
+                if span is None:
+                    # no time views anywhere: concrete empty range
+                    c.args["from"] = _EMPTY_RANGE_TS
+                    c.args["to"] = _EMPTY_RANGE_TS
+                else:
+                    lo, hi = span
+                    # same widening as executor._clamp_to_views: the
+                    # max view START plus the widest view unit (a year
+                    # view covers 366 days of data)
+                    if "from" not in c.args:
+                        c.args["from"] = lo.strftime(TIME_FORMAT)
+                    if "to" not in c.args:
+                        c.args["to"] = (hi + _dt.timedelta(days=366)
+                                        ).strftime(TIME_FORMAT)
+        filt = c.args.get("filter")
+        if isinstance(filt, _Call):
+            rewrite(filt)
+        for ch in c.children:
+            rewrite(ch)
+
+    rewrite(call)
+    return call
+
+
 def _has_sentinel(call) -> bool:
     """True when translation produced an internal sentinel call
     (_Empty/_EmptyRows/_Noop) anywhere in the tree — those have no PQL
@@ -547,6 +674,10 @@ def _check_collective(node, index_name: str, pql: str,
             # which has no PQL spelling to ship to peers — the scatter
             # path handles sentinels natively
             return "missing-key sentinel in translated query", None, None
+        try:
+            call = _resolve_open_time_ranges(node, idx, index_name, call)
+        except Exception as e:  # noqa: BLE001 — scatter path owns it
+            return f"open time-range resolution failed: {e!r}", None, None
         pql = str(call)
     ce = CollectiveExecutor(node.holder, node.cluster, index_name)
     if not ce.supported(call):

@@ -168,6 +168,127 @@ class TestSingleProcessCollective:
         with pytest.raises(spmd.CollectiveError):
             ce.execute("Count(Row(t=4, from='2019-01-01T00:00'))")
 
+    def test_open_time_range_resolution(self, single):
+        """Coordinator-side rewrite of open-ended time bounds to the
+        GLOBAL view clamp (the collective analog of the scatter path's
+        per-node _clamp_to_views): detection, peer-bounds merge, text
+        round-trip, and the no-views-anywhere empty rewrite."""
+        h, ce, ex, bits, vals = single
+        idx = h.index("i")
+
+        from pilosa_tpu.models.timequantum import parse_time
+        from pilosa_tpu.pql import parse
+
+        t = idx.create_field("t", FieldOptions.time_field("YMD"))
+        rng = random.Random(5)
+        trows, tcols, times = [], [], []
+        for _ in range(120):
+            trows.append(1)
+            tcols.append(rng.randrange(3 * SHARD_WIDTH))
+            times.append(parse_time(
+                f"2019-0{1 + rng.randrange(9)}-"
+                f"{1 + rng.randrange(27):02d}T00:00"))
+        t.import_bits(trows, tcols, timestamps=times)
+
+        call = parse("Count(Row(t=1, from='2019-03-01T00:00'))").calls[0]
+        assert spmd._open_time_fields(idx, call) == {"t"}
+        # bounded, non-time, and condition rows never trigger a round
+        for pql in ("Count(Row(t=1, from='2019-01-01T00:00', "
+                    "to='2019-02-01T00:00'))",
+                    "Count(Row(f=0))", "Count(Row(v > 10))"):
+            assert spmd._open_time_fields(idx, parse(pql).calls[0]) == set()
+
+        class _N:
+            def __init__(self, id):
+                self.id = id
+
+        sent = []
+
+        class _Transport:
+            def send_message(self, n, msg):
+                sent.append((n.id, msg))
+                return {"ok": True, "bounds":
+                        {"t": ["2018-06-01T00:00", "2020-02-01T00:00"]}}
+
+        class _Cluster:
+            local_id = "n0"
+            transport = _Transport()
+
+            def sorted_nodes(self):
+                return [_N("n0"), _N("n1")]
+
+        class _Node:
+            cluster = _Cluster()
+
+        out = spmd._resolve_open_time_ranges(_Node(), idx, "i", call)
+        row = out.children[0]
+        assert row.args["from"] == "2019-03-01T00:00"  # given: untouched
+        # peer's later bound wins the merge; +366d widening like
+        # executor._clamp_to_views
+        assert row.args["to"] == "2021-02-01T00:00"
+        assert sent and sent[0][1]["type"] == "collective-time-bounds"
+        # the rewritten call round-trips through PQL text (what ships)
+        assert str(parse(str(out)).calls[0]) == str(out)
+        # ... and the bounded rewrite is now collectively evaluable,
+        # matching the executor's open-ended evaluation exactly
+        want = ex.execute("i", "Count(Row(t=1, from='2019-03-01T00:00'))")[0]
+        assert ce.execute(f"Count({row})") == want
+
+        # no views anywhere: rewrite to a concrete empty range
+        class _TransportNone:
+            def send_message(self, n, msg):
+                return {"ok": True, "bounds": {"u": None}}
+
+        idx.create_field("u", FieldOptions.time_field("YMD"))
+        _Node.cluster.transport = _TransportNone()
+        call2 = parse("Count(Row(u=1, to='2019-01-01T00:00'))").calls[0]
+        out2 = spmd._resolve_open_time_ranges(_Node(), idx, "i", call2)
+        r2 = out2.children[0]
+        assert r2.args["from"] == r2.args["to"] == spmd._EMPTY_RANGE_TS
+        assert ce.execute(f"Count({r2})") == 0
+
+        # a peer that cannot answer aborts resolution (scatter fallback)
+        class _TransportErr:
+            def send_message(self, n, msg):
+                return {"ok": False, "error": "nope"}
+
+        _Node.cluster.transport = _TransportErr()
+        with pytest.raises(spmd.CollectiveError):
+            spmd._resolve_open_time_ranges(
+                _Node(), idx, "i",
+                parse("Count(Row(t=1, from='2019-03-01T00:00'))").calls[0])
+
+    def test_time_bounds_bus_message(self, tmp_path):
+        """Peer side of the resolution round: the collective-time-bounds
+        bus message reports the local view span per field."""
+        from pilosa_tpu.models.timequantum import parse_time
+        from pilosa_tpu.parallel.node import ClusterNode
+
+        h = Holder(str(tmp_path / "hb"))
+        idx = h.create_index("i")
+        t = idx.create_field("t", FieldOptions.time_field("YM"))
+        t.import_bits([0, 0], [5, 9],
+                      timestamps=[parse_time("2020-03-15T00:00"),
+                                  parse_time("2020-11-02T00:00")])
+        idx.create_field("empty_t", FieldOptions.time_field("YMD"))
+        cluster = Cluster(local_id="n0")
+        cluster.add_node(Node(id="n0", uri="local"))
+        node = ClusterNode(h, cluster)
+        r = node.receive_message(
+            {"type": "collective-time-bounds", "index": "i",
+             "fields": ["t", "empty_t", "missing"]})
+        assert r["ok"]
+        # YM quantum: the year view floors the min to the year start;
+        # the latest month view sets the max
+        assert r["bounds"]["t"] == ["2020-01-01T00:00", "2020-11-01T00:00"]
+        assert r["bounds"]["empty_t"] is None
+        assert r["bounds"]["missing"] is None
+        r = node.receive_message(
+            {"type": "collective-time-bounds", "index": "nope",
+             "fields": ["t"]})
+        assert not r["ok"]
+        h.close()
+
     def test_group_by_parity(self, single):
         h, ce, ex, bits, vals = single
         # second field so the 2-child walk crosses field boundaries
@@ -451,6 +572,10 @@ for row in range(3):
     rows_l += [row] * len(cols); cols_l += sorted(cols)
 vcols = sorted({rng.randrange(N_SHARDS * SHARD_WIDTH) for _ in range(300)})
 vals = {c: rng.randrange(-1000, 100000) for c in vcols}
+# time-field data: one month per column, deterministic for the oracle
+tcols = sorted({rng.randrange(N_SHARDS * SHARD_WIDTH) for _ in range(200)})
+tmonth = {cc: 1 + (i % 9) for i, cc in enumerate(tcols)}
+t_oracle = sum(1 for m in tmonth.values() if m >= 3)
 
 if pid == 0:
     post = lambda p, o: c.post_json(srv.uri + p, o)
@@ -458,9 +583,15 @@ if pid == 0:
     post("/index/i/field/f", {})
     post("/index/i/field/v",
          {"options": {"type": "int", "min": -1000, "max": 100000}})
+    post("/index/i/field/t",
+         {"options": {"type": "time", "timeQuantum": "YMD"}})
     post("/index/i/field/f/import", {"rowIDs": rows_l, "columnIDs": cols_l})
     post("/index/i/field/v/import-value",
          {"columnIDs": vcols, "values": [vals[c] for c in vcols]})
+    post("/index/i/field/t/import",
+         {"rowIDs": [1] * len(tcols), "columnIDs": tcols,
+          "timestamps": [f"2019-{tmonth[cc]:02d}-01T00:00"
+                         for cc in tcols]})
 
 # barrier: every process waits until the scatter-gather plane sees all
 # data, then signals readiness over the CONTROL plane (a file), never a
@@ -589,6 +720,19 @@ if pid == 0:
             break
     assert spmd.counters()["collective_initiated"] > before, \
         "no HTTP query ran collectively in 5 attempts"
+    # open-ended time range: the coordinator resolves the global view
+    # clamp over the control plane (collective-time-bounds round),
+    # rewrites the text, and the bounded program runs collectively
+    t_pql = "Count(Row(t=1, from='2019-03-01T00:00'))"
+    before_t = spmd.counters()["collective_initiated"]
+    for attempt in range(5):
+        got = c.post_json(srv.uri + "/index/i/query",
+                          {"query": t_pql})["results"][0]
+        assert got == t_oracle, (got, t_oracle)
+        if spmd.counters()["collective_initiated"] > before_t:
+            break
+    assert spmd.counters()["collective_initiated"] > before_t, \
+        "open-ended time query never ran collectively in 5 attempts"
     assert spmd.counters()["collective_joined"] == 0  # only peers join
     open(f"{data}/product_done.ok", "w").write("1")
 else:
