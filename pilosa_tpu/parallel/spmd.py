@@ -858,12 +858,13 @@ class CollectiveExecutor:
             fname = call.string_arg("_field") or call.args.get("_field")
             if not fname or not self._plain_field(fname):
                 return False
-            # args the executor path honors but this evaluator doesn't:
-            # refusing them routes the query to the scatter path rather
-            # than silently changing its meaning
-            if any(a in call.args for a in
-                   ("ids", "threshold", "attrName", "attrValues",
-                    "tanimotoThreshold")):
+            # attr filters need per-row attr-store lookups (origin-local
+            # state); refusing routes them to the scatter path rather
+            # than silently changing their meaning
+            if any(a in call.args for a in ("attrName", "attrValues")):
+                return False
+            # malformed args: let the scatter path raise the user error
+            if (call.uint_arg("tanimotoThreshold") or 0) > 100:
                 return False
             return not call.children or self._tree_ok(call.children[0])
         if call.name == "GroupBy":
@@ -1174,6 +1175,9 @@ class CollectiveExecutor:
         fname = call.string_arg("_field") or call.args.get("_field")
         f = self._field(fname)
         n = call.uint_arg("n") or 0
+        ids_arg = call.uint_slice_arg("ids")
+        threshold = call.uint_arg("threshold") or 0
+        tanimoto = call.uint_arg("tanimotoThreshold") or 0
         row_ids = agreed_row_ids(f)
         if not row_ids:
             return []
@@ -1182,13 +1186,47 @@ class CollectiveExecutor:
                 f"TopN over {len(row_ids)} rows exceeds the dense "
                 f"collective ceiling {MAX_COLLECTIVE_ROWS}")
         mat = global_matrix_stack(f, row_ids, plan)
-        if call.children:
-            filt = self._eval_stack(call.children[0], plan)
+        filt = (self._eval_stack(call.children[0], plan)
+                if call.children else None)
+        if filt is not None:
             per_shard = _jit_row_counts(plan.mesh, True)(mat, filt)
         else:
             per_shard = _jit_row_counts(plan.mesh, False)(mat)
         counts = np.asarray(per_shard, dtype=np.int64).sum(axis=0)
-        pairs = [Pair(id=rid, count=int(c))
-                 for rid, c in zip(row_ids, counts) if c > 0]
+        totals = {rid: int(c) for rid, c in zip(row_ids, counts) if c > 0}
+
+        # post-count filters, in the executor's exact order
+        # (executor.py _execute_topn; reference executor.go:860-1038)
+        if ids_arg:
+            allowed = set(ids_arg)
+            totals = {r: c for r, c in totals.items() if r in allowed}
+        if tanimoto and filt is not None:
+            # same math as the scatter path: count pre-window on FULL
+            # row counts, then the exact coefficient on global counts
+            # (two more collective dispatches — src popcount and the
+            # unfiltered scan — identical programs on every process)
+            import math
+
+            src_count = int(np.asarray(_jit_count(plan.mesh)(filt),
+                                       dtype=np.int64).sum())
+            full = np.asarray(_jit_row_counts(plan.mesh, False)(mat),
+                              dtype=np.int64).sum(axis=0)
+            full_counts = {rid: int(c) for rid, c in zip(row_ids, full)}
+            lo = src_count * tanimoto / 100.0
+            hi = src_count * 100.0 / tanimoto
+            kept = {}
+            for r, inter in totals.items():
+                cnt = full_counts.get(r, 0)
+                if not (lo < cnt < hi) or inter == 0:
+                    continue
+                coeff = math.ceil(inter * 100.0
+                                  / (cnt + src_count - inter))
+                if coeff > tanimoto:
+                    kept[r] = inter
+            totals = kept
+        elif threshold:
+            totals = {r: c for r, c in totals.items() if c >= threshold}
+
+        pairs = [Pair(id=r, count=c) for r, c in totals.items()]
         pairs.sort(key=lambda p: (-p.count, p.id))
         return pairs[: n] if n else pairs

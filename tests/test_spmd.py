@@ -313,15 +313,30 @@ class TestSingleProcessCollective:
                     "GroupBy(Rows(f, limit=2))",  # constrained child
                     "GroupBy(Rows(f), previous=1)",
                     "Count(Row(f=0, from='2019-01-01T00:00'))",
-                    # args the executor honors but this evaluator
-                    # doesn't — silently changed semantics is worse
-                    # than the scatter path
-                    "TopN(f, n=2, threshold=100)",
-                    "TopN(f, ids=[0,1])",
+                    # attr filters need origin-local attr stores;
+                    # malformed tanimoto must raise the scatter error
                     'TopN(f, attrName="x", attrValues=["y"])',
-                    "TopN(f, tanimotoThreshold=50)"):
+                    "TopN(f, Row(f=0), tanimotoThreshold=101)"):
             with pytest.raises(spmd.CollectiveError):
                 ce.execute(pql)
+
+    def test_topn_arg_parity(self, single):
+        """threshold/ids/tanimoto TopN args match the executor exactly
+        (post-count filters on the complete global counts)."""
+        h, ce, ex, bits, vals = single
+        for pql in ("TopN(f, n=2, threshold=100)",
+                    "TopN(f, threshold=301)",
+                    "TopN(f, ids=[0,2])",
+                    "TopN(f, ids=[1], n=1)",
+                    "TopN(f, Row(f=1), ids=[0,1,3])",
+                    "TopN(f, Row(f=0), threshold=10)",
+                    "TopN(f, Row(f=1), tanimotoThreshold=30)",
+                    "TopN(f, Row(f=0), tanimotoThreshold=95)",
+                    "TopN(f, tanimotoThreshold=50)"):  # no filter: inert
+            got = ce.execute(pql)
+            want = ex.execute("i", pql)[0]
+            assert [(p.id, p.count) for p in got] == \
+                   [(p.id, p.count) for p in want], pql
 
     def test_untranslated_key_args_refused(self, single):
         """The evaluator is id-space only: STRING row args (keys that
@@ -457,7 +472,8 @@ class TestSingleProcessCollective:
                         (best, sel.count(best)), q
             else:  # TopN / GroupBy with random filter
                 r = rng.randrange(4)
-                if rng.random() < 0.5:
+                roll = rng.random()
+                if roll < 0.35:
                     q = f"TopN(f, Row(f={r}), n=3)"
                     got = ce.execute(q)
                     want = sorted(((rid, len(c & bits[r]))
@@ -465,6 +481,14 @@ class TestSingleProcessCollective:
                                   key=lambda rc: (-rc[1], rc[0]))
                     want = [(rid, c) for rid, c in want if c > 0][:3]
                     assert [(p.id, p.count) for p in got] == want, q
+                elif roll < 0.6:
+                    # random post-count arg mix: executor is the oracle
+                    arg = rng.choice([
+                        f"threshold={rng.randrange(1, 250)}",
+                        f"ids=[{r}, {(r + 1) % 4}]",
+                        f"tanimotoThreshold={rng.randrange(5, 99)}"])
+                    q = f"TopN(f, Row(f={r}), n=3, {arg})"
+                    got = ce.execute(q)
                 else:
                     q = f"GroupBy(Rows(f), filter=Row(f={r}))"
                     got = ce.execute(q)
