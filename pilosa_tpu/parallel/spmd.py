@@ -320,6 +320,50 @@ def _jit_count(mesh):
 
 
 @functools.cache
+def _jit_sum0(mesh):
+    """Sum over the sharded axis, gathered replicated — the reduce for
+    tiny indicator stacks (XLA lowers it to one psum over the mesh)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.jit(lambda a: jnp.sum(a, axis=0, dtype=jnp.int32),
+                   out_shardings=NamedSharding(mesh, P()))
+
+
+def global_column_bits(field, row_ids, column: int, plan: Plan) -> np.ndarray:
+    """[R] replicated 0/1 per row of ``row_ids``: does the row contain
+    ``column``?  The owning shard's block carries the bits read from
+    its local fragment; every other block is zero; one mesh sum
+    replicates the answer (the collective analog of the executor's
+    vectorized column-word read, executor.py map_fn / reference
+    rowFilter ColumnFilter fragment.go:2618)."""
+    import jax
+
+    shard = column // SHARD_WIDTH
+    off = column % SHARD_WIDTH
+    w, b = off // bm.WORD_BITS, off % bm.WORD_BITS
+    view = field.view(VIEW_STANDARD)
+
+    def fill(buf, s):
+        if s != shard:
+            return
+        frag = view.fragment(s) if view is not None else None
+        if frag is None:
+            return
+        with frag._lock:
+            for i, r in enumerate(row_ids):
+                arr = frag._rows.get(r)
+                if arr is not None:
+                    buf[i] = np.uint32((int(arr[w]) >> b) & 1)
+
+    stack = jax.make_array_from_callback(
+        (len(plan.order), len(row_ids)), _sharding(plan, 1),
+        _fill_blocks(plan, (len(row_ids),), fill))
+    return np.asarray(_jit_sum0(plan.mesh)(stack))
+
+
+@functools.cache
 def _jit_exists(mesh):
     """planes[:, EXISTS] as a sharded [G, words] stack — eager slicing
     of a multi-process global array is illegal outside jit."""
@@ -880,9 +924,8 @@ class CollectiveExecutor:
                          or child.args.get("field"))
                 if not fname or not self._plain_field(fname):
                     return False
-                if any(a in child.args for a in
-                       ("limit", "column", "previous", "from", "to")):
-                    return False  # constrained children: scatter path
+                if any(a in child.args for a in ("from", "to")):
+                    return False  # time-constrained children: scatter
             filt = call.call_arg("filter")
             return filt is None or self._tree_ok(filt)
         return False
@@ -1117,13 +1160,29 @@ class CollectiveExecutor:
             fname = child.args.get("_field") or child.args.get("field")
             f = self._field(fname)
             ids = agreed_row_ids(f)
-            if not ids:
-                return []
             if len(ids) > MAX_COLLECTIVE_ROWS:
                 raise CollectiveError(
                     f"field {fname!r} has {len(ids)} rows > "
                     f"{MAX_COLLECTIVE_ROWS}; dense collective GroupBy "
                     f"declines (scatter path's level walk handles it)")
+            # constrained children, the executor's order (_execute_rows):
+            # column bit filter (one tiny collective — data lives on the
+            # owning shard), then previous, then limit.  previous/limit
+            # are pure functions of the agreed list, and the column
+            # gather replicates — every process derives the identical
+            # restricted list, so the programs stay in lockstep.
+            colarg = child.uint_arg("column")
+            if colarg is not None and ids:
+                bitvec = global_column_bits(f, ids, colarg, plan)
+                ids = [r for r, bit in zip(ids, bitvec) if bit]
+            prev = child.uint_arg("previous")
+            if prev is not None:
+                ids = [r for r in ids if r > prev]
+            lim = child.uint_arg("limit")
+            if lim is not None:
+                ids = ids[:lim]
+            if not ids:
+                return []
             fields.append(f)
             row_lists.append(ids)
         if (len(row_lists) == 2 and

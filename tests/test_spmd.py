@@ -310,7 +310,6 @@ class TestSingleProcessCollective:
         h, ce, ex, bits, vals = single
         for pql in ("Row(f=0)", "MinRow(field=f)",
                     "GroupBy(Rows(f), Rows(f), Rows(f))",  # >2 children
-                    "GroupBy(Rows(f, limit=2))",  # constrained child
                     "GroupBy(Rows(f), previous=1)",
                     "Count(Row(f=0, from='2019-01-01T00:00'))",
                     # attr filters need origin-local attr stores;
@@ -319,6 +318,28 @@ class TestSingleProcessCollective:
                     "TopN(f, Row(f=0), tanimotoThreshold=101)"):
             with pytest.raises(spmd.CollectiveError):
                 ce.execute(pql)
+
+    def test_group_by_constrained_children_parity(self, single):
+        """Rows-child limit/column/previous constraints match the
+        executor: column resolves via one collective bit gather, then
+        previous/limit apply to the agreed list (the executor's
+        _execute_rows order)."""
+        h, ce, ex, bits, vals = single
+        # a column present in row 1 and row 3 (deterministic probe)
+        col13 = next(iter(bits[1] & bits[3]
+                          or bits[1]))  # overlap or fall back to row 1
+        for pql in ("GroupBy(Rows(f, limit=2))",
+                    "GroupBy(Rows(f, previous=0))",
+                    "GroupBy(Rows(f, previous=1, limit=1))",
+                    f"GroupBy(Rows(f, column={col13}))",
+                    f"GroupBy(Rows(f, column={col13}, limit=1))",
+                    f"GroupBy(Rows(f, column={col13}), Rows(f))",
+                    "GroupBy(Rows(f, limit=3), Rows(f, previous=0), "
+                    "filter=Row(f=2))",
+                    "GroupBy(Rows(f, column=999999999))"):  # absent col
+            got = ce.execute(pql)
+            want = ex.execute("i", pql)[0]
+            assert got == want, (pql, got, want)
 
     def test_topn_arg_parity(self, single):
         """threshold/ids/tanimoto TopN args match the executor exactly
@@ -704,6 +725,21 @@ assert mx.val == hi and mx.count == sum(
 gb = ce.execute("GroupBy(Rows(f))")
 want_gb = sorted((r, len(cc)) for r, cc in bits.items() if cc)
 assert [(g.group[0].row_id, g.count) for g in gb] == want_gb, gb
+# constrained children: limit is a pure cut of the agreed list; column
+# resolves via the collective bit gather on the owning shard's process
+gbl = ce.execute("GroupBy(Rows(f, limit=2))")
+want_gbl = [(r, len(bits[r])) for r in sorted(bits)[:2] if bits[r]]
+assert [(g.group[0].row_id, g.count) for g in gbl] == want_gbl, gbl
+cc1 = min(bits[1])
+gbc = ce.execute(f"GroupBy(Rows(f, column={cc1}))")
+want_gbc = [(r, len(bits[r])) for r in sorted(bits) if cc1 in bits[r]]
+assert [(g.group[0].row_id, g.count) for g in gbc] == want_gbc, gbc
+# TopN post-count args, same lockstep
+tnt = ce.execute("TopN(f, Row(f=0), n=2, threshold=1)")
+want_tnt = sorted(((r, len(cc & bits[0])) for r, cc in bits.items()),
+                  key=lambda rc: (-rc[1], rc[0]))
+want_tnt = [(r, cnt) for r, cnt in want_tnt if cnt >= 1][:2]
+assert [(p.id, p.count) for p in tnt] == want_tnt, tnt
 
 # cross-check the collective data plane against the HTTP control plane.
 # Two phases with a control-plane barrier between: an HTTP scatter-
