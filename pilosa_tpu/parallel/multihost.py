@@ -74,10 +74,25 @@ def initialize(coordinator_address: str | None = None,
                 "global runtime before backends initialize")
     except ImportError:  # private module moved: let jax raise its own
         pass
+    # Failure-detection latency: a process death mid-collective is
+    # fail-stop for every participant (the coordination service
+    # terminates survivors after heartbeat_timeout_seconds — see
+    # spmd.try_collective), so the heartbeat window IS the bound on
+    # how long a broken world can park queries.  Default 100 s
+    # (jax's); operators running the collective plane trade detection
+    # latency against false positives here.
+    kwargs = {}
+    hb = os.environ.get("PILOSA_TPU_DIST_HEARTBEAT_S")
+    if hb:
+        kwargs["heartbeat_timeout_seconds"] = int(hb)
+    init_to = os.environ.get("PILOSA_TPU_DIST_INIT_TIMEOUT_S")
+    if init_to:
+        kwargs["initialization_timeout"] = int(init_to)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        **kwargs,
     )
     _initialized = True
     _initialized_distributed = True

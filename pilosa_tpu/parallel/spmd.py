@@ -746,9 +746,18 @@ def try_collective(node, index_name: str, pql: str):
     promises to enter — pure control-plane, no device work, no lock),
     then the EXECUTE broadcast fires asynchronously and this process
     enters the collective only after every peer has promised.  A peer
-    that dies between promise and entry is bounded by the collective
-    runtime's own timeout, which raises here and on every parked peer
-    (releasing their locks) — a slow failure, not a deadlock.
+    that DIES between promise and entry is a fail-stop event, not a
+    raised error: the jax.distributed coordination service declares
+    the world unhealthy after heartbeat_timeout_seconds (measured:
+    the survivor is TERMINATED by the runtime, client.h:80 — an
+    exception is never delivered to parked participants).  Bounded,
+    never a deadlock — but it takes every participating server process
+    down; durability is WAL-carried and restart heals (the fate
+    coupling is inherent to an SPMD world: survivors could not answer
+    collectively without the dead peer's shards anyway).  Operators
+    size the detection latency via PILOSA_TPU_DIST_HEARTBEAT_S
+    (multihost.initialize).  The HTTP scatter plane keeps replica
+    failover for node death on non-collective queries.
 
     Deadlock discipline (learned against real processes): the join
     broadcast must be in flight BEFORE this process enters the

@@ -103,3 +103,71 @@ print(f"OK {got}")
         assert p.returncode == 0, out[-2000:]
     counts = {out.strip().splitlines()[-1] for out in outs}
     assert len(counts) == 1 and next(iter(counts)).startswith("OK ")
+
+
+def test_peer_death_mid_collective_is_fail_stop_not_deadlock(tmp_path):
+    """Measured failure semantics of the collective plane (documented
+    in spmd.try_collective / docs/architecture.md): when a participant
+    dies before entering a collective the survivor is TERMINATED by
+    the jax.distributed coordination service after the heartbeat
+    window — no exception, no hang, no wrong answer.  This test pins
+    the two properties the design relies on: boundedness (the
+    survivor's wait is capped by PILOSA_TPU_DIST_HEARTBEAT_S) and
+    fail-stop (the survivor never completes the collective)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text("""
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)
+from pilosa_tpu.parallel import multihost
+
+multihost.initialize()
+pid = int(os.environ["JAX_PROCESS_ID"])
+print(f"init {pid}", flush=True)
+if pid == 1:
+    os._exit(1)  # abrupt death between promise and entry
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+out = multihost_utils.process_allgather(jnp.ones(4))
+print("COMPLETED-COLLECTIVE", out, flush=True)  # must never print
+""")
+
+    env = dict(os.environ)
+    env.update(
+        PALLAS_AXON_POOL_IPS="",
+        JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+        JAX_NUM_PROCESSES="2",
+        PILOSA_TPU_DIST_HEARTBEAT_S="10",
+        PYTHONPATH=os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))) + os.pathsep
+        + env.get("PYTHONPATH", ""),
+    )
+    procs = []
+    for pid in (0, 1):
+        e = dict(env, JAX_PROCESS_ID=str(pid))
+        procs.append(subprocess.Popen(
+            [sys.executable, str(worker)], env=e,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    t0 = time.monotonic()
+    # generous bound: heartbeat 10 s + polling/teardown margin; the
+    # point is "minutes, not forever" — and nowhere near the 120 s cap
+    outs = [p.communicate(timeout=120)[0] for p in procs]
+    elapsed = time.monotonic() - t0
+    assert procs[1].returncode == 1
+    # fail-stop: the survivor terminated (nonzero) without completing
+    assert procs[0].returncode != 0, outs[0][-2000:]
+    assert "COMPLETED-COLLECTIVE" not in outs[0], outs[0][-2000:]
+    assert elapsed < 90, f"unpark took {elapsed:.0f}s"
