@@ -678,6 +678,103 @@ def _has_sentinel(call) -> bool:
     return any(_has_sentinel(c) for c in call.children)
 
 
+#: marker: a bitmap subtree provably folded to the empty bitmap
+_EMPTY_TREE = object()
+
+
+def _fold_bitmap_tree(call):
+    """Fold ``_Empty`` sentinels out of a translated BITMAP tree by
+    set algebra, so a missing read key no longer forces the whole
+    query onto the scatter path (reference semantics: a missing key is
+    an empty row, executor.go:2610 translateCalls).
+
+    Returns the folded tree (a Call with no sentinels), ``_EMPTY_TREE``
+    when the subtree is provably the empty bitmap, or ``None`` when a
+    sentinel sits where algebra cannot remove it — ``Not(empty)`` is
+    the full existence set, which has no PQL spelling to ship to
+    peers, and ``_EmptyRows``/``_Noop`` never fold."""
+    from pilosa_tpu.pql import Call as _Call
+
+    name = call.name
+    if name == "_Empty":
+        return _EMPTY_TREE
+    if name.startswith("_"):
+        return None  # _EmptyRows/_Noop: not a bitmap-algebra sentinel
+    if not any(_has_sentinel(c) for c in call.children):
+        return call  # untouched subtree ships verbatim
+    kids = []
+    for c in call.children:
+        k = _fold_bitmap_tree(c)
+        if k is None:
+            return None
+        kids.append(k)
+    if name == "Union":
+        real = [k for k in kids if k is not _EMPTY_TREE]
+        if not real:
+            return _EMPTY_TREE
+        return real[0] if len(real) == 1 else _Call(name, dict(call.args), real)
+    if name == "Intersect":
+        if any(k is _EMPTY_TREE for k in kids):
+            return _EMPTY_TREE
+        return _Call(name, dict(call.args), kids)
+    if name == "Difference":
+        # Difference(a, b, c, ...) = a \ (b | c | ...)
+        if kids and kids[0] is _EMPTY_TREE:
+            return _EMPTY_TREE
+        real = kids[:1] + [k for k in kids[1:] if k is not _EMPTY_TREE]
+        if len(real) == 1:
+            return real[0]
+        return _Call(name, dict(call.args), real)
+    if name == "Xor":
+        # empty is the identity of symmetric difference
+        real = [k for k in kids if k is not _EMPTY_TREE]
+        if not real:
+            return _EMPTY_TREE
+        return real[0] if len(real) == 1 else _Call(name, dict(call.args), real)
+    if name == "Shift":
+        if kids[0] is _EMPTY_TREE:
+            return _EMPTY_TREE
+        return _Call(name, dict(call.args), kids)
+    if name == "Not":
+        # Not(empty) = the existence set: correct, but unshippable as
+        # text — decline and let the scatter path answer it
+        if kids[0] is _EMPTY_TREE:
+            return None
+        return _Call(name, dict(call.args), kids)
+    return None
+
+
+def _fold_query(call):
+    """Coordinator-side sentinel fold of one top-level read call.
+    Returns a sentinel-free Call ready to ship, or ``None`` when the
+    query (or its whole operand tree) cannot be folded to shippable
+    text — including the whole-tree-empty case, which the scatter
+    path's native sentinel handling answers with exactly the
+    reference's empty-row semantics."""
+    from pilosa_tpu.pql import Call as _Call
+
+    if call.name.startswith("_"):
+        return None
+    args = call.args
+    filt = args.get("filter")
+    if isinstance(filt, _Call) and _has_sentinel(filt):
+        folded = _fold_bitmap_tree(filt)
+        if folded is None or folded is _EMPTY_TREE:
+            return None
+        args = dict(args)
+        args["filter"] = folded
+        call = _Call(call.name, args, list(call.children))
+    if not any(_has_sentinel(c) for c in call.children):
+        return call if not _has_sentinel(call) else None
+    if call.name in ("Count", "Sum", "Min", "Max", "TopN"):
+        # the single child is a bitmap filter tree
+        kids = [_fold_bitmap_tree(c) for c in call.children]
+        if any(k is None or k is _EMPTY_TREE for k in kids):
+            return None
+        return _Call(call.name, dict(call.args), kids)
+    return None  # GroupBy children are Rows calls, not bitmap algebra
+
+
 def _check_collective(node, index_name: str, pql: str,
                       translate: bool = False):
     """Shared pre-flight validation (no locks, no device work).
@@ -715,9 +812,16 @@ def _check_collective(node, index_name: str, pql: str,
             return f"translation failed: {e!r}", None, None
         if _has_sentinel(call):
             # a missing key translated to an _Empty/_Noop sentinel,
-            # which has no PQL spelling to ship to peers — the scatter
-            # path handles sentinels natively
-            return "missing-key sentinel in translated query", None, None
+            # which has no PQL spelling to ship to peers.  Fold it out
+            # by set algebra where possible (Union drops empty
+            # children, Intersect collapses, ...); only unfoldable
+            # shapes — whole-tree-empty, Not(empty), _EmptyRows — fall
+            # back to the scatter path's native sentinel handling
+            folded = _fold_query(call)
+            if folded is None:
+                return ("missing-key sentinel in translated query",
+                        None, None)
+            call = folded
         try:
             call = _resolve_open_time_ranges(node, idx, index_name, call)
         except Exception as e:  # noqa: BLE001 — scatter path owns it
@@ -921,7 +1025,7 @@ class CollectiveExecutor:
                 return False
             return not call.children or self._tree_ok(call.children[0])
         if call.name == "GroupBy":
-            if not 1 <= len(call.children) <= 2:
+            if not 1 <= len(call.children) <= 3:
                 return False  # deeper nests: scatter path's level walk
             if any(a in call.args for a in ("previous", "aggregate",
                                             "having")):
@@ -1156,11 +1260,20 @@ class CollectiveExecutor:
             out = getattr(out, reducer)(ValCount(v + f.options.base, c))
         return out
 
+    #: level-1 rows looped for a 3-child GroupBy (one filtered
+    #: pair-counts dispatch each); larger outer levels decline to the
+    #: scatter path rather than queue hundreds of device programs
+    MAX_TRIPLE_OUTER = 64
+
     def _group_by(self, call, plan: Plan):
-        """GroupBy over 1-2 Rows children: agreed row-id lists per
-        child, one collective cartesian-counts program, host assembly
+        """GroupBy over 1-3 Rows children: agreed row-id lists per
+        child, collective cartesian-counts programs, host assembly
         in the executor's sorted-group order with offset-then-limit
-        (executor.go:1135-1149)."""
+        (executor.go:1135-1149).  Three children run as a lockstep
+        loop over level-1's agreed rows — one filtered pair-counts
+        program per outer row, every process iterating the identical
+        list (reference analog: the groupByIterator's cartesian walk,
+        executor.go:3058)."""
         from pilosa_tpu.parallel.results import FieldRow, GroupCount
 
         fields = []
@@ -1198,6 +1311,18 @@ class CollectiveExecutor:
                 len(row_lists[0]) * len(row_lists[1]) > MAX_COLLECTIVE_PAIRS):
             raise CollectiveError("GroupBy pair space too large for the "
                                   "dense collective path")
+        if len(row_lists) == 3:
+            if len(row_lists[0]) > self.MAX_TRIPLE_OUTER:
+                raise CollectiveError(
+                    f"GroupBy outer level has {len(row_lists[0])} rows "
+                    f"> {self.MAX_TRIPLE_OUTER}; scatter path walks it")
+            if (len(row_lists[0]) * len(row_lists[1]) * len(row_lists[2])
+                    > MAX_COLLECTIVE_PAIRS):
+                # the TOTAL group space is what the host accumulates —
+                # bounding only the inner pair space would admit
+                # outer x pairs ~ 64x the 2-child ceiling
+                raise CollectiveError("GroupBy triple space too large "
+                                      "for the dense collective path")
         filt_call = call.call_arg("filter")
         filt = (self._eval_stack(filt_call, plan)
                 if filt_call is not None else None)
@@ -1210,7 +1335,7 @@ class CollectiveExecutor:
             counts = np.asarray(per_shard, dtype=np.int64).sum(axis=0)
             totals = {((fields[0].name, r),): int(c)
                       for r, c in zip(row_lists[0], counts) if c > 0}
-        else:
+        elif len(fields) == 2:
             mat_a = global_matrix_stack(fields[0], row_lists[0], plan)
             mat_b = global_matrix_stack(fields[1], row_lists[1], plan)
             if filt is not None:
@@ -1226,6 +1351,24 @@ class CollectiveExecutor:
                 totals[((fields[0].name, int(ra_ids[i])),
                         (fields[1].name, int(rb_ids[j])))] = \
                     int(counts[i, j])
+        else:
+            mat_b = global_matrix_stack(fields[1], row_lists[1], plan)
+            mat_c = global_matrix_stack(fields[2], row_lists[2], plan)
+            rb_ids = np.asarray(row_lists[1])
+            rc_ids = np.asarray(row_lists[2])
+            totals = {}
+            for a in row_lists[0]:
+                fa = global_row_stack(fields[0], a, plan)
+                if filt is not None:
+                    fa = bm.b_and(fa, filt)
+                per_shard = _jit_pair_counts(plan.mesh, True)(
+                    mat_b, mat_c, fa)
+                counts = np.asarray(per_shard, dtype=np.int64).sum(axis=0)
+                for j, k in np.argwhere(counts > 0):
+                    totals[((fields[0].name, a),
+                            (fields[1].name, int(rb_ids[j])),
+                            (fields[2].name, int(rc_ids[k])))] = \
+                        int(counts[j, k])
         out = [GroupCount(group=[FieldRow(field=fn, row_id=r)
                                  for fn, r in key], count=c)
                for key, c in sorted(totals.items())]

@@ -303,13 +303,23 @@ class TestSingleProcessCollective:
                     "GroupBy(Rows(f), Rows(g))",
                     "GroupBy(Rows(f), Rows(g), filter=Row(f=0))",
                     "GroupBy(Rows(f), Rows(g), limit=3)",
-                    "GroupBy(Rows(f), Rows(g), offset=2, limit=4)"):
-            assert ce.execute(pql) == ex.execute("i", pql)[0], pql
+                    "GroupBy(Rows(f), Rows(g), offset=2, limit=4)",
+                    # 3-level nests: lockstep outer loop (round 3)
+                    "GroupBy(Rows(f), Rows(g), Rows(f))",
+                    "GroupBy(Rows(f), Rows(g), Rows(g), "
+                    "filter=Row(f=1))",
+                    "GroupBy(Rows(g), Rows(f), Rows(g), offset=3, "
+                    "limit=5)",
+                    "GroupBy(Rows(f, limit=2), Rows(g), "
+                    "Rows(f, previous=0))"):
+            got = ce.execute(pql)
+            want = ex.execute("i", pql)[0]
+            assert got == want, (pql, got, want)
 
     def test_unsupported_calls_refused(self, single):
         h, ce, ex, bits, vals = single
         for pql in ("Row(f=0)", "MinRow(field=f)",
-                    "GroupBy(Rows(f), Rows(f), Rows(f))",  # >2 children
+                    "GroupBy(Rows(f), Rows(f), Rows(f), Rows(f))",  # >3
                     "GroupBy(Rows(f), previous=1)",
                     "Count(Row(f=0, from='2019-01-01T00:00'))",
                     # attr filters need origin-local attr stores;
@@ -557,6 +567,67 @@ class TestSingleProcessCollective:
                 "i", 'Count(Row(kf="ghost"))')[0] == 0
         finally:
             h.close()
+
+    def test_sentinel_folding(self, tmp_path, monkeypatch):
+        """Missing read keys fold out of the tree by set algebra at the
+        coordinator (Union drops the empty child, Difference keeps its
+        head, ...) so mixed trees still run collectively; only
+        unfoldable shapes — whole-tree empty, Not(empty) — fall back
+        to the scatter path (reference: missing keys are empty rows,
+        executor.go:2610)."""
+        from pilosa_tpu.parallel.node import ClusterNode
+        from pilosa_tpu.pql import Call
+
+        h = Holder(str(tmp_path / "h"))
+        cluster = Cluster(local_id="n0")
+        cluster.add_node(Node(id="n0", uri="local"))
+        cluster.coordinator_id = "n0"
+        cluster.set_state("NORMAL")
+        node = ClusterNode(h, cluster)
+        idx = h.create_index("i")
+        idx.create_field("kf", FieldOptions.set_field(keys=True))
+        for col, key in [(1, "alice"), (2, "alice"), (3, "bob"),
+                         (2, "bob"), (9, "carol")]:
+            node.executor.execute("i", f'Set({col}, kf="{key}")')
+
+        monkeypatch.setattr(spmd, "collective_available", lambda: True)
+        try:
+            # Union: the empty child drops; answered collectively
+            q = 'Count(Union(Row(kf="alice"), Row(kf="ghost")))'
+            assert spmd.try_collective(node, "i", q) == [2]
+            assert node.executor.execute("i", q)[0] == 2
+            # Difference head survives
+            q = 'Count(Difference(Row(kf="alice"), Row(kf="ghost")))'
+            assert spmd.try_collective(node, "i", q) == [2]
+            # Xor: empty is the identity
+            q = 'Count(Xor(Row(kf="ghost"), Row(kf="bob")))'
+            assert spmd.try_collective(node, "i", q) == [2]
+            # Intersect with an empty leg folds to whole-tree empty:
+            # scatter path answers (collective declines)
+            q = 'Count(Intersect(Row(kf="alice"), Row(kf="ghost")))'
+            assert spmd.try_collective(node, "i", q) is None
+            assert node.executor.execute("i", q)[0] == 0
+            # TopN filter tree folds too
+            q = 'TopN(kf, Union(Row(kf="alice"), Row(kf="ghost")))'
+            pairs = spmd.try_collective(node, "i", q)[0]
+            assert [(p.key, p.count) for p in pairs] == \
+                [("alice", 2), ("bob", 1)]
+        finally:
+            h.close()
+
+        # algebra unit cases on raw trees
+        E = Call("_Empty")
+        row = Call("Row", {"f": 1})
+        assert spmd._fold_bitmap_tree(Call("Not", children=[E])) is None
+        assert spmd._fold_bitmap_tree(
+            Call("Shift", {"n": 2}, [E])) is spmd._EMPTY_TREE
+        assert spmd._fold_bitmap_tree(
+            Call("Difference", children=[E, row])) is spmd._EMPTY_TREE
+        u = spmd._fold_bitmap_tree(Call("Union", children=[E, row, E]))
+        assert u is row
+        x = spmd._fold_bitmap_tree(
+            Call("Xor", children=[E, row, Call("Row", {"f": 2})]))
+        assert x.name == "Xor" and len(x.children) == 2
 
     def test_rank_convention_checker(self, single):
         h, ce, ex, bits, vals = single
