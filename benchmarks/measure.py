@@ -123,11 +123,41 @@ def build_index(holder, name: str, n_shards: int, rows_per_field: int,
     return idx
 
 
-def main():
-    from pilosa_tpu.axon_guard import guard_dead_relay
+#: device configs need at least this host->device bandwidth; any real
+#: TPU host's DMA clears it by 10-100x, while the axon relay tunnel
+#: (observed ~MB/s, wedges on multi-GB transfers) never does
+MIN_DEVICE_GBPS = 0.05
 
-    guard_dead_relay()
+
+class _ConfigSkip(Exception):
+    """One config declines to produce a number; the sweep records the
+    reason and continues (no silent shrink, no dead artifact)."""
+
+
+def main():
+    from pilosa_tpu import axon_guard
+
+    axon_guard.guard_dead_relay()
     import jax
+
+    tunnel_note = None
+    if (os.environ.get("PALLAS_AXON_POOL_IPS")
+            and jax.config.jax_platforms != "cpu"):
+        # tunneled chip: measure what the relay can actually move
+        # BEFORE the in-process backend initializes, and pin the sweep
+        # to the host engine when the working sets could never transfer
+        # (the 10B config's prewarm pushes ~2.5 GB; a thin tunnel
+        # wedges end-to-end mid-transfer, taking the whole sweep down)
+        gbps = axon_guard.measured_transfer_gbps()
+        if gbps < MIN_DEVICE_GBPS:
+            tunnel_note = {
+                "config": "device-sweep", "skipped": True,
+                "reason": f"tunnel transfer bandwidth {gbps:.4f} GB/s "
+                          f"< {MIN_DEVICE_GBPS} GB/s floor; sweep runs "
+                          f"host-engine (exact results, CPU timings); "
+                          f"chip headline lives in bench.py's smaller "
+                          f"working set"}
+            jax.config.update("jax_platforms", "cpu")
 
     on_tpu = jax.devices()[0].platform == "tpu"
     n_shards = 64 if on_tpu else 16
@@ -139,6 +169,8 @@ def main():
     from pilosa_tpu.shardwidth import SHARD_WIDTH
 
     out = []
+    if tunnel_note is not None:
+        out.append(tunnel_note)
 
     bench_dir = tempfile.mkdtemp()
     holder = Holder(bench_dir + "/bench")
@@ -276,8 +308,13 @@ def main():
             from pilosa_tpu.runtime import prewarm, snapqueue
 
             t0 = _now()
-            assert prewarm.drain(timeout=300.0), "prewarm still running"
-            assert snapqueue.drain(timeout=300.0), "compaction still running"
+            if not (prewarm.drain(timeout=300.0)
+                    and snapqueue.drain(timeout=300.0)):
+                # never crash the sweep: a drain that can't settle
+                # (e.g. device transfers crawling through a thin
+                # tunnel) becomes a skip record, not a dead artifact
+                raise _ConfigSkip("background prewarm/compaction did "
+                                  "not settle in 300 s")
             prewarm_s = _now() - t0
             q_ns = "Count(Intersect(Row(f=0), Row(f=1)))"
             t0 = _now()
@@ -298,20 +335,29 @@ def main():
             t0 = _now()
             got_floor = ex.execute("northstar", q_ns)[0]
             floor_ms = (_now() - t0) * 1e3
+        except _ConfigSkip as e:
+            out.append({"config": 2,
+                        "metric": "intersect_count_p50_ms_10B_cols",
+                        "skipped": True, "reason": str(e)})
+            holder.delete_index("northstar")
+        else:
+            want = len(nbits[0] & nbits[1])
+            assert got == want, f"north-star mismatch: {got} != {want}"
+            assert got_floor == want, \
+                f"floor mismatch: {got_floor} != {want}"
+            out.append({"config": 2,
+                        "metric": "intersect_count_p50_ms_10B_cols",
+                        "value": round(statistics.median(lat), 1),
+                        "unit": "ms",
+                        "cols": ns_cols, "shards": ns_shards,
+                        "cold_ms": round(cold_ms, 1),
+                        "prewarm_s": round(prewarm_s, 1),
+                        "cold_floor_no_prewarm_ms": round(floor_ms, 1),
+                        "import_s": round(import_s, 1), "exact": True})
+            holder.delete_index("northstar")
         finally:
             mgr10.budget = old10
             mgr10.operator_sized = old10_sized
-        want = len(nbits[0] & nbits[1])
-        assert got == want, f"north-star mismatch: {got} != {want}"
-        assert got_floor == want, f"floor mismatch: {got_floor} != {want}"
-        out.append({"config": 2, "metric": "intersect_count_p50_ms_10B_cols",
-                    "value": round(statistics.median(lat), 1), "unit": "ms",
-                    "cols": ns_cols, "shards": ns_shards,
-                    "cold_ms": round(cold_ms, 1),
-                    "prewarm_s": round(prewarm_s, 1),
-                    "cold_floor_no_prewarm_ms": round(floor_ms, 1),
-                    "import_s": round(import_s, 1), "exact": True})
-        holder.delete_index("northstar")
     else:
         # a gated config must leave a record, never silently shrink the
         # artifact (VERDICT round-2 weak #6)

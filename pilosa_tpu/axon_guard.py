@@ -78,7 +78,20 @@ def tunnel_responsive(timeout_s: float = _PROBE_TIMEOUT_S,
                              capture_output=True, text=True,
                              timeout=timeout_s)
         ok = out.returncode == 0 and "probe 36" in out.stdout
-    except Exception:
+        if not ok:
+            # A fast child crash is NOT a tunnel hang — say what broke
+            # (observed: PYTHONPATH overridden without :$PYTHONPATH drops
+            # the axon site hook, so the child can't init the backend).
+            print(f"axon_guard: probe child failed (rc={out.returncode}, "
+                  f"not a timeout) stderr tail: {out.stderr[-400:]!r}",
+                  file=sys.stderr)
+    except subprocess.TimeoutExpired:
+        print(f"axon_guard: probe timed out after {timeout_s:.0f}s",
+              file=sys.stderr)
+        ok = False
+    except Exception as e:
+        print(f"axon_guard: probe raised {type(e).__name__}: {e}",
+              file=sys.stderr)
         ok = False
     if ok:
         try:
@@ -87,6 +100,41 @@ def tunnel_responsive(timeout_s: float = _PROBE_TIMEOUT_S,
         except OSError:
             pass
     return ok
+
+
+def measured_transfer_gbps(nbytes: int = 32 << 20,
+                           timeout_s: float = 240.0) -> float:
+    """Host->device transfer bandwidth in GB/s, measured by one
+    device_put in a KILLABLE subprocess (a wedged tunnel costs the
+    deadline, not the caller's run).  0.0 on any failure or timeout.
+
+    Purpose: scale benchmarks gate their device-resident configs on
+    this number.  A real TPU host moves multi-GB/s over DMA; the axon
+    relay tunnel has been observed at ~MB/s and WEDGES outright on
+    multi-GB transfers (round 3: a 10B-config prewarm pushing 2.5 GB
+    hung the tunnel end-to-end), so pushing a north-star working set
+    through it is never sane."""
+    code = (
+        "import time, numpy as np, jax\n"
+        # warm the backend first: a cold PJRT init through the relay is
+        # 30-60 s and must not count against the transfer itself
+        "jax.device_put(np.ones(1024, dtype=np.uint32))"
+        ".block_until_ready()\n"
+        f"x = np.ones({nbytes} // 4, dtype=np.uint32)\n"
+        "t0 = time.time()\n"
+        "d = jax.device_put(x)\n"
+        "d.block_until_ready()\n"
+        "dt = time.time() - t0\n"
+        f"print('gbps', {nbytes} / dt / 1e9)\n")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+        if out.returncode == 0 and "gbps" in out.stdout:
+            return float(out.stdout.split("gbps", 1)[1].split()[0])
+    except Exception:
+        pass
+    return 0.0
 
 
 def _wait_out_capture() -> bool:
