@@ -36,8 +36,11 @@ WORD_BLOCK = 2048
 
 
 def on_tpu() -> bool:
+    # the axon-relayed chip registers as platform "tpu" in practice,
+    # but accept the plugin's own name too — a silent False here would
+    # quietly reroute every Pallas call site to the XLA fallback
     try:
-        return jax.devices()[0].platform == "tpu"
+        return jax.devices()[0].platform in ("tpu", "axon")
     except Exception:
         return False
 
