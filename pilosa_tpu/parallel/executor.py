@@ -790,10 +790,11 @@ class Executor:
             if not isinstance(attr_values, list):
                 raise ExecutionError("TopN() attrValues must be a list")
             allowed_vals = set(attr_values)
+            row_attrs = f.row_attrs.attrs_bulk(totals)
             totals = {
                 r: c
                 for r, c in totals.items()
-                if f.row_attrs.attrs(r).get(attr_name) in allowed_vals
+                if row_attrs.get(r, {}).get(attr_name) in allowed_vals
             }
         if tanimoto and filter_call is not None:
             # Tanimoto similarity (reference fragment.top): the count
@@ -919,6 +920,22 @@ class Executor:
         column = call.uint_arg("column")
         shards = self._target_shards(idx, shards, opt)
 
+        # Time fields with from=/to= (or no standard view) scan the
+        # covering time views instead of standard — the reference's
+        # executeRowsShard view selection with open ends clamped to
+        # the existing views' min/max (executor.go:1319-1400); a
+        # non-time field ignores from/to exactly as the reference does
+        views = [VIEW_STANDARD]
+        if str(f.time_quantum) and ("from" in call.args
+                                    or "to" in call.args
+                                    or f.options.no_standard_view):
+            cover = self._time_range_views(f, call)
+            if cover is None:
+                raise ExecutionError("Rows(): malformed from/to time")
+            views = cover
+            if not views:
+                return []
+
         def push_down(ids: list[int]) -> list[int]:
             # previous/limit apply inside the shard scan (reference
             # executeRowsShard pushes the filter into the row iterator,
@@ -934,22 +951,35 @@ class Executor:
         def map_fn(shard):
             if column is not None and shard != column // SHARD_WIDTH:
                 return []
-            view = f.view(VIEW_STANDARD)
-            frag = view.fragment(shard) if view is not None else None
-            if frag is None:
+            frags = []
+            for vname in views:
+                view = f.view(vname)
+                frag = view.fragment(shard) if view is not None else None
+                if frag is not None:
+                    frags.append(frag)
+            if not frags:
                 return []
             if column is not None:
                 # one vectorized read of the column's word down the row
-                # matrix (reference rowFilter ColumnFilter,
-                # fragment.go:2618) — not a per-row bit probe
-                ids_arr, matrix = frag._stacked()
-                if len(ids_arr) == 0:
-                    return []
+                # matrix per view (reference rowFilter ColumnFilter,
+                # fragment.go:2618) — a row qualifies when the bit is
+                # set in ANY covering view (merged-row semantics)
                 off = column % SHARD_WIDTH
                 w, b = off // bm.WORD_BITS, off % bm.WORD_BITS
-                mask = (matrix[:, w] >> np.uint32(b)) & np.uint32(1)
-                return push_down([int(r) for r in ids_arr[mask.astype(bool)]])
-            return push_down(frag.row_ids())
+                hit: set[int] = set()
+                for frag in frags:
+                    ids_arr, matrix = frag._stacked()
+                    if len(ids_arr) == 0:
+                        continue
+                    mask = (matrix[:, w] >> np.uint32(b)) & np.uint32(1)
+                    hit.update(int(r) for r in ids_arr[mask.astype(bool)])
+                return push_down(sorted(hit))
+            if len(frags) == 1:
+                return push_down(frags[0].row_ids())
+            merged: set[int] = set()
+            for frag in frags:
+                merged.update(frag.row_ids())
+            return push_down(sorted(merged))
 
         parts = self._map_shards(
             map_fn, shards, idx=idx, call=call, opt=opt, adapt=lambda ids: [ids]

@@ -1015,10 +1015,11 @@ class CollectiveExecutor:
             fname = call.string_arg("_field") or call.args.get("_field")
             if not fname or not self._plain_field(fname):
                 return False
-            # attr filters need per-row attr-store lookups (origin-local
-            # state); refusing routes them to the scatter path rather
-            # than silently changing their meaning
-            if any(a in call.args for a in ("attrName", "attrValues")):
+            # attrName without a list attrValues is a user error the
+            # scatter path owns; the filter itself runs host-side
+            # post-count (AE-synced attr stores, coordinator's answer)
+            if ("attrName" in call.args
+                    and not isinstance(call.args.get("attrValues"), list)):
                 return False
             # malformed args: let the scatter path raise the user error
             if (call.uint_arg("tanimotoThreshold") or 0) > 100:
@@ -1411,6 +1412,20 @@ class CollectiveExecutor:
         if ids_arg:
             allowed = set(ids_arg)
             totals = {r: c for r, c in totals.items() if r in allowed}
+        attr_name = call.string_arg("attrName")
+        if attr_name:
+            # attrs filter host-side AFTER the identical device
+            # dispatches, so SPMD lockstep holds; stores are AE-synced,
+            # and only the coordinator's host answer reaches the client
+            attr_values = call.args.get("attrValues")
+            if not isinstance(attr_values, list):
+                raise CollectiveError("TopN() attrValues must be a list")
+            allowed_vals = set(attr_values)
+            row_attrs = f.row_attrs.attrs_bulk(totals)
+            totals = {
+                r: c for r, c in totals.items()
+                if row_attrs.get(r, {}).get(attr_name) in allowed_vals
+            }
         if tanimoto and filt is not None:
             # same math as the scatter path: count pre-window on FULL
             # row counts, then the exact coefficient on global counts

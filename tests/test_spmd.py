@@ -322,9 +322,9 @@ class TestSingleProcessCollective:
                     "GroupBy(Rows(f), Rows(f), Rows(f), Rows(f))",  # >3
                     "GroupBy(Rows(f), previous=1)",
                     "Count(Row(f=0, from='2019-01-01T00:00'))",
-                    # attr filters need origin-local attr stores;
-                    # malformed tanimoto must raise the scatter error
-                    'TopN(f, attrName="x", attrValues=["y"])',
+                    # attrName without a list attrValues is the scatter
+                    # path's user error; malformed tanimoto likewise
+                    'TopN(f, attrName="x")',
                     "TopN(f, Row(f=0), tanimotoThreshold=101)"):
             with pytest.raises(spmd.CollectiveError):
                 ce.execute(pql)
@@ -364,6 +364,28 @@ class TestSingleProcessCollective:
                     "TopN(f, Row(f=1), tanimotoThreshold=30)",
                     "TopN(f, Row(f=0), tanimotoThreshold=95)",
                     "TopN(f, tanimotoThreshold=50)"):  # no filter: inert
+            got = ce.execute(pql)
+            want = ex.execute("i", pql)[0]
+            assert [(p.id, p.count) for p in got] == \
+                   [(p.id, p.count) for p in want], pql
+
+    def test_topn_attr_filter_parity(self, single):
+        """attrName/attrValues filter host-side on the complete global
+        counts, matching the executor (the device programs are
+        unchanged, so SPMD lockstep holds)."""
+        h, ce, ex, bits, vals = single
+        f = h.index("i").field("f")
+        f.row_attrs.set_attrs(0, {"color": "red", "size": 3})
+        f.row_attrs.set_attrs(1, {"color": "blue"})
+        f.row_attrs.set_attrs(2, {"color": "red"})
+        for pql in ('TopN(f, attrName="color", attrValues=["red"])',
+                    'TopN(f, attrName="color", attrValues=["blue"], n=1)',
+                    'TopN(f, attrName="size", attrValues=[3])',
+                    'TopN(f, attrName="color", attrValues=["green"])',
+                    'TopN(f, Row(f=1), attrName="color", '
+                    'attrValues=["red","blue"])',
+                    'TopN(f, attrName="color", attrValues=["red"], '
+                    'threshold=100)'):
             got = ce.execute(pql)
             want = ex.execute("i", pql)[0]
             assert [(p.id, p.count) for p in got] == \
