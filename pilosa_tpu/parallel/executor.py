@@ -926,7 +926,7 @@ class Executor:
         # the existing views' min/max (executor.go:1319-1400); a
         # non-time field ignores from/to exactly as the reference does
         views = [VIEW_STANDARD]
-        if str(f.time_quantum) and ("from" in call.args
+        if f.time_quantum and ("from" in call.args
                                     or "to" in call.args
                                     or f.options.no_standard_view):
             cover = self._time_range_views(f, call)

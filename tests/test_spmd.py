@@ -391,6 +391,76 @@ class TestSingleProcessCollective:
             assert [(p.id, p.count) for p in got] == \
                    [(p.id, p.count) for p in want], pql
 
+    def test_fuzz_sentinel_folding(self, tmp_path, monkeypatch):
+        """Randomized keyed trees mixing real and MISSING keys through
+        the coordinator: whenever try_collective answers, it must match
+        the executor (which handles sentinels natively) and a Python
+        oracle — and the fold must actually engage on a healthy
+        fraction of ghost-bearing trees."""
+        from pilosa_tpu.parallel.node import ClusterNode
+
+        h = Holder(str(tmp_path / "h"))
+        cluster = Cluster(local_id="n0")
+        cluster.add_node(Node(id="n0", uri="local"))
+        cluster.coordinator_id = "n0"
+        cluster.set_state("NORMAL")
+        node = ClusterNode(h, cluster)
+        idx = h.create_index("i")
+        idx.create_field("kf", FieldOptions.set_field(keys=True))
+        rng = random.Random(2718)
+        real = {}
+        for key in ("a", "b", "c", "d"):
+            cols = {rng.randrange(3000) for _ in range(200)}
+            real[key] = cols
+        # bulk-load via the executor write path (keys allocate ids)
+        for key, cols in real.items():
+            for c in sorted(cols):
+                node.executor.execute("i", f'Set({c}, kf="{key}")')
+        ghosts = ["g1", "g2"]
+
+        def gen(depth):
+            if depth == 0 or rng.random() < 0.4:
+                key = rng.choice(list(real) + ghosts)
+                return f'Row(kf="{key}")', real.get(key, set())
+            op = rng.choice(["Union", "Intersect", "Difference", "Xor"])
+            n = rng.randrange(2, 4)
+            parts = [gen(depth - 1) for _ in range(n)]
+            texts = [p[0] for p in parts]
+            sets = [p[1] for p in parts]
+            if op == "Union":
+                acc = set().union(*sets)
+            elif op == "Intersect":
+                acc = sets[0]
+                for s in sets[1:]:
+                    acc = acc & s
+            elif op == "Difference":
+                acc = sets[0]
+                for s in sets[1:]:
+                    acc = acc - s
+            else:
+                acc = sets[0]
+                for s in sets[1:]:
+                    acc = acc ^ s
+            return f"{op}({', '.join(texts)})", acc
+
+        monkeypatch.setattr(spmd, "collective_available", lambda: True)
+        answered_with_ghost = 0
+        try:
+            for _ in range(100):
+                text, oracle = gen(depth=2)
+                q = f"Count({text})"
+                want = node.executor.execute("i", q)[0]
+                assert want == len(oracle), (q, want, len(oracle))
+                res = spmd.try_collective(node, "i", q)
+                if res is not None:
+                    assert res == [want], (q, res, want)
+                    if '"g' in text:
+                        answered_with_ghost += 1
+            # the fold must be doing real work, not refusing everything
+            assert answered_with_ghost >= 10, answered_with_ghost
+        finally:
+            h.close()
+
     def test_untranslated_key_args_refused(self, single):
         """The evaluator is id-space only: STRING row args (keys that
         never went through the coordinator's translation) are refused —
