@@ -556,7 +556,7 @@ def _open_time_fields(idx, call) -> set:
                 fname = None
             if fname:
                 f = idx.field(fname)
-                if f is not None and str(f.time_quantum):
+                if f is not None and f.time_quantum:
                     out.add(fname)
         filt = c.args.get("filter")
         if isinstance(filt, _Call):
@@ -1098,7 +1098,7 @@ class CollectiveExecutor:
 
         fname = call.field_arg()
         f = self._field(fname)
-        if not str(f.time_quantum):
+        if not f.time_quantum:
             return None
         from_arg = call.args.get("from")
         to_arg = call.args.get("to")
