@@ -43,6 +43,11 @@ N_SHARDS = 256
 WORDS = (1 << 20) // 32
 DENSITY = 0.08  # fraction of bits set; typical set-field fragment occupancy
 
+#: platforms that count as a real chip for peak-bw lookup and capture
+#: attachment (the axon relay registers the v5e as "tpu" in practice,
+#: but accept the plugin name too)
+_CHIP_PLATFORMS = ("tpu", "axon")
+
 # Peak HBM bandwidth by TPU generation, GB/s (public figures; used only
 # for the utilization ratio on real chips).
 _PEAK_GBPS = {
@@ -130,7 +135,7 @@ def bench_device(a_np: np.ndarray, b_np: np.ndarray):
     expect = int(np.asarray(bm.popcount_and(a, b)))
     qps_by_engine = {"xla": timed_qps(bm.popcount_and)}
 
-    if platform in ("tpu", "axon"):
+    if platform in _CHIP_PLATFORMS:
         # A/B the Pallas single-pass kernel against XLA's fused
         # AND+popcount on the real chip — both are exact; the headline
         # takes the winner and the artifact records both so a relay
@@ -211,7 +216,7 @@ def bench_cpu_baseline(a: np.ndarray, b: np.ndarray) -> tuple[float, int]:
 
 
 def _peak_gbps(platform: str) -> float | None:
-    if platform not in ("tpu", "axon"):
+    if platform not in _CHIP_PLATFORMS:
         return None
     import jax
 
@@ -219,6 +224,30 @@ def _peak_gbps(platform: str) -> float | None:
     for gen, peak in _PEAK_GBPS.items():
         if gen in kind:
             return peak
+    return None
+
+
+def _last_chip_capture():
+    """The newest committed on-chip bench capture, or None.  Attached
+    (clearly labeled) when THIS run had to fall back to the CPU host,
+    so a round-end artifact taken during a relay outage still points
+    at the repo's real chip evidence instead of reading as a
+    regression.  Never substitutes for the current run's numbers."""
+    import glob
+    import os
+
+    caps = sorted(glob.glob(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tools", "tpu_captures", "bench_*.json")))
+    for path in reversed(caps):
+        try:
+            with open(path) as fh:
+                rec = json.loads(fh.read().strip())
+        except (OSError, ValueError):
+            continue
+        if rec.get("platform") in _CHIP_PLATFORMS:
+            rec["captured"] = os.path.basename(path)[6:-5]
+            return rec
     return None
 
 
@@ -231,6 +260,8 @@ def main():
     bytes_per_query = a.nbytes + b.nbytes  # streamed once per query
     achieved_gbps = dev_qps * bytes_per_query / 1e9
     peak = _peak_gbps(platform)
+    chip = (None if platform in _CHIP_PLATFORMS
+            else _last_chip_capture())
     print(json.dumps({
         "metric": "intersect_count_qps_268M_cols",
         "value": round(dev_qps, 2),
@@ -243,6 +274,7 @@ def main():
         "bw_util": None if peak is None else round(achieved_gbps / peak, 3),
         "engines": {k: round(v, 2) if isinstance(v, float) else v
                     for k, v in qps_by_engine.items()},
+        **({"last_chip_capture": chip} if chip else {}),
     }))
 
 
