@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: test test-paranoia test-shard22 test-matrix bench measure validate-tpu soak check clean
+.PHONY: test test-paranoia test-shard22 test-matrix bench measure validate-tpu soak soak-spmd check clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -41,6 +41,12 @@ validate-tpu:
 SOAK_SECONDS ?= 300
 soak:
 	$(PY) tools/soak.py --seconds $(SOAK_SECONDS)
+
+# multi-process collective-plane soak (usage: make soak-spmd
+# SOAK_SECONDS=600 SOAK_PROCS=2)
+SOAK_PROCS ?= 2
+soak-spmd:
+	$(PY) tools/soak_spmd.py --seconds $(SOAK_SECONDS) --procs $(SOAK_PROCS)
 
 # offline data-dir integrity (usage: make check DIR=/path/to/data)
 check:
