@@ -112,24 +112,63 @@ def bench_device(a_np: np.ndarray, b_np: np.ndarray):
     a = jax.device_put(a_np)
     b = jax.device_put(b_np)
 
+    # Pre-stage N_VARIANTS distinct left operands (low bits XOR'd with the
+    # variant id — same byte volume, near-identical density, different
+    # count) and precompute each expected count on the host.  Timing with
+    # DISTINCT inputs matters twice over: (1) a serving process never
+    # re-answers one literal query back-to-back, and (2) the execution
+    # path may memoize an identical (executable, args) dispatch — measured
+    # on the axon relay, an identical-input loop reports >1 TB/s on an
+    # 819 GB/s part, i.e. the work provably did not re-run.  Rotating
+    # variants keeps every iteration a real HBM-streaming execution.
+    N_VARIANTS = 16
+    a_vars_np = [a_np ^ np.uint32(i) for i in range(N_VARIANTS)]
+    expects = [int(np.bitwise_count(v & b_np).sum(dtype=np.uint64))
+               for v in a_vars_np]
+    a_vars = [jax.device_put(v) for v in a_vars_np]
+    jax.block_until_ready(a_vars)
+
+    check_rng = np.random.default_rng(7)
+
     def timed_qps(fn) -> float:
-        # Closed-loop QPS: each iteration is one full query over all
-        # shards; re-time with more iterations if clock resolution
-        # dominates (fast devices finish 50 queries in <0.2s).
-        iters = 50
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn(a, b)
-        out.block_until_ready()
-        dt = time.perf_counter() - t0
-        if dt < 0.2:
-            iters = 500
-            t0 = time.perf_counter()
-            for _ in range(iters):
-                out = fn(a, b)
-            out.block_until_ready()
-            dt = time.perf_counter() - t0
-        return iters / dt
+        # Closed-loop QPS over rotating distinct queries: dispatches
+        # pipeline (block once at the end) as a serving process overlaps
+        # independent queries.  Correctness is checked two ways — each
+        # variant individually before timing, and a 32-query random
+        # sample of the timed window after it (per-result fetches cost
+        # ~10 ms each through the relay, so checking every one of
+        # thousands would dwarf the measurement; any systematic
+        # work-dropping still hits a sample of 32 with certainty) — so
+        # a run that got fast by skipping work fails loudly instead of
+        # recording a fantasy number.  Median of 3 repeats, >=200
+        # queries and >=0.3 s each, damps relay congestion spikes.
+        for i in range(N_VARIANTS):
+            got = int(np.asarray(fn(a_vars[i], b)))
+            if got != expects[i]:
+                raise AssertionError(
+                    f"variant {i} returned {got}, expected {expects[i]}")
+        reps = []
+        for _ in range(3):
+            iters = 200
+            while True:
+                outs = []
+                t0 = time.perf_counter()
+                for i in range(iters):
+                    outs.append(fn(a_vars[i % N_VARIANTS], b))
+                jax.block_until_ready(outs)
+                dt = time.perf_counter() - t0
+                if dt >= 0.3 or iters >= 3200:
+                    break
+                iters *= 4
+            for i in check_rng.choice(iters, size=32, replace=False):
+                got = int(np.asarray(outs[i]))
+                if got != expects[i % N_VARIANTS]:
+                    raise AssertionError(
+                        f"query {i} returned {got}, "
+                        f"expected {expects[i % N_VARIANTS]}")
+            reps.append(iters / dt)
+        reps.sort()
+        return reps[1]
 
     # Warm-up: compile + one execution.
     expect = int(np.asarray(bm.popcount_and(a, b)))
@@ -207,12 +246,19 @@ def bench_cpu_baseline(a: np.ndarray, b: np.ndarray) -> tuple[float, int]:
         return total
 
     expect = query()  # warm-up / page-in
-    iters = 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        query()
-    dt = time.perf_counter() - t0
-    return iters / dt, expect
+    # Best-of-3 minimum-duration loops: the baseline is the denominator
+    # of vs_baseline, so noise here swings the headline ratio harder
+    # than device noise does.  Taking the BEST repeat is deliberately
+    # conservative — it credits the CPU with its least-interrupted run.
+    best = 0.0
+    for _ in range(3):
+        iters = 0
+        t0 = time.perf_counter()
+        while iters < 3 or time.perf_counter() - t0 < 1.0:
+            query()
+            iters += 1
+        best = max(best, iters / (time.perf_counter() - t0))
+    return best, expect
 
 
 def _peak_gbps(platform: str) -> float | None:
@@ -260,6 +306,19 @@ def main():
     bytes_per_query = a.nbytes + b.nbytes  # streamed once per query
     achieved_gbps = dev_qps * bytes_per_query / 1e9
     peak = _peak_gbps(platform)
+    # Physics backstop: a memory-bound kernel cannot beat the HBM roof.
+    # The relay memoizes identical dispatches (see timed_qps); variant
+    # rotation defeats the observed back-to-back case, but a deeper
+    # (executable, args) cache would inflate QPS while every sampled
+    # count still verifies — so a >roof figure is flagged as a
+    # measurement fault in the artifact itself, never recorded as a
+    # clean number.
+    suspect = peak is not None and achieved_gbps > peak
+    if suspect:
+        print(f"bench: MEASUREMENT FAULT: achieved {achieved_gbps:.0f} "
+              f"GB/s exceeds the {peak:.0f} GB/s HBM roof — dispatches "
+              "were memoized, not executed; number is NOT trustworthy",
+              file=sys.stderr)
     chip = (None if platform in _CHIP_PLATFORMS
             else _last_chip_capture())
     print(json.dumps({
@@ -274,6 +333,7 @@ def main():
         "bw_util": None if peak is None else round(achieved_gbps / peak, 3),
         "engines": {k: round(v, 2) if isinstance(v, float) else v
                     for k, v in qps_by_engine.items()},
+        **({"suspect_memoized_dispatch": True} if suspect else {}),
         **({"last_chip_capture": chip} if chip else {}),
     }))
 

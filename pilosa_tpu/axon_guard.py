@@ -47,15 +47,30 @@ _CAPTURING_FLAG = os.path.join(
     "tools", "relay_watcher.capturing")
 
 
-def _relay_alive() -> bool:
+def _axon_registered() -> bool:
+    """True when the axon PJRT backend factory is registered (the site
+    hook ran at interpreter start).  Never triggers backend init."""
+    try:
+        from jax._src import xla_bridge as xb
+
+        return any("axon" in n
+                   for n in getattr(xb, "_backend_factories", {}))
+    except Exception:
+        return False
+
+
+def _relay_alive() -> bool | None:
+    """True/False when pgrep answered; None when the CHECK ITSELF
+    failed (pgrep missing/timed out) — callers that take destructive
+    action on "dead" must treat None as unknown, not as dead."""
     try:
         out = subprocess.run(["pgrep", "-f", r"\.relay\.py"],
                              capture_output=True, timeout=5)
         return bool(out.stdout.strip())
     except Exception as e:
-        print(f"axon_guard: pgrep failed ({e}); assuming relay dead",
+        print(f"axon_guard: pgrep failed ({e}); relay state unknown",
               file=sys.stderr)
-        return False
+        return None
 
 
 def tunnel_responsive(timeout_s: float = _PROBE_TIMEOUT_S,
@@ -155,6 +170,29 @@ def _wait_out_capture() -> bool:
     return not os.path.exists(_CAPTURING_FLAG)
 
 
+def scrub_axon_backend() -> None:
+    """Deregister the axon PJRT backend factory before first backend
+    init.  With the relay PROCESS gone (not merely a wedged tunnel),
+    plugin discovery hangs inside ``jax.devices()`` even when jax is
+    pinned to cpu (observed round 3: ``JAX_PLATFORMS=cpu python -c
+    'import jax; jax.devices()'`` never returns once the relay pid is
+    gone, while the same command completes instantly with the plugin
+    env unset).  Pinning the platform is not enough — the factory must
+    go.  Private-API access is deliberate and fenced: on a jax upgrade
+    this degrades to the documented hang plus a loud stderr line, never
+    a new failure mode.  No-op after backends are initialized."""
+    try:
+        from jax._src import xla_bridge as xb
+
+        for name in list(getattr(xb, "_backend_factories", {})):
+            if "axon" in name:
+                xb._backend_factories.pop(name, None)
+    except Exception as e:  # noqa: BLE001 — degrade loudly, not fatally
+        print(f"axon_guard: could not deregister axon backend "
+              f"({type(e).__name__}: {e}); backend init may hang",
+              file=sys.stderr)
+
+
 def guard_dead_relay(wait_s: float = 0.0) -> bool:
     """When this process targets the axon backend but the relay is
     gone — process dead OR tunnel unresponsive end-to-end — pin jax to
@@ -167,6 +205,28 @@ def guard_dead_relay(wait_s: float = 0.0) -> bool:
     giving up — benchmark entry points use this so a briefly-restarting
     relay still yields a chip number instead of a CPU fallback."""
     if os.environ.get("JAX_PLATFORMS") != "axon":
+        # Not targeting axon — but a REGISTERED axon plugin whose relay
+        # process is dead still hangs backend init for ANY platform pin
+        # (the discovery path blocks before the filter applies).  A dead
+        # relay means no accelerator is being hidden, so scrubbing here
+        # is always safe; a live relay never hangs init, so leave it.
+        # Scrub only on a CONFIRMED-dead relay (pgrep answered "no
+        # process") — a failed check (None) must never demote a live
+        # accelerator to CPU.
+        if _axon_registered() and _relay_alive() is False:
+            print("axon_guard: axon plugin registered but relay process "
+                  "is dead; deregistering it so backend init cannot hang",
+                  file=sys.stderr)
+            scrub_axon_backend()
+            # The site hook's register() also PINS jax_platforms config
+            # to "axon,cpu" (config beats the env var), so honor the
+            # caller's env choice minus the dead axon entry.
+            import jax
+
+            want = [p for p in
+                    (os.environ.get("JAX_PLATFORMS") or "cpu").split(",")
+                    if p and p != "axon"]
+            jax.config.update("jax_platforms", ",".join(want) or "cpu")
         return False
 
     deadline = time.monotonic() + wait_s
@@ -177,6 +237,12 @@ def guard_dead_relay(wait_s: float = 0.0) -> bool:
               file=sys.stderr)
         time.sleep(min(5.0, max(remaining, 0.1)))
         alive = _relay_alive()
+    if alive is None:
+        # The CHECK failed (pgrep missing/slow) — the relay may well be
+        # healthy, so let the end-to-end probe decide rather than
+        # demoting a live chip to CPU on a process-listing hiccup.
+        # A truly dead relay costs one probe deadline here.
+        alive = True
     if alive:
         if not _wait_out_capture():
             print("axon_guard: relay watcher capture still holds the "
@@ -200,4 +266,5 @@ def guard_dead_relay(wait_s: float = 0.0) -> bool:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    scrub_axon_backend()
     return True

@@ -74,6 +74,15 @@ def main(argv: list[str] | None = None) -> int:
     pcfg.add_argument("-c", "--config", help="TOML config file")
 
     args = p.parse_args(argv)
+    if args.command in ("server", "import", "check", "inspect"):
+        # These touch jax (directly or via bitmap/host_mode device
+        # enumeration); on an axon host whose relay died, backend init
+        # would hang even pinned to cpu (axon_guard.scrub_axon_backend).
+        # Guard AFTER parsing so --help/config/export (pure HTTP) never
+        # pay a tunnel probe.
+        from pilosa_tpu.axon_guard import guard_dead_relay
+
+        guard_dead_relay()
     return {
         "server": cmd_server,
         "import": cmd_import,

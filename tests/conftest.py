@@ -27,6 +27,14 @@ jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Tests are CPU-only by design, but a pinned platform is not enough when
+# the axon relay PROCESS is dead: PJRT plugin discovery then hangs
+# backend init outright (even JAX_PLATFORMS=cpu).  Deregister the axon
+# factory so the whole suite cannot hang on a relay outage.
+from pilosa_tpu.axon_guard import scrub_axon_backend  # noqa: E402
+
+scrub_axon_backend()
+
 import pytest  # noqa: E402
 
 
