@@ -36,6 +36,17 @@ def main() -> int:
 
     dev = jax.devices()[0]
     if dev.platform not in ("tpu", "axon"):
+        # Never clobber a real chip result with a skip: a CPU-fallback
+        # run during a relay outage must leave the last on-chip
+        # validation in place (it is the committed evidence).
+        try:
+            prior = json.load(open(OUT))
+        except (OSError, ValueError):
+            prior = None
+        if prior and prior.get("status") == "ran":
+            print(f"skipped: platform={dev.platform}; keeping prior "
+                  f"on-chip result ({prior.get('device_kind')})")
+            return 0
         json.dump({"status": "skipped",
                    "reason": f"no TPU (platform={dev.platform})"},
                   open(OUT, "w"), indent=1)
@@ -92,6 +103,92 @@ def main() -> int:
     check("count_and", _count_and)
     check("bsi_compare_unsigned", _bsi_compare)
     check("masked_matrix_counts", _mmc)
+
+    # --- per-kernel Pallas-vs-XLA timing at executor-realistic shapes —
+    # the evidence that decides pallas_kernels.pallas_enabled defaults.
+    # All operands are GENERATED ON DEVICE (jax.random.bits): the axon
+    # tunnel moves host->device data at ~MB/s and wedges on big pushes,
+    # so a timing pass must never stream operands through it.  Timing
+    # rotates 8 distinct variants through a pipelined loop (block once),
+    # median of 3 repeats — identical-dispatch loops are memoized
+    # behind the relay and report fantasy numbers (see bench.py).
+    import time
+
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    from pilosa_tpu.ops import bitmap as bm
+
+    def timed_us(fn, variants, min_iters=16):
+        outs = [fn(*v) for v in variants]
+        jax.block_until_ready(outs)  # compile + warm every variant
+        meds = []
+        for _ in range(3):
+            iters = max(min_iters, len(variants))
+            t0 = time.perf_counter()
+            outs = [fn(*variants[i % len(variants)])
+                    for i in range(iters)]
+            jax.block_until_ready(outs)
+            meds.append((time.perf_counter() - t0) / iters)
+        meds.sort()
+        return meds[1] * 1e6
+
+    def dvars(key, *shape, n=8):
+        ks = jr.split(jr.PRNGKey(key), n)
+        return [jr.bits(k, shape, dtype=jnp.uint32) for k in ks]
+
+    def ab(name, pallas_fn, xla_fn, variants):
+        if not results.get(name, {}).get("ok"):
+            return
+        try:
+            p_us = timed_us(pallas_fn, variants)
+            x_us = timed_us(xla_fn, variants)
+            results[name]["perf"] = {
+                "pallas_us": round(p_us, 1),
+                "xla_us": round(x_us, 1),
+                "winner": "pallas" if p_us < x_us else "xla",
+            }
+            print(f"PERF {name}: pallas {p_us:.0f} us vs xla "
+                  f"{x_us:.0f} us -> {results[name]['perf']['winner']}")
+        except Exception as e:  # noqa: BLE001 — perf is best-effort
+            results[name]["perf"] = {"error": f"{type(e).__name__}: {e}"}
+            print(f"PERF {name} failed: {e}")
+
+    W = 32768  # one 2^20-column shard in uint32 words
+    filt = dvars(99, W, n=1)[0]
+    masks = dvars(98, 32, W, n=1)[0]
+    planes_depth = 21
+
+    ab("row_counts_masked",
+       lambda m: pk._row_counts_masked_pallas(m, filt),
+       lambda m: bm.row_counts_masked(m, filt),
+       [(v,) for v in dvars(1, 512, W)])
+    # count_and at the bench shape (256 shards' worth of words) — the
+    # north-star op streams the full stacked operand pair
+    b_flat = dvars(97, 256 * W, n=1)[0]
+    ab("count_and",
+       lambda a: pk._count_and_pallas(a, b_flat),
+       lambda a: bm.popcount_and(a, b_flat),
+       [(v,) for v in dvars(2, 256 * W)])
+    # call the private kernel, NOT the public dispatcher — the
+    # dispatcher consults pallas_enabled()/on_tpu(), so with the knob
+    # off both legs would silently time XLA and record a meaningless
+    # "winner" in the committed evidence
+    pred_masks = jnp.asarray(np.array(
+        [[0xFFFFFFFF if (123456 >> i) & 1 else 0]
+         for i in range(planes_depth)], dtype=np.uint32))
+    ab("bsi_compare_unsigned",
+       lambda p: pk._bsi_compare_pallas(p, filt, pred_masks,
+                                        planes_depth),
+       lambda p: pk._bsi_compare_jnp(p, filt, 123456, planes_depth),
+       [(v,) for v in dvars(3, 2 + planes_depth, W)])
+    mmc_xla = jax.jit(lambda mm: jnp.sum(
+        jax.lax.population_count(mm[None, :, :] & masks[:, None, :]),
+        axis=-1, dtype=jnp.int32))
+    ab("masked_matrix_counts",
+       lambda m: pk._mmc_pallas(m, masks),
+       mmc_xla,
+       [(v,) for v in dvars(4, 512, W)])
 
     payload = {
         "status": "ran",

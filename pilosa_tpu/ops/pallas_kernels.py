@@ -45,6 +45,34 @@ def on_tpu() -> bool:
         return False
 
 
+def pallas_enabled() -> bool:
+    """Operator gate for the on-TPU Pallas routing:
+    PILOSA_TPU_PALLAS=0/off disables it (the escape hatch for a Mosaic
+    regression in a new toolchain); any other value (or unset) leaves
+    it enabled.  The knob only matters ON a TPU — off-chip the XLA
+    path always runs, because Mosaic kernels need a TPU (tests reach
+    them via interpret=True).  benchmarks/validate_tpu.py records
+    per-kernel pallas-vs-XLA chip timings so the default tracks
+    evidence, not hope."""
+    import os
+
+    v = os.environ.get("PILOSA_TPU_PALLAS", "auto").lower()
+    return v not in ("0", "off", "false", "no")
+
+
+def _use_pallas(interpret: bool, elems: int, floor: int = 1 << 16) -> bool:
+    """The single routing gate every dispatcher shares: interpret mode
+    always exercises the kernel (how CPU tests reach it); below
+    ``floor`` elements launch overhead dominates so XLA always runs;
+    otherwise Pallas runs exactly when on a TPU with the operator knob
+    enabled."""
+    if interpret:
+        return True
+    if elems < floor:
+        return False
+    return on_tpu() and pallas_enabled()
+
+
 def _pad_to(x: jnp.ndarray, axis: int, multiple: int):
     size = x.shape[axis]
     rem = (-size) % multiple
@@ -100,7 +128,7 @@ def row_counts_masked(mat, filt, interpret: bool = False):
     from pilosa_tpu.ops import bitmap as bm
 
     R, W = mat.shape
-    if (interpret or on_tpu()) and R * W >= 1 << 16:
+    if _use_pallas(interpret, R * W):
         return _row_counts_masked_pallas(mat, jnp.asarray(filt),
                                          interpret=interpret)
     return bm.row_counts_masked(mat, filt)
@@ -147,7 +175,7 @@ def count_and(a, b, interpret: bool = False):
     fusion elsewhere (roaring.IntersectionCount, roaring/roaring.go:570)."""
     from pilosa_tpu.ops import bitmap as bm
 
-    if (interpret or on_tpu()) and a.size >= 1 << 16:
+    if _use_pallas(interpret, a.size):
         return _count_and_pallas(jnp.asarray(a), jnp.asarray(b),
                                  interpret=interpret)
     return bm.popcount_and(a, b)
@@ -213,8 +241,8 @@ def masked_matrix_counts(mat, masks, interpret: bool = False):
 
     R, W = mat.shape
     G = masks.shape[0]
-    if ((interpret or on_tpu()) and not isinstance(mat, np.ndarray)
-            and G * R * W >= 1 << 18):
+    if (_use_pallas(interpret, G * R * W, floor=1 << 18)
+            and not isinstance(mat, np.ndarray)):
         return _mmc_pallas(jnp.asarray(mat), jnp.asarray(masks),
                            interpret=interpret)
     return bm.masked_matrix_counts(mat, masks)
@@ -299,7 +327,7 @@ def bsi_compare_unsigned(planes, filt, upred: int, depth: int,
         consider = jnp.asarray(planes[0]) & ~jnp.asarray(planes[1]) \
             & jnp.asarray(filt)
         return consider, jnp.zeros_like(consider)
-    if (interpret or on_tpu()) and planes.shape[1] >= 1 << 12:
+    if _use_pallas(interpret, planes.shape[1], floor=1 << 12):
         pred_masks = np.array(
             [[0xFFFFFFFF if (upred >> i) & 1 else 0]
              for i in range(depth)],

@@ -168,3 +168,29 @@ class TestMaskedMatrixCounts:
         masks = np.zeros((4, 128), dtype=np.uint32)
         got = np.asarray(pk._mmc_pallas(mat, masks, interpret=True))
         assert got.sum() == 0
+
+
+class TestRoutingGate:
+    """_use_pallas is the single routing gate all four dispatchers
+    share; PILOSA_TPU_PALLAS=0 is the operator escape hatch for a
+    Mosaic regression."""
+
+    def test_interpret_always_routes_to_pallas(self, monkeypatch):
+        monkeypatch.setattr(pk, "on_tpu", lambda: False)
+        assert pk._use_pallas(True, 1)
+
+    def test_small_shapes_stay_on_xla(self, monkeypatch):
+        monkeypatch.setattr(pk, "on_tpu", lambda: True)
+        assert not pk._use_pallas(False, (1 << 16) - 1)
+        assert pk._use_pallas(False, 1 << 16)
+
+    def test_off_tpu_always_xla(self, monkeypatch):
+        monkeypatch.setattr(pk, "on_tpu", lambda: False)
+        assert not pk._use_pallas(False, 1 << 30)
+
+    def test_knob_disables_on_tpu(self, monkeypatch):
+        monkeypatch.setattr(pk, "on_tpu", lambda: True)
+        monkeypatch.setenv("PILOSA_TPU_PALLAS", "0")
+        assert not pk._use_pallas(False, 1 << 30)
+        monkeypatch.setenv("PILOSA_TPU_PALLAS", "auto")
+        assert pk._use_pallas(False, 1 << 30)
