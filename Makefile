@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: test test-paranoia test-shard22 test-matrix bench measure validate-tpu soak soak-spmd check clean
+.PHONY: test test-paranoia test-shard22 test-matrix bench measure measure-resize measure-spmd validate-tpu soak soak-spmd check clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -32,6 +32,12 @@ measure:
 # elastic resize at 1.07B columns (join + leave, one JSON line each)
 measure-resize:
 	$(PY) benchmarks/measure_resize.py
+
+# collective vs scatter plane latency over real processes (usage:
+# make measure-spmd MEASURE_PROCS=2)
+MEASURE_PROCS ?= 2
+measure-spmd:
+	$(PY) benchmarks/measure_spmd.py --procs $(MEASURE_PROCS)
 
 # on-chip Pallas validation (no-op skip without a TPU)
 validate-tpu:

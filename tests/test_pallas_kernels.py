@@ -181,6 +181,8 @@ class TestRoutingGate:
 
     def test_small_shapes_stay_on_xla(self, monkeypatch):
         monkeypatch.setattr(pk, "on_tpu", lambda: True)
+        # isolate from an ambient operator escape hatch
+        monkeypatch.delenv("PILOSA_TPU_PALLAS", raising=False)
         assert not pk._use_pallas(False, (1 << 16) - 1)
         assert pk._use_pallas(False, 1 << 16)
 
