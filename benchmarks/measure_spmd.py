@@ -23,11 +23,9 @@ is an honest protocol-overhead envelope, not an ICI scaling claim
 Usage: python benchmarks/measure_spmd.py [--procs 2] [--reps 40]
 Prints one JSON line per (query, plane-pair) plus a summary line.
 
-NOTE: the fleet scaffolding (file barrier, join wait, dataset build,
-spawn/kill) deliberately mirrors tools/soak_spmd.py, whose copy is the
-canonical one (hours of committed soak evidence ran on it).  A change
-to either harness's barrier/fleet discipline must be mirrored in the
-other until the shared helper is extracted.
+The fleet scaffolding (file barrier, port allocation, spawn with
+kill-the-whole-fleet-on-timeout) is shared with tools/soak_spmd.py via
+tools/fleet_lib.py — change the discipline THERE, once.
 """
 
 from __future__ import annotations
@@ -35,11 +33,8 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import socket
-import subprocess
 import sys
 import tempfile
-import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -82,14 +77,11 @@ while len(srv.cluster.sorted_nodes()) < NPROC:
 spmd.verify_rank_convention(srv.cluster)
 
 
+from tools.fleet_lib import file_barrier
+
+
 def barrier(name, timeout=600):
-    open(f"{data}/{name}.{pid}", "w").write("1")
-    end = time.monotonic() + timeout
-    while not all(os.path.exists(f"{data}/{name}.{p}")
-                  for p in range(NPROC)):
-        if time.monotonic() > end:
-            raise SystemExit(f"barrier {name} timeout")
-        time.sleep(0.02)
+    file_barrier(data, name, pid, NPROC, timeout)
 
 
 # ---- deterministic dataset, identical in every process ----
@@ -233,16 +225,8 @@ if pid == 0:
 '''
 
 
-def _free_ports(n: int) -> list[int]:
-    socks, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        socks.append(s)
-        ports.append(s.getsockname()[1])
-    for s in socks:
-        s.close()
-    return ports
+sys.path.insert(0, REPO)
+from tools.fleet_lib import free_ports, run_fleet  # noqa: E402
 
 
 def main() -> int:
@@ -254,7 +238,7 @@ def main() -> int:
 
     n = args.procs
     with tempfile.TemporaryDirectory() as data:
-        coord_port, *http_ports = _free_ports(1 + n)
+        coord_port, *http_ports = free_ports(1 + n)
         env = {
             **os.environ,
             "JAX_PLATFORMS": "cpu",
@@ -277,34 +261,11 @@ def main() -> int:
         }
         for i, p in enumerate(http_ports):
             env[f"T_PORT{i}"] = str(p)
-        procs = []
-        for i in range(n):
-            procs.append(subprocess.Popen(
-                [sys.executable, "-u", "-c", WORKER],
-                env={**env, "JAX_PROCESS_ID": str(i)},
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-                text=True, cwd=REPO))
-        try:
-            outs = [p.communicate(timeout=900)[0] for p in procs]
-        except subprocess.TimeoutExpired:
-            # one worker dying (e.g. a cross-check assertion on the
-            # coordinator) leaves the others parked in a lockstep
-            # collective — kill the whole fleet so the failure is fast
-            # and no orphan holds the coordinator/HTTP ports
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-            outs = [(p.communicate()[0] or "") for p in procs]
-            sys.stderr.write("measure_spmd: TIMEOUT — fleet killed\n")
-            for i, out in enumerate(outs):
-                sys.stderr.write(f"--- worker {i} tail ---\n"
-                                 f"{out[-3000:]}\n")
-            return 1
-        ok = all(p.returncode == 0 for p in procs)
+        ok, outs = run_fleet(
+            [[sys.executable, "-u", "-c", WORKER] for _ in range(n)],
+            [{**env, "JAX_PROCESS_ID": str(i)} for i in range(n)],
+            timeout=900, label="measure_spmd", cwd=REPO)
         if not ok:
-            for i, (p, out) in enumerate(zip(procs, outs)):
-                sys.stderr.write(f"--- worker {i} (rc={p.returncode}) "
-                                 f"tail ---\n{out[-3000:]}\n")
             return 1
         for line in outs[0].splitlines():
             if line.startswith("RESULT "):

@@ -1,0 +1,100 @@
+"""Shared multi-process fleet scaffolding for the SPMD soak and the
+plane-latency measurement (tools/soak_spmd.py, benchmarks/
+measure_spmd.py).
+
+Both entry points boot N full-server workers inside one
+jax.distributed runtime and coordinate them over the CONTROL PLANE
+(files), never over jax collectives — a pending collective parks the
+local devices, and any peer progress that needs them (serving a
+scattered sub-query) deadlocks the join.  That barrier discipline
+lives here exactly once so a fix cannot drift between the two
+harnesses.
+
+Worker side: ``file_barrier``.  Parent side: ``free_ports`` and
+``run_fleet`` (spawn, bounded wait, kill-the-whole-fleet on timeout so
+a single dead worker becomes a fast failure instead of a half-hour
+hang plus orphaned coordinator/HTTP ports).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+
+def file_barrier(data_dir: str, name: str, pid: int, nproc: int,
+                 timeout: float = 300.0) -> None:
+    """Control-plane barrier: write my flag, wait for everyone's.
+    Timing out raises SystemExit — in a lockstep fleet a missing peer
+    is fatal, and exiting lets run_fleet's reaper surface it fast."""
+    open(f"{data_dir}/{name}.{pid}", "w").write("1")
+    end = time.monotonic() + timeout
+    while not all(os.path.exists(f"{data_dir}/{name}.{p}")
+                  for p in range(nproc)):
+        if time.monotonic() > end:
+            raise SystemExit(f"barrier {name} timeout")
+        time.sleep(0.02)
+
+
+def free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def run_fleet(argv_per_worker: list[list[str]], env_per_worker:
+              list[dict], timeout: float, label: str,
+              cwd: str | None = None) -> tuple[bool, list[str]]:
+    """Spawn one process per argv/env pair, wait ``timeout`` seconds
+    for ALL of them, and on timeout kill the WHOLE fleet (one worker
+    dying leaves the rest parked in a lockstep collective — the
+    failure must be fast and leak no coordinator/HTTP ports).
+
+    ``timeout`` bounds the WHOLE fleet (one shared deadline, not a
+    fresh allowance per worker).  Returns (ok, outputs); outputs
+    collected before a timeout are preserved (re-communicating a
+    finished process returns '', which would blank the very tails the
+    caller needs).  On any failure the tail of every worker's combined
+    stdout/stderr is written to stderr."""
+    procs = [subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              cwd=cwd)
+             for argv, env in zip(argv_per_worker, env_per_worker)]
+    deadline = time.monotonic() + timeout
+    outs: list[str] = []
+    timed_out = False
+    for p in procs:
+        try:
+            outs.append(p.communicate(
+                timeout=max(0.1, deadline - time.monotonic()))[0])
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            break
+    if timed_out:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        # collect the killed workers' (and any not-yet-waited) output
+        # without clobbering what finished workers already returned
+        for p in procs[len(outs):]:
+            outs.append(p.communicate()[0] or "")
+        sys.stderr.write(f"{label}: TIMEOUT — worker hung; fleet "
+                         "killed\n")
+        for i, out in enumerate(outs):
+            sys.stderr.write(f"--- worker {i} tail ---\n{out[-3000:]}\n")
+        return False, outs
+    ok = all(p.returncode == 0 for p in procs)
+    if not ok:
+        for i, (p, out) in enumerate(zip(procs, outs)):
+            sys.stderr.write(f"--- worker {i} (rc={p.returncode}) "
+                             f"tail ---\n{out[-3000:]}\n")
+    return ok, outs

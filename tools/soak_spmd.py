@@ -29,11 +29,13 @@ from __future__ import annotations
 import argparse
 import json
 import os
-import socket
-import subprocess
 import sys
 import tempfile
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import fleet_lib  # noqa: E402
 
 WORKER = r'''
 import json, os, random, sys, time
@@ -74,14 +76,11 @@ while len(srv.cluster.sorted_nodes()) < NPROC:
 spmd.verify_rank_convention(srv.cluster)
 
 
+from tools.fleet_lib import file_barrier
+
+
 def barrier(name, timeout=300):
-    open(f"{data}/{name}.{pid}", "w").write("1")
-    end = time.monotonic() + timeout
-    while not all(os.path.exists(f"{data}/{name}.{p}")
-                  for p in range(NPROC)):
-        if time.monotonic() > end:
-            raise SystemExit(f"barrier {name} timeout")
-        time.sleep(0.02)
+    file_barrier(data, name, pid, NPROC, timeout)
 
 
 # ---- deterministic base dataset (identical in every process) ----
@@ -353,14 +352,7 @@ def main() -> int:
     args = ap.parse_args()
 
     tmp = tempfile.mkdtemp(prefix="soak_spmd_")
-    socks = [socket.socket() for _ in range(1 + args.procs)]
-    try:
-        for s in socks:
-            s.bind(("127.0.0.1", 0))
-        coord_port, *node_ports = (s.getsockname()[1] for s in socks)
-    finally:
-        for s in socks:
-            s.close()
+    coord_port, *node_ports = fleet_lib.free_ports(1 + args.procs)
 
     worker = os.path.join(tmp, "worker.py")
     with open(worker, "w") as f:
@@ -378,30 +370,18 @@ def main() -> int:
         **{f"T_PORT{i}": str(p) for i, p in enumerate(node_ports)},
     )
     t0 = time.time()
-    procs = []
-    for pid in range(args.procs):
-        e = dict(env, JAX_PROCESS_ID=str(pid))
-        procs.append(subprocess.Popen(
-            [sys.executable, worker], env=e, stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT, text=True))
-    try:
-        outs = [p.communicate(timeout=args.seconds + 900)[0]
-                for p in procs]
-    except subprocess.TimeoutExpired:
-        # a hung worker is exactly what this soak hunts — kill the
-        # whole fleet so reruns never fight orphaned servers/ports
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-        outs = [(p.communicate()[0] or "") for p in procs]
-        sys.stderr.write("soak_spmd: TIMEOUT — worker hung; fleet "
-                         "killed\n")
-        for i, out in enumerate(outs):
-            sys.stderr.write(f"--- worker {i} tail ---\n{out[-3000:]}\n")
+    # a hung worker is exactly what this soak hunts — run_fleet kills
+    # the whole fleet on timeout so reruns never fight orphaned
+    # servers/ports
+    ok, outs = fleet_lib.run_fleet(
+        [[sys.executable, worker] for _ in range(args.procs)],
+        [dict(env, JAX_PROCESS_ID=str(pid))
+         for pid in range(args.procs)],
+        timeout=args.seconds + 900, label="soak_spmd")
+    if not ok and not any("RESULT " in out for out in outs):
         print(json.dumps({"ok": False, "reason": "worker hang/timeout",
                           "procs": args.procs, "seed": args.seed}))
         return 1
-    ok = all(p.returncode == 0 for p in procs)
     results = [ln for out in outs for ln in out.splitlines()
                if ln.startswith("RESULT ")]
     summary = {"ok": ok, "procs": args.procs,
@@ -417,10 +397,7 @@ def main() -> int:
                              "collective_queries_checked",
                              "plane_xchecks")})
             summary["counters"] = coord["counters"]
-    if not ok:
-        for i, out in enumerate(outs):
-            sys.stderr.write(f"--- worker {i} (rc={procs[i].returncode}) "
-                             f"tail ---\n{out[-3000:]}\n")
+    # run_fleet already wrote every worker's tail to stderr on failure
     print(json.dumps(summary))
     return 0 if ok else 1
 
