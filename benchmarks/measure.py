@@ -325,6 +325,20 @@ def main():
                 t0 = _now()
                 got = ex.execute("northstar", q_ns)[0]
                 lat.append((_now() - t0) * 1e3)
+            # TopN p50 at the north-star scale (BASELINE.json tracks
+            # it alongside the count): the full stacked row scan over
+            # the same warm 10B-column stacks, exact counts asserted
+            tn_lat = []
+            for _ in range(3):
+                t0 = _now()
+                pairs = ex.execute("northstar", "TopN(f)")[0]
+                tn_lat.append((_now() - t0) * 1e3)
+            got_tn = [(p.id, p.count) for p in pairs]
+            want_tn = sorted(
+                ((r, len(nbits[r])) for r in (0, 1)),
+                key=lambda rc: (-rc[1], rc[0]))
+            assert got_tn == want_tn, \
+                f"10B TopN mismatch: {got_tn} != {want_tn}"
             # documented floor: evict the row stacks and pay the full
             # assembly on a quiet system (no compaction running) — what
             # a query sees if eviction or a disabled prewarm leaves it
@@ -353,6 +367,7 @@ def main():
                         "cold_ms": round(cold_ms, 1),
                         "prewarm_s": round(prewarm_s, 1),
                         "cold_floor_no_prewarm_ms": round(floor_ms, 1),
+                        "topn_p50_ms": round(statistics.median(tn_lat), 1),
                         "import_s": round(import_s, 1), "exact": True})
             holder.delete_index("northstar")
         finally:

@@ -261,7 +261,7 @@ def main() -> int:
         }
         for i, p in enumerate(http_ports):
             env[f"T_PORT{i}"] = str(p)
-        ok, outs = run_fleet(
+        ok, outs, _timed_out = run_fleet(
             [[sys.executable, "-u", "-c", WORKER] for _ in range(n)],
             [{**env, "JAX_PROCESS_ID": str(i)} for i in range(n)],
             timeout=900, label="measure_spmd", cwd=REPO)

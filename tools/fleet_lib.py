@@ -53,17 +53,19 @@ def free_ports(n: int) -> list[int]:
 
 def run_fleet(argv_per_worker: list[list[str]], env_per_worker:
               list[dict], timeout: float, label: str,
-              cwd: str | None = None) -> tuple[bool, list[str]]:
+              cwd: str | None = None) -> tuple[bool, list[str], bool]:
     """Spawn one process per argv/env pair, wait ``timeout`` seconds
     for ALL of them, and on timeout kill the WHOLE fleet (one worker
     dying leaves the rest parked in a lockstep collective — the
     failure must be fast and leak no coordinator/HTTP ports).
 
     ``timeout`` bounds the WHOLE fleet (one shared deadline, not a
-    fresh allowance per worker).  Returns (ok, outputs); outputs
-    collected before a timeout are preserved (re-communicating a
-    finished process returns '', which would blank the very tails the
-    caller needs).  On any failure the tail of every worker's combined
+    fresh allowance per worker).  Returns (ok, outputs, timed_out) —
+    ``timed_out`` distinguishes a genuine hang from a fast worker
+    crash so callers classify failures correctly.  Outputs collected
+    before a timeout are preserved (re-communicating a finished
+    process returns '', which would blank the very tails the caller
+    needs).  On any failure the tail of every worker's combined
     stdout/stderr is written to stderr."""
     procs = [subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True,
@@ -91,10 +93,10 @@ def run_fleet(argv_per_worker: list[list[str]], env_per_worker:
                          "killed\n")
         for i, out in enumerate(outs):
             sys.stderr.write(f"--- worker {i} tail ---\n{out[-3000:]}\n")
-        return False, outs
+        return False, outs, True
     ok = all(p.returncode == 0 for p in procs)
     if not ok:
         for i, (p, out) in enumerate(zip(procs, outs)):
             sys.stderr.write(f"--- worker {i} (rc={p.returncode}) "
                              f"tail ---\n{out[-3000:]}\n")
-    return ok, outs
+    return ok, outs, False

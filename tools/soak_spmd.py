@@ -373,12 +373,15 @@ def main() -> int:
     # a hung worker is exactly what this soak hunts — run_fleet kills
     # the whole fleet on timeout so reruns never fight orphaned
     # servers/ports
-    ok, outs = fleet_lib.run_fleet(
+    ok, outs, timed_out = fleet_lib.run_fleet(
         [[sys.executable, worker] for _ in range(args.procs)],
         [dict(env, JAX_PROCESS_ID=str(pid))
          for pid in range(args.procs)],
         timeout=args.seconds + 900, label="soak_spmd")
-    if not ok and not any("RESULT " in out for out in outs):
+    if timed_out:
+        # a genuine hang — exactly what this soak hunts; crashes
+        # (rc!=0 without a hang) fall through to the normal summary so
+        # triage chases the right thing
         print(json.dumps({"ok": False, "reason": "worker hang/timeout",
                           "procs": args.procs, "seed": args.seed}))
         return 1
