@@ -337,8 +337,9 @@ def main():
             want_tn = sorted(
                 ((r, len(nbits[r])) for r in (0, 1)),
                 key=lambda rc: (-rc[1], rc[0]))
-            assert got_tn == want_tn, \
-                f"10B TopN mismatch: {got_tn} != {want_tn}"
+            # verified in the else-branch below, where a mismatch
+            # becomes a loud correctness_failure record instead of an
+            # AssertionError that kills the whole sweep
             # documented floor: evict the row stacks and pay the full
             # assembly on a quiet system (no compaction running) — what
             # a query sees if eviction or a disabled prewarm leaves it
@@ -355,20 +356,36 @@ def main():
                         "skipped": True, "reason": str(e)})
             holder.delete_index("northstar")
         else:
+            # A correctness mismatch must be LOUD but must not kill the
+            # sweep: configs 1-2's collected numbers and configs 3-5
+            # still have to reach the artifact, and the ~2.5 GB index
+            # still has to be deleted — so the violation becomes an
+            # explicit correctness_failure record, never a dead run
+            # (the same doctrine as the skip records above).
             want = len(nbits[0] & nbits[1])
-            assert got == want, f"north-star mismatch: {got} != {want}"
-            assert got_floor == want, \
-                f"floor mismatch: {got_floor} != {want}"
-            out.append({"config": 2,
-                        "metric": "intersect_count_p50_ms_10B_cols",
-                        "value": round(statistics.median(lat), 1),
-                        "unit": "ms",
-                        "cols": ns_cols, "shards": ns_shards,
-                        "cold_ms": round(cold_ms, 1),
-                        "prewarm_s": round(prewarm_s, 1),
-                        "cold_floor_no_prewarm_ms": round(floor_ms, 1),
-                        "topn_p50_ms": round(statistics.median(tn_lat), 1),
-                        "import_s": round(import_s, 1), "exact": True})
+            failures = []
+            if got != want:
+                failures.append(f"north-star count {got} != {want}")
+            if got_floor != want:
+                failures.append(f"floor count {got_floor} != {want}")
+            if got_tn != want_tn:
+                failures.append(f"TopN {got_tn} != {want_tn}")
+            if failures:
+                out.append({"config": 2,
+                            "metric": "intersect_count_p50_ms_10B_cols",
+                            "correctness_failure": "; ".join(failures)})
+            else:
+                out.append({
+                    "config": 2,
+                    "metric": "intersect_count_p50_ms_10B_cols",
+                    "value": round(statistics.median(lat), 1),
+                    "unit": "ms",
+                    "cols": ns_cols, "shards": ns_shards,
+                    "cold_ms": round(cold_ms, 1),
+                    "prewarm_s": round(prewarm_s, 1),
+                    "cold_floor_no_prewarm_ms": round(floor_ms, 1),
+                    "topn_p50_ms": round(statistics.median(tn_lat), 1),
+                    "import_s": round(import_s, 1), "exact": True})
             holder.delete_index("northstar")
         finally:
             mgr10.budget = old10
