@@ -391,15 +391,21 @@ def main() -> int:
                "wall_s": round(time.time() - t0, 1),
                "seed": args.seed}
     if ok and results:
-        coord = next((json.loads(r[7:]) for r in results
-                      if json.loads(r[7:])["writes_applied"] is not None),
-                     None)
+        parsed = [json.loads(r[7:]) for r in results]
+        coord = next((p for p in parsed
+                      if p["writes_applied"] is not None), None)
         if coord:
             summary.update({k: coord[k] for k in
                             ("rounds", "writes_applied",
                              "collective_queries_checked",
                              "plane_xchecks")})
-            summary["counters"] = coord["counters"]
+            # counters summed ACROSS workers: "joined" only ever
+            # increments on peers (the coordinator initiates), so the
+            # coordinator's counters alone would always read joined=0
+            # and make the evidence look like nothing ever joined
+            summary["counters"] = {
+                k: sum(p["counters"].get(k, 0) for p in parsed)
+                for k in coord["counters"]}
     # run_fleet already wrote every worker's tail to stderr on failure
     print(json.dumps(summary))
     return 0 if ok else 1
