@@ -467,6 +467,77 @@ def main():
     spread5 = timed_qps_spread(lambda: post("/index/c/query", q5))
     out.append({"config": 5, "metric": "cluster3_count_qps_http",
                 "unit": "qps", **spread5})
+
+    # ---- config 6: write path — single-Set latency and bulk-import
+    # throughput (the reference's headline ingest paths: executeSet,
+    # executor.go:2067, and fragment.bulkImport, fragment.go:1997).
+    # Reuses the 3-node cluster: every Set replicates synchronously to
+    # all shard owners, so this measures the real write pipeline (WAL
+    # append + replica POST), not a single-map update.
+    rng6 = random.Random(6)
+    set_lat = []
+    for i in range(300):
+        col = rng6.randrange(9 * SHARD_WIDTH)
+        q = {"query": f"Set({col}, f={100 + (i % 8)})"}
+        t0 = _now()
+        post("/index/c/query", q)
+        set_lat.append((_now() - t0) * 1e3)
+    set_lat.sort()
+    out.append({"config": 6, "metric": "set_write_p50_ms_replicated",
+                "value": round(set_lat[len(set_lat) // 2], 2),
+                "unit": "ms",
+                "p95_ms": round(set_lat[int(len(set_lat) * 0.95)], 2),
+                "writes": len(set_lat)})
+
+    n_bits = 2_000_000
+    rows6 = [rng6.randrange(64) for _ in range(n_bits)]
+    cols6 = [rng6.randrange(9 * SHARD_WIDTH) for _ in range(n_bits)]
+    t0 = _now()
+    post("/index/c/field/f/import", {"rowIDs": rows6,
+                                     "columnIDs": cols6})
+    dt = _now() - t0
+    got6 = post("/index/c/query",
+                {"query": "Count(Union(" + ", ".join(
+                    f"Row(f={r})" for r in range(8)) + "))"})["results"][0]
+    # exact oracle over everything this sweep put into rows 0-7: the
+    # config-5 import plus this bulk import (Set() wrote rows 100-107)
+    want6_set = ({c for r, c in zip(rows, cols) if r < 8}
+                 | {c for r, c in zip(rows6, cols6) if r < 8})
+    want6 = len(want6_set)
+    rec6 = {"config": 6, "metric": "bulk_import_mbits_per_s_json",
+            "value": round(n_bits / dt / 1e6, 2),
+            "unit": "Mbits/s", "bits": n_bits,
+            "wall_s": round(dt, 1), "exact": got6 == want6}
+    if got6 != want6:
+        rec6["correctness_failure"] = f"union count {got6} != {want6}"
+    out.append(rec6)
+
+    # Same bulk import over the protobuf wire form (the reference's
+    # CSV importer posts ImportRequest protobufs, ctl/import.go:34-350;
+    # the JSON figure above is dominated by 2M-element JSON encoding)
+    from pilosa_tpu import proto as _proto
+
+    rows6b = [rng6.randrange(64) for _ in range(n_bits)]
+    cols6b = [rng6.randrange(9 * SHARD_WIDTH) for _ in range(n_bits)]
+    body6 = _proto.encode(_proto.IMPORT_REQUEST, {
+        "index": "c", "field": "f", "shard": 0,
+        "rowIDs": rows6b, "columnIDs": cols6b})
+    t0 = _now()
+    client._request(
+        "POST", s0.uri + "/index/c/field/f/import", body6,
+        ctype="application/x-protobuf")
+    dtb = _now() - t0
+    got6b = post("/index/c/query",
+                 {"query": "Count(Union(" + ", ".join(
+                     f"Row(f={r})" for r in range(8)) + "))"})["results"][0]
+    want6b = len(want6_set | {c for r, c in zip(rows6b, cols6b) if r < 8})
+    rec6b = {"config": 6, "metric": "bulk_import_mbits_per_s_proto",
+             "value": round(n_bits / dtb / 1e6, 2),
+             "unit": "Mbits/s", "bits": n_bits,
+             "wall_s": round(dtb, 1), "exact": got6b == want6b}
+    if got6b != want6b:
+        rec6b["correctness_failure"] = f"union count {got6b} != {want6b}"
+    out.append(rec6b)
     client.close()
     s0.close(); s1.close(); s2.close()
 

@@ -942,17 +942,38 @@ class Field:
                 self.set_bit(r, c, ts)
             return
         # (view, shard) -> positions
-        by_frag: dict[tuple[str, int], list[int]] = {}
+        by_frag: dict[tuple[str, int], "list[int] | np.ndarray"] = {}
         has_std = not (self.options.type == FieldType.TIME and self.options.no_standard_view)
-        for i, (r, c) in enumerate(zip(rows, cols)):
-            shard = c // SHARD_WIDTH
-            pos = r * SHARD_WIDTH + (c % SHARD_WIDTH)
-            if has_std:
-                by_frag.setdefault((VIEW_STANDARD, shard), []).append(pos)
-            ts = timestamps[i] if timestamps is not None else None
-            if ts is not None:
-                for name in views_by_time(VIEW_STANDARD, ts, self.time_quantum):
-                    by_frag.setdefault((name, shard), []).append(pos)
+        if timestamps is None and has_std:
+            # the common bulk path (no time expansion) groups in numpy:
+            # a per-bit setdefault/append loop costs ~1.5 s at 2M bits
+            # where one argsort + split costs ~0.1 s
+            cols_np = np.asarray(cols, dtype=np.int64)
+            rows_np = np.asarray(rows, dtype=np.int64)
+            if len(rows_np) and (rows_np.min() < 0 or cols_np.min() < 0):
+                # the pre-vectorization path rejected negatives at the
+                # uint64 conversion (OverflowError); int64 arithmetic
+                # would silently wrap them into phantom rows instead
+                raise ValueError("negative row or column id in import")
+            shard_np = cols_np // SHARD_WIDTH
+            pos_np = rows_np * SHARD_WIDTH + (cols_np % SHARD_WIDTH)
+            order = np.argsort(shard_np, kind="stable")
+            sh = shard_np[order]
+            ps = pos_np[order]
+            bounds = np.flatnonzero(np.diff(sh)) + 1
+            for s, chunk in zip(sh[np.concatenate(([0], bounds))] if len(sh)
+                                else [], np.split(ps, bounds)):
+                by_frag[(VIEW_STANDARD, int(s))] = chunk
+        else:
+            for i, (r, c) in enumerate(zip(rows, cols)):
+                shard = c // SHARD_WIDTH
+                pos = r * SHARD_WIDTH + (c % SHARD_WIDTH)
+                if has_std:
+                    by_frag.setdefault((VIEW_STANDARD, shard), []).append(pos)
+                ts = timestamps[i] if timestamps is not None else None
+                if ts is not None:
+                    for name in views_by_time(VIEW_STANDARD, ts, self.time_quantum):
+                        by_frag.setdefault((name, shard), []).append(pos)
         # one .shards write for the whole batch — per-fragment saves
         # rewrite a growing JSON file O(n^2) times on wide imports.
         # finally: a mid-batch failure must still register the shards

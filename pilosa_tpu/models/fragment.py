@@ -497,8 +497,13 @@ class Fragment:
         """Bulk import of absolute fragment positions (pos = row*width+off);
         the fast ingest path (reference importPositions, fragment.go:2053)."""
         with self._lock:
-            sets = np.asarray(sorted(set_pos), dtype=np.uint64)
-            clears = np.asarray(sorted(clear_pos), dtype=np.uint64)
+            # np.unique = sort + dedup in one pass, ~10x Python
+            # sorted() at bulk sizes, and accepts the ndarray chunks
+            # field.import_bits now passes; dedup keeps the WAL bulk
+            # record and _op_n proportional to unique bits on
+            # duplicate-heavy ingest feeds
+            sets = np.unique(np.asarray(set_pos, dtype=np.uint64))
+            clears = np.unique(np.asarray(clear_pos, dtype=np.uint64))
             if len(sets) == 0 and len(clears) == 0:
                 return
             self._apply_bulk(sets.astype(np.int64), clears.astype(np.int64))
