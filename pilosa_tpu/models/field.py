@@ -955,6 +955,10 @@ class Field:
                 # uint64 conversion (OverflowError); int64 arithmetic
                 # would silently wrap them into phantom rows instead
                 raise ValueError("negative row or column id in import")
+            if len(rows_np) and rows_np.max() > ((1 << 63) - 1) // SHARD_WIDTH - 1:
+                # same wrap hazard at the top: row*SHARD_WIDTH must fit
+                # int64 or the position silently lands in a wrong row
+                raise ValueError("row id too large for position space")
             shard_np = cols_np // SHARD_WIDTH
             pos_np = rows_np * SHARD_WIDTH + (cols_np % SHARD_WIDTH)
             order = np.argsort(shard_np, kind="stable")
