@@ -195,7 +195,13 @@ class API:
             rows = self._translate_keys(index, field, row_keys)
             if len(rows) != cols_n:
                 raise ApiError("row keys and columns length mismatch")
-        rows, cols = list(rows), list(cols)
+        # ndarrays (the protobuf bulk path) pass through untouched —
+        # field.import_bits groups them vectorized; anything else
+        # becomes a list once here
+        if not isinstance(rows, np.ndarray):
+            rows = list(rows)
+        if not isinstance(cols, np.ndarray):
+            cols = list(cols)
         if remote or not self._clustered():
             f.import_bits(rows, cols, timestamps, clear=clear)
             if not clear:
@@ -203,12 +209,22 @@ class API:
             return
         known_shards = f.available_shards()
         for shard, sel in self._group_by_shard(cols).items():
+            # the bus payload is JSON — ndarray selections convert via
+            # fancy-index + tolist (C speed), list inputs via comp
+            def pick(seq, to_list: bool, sel=sel):
+                # sel bound at definition: local_fn runs inside
+                # _send_to_owners, but never risk the loop variable
+                if isinstance(seq, np.ndarray):
+                    out = seq[sel]
+                    return out.tolist() if to_list else out
+                return [seq[i] for i in sel]
+
             payload = {
                 "type": "import",
                 "index": index,
                 "field": field,
-                "rows": [rows[i] for i in sel],
-                "cols": [cols[i] for i in sel],
+                "rows": pick(rows, True),
+                "cols": pick(cols, True),
                 "timestamps": None if timestamps is None else
                     [_ts_iso(timestamps[i]) for i in sel],
                 "clear": clear,
@@ -217,13 +233,13 @@ class API:
                 index, shard, payload,
                 local_fn=lambda sel=sel: (
                     f.import_bits(
-                        [rows[i] for i in sel], [cols[i] for i in sel],
+                        pick(rows, False), pick(cols, False),
                         None if timestamps is None
                         else [timestamps[i] for i in sel],
                         clear=clear,
                     ),
                     None if clear else idx.import_existence(
-                        [cols[i] for i in sel]),
+                        pick(cols, False)),
                 ),
             )
             self._note_shard_everywhere(f, index, field, shard,
@@ -275,7 +291,14 @@ class API:
                 and len(self.cluster.sorted_nodes()) > 1)
 
     @staticmethod
-    def _group_by_shard(cols) -> dict[int, list[int]]:
+    def _group_by_shard(cols) -> dict:
+        """shard -> selection of indices into ``cols`` (list of ints
+        for list input, ndarray for ndarray input — both index back
+        into the parallel rows/cols sequences)."""
+        if isinstance(cols, np.ndarray):
+            from pilosa_tpu.ops.bitmap import group_indices
+
+            return group_indices(cols // SHARD_WIDTH)
         by_shard: dict[int, list[int]] = {}
         for i, c in enumerate(cols):
             by_shard.setdefault(c // SHARD_WIDTH, []).append(i)

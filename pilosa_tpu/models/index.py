@@ -156,9 +156,14 @@ class Index:
         api.go:968 importExistenceColumns; executor Set updates
         existence per bit)."""
         f = self.existence_field()
-        if f is None or not cols:
+        if f is None or len(cols) == 0:  # len(): ndarray-safe
             return
-        f.import_bits([0] * len(cols), list(cols))
+        import numpy as np
+
+        if isinstance(cols, np.ndarray):
+            f.import_bits(np.zeros(len(cols), dtype=np.int64), cols)
+        else:
+            f.import_bits([0] * len(cols), list(cols))
 
     def all_fields(self) -> list[Field]:
         """Public + internal fields (``_exists``) — storage-walking code

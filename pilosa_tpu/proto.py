@@ -97,7 +97,7 @@ def _encode_packed_np(vals, signed: bool) -> bytes:
     return out.tobytes()
 
 
-def _decode_packed_np(raw: bytes, signed: bool) -> list:
+def _decode_packed_np(raw: bytes, signed: bool, arrays: bool = False):
     """Packed-varint decode of a large buffer, fully vectorized.
     Semantics match the byte loop with the 64-bit mask the wire
     implies (contributions land in disjoint 7-bit lanes, so the
@@ -121,7 +121,7 @@ def _decode_packed_np(raw: bytes, signed: bool) -> list:
     vals = np.add.reduceat(contrib, starts)
     if signed:
         vals = vals.astype(np.int64)
-    return vals.tolist()
+    return vals if arrays else vals.tolist()
 
 
 def _signed(n: int) -> int:
@@ -141,7 +141,14 @@ def encode(schema: dict, obj: dict) -> bytes:
         spec = schema[field]
         name, kind = spec[0], spec[1]
         v = obj.get(name)
-        if not v and v != 0.0:  # proto3 default: omit zero/empty/False
+        # proto3 default: omit zero/empty/False.  Sized values (lists,
+        # strings, ndarrays — whose truthiness raises) check len().
+        if v is None:
+            continue
+        if hasattr(v, "__len__"):
+            if len(v) == 0:
+                continue
+        elif not v and v != 0.0:
             continue
         if kind == "uint" or kind == "bool":
             if int(v) == 0:
@@ -194,9 +201,16 @@ def _default(kind: str):
             "string": "", "bytes": b"", "msg": None}[kind]
 
 
-def decode(schema: dict, data: bytes) -> dict:
+def decode(schema: dict, data: bytes, arrays: bool = False) -> dict:
     """Decode bytes against a schema table; unknown fields are skipped
-    (proto3 forward compatibility), absent fields read as defaults."""
+    (proto3 forward compatibility), absent fields read as defaults.
+
+    ``arrays=True`` leaves LARGE packed uint*/int* fields as numpy
+    int64/uint64 ndarrays instead of Python lists — the bulk-import
+    endpoints opt in so 2M-element ID arrays flow to
+    field.import_bits' vectorized grouping with zero list
+    materialization.  Callers opting in must length-check with
+    ``len(x)`` (ndarray truthiness raises)."""
     obj = {spec[0]: _default(spec[1]) for spec in schema.values()}
     i = 0
     while i < len(data):
@@ -212,10 +226,13 @@ def decode(schema: dict, data: bytes) -> dict:
                 obj[name] = bool(n)
             elif kind == "int":
                 obj[name] = _signed(n)
-            elif kind == "int*":
-                obj[name].append(_signed(n))  # unpacked repeated
-            elif kind == "uint*":
-                obj[name].append(n)
+            elif kind == "int*" or kind == "uint*":
+                # unpacked repeated occurrence; legal proto3 encoders
+                # may mix it with packed chunks, so an ndarray from an
+                # earlier arrays=True chunk converts back to plain ints
+                if not isinstance(obj[name], list):
+                    obj[name] = obj[name].tolist()
+                obj[name].append(_signed(n) if kind == "int*" else n)
             elif kind == "uint":
                 obj[name] = n
             else:
@@ -249,9 +266,23 @@ def decode(schema: dict, data: bytes) -> dict:
                 obj[name].append(decode(spec[2], raw))
             elif kind == "uint*" or kind == "int*":
                 if ln >= _NP_PACKED_MIN:
-                    obj[name].extend(
-                        _decode_packed_np(raw, signed=(kind == "int*")))
+                    decoded = _decode_packed_np(
+                        raw, signed=(kind == "int*"), arrays=arrays)
+                    if arrays and isinstance(obj[name], list) \
+                            and not obj[name]:
+                        obj[name] = decoded
+                    else:
+                        # second occurrence (packed fields may be
+                        # split): degrade to a plain-int list —
+                        # .tolist(), never list(ndarray), so no np
+                        # scalars leak into JSON-bound payloads
+                        if not isinstance(obj[name], list):
+                            obj[name] = obj[name].tolist()
+                        obj[name].extend(
+                            decoded.tolist() if arrays else decoded)
                 else:
+                    if not isinstance(obj[name], list):
+                        obj[name] = obj[name].tolist()
                     j = 0
                     while j < ln:
                         n, j = _read_varint(raw, j)

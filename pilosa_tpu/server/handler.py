@@ -545,13 +545,18 @@ class Handler:
         if "protobuf" in req.headers.get("Content-Type", ""):
             from pilosa_tpu import proto
 
-            d = proto.decode(proto.IMPORT_REQUEST, body)
-            if d.get("timestamps"):
+            # arrays=True: large packed ID fields stay ndarrays all the
+            # way into field.import_bits' vectorized grouping (length
+            # checks below must use len(), never truthiness)
+            d = proto.decode(proto.IMPORT_REQUEST, body, arrays=True)
+            ts = d.get("timestamps")
+            if ts is not None and len(ts):
                 # 0 = "no timestamp" in the reference's wire form
-                d["timestamps"] = [t or None for t in d["timestamps"]]
+                d["timestamps"] = [int(t) or None for t in ts]
             # empty repeated fields mean "unkeyed", like absent JSON keys
             for k in ("rowKeys", "columnKeys", "timestamps"):
-                if not d.get(k):
+                v = d.get(k)
+                if v is None or not len(v):
                     d[k] = None
         else:
             d = json.loads(body)
@@ -559,9 +564,12 @@ class Handler:
         if timestamps:
             timestamps = [None if t is None else _parse_ts(t)
                           for t in timestamps]
+        rows_in = d.get("rowIDs")
+        cols_in = d.get("columnIDs")
         self.api.import_bits(
             path["index"], path["field"],
-            d.get("rowIDs") or [], d.get("columnIDs") or [],
+            rows_in if rows_in is not None and len(rows_in) else [],
+            cols_in if cols_in is not None and len(cols_in) else [],
             timestamps=timestamps,
             row_keys=d.get("rowKeys"), col_keys=d.get("columnKeys"),
             clear=params.get("clear") == "true",

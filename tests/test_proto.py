@@ -313,3 +313,69 @@ class TestPackedVarintVectorized:
         assert len(ten) == 10
         assert proto._read_varint(ten, 0)[0] == (1 << 64) - 1
         assert proto._decode_packed_np(ten, signed=False) == [(1 << 64) - 1]
+
+
+class TestNdarrayImportPath:
+    """arrays=True decode hands packed ID fields to the import
+    pipeline as ndarrays; the clustered fan-out must produce results
+    bit-identical to the JSON list path (api._group_by_shard and the
+    payload pick() have dedicated ndarray branches)."""
+
+    def test_clustered_proto_import_exact(self, tmp_path):
+        import random
+
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        s0 = Server(str(tmp_path / "c0"), coordinator=True)
+        s0.open()
+        s1 = Server(str(tmp_path / "c1"), seeds=[s0.uri])
+        s1.open()
+        try:
+            _post(s0.uri, "/index/i", b"{}", "application/json")
+            _post(s0.uri, "/index/i/field/f", b"{}", "application/json")
+            rng = random.Random(4)
+            n = proto._NP_PACKED_MIN * 3  # above the ndarray threshold
+            rows = [rng.randrange(8) for _ in range(n)]
+            cols = [rng.randrange(5 * SHARD_WIDTH) for _ in range(n)]
+            body = proto.encode(proto.IMPORT_REQUEST,
+                                {"index": "i", "field": "f",
+                                 "rowIDs": rows, "columnIDs": cols})
+            _post(s0.uri, "/index/i/field/f/import", body,
+                  "application/x-protobuf")
+            oracle = {}
+            for r, c in zip(rows, cols):
+                oracle.setdefault(r, set()).add(c)
+            # every node answers every row exactly; existence too
+            for uri in (s0.uri, s1.uri):
+                for r in (0, 3, 7):
+                    raw, _ = _post(
+                        uri, "/index/i/query",
+                        json.dumps({"query": f"Count(Row(f={r}))"}).encode(),
+                        "application/json")
+                    assert json.loads(raw)["results"][0] == len(oracle[r])
+                raw, _ = _post(
+                    uri, "/index/i/query",
+                    json.dumps({"query": "Count(Not(Row(f=99)))"}).encode(),
+                    "application/json")
+                assert json.loads(raw)["results"][0] == len(set(cols))
+        finally:
+            s0.close()
+            s1.close()
+
+    def test_mixed_packed_unpacked_occurrences_arrays(self):
+        """proto3 encoders may split or mix packed and unpacked
+        occurrences of one repeated field; arrays=True must degrade to
+        a plain-int list (never crash on ndarray.append, never leak np
+        scalars into JSON-bound payloads)."""
+        rows = list(range(proto._NP_PACKED_MIN * 2))
+        body = proto.encode(proto.IMPORT_REQUEST,
+                            {"index": "i", "field": "f", "rowIDs": rows})
+        extra = proto._key(4, 0) + proto._varint(7)
+        d = proto.decode(proto.IMPORT_REQUEST, body + extra, arrays=True)
+        assert list(d["rowIDs"]) == rows + [7]
+        assert all(type(x) is int for x in d["rowIDs"][-2:])
+        packed = proto._encode_packed_np(rows, signed=False)
+        chunk = proto._key(4, 2) + proto._varint(len(packed)) + packed
+        d2 = proto.decode(proto.IMPORT_REQUEST, body + chunk, arrays=True)
+        assert d2["rowIDs"] == rows + rows
+        assert type(d2["rowIDs"][0]) is int
