@@ -1024,43 +1024,81 @@ class Field:
         self._require_int()
         from pilosa_tpu.ops import bsi as bsi_ops
 
-        cols = list(cols)
-        values = list(values)
+        if not isinstance(cols, np.ndarray):
+            cols = list(cols)
+        if not isinstance(values, np.ndarray):
+            values = list(values)
         if len(cols) != len(values):
             raise ValueError("columns and values length mismatch")
-        if not cols:
+        if len(cols) == 0:
             return
         o = self.options
-        for v in values:
-            if v < o.min or v > o.max:
-                raise ValueError(f"value {v} outside field range [{o.min}, {o.max}]")
-        required = max(bit_depth(abs(v - o.base)) for v in values)
+        cols_np = np.asarray(cols, dtype=np.int64)
+        if cols_np.min() < 0:
+            raise ValueError("negative column id in import")
+        # Coerce values preserving the pre-vectorization error
+        # contract: floats raised TypeError (shift op), out-of-range
+        # ints raised ValueError — np.asarray(..., int64) would
+        # silently truncate the former and turn the latter into
+        # OverflowError (a 500 instead of a 400 at the handler).
+        raw = values if isinstance(values, np.ndarray) \
+            else np.asarray(values)
+        if np.issubdtype(raw.dtype, np.floating):
+            raise TypeError("BSI values must be integers")
+        if raw.dtype == object:
+            # mixed/bigint input: range-check in Python first (values
+            # that pass fit int64 — FieldOptions caps ranges below
+            # 63 bits from base)
+            for v in raw.tolist():
+                if not isinstance(v, int):
+                    raise TypeError("BSI values must be integers")
+                if v < o.min or v > o.max:
+                    raise ValueError(f"value {v} outside field range "
+                                     f"[{o.min}, {o.max}]")
+            vals_np = np.asarray(raw.tolist(), dtype=np.int64)
+        else:
+            vals_np = raw.astype(np.int64, copy=False)
+        bad = vals_np[(vals_np < o.min) | (vals_np > o.max)]
+        if len(bad):
+            raise ValueError(f"value {int(bad[0])} outside field range "
+                             f"[{o.min}, {o.max}]")
+        bv = vals_np - o.base
+        uv = np.abs(bv)
+        required = bit_depth(int(uv.max()))
         if required > o.bit_depth:
             with self._lock:
                 o.bit_depth = required
                 self.save_meta()
         depth = o.bit_depth
         view = self.create_view_if_not_exists(self.bsi_view_name)
-        # shard -> (set positions, clear positions), one bulk apply per
-        # fragment (reference fragment.importValue, fragment.go:2186).
-        by_shard: dict[int, tuple[list[int], list[int]]] = {}
-        for c, v in zip(cols, values):
-            shard = c // SHARD_WIDTH
-            off = c % SHARD_WIDTH
-            sets, clears = by_shard.setdefault(shard, ([], []))
-            bv = v - o.base
-            uv = -bv if bv < 0 else bv
-            for i in range(depth):
-                pos = (bsi_ops.OFFSET_PLANE + i) * SHARD_WIDTH + off
-                (sets if (uv >> i) & 1 else clears).append(pos)
-            sets.append(bsi_ops.EXISTS_PLANE * SHARD_WIDTH + off)
-            (sets if bv < 0 else clears).append(bsi_ops.SIGN_PLANE * SHARD_WIDTH + off)
+        # One set/clear position batch per shard, built in numpy: each
+        # value contributes its magnitude bit per plane, an exists bit,
+        # and a sign bit (reference fragment.importValue,
+        # fragment.go:2186 — there per-bit, here [n, depth] at once).
+        from pilosa_tpu.ops.bitmap import group_indices
+
+        off = cols_np % SHARD_WIDTH
+        planes = np.arange(depth, dtype=np.int64)
         done: set[int] = set()
         try:
-            for shard, (sets, clears) in by_shard.items():
-                frag = view.create_fragment_if_not_exists(shard)
+            for shard, sel in group_indices(cols_np // SHARD_WIDTH).items():
+                offs = off[sel]
+                bits = (uv[sel][:, None] >> planes[None, :]) & 1
+                pos = ((bsi_ops.OFFSET_PLANE + planes)[None, :]
+                       * SHARD_WIDTH + offs[:, None])
+                neg = bv[sel] < 0
+                sets = np.concatenate([
+                    pos[bits == 1],
+                    bsi_ops.EXISTS_PLANE * SHARD_WIDTH + offs,
+                    bsi_ops.SIGN_PLANE * SHARD_WIDTH + offs[neg],
+                ])
+                clears = np.concatenate([
+                    pos[bits == 0],
+                    bsi_ops.SIGN_PLANE * SHARD_WIDTH + offs[~neg],
+                ])
+                frag = view.create_fragment_if_not_exists(int(shard))
                 frag.import_positions(sets, clears)
-                done.add(shard)
+                done.add(int(shard))
         finally:
             self._note_shards(done)
         self._prewarm(())  # int field: warms the BSI plane stack
