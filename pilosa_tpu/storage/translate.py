@@ -163,12 +163,62 @@ class SQLiteTranslateStore(TranslateStore):
                 return int(cur.fetchone()[0])
 
     def translate_keys(self, keys, create: bool = False) -> list[int | None]:
-        return [self.translate_key(k, create) for k in keys]
+        """Batched translate: IN-chunked lookups plus ONE
+        INSERT-OR-IGNORE transaction for all new keys — the per-key
+        path commits (fsyncs) once per new key, which dominates keyed
+        bulk imports.  Same race semantics as translate_key: a
+        concurrent creator wins and the re-select picks up its id."""
+        # normalize to str up front: the per-key path matched numeric
+        # keys through SQLite's TEXT affinity, but a dict keyed on the
+        # DB's returned strings would miss them; None never worked (it
+        # crashed in the race handler) so reject it loudly
+        norm = []
+        for k in keys:
+            if k is None:
+                raise ValueError("null key")
+            norm.append(k if isinstance(k, str) else str(k))
+        keys = norm
+        uniq = list(dict.fromkeys(keys))
+        con = self._conn()
+        found: dict[str, int] = {}
+
+        def select_into(chunked):
+            for k, id_ in self._select_in(con, "key", chunked):
+                found[k] = int(id_)
+
+        select_into(uniq)
+        if create:
+            missing = [k for k in uniq if k not in found]
+            if missing:
+                self._check_writable()
+                with self._lock:
+                    try:
+                        con.executemany(
+                            "INSERT OR IGNORE INTO keys (key) VALUES (?)",
+                            [(k,) for k in missing])
+                        con.commit()
+                    except Exception:
+                        con.rollback()
+                        raise
+                select_into(missing)
+        return [found.get(k) for k in keys]
 
     def translate_id(self, id: int) -> str | None:
         cur = self._conn().execute("SELECT key FROM keys WHERE id = ?", (int(id),))
         row = cur.fetchone()
         return None if row is None else row[0]
+
+    @staticmethod
+    def _select_in(con, column: str, values):
+        """(key, id) rows for ``values`` matched on ``column``, one
+        IN-query per 500 values (comfortably under SQLite's 999
+        parameter floor) — the shared chunking for both batched
+        directions."""
+        for i in range(0, len(values), 500):
+            chunk = values[i:i + 500]
+            yield from con.execute(
+                "SELECT key, id FROM keys WHERE "
+                f"{column} IN ({','.join('?' * len(chunk))})", chunk)
 
     def translate_ids(self, ids) -> list[str | None]:
         """Batched lookup: one IN-query per 500 ids instead of a
@@ -176,15 +226,8 @@ class SQLiteTranslateStore(TranslateStore):
         dominated by per-id SELECTs)."""
         ids = [int(i) for i in ids]
         found: dict[int, str] = {}
-        con = self._conn()
-        for i in range(0, len(ids), 500):
-            chunk = ids[i : i + 500]
-            cur = con.execute(
-                f"SELECT id, key FROM keys WHERE id IN ({','.join('?' * len(chunk))})",
-                chunk,
-            )
-            for id_, key in cur.fetchall():
-                found[int(id_)] = key
+        for key, id_ in self._select_in(self._conn(), "id", ids):
+            found[int(id_)] = key
         return [found.get(i) for i in ids]
 
     def max_offset(self) -> int:
