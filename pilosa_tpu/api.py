@@ -231,7 +231,10 @@ class API:
             }
             self._send_to_owners(
                 index, shard, payload,
-                local_fn=lambda sel=sel: (
+                # pick=pick: pick rebinds every iteration, so the
+                # lambda must be self-contained even if delivery is
+                # ever deferred past this loop step
+                local_fn=lambda sel=sel, pick=pick: (
                     f.import_bits(
                         pick(rows, False), pick(cols, False),
                         None if timestamps is None

@@ -940,10 +940,46 @@ class Field:
             raise ValueError("timestamps length mismatch")
         if self.options.type == FieldType.INT:
             raise ValueError(f"field {self.name} is an int field; use import_values")
+        # exact overflow bounds shared by EVERY path below (incl. the
+        # mutex per-bit loop): pos = r*SHARD_WIDTH + offset with
+        # offset <= SHARD_WIDTH-1 must fit int64, so
+        # r <= (2^63 - SHARD_WIDTH) // SHARD_WIDTH, and column ids
+        # themselves must fit int64
+        max_row = ((1 << 63) - SHARD_WIDTH) // SHARD_WIDTH
+        max_col = (1 << 63) - 1
+
+        def _check_pair(r: int, c: int) -> None:
+            if r < 0 or c < 0:
+                raise ValueError("negative row or column id in import")
+            if r > max_row:
+                raise ValueError("row id too large for position space")
+            if c > max_col:
+                raise ValueError("column id too large for position space")
+
+        def _as_i64(a, what: str) -> np.ndarray:
+            # uint64 ndarrays >= 2^63 would wrap NEGATIVE on the int64
+            # cast and surface as a misleading "negative id" error;
+            # out-of-int64 Python ints raise OverflowError — map both
+            # to the same ValueError contract the per-bit paths use,
+            # classifying by sign so negatives never read "too large"
+            if isinstance(a, np.ndarray) and a.dtype.kind == "u" \
+                    and len(a) and int(a.max()) > max_col:
+                raise ValueError(f"{what} id too large for position space")
+            try:
+                return np.asarray(a, dtype=np.int64)
+            except OverflowError:
+                if any(int(v) < 0 for v in a):
+                    raise ValueError(
+                        "negative row or column id in import") from None
+                raise ValueError(
+                    f"{what} id too large for position space") from None
+
         if self._is_mutex_like and not clear:
             for i, (r, c) in enumerate(zip(rows, cols)):
+                r, c = int(r), int(c)  # int(): ndarray-safe
+                _check_pair(r, c)
                 ts = timestamps[i] if timestamps is not None else None
-                self.set_bit(int(r), int(c), ts)  # int(): ndarray-safe
+                self.set_bit(r, c, ts)
             return
         # (view, shard) -> positions
         by_frag: dict[tuple[str, int], "list[int] | np.ndarray"] = {}
@@ -952,14 +988,14 @@ class Field:
             # the common bulk path (no time expansion) groups in numpy:
             # a per-bit setdefault/append loop costs ~1.5 s at 2M bits
             # where one argsort + split costs ~0.1 s
-            cols_np = np.asarray(cols, dtype=np.int64)
-            rows_np = np.asarray(rows, dtype=np.int64)
+            cols_np = _as_i64(cols, "column")
+            rows_np = _as_i64(rows, "row")
             if len(rows_np) and (rows_np.min() < 0 or cols_np.min() < 0):
                 # the pre-vectorization path rejected negatives at the
                 # uint64 conversion (OverflowError); int64 arithmetic
                 # would silently wrap them into phantom rows instead
                 raise ValueError("negative row or column id in import")
-            if len(rows_np) and rows_np.max() > ((1 << 63) - 1) // SHARD_WIDTH - 1:
+            if len(rows_np) and rows_np.max() > max_row:
                 # same wrap hazard at the top: row*SHARD_WIDTH must fit
                 # int64 or the position silently lands in a wrong row
                 raise ValueError("row id too large for position space")
@@ -974,6 +1010,7 @@ class Field:
                 # int(): ndarray elements are fixed-width and would
                 # wrap silently at r*SHARD_WIDTH; Python ints fail loud
                 r, c = int(r), int(c)
+                _check_pair(r, c)
                 shard = c // SHARD_WIDTH
                 pos = r * SHARD_WIDTH + (c % SHARD_WIDTH)
                 if has_std:

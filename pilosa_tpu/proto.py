@@ -232,9 +232,9 @@ def decode(schema: dict, data: bytes, arrays: bool = False) -> dict:
                 # earlier arrays=True chunk converts back to plain ints
                 if not isinstance(obj[name], list):
                     obj[name] = obj[name].tolist()
-                obj[name].append(_signed(n) if kind == "int*" else n)
+                obj[name].append(_signed(n) if kind == "int*" else n & _U64)
             elif kind == "uint":
-                obj[name] = n
+                obj[name] = n & _U64
             else:
                 raise ValueError(
                     f"field {field} wire type 0 does not match {kind!r}")

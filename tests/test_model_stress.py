@@ -256,3 +256,67 @@ def test_interleaved_ops_match_model(tmp_path, seed):
     for _ in range(25):
         check_read()
     holder.close()
+
+
+def test_import_row_id_boundary_agrees_across_paths(tmp_path):
+    """Both import_bits grouping paths (vectorized no-timestamp and
+    the timestamped loop) must agree at the exact int64 position
+    boundary: pos = row*SHARD_WIDTH + offset must fit int64, so the
+    largest legal row is (2^63 - SHARD_WIDTH) // SHARD_WIDTH
+    (round-3 advisor finding: the vectorized path was one stricter
+    and the timestamped path unbounded)."""
+    max_row = ((1 << 63) - SHARD_WIDTH) // SHARD_WIDTH
+    ts = dt.datetime(2021, 3, 4, 5)
+
+    holder = Holder(str(tmp_path / "h"))
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    t = idx.create_field("t", FieldOptions.time_field("YMDH"))
+
+    # the largest legal row imports on both paths (rows are sparse:
+    # one row materializes one shard-width bitmap, not a dense stack)
+    from pilosa_tpu.ops.bitmap import unpack_positions
+
+    f.import_bits([max_row], [SHARD_WIDTH - 1])
+    assert list(unpack_positions(f.row(max_row, 0))) == [SHARD_WIDTH - 1]
+    t.import_bits([max_row], [SHARD_WIDTH - 1], [ts])
+    assert list(unpack_positions(t.row(max_row, 0))) == [SHARD_WIDTH - 1]
+
+    # one past it is rejected by BOTH paths with the same error
+    with pytest.raises(ValueError, match="too large"):
+        f.import_bits([max_row + 1], [0])
+    with pytest.raises(ValueError, match="too large"):
+        t.import_bits([max_row + 1], [0], [ts])
+    # negatives are rejected by both paths too
+    with pytest.raises(ValueError, match="negative"):
+        f.import_bits([-1], [0])
+    with pytest.raises(ValueError, match="negative"):
+        t.import_bits([-1], [0], [ts])
+
+    # column ids past int64 are rejected by both paths with the same
+    # contract, regardless of carrier (Python int list or uint64
+    # ndarray — the latter would otherwise wrap negative on the cast)
+    import numpy as np
+    for bad_cols in ([1 << 63], np.asarray([1 << 63], dtype=np.uint64)):
+        with pytest.raises(ValueError, match="column id too large"):
+            f.import_bits([0], bad_cols)
+        with pytest.raises(ValueError, match="column id too large"):
+            t.import_bits([0], bad_cols, [ts])
+
+    # a too-NEGATIVE id (below int64) still reads as negative, never
+    # as "too large", on the vectorized path
+    with pytest.raises(ValueError, match="negative"):
+        f.import_bits([-(1 << 63) - 1], [0])
+
+    # the mutex per-bit path honors the same contract instead of
+    # leaking struct.error from deep inside the WAL
+    m = idx.create_field("m", FieldOptions.mutex_field())
+    with pytest.raises(ValueError, match="negative"):
+        m.import_bits([-1], [0])
+    with pytest.raises(ValueError, match="row id too large"):
+        m.import_bits([max_row + 1], [0])
+    with pytest.raises(ValueError, match="column id too large"):
+        m.import_bits([0], [1 << 63])
+    m.import_bits([max_row], [5])
+    assert list(unpack_positions(m.row(max_row, 0))) == [5]
+    holder.close()

@@ -47,6 +47,24 @@ class TestWireCodec:
                             for v in vals)
         assert proto.decode(proto.ROW, unpacked)["columns"] == vals
 
+    def test_noncanonical_overlong_varint_masks_to_64_bits(self):
+        # a 10-byte varint encoding a value >2^64 must decode to the
+        # same 64-bit value whether it arrives packed or unpacked
+        # 10-byte varint (the decoder's cap) carrying bits beyond u64
+        big = (1 << 69) | 12345
+        overlong = bytearray()
+        n = big
+        while n > 0x7F:
+            overlong.append((n & 0x7F) | 0x80)
+            n >>= 7
+        overlong.append(n)
+        want = big & ((1 << 64) - 1)
+        unpacked = proto._key(1, 0) + bytes(overlong)
+        packed = (proto._key(1, 2) + proto._varint(len(overlong))
+                  + bytes(overlong))
+        assert proto.decode(proto.ROW, unpacked)["columns"] == [want]
+        assert proto.decode(proto.ROW, packed)["columns"] == [want]
+
     def test_unknown_fields_skipped(self):
         # append an unknown varint field 15 and an unknown LEN field 14
         enc = proto.encode(proto.PAIR, {"id": 3, "count": 7})

@@ -22,6 +22,7 @@ import os
 import socket
 import subprocess
 import sys
+import threading
 import time
 
 
@@ -62,22 +63,38 @@ def run_fleet(argv_per_worker: list[list[str]], env_per_worker:
     ``timeout`` bounds the WHOLE fleet (one shared deadline, not a
     fresh allowance per worker).  Returns (ok, outputs, timed_out) —
     ``timed_out`` distinguishes a genuine hang from a fast worker
-    crash so callers classify failures correctly.  Outputs collected
-    before a timeout are preserved (re-communicating a finished
-    process returns '', which would blank the very tails the caller
-    needs).  On any failure the tail of every worker's combined
+    crash so callers classify failures correctly.  Every worker's
+    pipe is drained by its own reader thread: a worker that writes
+    more than the ~64 KB pipe buffer while the parent is waiting on
+    an earlier worker must never block on write, or a verbose fast
+    crash wedges the lockstep fleet and gets misclassified as a
+    hang.  On any failure the tail of every worker's combined
     stdout/stderr is written to stderr."""
+    # errors="replace": a stray non-UTF-8 byte must not kill a reader
+    # thread (a dead reader stops draining and re-creates the wedge)
     procs = [subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True,
-                              cwd=cwd)
+                              errors="replace", cwd=cwd)
              for argv, env in zip(argv_per_worker, env_per_worker)]
+    bufs: list[list[str]] = [[] for _ in procs]
+
+    def _drain(stream, buf: list[str]) -> None:
+        while True:
+            chunk = stream.read(65536)
+            if not chunk:
+                return
+            buf.append(chunk)
+
+    readers = [threading.Thread(target=_drain, args=(p.stdout, buf),
+                                daemon=True)
+               for p, buf in zip(procs, bufs)]
+    for t in readers:
+        t.start()
     deadline = time.monotonic() + timeout
-    outs: list[str] = []
     timed_out = False
     for p in procs:
         try:
-            outs.append(p.communicate(
-                timeout=max(0.1, deadline - time.monotonic()))[0])
+            p.wait(timeout=max(0.1, deadline - time.monotonic()))
         except subprocess.TimeoutExpired:
             timed_out = True
             break
@@ -85,10 +102,14 @@ def run_fleet(argv_per_worker: list[list[str]], env_per_worker:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-        # collect the killed workers' (and any not-yet-waited) output
-        # without clobbering what finished workers already returned
-        for p in procs[len(outs):]:
-            outs.append(p.communicate()[0] or "")
+        for p in procs:
+            p.wait()
+    # killed (or exited) processes close their pipe ends, so the
+    # readers hit EOF; the join bound is a backstop, not a drain
+    for t in readers:
+        t.join(timeout=10.0)
+    outs = ["".join(buf) for buf in bufs]
+    if timed_out:
         sys.stderr.write(f"{label}: TIMEOUT — worker hung; fleet "
                          "killed\n")
         for i, out in enumerate(outs):
