@@ -106,7 +106,8 @@ class API:
             # upgrade unreachable on config-launched servers.
             from pilosa_tpu.parallel import spmd
 
-            res = spmd.try_collective(self.node, index, pql)
+            res = spmd.try_collective(self.node, index, pql,
+                                      exclude_row_attrs=exclude_row_attrs)
             if res is not None:
                 return res
         if self.max_writes_per_request > 0:

@@ -37,11 +37,15 @@ Collectives are SPMD: every process must enter the same program in the
 same order.  `collective_query` is therefore called symmetrically — on
 a live cluster the coordinator broadcasts the query over the control
 plane (`/internal/collective/execute`) and every process joins; tests
-drive both processes directly.  Supported calls (v1): Count over
-Row/Union/Intersect/Difference/Xor trees (incl. BSI-condition rows,
-the Range surface), Sum (optional filter), TopN (optional filter).
-Everything else stays on the scatter-gather path; key-translated
-queries translate before entering (the test covers raw ids).
+drive both processes directly.  Supported calls: bare bitmap trees
+(Row/Union/Intersect/Difference/Xor/Not/Shift/Range — the result Row
+gathers replicated and the coordinator assembles segments), Count over
+those trees (incl. BSI-condition rows, the Range surface), Sum/Min/Max
+(optional filter), TopN (optional filter), GroupBy over N Rows
+children (incl. column/previous/limit constraints and time-constrained
+children via their agreed view cover).  Everything else stays on the
+scatter-gather path; key-translated queries translate before entering
+(the test covers raw ids).
 """
 
 from __future__ import annotations
@@ -73,6 +77,16 @@ class CollectiveError(RuntimeError):
 #: is left parked in a half-entered collective.
 MAX_COLLECTIVE_ROWS = 4096
 MAX_COLLECTIVE_PAIRS = 1 << 22
+
+#: top-level calls whose result is a bitmap (a global Row) — the
+#: ordinary read surface (reference executeBitmapCall, executor.go:651)
+BITMAP_ROOTS = ("Row", "Range", "Union", "Intersect", "Difference",
+                "Xor", "Not", "Shift")
+
+#: byte ceiling for the replicated bare-bitmap gather ([G, words] on
+#: every process).  Indexes wider than this answer bare rows on the
+#: scatter plane, whose per-shard segments never replicate.
+MAX_ROW_GATHER_BYTES = 1 << 28
 
 
 @dataclass(frozen=True)
@@ -240,44 +254,51 @@ def global_plane_stack(field, plan: Plan):
         _fill_blocks(plan, (n_planes, n_words), fill))
 
 
-def global_matrix_stack(field, row_ids, plan: Plan):
-    """[G, R, words] matrix over an AGREED row-id list (TopN operand).
-    The row list must be identical on every process — see
-    ``agreed_row_ids``."""
+def global_matrix_stack(field, row_ids, plan: Plan,
+                        view_names=(VIEW_STANDARD,)):
+    """[G, R, words] matrix over an AGREED row-id list (TopN/GroupBy
+    operand).  The row list must be identical on every process — see
+    ``agreed_row_ids``.  With multiple ``view_names`` (time-constrained
+    GroupBy children) each block row is the OR of the covering views'
+    rows, matching the scatter path's merged-row semantics
+    (executor._execute_rows view scan)."""
     import jax
 
-    view = field.view(VIEW_STANDARD)
+    views = [field.view(vn) for vn in view_names]
     n_words = bm.n_words(SHARD_WIDTH)
     rid_list = list(row_ids)
 
     def fill(buf, s):
-        frag = view.fragment(s) if view is not None else None
-        if frag is None:
-            return
-        with frag._lock:
-            for j, rid in enumerate(rid_list):
-                arr = frag._rows.get(rid)
-                if arr is not None:
-                    buf[j] = arr
+        for v in views:
+            frag = v.fragment(s) if v is not None else None
+            if frag is None:
+                continue
+            with frag._lock:  # OR under the lock: rows mutate in place
+                for j, rid in enumerate(rid_list):
+                    arr = frag._rows.get(rid)
+                    if arr is not None:
+                        np.bitwise_or(buf[j], arr, out=buf[j])
 
     return jax.make_array_from_callback(
         (len(plan.order), len(rid_list), n_words), _sharding(plan, 2),
         _fill_blocks(plan, (len(rid_list), n_words), fill))
 
 
-def agreed_row_ids(field) -> list[int]:
+def agreed_row_ids(field, view_names=(VIEW_STANDARD,)) -> list[int]:
     """The union of row ids across every process, identical everywhere:
-    local union, then a fixed-size allgather (count exchange first, pad
-    to the max).  Control-plane-free — it rides the same collective
-    runtime as the data."""
+    local union (across the agreed view cover), then a fixed-size
+    allgather (count exchange first, pad to the max).
+    Control-plane-free — it rides the same collective runtime as the
+    data.  ``view_names`` must be identical on every process."""
     import jax
     from jax.experimental import multihost_utils
 
-    view = field.view(VIEW_STANDARD)
     local: set[int] = set()
-    if view is not None:
-        for frag in list(view.fragments.values()):
-            local.update(frag.row_ids())
+    for vn in view_names:
+        view = field.view(vn)
+        if view is not None:
+            for frag in list(view.fragments.values()):
+                local.update(frag.row_ids())
     if jax.process_count() == 1:
         return sorted(local)
     mine = np.array(sorted(local), dtype=np.int64)
@@ -331,36 +352,53 @@ def _jit_sum0(mesh):
                    out_shardings=NamedSharding(mesh, P()))
 
 
-def global_column_bits(field, row_ids, column: int, plan: Plan) -> np.ndarray:
+def global_column_bits(field, row_ids, column: int, plan: Plan,
+                       view_names=(VIEW_STANDARD,)) -> np.ndarray:
     """[R] replicated 0/1 per row of ``row_ids``: does the row contain
     ``column``?  The owning shard's block carries the bits read from
     its local fragment; every other block is zero; one mesh sum
     replicates the answer (the collective analog of the executor's
     vectorized column-word read, executor.py map_fn / reference
-    rowFilter ColumnFilter fragment.go:2618)."""
+    rowFilter ColumnFilter fragment.go:2618).  With multiple
+    ``view_names`` a row qualifies when the bit is set in ANY covering
+    view (merged-row semantics, as the scatter path)."""
     import jax
 
     shard = column // SHARD_WIDTH
     off = column % SHARD_WIDTH
     w, b = off // bm.WORD_BITS, off % bm.WORD_BITS
-    view = field.view(VIEW_STANDARD)
+    views = [field.view(vn) for vn in view_names]
 
     def fill(buf, s):
         if s != shard:
             return
-        frag = view.fragment(s) if view is not None else None
-        if frag is None:
-            return
-        with frag._lock:
-            for i, r in enumerate(row_ids):
-                arr = frag._rows.get(r)
-                if arr is not None:
-                    buf[i] = np.uint32((int(arr[w]) >> b) & 1)
+        for v in views:
+            frag = v.fragment(s) if v is not None else None
+            if frag is None:
+                continue
+            with frag._lock:
+                for i, r in enumerate(row_ids):
+                    arr = frag._rows.get(r)
+                    if arr is not None:
+                        buf[i] |= np.uint32((int(arr[w]) >> b) & 1)
 
     stack = jax.make_array_from_callback(
         (len(plan.order), len(row_ids)), _sharding(plan, 1),
         _fill_blocks(plan, (len(row_ids),), fill))
     return np.asarray(_jit_sum0(plan.mesh)(stack))
+
+
+@functools.cache
+def _jit_gather(mesh):
+    """Replicate a sharded [G, words] result stack to every process —
+    one all-gather over the mesh.  The bare-bitmap result path: the
+    coordinator assembles the global Row host-side from the replicated
+    copy (every process runs the identical program; peers discard)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return jax.jit(lambda stack: stack,
+                   out_shardings=NamedSharding(mesh, P()))
 
 
 @functools.cache
@@ -537,9 +575,51 @@ def collective_available() -> bool:
     return jax.process_count() > 1
 
 
+def _call_time_field(idx, c):
+    """The time-quantum field a call's from/to args refer to, or None
+    (no field arg, unknown field, not a time field)."""
+    if c.name == "Rows":
+        fname = c.args.get("_field") or c.args.get("field")
+    else:
+        try:
+            fname = c.field_arg()
+        except Exception:  # noqa: BLE001 — malformed: supported() refuses
+            fname = None
+    if not fname:
+        return None
+    f = idx.field(fname)
+    return f if (f is not None and f.time_quantum) else None
+
+
+def _needs_time_bounds(c, f) -> bool:
+    """Does this call carry an under-specified time range the
+    coordinator must resolve to concrete global values?  Row/Range:
+    exactly one of from=/to=.  Rows (GroupBy children): any missing
+    bound once the time-view scan is engaged — which the scatter path
+    engages for from=/to= OR a no-standard-view field
+    (executor._execute_rows view selection)."""
+    has_from, has_to = "from" in c.args, "to" in c.args
+    if c.name in ("Row", "Range"):
+        return has_from != has_to
+    if c.name == "Rows":
+        # from/to on an UNconstrained GroupBy child is ignored outright
+        # (reference executeGroupBy pre-executes the child Rows query —
+        # the only place time bounds bite — solely for limit/column,
+        # executor.go:1104-1117; newGroupByIterator always scans
+        # viewStandard, executor.go:3102).  A no-standard-view child
+        # makes the whole GroupBy empty regardless of bounds, so only
+        # a constrained child with exactly one bound needs resolution.
+        if not any(k in c.args for k in ("limit", "column", "previous")):
+            return False
+        if f.options.no_standard_view:
+            return False
+        return has_from != has_to
+    return False
+
+
 def _open_time_fields(idx, call) -> set:
-    """Field names of time-range Rows in the tree carrying an
-    open-ended bound (exactly one of from=/to=).  Only fields that
+    """Field names of time-range calls in the tree carrying an
+    under-specified bound (see _needs_time_bounds).  Only fields that
     exist with a time quantum count — anything else is the supported()
     check's problem."""
     from pilosa_tpu.pql import Call as _Call
@@ -549,15 +629,9 @@ def _open_time_fields(idx, call) -> set:
     def walk(c):
         if not isinstance(c, _Call):
             return
-        if c.name == "Row" and (("from" in c.args) != ("to" in c.args)):
-            try:
-                fname = c.field_arg()
-            except Exception:  # noqa: BLE001 — malformed: supported() refuses
-                fname = None
-            if fname:
-                f = idx.field(fname)
-                if f is not None and f.time_quantum:
-                    out.add(fname)
+        f = _call_time_field(idx, c)
+        if f is not None and _needs_time_bounds(c, f):
+            out.add(f.name)
         filt = c.args.get("filter")
         if isinstance(filt, _Call):
             walk(filt)
@@ -632,28 +706,24 @@ def _resolve_open_time_ranges(node, idx, index_name: str, call):
     def rewrite(c):
         if not isinstance(c, _Call):
             return
-        if c.name == "Row" and (("from" in c.args) != ("to" in c.args)):
-            fname = None
-            try:
-                fname = c.field_arg()
-            except Exception:  # noqa: BLE001
-                pass
-            if fname in bounds:
-                span = bounds[fname]
-                if span is None:
-                    # no time views anywhere: concrete empty range
-                    c.args["from"] = _EMPTY_RANGE_TS
-                    c.args["to"] = _EMPTY_RANGE_TS
-                else:
-                    lo, hi = span
-                    # same widening as executor._clamp_to_views: the
-                    # max view START plus the widest view unit (a year
-                    # view covers 366 days of data)
-                    if "from" not in c.args:
-                        c.args["from"] = lo.strftime(TIME_FORMAT)
-                    if "to" not in c.args:
-                        c.args["to"] = (hi + _dt.timedelta(days=366)
-                                        ).strftime(TIME_FORMAT)
+        f = _call_time_field(idx, c)
+        if (f is not None and f.name in bounds
+                and _needs_time_bounds(c, f)):
+            span = bounds[f.name]
+            if span is None:
+                # no time views anywhere: concrete empty range
+                c.args["from"] = _EMPTY_RANGE_TS
+                c.args["to"] = _EMPTY_RANGE_TS
+            else:
+                lo, hi = span
+                # same widening as executor._clamp_to_views: the
+                # max view START plus the widest view unit (a year
+                # view covers 366 days of data)
+                if "from" not in c.args:
+                    c.args["from"] = lo.strftime(TIME_FORMAT)
+                if "to" not in c.args:
+                    c.args["to"] = (hi + _dt.timedelta(days=366)
+                                    ).strftime(TIME_FORMAT)
         filt = c.args.get("filter")
         if isinstance(filt, _Call):
             rewrite(filt)
@@ -772,6 +842,13 @@ def _fold_query(call):
         if any(k is None or k is _EMPTY_TREE for k in kids):
             return None
         return _Call(call.name, dict(call.args), kids)
+    if call.name in BITMAP_ROOTS:
+        folded = _fold_bitmap_tree(call)
+        if folded is None or folded is _EMPTY_TREE:
+            # whole-tree-empty: the scatter path's native sentinel
+            # handling answers with the reference's empty-row semantics
+            return None
+        return folded
     return None  # GroupBy children are Rows calls, not bitmap algebra
 
 
@@ -799,7 +876,8 @@ def _check_collective(node, index_name: str, pql: str,
     if len(calls) != 1:
         return "multi-call query", None, None
     call = calls[0]
-    if call.name not in ("Count", "Sum", "Min", "Max", "TopN", "GroupBy"):
+    if (call.name not in ("Count", "Sum", "Min", "Max", "TopN", "GroupBy")
+            and call.name not in BITMAP_ROOTS):
         # cheap refusal BEFORE any translation: writes and other
         # non-collective calls must not pay a cloned translate (with
         # create=True key allocation for Set) that the scatter path
@@ -837,7 +915,8 @@ def _check_collective(node, index_name: str, pql: str,
     return None, pql, call
 
 
-def try_collective(node, index_name: str, pql: str):
+def try_collective(node, index_name: str, pql: str,
+                   exclude_row_attrs: bool = False):
     """Coordinator-side upgrade of one user query to collective SPMD
     execution.  Returns a result list, or None to fall back to the
     scatter-gather plane (not applicable, a peer refused during the
@@ -876,6 +955,7 @@ def try_collective(node, index_name: str, pql: str):
         return None
     if not cluster.is_coordinator or cluster.state != STATE_NORMAL:
         return None
+    user_pql = pql
     reason, pql, tcall = _check_collective(node, index_name, pql,
                                            translate=True)
     if reason is not None:
@@ -931,11 +1011,32 @@ def try_collective(node, index_name: str, pql: str):
         for t in threads:
             t.join(timeout=60)
         # ids -> keys in the result, at the origin only (the reference's
-        # translateResults, executor.go:2781).  Guarded: a concurrent
-        # index delete or a transient read-through translate failure
-        # must fall back, never 500 an answerable query.
+        # translateResults, executor.go:2781), plus row-attr attachment
+        # for plain Row results (executor.go:206 — coordinator-side
+        # only; attr stores are AE-synced and peers discard).  Guarded:
+        # a concurrent index delete or a transient read-through
+        # translate failure must fall back, never 500 an answerable
+        # query.
         try:
             idx = node.holder.index(index_name)
+            from pilosa_tpu.models.row import Row as _Row
+
+            if (isinstance(result, _Row) and not exclude_row_attrs
+                    and tcall.name == "Row"
+                    and not tcall.has_condition_arg()):
+                # attach only when the USER wrote a literal Row():
+                # sentinel folding can collapse Union(Row, ghost) to a
+                # Row, but the scatter plane (and the reference,
+                # executor.go:206) key off the original call name —
+                # the planes must serialize identically
+                from pilosa_tpu.pql import parse as _parse
+
+                if _parse(user_pql).calls[0].name == "Row":
+                    fname = tcall.field_arg()
+                    rowid = tcall.args.get(fname)
+                    f = idx.field(fname)
+                    if f is not None and isinstance(rowid, int):
+                        result.attrs = f.row_attrs.attrs(rowid)
             result = node.executor._translate_result(idx, tcall, result)
         except Exception as e:  # noqa: BLE001
             _bump("collective_fallbacks")
@@ -1003,6 +1104,15 @@ class CollectiveExecutor:
             return False
 
     def _supported(self, call) -> bool:
+        if call.name in BITMAP_ROOTS:
+            # bare bitmap result: the whole tree evaluates as one
+            # collective program and the global Row gathers replicated
+            # — bounded by the gather ceiling (wider indexes scatter)
+            n_shards = len(self.idx.available_shards())
+            if n_shards * bm.n_words(SHARD_WIDTH) * 4 \
+                    > MAX_ROW_GATHER_BYTES:
+                return False
+            return self._tree_ok(call)
         if call.name == "Count":
             return (len(call.children) == 1
                     and self._tree_ok(call.children[0]))
@@ -1026,8 +1136,8 @@ class CollectiveExecutor:
                 return False
             return not call.children or self._tree_ok(call.children[0])
         if call.name == "GroupBy":
-            if not 1 <= len(call.children) <= 3:
-                return False  # deeper nests: scatter path's level walk
+            if not call.children:
+                return False
             if any(a in call.args for a in ("previous", "aggregate",
                                             "having")):
                 return False
@@ -1038,8 +1148,11 @@ class CollectiveExecutor:
                          or child.args.get("field"))
                 if not fname or not self._plain_field(fname):
                     return False
-                if any(a in child.args for a in ("from", "to")):
-                    return False  # time-constrained children: scatter
+                if self.idx.field(fname).options.no_standard_view:
+                    continue  # constant-empty child (see _group_by)
+                if (self._child_constrained(child)
+                        and self._child_selection_views(child) is None):
+                    return False  # unresolved/oversized time cover
             filt = call.call_arg("filter")
             return filt is None or self._tree_ok(filt)
         return False
@@ -1052,7 +1165,7 @@ class CollectiveExecutor:
         return self.idx.field(name) is not None
 
     def _tree_ok(self, call) -> bool:
-        if call.name == "Row":
+        if call.name in ("Row", "Range"):
             if "from" in call.args or "to" in call.args:
                 fname = call.field_arg()
                 if not fname or not self._plain_field(fname):
@@ -1086,22 +1199,18 @@ class CollectiveExecutor:
     #: (an unclamped multi-century cover would compile huge programs)
     MAX_TIME_VIEWS = 256
 
-    def _time_views(self, call) -> list[str] | None:
-        """The covering view names for a Row(from=, to=), derived ONLY
-        from query text + the field's replicated quantum — every
-        process computes the identical list (a clamp against locally
-        present views, as the per-node fused path does, would diverge
-        the SPMD programs).  None = not collectively evaluable (bad
-        range, open-ended, or cover too wide)."""
+    def _views_for_range(self, f, from_arg, to_arg) -> list[str] | None:
+        """Covering view names for a concrete [from, to) on a time
+        field, derived ONLY from query text + the field's replicated
+        quantum — every process computes the identical list (a clamp
+        against locally present views, as the per-node fused path does,
+        would diverge the SPMD programs).  None = not collectively
+        evaluable (bad range, open-ended, or cover too wide)."""
         from pilosa_tpu.models.timequantum import (parse_time,
                                                    views_by_time_range)
 
-        fname = call.field_arg()
-        f = self._field(fname)
         if not f.time_quantum:
             return None
-        from_arg = call.args.get("from")
-        to_arg = call.args.get("to")
         if from_arg is None or to_arg is None:
             return None  # open-ended: needs the local clamp, scatter path
         try:
@@ -1116,6 +1225,41 @@ class CollectiveExecutor:
                                          f.time_quantum))
         return views if len(views) <= self.MAX_TIME_VIEWS else None
 
+    def _time_views(self, call) -> list[str] | None:
+        """Covering views for a Row(from=, to=)/Range call."""
+        f = self._field(call.field_arg())
+        return self._views_for_range(f, call.args.get("from"),
+                                     call.args.get("to"))
+
+    @staticmethod
+    def _child_constrained(child) -> bool:
+        """Does this GroupBy Rows child trigger the cluster-wide row
+        pre-selection (scatter: _execute_group_by pre-executes
+        _execute_rows for limit/column/previous)?"""
+        return any(child.uint_arg(k) is not None
+                   for k in ("limit", "column", "previous"))
+
+    def _child_selection_views(self, child) -> list[str] | None:
+        """View cover for a CONSTRAINED GroupBy Rows child's row
+        pre-selection, mirroring the scatter path (_execute_rows view
+        selection): a non-time field ignores from=/to= and selects
+        from standard; a time field selects from the covering time
+        views when from=/to= is present.  Counts always come from
+        viewStandard regardless (reference newGroupByIterator,
+        executor.go:3102); no-standard-view children never reach here
+        — both callers short-circuit them to the constant-empty
+        result first.  Returns view names, [] for a provably empty
+        range, or None when not collectively evaluable (open-ended
+        bounds must already be resolved by the coordinator's
+        _resolve_open_time_ranges rewrite)."""
+        fname = child.args.get("_field") or child.args.get("field")
+        f = self._field(fname)
+        if f.time_quantum and ("from" in child.args
+                               or "to" in child.args):
+            return self._views_for_range(f, child.args.get("from"),
+                                         child.args.get("to"))
+        return [VIEW_STANDARD]
+
     def execute(self, pql: str):
         from pilosa_tpu.pql import parse
 
@@ -1127,6 +1271,8 @@ class CollectiveExecutor:
             raise CollectiveError(f"unsupported collective call: "
                                   f"{call.name}")
         plan = self._plan()
+        if call.name in BITMAP_ROOTS:
+            return self._bitmap_row(call, plan)
         if call.name == "Count":
             stack = self._eval_stack(call.children[0], plan)
             per_shard = np.asarray(_jit_count(plan.mesh)(stack),
@@ -1155,9 +1301,27 @@ class CollectiveExecutor:
             np.zeros((len(plan.order), bm.n_words(SHARD_WIDTH)),
                      np.uint32), _sharding(plan, 1))
 
+    def _bitmap_row(self, call, plan: Plan):
+        """Bare bitmap tree -> global Row: evaluate the collective
+        program, all-gather the [G, words] result replicated, assemble
+        per-shard segments host-side (reference executeBitmapCall,
+        executor.go:651; cross-node merge row.go Merge — here the
+        merge IS the gather)."""
+        from pilosa_tpu.models.row import Row
+
+        stack = self._eval_stack(call, plan)
+        full = np.asarray(_jit_gather(plan.mesh)(stack))
+        segments: dict[int, np.ndarray] = {}
+        for gi, s in enumerate(plan.order):
+            if s >= 0 and full[gi].any():
+                # copy: a view would pin the whole gathered stack for
+                # as long as one sparse segment lives
+                segments[s] = full[gi].copy()
+        return Row(segments)
+
     def _eval_stack(self, call, plan: Plan):
         name = call.name
-        if name == "Row":
+        if name in ("Row", "Range"):
             if "from" in call.args or "to" in call.args:
                 views = self._time_views(call)
                 if views is None:
@@ -1261,20 +1425,26 @@ class CollectiveExecutor:
             out = getattr(out, reducer)(ValCount(v + f.options.base, c))
         return out
 
-    #: level-1 rows looped for a 3-child GroupBy (one filtered
-    #: pair-counts dispatch each); larger outer levels decline to the
-    #: scatter path rather than queue hundreds of device programs
-    MAX_TRIPLE_OUTER = 64
+    #: ceiling on the cartesian product of OUTER levels for a
+    #: >=3-child GroupBy (one filtered pair-counts dispatch per
+    #: combination); wider outer spaces decline to the scatter path
+    #: rather than queue hundreds of device programs
+    MAX_OUTER_DISPATCHES = 64
 
     def _group_by(self, call, plan: Plan):
-        """GroupBy over 1-3 Rows children: agreed row-id lists per
-        child, collective cartesian-counts programs, host assembly
-        in the executor's sorted-group order with offset-then-limit
-        (executor.go:1135-1149).  Three children run as a lockstep
-        loop over level-1's agreed rows — one filtered pair-counts
-        program per outer row, every process iterating the identical
-        list (reference analog: the groupByIterator's cartesian walk,
+        """GroupBy over N Rows children: agreed row-id lists per child
+        (over each child's view cover — time-constrained children scan
+        their covering time views), collective cartesian-counts
+        programs, host assembly in the executor's sorted-group order
+        with offset-then-limit (executor.go:1135-1149).  Three or more
+        children run as a lockstep loop over the outer levels'
+        cartesian product — one filtered pair-counts program per outer
+        combination, every process iterating the identical product
+        (reference analog: the groupByIterator's cartesian walk,
         executor.go:3058)."""
+        import itertools
+        import math
+
         from pilosa_tpu.parallel.results import FieldRow, GroupCount
 
         fields = []
@@ -1282,7 +1452,28 @@ class CollectiveExecutor:
         for child in call.children:
             fname = child.args.get("_field") or child.args.get("field")
             f = self._field(fname)
-            ids = agreed_row_ids(f)
+            if f.options.no_standard_view:
+                # the reference's iterator needs the standard fragment
+                # and bails per shard without it (newGroupByIterator,
+                # executor.go:3101-3104) — the whole GroupBy is empty
+                return []
+            # row pre-SELECTION cover: constrained children select
+            # over their time cover like the scatter pre-executed Rows
+            # query; unconstrained children list standard-view rows
+            # (from/to is ignored there, as the reference does).
+            # Counts below always come from viewStandard.
+            if self._child_constrained(child):
+                views = self._child_selection_views(child)
+                if views is None:
+                    raise CollectiveError(
+                        f"Rows({fname}) time cover not collectively "
+                        f"evaluable")
+                if not views:
+                    return []  # provably empty time range
+                sel_cover = tuple(views)
+            else:
+                sel_cover = (VIEW_STANDARD,)
+            ids = agreed_row_ids(f, sel_cover)
             if len(ids) > MAX_COLLECTIVE_ROWS:
                 raise CollectiveError(
                     f"field {fname!r} has {len(ids)} rows > "
@@ -1296,7 +1487,8 @@ class CollectiveExecutor:
             # restricted list, so the programs stay in lockstep.
             colarg = child.uint_arg("column")
             if colarg is not None and ids:
-                bitvec = global_column_bits(f, ids, colarg, plan)
+                bitvec = global_column_bits(f, ids, colarg, plan,
+                                            sel_cover)
                 ids = [r for r, bit in zip(ids, bitvec) if bit]
             prev = child.uint_arg("previous")
             if prev is not None:
@@ -1308,22 +1500,21 @@ class CollectiveExecutor:
                 return []
             fields.append(f)
             row_lists.append(ids)
-        if (len(row_lists) == 2 and
-                len(row_lists[0]) * len(row_lists[1]) > MAX_COLLECTIVE_PAIRS):
-            raise CollectiveError("GroupBy pair space too large for the "
-                                  "dense collective path")
-        if len(row_lists) == 3:
-            if len(row_lists[0]) > self.MAX_TRIPLE_OUTER:
-                raise CollectiveError(
-                    f"GroupBy outer level has {len(row_lists[0])} rows "
-                    f"> {self.MAX_TRIPLE_OUTER}; scatter path walks it")
-            if (len(row_lists[0]) * len(row_lists[1]) * len(row_lists[2])
-                    > MAX_COLLECTIVE_PAIRS):
-                # the TOTAL group space is what the host accumulates —
-                # bounding only the inner pair space would admit
-                # outer x pairs ~ 64x the 2-child ceiling
-                raise CollectiveError("GroupBy triple space too large "
-                                      "for the dense collective path")
+        if (len(row_lists) >= 2 and
+                math.prod(len(l) for l in row_lists)
+                > MAX_COLLECTIVE_PAIRS):
+            # the TOTAL group space is what the host accumulates —
+            # bounding only the inner pair space would admit
+            # outer x pairs far past the 2-child ceiling
+            raise CollectiveError("GroupBy group space too large for "
+                                  "the dense collective path")
+        if (len(row_lists) >= 3 and
+                math.prod(len(l) for l in row_lists[:-2])
+                > self.MAX_OUTER_DISPATCHES):
+            raise CollectiveError(
+                f"GroupBy outer levels span more than "
+                f"{self.MAX_OUTER_DISPATCHES} combinations; scatter "
+                f"path walks them")
         filt_call = call.call_arg("filter")
         filt = (self._eval_stack(filt_call, plan)
                 if filt_call is not None else None)
@@ -1353,22 +1544,27 @@ class CollectiveExecutor:
                         (fields[1].name, int(rb_ids[j])))] = \
                     int(counts[i, j])
         else:
-            mat_b = global_matrix_stack(fields[1], row_lists[1], plan)
-            mat_c = global_matrix_stack(fields[2], row_lists[2], plan)
-            rb_ids = np.asarray(row_lists[1])
-            rc_ids = np.asarray(row_lists[2])
+            mat_b = global_matrix_stack(fields[-2], row_lists[-2], plan)
+            mat_c = global_matrix_stack(fields[-1], row_lists[-1], plan)
+            rb_ids = np.asarray(row_lists[-2])
+            rc_ids = np.asarray(row_lists[-1])
             totals = {}
-            for a in row_lists[0]:
-                fa = global_row_stack(fields[0], a, plan)
+            for combo in itertools.product(*row_lists[:-2]):
+                fa = None
+                for f_o, rid in zip(fields[:-2], combo):
+                    stack = global_row_stack(f_o, rid, plan)
+                    fa = stack if fa is None else bm.b_and(fa, stack)
                 if filt is not None:
                     fa = bm.b_and(fa, filt)
                 per_shard = _jit_pair_counts(plan.mesh, True)(
                     mat_b, mat_c, fa)
                 counts = np.asarray(per_shard, dtype=np.int64).sum(axis=0)
+                prefix = tuple((f_o.name, rid) for f_o, rid
+                               in zip(fields[:-2], combo))
                 for j, k in np.argwhere(counts > 0):
-                    totals[((fields[0].name, a),
-                            (fields[1].name, int(rb_ids[j])),
-                            (fields[2].name, int(rc_ids[k])))] = \
+                    totals[prefix
+                           + ((fields[-2].name, int(rb_ids[j])),
+                              (fields[-1].name, int(rc_ids[k])))] = \
                         int(counts[j, k])
         out = [GroupCount(group=[FieldRow(field=fn, row_id=r)
                                  for fn, r in key], count=c)

@@ -318,16 +318,68 @@ class TestSingleProcessCollective:
 
     def test_unsupported_calls_refused(self, single):
         h, ce, ex, bits, vals = single
-        for pql in ("Row(f=0)", "MinRow(field=f)",
-                    "GroupBy(Rows(f), Rows(f), Rows(f), Rows(f))",  # >3
+        for pql in ("MinRow(field=f)",
                     "GroupBy(Rows(f), previous=1)",
                     "Count(Row(f=0, from='2019-01-01T00:00'))",
+                    # bare open-ended time Row: needs the coordinator's
+                    # bounds resolution, declined at the evaluator
+                    "Row(f=0, from='2019-01-01T00:00')",
                     # attrName without a list attrValues is the scatter
                     # path's user error; malformed tanimoto likewise
                     'TopN(f, attrName="x")',
                     "TopN(f, Row(f=0), tanimotoThreshold=101)"):
             with pytest.raises(spmd.CollectiveError):
                 ce.execute(pql)
+
+    def test_bare_bitmap_parity(self, single):
+        """Bare bitmap trees — the single most ordinary PQL read —
+        return a global Row assembled from the replicated gather,
+        exactly matching the scatter executor (round-4 VERDICT #3;
+        reference executeBitmapCall, executor.go:651)."""
+        h, ce, ex, bits, vals = single
+        for pql in ("Row(f=0)",
+                    "Union(Row(f=0), Row(f=1), Row(f=2))",
+                    "Intersect(Row(f=0), Row(f=1))",
+                    "Difference(Row(f=2), Row(f=3))",
+                    "Xor(Row(f=1), Row(f=2))",
+                    "Shift(Row(f=0), n=5)",
+                    "Row(v > 100000)",
+                    "Row(v >< [-100, 50000])"):
+            got = ce.execute(pql)
+            want = ex.execute("i", pql)[0]
+            assert got == want, (pql, len(got.columns()),
+                                 len(want.columns()))
+        # oracle spot-checks (not just plane agreement)
+        got = ce.execute("Union(Row(f=0), Row(f=1))")
+        assert sorted(int(c) for c in got.columns()) == \
+            sorted(bits[0] | bits[1])
+        got = ce.execute("Row(v > 100000)")
+        assert sorted(int(c) for c in got.columns()) == \
+            sorted(c for c, x in vals.items() if x > 100000)
+
+    def test_wide_group_by_parity(self, single):
+        """4+-child GroupBy runs collectively via the outer cartesian
+        lockstep loop (round-4 VERDICT #3)."""
+        h, ce, ex, bits, vals = single
+        g = h.index("i").field("g")
+        if g is None:
+            g = h.index("i").create_field("g")
+            rows_l, cols_l = [], []
+            for row in range(3):
+                for c in sorted(bits[row])[:150]:
+                    rows_l.append(row)
+                    cols_l.append(c)
+            g.import_bits(rows_l, cols_l)
+        for pql in ("GroupBy(Rows(f), Rows(g), Rows(f), Rows(g))",
+                    "GroupBy(Rows(f), Rows(g), Rows(f), Rows(g), "
+                    "filter=Row(f=0))",
+                    "GroupBy(Rows(f, limit=2), Rows(g), Rows(f), "
+                    "Rows(g), limit=30, offset=4)",
+                    "GroupBy(Rows(g), Rows(g), Rows(f), Rows(g), "
+                    "Rows(f))"):
+            got = ce.execute(pql)
+            want = ex.execute("i", pql)[0]
+            assert got == want, (pql, got[:4], want[:4])
 
     def test_group_by_constrained_children_parity(self, single):
         """Rows-child limit/column/previous constraints match the
@@ -347,6 +399,51 @@ class TestSingleProcessCollective:
                     "GroupBy(Rows(f, limit=3), Rows(f, previous=0), "
                     "filter=Row(f=2))",
                     "GroupBy(Rows(f, column=999999999))"):  # absent col
+            got = ce.execute(pql)
+            want = ex.execute("i", pql)[0]
+            assert got == want, (pql, got, want)
+
+    def test_group_by_time_children_parity(self, single):
+        """Time-constrained GroupBy Rows children match the scatter
+        path's reference-faithful semantics (executor.go:1104-1117 +
+        newGroupByIterator executor.go:3102): from/to bites only
+        through the constrained-child row pre-selection; counts always
+        come from the standard view; a no-standard-view child empties
+        the whole GroupBy."""
+        import datetime as dt
+
+        from pilosa_tpu.models.field import FieldOptions as FO
+
+        h, ce, ex, bits, vals = single
+        idx = h.index("i")
+        t = idx.create_field("t", FO.time_field("YMD"))
+        ns = idx.create_field("ns", FO.time_field(
+            "YMD", no_standard_view=True))
+        rng = random.Random(55)
+        for fld in (t, ns):
+            rows_l, cols_l, ts_l = [], [], []
+            for row in range(3):
+                for c in sorted(bits[row])[:80]:
+                    rows_l.append(row)
+                    cols_l.append(c)
+                    ts_l.append(dt.datetime(2020, rng.randrange(1, 13),
+                                            rng.randrange(1, 28)))
+            fld.import_bits(rows_l, cols_l, ts_l)
+        for pql in (
+                # unconstrained: from/to ignored (reference semantics)
+                "GroupBy(Rows(t, from='2020-03-01T00:00', "
+                "to='2020-06-01T00:00'))",
+                # constrained: selection honors the time cover
+                "GroupBy(Rows(t, from='2020-03-01T00:00', "
+                "to='2020-06-01T00:00', limit=2))",
+                "GroupBy(Rows(t, from='2020-02-01T00:00', "
+                "to='2020-11-01T00:00', previous=0), Rows(f))",
+                "GroupBy(Rows(f), Rows(t, from='2020-01-01T00:00', "
+                "to='2021-01-01T00:00', limit=2), Rows(f))",
+                # no-standard-view children: constant empty
+                "GroupBy(Rows(ns))",
+                "GroupBy(Rows(ns, limit=3))",
+                "GroupBy(Rows(ns), Rows(f))"):
             got = ce.execute(pql)
             want = ex.execute("i", pql)[0]
             assert got == want, (pql, got, want)
@@ -721,6 +818,42 @@ class TestSingleProcessCollective:
             Call("Xor", children=[E, row, Call("Row", {"f": 2})]))
         assert x.name == "Xor" and len(x.children) == 2
 
+    def test_row_attr_attachment_matches_scatter_plane(
+            self, tmp_path, monkeypatch):
+        """Row attrs attach for a LITERAL user Row() only — a tree that
+        sentinel-folds down to a Row must serialize identically on both
+        planes (the reference attaches only for Row calls,
+        executor.go:206)."""
+        from pilosa_tpu.parallel.node import ClusterNode
+
+        h = Holder(str(tmp_path / "h"))
+        cluster = Cluster(local_id="n0")
+        cluster.add_node(Node(id="n0", uri="local"))
+        cluster.coordinator_id = "n0"
+        cluster.set_state("NORMAL")
+        node = ClusterNode(h, cluster)
+        idx = h.create_index("i")
+        idx.create_field("kf", FieldOptions.set_field(keys=True))
+        for col, key in [(1, "alice"), (2, "alice"), (3, "bob")]:
+            node.executor.execute("i", f'Set({col}, kf="{key}")')
+        node.executor.execute(
+            "i", 'SetRowAttrs(kf, "alice", color="red")')
+        monkeypatch.setattr(spmd, "collective_available", lambda: True)
+        try:
+            q = 'Row(kf="alice")'
+            r_coll = spmd.try_collective(node, "i", q)[0]
+            r_scat = node.executor.execute("i", q)[0]
+            assert r_coll.attrs == r_scat.attrs == {"color": "red"}
+            assert r_coll == r_scat
+            # folded Union(Row, ghost) -> Row: neither plane attaches
+            q = 'Union(Row(kf="alice"), Row(kf="ghost"))'
+            u_coll = spmd.try_collective(node, "i", q)[0]
+            u_scat = node.executor.execute("i", q)[0]
+            assert u_coll == u_scat
+            assert u_coll.attrs == u_scat.attrs == {}
+        finally:
+            h.close()
+
     def test_rank_convention_checker(self, single):
         h, ce, ex, bits, vals = single
         # single process: rank 0 must be the sorted position of "n0"
@@ -903,6 +1036,26 @@ want_tnt = sorted(((r, len(cc & bits[0])) for r, cc in bits.items()),
                   key=lambda rc: (-rc[1], rc[0]))
 want_tnt = [(r, cnt) for r, cnt in want_tnt if cnt >= 1][:2]
 assert [(p.id, p.count) for p in tnt] == want_tnt, tnt
+# bare bitmap results: the global Row gathers replicated; segments
+# must match the oracle's columns exactly on EVERY process
+br = ce.execute("Row(f=2)")
+assert sorted(int(x) for x in br.columns()) == sorted(bits[2]), "bareRow"
+br = ce.execute("Union(Row(f=0), Row(f=1))")
+assert sorted(int(x) for x in br.columns()) == \
+    sorted(bits[0] | bits[1]), "bareUnion"
+br = ce.execute("Difference(Row(f=0), Row(f=1), Row(f=2))")
+assert sorted(int(x) for x in br.columns()) == \
+    sorted(bits[0] - bits[1] - bits[2]), "bareDiff"
+# 4-child GroupBy: outer cartesian lockstep loop across processes
+import itertools as _it
+gb4 = ce.execute("GroupBy(Rows(f), Rows(f), Rows(f), Rows(f))")
+want_gb4 = sorted(
+    ((a, b, cc_, d), len(bits[a] & bits[b] & bits[cc_] & bits[d]))
+    for a, b, cc_, d in _it.product(sorted(bits), repeat=4)
+    if bits[a] & bits[b] & bits[cc_] & bits[d])
+assert [tuple(fr.row_id for fr in g.group) for g in gb4] == \
+    [k for k, _ in want_gb4], "gb4 keys"
+assert [g.count for g in gb4] == [n for _, n in want_gb4], "gb4 counts"
 
 # cross-check the collective data plane against the HTTP control plane.
 # Two phases with a control-plane barrier between: an HTTP scatter-
@@ -956,6 +1109,19 @@ if pid == 0:
             break
     assert spmd.counters()["collective_initiated"] > before_t, \
         "open-ended time query never ran collectively in 5 attempts"
+    # bare Row over HTTP: the most ordinary PQL query upgrades to the
+    # collective plane end-to-end (translate -> gather -> serialize)
+    r_pql = "Union(Row(f=0), Row(f=1))"
+    before_r = spmd.counters()["collective_initiated"]
+    for attempt in range(5):
+        got = c.post_json(srv.uri + "/index/i/query",
+                          {"query": r_pql})["results"][0]
+        assert sorted(got["columns"]) == sorted(bits[0] | bits[1]), \
+            "bare row HTTP result"
+        if spmd.counters()["collective_initiated"] > before_r:
+            break
+    assert spmd.counters()["collective_initiated"] > before_r, \
+        "bare row query never ran collectively in 5 attempts"
     assert spmd.counters()["collective_joined"] == 0  # only peers join
     open(f"{data}/product_done.ok", "w").write("1")
 else:

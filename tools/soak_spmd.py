@@ -164,7 +164,15 @@ CMPS = {"<": _op.lt, "<=": _op.le, ">": _op.gt, ">=": _op.ge,
 def gen_query(r):
     """-> (pql, oracle_fn) — oracle_fn() computed lazily AFTER this
     round's writes land in the shared state."""
-    kind = r.randrange(8)
+    kind = r.randrange(10)
+    if kind == 8:
+        # bare bitmap tree: the global Row gathers replicated (round 4)
+        text, acc = gen_tree(r, 2)
+        return text, (lambda a=acc: sorted(a)), "row"
+    if kind == 9:
+        text, acc = gen_tree(r, 1)
+        return (f"Not({text})",
+                lambda a=acc: sorted(exists - a), "row")
     if kind == 7:
         # Not rides the existence field: oracle = every column ever
         # Set/imported minus the subtree (Clear never clears _exists,
@@ -216,7 +224,9 @@ def gen_query(r):
             return t[:n] if n else t
         return f"TopN({', '.join(args)})", topn, "pairs"
     if kind == 5:
-        nch = r.randrange(1, 4)
+        # up to 4 children: the outer cartesian loop stays within
+        # MAX_OUTER_DISPATCHES (5 rows/field -> <=25 combos)
+        nch = r.randrange(1, 5)
         fis = [r.randrange(3) for _ in range(nch)]
         children = ", ".join(f"Rows(f{fi})" for fi in fis)
         def gb(fis=tuple(fis)):
@@ -315,6 +325,9 @@ while True:
             g = [(tuple((fr.field, fr.row_id) for fr in gc.group),
                   gc.count) for gc in got]
             assert g == want, (R, q, g, want)
+        elif shape == "row":
+            assert sorted(int(x) for x in got.columns()) == want, \
+                (R, q, len(got.columns()), len(want))
         checked += 1
     barrier(f"q{R}")
 
@@ -324,6 +337,13 @@ while True:
     # checked above on every round
     if R % 5 == 0 and pid == 0:
         for q, coll in answers:
+            if hasattr(coll, "columns"):  # bare Row: compare columns
+                http = c.post_json(srv.uri + "/index/i/query",
+                                   {"query": q})["results"][0]
+                assert sorted(http.get("columns", [])) == \
+                    sorted(int(x) for x in coll.columns()), (R, q)
+                xchecks += 1
+                continue
             if not isinstance(coll, int):
                 continue
             http = c.post_json(srv.uri + "/index/i/query",
