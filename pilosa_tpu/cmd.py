@@ -153,6 +153,9 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         _tracing.set_global_tracer(exporter)
     elif cfg.tracing.enabled:
         _tracing.set_global_tracer(_tracing.MemTracer())
+    from pilosa_tpu.runtime import filebudget
+
+    filebudget.set_cap(cfg.max_wal_files)
     srv = Server(
         cfg.expanded_data_dir(),
         host=cfg.host,

@@ -87,6 +87,9 @@ class Config:
     verbose: bool = False
     log_path: str = ""
     max_writes_per_request: int = 5000
+    # process-wide cap on long-lived WAL fds (reference syswrap
+    # max-file-count, syswrap/os.go:41); runtime/filebudget.py LRU
+    max_wal_files: int = 512
     heartbeat_interval: float = 0.0  # seconds; 0 disables the detector
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
@@ -174,6 +177,7 @@ class Config:
             f"verbose = {str(self.verbose).lower()}",
             f'log-path = "{self.log_path}"',
             f"max-writes-per-request = {self.max_writes_per_request}",
+            f"max-wal-files = {self.max_wal_files}",
             f"heartbeat-interval = {self.heartbeat_interval}",
             "",
             "[cluster]",

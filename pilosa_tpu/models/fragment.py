@@ -33,6 +33,7 @@ import numpy as np
 
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.runtime import filebudget
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 DEFAULT_MAX_OP_N = 10000
@@ -99,7 +100,11 @@ class Fragment:
         if path is not None:
             os.makedirs(os.path.dirname(path), exist_ok=True)
             self._load()
-            self._wal = open(self._wal_path, "ab")
+            # budgeted: the process-wide fd cap may transparently close
+            # and reopen this between appends (reference syswrap
+            # OpenFile cap, syswrap/os.go:41) — ~9.5k open fragments at
+            # the 10B scale must not blow ulimit -n
+            self._wal = filebudget.open_append(self._wal_path)
             # A persisted .cache is exact only for a WAL-clean reopen
             # (fragment.go:2403 .cache files).
             if self._op_n == 0:
@@ -203,8 +208,7 @@ class Fragment:
 
     def _wal_append(self, data: bytes) -> None:
         if self._wal is not None:
-            self._wal.write(data)
-            self._wal.flush()
+            self._wal.write(data)  # BudgetedAppendFile flushes per write
 
     def snapshot(self) -> None:
         """Atomically persist the full matrix and truncate the WAL
@@ -232,13 +236,19 @@ class Fragment:
                 ops_at_swap = self._op_n
                 if old_wal is not None:
                     old_wal.close()
-                self._wal = open(self._wal_new_path, "wb")
+                self._wal = filebudget.open_append(self._wal_new_path,
+                                                   truncate=True)
             except BaseException:
                 # phase-1 failure (ENOSPC/EMFILE/MemoryError) must not
                 # wedge the fragment: restore an appendable WAL handle
                 # and clear the in-progress flag
                 try:
-                    self._wal = open(self._wal_path, "ab")
+                    if old_wal is not None:
+                        # idempotent; without it an early raise (e.g.
+                        # MemoryError in _stacked) would strand the old
+                        # handle registered in the fd budget forever
+                        old_wal.close()
+                    self._wal = filebudget.open_append(self._wal_path)
                 except OSError:
                     # reopen failed too — keep the CLOSED old handle so
                     # the next write fails LOUDLY (ValueError) instead
@@ -264,10 +274,17 @@ class Fragment:
             with self._lock:
                 if ok:
                     # commit the overflow segment as the new WAL (the
-                    # snapshot incorporated everything before it); valid
-                    # even if close() ran during phase 2 — only a file
-                    # rename, the open handle follows the inode
-                    os.replace(self._wal_new_path, self._wal_path)
+                    # snapshot incorporated everything before it).
+                    # rename_to keeps the budgeted handle's reopen path
+                    # in lockstep with the rename — an eviction/reopen
+                    # straddling a bare os.replace would resurrect the
+                    # old path and strand acked records there
+                    if self._wal is not None:
+                        self._wal.rename_to(self._wal_path)
+                    else:
+                        # close() ran during phase 2: only the rename
+                        # remains (no live handle to retarget)
+                        os.replace(self._wal_new_path, self._wal_path)
                     self._op_n -= ops_at_swap
                     if not self._closed:
                         self.topn_cache.save(self._cache_path, gen)
@@ -282,7 +299,7 @@ class Fragment:
                         w.write(nf.read())
                     os.remove(self._wal_new_path)
                     if not self._closed:
-                        self._wal = open(self._wal_path, "ab")
+                        self._wal = filebudget.open_append(self._wal_path)
                 self._snapshotting = False
                 self._snap_done.notify_all()
 

@@ -701,11 +701,12 @@ class Handler:
         # routing through any one server's registry — compaction
         # starvation must be alert-able from any node's /metrics.
         from pilosa_tpu.parallel import spmd
-        from pilosa_tpu.runtime import prewarm, snapqueue
+        from pilosa_tpu.runtime import filebudget, prewarm, snapqueue
 
         text += snapqueue.prometheus_lines()
         text += prewarm.prometheus_lines()
         text += spmd.prometheus_lines()
+        text += filebudget.prometheus_lines()
         self._bytes(req, text.encode(), "text/plain; version=0.0.4")
 
     @route("GET", "/diagnostics")
