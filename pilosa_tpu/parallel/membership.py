@@ -1,19 +1,42 @@
-"""Membership liveness: heartbeat-based failure detection.
+"""Membership liveness: SWIM-style failure detection over the control
+plane.
 
-Parity target: the reference's gossip/SWIM membership (gossip/gossip.go
-memberlist delegate) and its false-down protection — a suspect node is
-dialed repeatedly before being declared DOWN (cluster.go:1724
-confirmNodeDown, 10 retries).  The TPU-native design replaces UDP gossip
-with direct heartbeats over the DCN control plane: every node pings its
-peers each round; state changes broadcast as node-state messages and the
-NORMAL/DEGRADED state machine reacts (cluster.go:571-583).
+Parity target: the reference's gossip/SWIM membership
+(gossip/gossip.go:43-612, hashicorp memberlist delegate) and its
+false-down protection — a suspect node is dialed repeatedly before
+being declared DOWN (cluster.go:1724 confirmNodeDown, 10 retries).
+The TPU-native design keeps the request/response DCN control plane
+(no UDP) but adopts SWIM's scalable shape (round 4, VERDICT #5):
+
+- **k-random probing**: each round a node probes ``PROBE_FANOUT``
+  random peers, not every peer — cluster-wide load is O(N·k) messages
+  per round instead of the previous serial O(N²) sweep.
+- **Concurrent probes with a deadline**: the round's pings run on
+  worker threads and the round waits at most ``PROBE_DEADLINE_S`` —
+  one slow peer no longer stretches every node's detection latency,
+  and confirm-down retries run inside the suspect's own worker rather
+  than blocking the sweep inline.
+- **Indirect probing** (SWIM ping-req): a failed direct probe asks
+  ``INDIRECT_PROBES`` other peers to dial the suspect before any
+  confirm round — a broken prober↔suspect link does not produce a
+  false DOWN.
+- **Piggybacked dissemination**: pings carry the prober's node-state
+  view and responses carry the responder's; DISAGREEMENTS become
+  next-round probe hints, never blind state writes (a stale gossiped
+  DOWN cannot flap a healthy node — every state change still goes
+  through this node's own confirm machinery).  Confirmed changes
+  broadcast as ``node-state`` messages exactly as before.
 
 Query-time replica failover (executor mapReduce re-mapping,
-executor.go:2492) is independent of this detector — it handles mid-query
-loss; the detector handles steady-state routing (DOWN primaries are
-skipped up front in shards_by_node)."""
+executor.go:2492) is independent of this detector — it handles
+mid-query loss; the detector handles steady-state routing (DOWN
+primaries are skipped up front in shards_by_node)."""
 
 from __future__ import annotations
+
+import random as _random
+import threading
+import time
 
 from pilosa_tpu.parallel.cluster import (
     NODE_DOWN,
@@ -21,17 +44,57 @@ from pilosa_tpu.parallel.cluster import (
     TransportError,
 )
 
+#: direct probes per round (SWIM k); every peer is still probed when
+#: the cluster is smaller than k, so small clusters detect in 1 round
+PROBE_FANOUT = 3
+
+#: peers asked to dial a suspect on our behalf after a failed direct
+#: probe (SWIM ping-req fan-out)
+INDIRECT_PROBES = 2
+
+#: wall-clock bound on one round's concurrent probe phase
+PROBE_DEADLINE_S = 5.0
+
 # Dial attempts before declaring a node DOWN (cluster.go:1724 uses 10
-#×1s; the control plane here is request/response so 3 suffices).
+# ×1s; the control plane here is request/response so 3 suffices).
 CONFIRM_RETRIES = 3
 
 
 def ping(node, target) -> bool:
+    ok, _ = ping_with_states(node, target, piggyback=False)
+    return ok
+
+
+def ping_with_states(node, target, piggyback: bool = True):
+    """-> (alive, responder_node_states | None).  With ``piggyback``
+    the request carries our state view so the responder can hint-check
+    disagreements on its next round."""
+    msg: dict = {"type": "ping"}
+    if piggyback:
+        msg["states"] = {n.id: n.state
+                        for n in node.cluster.sorted_nodes()}
     try:
-        resp = node.cluster.transport.send_message(target, {"type": "ping"})
-        return bool(resp.get("ok"))
+        resp = node.cluster.transport.send_message(target, msg)
+        return bool(resp.get("ok")), resp.get("node_states")
     except TransportError:
-        return False
+        return False, None
+
+
+def indirect_probe(node, target, peers, rng,
+                   n_relays: int = INDIRECT_PROBES) -> bool:
+    """SWIM ping-req: ask up to ``n_relays`` other live peers to dial
+    the suspect; True if any relay reaches it."""
+    relays = [p for p in peers
+              if p.id != target.id and p.state != NODE_DOWN]
+    for relay in rng.sample(relays, min(n_relays, len(relays))):
+        try:
+            resp = node.cluster.transport.send_message(
+                relay, {"type": "ping-req", "target": target.id})
+            if resp.get("ok") and resp.get("alive"):
+                return True
+        except TransportError:
+            continue
+    return False
 
 
 def confirm_down(node, target) -> bool:
@@ -43,24 +106,112 @@ def confirm_down(node, target) -> bool:
     return True
 
 
-def heartbeat_round(node) -> dict[str, str]:
-    """One liveness sweep over all peers; returns {node_id: new_state}
-    for nodes whose state changed.  State changes are applied locally
-    and broadcast (reference: memberlist events -> cluster.ReceiveEvent,
-    cluster.go:1754)."""
+#: guards every node's hint set: the bus ping handler adds hints on a
+#: transport thread while the heartbeat loop pops them — an
+#: unsynchronized swap would orphan a concurrent add's whole batch
+_hints_lock = threading.Lock()
+
+
+def take_hints(node) -> set:
+    """Pop the node ids queued for a priority probe (piggybacked
+    disagreements recorded by the bus ping handler or a prior round)."""
+    with _hints_lock:
+        hints = getattr(node, "_membership_hints", set())
+        node._membership_hints = set()
+        return hints
+
+
+def add_hints(node, node_ids) -> None:
+    with _hints_lock:
+        hints = getattr(node, "_membership_hints", None)
+        if hints is None:
+            hints = node._membership_hints = set()
+        hints.update(node_ids)
+
+
+def heartbeat_round(node, k: int = PROBE_FANOUT,
+                    rng=None,
+                    deadline_s: float = PROBE_DEADLINE_S) -> dict[str, str]:
+    """One SWIM round: k random peers (plus any hinted suspects) probed
+    CONCURRENTLY under one deadline; failed probes escalate through
+    indirect ping-req, then confirm-down; confirmed changes apply
+    locally and broadcast (reference: memberlist events ->
+    cluster.ReceiveEvent, cluster.go:1754).  Returns {node_id:
+    new_state} for nodes whose state changed."""
     cluster = node.cluster
     if cluster.transport is None:
         return {}
-    changes: dict[str, str] = {}
-    for target in cluster.sorted_nodes():
-        if target.id == cluster.local_id:
-            continue
-        alive = ping(node, target)
+    rng = rng or _random
+    peers = [p for p in cluster.sorted_nodes()
+             if p.id != cluster.local_id]
+    if not peers:
+        return {}
+    # probe set: hinted disagreements first (they were gossiped —
+    # verify them ourselves), then k random peers.  take_hints pops
+    # the set ONCE — calling it per element would empty it mid-scan
+    hinted = take_hints(node)
+    targets = {p.id: p for p in peers if p.id in hinted}
+    pool = [p for p in peers if p.id not in targets]
+    if pool:
+        for p in rng.sample(pool, min(k, len(pool))):
+            targets[p.id] = p
+
+    # round-private state, guarded: an abandoned straggler thread can
+    # finish its confirm while the round thread snapshots — unguarded,
+    # the dict/set copy races a concurrent resize
+    round_lock = threading.Lock()
+    results: dict[str, str] = {}
+    gossip_hints: set[str] = set()
+    done: set[str] = set()
+
+    def probe(target) -> None:
+        try:
+            _probe(target)
+        except Exception:  # noqa: BLE001 — a probe thread must never
+            # surface an exception: abandoned stragglers can run past
+            # the round (even past test teardown); any failure simply
+            # means no result for this round
+            pass
+
+    def _probe(target) -> None:
+        alive, their_states = ping_with_states(node, target)
+        if their_states:
+            hint = {nid for nid, st in their_states.items()
+                    if nid != cluster.local_id
+                    and (known := cluster.node(nid)) is not None
+                    and known.state != st}
+            if hint:
+                with round_lock:
+                    gossip_hints.update(hint)
+        if not alive:
+            alive = indirect_probe(node, target, peers, rng)
+        change = None
         if not alive and target.state != NODE_DOWN:
             if confirm_down(node, target):
-                changes[target.id] = NODE_DOWN
+                change = NODE_DOWN
         elif alive and target.state == NODE_DOWN:
-            changes[target.id] = NODE_READY
+            change = NODE_READY
+        with round_lock:
+            if change is not None:
+                results[target.id] = change
+            done.add(target.id)
+
+    threads = [threading.Thread(target=probe, args=(t,), daemon=True)
+               for t in targets.values()]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + deadline_s
+    for t in threads:
+        t.join(timeout=max(0.0, deadline - time.monotonic()))
+    # stragglers past the deadline are abandoned (daemon threads); a
+    # late result for THIS round is simply dropped — the next round
+    # re-probes.  Changes apply on the round's thread only.
+    with round_lock:
+        changes = dict(results)
+        pending = set(gossip_hints)
+    # hinted suspects whose probe was abandoned keep their priority:
+    # re-queue them so the next round re-probes first
+    add_hints(node, (pending | (hinted - done)) - set(changes))
     for nid, state in changes.items():
         cluster.set_node_state(nid, state)
         node.broadcast({"type": "node-state", "node": nid, "state": state})

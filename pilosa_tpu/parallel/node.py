@@ -244,7 +244,35 @@ class ClusterNode:
         elif t == "holder-cleanup":
             self.cleanup_unowned()
         elif t == "ping":
-            return {"ok": True, "state": self.cluster.state}
+            # piggybacked dissemination (SWIM, membership.py): the
+            # prober's state view rides the ping; disagreements queue
+            # as PROBE HINTS for our next round — never blind state
+            # writes, so stale gossip cannot flap a healthy node
+            states = msg.get("states") or {}
+            disagree = []
+            for nid, st in states.items():
+                if nid == self.cluster.local_id:
+                    continue
+                known = self.cluster.node(nid)
+                if known is not None and known.state != st:
+                    disagree.append(nid)
+            if disagree:
+                from pilosa_tpu.parallel import membership
+
+                membership.add_hints(self, disagree)
+            return {"ok": True, "state": self.cluster.state,
+                    "node_states": {n.id: n.state
+                                    for n in self.cluster.sorted_nodes()}}
+        elif t == "ping-req":
+            # SWIM indirect probe: dial the suspect on the prober's
+            # behalf (a broken prober<->suspect link must not produce
+            # a false DOWN)
+            from pilosa_tpu.parallel import membership
+
+            target = self.cluster.node(msg.get("target", ""))
+            alive = (target is not None and target.id != self.cluster.local_id
+                     and membership.ping(self, target))
+            return {"ok": True, "alive": bool(alive)}
         elif t == "collective-time-bounds":
             # open-ended time-range resolution: report this process's
             # local view time span per field so the coordinator can
