@@ -538,6 +538,52 @@ def main():
     if got6b != want6b:
         rec6b["correctness_failure"] = f"union count {got6b} != {want6b}"
     out.append(rec6b)
+
+    # The import-roaring fast path (reference api.go:368 ImportRoaring
+    # -> roaring.ImportRoaringBits, roaring/roaring.go:1511 — its
+    # fastest ingest).  Payloads are PRE-ENCODED per shard (matching
+    # the reference benchmark shape: the server-side rate is what's
+    # measured).  Two densities: the protobuf row's sparse 2M-random
+    # shape (worst case for bitmap merge — ~1 bit per 64-bit word),
+    # and a 10x-denser bulk-load shape where container merges amortize.
+    from pilosa_tpu.storage import roaring as _rcodec
+
+    for label, nb, row0 in (("sparse", n_bits, 200),
+                            ("dense", 10 * n_bits, 300)):
+        rng_r = np.random.default_rng(7 + nb)
+        rows_r = rng_r.integers(row0, row0 + 64, nb, dtype=np.int64)
+        cols_r = rng_r.integers(0, 9 * SHARD_WIDTH, nb, dtype=np.int64)
+        shard_r = cols_r // SHARD_WIDTH
+        pos_r = (rows_r * SHARD_WIDTH
+                 + (cols_r % SHARD_WIDTH)).astype(np.uint64)
+        payloads = {}
+        uniq_total = 0
+        for s in range(9):
+            u = np.unique(pos_r[shard_r == s])
+            uniq_total += len(u)
+            k_, w_ = _rcodec.positions_to_containers(u)
+            payloads[s] = _rcodec.encode(k_, w_)
+        wire_b = sum(len(v) for v in payloads.values())
+        t0 = _now()
+        for s, data in payloads.items():
+            client.import_roaring(s0.uri, "c", "f", s, data)
+        dtr = _now() - t0
+        got_r = post("/index/c/query", {"query": "Count(Union("
+                     + ", ".join(f"Row(f={r})"
+                                 for r in range(row0, row0 + 64))
+                     + "))"})["results"][0]
+        want_r = len(np.unique(cols_r))
+        rec_r = {"config": 6,
+                 "metric": f"import_roaring_mbits_per_s_{label}",
+                 "value": round(uniq_total / dtr / 1e6, 2),
+                 "unit": "Mbits/s", "bits": uniq_total,
+                 "wire_mb_per_s": round(wire_b / dtr / 1e6, 1),
+                 "wall_s": round(dtr, 2), "exact": got_r == want_r}
+        if got_r != want_r:
+            rec_r["correctness_failure"] = \
+                f"union count {got_r} != {want_r}"
+        out.append(rec_r)
+
     client.close()
     s0.close(); s1.close(); s2.close()
 

@@ -10,6 +10,7 @@ tests — share one enforcement point.
 
 from __future__ import annotations
 
+import base64 as _b64mod
 import io
 
 import numpy as np
@@ -334,20 +335,44 @@ class API:
                 pass
 
     def import_roaring(self, index: str, field: str, shard: int,
-                       views: dict[str, bytes], clear: bool = False) -> None:
+                       views: dict[str, bytes], clear: bool = False,
+                       remote: bool = False) -> None:
         """Merge serialized roaring bitmaps per view into one shard's
-        fragments (api.go:368 API.ImportRoaring)."""
+        fragments, replicated to every shard owner (api.go:368
+        API.ImportRoaring: the origin forwards to all owners with
+        remote=true; remote receivers apply locally only)."""
         self._validate("import_roaring")
+        from pilosa_tpu.models.field import FieldType
         from pilosa_tpu.models.view import VIEW_STANDARD
 
         f = self.field(index, field)
-        for vname, data in views.items():
-            if not vname:
-                vname = VIEW_STANDARD
-            view = f.create_view_if_not_exists(vname)
-            frag = view.create_fragment_if_not_exists(shard)
-            frag.import_roaring(data, clear=clear)
-            f._note_shard(shard)
+        if f.options.type not in (FieldType.SET, FieldType.TIME):
+            raise ApiError("roaring import is only supported for set "
+                           "and time fields")
+
+        def apply_local() -> None:
+            for vname, data in views.items():
+                view = f.create_view_if_not_exists(vname or VIEW_STANDARD)
+                frag = view.create_fragment_if_not_exists(shard)
+                frag.import_roaring(data, clear=clear)
+                f._note_shard(shard)
+
+        if remote or not self._clustered():
+            apply_local()
+            return
+        known_shards = f.available_shards()
+        payload = {
+            "type": "import-roaring",
+            "index": index,
+            "field": field,
+            "shard": shard,
+            "views": {vname: _b64mod.b64encode(data).decode()
+                      for vname, data in views.items()},
+            "clear": clear,
+        }
+        self._send_to_owners(index, shard, payload, local_fn=apply_local)
+        self._note_shard_everywhere(f, index, field, shard,
+                                    known=shard in known_shards)
 
     def export_csv(self, index: str, field: str, shard: int, w: io.TextIOBase) -> None:
         """Write `row,col` (or translated keys) CSV for one shard

@@ -45,8 +45,10 @@ _SNAP_HEADER = struct.Struct("<4sIIQ")  # magic, version, width_exp, n_rows
 _WAL_SET = 1
 _WAL_CLEAR = 2
 _WAL_BULK = 3
+_WAL_ROARING = 4
 _WAL_REC = struct.Struct("<BQQ")  # op, row, col-offset
 _WAL_BULK_HDR = struct.Struct("<BQQ")  # op, n_set, n_clear
+_WAL_ROARING_HDR = struct.Struct("<BQQ")  # op, blob_len, clear-flag
 
 
 class Fragment:
@@ -203,6 +205,21 @@ class Fragment:
                 off += 8 * n_clear
                 self._apply_bulk(sets.astype(np.int64), clears.astype(np.int64))
                 self._op_n += n_set + n_clear
+            elif op == _WAL_ROARING:
+                blob_len, clear_flag = a, b
+                if off + blob_len > n:
+                    break  # torn roaring record: crash mid-append
+                blob = bytes(buf[off:off + blob_len])
+                off += blob_len
+                try:
+                    # re-merge is idempotent and replays IN ORDER, so
+                    # re-applying a record the snapshot already holds
+                    # reaches the same end state (last-writer-wins per
+                    # position, like set/clear replay)
+                    self._op_n += self._merge_roaring(
+                        blob, clear=bool(clear_flag))
+                except Exception:  # noqa: BLE001 — corrupt blob: stop
+                    break  # like any torn/corrupt tail
             else:
                 break  # corrupt/torn record; ignore tail (same as op-log replay stop)
 
@@ -539,57 +556,97 @@ class Fragment:
         """Bulk-merge a serialized roaring bitmap in fragment position
         space (pos = row*width + off) — the fastest ingest path
         (reference fragment.importRoaring, fragment.go:2255, via
-        roaring.ImportRoaringBits).  Durability: the changed-bit deltas
-        append to the WAL as one bulk record (the reference's op-log
-        batching, fragment.go:84), so chunked streaming imports stay
-        linear instead of re-snapshotting the fragment per chunk."""
+        roaring.ImportRoaringBits).  Durability: the WHOLE payload
+        appends to the WAL as one roaring record (replay re-merges it;
+        idempotent and in-order, so recovery is exact) — logging the
+        blob instead of extracted per-bit deltas keeps the hot path
+        free of bit-position expansion AND writes ~15x less WAL than
+        8-byte-per-bit delta records at typical densities."""
+        with self._lock:
+            changed = self._merge_roaring(data, clear)
+            if changed:
+                self._wal_append(
+                    _WAL_ROARING_HDR.pack(_WAL_ROARING, len(data),
+                                          1 if clear else 0) + data)
+                self._op_n += changed
+                self._gen += 1
+                self._maybe_snapshot()
+            self._paranoia_check()
+
+    def _merge_roaring(self, data: bytes, clear: bool) -> int:
+        """In-memory merge of a roaring payload; returns the number of
+        bits actually flipped.  Caller holds the lock (or is _load
+        replay, which is single-threaded).  Containers arrive sorted by
+        key, so each row is one contiguous run — every container's
+        current words gather into ONE matrix, the diff is one op, and
+        the changed-bit count is a popcount reduce; no per-container
+        Python loop, no bit-position expansion.  Chunked so a dense
+        whole-fragment archive never materializes more than ~3x 64 MB
+        of temporaries."""
         from pilosa_tpu.storage import roaring as rcodec
 
         keys, cwords, _flags = rcodec.decode(data)
         cpr = self.width // rcodec.CONTAINER_BITS  # containers per row
-        delta_pos = []  # absolute fragment positions actually flipped
-        with self._lock:
-            for i in range(len(keys)):
-                k = int(keys[i])
-                row = k // cpr
-                lo = (k % cpr) * rcodec.WORDS_PER_CONTAINER
-                hi = lo + rcodec.WORDS_PER_CONTAINER
+        wpc = rcodec.WORDS_PER_CONTAINER
+        # drop empty containers up front (the set path must not
+        # materialize rows for them; decode may emit them)
+        if len(keys):
+            keep = cwords.any(axis=1)
+            if not keep.all():
+                keys, cwords = keys[keep], cwords[keep]
+        changed = 0
+        keys_i = keys.astype(np.int64)
+        # the batched merge requires sorted, UNIQUE keys (rows must be
+        # contiguous runs and the per-row fancy-index write-back is
+        # last-writer-wins on duplicate slots).  The format says keys
+        # are sorted, but decode accepts unsorted/duplicated wire
+        # payloads — normalize or such a blob silently corrupts rows
+        if len(keys_i) > 1:
+            if not np.all(keys_i[1:] > keys_i[:-1]):
+                order = np.argsort(keys_i, kind="stable")
+                keys_i = keys_i[order]
+                cwords = cwords[order]
+                dup = keys_i[1:] == keys_i[:-1]
+                if dup.any():
+                    uk, inv = np.unique(keys_i, return_inverse=True)
+                    merged = np.zeros((len(uk), cwords.shape[1]),
+                                      dtype=np.uint64)
+                    np.bitwise_or.at(merged, inv, cwords)
+                    keys_i, cwords = uk, merged
+        chunk = 8192  # containers per batch
+        for c0 in range(0, len(keys_i), chunk):
+            c1 = min(c0 + chunk, len(keys_i))
+            ck = keys_i[c0:c1]
+            cw = cwords[c0:c1]
+            rows_of = ck // cpr
+            slots_of = ck % cpr
+            urows, starts = np.unique(rows_of, return_index=True)
+            bounds = np.append(starts, len(ck))
+            cur = np.zeros((len(ck), wpc), dtype=np.uint64)
+            row_blocks = []
+            for ri in range(len(urows)):
+                row = int(urows[ri])
+                sel = slice(int(bounds[ri]), int(bounds[ri + 1]))
                 if clear:
                     arr = self._rows.get(row)
                     if arr is None:
                         continue
-                    w64 = arr.view(np.uint64)
-                    gone = w64[lo:hi] & cwords[i]
-                    if gone.any():
-                        bits = np.unpackbits(gone.view(np.uint8), bitorder="little")
-                        delta_pos.append(
-                            np.uint64(k << 16) + np.nonzero(bits)[0].astype(np.uint64)
-                        )
-                        w64[lo:hi] &= ~cwords[i]
                 else:
-                    if not cwords[i].any():
-                        continue
                     arr = self._row_array(row, create=True)
-                    w64 = arr.view(np.uint64)
-                    new = cwords[i] & ~w64[lo:hi]
-                    if new.any():
-                        bits = np.unpackbits(new.view(np.uint8), bitorder="little")
-                        delta_pos.append(
-                            np.uint64(k << 16) + np.nonzero(bits)[0].astype(np.uint64)
-                        )
-                        w64[lo:hi] |= cwords[i]
-            if delta_pos:
-                pos = np.concatenate(delta_pos)
-                sets = pos if not clear else np.empty(0, dtype=np.uint64)
-                clears = pos if clear else np.empty(0, dtype=np.uint64)
-                self._wal_append(
-                    _WAL_BULK_HDR.pack(_WAL_BULK, len(sets), len(clears))
-                    + sets.tobytes() + clears.tobytes()
-                )
-                self._op_n += len(pos)
-                self._gen += 1
-                self._maybe_snapshot()
-            self._paranoia_check()
+                w64 = arr.view(np.uint64).reshape(cpr, wpc)
+                cur[sel] = w64[slots_of[sel]]
+                row_blocks.append((w64, sel))
+            delta = (cur & cw) if clear else (cw & ~cur)
+            n_flip = int(np.bitwise_count(delta).sum())
+            if not n_flip:
+                continue
+            changed += n_flip
+            for w64, sel in row_blocks:
+                if clear:
+                    w64[slots_of[sel]] = cur[sel] & ~cw[sel]
+                else:
+                    w64[slots_of[sel]] = cur[sel] | cw[sel]
+        return changed
 
     def to_roaring(self) -> bytes:
         """Serialize the whole fragment as one roaring bitmap in fragment

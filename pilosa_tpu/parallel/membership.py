@@ -60,12 +60,24 @@ PROBE_DEADLINE_S = 5.0
 CONFIRM_RETRIES = 3
 
 
-def ping(node, target) -> bool:
-    ok, _ = ping_with_states(node, target, piggyback=False)
+def _send(transport, target, msg, timeout=None):
+    """Transport send with an optional per-dial bound.  Feature-
+    detected (``send_message_timeout`` on HTTPTransport): test fabrics
+    and wrappers that only implement ``send_message`` keep working."""
+    f = getattr(transport, "send_message_timeout", None)
+    if f is not None and timeout is not None:
+        return f(target, msg, timeout)
+    return transport.send_message(target, msg)
+
+
+def ping(node, target, timeout: float | None = None) -> bool:
+    ok, _ = ping_with_states(node, target, piggyback=False,
+                             timeout=timeout)
     return ok
 
 
-def ping_with_states(node, target, piggyback: bool = True):
+def ping_with_states(node, target, piggyback: bool = True,
+                     timeout: float | None = None):
     """-> (alive, responder_node_states | None).  With ``piggyback``
     the request carries our state view so the responder can hint-check
     disagreements on its next round."""
@@ -74,22 +86,24 @@ def ping_with_states(node, target, piggyback: bool = True):
         msg["states"] = {n.id: n.state
                         for n in node.cluster.sorted_nodes()}
     try:
-        resp = node.cluster.transport.send_message(target, msg)
+        resp = _send(node.cluster.transport, target, msg, timeout)
         return bool(resp.get("ok")), resp.get("node_states")
     except TransportError:
         return False, None
 
 
 def indirect_probe(node, target, peers, rng,
-                   n_relays: int = INDIRECT_PROBES) -> bool:
+                   n_relays: int = INDIRECT_PROBES,
+                   timeout: float | None = None) -> bool:
     """SWIM ping-req: ask up to ``n_relays`` other live peers to dial
     the suspect; True if any relay reaches it."""
     relays = [p for p in peers
               if p.id != target.id and p.state != NODE_DOWN]
     for relay in rng.sample(relays, min(n_relays, len(relays))):
         try:
-            resp = node.cluster.transport.send_message(
-                relay, {"type": "ping-req", "target": target.id})
+            resp = _send(node.cluster.transport, relay,
+                         {"type": "ping-req", "target": target.id},
+                         timeout)
             if resp.get("ok") and resp.get("alive"):
                 return True
         except TransportError:
@@ -97,11 +111,11 @@ def indirect_probe(node, target, peers, rng,
     return False
 
 
-def confirm_down(node, target) -> bool:
+def confirm_down(node, target, timeout: float | None = None) -> bool:
     """True if the target is really unreachable after retries
     (cluster.go:1724 confirmNodeDown)."""
     for _ in range(CONFIRM_RETRIES):
-        if ping(node, target):
+        if ping(node, target, timeout=timeout):
             return False
     return True
 
@@ -173,8 +187,16 @@ def heartbeat_round(node, k: int = PROBE_FANOUT,
             # means no result for this round
             pass
 
+    # per-dial budget: the worst escalation chain is 1 direct + 2
+    # indirect + 3 confirm = 6 sequential dials, and a dead host that
+    # swallows packets costs a full timeout per dial — the chain must
+    # finish INSIDE the round deadline or the confirm result would be
+    # dropped every round and the node never marked DOWN
+    per_dial = max(0.2, deadline_s / 8.0)
+
     def _probe(target) -> None:
-        alive, their_states = ping_with_states(node, target)
+        alive, their_states = ping_with_states(node, target,
+                                               timeout=per_dial)
         if their_states:
             hint = {nid for nid, st in their_states.items()
                     if nid != cluster.local_id
@@ -184,10 +206,11 @@ def heartbeat_round(node, k: int = PROBE_FANOUT,
                 with round_lock:
                     gossip_hints.update(hint)
         if not alive:
-            alive = indirect_probe(node, target, peers, rng)
+            alive = indirect_probe(node, target, peers, rng,
+                                   timeout=per_dial)
         change = None
         if not alive and target.state != NODE_DOWN:
-            if confirm_down(node, target):
+            if confirm_down(node, target, timeout=per_dial):
                 change = NODE_DOWN
         elif alive and target.state == NODE_DOWN:
             change = NODE_READY
@@ -210,8 +233,12 @@ def heartbeat_round(node, k: int = PROBE_FANOUT,
         changes = dict(results)
         pending = set(gossip_hints)
     # hinted suspects whose probe was abandoned keep their priority:
-    # re-queue them so the next round re-probes first
-    add_hints(node, (pending | (hinted - done)) - set(changes))
+    # re-queue them so the next round re-probes first.  Restricted to
+    # CURRENT peers — a hint naming a node a resize removed would
+    # otherwise re-queue forever (it can never be probed or done)
+    peer_ids = {p.id for p in peers}
+    add_hints(node,
+              ((pending | (hinted - done)) - set(changes)) & peer_ids)
     for nid, state in changes.items():
         cluster.set_node_state(nid, state)
         node.broadcast({"type": "node-state", "node": nid, "state": state})

@@ -122,6 +122,24 @@ class ClusterNode:
                 f = idx.field(msg["field"])
                 if f is not None:
                     f._note_shard(int(msg["shard"]))
+        elif t == "import-roaring":
+            # replica delivery of a roaring import (api.import_roaring
+            # origin fan-out; reference client.ImportRoaring remote=true)
+            import base64 as _b64i
+
+            from pilosa_tpu.models.view import VIEW_STANDARD
+
+            idx = self.holder.index(msg["index"])
+            f = None if idx is None else idx.field(msg["field"])
+            if f is None:
+                return {"ok": False, "error": "field not found"}
+            shard = int(msg["shard"])
+            for vname, b in (msg.get("views") or {}).items():
+                view = f.create_view_if_not_exists(vname or VIEW_STANDARD)
+                frag = view.create_fragment_if_not_exists(shard)
+                frag.import_roaring(_b64i.b64decode(b),
+                                    clear=bool(msg.get("clear")))
+                f._note_shard(shard)
         elif t == "import":
             idx = self.holder.index(msg["index"])
             f = None if idx is None else idx.field(msg["field"])
@@ -270,8 +288,11 @@ class ClusterNode:
             from pilosa_tpu.parallel import membership
 
             target = self.cluster.node(msg.get("target", ""))
+            # bounded relay dial: the prober gave up on its own short
+            # budget; this handler thread must not sit on a 30 s
+            # default timeout for a packet-swallowing dead host
             alive = (target is not None and target.id != self.cluster.local_id
-                     and membership.ping(self, target))
+                     and membership.ping(self, target, timeout=2.0))
             return {"ok": True, "alive": bool(alive)}
         elif t == "collective-time-bounds":
             # open-ended time-range resolution: report this process's

@@ -49,19 +49,19 @@ class InternalClient:
 
     # ------------------------------------------------------------- basics
 
-    def _connect(self, scheme: str, netloc: str):
+    def _connect(self, scheme: str, netloc: str,
+                 timeout: float | None = None):
         import http.client
         import socket
         import ssl as _ssl
 
+        t = self.timeout if timeout is None else timeout
         if scheme == "https":
             ctx = self._ssl_ctx or _ssl.create_default_context()
-            conn = http.client.HTTPSConnection(netloc,
-                                               timeout=self.timeout,
+            conn = http.client.HTTPSConnection(netloc, timeout=t,
                                                context=ctx)
         else:
-            conn = http.client.HTTPConnection(netloc,
-                                              timeout=self.timeout)
+            conn = http.client.HTTPConnection(netloc, timeout=t)
         conn.connect()
         # Nagle + delayed-ACK stalls kill keep-alive RPC latency (the
         # header and body go out as separate small segments); urllib
@@ -69,13 +69,14 @@ class InternalClient:
         conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return conn
 
-    def _acquire(self, scheme: str, netloc: str):
+    def _acquire(self, scheme: str, netloc: str,
+                 timeout: float | None = None):
         """-> (connection, came_from_pool)"""
         with self._pool_lock:
             idle = self._pool.get((scheme, netloc))
             if idle:
                 return idle.pop(), True
-        return self._connect(scheme, netloc), False
+        return self._connect(scheme, netloc, timeout), False
 
     def close(self) -> None:
         """Drop every pooled connection and refuse re-pooling from
@@ -103,7 +104,8 @@ class InternalClient:
     def _request(self, method: str, url: str, body: bytes | None = None,
                  ctype: str = "application/json",
                  accept: str | None = None,
-                 error_decoder=None) -> bytes:
+                 error_decoder=None,
+                 timeout: float | None = None) -> bytes:
         """One transport path for JSON and protobuf requests over
         pooled keep-alive connections; ``error_decoder(raw) -> str``
         extracts the error detail from a non-2xx body (default: JSON
@@ -139,10 +141,18 @@ class InternalClient:
             try:
                 # _acquire may CONNECT (refused/unreachable raises here,
                 # inside the same error mapping as request IO)
-                conn, pooled = self._acquire(parts.scheme, parts.netloc)
+                conn, pooled = self._acquire(parts.scheme, parts.netloc,
+                                             timeout)
+                if timeout is not None and conn.sock is not None:
+                    # per-call override (membership probes need dials
+                    # far shorter than the pooled default); restored
+                    # before the connection re-pools below
+                    conn.sock.settimeout(timeout)
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
                 raw = resp.read()
+                if timeout is not None and conn.sock is not None:
+                    conn.sock.settimeout(self.timeout)
             except (ConnectionError, TimeoutError, OSError,
                     _hc.HTTPException) as e:
                 if conn is not None:
@@ -206,8 +216,12 @@ class InternalClient:
         d = proto.decode(proto.QUERY_RESPONSE, raw)
         return [proto.proto_to_result(r) for r in d["results"]]
 
-    def send_message(self, uri: str, message: dict) -> dict:
-        return self._json("POST", f"{uri}/internal/cluster/message", message)
+    def send_message(self, uri: str, message: dict,
+                     timeout: float | None = None) -> dict:
+        body = json.dumps(message).encode()
+        raw = self._request("POST", f"{uri}/internal/cluster/message",
+                            body, timeout=timeout)
+        return json.loads(raw or b"null")
 
     # ------------------------------------------------------------- schema
 
@@ -313,3 +327,11 @@ class HTTPTransport(Transport):
 
     def send_message(self, node: Node, message: dict) -> dict:
         return self.client.send_message(node.uri, message)
+
+    def send_message_timeout(self, node: Node, message: dict,
+                             timeout: float) -> dict:
+        """Bounded-dial variant for membership probes: a dead host
+        that swallows packets must fail the ping at the probe budget,
+        not the pooled connection's 30 s default."""
+        return self.client.send_message(node.uri, message,
+                                        timeout=timeout)

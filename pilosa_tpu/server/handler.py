@@ -614,7 +614,8 @@ class Handler:
             views = {"": body}
         self.api.import_roaring(path["index"], path["field"],
                                 int(path["shard"]), views,
-                                clear=clear)
+                                clear=clear,
+                                remote=params.get("remote") == "true")
         self._import_ok(req)
 
     @route("GET", "/export")
