@@ -48,6 +48,46 @@ def payload(node) -> dict:
 _START_TIME = time.time()
 
 
+def compare_versions(current: str, latest: str) -> bool:
+    """True when ``latest`` is strictly newer than ``current`` —
+    numeric dotted compare with a lenient tail (the reference's
+    VersionSegments compare, diagnostics.go:230 compareVersions)."""
+    def segs(v: str) -> list[int]:
+        v = v.lstrip("v").split("-")[0].split("+")[0]
+        out = []
+        for part in v.split("."):
+            digits = "".join(ch for ch in part if ch.isdigit())
+            out.append(int(digits) if digits else 0)
+        return out
+    a, b = segs(current), segs(latest)
+    n = max(len(a), len(b))
+    a += [0] * (n - len(a))
+    b += [0] * (n - len(b))
+    return b > a
+
+
+def check_version(fetch=None) -> dict:
+    """Update-check surface (reference diagnostics.go CheckVersion,
+    which polls the install server hourly).  This build NEVER phones
+    home (the documented local-only deviation): with no ``fetch`` the
+    check reports itself disabled; an operator can wire ``fetch`` — a
+    zero-arg callable returning the latest version string from their
+    own mirror — and gets the reference's compare/report behavior."""
+    out: dict = {"version": VERSION}
+    if fetch is None:
+        out["updateCheck"] = "disabled (local-only diagnostics; " \
+                             "wire a fetcher to enable)"
+        return out
+    try:
+        latest = str(fetch())
+    except Exception as e:  # noqa: BLE001 — a broken mirror must not 500 /version
+        out["updateCheck"] = f"error: {e!r}"
+        return out
+    out["latest"] = latest
+    out["updateAvailable"] = compare_versions(VERSION, latest)
+    return out
+
+
 def runtime_gauges(stats) -> None:
     """One sweep of process gauges (server.go:813 monitorRuntime:
     goroutines -> threads, heap -> RSS, open FDs, GC collections)."""

@@ -12,10 +12,16 @@ import hashlib
 import json
 import sqlite3
 import threading
+from collections import OrderedDict
 
 # Attribute block size for anti-entropy diffs (reference attrBlockSize,
 # attr.go:80).
 ATTR_BLOCK_SIZE = 100
+
+#: read-cache entries per store (reference attrCacheSize LRU in front
+#: of BoltDB, attr.go:80) — hot TopN attr-filter scans must not hit
+#: SQLite per row
+ATTR_CACHE_SIZE = 8192
 
 
 class AttrStore:
@@ -30,47 +36,102 @@ class AttrStore:
             c.execute(
                 "CREATE TABLE IF NOT EXISTS attrs (id INTEGER PRIMARY KEY, data TEXT)"
             )
+        # LRU read cache (attr.go:80) holding the JSON STRING exactly
+        # as stored ("" = id absent, so hot attr-less rows skip SQLite
+        # too).  Caching the string rather than the parsed dict makes
+        # every read an independent json.loads — no shared mutable
+        # values, so a caller mutating its result (even nested lists)
+        # can never poison the cache.  Writes update the entry with
+        # the dump they computed anyway.
+        self._cache: OrderedDict[int, str] = OrderedDict()
+        self.cache_hits = 0
+        self.cache_misses = 0
 
     def _conn(self) -> sqlite3.Connection:
         return self._db
 
+    def _cache_put(self, id_: int, data: str) -> None:
+        # under self._lock
+        self._cache[id_] = data
+        self._cache.move_to_end(id_)
+        while len(self._cache) > ATTR_CACHE_SIZE:
+            self._cache.popitem(last=False)
+
+    def _data_locked(self, id_: int) -> str:
+        """Cached JSON string for one id ("" = absent); under
+        self._lock.  Counter-free — set_attrs' read-modify-write goes
+        through here so the hit/miss counters track READ traffic only
+        (they exist to size ATTR_CACHE_SIZE)."""
+        hit = self._cache.get(id_)
+        if hit is not None:
+            self._cache.move_to_end(id_)
+            return hit
+        cur = self._conn().execute("SELECT data FROM attrs WHERE id=?",
+                                   (id_,))
+        row = cur.fetchone()
+        data = row[0] if row else ""
+        self._cache_put(id_, data)
+        return data
+
     def attrs(self, id_: int) -> dict:
         with self._lock:
-            cur = self._conn().execute("SELECT data FROM attrs WHERE id=?", (id_,))
-            row = cur.fetchone()
-        return json.loads(row[0]) if row else {}
+            cached = id_ in self._cache
+            self.cache_hits += cached
+            self.cache_misses += not cached
+            data = self._data_locked(id_)
+        return json.loads(data) if data else {}
 
     def set_attrs(self, id_: int, attrs: dict) -> None:
         """Merge attrs into existing; None values delete keys (reference
         SetAttrs merge semantics, boltdb/attrstore.go:120)."""
         with self._lock:
-            cur = self.attrs(id_)
+            data = self._data_locked(id_)
+            cur = json.loads(data) if data else {}
             for k, v in attrs.items():
                 if v is None:
                     cur.pop(k, None)
                 else:
                     cur[k] = v
+            dumped = json.dumps(cur, sort_keys=True)
             with self._db as c:
                 c.execute(
                     "INSERT OR REPLACE INTO attrs (id, data) VALUES (?, ?)",
-                    (id_, json.dumps(cur, sort_keys=True)),
+                    (id_, dumped),
                 )
+            self._cache_put(id_, dumped)
 
     def attrs_bulk(self, ids) -> dict[int, dict]:
-        """Batched lookup: one IN-query per 500 ids (the per-id form
-        would hold the store lock once per column on columnAttrs
-        responses)."""
-        ids = [int(i) for i in ids]
+        """Batched lookup: cache hits first, then one IN-query per 500
+        missing ids (the per-id form would hold the store lock once per
+        column on columnAttrs responses); misses populate the cache."""
+        ids = [int(i) for i in dict.fromkeys(ids)]  # dedupe, keep order
         out: dict[int, dict] = {}
         with self._lock:
+            missing = []
+            for id_ in ids:
+                hit = self._cache.get(id_)
+                if hit is not None:
+                    self._cache.move_to_end(id_)
+                    self.cache_hits += 1
+                    if hit:  # attr-less ids stay absent, as before
+                        out[id_] = json.loads(hit)
+                else:
+                    missing.append(id_)
+            self.cache_misses += len(missing)
             con = self._conn()
-            for i in range(0, len(ids), 500):
-                chunk = ids[i:i + 500]
+            found = {}
+            for i in range(0, len(missing), 500):
+                chunk = missing[i:i + 500]
                 cur = con.execute(
                     "SELECT id, data FROM attrs WHERE id IN "
                     f"({','.join('?' * len(chunk))})", chunk)
                 for id_, data in cur.fetchall():
-                    out[int(id_)] = json.loads(data)
+                    found[int(id_)] = data
+            for id_ in missing:
+                data = found.get(id_, "")
+                self._cache_put(id_, data)
+                if data:
+                    out[id_] = json.loads(data)
         return out
 
     def set_bulk_attrs(self, attrs_by_id: dict[int, dict]) -> None:

@@ -165,6 +165,10 @@ class Handler:
         self.stats = stats
         self.tracer = tracer
         self.heap_frames = heap_frames  # ?start=1 tracemalloc depth
+        # optional zero-arg callable returning the latest released
+        # version string (diagnostics.check_version); None = the
+        # local-only default, never phones home
+        self.version_fetcher = None
         self.tls = bool(tls_cert)
         handler_self = self
 
@@ -322,7 +326,12 @@ class Handler:
 
     @route("GET", "/version")
     def handle_version(self, req, params, path, body):
-        self._json(req, {"version": self.api.version()})
+        # update-check surface (reference handleGetVersion +
+        # diagnostics CheckVersion); local-only by design — see
+        # diagnostics.check_version
+        from pilosa_tpu import diagnostics
+
+        self._json(req, diagnostics.check_version(self.version_fetcher))
 
     @route("GET", "/info")
     def handle_info(self, req, params, path, body):
