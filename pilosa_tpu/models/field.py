@@ -438,12 +438,12 @@ class Field:
             local = jax.local_devices()
             if len(local) > 1:
                 return pmesh.shard_stack(pmesh.local_device_mesh(), stack)
-            return jax.device_put(stack, local[0])
+            return bm.chunked_device_put(stack, local[0])
         if len(jax.devices()) > 1:
             from pilosa_tpu.parallel import mesh as pmesh
 
             return pmesh.shard_stack(pmesh.device_mesh(), stack)
-        return jax.device_put(stack)
+        return bm.chunked_device_put(stack)
 
     def device_time_row_stack(self, row_id: int, shards: tuple[int, ...],
                               view_names: tuple[str, ...]):

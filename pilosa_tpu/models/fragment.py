@@ -820,7 +820,7 @@ class Fragment:
             from pilosa_tpu.ops import bitmap as bm
 
             dev = (np.ascontiguousarray(matrix) if bm.host_mode()
-                   else jax.device_put(matrix))
+                   else bm.chunked_device_put(matrix))
             self._device_cache[key] = (self._gen, ids, dev)
             residency.manager().admit(self._device_cache, key,
                                       matrix.nbytes)

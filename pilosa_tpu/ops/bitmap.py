@@ -106,6 +106,37 @@ def unpack_positions(words: np.ndarray) -> np.ndarray:
     return np.nonzero(bits)[0].astype(np.int64)
 
 
+def chunked_device_put(stack: np.ndarray, device=None):
+    """device_put in bounded pieces (axis 0), concatenated ON device.
+    A single multi-GB transfer can wedge a constrained transport
+    end-to-end (the axon relay tunnel died mid-2.5 GB prewarm and took
+    the whole session's device access with it, BASELINE.md round 3);
+    chunking caps any one transfer at ``PILOSA_TPU_STAGE_CHUNK_MB``
+    and the per-piece block_until_ready doubles as a progress
+    keepalive.  DISABLED by default (0): on a real host a single DMA
+    put is pipelined and needs no extra HBM, while chunk+concatenate
+    holds pieces and result alive together (~2x peak) — constrained
+    transports opt in at their entry points (measure.py pins 16 MB
+    when staging rides the relay tunnel)."""
+    import os as _os
+
+    chunk_bytes = int(float(_os.environ.get(
+        "PILOSA_TPU_STAGE_CHUNK_MB", "0")) * 1e6)
+    put = (lambda a: jax.device_put(a, device)) if device is not None \
+        else jax.device_put
+    if (not chunk_bytes or stack.nbytes <= chunk_bytes
+            or stack.ndim < 2):
+        return put(stack)
+    row_bytes = max(1, stack.nbytes // max(1, stack.shape[0]))
+    rows_per = max(1, chunk_bytes // row_bytes)
+    parts = []
+    for i in range(0, stack.shape[0], rows_per):
+        d = put(np.ascontiguousarray(stack[i:i + rows_per]))
+        d.block_until_ready()
+        parts.append(d)
+    return jnp.concatenate(parts, axis=0)
+
+
 def pack_positions_matrix(rows_cols, row_ids, nbits: int) -> np.ndarray:
     """Pack (row, col) pairs into a dense [len(row_ids), nbits/32] matrix.
 

@@ -166,3 +166,24 @@ class TestProductIntegration:
             assert not frag._device_cache
         assert not f._row_stack_cache and not f._matrix_stack_cache
         assert residency.manager().stats()["total"] == 0
+
+
+def test_chunked_device_put_equivalence(monkeypatch):
+    """Chunked staging must produce the identical device array as one
+    device_put, at any chunk boundary (round 4, VERDICT #2: the relay
+    tunnel wedges on multi-GB single transfers; real hosts just see
+    back-to-back DMA pieces)."""
+    import numpy as np
+
+    from pilosa_tpu.ops import bitmap as bm
+
+    stack = np.arange(64 * 1024, dtype=np.uint32).reshape(64, 1024)
+    whole = np.asarray(bm.chunked_device_put(stack))
+    for mb in ("0.01", "0.1", "0"):  # tiny chunks and disabled
+        monkeypatch.setenv("PILOSA_TPU_STAGE_CHUNK_MB", mb)
+        got = np.asarray(bm.chunked_device_put(stack))
+        assert np.array_equal(got, whole), mb
+    # 1-D arrays pass through unchunked
+    monkeypatch.setenv("PILOSA_TPU_STAGE_CHUNK_MB", "0.0001")
+    one_d = np.arange(100000, dtype=np.int64)
+    assert np.array_equal(np.asarray(bm.chunked_device_put(one_d)), one_d)

@@ -123,7 +123,8 @@ def run(path: str, fill: bool = False) -> int:
                                              method="POST")
                 if body and body.lstrip().startswith("{"):
                     req.add_header("Content-Type", "application/json")
-                urllib.request.urlopen(req).read()
+                with urllib.request.urlopen(req) as resp:
+                    resp.read()
                 continue
             _, index, pql, expected, span = ev
             req = urllib.request.Request(
