@@ -41,12 +41,12 @@ drive both processes directly.  Supported calls: bare bitmap trees
 (Row/Union/Intersect/Difference/Xor/Not/Shift/Range — the result Row
 gathers replicated and the coordinator assembles segments), Count over
 those trees (incl. BSI-condition rows, the Range surface), Sum/Min/Max
-(optional filter), TopN (optional filter), GroupBy over N Rows
-children (incl. column/previous/limit constraints and time-constrained
-children via their agreed view cover).  Everything else stays on the
-scatter-gather path; key-translated queries translate before entering
-(the test covers raw ids).
-"""
+(optional filter), TopN (optional filter), MinRow/MaxRow (optional
+filter), Rows (incl. column/previous/limit and time covers), GroupBy
+over N Rows children (incl. column/previous/limit constraints and
+time-constrained children via their agreed view cover).  Everything
+else stays on the scatter-gather path; key-translated queries
+translate before entering (the test covers raw ids)."""
 
 from __future__ import annotations
 
@@ -591,27 +591,31 @@ def _call_time_field(idx, c):
     return f if (f is not None and f.time_quantum) else None
 
 
-def _needs_time_bounds(c, f) -> bool:
+def _needs_time_bounds(c, f, top: bool = False) -> bool:
     """Does this call carry an under-specified time range the
     coordinator must resolve to concrete global values?  Row/Range:
-    exactly one of from=/to=.  Rows (GroupBy children): any missing
-    bound once the time-view scan is engaged — which the scatter path
-    engages for from=/to= OR a no-standard-view field
-    (executor._execute_rows view selection)."""
+    exactly one of from=/to=.  Rows: a STANDALONE (top-level) call
+    engages the time-view scan for from/to or a no-standard-view
+    field and needs both bounds concrete; a GroupBy CHILD only needs
+    bounds when constrained (the pre-selection is the only place time
+    bites there — reference executeGroupBy pre-executes solely for
+    limit/column, executor.go:1104-1117, and newGroupByIterator
+    always scans viewStandard, executor.go:3102; a no-standard-view
+    child is constant-empty before any bound is consulted, so
+    resolving would only add a pointless peer round)."""
     has_from, has_to = "from" in c.args, "to" in c.args
     if c.name in ("Row", "Range"):
         return has_from != has_to
     if c.name == "Rows":
-        # from/to on an UNconstrained GroupBy child is ignored outright
-        # (reference executeGroupBy pre-executes the child Rows query —
-        # the only place time bounds bite — solely for limit/column,
-        # executor.go:1104-1117; newGroupByIterator always scans
-        # viewStandard, executor.go:3102).  A no-standard-view child
-        # makes the whole GroupBy empty regardless of bounds, so only
-        # a constrained child with exactly one bound needs resolution.
-        if not any(k in c.args for k in ("limit", "column", "previous")):
-            return False
+        if not top:
+            if f.options.no_standard_view:
+                return False  # constant-empty GroupBy child
+            if not any(k in c.args
+                       for k in ("limit", "column", "previous")):
+                return False  # unconstrained child: from/to ignored
         if f.options.no_standard_view:
+            return not (has_from and has_to)
+        if not (has_from or has_to):
             return False
         return has_from != has_to
     return False
@@ -626,19 +630,19 @@ def _open_time_fields(idx, call) -> set:
 
     out = set()
 
-    def walk(c):
+    def walk(c, top: bool) -> None:
         if not isinstance(c, _Call):
             return
         f = _call_time_field(idx, c)
-        if f is not None and _needs_time_bounds(c, f):
+        if f is not None and _needs_time_bounds(c, f, top=top):
             out.add(f.name)
         filt = c.args.get("filter")
         if isinstance(filt, _Call):
-            walk(filt)
+            walk(filt, False)
         for ch in c.children:
-            walk(ch)
+            walk(ch, False)
 
-    walk(call)
+    walk(call, True)
     return out
 
 
@@ -703,12 +707,12 @@ def _resolve_open_time_ranges(node, idx, index_name: str, call):
 
     from pilosa_tpu.pql import Call as _Call
 
-    def rewrite(c):
+    def rewrite(c, top: bool = False):
         if not isinstance(c, _Call):
             return
         f = _call_time_field(idx, c)
         if (f is not None and f.name in bounds
-                and _needs_time_bounds(c, f)):
+                and _needs_time_bounds(c, f, top=top)):
             span = bounds[f.name]
             if span is None:
                 # no time views anywhere: concrete empty range
@@ -730,7 +734,7 @@ def _resolve_open_time_ranges(node, idx, index_name: str, call):
         for ch in c.children:
             rewrite(ch)
 
-    rewrite(call)
+    rewrite(call, top=True)
     return call
 
 
@@ -836,7 +840,8 @@ def _fold_query(call):
         call = _Call(call.name, args, list(call.children))
     if not any(_has_sentinel(c) for c in call.children):
         return call if not _has_sentinel(call) else None
-    if call.name in ("Count", "Sum", "Min", "Max", "TopN"):
+    if call.name in ("Count", "Sum", "Min", "Max", "TopN",
+                     "MinRow", "MaxRow"):
         # the single child is a bitmap filter tree
         kids = [_fold_bitmap_tree(c) for c in call.children]
         if any(k is None or k is _EMPTY_TREE for k in kids):
@@ -876,7 +881,8 @@ def _check_collective(node, index_name: str, pql: str,
     if len(calls) != 1:
         return "multi-call query", None, None
     call = calls[0]
-    if (call.name not in ("Count", "Sum", "Min", "Max", "TopN", "GroupBy")
+    if (call.name not in ("Count", "Sum", "Min", "Max", "TopN", "GroupBy",
+                          "Rows", "MinRow", "MaxRow")
             and call.name not in BITMAP_ROOTS):
         # cheap refusal BEFORE any translation: writes and other
         # non-collective calls must not pay a cloned translate (with
@@ -1121,6 +1127,19 @@ class CollectiveExecutor:
             if not fname or not self._plain_field(fname):
                 return False
             return not call.children or self._tree_ok(call.children[0])
+        if call.name in ("MinRow", "MaxRow"):
+            fname = call.string_arg("field") or call.args.get("field")
+            if not fname or not self._plain_field(fname):
+                return False
+            return not call.children or self._tree_ok(call.children[0])
+        if call.name == "Rows":
+            fname = call.args.get("_field") or call.args.get("field")
+            if not fname or not self._plain_field(fname):
+                return False
+            # standalone Rows honors from/to (unlike GroupBy children):
+            # the cover must be collectively derivable
+            return self._rows_views(self.idx.field(fname), call) \
+                is not None
         if call.name == "TopN":
             fname = call.string_arg("_field") or call.args.get("_field")
             if not fname or not self._plain_field(fname):
@@ -1239,6 +1258,19 @@ class CollectiveExecutor:
         return any(child.uint_arg(k) is not None
                    for k in ("limit", "column", "previous"))
 
+    def _rows_views(self, f, call) -> list[str] | None:
+        """View cover for a STANDALONE Rows call, mirroring the scatter
+        path (_execute_rows view selection): a time field scans the
+        covering time views when from=/to= is present or the field has
+        no standard view (bounds must arrive concrete — the
+        coordinator's resolution rewrote open ends); everything else
+        scans standard and IGNORES from/to like the reference."""
+        if f.time_quantum and ("from" in call.args or "to" in call.args
+                               or f.options.no_standard_view):
+            return self._views_for_range(f, call.args.get("from"),
+                                         call.args.get("to"))
+        return [VIEW_STANDARD]
+
     def _child_selection_views(self, child) -> list[str] | None:
         """View cover for a CONSTRAINED GroupBy Rows child's row
         pre-selection, mirroring the scatter path (_execute_rows view
@@ -1286,6 +1318,10 @@ class CollectiveExecutor:
             return self._topn(call, plan)
         if call.name == "GroupBy":
             return self._group_by(call, plan)
+        if call.name == "Rows":
+            return self._rows(call, plan)
+        if call.name in ("MinRow", "MaxRow"):
+            return self._extreme_row(call, plan)
         raise CollectiveError(call.name)
 
     def _field(self, name: str):
@@ -1431,6 +1467,78 @@ class CollectiveExecutor:
     #: rather than queue hundreds of device programs
     MAX_OUTER_DISPATCHES = 64
 
+    def _restrict_agreed_ids(self, f, call, ids, plan: Plan,
+                             cover) -> list[int]:
+        """The executor's Rows constraint order over an agreed list —
+        column bit filter (one tiny collective; ceiling-guarded: the
+        [G, R] gather is the only dense operand here), then previous,
+        then limit (reference executeRows push-down,
+        executor.go:1040-1071).  Shared by standalone Rows and the
+        GroupBy constrained-child pre-selection so the lockstep-
+        critical logic cannot drift between them."""
+        colarg = call.uint_arg("column")
+        if colarg is not None and ids:
+            if len(ids) > MAX_COLLECTIVE_ROWS:
+                raise CollectiveError(
+                    f"column filter over {len(ids)} rows exceeds the "
+                    f"dense collective ceiling {MAX_COLLECTIVE_ROWS}")
+            bitvec = global_column_bits(f, ids, colarg, plan, cover)
+            ids = [r for r, bit in zip(ids, bitvec) if bit]
+        prev = call.uint_arg("previous")
+        if prev is not None:
+            ids = [r for r in ids if r > prev]
+        lim = call.uint_arg("limit")
+        if lim is not None:
+            ids = ids[:lim]
+        return ids
+
+    def _rows(self, call, plan: Plan) -> list[int]:
+        """Standalone Rows: the agreed global row-id list over the
+        call's view cover, with the executor's constraint order
+        (reference executeRows, executor.go:1040-1071; scatter analog
+        _execute_rows)."""
+        fname = call.args.get("_field") or call.args.get("field")
+        f = self._field(fname)
+        views = self._rows_views(f, call)
+        if views is None:
+            raise CollectiveError(f"Rows({fname}) time cover not "
+                                  f"collectively evaluable")
+        if not views:
+            return []
+        cover = tuple(views)
+        return self._restrict_agreed_ids(f, call,
+                                         agreed_row_ids(f, cover),
+                                         plan, cover)
+
+    def _extreme_row(self, call, plan: Plan):
+        """MinRow/MaxRow: the smallest/largest row id with any bit
+        (optionally intersected with a filter), plus its count — one
+        collective row-counts scan over the agreed list (reference
+        executeMinRow/executeMaxRow, executor.go:3029)."""
+        from pilosa_tpu.parallel.results import Pair
+
+        fname = call.string_arg("field") or call.args.get("field")
+        f = self._field(fname)
+        ids = agreed_row_ids(f)
+        if not ids:
+            return Pair()
+        if len(ids) > MAX_COLLECTIVE_ROWS:
+            raise CollectiveError(
+                f"{call.name} over {len(ids)} rows exceeds the dense "
+                f"collective ceiling {MAX_COLLECTIVE_ROWS}")
+        mat = global_matrix_stack(f, ids, plan)
+        if call.children:
+            filt = self._eval_stack(call.children[0], plan)
+            per_shard = _jit_row_counts(plan.mesh, True)(mat, filt)
+        else:
+            per_shard = _jit_row_counts(plan.mesh, False)(mat)
+        counts = np.asarray(per_shard, dtype=np.int64).sum(axis=0)
+        live = [(r, int(c)) for r, c in zip(ids, counts) if c > 0]
+        if not live:
+            return Pair()
+        rid, cnt = min(live) if call.name == "MinRow" else max(live)
+        return Pair(id=rid, count=cnt)
+
     def _group_by(self, call, plan: Plan):
         """GroupBy over N Rows children: agreed row-id lists per child
         (over each child's view cover — time-constrained children scan
@@ -1479,23 +1587,12 @@ class CollectiveExecutor:
                     f"field {fname!r} has {len(ids)} rows > "
                     f"{MAX_COLLECTIVE_ROWS}; dense collective GroupBy "
                     f"declines (scatter path's level walk handles it)")
-            # constrained children, the executor's order (_execute_rows):
-            # column bit filter (one tiny collective — data lives on the
-            # owning shard), then previous, then limit.  previous/limit
-            # are pure functions of the agreed list, and the column
-            # gather replicates — every process derives the identical
-            # restricted list, so the programs stay in lockstep.
-            colarg = child.uint_arg("column")
-            if colarg is not None and ids:
-                bitvec = global_column_bits(f, ids, colarg, plan,
+            # constrained children restrict in the executor's order
+            # (shared helper: column gather replicates, previous/limit
+            # are pure functions of the agreed list — every process
+            # derives the identical restricted list, lockstep holds)
+            ids = self._restrict_agreed_ids(f, child, ids, plan,
                                             sel_cover)
-                ids = [r for r, bit in zip(ids, bitvec) if bit]
-            prev = child.uint_arg("previous")
-            if prev is not None:
-                ids = [r for r in ids if r > prev]
-            lim = child.uint_arg("limit")
-            if lim is not None:
-                ids = ids[:lim]
             if not ids:
                 return []
             fields.append(f)

@@ -318,7 +318,7 @@ class TestSingleProcessCollective:
 
     def test_unsupported_calls_refused(self, single):
         h, ce, ex, bits, vals = single
-        for pql in ("MinRow(field=f)",
+        for pql in ("Set(5, f=1)",  # writes never run collectively
                     "GroupBy(Rows(f), previous=1)",
                     "Count(Row(f=0, from='2019-01-01T00:00'))",
                     # bare open-ended time Row: needs the coordinator's
@@ -444,6 +444,44 @@ class TestSingleProcessCollective:
                 "GroupBy(Rows(ns))",
                 "GroupBy(Rows(ns, limit=3))",
                 "GroupBy(Rows(ns), Rows(f))"):
+            got = ce.execute(pql)
+            want = ex.execute("i", pql)[0]
+            assert got == want, (pql, got, want)
+
+    def test_rows_and_extreme_row_parity(self, single):
+        """Standalone Rows (incl. constraints and time covers) and
+        MinRow/MaxRow run collectively, matching the scatter executor
+        (round 4: the ordinary-read surface rounds out)."""
+        import datetime as dt
+
+        from pilosa_tpu.models.field import FieldOptions as FO
+
+        h, ce, ex, bits, vals = single
+        idx = h.index("i")
+        t = idx.create_field("t2", FO.time_field("YMD"))
+        rng = random.Random(77)
+        rows_l, cols_l, ts_l = [], [], []
+        for row in range(4):
+            for c in sorted(bits[row])[:60]:
+                rows_l.append(row)
+                cols_l.append(c)
+                ts_l.append(dt.datetime(2021, rng.randrange(1, 13), 5))
+        t.import_bits(rows_l, cols_l, ts_l)
+        col0 = min(bits[0])
+        for pql in ("Rows(f)",
+                    "Rows(f, limit=2)",
+                    "Rows(f, previous=1)",
+                    f"Rows(f, column={col0})",
+                    # time field: from/to select the covering views
+                    "Rows(t2)",
+                    "Rows(t2, from='2021-01-01T00:00', "
+                    "to='2021-06-01T00:00')",
+                    "Rows(t2, from='2021-01-01T00:00', "
+                    "to='2022-01-01T00:00', limit=2)",
+                    "MinRow(field=f)",
+                    "MaxRow(field=f)",
+                    "MinRow(Row(f=1), field=f)",
+                    "MaxRow(Row(f=0), field=f)"):
             got = ce.execute(pql)
             want = ex.execute("i", pql)[0]
             assert got == want, (pql, got, want)
