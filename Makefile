@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: test test-paranoia test-shard22 test-matrix bench measure measure-resize measure-spmd validate-tpu soak soak-spmd check clean
+.PHONY: test test-paranoia test-shard22 test-matrix bench measure measure-resize measure-spmd validate-tpu soak soak-spmd check doccheck doccheck-fill clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -20,6 +20,15 @@ test-shard22:
 	PILOSA_TPU_SHARD_WIDTH_EXP=22 $(PY) -m pytest tests/ -x -q
 
 test-matrix: test test-paranoia test-shard22
+
+# executable documentation: verify every doc example against a live
+# server; doccheck-fill rewrites the response blocks from actual
+# results (the authoring loop)
+doccheck:
+	$(PY) tools/doccheck.py docs/query-language.md docs/getting-started.md
+
+doccheck-fill:
+	$(PY) tools/doccheck.py --fill docs/query-language.md docs/getting-started.md
 
 # north-star benchmark: one JSON line (driver artifact)
 bench:

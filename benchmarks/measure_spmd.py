@@ -77,6 +77,7 @@ while len(srv.cluster.sorted_nodes()) < NPROC:
 spmd.verify_rank_convention(srv.cluster)
 
 
+from tools import fleet_lib as _fl
 from tools.fleet_lib import file_barrier
 
 
@@ -136,36 +137,23 @@ QUERIES = [
     ("sum_filtered", "Sum(Row(f0=1), field=v)"),
     ("topn", "TopN(f0)"),
     ("groupby_2child", "GroupBy(Rows(f0), Rows(f1))"),
+    # round-4 additions: the ordinary-read surface
+    ("bare_row", "Row(f0=0)"),
+    ("bare_union", "Union(Row(f0=0), Row(f1=1))"),
+    ("groupby_4child", "GroupBy(Rows(f0), Rows(f1), Rows(f2), Rows(f0))"),
+    ("rows", "Rows(f0)"),
+    ("minrow", "MinRow(field=f0)"),
 ]
 
 
-def norm(res):
-    # plane-comparable shape for cross-checking answers
-    if isinstance(res, int):
-        return res
-    if hasattr(res, "val"):
-        return (res.val, res.count)
-    if isinstance(res, list) and res and hasattr(res[0], "id"):
-        return [(p.id, p.count) for p in res]
-    if isinstance(res, list) and res and hasattr(res[0], "group"):
-        return sorted(
-            (tuple((fr.field, fr.row_id) for fr in gc.group), gc.count)
-            for gc in res)
-    return res
+# plane-comparable normalization is SHARED with the SPMD soak
+# (tools/fleet_lib.norm_result / norm_http_result) so the two
+# harnesses' cross-check conventions can never drift
+norm = _fl.norm_result
 
 
 def norm_http(name, raw):
-    if name in ("count_tree", "bsi_condition"):
-        return raw
-    if name == "sum_filtered":
-        return (raw["value"], raw["count"])
-    if name == "topn":
-        return [(p["id"], p["count"]) for p in raw]
-    if name == "groupby_2child":
-        return sorted(
-            (tuple((fr["field"], fr["rowID"]) for fr in gc["group"]),
-             gc["count"]) for gc in raw)
-    return raw
+    return _fl.norm_http_result(raw)
 
 
 out = []

@@ -40,6 +40,50 @@ def file_barrier(data_dir: str, name: str, pid: int, nproc: int,
         time.sleep(0.02)
 
 
+def norm_result(res):
+    """One plane-comparable shape for any query result object —
+    shared by the SPMD soak's cross-checks and measure_spmd so the
+    two harnesses can never drift on normalization conventions.
+    Column lists sort defensively (Row.columns() is sorted per shard;
+    sorting costs nothing and removes the ordering assumption)."""
+    if isinstance(res, (int, bool)):
+        return res
+    if hasattr(res, "columns"):  # Row: compare the column list
+        return sorted(int(c) for c in res.columns())
+    if hasattr(res, "val"):  # ValCount
+        return (res.val, res.count)
+    if hasattr(res, "id"):  # Pair (MinRow/MaxRow)
+        return (res.id, res.count)
+    if isinstance(res, list) and res and hasattr(res[0], "id"):
+        return [(p.id, p.count) for p in res]  # TopN pairs
+    if isinstance(res, list) and res and hasattr(res[0], "group"):
+        return sorted(
+            (tuple((fr.field, fr.row_id) for fr in gc.group), gc.count)
+            for gc in res)
+    return res
+
+
+def norm_http_result(raw):
+    """The HTTP-JSON twin of norm_result (handler serialize_result
+    shapes)."""
+    if isinstance(raw, dict):
+        if "columns" in raw or "keys" in raw or raw == {}:
+            return sorted(raw.get("columns", []))
+        if "value" in raw:
+            return (raw["value"], raw["count"])
+        if "id" in raw:
+            return (raw["id"], raw["count"])
+        return raw
+    if isinstance(raw, list) and raw and isinstance(raw[0], dict):
+        if "group" in raw[0]:
+            return sorted(
+                (tuple((fr["field"], fr["rowID"]) for fr in gc["group"]),
+                 gc["count"]) for gc in raw)
+        if "id" in raw[0]:
+            return [(p["id"], p["count"]) for p in raw]
+    return raw
+
+
 def free_ports(n: int) -> list[int]:
     socks, ports = [], []
     for _ in range(n):

@@ -39,6 +39,7 @@ from tools import fleet_lib  # noqa: E402
 
 WORKER = r'''
 import json, os, random, sys, time
+from tools import fleet_lib as _fl
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -337,18 +338,16 @@ while True:
     # checked above on every round
     if R % 5 == 0 and pid == 0:
         for q, coll in answers:
-            if hasattr(coll, "columns"):  # bare Row: compare columns
-                http = c.post_json(srv.uri + "/index/i/query",
-                                   {"query": q})["results"][0]
-                assert sorted(http.get("columns", [])) == \
-                    sorted(int(x) for x in coll.columns()), (R, q)
-                xchecks += 1
-                continue
-            if not isinstance(coll, int):
+            # counts and bare Rows cross-check against the HTTP plane
+            # (aggregate/pair shapes are oracle-checked every round);
+            # normalization is SHARED with measure_spmd (fleet_lib) so
+            # the two harnesses cannot drift
+            if not (isinstance(coll, int) or hasattr(coll, "columns")):
                 continue
             http = c.post_json(srv.uri + "/index/i/query",
                                {"query": q})["results"][0]
-            assert http == coll, (R, q, http, coll)
+            assert _fl.norm_http_result(http) == _fl.norm_result(coll), \
+                (R, q, http)
             xchecks += 1
     barrier(f"x{R}")
     rounds += 1
