@@ -284,12 +284,21 @@ def global_matrix_stack(field, row_ids, plan: Plan,
         _fill_blocks(plan, (len(rid_list), n_words), fill))
 
 
-def agreed_row_ids(field, view_names=(VIEW_STANDARD,)) -> list[int]:
+def plan_shards(plan: Plan) -> frozenset:
+    """The real (non-padding) shard set a plan covers."""
+    return frozenset(s for s in plan.order if s >= 0)
+
+
+def agreed_row_ids(field, view_names=(VIEW_STANDARD,),
+                   shards=None) -> list[int]:
     """The union of row ids across every process, identical everywhere:
-    local union (across the agreed view cover), then a fixed-size
+    local union (across the agreed view cover, restricted to
+    ``shards`` when given — an Options(shards=[...]) plan must not
+    list rows living only outside its restriction), then a fixed-size
     allgather (count exchange first, pad to the max).
     Control-plane-free — it rides the same collective runtime as the
-    data.  ``view_names`` must be identical on every process."""
+    data.  ``view_names`` and ``shards`` must be identical on every
+    process (both derive from the agreed query text + plan)."""
     import jax
     from jax.experimental import multihost_utils
 
@@ -297,7 +306,9 @@ def agreed_row_ids(field, view_names=(VIEW_STANDARD,)) -> list[int]:
     for vn in view_names:
         view = field.view(vn)
         if view is not None:
-            for frag in list(view.fragments.values()):
+            for shard, frag in list(view.fragments.items()):
+                if shards is not None and shard not in shards:
+                    continue
                 local.update(frag.row_ids())
     if jax.process_count() == 1:
         return sorted(local)
@@ -640,7 +651,9 @@ def _open_time_fields(idx, call) -> set:
         if isinstance(filt, _Call):
             walk(filt, False)
         for ch in c.children:
-            walk(ch, False)
+            # Options is transparent: Options(Rows(...)) is still a
+            # STANDALONE Rows for the bounds rules
+            walk(ch, top and c.name == "Options")
 
     walk(call, True)
     return out
@@ -732,7 +745,7 @@ def _resolve_open_time_ranges(node, idx, index_name: str, call):
         if isinstance(filt, _Call):
             rewrite(filt)
         for ch in c.children:
-            rewrite(ch)
+            rewrite(ch, top=top and c.name == "Options")
 
     rewrite(call, top=True)
     return call
@@ -854,6 +867,11 @@ def _fold_query(call):
             # handling answers with the reference's empty-row semantics
             return None
         return folded
+    if call.name == "Options" and len(call.children) == 1:
+        inner = _fold_query(call.children[0])
+        if inner is None:
+            return None
+        return _Call(call.name, dict(call.args), [inner])
     return None  # GroupBy children are Rows calls, not bitmap algebra
 
 
@@ -881,14 +899,18 @@ def _check_collective(node, index_name: str, pql: str,
     if len(calls) != 1:
         return "multi-call query", None, None
     call = calls[0]
-    if (call.name not in ("Count", "Sum", "Min", "Max", "TopN", "GroupBy",
+    gate = call
+    while gate.name == "Options" and gate.children:
+        gate = gate.children[0]  # the gate must see THROUGH Options:
+        # Options(Set(...)) is still a write
+    if (gate.name not in ("Count", "Sum", "Min", "Max", "TopN", "GroupBy",
                           "Rows", "MinRow", "MaxRow")
-            and call.name not in BITMAP_ROOTS):
+            and gate.name not in BITMAP_ROOTS):
         # cheap refusal BEFORE any translation: writes and other
         # non-collective calls must not pay a cloned translate (with
         # create=True key allocation for Set) that the scatter path
         # immediately repeats
-        return f"unsupported call {call.name}", None, None
+        return f"unsupported call {gate.name}", None, None
     if translate:
         try:
             call = node.executor._translate_call(idx, call)
@@ -1027,9 +1049,19 @@ def try_collective(node, index_name: str, pql: str,
             idx = node.holder.index(index_name)
             from pilosa_tpu.models.row import Row as _Row
 
+            # Options(...) wraps: unwrap for the attr decision, and
+            # ASSIGN its excludeRowAttrs like the scatter executor
+            # (bool(value) — an explicit false overrides the URL-level
+            # flag there too; inner nesting levels override outer)
+            acall = tcall
+            while acall.name == "Options" and acall.children:
+                if "excludeRowAttrs" in acall.args:
+                    exclude_row_attrs = bool(
+                        acall.args["excludeRowAttrs"])
+                acall = acall.children[0]
             if (isinstance(result, _Row) and not exclude_row_attrs
-                    and tcall.name == "Row"
-                    and not tcall.has_condition_arg()):
+                    and acall.name == "Row"
+                    and not acall.has_condition_arg()):
                 # attach only when the USER wrote a literal Row():
                 # sentinel folding can collapse Union(Row, ghost) to a
                 # Row, but the scatter plane (and the reference,
@@ -1037,9 +1069,12 @@ def try_collective(node, index_name: str, pql: str,
                 # the planes must serialize identically
                 from pilosa_tpu.pql import parse as _parse
 
-                if _parse(user_pql).calls[0].name == "Row":
-                    fname = tcall.field_arg()
-                    rowid = tcall.args.get(fname)
+                ocall = _parse(user_pql).calls[0]
+                if ocall.name == "Options" and ocall.children:
+                    ocall = ocall.children[0]
+                if ocall.name == "Row":
+                    fname = acall.field_arg()
+                    rowid = acall.args.get(fname)
                     f = idx.field(fname)
                     if f is not None and isinstance(rowid, int):
                         result.attrs = f.row_attrs.attrs(rowid)
@@ -1094,8 +1129,15 @@ class CollectiveExecutor:
 
     # -- plan
 
-    def _plan(self) -> Plan:
-        shards = sorted(self.idx.available_shards())
+    def _plan(self, shard_filter=None) -> Plan:
+        """Global plan over the index's shards — or EXACTLY the
+        Options(shards=[...]) list when given (the scatter path's
+        _target_shards uses the given list verbatim too: absent
+        shards contribute zero blocks)."""
+        if shard_filter is not None:
+            shards = sorted(int(s) for s in shard_filter)
+        else:
+            shards = sorted(self.idx.available_shards())
         return make_plan(shards, owner_rank_fn(self.cluster,
                                                self.index_name))
 
@@ -1109,7 +1151,23 @@ class CollectiveExecutor:
             # user-facing error (try_collective must never raise)
             return False
 
+    #: Options() argument surface (reference executeOptionsCall,
+    #: executor.go:3180): serialization flags + a shard restriction
+    _OPTIONS_ARGS = frozenset(
+        {"columnAttrs", "excludeRowAttrs", "excludeColumns", "shards"})
+
     def _supported(self, call) -> bool:
+        if call.name == "Options":
+            if len(call.children) != 1:
+                return False
+            if not set(call.args) <= self._OPTIONS_ARGS:
+                return False  # unknown option: scatter owns the error
+            shards = call.args.get("shards")
+            if shards is not None and not (
+                    isinstance(shards, list)
+                    and all(isinstance(s, int) for s in shards)):
+                return False
+            return self._supported(call.children[0])
         if call.name in BITMAP_ROOTS:
             # bare bitmap result: the whole tree evaluates as one
             # collective program and the global Row gathers replicated
@@ -1302,7 +1360,22 @@ class CollectiveExecutor:
         if not self.supported(call):
             raise CollectiveError(f"unsupported collective call: "
                                   f"{call.name}")
-        plan = self._plan()
+        opt_args: dict = {}
+        while call.name == "Options":
+            # unwrap (reference executeOptionsCall, which recurses —
+            # nesting is legal and INNER levels override): shards
+            # restrict the plan — in the TEXT, so every process
+            # agrees — and the serialization flags ride the result
+            opt_args.update(call.args)
+            call = call.children[0]
+        plan = self._plan(opt_args.get("shards"))
+        result = self._dispatch(call, plan)
+        if opt_args and hasattr(result, "segments"):
+            result.exclude_columns = bool(opt_args.get("excludeColumns"))
+            result.wants_column_attrs = bool(opt_args.get("columnAttrs"))
+        return result
+
+    def _dispatch(self, call, plan: Plan):
         if call.name in BITMAP_ROOTS:
             return self._bitmap_row(call, plan)
         if call.name == "Count":
@@ -1507,7 +1580,7 @@ class CollectiveExecutor:
             return []
         cover = tuple(views)
         return self._restrict_agreed_ids(f, call,
-                                         agreed_row_ids(f, cover),
+                                         agreed_row_ids(f, cover, plan_shards(plan)),
                                          plan, cover)
 
     def _extreme_row(self, call, plan: Plan):
@@ -1519,7 +1592,7 @@ class CollectiveExecutor:
 
         fname = call.string_arg("field") or call.args.get("field")
         f = self._field(fname)
-        ids = agreed_row_ids(f)
+        ids = agreed_row_ids(f, shards=plan_shards(plan))
         if not ids:
             return Pair()
         if len(ids) > MAX_COLLECTIVE_ROWS:
@@ -1581,7 +1654,7 @@ class CollectiveExecutor:
                 sel_cover = tuple(views)
             else:
                 sel_cover = (VIEW_STANDARD,)
-            ids = agreed_row_ids(f, sel_cover)
+            ids = agreed_row_ids(f, sel_cover, plan_shards(plan))
             if len(ids) > MAX_COLLECTIVE_ROWS:
                 raise CollectiveError(
                     f"field {fname!r} has {len(ids)} rows > "
@@ -1683,7 +1756,7 @@ class CollectiveExecutor:
         ids_arg = call.uint_slice_arg("ids")
         threshold = call.uint_arg("threshold") or 0
         tanimoto = call.uint_arg("tanimotoThreshold") or 0
-        row_ids = agreed_row_ids(f)
+        row_ids = agreed_row_ids(f, shards=plan_shards(plan))
         if not row_ids:
             return []
         if len(row_ids) > MAX_COLLECTIVE_ROWS:

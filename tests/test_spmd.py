@@ -448,6 +448,41 @@ class TestSingleProcessCollective:
             want = ex.execute("i", pql)[0]
             assert got == want, (pql, got, want)
 
+    def test_options_parity(self, single):
+        """Options() runs collectively: shards restrict the plan (and
+        the agreed row lists), serialization flags ride the result —
+        matching the scatter executor (reference executeOptionsCall)."""
+        h, ce, ex, bits, vals = single
+        for pql in ("Options(Count(Row(f=0)), shards=[0, 2])",
+                    "Options(Count(Union(Row(f=0), Row(f=1))), "
+                    "shards=[1])",
+                    "Options(Row(f=1), excludeColumns=true)",
+                    "Options(Sum(Row(f=0), field=v), shards=[0, 1, 3])",
+                    "Options(TopN(f), shards=[2])",
+                    "Options(Rows(f), shards=[0])",
+                    "Options(Count(Row(f=2)), shards=[])"):
+            got = ce.execute(pql)
+            want = ex.execute("i", pql)[0]
+            assert got == want, (pql, got, want)
+        # flags ride the Row result like the scatter plane's
+        r = ce.execute("Options(Row(f=0), excludeColumns=true)")
+        assert r.exclude_columns is True
+        r = ce.execute("Options(Row(f=0), columnAttrs=true)")
+        assert r.wants_column_attrs is True
+        # nested Options: inner levels override (scatter recurses too)
+        got = ce.execute("Options(Options(Count(Row(f=0)), shards=[0]), "
+                         "shards=[0, 1, 2, 3, 4])")
+        want = ex.execute(
+            "i", "Options(Options(Count(Row(f=0)), shards=[0]), "
+            "shards=[0, 1, 2, 3, 4])")[0]
+        assert got == want
+        # unknown options stay the scatter path's user error; writes
+        # under Options never run collectively
+        with pytest.raises(spmd.CollectiveError):
+            ce.execute("Options(Count(Row(f=0)), bogus=true)")
+        with pytest.raises(spmd.CollectiveError):
+            ce.execute("Options(Set(9999, f=0), shards=[0])")
+
     def test_rows_and_extreme_row_parity(self, single):
         """Standalone Rows (incl. constraints and time covers) and
         MinRow/MaxRow run collectively, matching the scatter executor
