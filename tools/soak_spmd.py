@@ -165,7 +165,17 @@ CMPS = {"<": _op.lt, "<=": _op.le, ">": _op.gt, ">=": _op.ge,
 def gen_query(r):
     """-> (pql, oracle_fn) — oracle_fn() computed lazily AFTER this
     round's writes land in the shared state."""
-    kind = r.randrange(10)
+    kind = r.randrange(11)
+    if kind == 10:
+        # Options(shards=[...]) restricts the plan (late round 4):
+        # oracle filters the column universe to the chosen shards
+        text, acc = gen_tree(r, 2)
+        ss = sorted(r.sample(range(N_SHARDS), r.randrange(1, N_SHARDS)))
+        lo_hi = [(s * SHARD_WIDTH, (s + 1) * SHARD_WIDTH) for s in ss]
+        return (f"Options(Count({text}), shards={ss})",
+                lambda a=acc, lh=tuple(lo_hi): sum(
+                    1 for c in a if any(lo <= c < hi for lo, hi in lh)),
+                "count")
     if kind == 8:
         # bare bitmap tree: the global Row gathers replicated (round 4)
         text, acc = gen_tree(r, 2)
