@@ -349,6 +349,21 @@ def main():
             # verified in the else-branch below, where a mismatch
             # becomes a loud correctness_failure record instead of an
             # AssertionError that kills the whole sweep
+            # AE leg at the north star: one block-checksum-only pass
+            # over every 10B fragment — the per-cycle hashing floor a
+            # holderSyncer pays before any wire traffic (reference
+            # holder.go:880, fragment.go:1762 Checksum; cadence 10 min,
+            # server.go:514)
+            t0 = _now()
+            ae_blocks = 0
+            ae_bytes = 0
+            for vw in nf.views.values():
+                for fr in vw.fragments.values():
+                    ae_blocks += len(fr.blocks())
+                    ae_bytes += sum(fr._rows[r].nbytes
+                                    for r in fr.row_ids()) + 8 * len(
+                                        fr.row_ids())
+            ae_checksum_s = _now() - t0
             # documented floor: evict the row stacks and pay the full
             # assembly on a quiet system (no compaction running) — what
             # a query sees if eviction or a disabled prewarm leaves it
@@ -395,6 +410,13 @@ def main():
                     "cold_floor_no_prewarm_ms": round(floor_ms, 1),
                     "topn_p50_ms": round(statistics.median(tn_lat), 1),
                     "import_s": round(import_s, 1), "exact": True})
+                out.append({
+                    "config": 7,
+                    "metric": "ae_checksum_pass_s_10B_cols",
+                    "value": round(ae_checksum_s, 2), "unit": "s",
+                    "cols": ns_cols, "shards": ns_shards,
+                    "blocks": ae_blocks,
+                    "mb_hashed": round(ae_bytes / 1e6, 1)})
             holder.delete_index("northstar")
         finally:
             mgr10.budget = old10
@@ -595,6 +617,128 @@ def main():
 
     client.close()
     s0.close(); s1.close(); s2.close()
+
+    # ---- config 7: anti-entropy cycle cost at scale (VERDICT r4 item
+    # 4; reference holderSyncer holder.go:880-1101, 10-min cadence
+    # server.go:514).  Fresh replica-2 cluster so blocks actually have
+    # two owners; AE loops disabled — cycles run by hand, timed.
+    # Leg A: in-sync full SyncHolder cycle over a wide index (wall +
+    #   bytes hashed: the steady-state cost of "nothing to do").
+    # Leg B: one replica diverges (direct local import bypassing
+    #   replication); the next cycle must move ONLY the diff and every
+    #   node must answer exactly afterwards.
+    ae_shards = 1024 if avail_kb >= 8 * 1024 * 1024 else 128
+    base7 = tempfile.mkdtemp()
+    a0 = Server(data_dir=f"{base7}/n0", coordinator=True, replica_n=2)
+    a0.open()
+    a1 = Server(data_dir=f"{base7}/n1", seeds=[a0.uri], replica_n=2)
+    a1.open()
+    a2 = Server(data_dir=f"{base7}/n2", seeds=[a0.uri], replica_n=2)
+    a2.open()
+    cl7 = InternalClient(timeout=300)
+
+    def post7(path, obj):
+        return cl7.post_json(a0.uri + path, obj)
+
+    # replica-2 writes need all three members up before the import; a
+    # cluster that never forms becomes a skip record, never a run
+    # against a partial cluster (which would record false divergence)
+    deadline = _now() + 120
+    ready = False
+    while _now() < deadline:
+        st = cl7._json("GET", a0.uri + "/status")
+        if st.get("state") == "NORMAL" and len(st.get("nodes", [])) == 3:
+            ready = True
+            break
+        time.sleep(0.2)
+    if not ready:
+        out.append({"config": 7, "metric": "ae_sync_cycle_s_insync",
+                    "skipped": True,
+                    "reason": "3-node replica-2 cluster never reached "
+                              "NORMAL within 120 s"})
+        cl7.close()
+        a0.close(); a1.close(); a2.close()
+        shutil.rmtree(base7, ignore_errors=True)
+        return _emit(out)
+
+    post7("/index/ae", {})
+    post7("/index/ae/field/f", {})
+    arng = random.Random(77)
+    rows_l, cols_l = [], []
+    for row in range(4):
+        for s in range(ae_shards):
+            for _ in range(2):
+                rows_l.append(row)
+                cols_l.append(s * SHARD_WIDTH + arng.randrange(SHARD_WIDTH))
+    post7("/index/ae/field/f/import", {"rowIDs": rows_l,
+                                       "columnIDs": cols_l})
+
+    from pilosa_tpu.parallel.syncer import HolderSyncer
+
+    def hashed_mb(server):
+        total = 0
+        idx = server.holder.index("ae")
+        for f in idx.all_fields():
+            for vw in f.views.values():
+                for fr in vw.fragments.values():
+                    if server.cluster.owns_shard(
+                            server.cluster.local_id, "ae", fr.shard):
+                        total += sum(fr._rows[r].nbytes
+                                     for r in fr.row_ids())
+        return total / 1e6
+
+    t0 = _now()
+    dirty_a = HolderSyncer(a0.node).sync_holder()
+    wall_a = _now() - t0
+    rec7 = {"config": 7, "metric": "ae_sync_cycle_s_insync",
+            "value": round(wall_a, 2), "unit": "s",
+            "cols": ae_shards * SHARD_WIDTH, "shards": ae_shards,
+            "dirty_blocks": dirty_a,
+            "local_mb_hashed": round(hashed_mb(a0), 1)}
+    if dirty_a:
+        rec7["correctness_failure"] = \
+            f"{dirty_a} dirty blocks on an in-sync cluster"
+    out.append(rec7)
+
+    # Leg B — diverge one replica: bits land on a1 only (local import,
+    # no replication), on shards a1 owns; AE must push them everywhere.
+    div_shards = [s for s in range(ae_shards)
+                  if a1.cluster.owns_shard(a1.cluster.local_id, "ae", s)][:8]
+    div_want = 0
+    for s in div_shards:
+        frag = a1.node.local_fragment("ae", "f", "standard", s, True)
+        frag.import_positions(
+            [9 * SHARD_WIDTH + arng.randrange(SHARD_WIDTH)
+             for _ in range(125)])
+        div_want += frag.row_count(9)
+    t0 = _now()
+    dirty_b = HolderSyncer(a1.node).sync_holder()
+    wall_b = _now() - t0
+    got_counts = []
+    for srv in (a0, a1, a2):
+        got_counts.append(cl7.post_json(
+            srv.uri + "/index/ae/query",
+            {"query": "Count(Row(f=9))"})["results"][0])
+    rec7b = {"config": 7, "metric": "ae_sync_cycle_s_diverged",
+             "value": round(wall_b, 2), "unit": "s",
+             "diverged_shards": len(div_shards),
+             "diverged_bits": div_want,
+             "dirty_blocks": dirty_b,
+             "exact": all(g == div_want for g in got_counts)}
+    if not rec7b["exact"]:
+        rec7b["correctness_failure"] = \
+            f"post-AE counts {got_counts} != {div_want}"
+    out.append(rec7b)
+
+    cl7.close()
+    a0.close(); a1.close(); a2.close()
+    shutil.rmtree(base7, ignore_errors=True)
+
+    return _emit(out)
+
+
+def _emit(out):
+    import jax
 
     platform = jax.devices()[0].platform
     for rec in out:

@@ -260,6 +260,12 @@ class Fragment:
                 # wedge the fragment: restore an appendable WAL handle
                 # and clear the in-progress flag
                 try:
+                    if self._wal is not None and self._wal is not old_wal:
+                        # the new-path handle was already swapped in
+                        # (e.g. a signal landed after the assignment):
+                        # close it, or its FileBudget registration
+                        # strands an fd for the process lifetime
+                        self._wal.close()
                     if old_wal is not None:
                         # idempotent; without it an early raise (e.g.
                         # MemoryError in _stacked) would strand the old
@@ -573,17 +579,53 @@ class Fragment:
                 self._maybe_snapshot()
             self._paranoia_check()
 
+    #: positions path iff avg set bits/container is below this — the
+    #: dense merge costs ~1024 word-ops (~3 passes over 8 KB) per
+    #: container regardless of cardinality, the positions merge ~1
+    #: word-op per bit, so the true crossover is near 1024; 512 leaves
+    #: margin for the positions path's extra decode copy
+    _SPARSE_BITS_PER_CONTAINER = 512
+    #: absolute positions-path ceiling (u64 positions materialized)
+    _SPARSE_MAX_BITS = 1 << 25
+
     def _merge_roaring(self, data: bytes, clear: bool) -> int:
         """In-memory merge of a roaring payload; returns the number of
         bits actually flipped.  Caller holds the lock (or is _load
-        replay, which is single-threaded).  Containers arrive sorted by
-        key, so each row is one contiguous run — every container's
-        current words gather into ONE matrix, the diff is one op, and
-        the changed-bit count is a popcount reduce; no per-container
-        Python loop, no bit-position expansion.  Chunked so a dense
-        whole-fragment archive never materializes more than ~3x 64 MB
-        of temporaries."""
+        replay, which is single-threaded).
+
+        Two regimes, chosen from the payload's descriptive headers
+        alone (cost ∝ container count, no expansion):
+
+        - **sparse** (avg bits/container below _SPARSE_BITS_PER_
+          CONTAINER): decode straight to bit positions and merge in
+          position space — O(set bits), never touching the ~8 KB dense
+          block per container.  This is the analog of the reference's
+          streamed ImportRoaringBits (roaring/roaring.go:1511), whose
+          cost also tracks bits, not container footprint.
+        - **dense**: containers arrive sorted by key, so each row is
+          one contiguous run — every container's current words gather
+          into ONE matrix, the diff is one op, and the changed-bit
+          count is a popcount reduce; no per-container Python loop.
+          Chunked so a dense whole-fragment archive never materializes
+          more than ~3x 64 MB of temporaries."""
         from pilosa_tpu.storage import roaring as rcodec
+
+        stats = rcodec.payload_stats(data)
+        if stats is not None:
+            n_cont, n_bits = stats
+            if (n_cont > 0 and n_bits <= self._SPARSE_MAX_BITS
+                    and n_bits <= n_cont * self._SPARSE_BITS_PER_CONTAINER):
+                try:
+                    pos = rcodec.decode_positions(
+                        data, max_positions=2 * self._SPARSE_MAX_BITS)
+                except rcodec.RoaringError:
+                    # descriptor cardinalities are untrusted: a payload
+                    # whose runs expand past the cap (or any decode
+                    # fault) falls through to the dense path, which is
+                    # chunk-bounded and owns the error reporting
+                    pass
+                else:
+                    return self._merge_positions(pos, clear)
 
         keys, cwords, _flags = rcodec.decode(data)
         cpr = self.width // rcodec.CONTAINER_BITS  # containers per row
@@ -646,6 +688,81 @@ class Fragment:
                     w64[slots_of[sel]] = cur[sel] & ~cw[sel]
                 else:
                     w64[slots_of[sel]] = cur[sel] | cw[sel]
+        return changed
+
+    def _merge_positions(self, pos: np.ndarray, clear: bool) -> int:
+        """Position-space merge: O(set bits).  ``pos`` is absolute
+        fragment positions (row*width + off); sorted input is the wire
+        contract, but a hostile unsorted payload is just re-sorted
+        (duplicates are harmless — OR/ANDN are idempotent and the
+        changed-bit count works on per-word aggregates)."""
+        if len(pos) == 0:
+            return 0
+        pos = np.ascontiguousarray(pos, dtype=np.uint64)
+        if len(pos) > 1 and not np.all(pos[1:] >= pos[:-1]):
+            pos = np.sort(pos)
+        # width is a power of two, so row/word boundaries align and
+        # shift/mask replace div/mod; rows are contiguous runs in the
+        # sorted positions — one diff-flag pass finds the segments
+        width_shift = self.width.bit_length() - 1
+        row_of = (pos >> np.uint64(width_shift)).astype(np.int64)
+        rflag = np.empty(len(pos), dtype=bool)
+        rflag[0] = True
+        np.not_equal(row_of[1:], row_of[:-1], out=rflag[1:])
+        rstarts = np.flatnonzero(rflag)
+        rbounds = np.append(rstarts, len(pos))
+        # materialize target rows (clear skips absent ones) — then the
+        # whole payload merges in one native call when available
+        row_arrays, seg = [], []
+        for ri in range(len(rstarts)):
+            row = int(row_of[rstarts[ri]])
+            if clear:
+                arr = self._rows.get(row)
+                if arr is None:
+                    continue
+            else:
+                arr = self._row_array(row, create=True)
+            row_arrays.append(arr)
+            seg.append(ri)
+        if not row_arrays:
+            return 0
+        seg = np.asarray(seg, dtype=np.int64)
+        seg_start, seg_end = rbounds[seg], rbounds[seg + 1]
+        from pilosa_tpu.ops import hostkernels
+
+        native = hostkernels.merge_positions(
+            row_arrays, seg_start, seg_end, pos,
+            self.width - 1, clear)
+        if native is not None:
+            return native
+        # numpy fallback: per-word OR aggregates via diff-flag
+        # segmentation + reduceat (sorted positions: each word is one
+        # contiguous run), then gather/compare/scatter per row
+        masks = np.uint64(1) << (pos & np.uint64(63))
+        gw = (pos >> np.uint64(6)).astype(np.int64)
+        changed = 0
+        for k, arr in enumerate(row_arrays):
+            s0, s1 = int(seg_start[k]), int(seg_end[k])
+            gws = gw[s0:s1]
+            flag = np.empty(s1 - s0, dtype=bool)
+            flag[0] = True
+            np.not_equal(gws[1:], gws[:-1], out=flag[1:])
+            ws = np.flatnonzero(flag)
+            wpr_shift = (self.width >> 6).bit_length() - 1
+            uw = gws[ws] & ((1 << wpr_shift) - 1)
+            a = np.bitwise_or.reduceat(masks[s0:s1], ws)
+            w64 = arr.view(np.uint64)
+            cur = w64[uw]
+            if clear:
+                delta = cur & a
+                new = cur & ~a
+            else:
+                delta = a & ~cur
+                new = cur | a
+            n_flip = int(np.bitwise_count(delta).sum())
+            if n_flip:
+                changed += n_flip
+                w64[uw] = new
         return changed
 
     def to_roaring(self) -> bytes:

@@ -26,6 +26,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -211,6 +212,68 @@ void pt_masked_matrix_counts(const uint32_t* mat, const uint32_t* masks,
                                 out[g * rows + r] = (int32_t)count_and_serial(
                                     mat + r * n32, masks + g * n32, n32);
                     });
+}
+
+// Sparse position-space merge: OR (clear=0) or ANDN (clear=1) sorted
+// absolute bit positions into per-row bitmap buffers, returning the
+// number of bits actually flipped.  One call per payload: row r's
+// positions are pos[seg_start[r]..seg_end[r]) (absolute fragment
+// positions; the in-row offset is pos & width_mask), applied to
+// row_ptrs[r] (a u64 view of the row's packed words).  Start/end are
+// separate so a clear that skips absent rows passes a sparse subset
+// of segments.  Same-word positions are consecutive (pos sorted), so
+// the inner loop accumulates a register mask per word run — one pass,
+// no materialized per-word aggregates.  Parallel over rows (each
+// row's buffer is touched by exactly one thread); the changed-bit
+// total folds under a mutex at join.
+long long pt_merge_positions(uint64_t* const* row_ptrs,
+                             const long long* seg_start,
+                             const long long* seg_end, long long n_rows,
+                             const uint64_t* pos, uint64_t width_mask,
+                             int clear) {
+    long long total_pos = 0;
+    for (long long r = 0; r < n_rows; r++)
+        total_pos += seg_end[r] - seg_start[r];
+    // Parallel over rows: each row's words live in exactly one
+    // segment, so threads never touch the same buffer.  Fresh rows are
+    // fault-bound (zero-fill-on-demand on first touch), which
+    // parallelizes well — weight the thread gate by ~8 words touched
+    // per position to reflect that.
+    long long changed = 0;
+    std::mutex mu;
+    parallel_chunks(n_rows, 1, (total_pos / (n_rows ? n_rows : 1)) * 8 + 1,
+                    [&](long long rlo, long long rhi, int) {
+        long long local = 0;
+        for (long long r = rlo; r < rhi; r++) {
+            uint64_t* w = row_ptrs[r];
+            long long i = seg_start[r];
+            const long long end = seg_end[r];
+            while (i < end) {
+                // sparse payloads touch ~1 word per cache line; the
+                // scattered read-modify-write is miss-bound, so pull
+                // lines ~16 positions ahead while this one resolves
+                if (i + 16 < end)
+                    __builtin_prefetch(w + ((pos[i + 16] & width_mask) >> 6), 1);
+                uint64_t off = pos[i] & width_mask;
+                uint64_t widx = off >> 6;
+                uint64_t mask = 1ULL << (off & 63);
+                i++;
+                while (i < end && ((pos[i] & width_mask) >> 6) == widx) {
+                    mask |= 1ULL << (pos[i] & width_mask & 63);
+                    i++;
+                }
+                uint64_t cur = w[widx];
+                uint64_t delta = clear ? (cur & mask) : (mask & ~cur);
+                if (delta) {
+                    local += __builtin_popcountll(delta);
+                    w[widx] = clear ? (cur & ~mask) : (cur | mask);
+                }
+            }
+        }
+        std::lock_guard<std::mutex> g(mu);
+        changed += local;
+    });
+    return changed;
 }
 
 }  // extern "C"

@@ -76,6 +76,7 @@ enum {
   ROARING_ERR_VERSION = -3,
   ROARING_ERR_TYPE = -4,
   ROARING_ERR_OFFSET = -5,
+  ROARING_ERR_CAP = -7,
 };
 
 // Decode a serialized bitmap into dense containers.
@@ -167,6 +168,98 @@ fail_offset:
 }
 
 void pilosa_roaring_free_buf(void* p) { std::free(p); }
+
+// Decode a serialized bitmap straight to absolute bit positions
+// (key<<16 | in-container offset), WITHOUT materializing dense words —
+// O(set bits), the sparse-ingest fast path.  Positions come out sorted
+// ascending iff the wire's container keys are sorted (the format
+// guarantees it; callers defensively re-sort if a hostile payload
+// isn't).  max_positions bounds the output on the ACTUAL emitted
+// count, not the descriptor cardinalities — run containers expand from
+// run data, so a hostile payload whose descriptors lie small must hit
+// ROARING_ERR_CAP instead of allocating unbounded memory (the caller
+// falls back to the chunk-bounded dense path).  pos_out is malloc'd;
+// caller frees with pilosa_roaring_free_buf.
+int pilosa_roaring_decode_positions(const uint8_t* data, uint64_t len,
+                                    uint64_t max_positions,
+                                    uint64_t** pos_out, uint64_t* n_out,
+                                    uint8_t* flags_out) try {
+  if (len < kHeaderBaseSize) return ROARING_ERR_TRUNCATED;
+  if (rd16(data) != kMagic) return ROARING_ERR_MAGIC;
+  if (data[2] != 0) return ROARING_ERR_VERSION;
+  *flags_out = data[3];
+  uint64_t n = rd32(data + 4);
+  if (len < kHeaderBaseSize + n * 12ULL + n * 4ULL) return ROARING_ERR_TRUNCATED;
+
+  const uint8_t* desc = data + kHeaderBaseSize;
+  const uint8_t* offs = desc + n * 12;
+  // capacity pass: descriptor cardinalities bound the array/bitmap
+  // output exactly; run containers re-count from run data below
+  uint64_t cap = 0;
+  for (uint64_t i = 0; i < n; i++)
+    cap += static_cast<uint64_t>(rd16(desc + i * 12 + 10)) + 1;
+  if (cap > max_positions) return ROARING_ERR_CAP;
+  std::vector<uint64_t> pos;
+  pos.reserve(cap);
+  for (uint64_t i = 0; i < n; i++) {
+    uint64_t base = rd64(desc + i * 12) << 16;
+    uint16_t typ = rd16(desc + i * 12 + 8);
+    uint32_t card = static_cast<uint32_t>(rd16(desc + i * 12 + 10)) + 1;
+    uint32_t off = rd32(offs + i * 4);
+    switch (typ) {
+      case kTypeArray: {
+        if (static_cast<uint64_t>(off) + 2ULL * card > len)
+          return ROARING_ERR_OFFSET;
+        const uint8_t* p = data + off;
+        for (uint32_t j = 0; j < card; j++)
+          pos.push_back(base | rd16(p + 2 * j));
+        break;
+      }
+      case kTypeBitmap: {
+        if (static_cast<uint64_t>(off) + 8192ULL > len)
+          return ROARING_ERR_OFFSET;
+        for (uint32_t k = 0; k < kWordsPerContainer; k++) {
+          uint64_t v = rd64(data + off + 8 * k);
+          while (v) {
+            pos.push_back(base | (k * 64 +
+                static_cast<uint32_t>(__builtin_ctzll(v))));
+            v &= v - 1;
+          }
+        }
+        break;
+      }
+      case kTypeRun: {
+        if (static_cast<uint64_t>(off) + 2ULL > len)
+          return ROARING_ERR_OFFSET;
+        uint16_t run_count = rd16(data + off);
+        if (static_cast<uint64_t>(off) + 2ULL + 4ULL * run_count > len)
+          return ROARING_ERR_OFFSET;
+        const uint8_t* p = data + off + 2;
+        for (uint32_t r = 0; r < run_count; r++) {
+          uint32_t start = rd16(p + 4 * r);
+          uint32_t last = rd16(p + 4 * r + 2);
+          if (pos.size() + (last - start + 1) > max_positions)
+            return ROARING_ERR_CAP;
+          for (uint32_t b = start; b <= last; b++) pos.push_back(base | b);
+        }
+        break;
+      }
+      default:
+        return ROARING_ERR_TYPE;
+    }
+    if (pos.size() > max_positions) return ROARING_ERR_CAP;
+  }
+  uint64_t* out =
+      static_cast<uint64_t*>(std::malloc(pos.size() * sizeof(uint64_t)));
+  if (!out && !pos.empty()) return ROARING_ERR_TRUNCATED;
+  std::memcpy(out, pos.data(), pos.size() * sizeof(uint64_t));
+  *pos_out = out;
+  *n_out = pos.size();
+  return ROARING_OK;
+} catch (...) {
+  // never let bad_alloc (or anything) cross the ctypes boundary
+  return ROARING_ERR_CAP;
+}
 
 // Encode dense containers into the serialized format.
 // keys must be sorted ascending; words is n * 1024 u64.

@@ -65,6 +65,9 @@ def _setup(lib) -> None:
     lib.pt_row_counts_gathered.argtypes = [VP, VP, IP, LL, LL, IP]
     lib.pt_masked_matrix_counts.restype = None
     lib.pt_masked_matrix_counts.argtypes = [VP, VP, LL, LL, LL, IP]
+    lib.pt_merge_positions.restype = LL
+    lib.pt_merge_positions.argtypes = [VP, VP, VP, LL, VP,
+                                       ctypes.c_uint64, ctypes.c_int]
     # 0 (default) = auto: hardware_concurrency capped at >=4 MiB of
     # operand per thread; ctypes releases the GIL for the call, so the
     # kernel threads own the cores (the reference's per-shard worker
@@ -217,3 +220,29 @@ def masked_matrix_counts(mat: np.ndarray, masks: np.ndarray) -> np.ndarray:
     lib.pt_masked_matrix_counts(mat.ctypes.data, masks.ctypes.data,
                                 groups, rows, words, out.ctypes.data)
     return out
+
+
+def merge_positions(row_arrays: list, seg_start: np.ndarray,
+                    seg_end: np.ndarray, pos: np.ndarray,
+                    width_mask: int, clear: bool) -> int | None:
+    """Sparse position-space merge into per-row bitmap buffers: for row
+    r, OR (or ANDN when clear) the sorted absolute positions
+    pos[seg_start[r]:seg_end[r]] (in-row offset = pos & width_mask)
+    into row_arrays[r], in place.  Returns flipped-bit count, or None
+    when the native library is unavailable (caller runs its numpy
+    fallback).  One C call replaces the whole numpy aggregation
+    pipeline — the import-roaring sparse hot path
+    (fragment._merge_positions)."""
+    lib = _NATIVE.load()
+    if lib is None:
+        return None
+    # __array_interface__ is ~10x cheaper per array than .ctypes.data
+    ptrs = np.array([a.__array_interface__["data"][0]
+                     for a in row_arrays], dtype=np.uint64)
+    seg_start = np.ascontiguousarray(seg_start, dtype=np.int64)
+    seg_end = np.ascontiguousarray(seg_end, dtype=np.int64)
+    pos = np.ascontiguousarray(pos, dtype=np.uint64)
+    return int(lib.pt_merge_positions(
+        ptrs.ctypes.data, seg_start.ctypes.data, seg_end.ctypes.data,
+        len(row_arrays), pos.ctypes.data, width_mask,
+        1 if clear else 0))

@@ -34,6 +34,7 @@ primaries are skipped up front in shards_by_node)."""
 
 from __future__ import annotations
 
+import os
 import random as _random
 import threading
 import time
@@ -52,8 +53,11 @@ PROBE_FANOUT = 3
 #: probe (SWIM ping-req fan-out)
 INDIRECT_PROBES = 2
 
-#: wall-clock bound on one round's concurrent probe phase
-PROBE_DEADLINE_S = 5.0
+#: wall-clock bound on one round's concurrent probe phase.  Env-
+#: overridable so process-level tests can tighten detection latency
+#: to fit their wait windows deterministically under CI load.
+PROBE_DEADLINE_S = float(
+    os.environ.get("PILOSA_TPU_PROBE_DEADLINE_S", "5.0"))
 
 # Dial attempts before declaring a node DOWN (cluster.go:1724 uses 10
 # ×1s; the control plane here is request/response so 3 suffices).

@@ -1130,14 +1130,18 @@ class CollectiveExecutor:
     # -- plan
 
     def _plan(self, shard_filter=None) -> Plan:
-        """Global plan over the index's shards — or EXACTLY the
-        Options(shards=[...]) list when given (the scatter path's
-        _target_shards uses the given list verbatim too: absent
-        shards contribute zero blocks)."""
+        """Global plan over the index's shards — or the
+        Options(shards=[...]) list intersected with
+        available_shards().  Absent shards contribute zero blocks on
+        both planes, so the intersection is semantics-preserving; it
+        also bounds the dense operand stacks by what actually exists
+        (an hostile shards=[0..10^6] list must not size gigabytes of
+        device buffers)."""
+        avail = set(self.idx.available_shards())
         if shard_filter is not None:
-            shards = sorted(int(s) for s in shard_filter)
+            shards = sorted({int(s) for s in shard_filter} & avail)
         else:
-            shards = sorted(self.idx.available_shards())
+            shards = sorted(avail)
         return make_plan(shards, owner_rank_fn(self.cluster,
                                                self.index_name))
 
@@ -1156,7 +1160,7 @@ class CollectiveExecutor:
     _OPTIONS_ARGS = frozenset(
         {"columnAttrs", "excludeRowAttrs", "excludeColumns", "shards"})
 
-    def _supported(self, call) -> bool:
+    def _supported(self, call, shard_filter=None) -> bool:
         if call.name == "Options":
             if len(call.children) != 1:
                 return False
@@ -1167,12 +1171,20 @@ class CollectiveExecutor:
                     isinstance(shards, list)
                     and all(isinstance(s, int) for s in shards)):
                 return False
-            return self._supported(call.children[0])
+            return self._supported(call.children[0], shards)
         if call.name in BITMAP_ROOTS:
             # bare bitmap result: the whole tree evaluates as one
             # collective program and the global Row gathers replicated
-            # — bounded by the gather ceiling (wider indexes scatter)
-            n_shards = len(self.idx.available_shards())
+            # — bounded by the gather ceiling (wider indexes scatter).
+            # The ceiling is judged on the RESTRICTED shard list (the
+            # same intersection _plan materializes), so Options(shards)
+            # can keep a wide index on the collective plane.
+            avail = self.idx.available_shards()
+            if shard_filter is not None:
+                n_shards = len({int(s) for s in shard_filter}
+                               & set(avail))
+            else:
+                n_shards = len(avail)
             if n_shards * bm.n_words(SHARD_WIDTH) * 4 \
                     > MAX_ROW_GATHER_BYTES:
                 return False

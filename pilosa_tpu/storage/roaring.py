@@ -54,6 +54,15 @@ def _setup(lib) -> None:
         ctypes.POINTER(ctypes.c_uint64),
     ]
     lib.pilosa_roaring_free_buf.argtypes = [ctypes.c_void_p]
+    lib.pilosa_roaring_decode_positions.restype = ctypes.c_int
+    lib.pilosa_roaring_decode_positions.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint8),
+    ]
 
 
 _NATIVE = NativeLib(src=_SRC, so=_SO, setup=_setup)
@@ -74,6 +83,7 @@ _ERRORS = {
     -4: "unknown container type",
     -5: "container offset out of bounds",
     -6: "serialized size exceeds the format's 4 GiB offset limit",
+    -7: "decoded positions exceed the caller's cap",
 }
 
 
@@ -326,6 +336,77 @@ def _encode_py(keys: np.ndarray, words: np.ndarray, flags: int) -> bytes:
             pairs[:, 1] = ends
             out += pairs.tobytes()
     return bytes(out)
+
+
+def payload_stats(data: bytes) -> tuple[int, int] | None:
+    """Cheap (n_containers, n_set_bits) from the descriptive headers
+    alone — no container expansion.  Lets ingest choose between the
+    dense container merge (cost ∝ containers x 1024 words) and the
+    position-space merge (cost ∝ set bits) before paying either.
+    Returns None when the header can't be parsed (caller falls back to
+    the dense path, which owns the error reporting)."""
+    try:
+        if len(data) < 8:
+            return None
+        cookie16 = int.from_bytes(data[:2], "little")
+        cookie32 = int.from_bytes(data[:4], "little")
+        if cookie16 == MAGIC:
+            n = int.from_bytes(data[4:8], "little")
+            if len(data) < 8 + n * 12:
+                return None
+            desc = np.frombuffer(data, dtype=np.uint8, count=n * 12,
+                                 offset=8).reshape(n, 12)
+            cards = (desc[:, 10:12].copy().view(np.uint16)
+                     .astype(np.int64) + 1)
+            return n, int(cards.sum())
+        if cookie16 == COOKIE_OFFICIAL_RUNS:
+            n = int.from_bytes(data[2:4], "little") + 1
+            pos = 4 + (n + 7) // 8
+        elif cookie32 == COOKIE_OFFICIAL:
+            n = int.from_bytes(data[4:8], "little")
+            pos = 8
+        else:
+            return None
+        if len(data) < pos + 4 * n:
+            return None
+        desc = np.frombuffer(data, dtype=np.uint16, count=2 * n,
+                             offset=pos).reshape(n, 2)
+        return n, int((desc[:, 1].astype(np.int64) + 1).sum())
+    except Exception:  # noqa: BLE001 — stats are advisory only
+        return None
+
+
+def decode_positions(data: bytes,
+                     max_positions: int = 1 << 28) -> np.ndarray:
+    """Parse serialized roaring -> sorted absolute bit positions
+    (u64[n_bits]) WITHOUT materializing dense 1024-word blocks — the
+    sparse-ingest fast path (the analog of the reference's streamed
+    ImportRoaringBits iterator, roaring/roaring.go:1511, which likewise
+    walks containers without densifying arrays).  Raises RoaringError
+    when the ACTUAL emitted count would exceed ``max_positions`` —
+    descriptor cardinalities are untrusted (a hostile run container can
+    lie small); callers fall back to the chunk-bounded dense path."""
+    if len(data) >= 2 and int.from_bytes(data[:2], "little") == MAGIC:
+        lib = _load_native()
+        if lib is not None:
+            pos_p = ctypes.POINTER(ctypes.c_uint64)()
+            n = ctypes.c_uint64()
+            flags = ctypes.c_uint8()
+            rc = lib.pilosa_roaring_decode_positions(
+                data, len(data), int(max_positions), ctypes.byref(pos_p),
+                ctypes.byref(n), ctypes.byref(flags))
+            if rc != 0:
+                raise RoaringError(
+                    _ERRORS.get(rc, f"roaring decode error {rc}"))
+            nv = n.value
+            try:
+                out = (np.ctypeslib.as_array(pos_p, shape=(nv,)).copy()
+                       if nv else np.empty(0, np.uint64))
+            finally:
+                lib.pilosa_roaring_free_buf(pos_p)
+            return out
+    keys, words, _flags = decode(data)
+    return containers_to_positions(keys, words)
 
 
 # ------------------------------------------------- position conversion

@@ -46,3 +46,68 @@ def test_every_executor_op_documented():
     missing = [op for op in ops
                if not re.search(rf"(?<![A-Za-z]){op}\(", tested_pql)]
     assert not missing, f"ops without a tested example: {missing}"
+
+
+def test_every_config_key_documented():
+    """configuration.md must name every Config field's TOML key and
+    env var (the reference ships a full configuration reference,
+    docs/configuration.md:1-638; ours is introspection-checked so a
+    new field can't ship undocumented)."""
+    from dataclasses import fields
+
+    from pilosa_tpu import config as cfgmod
+
+    text = open(os.path.join(DOCS, "configuration.md")).read()
+    missing = []
+    sections = ("cluster", "anti_entropy", "metric", "tracing",
+                "profile", "tls")
+    for f in fields(cfgmod.Config):
+        if f.name in sections:
+            section = f.name
+            sec_cls = type(getattr(cfgmod.Config(), section))
+            for sf in fields(sec_cls):
+                toml_key = sf.name.replace("_", "-")
+                env = f"PILOSA_TPU_{section}_{sf.name}".upper()
+                if f"`{toml_key}`" not in text:
+                    missing.append(f"[{section}] {toml_key}")
+                if env not in text:
+                    missing.append(env)
+        else:
+            toml_key = f.name.replace("_", "-")
+            env = f"PILOSA_TPU_{f.name}".upper()
+            if f"`{toml_key}`" not in text:
+                missing.append(toml_key)
+            if env not in text:
+                missing.append(env)
+    assert not missing, f"undocumented config keys: {missing}"
+
+
+def test_runtime_env_knobs_documented():
+    """Every PILOSA_TPU_* environment knob read anywhere in the
+    package must appear in configuration.md."""
+    import re
+    import subprocess
+
+    pkg = os.path.join(os.path.dirname(DOCS), "pilosa_tpu")
+    src = subprocess.run(
+        ["grep", "-rhoE", r"PILOSA_TPU_[A-Z_]+", pkg],
+        capture_output=True, text=True).stdout
+    knobs = set(re.findall(r"PILOSA_TPU_[A-Z_0-9]+", src))
+    # exclude the config-derived names (covered by the test above) and
+    # internal coordination flags not meant for operators
+    internal = {"PILOSA_TPU_AXON_CAPTURING"}
+    from dataclasses import fields
+
+    from pilosa_tpu import config as cfgmod
+
+    derived = set()
+    for f in fields(cfgmod.Config):
+        derived.add(f"PILOSA_TPU_{f.name}".upper())
+        val = getattr(cfgmod.Config(), f.name)
+        if hasattr(val, "__dataclass_fields__"):
+            for sf in fields(type(val)):
+                derived.add(f"PILOSA_TPU_{f.name}_{sf.name}".upper())
+    text = open(os.path.join(DOCS, "configuration.md")).read()
+    missing = sorted(k for k in knobs - internal - derived
+                     if k not in text)
+    assert not missing, f"undocumented env knobs: {missing}"

@@ -32,6 +32,9 @@ def _env() -> dict:
     env.update(
         JAX_PLATFORMS="cpu",
         PALLAS_AXON_POOL_IPS="",
+        # tighten SWIM probe rounds so DOWN detection fits the
+        # _wait_status windows deterministically under CI load
+        PILOSA_TPU_PROBE_DEADLINE_S="2.0",
         PILOSA_TPU_SHARD_WIDTH_EXP=os.environ.get(
             "PILOSA_TPU_SHARD_WIDTH_EXP", "16"),
         PYTHONPATH=os.path.dirname(os.path.dirname(
@@ -235,7 +238,7 @@ def test_freeze_fault_sigstop_mid_import_and_query(tmp_path):
 
         # ---- while frozen: survivors detect the freeze (DEGRADED)
         # and answer exactly via replica failover
-        _wait_status(ports[0], "DEGRADED", deadline=30.0)
+        _wait_status(ports[0], "DEGRADED", deadline=60.0)
         frozen_q = _post(ports[0], "/index/i/query",
                          {"query": "Count(Row(f=2))"}, timeout=60.0)
         # exact-failover bound: at least everything the pre-freeze
@@ -253,7 +256,7 @@ def test_freeze_fault_sigstop_mid_import_and_query(tmp_path):
         assert not t_imp.is_alive(), "import never finished after thaw"
         assert not import_err, import_err
         for p in ports:
-            _wait_status(p, "NORMAL", 3, deadline=90.0)
+            _wait_status(p, "NORMAL", 3, deadline=120.0)
         # anti-entropy cycle (2 s interval) heals replicas the frozen
         # window missed; poll until all three answer identically
         deadline = time.time() + 60.0
@@ -286,6 +289,6 @@ def test_freeze_fault_sigstop_mid_import_and_query(tmp_path):
         time.sleep(3.0)
         procs[2].send_signal(signal.SIGCONT)
         for p in ports:
-            _wait_status(p, "NORMAL", 3, deadline=90.0)
+            _wait_status(p, "NORMAL", 3, deadline=120.0)
         for p in ports:
             check_exact(p)

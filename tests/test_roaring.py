@@ -293,3 +293,181 @@ def test_import_roaring_unsorted_duplicate_keys(tmp_path):
     f3 = Fragment(path, "i", "f", "standard", 0)
     assert f3.bit(0, 1) and f3.bit(1, 0)
     f3.close()
+
+
+# ---------------------------------------------- sparse positions path
+
+
+def test_decode_positions_golden():
+    """decode_positions agrees with the dense decode on the golden file
+    (array + run + bitmap containers), in sorted order."""
+    data = golden_bytes()
+    pos = rc.decode_positions(data)
+    keys, words, _ = rc.decode(data)
+    want = rc.containers_to_positions(keys, words)
+    assert np.array_equal(pos, want)
+    assert np.all(pos[1:] > pos[:-1])
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_decode_positions_matches_dense(seed):
+    keys, words = random_containers(seed)
+    data = rc.encode(keys, words)
+    pos = rc.decode_positions(data)
+    want = rc.containers_to_positions(keys, words)
+    assert np.array_equal(pos, want)
+
+
+def test_payload_stats():
+    data = golden_bytes()
+    n_cont, n_bits = rc.payload_stats(data)
+    assert n_cont == 3
+    assert n_bits == 2 + 11 + 32768
+    assert rc.payload_stats(b"\x00\x01") is None
+    # official 32-bit format header (cookie 12346): hand-built file
+    # with one array container of 3 values — stats must parse its
+    # descriptor without expanding the payload
+    off = bytearray()
+    off += (12346).to_bytes(4, "little")       # cookie, no runs
+    off += (1).to_bytes(4, "little")           # container count
+    off += (0).to_bytes(2, "little")           # key 0
+    off += (2).to_bytes(2, "little")           # cardinality-1
+    off += (16).to_bytes(4, "little")          # offset header
+    off += (3).to_bytes(2, "little") + (9).to_bytes(2, "little") \
+        + (100).to_bytes(2, "little")
+    assert rc.payload_stats(bytes(off)) == (1, 3)
+    # and the dense official decoder agrees on the same bytes
+    k_off, w_off, _ = rc.decode(bytes(off))
+    assert list(k_off) == [0]
+    assert int(np.bitwise_count(w_off).sum()) == 3
+
+
+def test_merge_positions_matches_dense_merge(tmp_path):
+    """fragment.import_roaring takes the positions path for sparse
+    payloads and the dense container path otherwise; both must produce
+    identical state and changed-counts, set AND clear."""
+    from pilosa_tpu.models.fragment import Fragment
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(21)
+
+    def mk(tag, sparse_threshold):
+        f = Fragment(path=str(tmp_path / tag), index="i", field="f",
+                     view="standard", shard=0)
+        f._SPARSE_BITS_PER_CONTAINER = sparse_threshold
+        return f
+
+    f_pos = mk("pos", 1 << 30)   # always positions path
+    f_dense = mk("dense", 0)     # always dense path
+    # seed both, then merge a second batch, then clear a third —
+    # _merge_roaring returns the changed-bit count, compared per call
+    for nb in (4000, 12000):
+        pos = np.unique(rng.integers(0, 64 * SHARD_WIDTH, nb,
+                                     dtype=np.uint64))
+        data = rc.encode(*rc.positions_to_containers(pos))
+        c1 = f_pos._merge_roaring(data, False)
+        c2 = f_dense._merge_roaring(data, False)
+        assert c1 == c2, (c1, c2)
+    clear_pos = np.unique(rng.integers(0, 64 * SHARD_WIDTH, 6000,
+                                       dtype=np.uint64))
+    cdata = rc.encode(*rc.positions_to_containers(clear_pos))
+    c1 = f_pos._merge_roaring(cdata, True)
+    c2 = f_dense._merge_roaring(cdata, True)
+    assert c1 == c2, (c1, c2)
+    rows = set(f_pos._rows) | set(f_dense._rows)
+    for r in rows:
+        a, b = f_pos._rows.get(r), f_dense._rows.get(r)
+        if a is None or b is None:
+            assert (a is None or not a.any()) and (b is None or not b.any())
+        else:
+            assert np.array_equal(a, b), r
+
+
+def test_merge_positions_numpy_fallback(tmp_path, monkeypatch):
+    """State parity when the native merge kernel is unavailable."""
+    from pilosa_tpu.models.fragment import Fragment
+    from pilosa_tpu.ops import hostkernels
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(22)
+    pos = np.unique(rng.integers(0, 8 * SHARD_WIDTH, 3000,
+                                 dtype=np.uint64))
+    data = rc.encode(*rc.positions_to_containers(pos))
+
+    f_native = Fragment(path=str(tmp_path / "n"), index="i", field="f",
+                        view="standard", shard=0)
+    f_native._merge_positions(rc.decode_positions(data), False)
+    monkeypatch.setattr(hostkernels, "merge_positions",
+                        lambda *a, **k: None)
+    f_py = Fragment(path=str(tmp_path / "p"), index="i", field="f",
+                    view="standard", shard=0)
+    n_py = f_py._merge_positions(rc.decode_positions(data), False)
+    assert n_py == len(pos)
+    for r in set(f_native._rows) | set(f_py._rows):
+        assert np.array_equal(f_native._rows[r], f_py._rows[r])
+    # clear half through the fallback too
+    half = pos[::2]
+    hdata = rc.encode(*rc.positions_to_containers(half))
+    n_clear = f_py._merge_positions(rc.decode_positions(hdata), True)
+    assert n_clear == len(half)
+
+
+def test_merge_positions_unsorted_hostile_payload(tmp_path):
+    """A wire payload with out-of-order keys must not corrupt state:
+    decode_positions output gets re-sorted before the merge."""
+    from pilosa_tpu.models.fragment import Fragment
+
+    # containers with keys out of order on the wire (hand-built)
+    out = bytearray()
+    out += (12348).to_bytes(2, "little") + bytes([0, 0])
+    out += (2).to_bytes(4, "little")
+    out += (5).to_bytes(8, "little") + (1).to_bytes(2, "little") \
+        + (0).to_bytes(2, "little")
+    out += (1).to_bytes(8, "little") + (1).to_bytes(2, "little") \
+        + (0).to_bytes(2, "little")
+    base = 8 + 2 * 12 + 2 * 4
+    out += base.to_bytes(4, "little")
+    out += (base + 2).to_bytes(4, "little")
+    out += (7).to_bytes(2, "little")   # key 5: bit 7
+    out += (9).to_bytes(2, "little")   # key 1: bit 9
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    f = Fragment(path=str(tmp_path / "h"), index="i", field="f",
+                 view="standard", shard=0)
+    changed = f._merge_positions(rc.decode_positions(bytes(out)), False)
+    assert changed == 2
+    for p in ((5 << 16) | 7, (1 << 16) | 9):
+        assert f.row_count(p // SHARD_WIDTH) >= 1
+    assert sum(f.row_count(r) for r in set(f._rows)) == 2
+
+
+def test_lying_run_descriptor_falls_back_to_dense(tmp_path):
+    """A hostile payload whose run containers declare tiny descriptor
+    cardinalities but expand huge must NOT be able to blow past the
+    sparse-path memory cap: decode_positions enforces the cap on the
+    ACTUAL emitted count and import falls back to the chunk-bounded
+    dense path, still merging exactly."""
+    from pilosa_tpu.models.fragment import Fragment
+
+    out = bytearray()
+    out += (12348).to_bytes(2, "little") + bytes([0, 0])
+    out += (1).to_bytes(4, "little")
+    # descriptor LIES: card-1 = 0 (claims 1 bit)
+    out += (0).to_bytes(8, "little") + (3).to_bytes(2, "little") \
+        + (0).to_bytes(2, "little")
+    base = 8 + 12 + 4
+    out += base.to_bytes(4, "little")
+    # run payload: one run covering the whole container (65536 bits)
+    out += (1).to_bytes(2, "little")
+    out += (0).to_bytes(2, "little") + (65535).to_bytes(2, "little")
+    data = bytes(out)
+
+    with pytest.raises(rc.RoaringError):
+        rc.decode_positions(data, max_positions=1024)
+
+    f = Fragment(path=str(tmp_path / "l"), index="i", field="f",
+                 view="standard", shard=0)
+    f._SPARSE_MAX_BITS = 512  # force the cap low: lying payload trips it
+    f.import_roaring(data)
+    total = sum(f.row_count(r) for r in set(f._rows))
+    assert total == 65536  # dense path merged the real bits exactly
