@@ -146,17 +146,39 @@ class Transport:
 class LocalTransport(Transport):
     """In-process fabric for multi-node tests: the registry maps node id
     -> handle with .executor/.holder/.receive_message (the reference's
-    in-process test cluster, test/pilosa.go:390)."""
+    in-process test cluster, test/pilosa.go:390).
+
+    Fault injection: ``set_down`` makes a node unreachable from
+    everyone (process death); ``set_partition(a, b)`` drops messages
+    between a PAIR of live nodes bidirectionally (the pumba netem
+    partition, internal/clustertests/cluster_test.go:69-80) — each
+    side still serves everyone else, so SWIM indirect probing through
+    a third node can still vouch for both.  Partition enforcement
+    needs the sender's identity, which the wire protocol has but a
+    shared in-process registry does not — ``bind(node_id)`` returns a
+    per-node view that stamps the sender on every call."""
 
     def __init__(self):
         self.handles: dict[str, object] = {}
         self.down: set[str] = set()
+        self.partitions: set[frozenset] = set()
 
     def register(self, node_id: str, handle) -> None:
         self.handles[node_id] = handle
 
     def set_down(self, node_id: str, down: bool = True) -> None:
         (self.down.add if down else self.down.discard)(node_id)
+
+    def set_partition(self, a: str, b: str, on: bool = True) -> None:
+        key = frozenset((a, b))
+        (self.partitions.add if on else self.partitions.discard)(key)
+
+    def bind(self, node_id: str) -> "BoundTransport":
+        return BoundTransport(self, node_id)
+
+    def _check_partition(self, src: str, dst: str) -> None:
+        if frozenset((src, dst)) in self.partitions:
+            raise TransportError(f"partitioned: {src} <-/-> {dst}")
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int]):
         from pilosa_tpu.parallel.executor import ExecOptions
@@ -175,6 +197,31 @@ class LocalTransport(Transport):
         if node.id in self.down or node.id not in self.handles:
             raise TransportError(f"node unreachable: {node.id}")
         return self.handles[node.id].receive_message(message)
+
+
+class BoundTransport(Transport):
+    """A LocalTransport view that stamps one node's identity on every
+    outgoing call so pair partitions can be enforced.  The partition
+    check runs here, then delegates to the parent's PUBLIC methods —
+    tests that monkeypatch ``parent.send_message``/``query_node`` keep
+    intercepting all traffic with their original signatures."""
+
+    def __init__(self, parent: LocalTransport, src: str):
+        self.parent = parent
+        self.src = src
+
+    def __getattr__(self, name):
+        # everything except the two partition-checked overrides
+        # delegates to the shared parent (registry, down set, bind...)
+        return getattr(self.parent, name)
+
+    def query_node(self, node: Node, index: str, pql: str, shards: list[int]):
+        self.parent._check_partition(self.src, node.id)
+        return self.parent.query_node(node, index, pql, shards)
+
+    def send_message(self, node: Node, message: dict) -> dict:
+        self.parent._check_partition(self.src, node.id)
+        return self.parent.send_message(node, message)
 
 
 class Cluster:

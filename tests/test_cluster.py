@@ -117,7 +117,7 @@ def make_cluster(tmp_path, n=3, replica_n=1, hasher=None):
             nodes=[Node(id=x) for x in node_ids],
             replica_n=replica_n,
             hasher=hasher,
-            transport=transport,
+            transport=transport.bind(nid),
         )
         cluster.set_state("NORMAL")
         nodes.append(ClusterNode(holder, cluster))

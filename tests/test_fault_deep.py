@@ -243,3 +243,72 @@ class TestRestartOverTornWal:
         assert got == 190, got
         c2.close()
         s2.close()
+
+
+class TestPairPartition:
+    """Bidirectional pair partition (the pumba netem scenario,
+    internal/clustertests/cluster_test.go:69-80): two LIVE nodes stop
+    hearing each other while both keep serving everyone else.  Reads
+    from either side must fail over to the reachable replica, SWIM
+    must NOT declare either side dead (indirect ping-req through the
+    third node vouches for both), and anti-entropy passes racing the
+    partition must skip the unreachable peer without corrupting."""
+
+    def test_partition_failover_vouching_and_ae_race(self, tmp_path):
+        import random
+
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=2)
+        cols = _seed(nodes[0])
+        want = len(cols)
+        for nd in nodes:
+            assert nd.executor.execute("i", "Count(Row(f=1))")[0] == want
+
+        transport.set_partition("node0", "node1")
+        try:
+            # direct link is dead both ways
+            n0, n1 = nodes[0], nodes[1]
+            with pytest.raises(TransportError):
+                n0.cluster.transport.send_message(
+                    Node(id="node1"), {"type": "ping"})
+            with pytest.raises(TransportError):
+                n1.cluster.transport.send_message(
+                    Node(id="node0"), {"type": "ping"})
+            # ...but a third party still reaches both sides
+            assert transport.send_message(
+                Node(id="node0"), {"type": "ping"}).get("ok")
+
+            # reads stay exact from EVERY node: shards whose primary
+            # sits across the cut fail over to the reachable replica
+            for nd in nodes:
+                assert nd.executor.execute(
+                    "i", "Count(Row(f=1))")[0] == want
+
+            # SWIM: node0's round probes node1 directly (fails) then
+            # escalates to ping-req via node2 (succeeds) -> no state
+            # change, nobody marked DOWN
+            changes = heartbeat_round(nodes[0], k=2,
+                                      rng=random.Random(7))
+            assert not changes, changes
+            assert all(p.state != "DOWN"
+                       for p in nodes[0].cluster.sorted_nodes())
+
+            # anti-entropy racing the partition: each syncer skips the
+            # peer it cannot reach; nothing is lost or half-applied
+            for nd in nodes:
+                HolderSyncer(nd).sync_holder()
+            for nd in nodes:
+                assert nd.executor.execute(
+                    "i", "Count(Row(f=1))")[0] == want
+
+            # writes land on the reachable replica set; the cut replica
+            # is healed by AE after the partition lifts
+            API(nodes[2]).import_bits("i", "f", [1],
+                                      [5 * SHARD_WIDTH + 123])
+            want += 1
+        finally:
+            transport.set_partition("node0", "node1", False)
+
+        for nd in nodes:
+            HolderSyncer(nd).sync_holder()
+        for nd in nodes:
+            assert nd.executor.execute("i", "Count(Row(f=1))")[0] == want

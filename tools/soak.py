@@ -11,6 +11,15 @@ driving coordinator-led elastic RESIZE events: a fourth node joins
 exact across every ownership change — the reference's
 internal/clustertests/ tier including its resize legs.
 
+Round 5 adds bidirectional PAIR PARTITIONS to the fault schedule
+(internal/clustertests/cluster_test.go:69-80's pumba netem scenario):
+two live nodes stop hearing each other while both keep serving the
+rest of the cluster — reads from either side must fail over to the
+reachable replica, and anti-entropy passes RACE the partition (the
+syncer must skip the unreachable peer, never half-apply).  The
+process-level counterpart with real SIGSTOP freezes is
+tools/soak_proc.py.
+
     PYTHONPATH=/root/repo:$PYTHONPATH python tools/soak.py --seconds 600
 
 Exit code 0 = no divergence.  Deterministic per --seed.  The CI-tier
@@ -79,9 +88,11 @@ def main() -> int:
     from pilosa_tpu.pql import parse_python
 
     downed: str | None = None
+    partition: tuple[str, str] | None = None
     iters = 0
     checks = 0
     resizes = 0
+    partitions = 0
     extra: list = []  # nodes joined beyond the base 3, newest last
     next_extra_id = 3
     t_end = time.monotonic() + args.seconds
@@ -94,12 +105,15 @@ def main() -> int:
     while time.monotonic() < t_end:
         iters += 1
         action = rng.random()
+        # writes and resizes need every replica reachable from the
+        # coordinator; reads and AE deliberately RACE active faults
+        quiesced = downed is None and partition is None
 
         if action < 0.18:  # bulk import
             f = rng.choice(fields)
             row = rng.randrange(5)
             cs = sorted({col() for _ in range(rng.randrange(1, 120))})
-            if downed is None:  # writes only with all replicas up
+            if quiesced:  # writes only with all replicas up
                 api.import_bits("i", f, [row] * len(cs), cs)
                 bits[(f, row)].update(cs)
                 universe.update(cs)
@@ -107,7 +121,7 @@ def main() -> int:
             f = rng.choice(fields)
             row = rng.randrange(5)
             c = col()
-            if downed is None:
+            if quiesced:
                 if rng.random() < 0.7:
                     ex.execute("i", f"Set({c}, {f}={row})")
                     bits[(f, row)].add(c)
@@ -118,7 +132,7 @@ def main() -> int:
         elif action < 0.36:  # BSI write
             c = col()
             v = rng.randrange(-1000, 1001)
-            if downed is None:
+            if quiesced:
                 ex.execute("i", f"Set({c}, v={v})")
                 vals[c] = v
                 universe.add(c)
@@ -175,7 +189,7 @@ def main() -> int:
             # ownership moves under live traffic; the oracle must stay
             # exact across every re-homing (reference clustertests
             # resize legs, cluster.go:1196-1561)
-            if downed is None:
+            if quiesced:
                 from pilosa_tpu.models.holder import Holder
                 from pilosa_tpu.parallel.cluster import Cluster, Node
                 from pilosa_tpu.parallel.node import ClusterNode
@@ -190,7 +204,8 @@ def main() -> int:
                     next_extra_id += 1
                     h = Holder(str(tmp / dirname))
                     cl = Cluster("node3", nodes=[Node(id="node3")],
-                                 replica_n=2, transport=transport)
+                                 replica_n=2,
+                                 transport=transport.bind("node3"))
                     jn = ClusterNode(h, cl)
                     resp = transport.send_message(
                         coord.cluster.local_node,
@@ -210,14 +225,26 @@ def main() -> int:
                 for nd in live_nodes():
                     assert nd.cluster.state == "NORMAL", (
                         f"{nd.cluster.local_id} not NORMAL after resize")
-        elif action < 0.975:  # fault injection: drop / restore a node
-            if downed is None:
+        elif action < 0.975:  # fault injection: heal, or down / partition
+            if downed is not None:
+                transport.set_down(downed, False)
+                downed = None
+            elif partition is not None:
+                transport.set_partition(*partition, False)
+                partition = None
+            elif rng.random() < 0.5:
                 downed = rng.choice(["node1", "node2"])
                 transport.set_down(downed)
             else:
-                transport.set_down(downed, False)
-                downed = None
-        else:  # anti-entropy repair pass
+                # bidirectional pair partition between two LIVE nodes:
+                # both keep serving everyone else; reads from either
+                # side must fail over to the reachable replica
+                ids = [nd.cluster.local_id for nd in live_nodes()]
+                a, b = rng.sample(ids, 2)
+                transport.set_partition(a, b)
+                partition = (a, b)
+                partitions += 1
+        else:  # anti-entropy repair pass — races any active partition
             if downed is None:
                 for nd in live_nodes():
                     HolderSyncer(nd).sync_holder()
@@ -225,11 +252,14 @@ def main() -> int:
         if time.monotonic() >= t_report:
             t_report = time.monotonic() + args.progress_every
             print(f"soak: {iters} iters, {checks} oracle checks, "
-                  f"{resizes} resizes, nodes={len(live_nodes())}, "
-                  f"downed={downed}", flush=True)
+                  f"{resizes} resizes, {partitions} partitions, "
+                  f"nodes={len(live_nodes())}, downed={downed}, "
+                  f"partition={partition}", flush=True)
 
     if downed is not None:
         transport.set_down(downed, False)
+    if partition is not None:
+        transport.set_partition(*partition, False)
     for nd in live_nodes():
         HolderSyncer(nd).sync_holder()
     # final convergence: every node answers every row exactly
@@ -242,7 +272,7 @@ def main() -> int:
                 assert got == want, f"final divergence {f}={r} on " \
                     f"{nd.cluster.local_id}"
     print(f"soak PASSED: {iters} iters, {checks} oracle checks, "
-          f"{resizes} resizes")
+          f"{resizes} resizes, {partitions} partitions")
     return 0
 
 
