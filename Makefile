@@ -6,10 +6,20 @@
 
 PY ?= python
 
-.PHONY: test test-paranoia test-shard22 test-matrix bench measure measure-resize measure-spmd validate-tpu soak soak-spmd check doccheck doccheck-fill clean
+.PHONY: test test-paranoia test-shard22 test-matrix bench measure measure-resize measure-spmd validate-tpu soak soak-spmd check doccheck doccheck-fill native clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+native:  # pre-build all four C++ fast paths (they also self-build lazily)
+	$(PY) -c "from pilosa_tpu.ops import hostkernels as hk; \
+	from pilosa_tpu.storage import roaring; \
+	from pilosa_tpu.pql import native as pqlnative; \
+	from pilosa_tpu import csvload; \
+	print('bitcount:', hk.native_available()); \
+	print('roaring :', roaring.native_available()); \
+	print('pql     :', pqlnative.available()); \
+	print('csv     :', csvload.available())"
 
 # sanitizer tier: every fragment mutation re-validates invariants
 test-paranoia:

@@ -19,6 +19,8 @@ DOCS = os.path.join(os.path.dirname(os.path.dirname(
 @pytest.mark.parametrize("doc,min_examples", [
     ("query-language.md", 45),
     ("getting-started.md", 5),
+    ("tutorials.md", 18),
+    ("examples.md", 10),
 ])
 def test_doc_examples_verify(doc, min_examples):
     checked = doccheck.run(os.path.join(DOCS, doc))
