@@ -171,8 +171,11 @@ def main() -> None:
     log(f"relay_watcher start pid={os.getpid()} poll={POLL_S}s")
     was_up = False
     last_heartbeat = 0.0
-    last_bench = 0.0
-    last_full = 0.0
+    # time.monotonic() starts at machine boot: initializing these to
+    # 0.0 would read as "captured moments ago" on a fresh boot and sit
+    # out the first hours of an up-window — force both due at start
+    last_bench = time.monotonic() - 2 * BENCH_RECAPTURE_S
+    last_full = time.monotonic() - 2 * FULL_RECAPTURE_S
     while True:
         now = time.monotonic()
         up = relay_up()
