@@ -570,9 +570,9 @@ class ClusterNode:
             entries = resp.get("entries", [])
             if not entries:
                 return applied
-            for e in entries:
-                store.apply_entry(e["offset"], e["id"], e["key"])
-                applied += 1
+            store.apply_entries(
+                [(e["offset"], e["id"], e["key"]) for e in entries])
+            applied += len(entries)
             if store.max_offset() <= before:
                 # no forward progress (conflicting local entries were
                 # ignored by apply): bail rather than spin forever

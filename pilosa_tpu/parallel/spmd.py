@@ -83,9 +83,12 @@ MAX_COLLECTIVE_PAIRS = 1 << 22
 BITMAP_ROOTS = ("Row", "Range", "Union", "Intersect", "Difference",
                 "Xor", "Not", "Shift")
 
-#: byte ceiling for the replicated bare-bitmap gather ([G, words] on
-#: every process).  Indexes wider than this answer bare rows on the
-#: scatter plane, whose per-shard segments never replicate.
+#: per-window byte bound for the replicated bare-bitmap gather.  A
+#: [G, words] result wider than this replicates in shard-range
+#: windows (each a bounded collective) instead of one all-gather, so
+#: ANY index width stays on the collective plane with per-process
+#: transient memory capped at one window (round 5; previously a hard
+#: ceiling that pushed wide indexes to the scatter plane).
 MAX_ROW_GATHER_BYTES = 1 << 28
 
 
@@ -1160,7 +1163,7 @@ class CollectiveExecutor:
     _OPTIONS_ARGS = frozenset(
         {"columnAttrs", "excludeRowAttrs", "excludeColumns", "shards"})
 
-    def _supported(self, call, shard_filter=None) -> bool:
+    def _supported(self, call) -> bool:
         if call.name == "Options":
             if len(call.children) != 1:
                 return False
@@ -1171,23 +1174,13 @@ class CollectiveExecutor:
                     isinstance(shards, list)
                     and all(isinstance(s, int) for s in shards)):
                 return False
-            return self._supported(call.children[0], shards)
+            return self._supported(call.children[0])
         if call.name in BITMAP_ROOTS:
             # bare bitmap result: the whole tree evaluates as one
-            # collective program and the global Row gathers replicated
-            # — bounded by the gather ceiling (wider indexes scatter).
-            # The ceiling is judged on the RESTRICTED shard list (the
-            # same intersection _plan materializes), so Options(shards)
-            # can keep a wide index on the collective plane.
-            avail = self.idx.available_shards()
-            if shard_filter is not None:
-                n_shards = len({int(s) for s in shard_filter}
-                               & set(avail))
-            else:
-                n_shards = len(avail)
-            if n_shards * bm.n_words(SHARD_WIDTH) * 4 \
-                    > MAX_ROW_GATHER_BYTES:
-                return False
+            # collective program and the global Row replicates — in
+            # one all-gather, or in MAX_ROW_GATHER_BYTES shard-range
+            # windows on indexes too wide for a single replicated
+            # stack (no width limit on collective support).
             return self._tree_ok(call)
         if call.name == "Count":
             return (len(call.children) == 1
@@ -1423,21 +1416,45 @@ class CollectiveExecutor:
                      np.uint32), _sharding(plan, 1))
 
     def _bitmap_row(self, call, plan: Plan):
-        """Bare bitmap tree -> global Row: evaluate the collective
-        program, all-gather the [G, words] result replicated, assemble
-        per-shard segments host-side (reference executeBitmapCall,
+        """Bare bitmap tree -> global Row, assembled host-side from
+        replicated gathers (reference executeBitmapCall,
         executor.go:651; cross-node merge row.go Merge — here the
-        merge IS the gather)."""
+        merge IS the gather).
+
+        Width bound: past MAX_ROW_GATHER_BYTES the tree is evaluated
+        per shard-range SUB-PLAN — every call in a bare bitmap tree is
+        shard-local (set algebra, BSI compares, time unions all work
+        words-wise within a shard), so evaluating the tree restricted
+        to a shard window yields exactly that window of the full
+        result, each shard still evaluated once.  Both the sharded
+        operand stacks and the replicated gather are then window-sized
+        (a sliced gather of one big result stack would NOT bound
+        memory: SPMD partitioning of a dynamic-slice on the sharded
+        dim compiles to a full all-gather first).  Every process
+        derives the identical window sequence from the shared plan —
+        collective order safe."""
         from pilosa_tpu.models.row import Row
 
-        stack = self._eval_stack(call, plan)
-        full = np.asarray(_jit_gather(plan.mesh)(stack))
         segments: dict[int, np.ndarray] = {}
-        for gi, s in enumerate(plan.order):
-            if s >= 0 and full[gi].any():
-                # copy: a view would pin the whole gathered stack for
-                # as long as one sparse segment lives
-                segments[s] = full[gi].copy()
+
+        def assemble(sub: Plan) -> None:
+            stack = self._eval_stack(call, sub)
+            full = np.asarray(_jit_gather(sub.mesh)(stack))
+            for gi, s in enumerate(sub.order):
+                if s >= 0 and full[gi].any():
+                    # copy: a view would pin the whole gathered
+                    # window for as long as one sparse segment lives
+                    segments[s] = full[gi].copy()
+
+        words = bm.n_words(SHARD_WIDTH)
+        max_g = max(1, MAX_ROW_GATHER_BYTES // (words * 4))
+        if len(plan.order) <= max_g:
+            assemble(plan)
+        else:
+            real = [s for s in plan.order if s >= 0]
+            owner = owner_rank_fn(self.cluster, self.index_name)
+            for w0 in range(0, len(real), max_g):
+                assemble(make_plan(real[w0:w0 + max_g], owner))
         return Row(segments)
 
     def _eval_stack(self, call, plan: Plan):

@@ -60,6 +60,14 @@ class TranslateStore:
         """Replica-side apply of a streamed entry (idempotent)."""
         raise NotImplementedError
 
+    def apply_entries(self, entries) -> None:
+        """Replica-side apply of a whole streamed page
+        [(offset, id, key)] — overridden where a single transaction
+        beats per-entry commits (tailing a 1M-key backlog pays one
+        fsync per PAGE, not per key)."""
+        for off, id_, key in entries:
+            self.apply_entry(off, id_, key)
+
     def set_read_only(self, ro: bool) -> None:
         self.read_only = ro
 
@@ -248,6 +256,22 @@ class SQLiteTranslateStore(TranslateStore):
                 "INSERT OR IGNORE INTO keys (id, key) VALUES (?, ?)", (int(id), key)
             )
             con.commit()
+
+    def apply_entries(self, entries) -> None:
+        """One INSERT-OR-IGNORE transaction per streamed page: a
+        replica catching up a large backlog commits once per ~10k-entry
+        page instead of once per key (the per-entry path fsynced every
+        apply — the dominant cost of 1M-key tail catch-up)."""
+        con = self._conn()
+        with self._lock:
+            try:
+                con.executemany(
+                    "INSERT OR IGNORE INTO keys (id, key) VALUES (?, ?)",
+                    [(int(id_), key) for _, id_, key in entries])
+                con.commit()
+            except Exception:
+                con.rollback()
+                raise
 
     def close(self) -> None:
         con = getattr(self._local, "con", None)

@@ -357,6 +357,28 @@ class TestSingleProcessCollective:
         assert sorted(int(c) for c in got.columns()) == \
             sorted(c for c, x in vals.items() if x > 100000)
 
+    def test_bare_bitmap_windowed_gather(self, single, monkeypatch):
+        """Past MAX_ROW_GATHER_BYTES the bare-bitmap result replicates
+        in shard-range windows instead of one all-gather — same exact
+        Row, bounded per-process transient (round-5 VERDICT #8).
+        Shrinking the bound to ~2 shards per window forces the 5-shard
+        index through the windowed path, including the clamped
+        overlapping last window."""
+        h, ce, ex, bits, vals = single
+        words = spmd.bm.n_words(SHARD_WIDTH)
+        for max_shards in (1, 2, 3):
+            monkeypatch.setattr(spmd, "MAX_ROW_GATHER_BYTES",
+                                max_shards * words * 4)
+            for pql in ("Row(f=0)",
+                        "Union(Row(f=0), Row(f=1), Row(f=2))",
+                        "Row(v > 100000)"):
+                got = ce.execute(pql)
+                want = ex.execute("i", pql)[0]
+                assert got == want, (max_shards, pql)
+        got = ce.execute("Union(Row(f=0), Row(f=1))")
+        assert sorted(int(c) for c in got.columns()) == \
+            sorted(bits[0] | bits[1])
+
     def test_wide_group_by_parity(self, single):
         """4+-child GroupBy runs collectively via the outer cartesian
         lockstep loop (round-4 VERDICT #3)."""

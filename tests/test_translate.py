@@ -192,3 +192,30 @@ def test_batched_translate_ids(tmp_path):
     assert keys[:3] == ["k0", "k1", "k2"]
     assert keys[-1] is None
     s.close()
+
+
+def test_apply_entries_batched_page(tmp_path):
+    """Replica-side page apply: one transaction per streamed page,
+    idempotent under re-delivery, conflicting ids ignored (offsets
+    stay gapless for tail resume) — the 1M-key catch-up fast path
+    (reference TranslateEntryReader, holder.go:690-878)."""
+    primary = SQLiteTranslateStore(str(tmp_path / "p.db"))
+    primary.translate_keys([f"k{i}" for i in range(25_000)], create=True)
+
+    replica = SQLiteTranslateStore(str(tmp_path / "r.db"))
+    # apply in 10k pages exactly as _tail_store streams them
+    off = 0
+    while True:
+        page = primary.entries(off)
+        if not page:
+            break
+        replica.apply_entries(page)
+        off = page[-1][0]
+    assert replica.max_offset() == primary.max_offset()
+    assert replica.translate_id(25_000) == "k24999"  # ids are 1-based
+    assert replica.translate_key("k0") == primary.translate_key("k0")
+    # re-delivery of an old page is a no-op (INSERT OR IGNORE)
+    replica.apply_entries(primary.entries(0))
+    assert replica.max_offset() == primary.max_offset()
+    primary.close()
+    replica.close()
