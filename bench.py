@@ -213,11 +213,16 @@ def bench_device(a_np: np.ndarray, b_np: np.ndarray):
         # A/B the Pallas single-pass kernel against XLA's fused
         # AND+popcount on the real chip — both are exact; the headline
         # takes the winner and the artifact records both so a relay
-        # window always captures the comparison
+        # window always captures the comparison.  The PRIVATE kernel
+        # entry point, deliberately: the public wrapper routes by the
+        # committed per-kernel winners, so going through it would time
+        # XLA against itself once evidence says XLA wins.
         from pilosa_tpu.ops import pallas_kernels as pk
 
+        pallas_count = pk._count_and_pallas
+
         try:
-            got = int(np.asarray(pk.count_and(a, b)))
+            got = int(np.asarray(pallas_count(a, b)))
         except Exception as e:  # noqa: BLE001 — a Mosaic lowering bug
             # must not kill the bench; the xla number stands, and the
             # artifact records WHY the pallas leg is absent
@@ -229,7 +234,7 @@ def bench_device(a_np: np.ndarray, b_np: np.ndarray):
                 # skip — it must be loud in the artifact
                 qps_by_engine["pallas"] = f"WRONG COUNT {got} != {expect}"
             else:
-                qps_by_engine["pallas"] = timed_qps(pk.count_and)
+                qps_by_engine["pallas"] = timed_qps(pallas_count)
 
     extras: dict = {}
     if platform in _CHIP_PLATFORMS:

@@ -84,7 +84,18 @@ def main() -> int:
         depth = 21
         planes, filt = words(2 + depth, 8192), words(8192)
         upred = int(rng.integers(0, 1 << depth))
-        lt, gt = pk.bsi_compare_unsigned(planes, filt, upred, depth)
+        # private Pallas entry, NOT the public wrapper: the wrapper
+        # routes by committed winners, so after a winner='xla' capture
+        # it would compare the jnp fallback against itself and record
+        # a vacuous ok while a Mosaic regression hides
+        import jax.numpy as jnp
+
+        pred_masks = np.array(
+            [[0xFFFFFFFF if (upred >> i) & 1 else 0]
+             for i in range(depth)], dtype=np.uint32)
+        lt, gt = pk._bsi_compare_pallas(
+            jnp.asarray(planes), jnp.asarray(filt),
+            jnp.asarray(pred_masks), depth)
         wlt, wgt = pk._bsi_compare_jnp(planes, filt, upred, depth)
         np.testing.assert_array_equal(np.asarray(lt), np.asarray(wlt))
         np.testing.assert_array_equal(np.asarray(gt), np.asarray(wgt))

@@ -60,17 +60,57 @@ def pallas_enabled() -> bool:
     return v not in ("0", "off", "false", "no")
 
 
-def _use_pallas(interpret: bool, elems: int, floor: int = 1 << 16) -> bool:
+@functools.cache
+def _kernel_winners() -> dict:
+    """Per-kernel chip A/B winners ('pallas' | 'xla') from the
+    committed validation artifact (PALLAS_TPU_VALIDATION.json, written
+    by benchmarks/validate_tpu.py with per-kernel timings during a
+    relay window).  Empty when the artifact is absent, untimed, or was
+    not captured on a real chip — routing then defaults to Pallas on
+    TPU as before."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "PALLAS_TPU_VALIDATION.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("platform") not in ("tpu", "axon"):
+            return {}
+        return {name: k["perf"]["winner"]
+                for name, k in doc.get("kernels", {}).items()
+                if isinstance(k, dict) and k.get("ok")
+                and isinstance(k.get("perf"), dict)
+                and k["perf"].get("winner") in ("pallas", "xla")
+                # timings the validator itself flagged as beating the
+                # HBM roof (memoized dispatches) must not decide
+                # routing — treat them as no evidence
+                and not k["perf"].get("suspect_memoized_dispatch")}
+    except Exception:  # noqa: BLE001 — unreadable evidence = no evidence
+        return {}
+
+
+def _use_pallas(interpret: bool, elems: int, floor: int = 1 << 16,
+                kernel: str | None = None) -> bool:
     """The single routing gate every dispatcher shares: interpret mode
     always exercises the kernel (how CPU tests reach it); below
     ``floor`` elements launch overhead dominates so XLA always runs;
-    otherwise Pallas runs exactly when on a TPU with the operator knob
-    enabled."""
+    otherwise Pallas runs on a TPU with the operator knob enabled —
+    UNLESS the committed chip validation timed this kernel slower than
+    XLA's fusion (per-kernel evidence beats the blanket default;
+    PILOSA_TPU_PALLAS=force overrides the evidence for A/B work)."""
     if interpret:
         return True
     if elems < floor:
         return False
-    return on_tpu() and pallas_enabled()
+    if not (on_tpu() and pallas_enabled()):
+        return False
+    import os
+
+    if os.environ.get("PILOSA_TPU_PALLAS", "").lower() == "force":
+        return True
+    return _kernel_winners().get(kernel) != "xla"
 
 
 def _pad_to(x: jnp.ndarray, axis: int, multiple: int):
@@ -128,7 +168,7 @@ def row_counts_masked(mat, filt, interpret: bool = False):
     from pilosa_tpu.ops import bitmap as bm
 
     R, W = mat.shape
-    if _use_pallas(interpret, R * W):
+    if _use_pallas(interpret, R * W, kernel="row_counts_masked"):
         return _row_counts_masked_pallas(mat, jnp.asarray(filt),
                                          interpret=interpret)
     return bm.row_counts_masked(mat, filt)
@@ -175,7 +215,7 @@ def count_and(a, b, interpret: bool = False):
     fusion elsewhere (roaring.IntersectionCount, roaring/roaring.go:570)."""
     from pilosa_tpu.ops import bitmap as bm
 
-    if _use_pallas(interpret, a.size):
+    if _use_pallas(interpret, a.size, kernel="count_and"):
         return _count_and_pallas(jnp.asarray(a), jnp.asarray(b),
                                  interpret=interpret)
     return bm.popcount_and(a, b)
@@ -241,7 +281,8 @@ def masked_matrix_counts(mat, masks, interpret: bool = False):
 
     R, W = mat.shape
     G = masks.shape[0]
-    if (_use_pallas(interpret, G * R * W, floor=1 << 18)
+    if (_use_pallas(interpret, G * R * W, floor=1 << 18,
+                    kernel="masked_matrix_counts")
             and not isinstance(mat, np.ndarray)):
         return _mmc_pallas(jnp.asarray(mat), jnp.asarray(masks),
                            interpret=interpret)
@@ -327,7 +368,8 @@ def bsi_compare_unsigned(planes, filt, upred: int, depth: int,
         consider = jnp.asarray(planes[0]) & ~jnp.asarray(planes[1]) \
             & jnp.asarray(filt)
         return consider, jnp.zeros_like(consider)
-    if _use_pallas(interpret, planes.shape[1], floor=1 << 12):
+    if _use_pallas(interpret, planes.shape[1], floor=1 << 12,
+                   kernel="bsi_compare_unsigned"):
         pred_masks = np.array(
             [[0xFFFFFFFF if (upred >> i) & 1 else 0]
              for i in range(depth)],

@@ -196,3 +196,34 @@ class TestRoutingGate:
         assert not pk._use_pallas(False, 1 << 30)
         monkeypatch.setenv("PILOSA_TPU_PALLAS", "auto")
         assert pk._use_pallas(False, 1 << 30)
+
+
+def test_pallas_routing_honors_chip_winners(monkeypatch):
+    """The dispatch gate routes per-kernel by the committed chip A/B
+    (PALLAS_TPU_VALIDATION.json winners): a kernel the chip timed
+    slower than XLA's fusion routes to XLA, winners and unmeasured
+    kernels route to Pallas, PILOSA_TPU_PALLAS=force/0 override both
+    ways (round-5: evidence-driven routing instead of blanket
+    on-TPU default)."""
+    import pytest
+
+    from pilosa_tpu.ops import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "on_tpu", lambda: True)
+    monkeypatch.delenv("PILOSA_TPU_PALLAS", raising=False)
+    winners = pk._kernel_winners()
+    if not winners:
+        pytest.skip("no timed chip validation artifact committed")
+    assert set(winners.values()) <= {"pallas", "xla"}
+    for name, w in winners.items():
+        assert pk._use_pallas(False, 1 << 30, kernel=name) \
+            == (w != "xla"), (name, w)
+    # evidence-free kernels keep the on-TPU default
+    assert pk._use_pallas(False, 1 << 30, kernel="not-a-kernel")
+    # force re-enables losers (the A/B escape hatch)...
+    monkeypatch.setenv("PILOSA_TPU_PALLAS", "force")
+    assert all(pk._use_pallas(False, 1 << 30, kernel=n) for n in winners)
+    # ...and off disables winners
+    monkeypatch.setenv("PILOSA_TPU_PALLAS", "0")
+    assert not any(pk._use_pallas(False, 1 << 30, kernel=n)
+                   for n in winners)
