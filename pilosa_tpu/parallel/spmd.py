@@ -51,6 +51,7 @@ translate before entering (the test covers raw ids)."""
 from __future__ import annotations
 
 import functools
+import os
 import threading
 from dataclasses import dataclass
 
@@ -88,8 +89,11 @@ BITMAP_ROOTS = ("Row", "Range", "Union", "Intersect", "Difference",
 #: windows (each a bounded collective) instead of one all-gather, so
 #: ANY index width stays on the collective plane with per-process
 #: transient memory capped at one window (round 5; previously a hard
-#: ceiling that pushed wide indexes to the scatter plane).
-MAX_ROW_GATHER_BYTES = 1 << 28
+#: ceiling that pushed wide indexes to the scatter plane).  Env knob
+#: exists for memory-constrained deployments and for the
+#: multi-process test tier to force the windowed path on small data.
+MAX_ROW_GATHER_BYTES = int(os.environ.get(
+    "PILOSA_TPU_MAX_ROW_GATHER_BYTES", 1 << 28))
 
 
 @dataclass(frozen=True)

@@ -1141,6 +1141,21 @@ assert sorted(int(x) for x in br.columns()) == \
 br = ce.execute("Difference(Row(f=0), Row(f=1), Row(f=2))")
 assert sorted(int(x) for x in br.columns()) == \
     sorted(bits[0] - bits[1] - bits[2]), "bareDiff"
+# windowed gather (round 5): shrink the per-window bound so the
+# 6-shard result replicates in 2-shard sub-plan windows — the window
+# sequence must stay in LOCKSTEP across processes (divergence here
+# deadlocks the fleet rather than just mismatching)
+_saved_gather_bytes = spmd.MAX_ROW_GATHER_BYTES
+spmd.MAX_ROW_GATHER_BYTES = 2 * spmd.bm.n_words(SHARD_WIDTH) * 4
+try:
+    br = ce.execute("Union(Row(f=0), Row(f=1))")
+    assert sorted(int(x) for x in br.columns()) == \
+        sorted(bits[0] | bits[1]), "windowedUnion"
+    br = ce.execute("Row(f=2)")
+    assert sorted(int(x) for x in br.columns()) == \
+        sorted(bits[2]), "windowedRow"
+finally:
+    spmd.MAX_ROW_GATHER_BYTES = _saved_gather_bytes
 # 4-child GroupBy: outer cartesian lockstep loop across processes
 import itertools as _it
 gb4 = ce.execute("GroupBy(Rows(f), Rows(f), Rows(f), Rows(f))")

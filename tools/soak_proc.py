@@ -18,6 +18,12 @@ own 2 s anti-entropy cadence:
   - KILL cycle: SIGKILL the victim, require DEGRADED detection and
     exact reads from survivors, restart from the same data dir, and
     require NORMAL + exact reads everywhere (WAL/snapshot recovery).
+  - RESIZE cycle: a REAL 4th server process joins (coordinator-led
+    re-homing over live sockets) — half the time with a replica
+    FROZEN mid-join, the zombie-rejoin-versus-resize race the
+    in-process soak cannot produce — then leaves via
+    /cluster/resize/remove-node; reads must be exact at every stage
+    whether the contested join completed or aborted cleanly.
   - QUIET cycle: import + exact reads on every node (steady-state
     oracle pressure between faults).
 
@@ -70,8 +76,9 @@ def main() -> int:
                           seeds=[ports[0]] if i else None,
                           paranoia=True)
 
-    stats = {"cycles": 0, "freezes": 0, "kills": 0, "checks": 0,
-             "imports": 0}
+    stats = {"cycles": 0, "freezes": 0, "kills": 0, "resizes": 0,
+             "frozen_joins": 0, "checks": 0, "imports": 0}
+    epoch = 0
     oracle: dict[int, set] = {r: set() for r in range(4)}
 
     def batch(n=250):
@@ -120,6 +127,7 @@ def main() -> int:
         spawn(2)
         for p in ports:
             _wait_status(p, "NORMAL", 3)
+        base_ids = {_get(p, "/status")["localID"] for p in ports}
         _post(ports[0], "/index/i", {})
         _post(ports[0], "/index/i/field/f", {})
         _post(ports[0], "/index/i/field/f/import", batch())
@@ -128,7 +136,20 @@ def main() -> int:
             check_exact(p)
 
         t_end = time.monotonic() + args.seconds
+        capturing_flag = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "relay_watcher.capturing")
         while time.monotonic() < t_end:
+            # yield the single core while a relay capture is timing
+            # QPS on the chip (same hygiene and staleness bound as
+            # tools/soak.py — an orphaned flag must not pause forever)
+            while os.path.exists(capturing_flag):
+                try:
+                    if time.time() - os.path.getmtime(
+                            capturing_flag) > 7200:
+                        break
+                except OSError:
+                    break
+                time.sleep(5)
             stats["cycles"] += 1
             roll = rng.random()
             victim = rng.choice([1, 2])
@@ -170,7 +191,80 @@ def main() -> int:
                     _wait_status(p, "NORMAL", 3, deadline=120.0)
                 converge()
 
-            elif roll < 0.65:  # ---- KILL + restart cycle
+            elif roll < 0.60:  # ---- RESIZE cycle: real 4th process
+                # joins (sometimes against a frozen replica) and leaves
+                stats["resizes"] += 1
+                epoch += 1
+                p3 = _free_port()
+                # fresh dir per epoch: a re-joining node must never
+                # resurrect a removed epoch's detached fragments
+                pr3 = _spawn(str(tmp / f"n3-e{epoch}"), p3,
+                             seeds=[ports[0]], paranoia=True)
+                frozen = rng.random() < 0.5
+                if frozen:
+                    stats["frozen_joins"] += 1
+                    time.sleep(rng.uniform(0.0, 1.0))
+                    procs[victim].send_signal(signal.SIGSTOP)
+                    time.sleep(rng.uniform(2.0, 5.0))
+                    procs[victim].send_signal(signal.SIGCONT)
+                try:
+                    # the join either completes (4 nodes) or aborts
+                    # cleanly (3) — both legal under a frozen owner;
+                    # reads must be exact either way once NORMAL
+                    deadline = time.time() + 120.0
+                    settled = False
+                    while time.time() < deadline:
+                        try:
+                            st = _get(ports[0], "/status", timeout=5)
+                            if st["state"] == "NORMAL" and (
+                                    len(st["nodes"]) == 4
+                                    or pr3.poll() is not None):
+                                settled = True
+                                break
+                        except OSError:
+                            pass
+                        time.sleep(1.0)
+                    if settled:
+                        for p in ports:
+                            check_exact(p)
+                    # deadline expiry = the contested join neither
+                    # completed nor aborted in time; exactness is
+                    # enforced by the post-cleanup NORMAL wait +
+                    # converge() below, after strays are removed
+                finally:
+                    # graceful leave for whatever actually joined
+                    # (judged from the coordinator's member list, not
+                    # our racy local view), then stop the process
+                    end = time.time() + 120.0
+                    while time.time() < end:
+                        try:
+                            st = _get(ports[0], "/status", timeout=10)
+                            stray = [n["id"] for n in st["nodes"]
+                                     if n["id"] not in base_ids]
+                            if not stray and st["state"] == "NORMAL":
+                                break
+                            for nid in stray:
+                                _post(ports[0],
+                                      "/cluster/resize/remove-node",
+                                      {"id": nid}, timeout=120.0)
+                        except OSError:
+                            pass  # coordinator mid-resize; retry
+                        time.sleep(1.0)
+                    if pr3.poll() is None:
+                        pr3.terminate()
+                        try:
+                            pr3.wait(timeout=15)
+                        except Exception:  # noqa: BLE001
+                            pr3.kill()
+                    import shutil
+
+                    shutil.rmtree(tmp / f"n3-e{epoch}",
+                                  ignore_errors=True)
+                for p in ports:
+                    _wait_status(p, "NORMAL", 3, deadline=120.0)
+                converge()
+
+            elif roll < 0.80:  # ---- KILL + restart cycle
                 stats["kills"] += 1
                 procs[victim].send_signal(signal.SIGKILL)
                 procs[victim].wait(timeout=30)
