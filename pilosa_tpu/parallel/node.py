@@ -10,6 +10,8 @@ the same surface over the wire.
 
 from __future__ import annotations
 
+import threading
+
 from pilosa_tpu.models.field import FieldOptions
 from pilosa_tpu.models.index import IndexOptions
 from pilosa_tpu.parallel.cluster import Cluster, Transport, TransportError
@@ -26,6 +28,9 @@ class ClusterNode:
         self.executor = Executor(holder, worker_pool_size, cluster=cluster)
         self.executor.node = self
         self._tail_last: dict = {}  # (index, field) -> last tail time
+        self._cleanup_lock = threading.Lock()
+        self._cleanup_timer: threading.Timer | None = None
+        self._cleanup_deadline = 0.0
         if cluster.transport is not None and hasattr(cluster.transport, "register"):
             cluster.transport.register(cluster.local_id, self)
 
@@ -260,7 +265,7 @@ class ClusterNode:
             return {"ok": True,
                     "data": _b64.b64encode(frag.to_roaring()).decode()}
         elif t == "holder-cleanup":
-            self.cleanup_unowned()
+            self.request_cleanup()
         elif t == "ping":
             # piggybacked dissemination (SWIM, membership.py): the
             # prober's state view rides the ping; disagreements queue
@@ -398,6 +403,76 @@ class ClusterNode:
                         if not self.cluster.owns_shard(
                                 self.cluster.local_id, iname, shard):
                             view.delete_fragment(shard)
+
+    def request_cleanup(self) -> None:
+        """Schedule cleanup_unowned at least one grace period after
+        the LATEST request, coalescing into one pending timer.
+
+        Deleting re-homed fragments IMMEDIATELY at resize commit loses
+        reads (found by the round-5 process soak, data bit-exact on
+        disk): a query planned under the pre-commit topology can
+        execute its remote sub-queries AFTER the old owner's cleanup,
+        and an absent fragment legitimately reads as zero bits — a
+        silent undercount, not an error.  The reference never has this
+        race window small: its holderCleaner runs on a slow periodic
+        cadence (holder.go:1103), so old owners keep their fragments
+        long past any in-flight query.  The grace period restores that
+        property while keeping disk bounded.
+
+        Every request EXTENDS the pending sweep's deadline (a fixed
+        timer would give a resize that commits just before an earlier
+        sweep fires near-zero effective grace — the same race back),
+        and the timer slot is cleared BEFORE the sweep runs, so a
+        request arriving mid-sweep schedules a fresh timer instead of
+        being lost.  PILOSA_TPU_CLEANUP_GRACE_S=0 restores immediate
+        cleanup."""
+        import os
+
+        grace = float(os.environ.get("PILOSA_TPU_CLEANUP_GRACE_S",
+                                     "30.0"))
+        if grace <= 0:
+            self.cleanup_unowned()
+            return
+        import time as _time
+
+        with self._cleanup_lock:
+            self._cleanup_deadline = _time.monotonic() + grace
+            if self._cleanup_timer is None:
+                self._schedule_cleanup_locked(grace)
+
+    def _schedule_cleanup_locked(self, delay: float) -> None:
+        t = threading.Timer(delay, self._cleanup_fire)
+        t.daemon = True
+        self._cleanup_timer = t
+        t.start()
+
+    def _cleanup_fire(self) -> None:
+        import time as _time
+
+        with self._cleanup_lock:
+            remaining = self._cleanup_deadline - _time.monotonic()
+            if remaining > 0.05:
+                # deadline was extended by a later request — honor it
+                self._schedule_cleanup_locked(remaining)
+                return
+            self._cleanup_timer = None
+        try:
+            self.cleanup_unowned()
+        except Exception as e:  # noqa: BLE001 — a timer thread must
+            # not die silently NOR crash the process; shutdown races
+            # land here too, but persistent failures stay visible
+            msg = (f"deferred holder-cleanup failed: "
+                   f"{type(e).__name__}: {e}")
+            try:
+                log = getattr(self.executor, "logger", None)
+                if log is not None:
+                    log.printf("%s", msg)
+                else:
+                    import sys
+
+                    print(msg, file=sys.stderr)
+            except Exception:  # noqa: BLE001
+                pass
 
     def resize_abort(self) -> None:
         """Abort an in-flight resize job (api.go:1250 ResizeAbort);

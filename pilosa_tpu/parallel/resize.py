@@ -144,8 +144,11 @@ class Resizer:
         # joiner fans queries out over shards it doesn't hold locally
         self.node.broadcast_node_status()
         # post-resize cleanup everywhere (holder.go:1126 holderCleaner)
+        # — grace-deferred: an in-flight query planned under the OLD
+        # topology may still read the re-homed fragments (see
+        # ClusterNode.request_cleanup)
         self.node.broadcast({"type": "holder-cleanup"})
-        self.node.cleanup_unowned()
+        self.node.request_cleanup()
         return {"transfers": total, "nodes": new_ids}
 
     def _execute(self, plan: dict[str, list[dict]], add: Node | None,

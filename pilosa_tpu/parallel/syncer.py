@@ -162,8 +162,10 @@ class HolderSyncer:
         # periodic unowned-fragment cleanup rides the AE cadence, so a
         # node that missed the one-shot post-resize holder-cleanup
         # broadcast still converges (reference holderCleaner loop,
-        # holder.go:1103)
-        self.node.cleanup_unowned()
+        # holder.go:1103) — grace-deferred like every cleanup path,
+        # or a short AE interval re-opens the read-vs-cleanup race
+        # the grace exists to close
+        self.node.request_cleanup()
         # replicas tail the primary's key-translation entry stream
         # (reference holder.go:690-878)
         self.node.tail_translate_entries()

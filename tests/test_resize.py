@@ -189,7 +189,11 @@ class TestRemove:
         assert len(nodes[0].cluster.sorted_nodes()) == 2
         assert _query(nodes[0], "i", "Count(Row(f=1))") == len(cols)
 
-    def test_cleanup_deletes_unowned_fragments(self, tmp_path):
+    def test_cleanup_deletes_unowned_fragments(self, tmp_path,
+                                               monkeypatch):
+        # grace 0 = immediate cleanup (the pre-round-5 behavior this
+        # test pins); the grace path is covered by the test below
+        monkeypatch.setenv("PILOSA_TPU_CLEANUP_GRACE_S", "0")
         transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
         cols = _seed_data(nodes[0], n_shards=6)
         # join node2: shards re-homed to it must eventually disappear
@@ -210,6 +214,102 @@ class TestRemove:
                 assert nd.cluster.local_id in owners, (
                     f"unowned fragment {shard} survived cleanup on "
                     f"{nd.cluster.local_id}")
+
+    def test_cleanup_grace_keeps_rehomed_fragments_readable(
+            self, tmp_path, monkeypatch):
+        """Regression for the round-5 process-soak divergence: deleting
+        re-homed fragments AT resize commit silently zeroed reads whose
+        scatter was planned under the pre-commit topology (an absent
+        fragment legitimately reads as zero bits, so there is no error
+        to fail over on).  With the grace period, old owners keep
+        their fragments past any in-flight query; the deferred sweep
+        re-checks ownership when it fires."""
+        monkeypatch.setenv("PILOSA_TPU_CLEANUP_GRACE_S", "300")
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        cols = _seed_data(nodes[0], n_shards=6)
+        holder2 = Holder(str(tmp_path / "node2"))
+        cluster2 = Cluster("node2", nodes=[Node(id="node2")],
+                           replica_n=1, transport=transport)
+        joiner = ClusterNode(holder2, cluster2)
+        transport.send_message(
+            nodes[0].cluster.local_node,
+            {"type": "node-join", "node": {"id": "node2", "uri": ""}})
+        # the joiner owns shards now, so some base-node fragment is
+        # unowned — and must STILL be present (grace pending)
+        lingering = 0
+        for nd in nodes:
+            view = nd.holder.index("i").field("f").view("standard")
+            if view is None:
+                continue
+            for shard in list(view.fragments):
+                owners = [n.id
+                          for n in nd.cluster.shard_nodes("i", shard)]
+                if nd.cluster.local_id not in owners:
+                    lingering += 1
+        assert lingering > 0, \
+            "expected re-homed fragments to linger through the grace"
+        # reads are exact everywhere while they linger
+        for nd in (*nodes, joiner):
+            assert _query(nd, "i", "Count(Row(f=1))") == len(cols)
+        # the sweep itself still removes them when it fires
+        for nd in (*nodes, joiner):
+            nd.cleanup_unowned()
+        for nd in nodes:
+            view = nd.holder.index("i").field("f").view("standard")
+            if view is None:
+                continue
+            for shard in list(view.fragments):
+                owners = [n.id
+                          for n in nd.cluster.shard_nodes("i", shard)]
+                assert nd.cluster.local_id in owners
+        # and reads stay exact after the sweep
+        for nd in (*nodes, joiner):
+            assert _query(nd, "i", "Count(Row(f=1))") == len(cols)
+
+    def test_cleanup_timer_fires_and_extends(self, tmp_path,
+                                             monkeypatch):
+        """The ACTUAL deferred machinery: a request schedules the
+        sweep, a second request while one is pending EXTENDS the
+        deadline (a fixed timer would hand a just-committed resize
+        near-zero grace — the race back), and the sweep eventually
+        fires on its own."""
+        import time
+
+        monkeypatch.setenv("PILOSA_TPU_CLEANUP_GRACE_S", "0.4")
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        _seed_data(nodes[0], n_shards=6)
+        holder2 = Holder(str(tmp_path / "node2"))
+        cluster2 = Cluster("node2", nodes=[Node(id="node2")],
+                           replica_n=1, transport=transport)
+        ClusterNode(holder2, cluster2)
+        transport.send_message(
+            nodes[0].cluster.local_node,
+            {"type": "node-join", "node": {"id": "node2", "uri": ""}})
+
+        def unowned(nd):
+            view = nd.holder.index("i").field("f").view("standard")
+            if view is None:
+                return 0
+            return sum(
+                1 for shard in list(view.fragments)
+                if nd.cluster.local_id not in
+                [n.id for n in nd.cluster.shard_nodes("i", shard)])
+
+        nd = max(nodes, key=unowned)
+        assert unowned(nd) > 0, "join re-homed nothing to clean"
+        # extend while pending: the sweep must not fire before the
+        # extension's deadline
+        nd.request_cleanup()
+        t_extend = time.monotonic()
+        assert unowned(nd) > 0  # still lingering (grace pending)
+        # poll until the timer fires on its own
+        deadline = time.monotonic() + 10.0
+        while unowned(nd) > 0:
+            assert time.monotonic() < deadline, \
+                "deferred sweep never fired"
+            time.sleep(0.05)
+        assert time.monotonic() - t_extend >= 0.35, \
+            "sweep fired before the extended grace elapsed"
 
     def test_removed_node_detaches_into_standalone(self, tmp_path):
         transport, nodes = make_cluster(tmp_path, n=3, replica_n=1)
