@@ -320,19 +320,58 @@ class API:
 
     def _send_to_owners(self, index: str, shard: int, payload: dict,
                         local_fn) -> None:
-        """Deliver one shard's import to all owner replicas; unreachable
-        peers are skipped (anti-entropy reconciles, like the reference's
-        best-effort replication)."""
+        """Deliver one shard's import to all owner replicas;
+        unreachable peers are skipped (anti-entropy reconciles, like
+        the reference's best-effort replication).
+
+        A peer REFUSING as non-owner (reference api.go
+        ErrClusterDoesNotOwnShard) means its membership view is
+        fresher than ours — a resize just re-homed the shard.  The
+        fan-out then waits for the status broadcast to land,
+        re-resolves the owner set, and retries the refused deliveries;
+        if the views never converge it raises instead of silently
+        dropping a write on an ex-owner (whose fragments the
+        post-resize sweep deletes)."""
+        from pilosa_tpu.parallel.cluster import converge_owner_deliveries
+
+        applied: set[str] = set()
+
+        def on_timeout() -> None:
+            raise ApiError(
+                f"shard {shard} owners refused the import as "
+                "non-owners and the membership view did not "
+                "converge; retry")
+
+        converge_owner_deliveries(
+            lambda: self._owner_pass(index, shard, payload, local_fn,
+                                     applied),
+            on_timeout)
+
+    def _owner_pass(self, index: str, shard: int, payload: dict,
+                    local_fn, applied: set) -> bool:
+        """One delivery sweep over the CURRENT owner set, skipping
+        nodes already applied.  Returns True if any owner refused as
+        non-owner (caller retries after the view converges)."""
         from pilosa_tpu.parallel.cluster import TransportError
 
+        refused = False
         for n in self.cluster.shard_nodes(index, shard):
+            if n.id in applied:
+                continue
             if n.id == self.cluster.local_id:
                 local_fn()
+                applied.add(n.id)
                 continue
             try:
-                self.cluster.transport.send_message(n, payload)
+                resp = self.cluster.transport.send_message(n, payload)
             except TransportError:
-                pass
+                applied.add(n.id)  # unreachable: AE reconciles later
+                continue
+            if isinstance(resp, dict) and resp.get("unowned"):
+                refused = True
+                continue
+            applied.add(n.id)
+        return refused
 
     def import_roaring(self, index: str, field: str, shard: int,
                        views: dict[str, bytes], clear: bool = False,

@@ -128,6 +128,41 @@ class TransportError(RuntimeError):
     replica failover in the executor (executor.go:2492)."""
 
 
+#: cross-transport marker for a replica write delivery refused by a
+#: non-owner (reference api.go ErrClusterDoesNotOwnShard).  Typed
+#: exceptions survive LocalTransport; over HTTP the refusal travels as
+#: an error STRING, so both write origins match on this substring.
+UNOWNED_MARKER = "does not own shard"
+
+
+def refusal_is_unowned(exc: BaseException) -> bool:
+    return UNOWNED_MARKER in str(exc)
+
+
+def converge_owner_deliveries(delivery_pass, on_timeout) -> None:
+    """Drive ``delivery_pass()`` (one sweep over the CURRENT owner
+    set; returns True when some owner refused as non-owner) until no
+    refusals remain — an owner refusing means its membership view is
+    fresher than ours, so wait for the status broadcast and
+    re-resolve.  Shared by the import fan-out (api._send_to_owners)
+    and the PQL write replication (executor._replicate_to_shard_owners)
+    so the budget/backoff semantics cannot drift between them.  On
+    budget exhaustion calls ``on_timeout()`` (which raises the
+    caller's error type)."""
+    import os
+    import time
+
+    budget = float(os.environ.get("PILOSA_TPU_WRITE_RETRY_S", "10.0"))
+    deadline = time.monotonic() + budget
+    while True:
+        if not delivery_pass():
+            return
+        if time.monotonic() >= deadline:
+            on_timeout()
+            return
+        time.sleep(0.2)
+
+
 class Transport:
     """Node-to-node fabric (the reference's InternalClient role,
     http/client.go:37)."""

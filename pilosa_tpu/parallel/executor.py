@@ -33,7 +33,7 @@ import numpy as np
 
 from pilosa_tpu.models.field import FieldType
 from pilosa_tpu.models.row import Row
-from pilosa_tpu.parallel.cluster import TransportError
+from pilosa_tpu.parallel.cluster import UNOWNED_MARKER, TransportError
 from pilosa_tpu.models.timequantum import parse_time
 from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.ops import bitmap as bm
@@ -70,6 +70,18 @@ class ExecOptions:
 
 class ExecutionError(ValueError):
     pass
+
+
+class UnownedShardError(ExecutionError):
+    """A replica write delivery targeted a shard this node does not
+    own per its CURRENT membership view (reference api.go
+    ErrClusterDoesNotOwnShard) — the origin's view is stale; it must
+    re-resolve the owner set and retry.  The message text is the
+    cross-transport contract: HTTP surfaces it as an error string the
+    origin matches on."""
+
+    def __init__(self, shard: int):
+        super().__init__(f"{UNOWNED_MARKER} {shard}")
 
 
 # Sentinel call names substituted during key translation when a read-path
@@ -1418,22 +1430,71 @@ class Executor:
         """Run a single-shard write on every owner replica synchronously
         (reference executeSetBitField, executor.go:2137-2168).  A replica
         that cannot be reached fails the write — the reference offers the
-        same all-owners guarantee, with anti-entropy as the backstop."""
-        changed = False
-        for n in self.cluster.shard_nodes(idx.name, shard):
-            if n.id == self.cluster.local_id:
-                changed |= local_fn()
-            else:
+        same all-owners guarantee, with anti-entropy as the backstop.
+
+        An owner REFUSING as non-owner means a resize just re-homed the
+        shard and its view is fresher than ours: wait for the status
+        broadcast, re-resolve the owner set, and retry the refused
+        deliveries within the PILOSA_TPU_WRITE_RETRY_S budget."""
+        from pilosa_tpu.parallel.cluster import (
+            converge_owner_deliveries, refusal_is_unowned)
+
+        applied: set[str] = set()
+        changed = [False]
+
+        def delivery_pass() -> bool:
+            refused = False
+            for n in self.cluster.shard_nodes(idx.name, shard):
+                if n.id in applied:
+                    continue
+                if n.id == self.cluster.local_id:
+                    changed[0] |= local_fn()
+                    applied.add(n.id)
+                    continue
                 try:
                     res = self.cluster.transport.query_node(
                         n, idx.name, str(call), [shard]
                     )
-                except TransportError as e:
-                    raise ExecutionError(
-                        f"write replication to node {n.id} failed: {e}"
-                    )
-                changed |= bool(res[0])
-        return changed
+                except Exception as e:  # noqa: BLE001 — the refusal
+                    # contract is a STRING over HTTP (ClientError, not
+                    # TransportError), a typed error in-process
+                    if refusal_is_unowned(e):
+                        refused = True
+                        continue
+                    if isinstance(e, TransportError):
+                        raise ExecutionError(
+                            f"write replication to node {n.id} "
+                            f"failed: {e}")
+                    raise
+                changed[0] |= bool(res[0])
+                applied.add(n.id)
+            return refused
+
+        def on_timeout() -> None:
+            raise ExecutionError(
+                f"shard {shard} owners refused the write as "
+                "non-owners and the membership view did not "
+                "converge; retry")
+
+        converge_owner_deliveries(delivery_pass, on_timeout)
+        return changed[0]
+
+    def _check_remote_write_owned(self, idx, shard: int,
+                                  opt: ExecOptions | None) -> None:
+        """Receiver-side ownership gate for replica write deliveries
+        (Set/Clear with remote semantics): refuse a shard this node
+        does not own per its CURRENT view instead of silently
+        absorbing a stale-view origin's write onto an ex-owner
+        (reference api.go ErrClusterDoesNotOwnShard; the import
+        message types carry the same gate in node.receive_message)."""
+        if opt is None or not opt.remote:
+            return
+        if (self.cluster is None or self.cluster.transport is None
+                or len(self.cluster.sorted_nodes()) < 2):
+            return
+        if not self.cluster.owns_shard(self.cluster.local_id,
+                                       idx.name, shard):
+            raise UnownedShardError(shard)
 
     def _note_new_shard(self, idx, f, shard: int) -> None:
         """Record shard existence locally and broadcast it (reference
@@ -1488,6 +1549,7 @@ class Executor:
                 idx, call, shard,
                 lambda: self._apply_set(idx, f, col, value, timestamp),
             )
+        self._check_remote_write_owned(idx, col // SHARD_WIDTH, opt)
         return self._apply_set(idx, f, col, value, timestamp)
 
     def _execute_set_local(self, idx, call: Call) -> bool:
@@ -1503,6 +1565,7 @@ class Executor:
                 idx, call, col // SHARD_WIDTH,
                 lambda: self._execute_clear_local(idx, call),
             )
+        self._check_remote_write_owned(idx, col // SHARD_WIDTH, opt)
         return self._execute_clear_local(idx, call)
 
     def _execute_clear_local(self, idx, call: Call) -> bool:

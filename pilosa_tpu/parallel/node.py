@@ -139,6 +139,9 @@ class ClusterNode:
             if f is None:
                 return {"ok": False, "error": "field not found"}
             shard = int(msg["shard"])
+            refuse = self._refuse_unowned_import(msg["index"], shard)
+            if refuse is not None:
+                return refuse
             for vname, b in (msg.get("views") or {}).items():
                 view = f.create_view_if_not_exists(vname or VIEW_STANDARD)
                 frag = view.create_fragment_if_not_exists(shard)
@@ -150,6 +153,13 @@ class ClusterNode:
             f = None if idx is None else idx.field(msg["field"])
             if f is None:
                 return {"ok": False, "error": "field not found"}
+            if msg["cols"]:
+                from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+                refuse = self._refuse_unowned_import(
+                    msg["index"], int(msg["cols"][0]) // SHARD_WIDTH)
+                if refuse is not None:
+                    return refuse
             ts = msg.get("timestamps")
             if ts is not None:
                 import datetime as _dt
@@ -165,6 +175,13 @@ class ClusterNode:
             f = None if idx is None else idx.field(msg["field"])
             if f is None:
                 return {"ok": False, "error": "field not found"}
+            if msg["cols"]:
+                from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+                refuse = self._refuse_unowned_import(
+                    msg["index"], int(msg["cols"][0]) // SHARD_WIDTH)
+                if refuse is not None:
+                    return refuse
             f.import_values(msg["cols"], msg["values"])
             idx.import_existence(msg["cols"])
         elif t == "fragment-blocks":
@@ -385,11 +402,36 @@ class ClusterNode:
         if not resp.get("ok", True):
             raise RuntimeError(resp.get("error", "remove-node failed"))
 
+    def _refuse_unowned_import(self, index: str,
+                               shard: int) -> dict | None:
+        """Reference api.go ErrClusterDoesNotOwnShard: a replica
+        delivery for a shard this node does not own (per its CURRENT
+        view) is refused, not silently absorbed — a stale-view origin
+        would otherwise land bits on an ex-owner whose fragments the
+        post-resize sweep deletes, losing the write.  The origin
+        re-resolves owners and retries (api._send_to_owners)."""
+        if self.cluster.transport is None \
+                or len(self.cluster.sorted_nodes()) < 2:
+            return None
+        if self.cluster.owns_shard(self.cluster.local_id, index, shard):
+            return None
+        return {"ok": False, "unowned": True,
+                "error": f"does not own shard {shard}"}
+
     def cleanup_unowned(self) -> None:
         """Delete local fragments for shards this node no longer owns
         (reference holderCleaner, holder.go:1103-1154).  Shard
-        availability bookkeeping is left global — other nodes still hold
-        the shard."""
+        availability bookkeeping is left global — other nodes still
+        hold the shard.
+
+        RESCUE-BEFORE-DELETE (round 5): a fragment is deleted only
+        after a current owner PROVABLY holds a superset of its bits
+        (block-checksum verified, diffs pushed via the AE fragment
+        syncer first).  Bits can legitimately strand on an ex-owner —
+        a write whose origin's own stale view listed this node as
+        owner has no peer that could refuse it — and deleting such a
+        fragment would lose the only copy.  Unverifiable fragments
+        (owners unreachable) are kept for the next sweep."""
         if self.cluster.transport is None or len(self.cluster.sorted_nodes()) < 2:
             return
         for d in self.holder.schema():
@@ -398,11 +440,65 @@ class ClusterNode:
             if idx is None:
                 continue
             for f in idx.all_fields():
-                for view in list(f.views.values()):
+                for vname, view in list(f.views.items()):
                     for shard in list(view.fragments):
-                        if not self.cluster.owns_shard(
+                        if self.cluster.owns_shard(
                                 self.cluster.local_id, iname, shard):
+                            continue
+                        if self._owner_covers_fragment(
+                                iname, f.name, vname, shard):
                             view.delete_fragment(shard)
+
+    def _owner_covers_fragment(self, index: str, field: str,
+                               vname: str, shard: int) -> bool:
+        """True when some current owner verifiably holds every bit of
+        the local (unowned) fragment: run one AE reconcile pass (which
+        pushes any bits the owners are missing), then require a
+        block-checksum match from at least one owner.  AE replicates
+        among owners afterward, so one verified copy suffices."""
+        from pilosa_tpu.parallel.syncer import FragmentSyncer
+
+        frag = self.local_fragment(index, field, vname, shard,
+                                   create=False)
+        if frag is None:
+            return True
+        local = {b["id"]: b["checksum"] for b in frag.blocks()}
+        if not local:
+            return True  # empty fragment: nothing to lose
+        # verify-first: after a clean resize transfer the owners hold
+        # identical fragments, so the common case costs ONE checksum
+        # RPC per owner and no sync pass
+        if self._any_owner_matches(index, field, vname, shard, local):
+            return True
+        try:
+            FragmentSyncer(self, index, field, vname, shard).sync()
+        except Exception:  # noqa: BLE001 — keep the data on any doubt
+            return False
+        # sync may have pulled peer bits INTO this fragment too;
+        # re-read the local checksums before re-verifying
+        local = {b["id"]: b["checksum"] for b in frag.blocks()}
+        return self._any_owner_matches(index, field, vname, shard,
+                                       local)
+
+    def _any_owner_matches(self, index: str, field: str, vname: str,
+                           shard: int, local: dict) -> bool:
+        from pilosa_tpu.parallel.cluster import TransportError
+
+        for n in self.cluster.shard_nodes(index, shard):
+            if n.id == self.cluster.local_id:
+                continue
+            try:
+                resp = self.cluster.transport.send_message(n, {
+                    "type": "fragment-blocks", "index": index,
+                    "field": field, "view": vname, "shard": shard,
+                })
+            except TransportError:
+                continue
+            peer = {b["id"]: b["checksum"]
+                    for b in resp.get("blocks", [])}
+            if all(peer.get(bid) == cs for bid, cs in local.items()):
+                return True
+        return False
 
     def request_cleanup(self) -> None:
         """Schedule cleanup_unowned at least one grace period after

@@ -312,3 +312,135 @@ class TestPairPartition:
             HolderSyncer(nd).sync_holder()
         for nd in nodes:
             assert nd.executor.execute("i", "Count(Row(f=1))")[0] == want
+
+
+class TestStaleViewImport:
+    """Write-side counterpart of the round-5 read-vs-cleanup race: a
+    replica delivery for a shard the receiver does not own (per its
+    CURRENT view) is refused (reference api.go
+    ErrClusterDoesNotOwnShard), and the origin's fan-out re-resolves
+    the owner set and retries — a stale-view write must never land
+    its only copy on an ex-owner whose fragments the post-resize
+    sweep deletes."""
+
+    def test_non_owner_delivery_refused(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        shard = 0
+        owner = nodes[0].cluster.shard_nodes("i", shard)[0].id
+        non_owner = next(nd for nd in nodes
+                         if nd.cluster.local_id != owner)
+        col = shard * SHARD_WIDTH + 5
+        resp = non_owner.receive_message(
+            {"type": "import", "index": "i", "field": "f",
+             "rows": [1], "cols": [col], "timestamps": None,
+             "clear": False})
+        assert resp.get("unowned") and not resp.get("ok"), resp
+        # nothing was absorbed locally
+        view = non_owner.holder.index("i").field("f").view("standard")
+        assert view is None or view.fragment(shard) is None
+
+    def test_stale_origin_reroutes_after_refusal(self, tmp_path,
+                                                 monkeypatch):
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        shard = 0
+        owner = nodes[0].cluster.shard_nodes("i", shard)[0].id
+        wrong = next(n for n in nodes[0].cluster.sorted_nodes()
+                     if n.id != owner and n.id != "node0")
+        real = nodes[0].cluster.shard_nodes
+        calls = {"n": 0}
+
+        def stale(index, s):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return [wrong]  # stale view: delivers to an ex-owner
+            return real(index, s)
+
+        monkeypatch.setattr(nodes[0].cluster, "shard_nodes", stale)
+        col = shard * SHARD_WIDTH + 7
+        API(nodes[0]).import_bits("i", "f", [1], [col])
+        assert calls["n"] >= 2, "fan-out never re-resolved owners"
+        # the bit landed on the TRUE owner; exact from every node
+        for nd in nodes:
+            assert int(nd.executor.execute(
+                "i", "Count(Row(f=1))")[0]) == 1, nd.cluster.local_id
+
+    def test_stale_origin_set_reroutes_after_refusal(self, tmp_path,
+                                                     monkeypatch):
+        """Same contract on the PQL write path: a remote Set delivered
+        to a non-owner raises UnownedShardError; the origin's
+        replication loop re-resolves the owner set and retries."""
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        shard = 0
+        owner = nodes[0].cluster.shard_nodes("i", shard)[0].id
+        wrong = next(n for n in nodes[0].cluster.sorted_nodes()
+                     if n.id != owner and n.id != "node0")
+        real = nodes[0].cluster.shard_nodes
+        calls = {"n": 0}
+
+        def stale(index, s):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return [wrong]
+            return real(index, s)
+
+        monkeypatch.setattr(nodes[0].cluster, "shard_nodes", stale)
+        col = shard * SHARD_WIDTH + 9
+        assert nodes[0].executor.execute("i", f"Set({col}, f=2)") == [True]
+        assert calls["n"] >= 2, "replication never re-resolved owners"
+        for nd in nodes:
+            assert int(nd.executor.execute(
+                "i", "Count(Row(f=2))")[0]) == 1, nd.cluster.local_id
+
+    def test_cleanup_rescues_stranded_bits_before_delete(self, tmp_path):
+        """A write whose origin's OWN stale view listed an ex-owner as
+        owner has no peer that can refuse it — the bits strand there.
+        The unowned sweep must push them to the current owners (AE
+        diff) and verify coverage by block checksum BEFORE deleting,
+        never discarding the only copy."""
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        nodes[0].create_index("i")
+        nodes[0].create_field("i", "f")
+        shard = 0
+        owner_id = nodes[0].cluster.shard_nodes("i", shard)[0].id
+        owner = next(nd for nd in nodes
+                     if nd.cluster.local_id == owner_id)
+        stray = next(nd for nd in nodes
+                     if nd.cluster.local_id != owner_id)
+        col = shard * SHARD_WIDTH + 11
+        # strand the only copy on the non-owner
+        stray.holder.index("i").field("f").import_bits([3], [col])
+        stray.cleanup_unowned()
+        # fragment removed locally...
+        view = stray.holder.index("i").field("f").view("standard")
+        assert view is None or view.fragment(shard) is None
+        # ...and the bits now live on the true owner
+        ofrag = owner.holder.index("i").field("f") \
+            .view("standard").fragment(shard)
+        assert ofrag is not None
+        import numpy as np
+
+        arr = ofrag._rows.get(3)
+        off = col - shard * SHARD_WIDTH
+        assert arr is not None and (arr[off // 32] >> (off % 32)) & 1, \
+            "stranded bit was not rescued to the owner"
+
+    def test_refusal_contract_matches_http_client_error(self):
+        """Over the production HTTP fabric a refusal arrives as
+        ClientError (handler maps ExecutionError to 400), NOT
+        TransportError — the origin's retry matcher must recognize the
+        string contract on ANY exception type."""
+        from pilosa_tpu.parallel.cluster import refusal_is_unowned
+        from pilosa_tpu.parallel.executor import UnownedShardError
+        from pilosa_tpu.server.client import ClientError
+
+        assert refusal_is_unowned(UnownedShardError(7))
+        assert refusal_is_unowned(
+            ClientError(400, "does not own shard 7"))
+        assert not refusal_is_unowned(ClientError(400, "bad query"))
+        assert not refusal_is_unowned(TransportError("connection refused"))
