@@ -197,12 +197,31 @@ class LocalTransport(Transport):
         self.handles: dict[str, object] = {}
         self.down: set[str] = set()
         self.partitions: set[frozenset] = set()
+        self.slow: dict[str, float] = {}
 
     def register(self, node_id: str, handle) -> None:
         self.handles[node_id] = handle
 
     def set_down(self, node_id: str, down: bool = True) -> None:
         (self.down.add if down else self.down.discard)(node_id)
+
+    def set_slow(self, node_id: str, delay_s: float = 0.0) -> None:
+        """Gray failure: the node stays alive and correct but every
+        message to it is delayed — distinct from death (no
+        TransportError, so no failover) and from partition (everyone
+        is affected equally).  SWIM must keep it a member; reads and
+        writes must stay exact, just slower."""
+        if delay_s > 0:
+            self.slow[node_id] = delay_s
+        else:
+            self.slow.pop(node_id, None)
+
+    def _maybe_delay(self, node_id: str) -> None:
+        d = self.slow.get(node_id)
+        if d:
+            import time
+
+            time.sleep(d)
 
     def set_partition(self, a: str, b: str, on: bool = True) -> None:
         key = frozenset((a, b))
@@ -220,6 +239,7 @@ class LocalTransport(Transport):
 
         if node.id in self.down or node.id not in self.handles:
             raise TransportError(f"node unreachable: {node.id}")
+        self._maybe_delay(node.id)
         h = self.handles[node.id]
         return h.executor.execute(
             index, pql,
@@ -231,6 +251,7 @@ class LocalTransport(Transport):
     def send_message(self, node: Node, message: dict) -> dict:
         if node.id in self.down or node.id not in self.handles:
             raise TransportError(f"node unreachable: {node.id}")
+        self._maybe_delay(node.id)
         return self.handles[node.id].receive_message(message)
 
 

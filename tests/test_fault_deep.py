@@ -444,3 +444,58 @@ class TestStaleViewImport:
             ClientError(400, "does not own shard 7"))
         assert not refusal_is_unowned(ClientError(400, "bad query"))
         assert not refusal_is_unowned(TransportError("connection refused"))
+
+
+class TestGrayFailure:
+    """Slow-but-alive node (gray failure): no TransportError fires, so
+    nothing fails over — correctness must come from the write path
+    actually WAITING for the slow replica, and SWIM must keep the
+    node a member (it answers probes, late)."""
+
+    def test_slow_node_stays_member_reads_and_writes_exact(
+            self, tmp_path):
+        import random
+
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=2)
+        cols = _seed(nodes[0])
+        want = len(cols)
+        transport.set_slow("node1", 0.05)
+        try:
+            # SWIM: probes are slow, not dead — no state change
+            changes = heartbeat_round(nodes[0], k=2,
+                                      rng=random.Random(3))
+            assert not changes, changes
+            # reads exact from every node (including through the slow
+            # replica's owned shards)
+            for nd in nodes:
+                assert nd.executor.execute(
+                    "i", "Count(Row(f=1))")[0] == want
+            # writes replicate through the slow node synchronously —
+            # target a shard the SLOW node owns, chosen dynamically so
+            # a placement/width change can never silently skip the
+            # replication assertion below
+            slow_shard = next(
+                s for s in range(6)
+                if "node1" in [n.id
+                               for n in nodes[0].cluster.shard_nodes(
+                                   "i", s)])
+            API(nodes[0]).import_bits(
+                "i", "f", [1], [slow_shard * SHARD_WIDTH + 777])
+            want += 1
+            assert nodes[2].executor.execute(
+                "i", "Set(99, f=1)")[0] is True
+            want += 1
+        finally:
+            transport.set_slow("node1", 0.0)
+        # the slow replica's LOCAL fragment carries the write — it was
+        # not skipped while the node was slow
+        frag = nodes[1].holder.index("i").field("f") \
+            .view("standard").fragment(slow_shard)
+        assert frag is not None, "slow replica never got the fragment"
+        arr = frag._rows.get(1)
+        off = 777
+        assert arr is not None and (arr[off // 32] >> (off % 32)) & 1, \
+            "write was skipped on the slow replica"
+        for nd in nodes:
+            assert nd.executor.execute(
+                "i", "Count(Row(f=1))")[0] == want

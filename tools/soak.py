@@ -16,7 +16,10 @@ Round 5 adds bidirectional PAIR PARTITIONS to the fault schedule
 two live nodes stop hearing each other while both keep serving the
 rest of the cluster — reads from either side must fail over to the
 reachable replica, and anti-entropy passes RACE the partition (the
-syncer must skip the unreachable peer, never half-apply).  The
+syncer must skip the unreachable peer, never half-apply.  Also GRAY
+faults: a node answers every message LATE — no TransportError fires,
+so nothing fails over; writes keep replicating through it
+synchronously and every read must stay exact, just slower).  The
 process-level counterpart with real SIGSTOP freezes is
 tools/soak_proc.py.
 
@@ -89,10 +92,12 @@ def main() -> int:
 
     downed: str | None = None
     partition: tuple[str, str] | None = None
+    slowed: str | None = None
     iters = 0
     checks = 0
     resizes = 0
     partitions = 0
+    slow_events = 0
     extra: list = []  # nodes joined beyond the base 3, newest last
     next_extra_id = 3
     t_end = time.monotonic() + args.seconds
@@ -240,25 +245,39 @@ def main() -> int:
                 for nd in live_nodes():
                     assert nd.cluster.state == "NORMAL", (
                         f"{nd.cluster.local_id} not NORMAL after resize")
-        elif action < 0.975:  # fault injection: heal, or down / partition
+        elif action < 0.975:  # fault injection: heal, or down /
+            # partition / gray (slow) failure
             if downed is not None:
                 transport.set_down(downed, False)
                 downed = None
             elif partition is not None:
                 transport.set_partition(*partition, False)
                 partition = None
-            elif rng.random() < 0.5:
-                downed = rng.choice(["node1", "node2"])
-                transport.set_down(downed)
+            elif slowed is not None:
+                transport.set_slow(slowed, 0.0)
+                slowed = None
             else:
-                # bidirectional pair partition between two LIVE nodes:
-                # both keep serving everyone else; reads from either
-                # side must fail over to the reachable replica
-                ids = [nd.cluster.local_id for nd in live_nodes()]
-                a, b = rng.sample(ids, 2)
-                transport.set_partition(a, b)
-                partition = (a, b)
-                partitions += 1
+                kind = rng.random()
+                if kind < 0.4:
+                    downed = rng.choice(["node1", "node2"])
+                    transport.set_down(downed)
+                elif kind < 0.8:
+                    # bidirectional pair partition between two LIVE
+                    # nodes: both keep serving everyone else; reads
+                    # from either side must fail over to the
+                    # reachable replica
+                    ids = [nd.cluster.local_id for nd in live_nodes()]
+                    a, b = rng.sample(ids, 2)
+                    transport.set_partition(a, b)
+                    partition = (a, b)
+                    partitions += 1
+                else:
+                    # GRAY failure: the node answers, just late —
+                    # no failover triggers, writes keep flowing, and
+                    # every read must still be exact
+                    slowed = rng.choice(["node1", "node2"])
+                    transport.set_slow(slowed, rng.uniform(0.01, 0.06))
+                    slow_events += 1
         else:  # anti-entropy repair pass — races any active partition
             if downed is None:
                 for nd in live_nodes():
@@ -268,13 +287,16 @@ def main() -> int:
             t_report = time.monotonic() + args.progress_every
             print(f"soak: {iters} iters, {checks} oracle checks, "
                   f"{resizes} resizes, {partitions} partitions, "
-                  f"nodes={len(live_nodes())}, downed={downed}, "
-                  f"partition={partition}", flush=True)
+                  f"{slow_events} gray, nodes={len(live_nodes())}, "
+                  f"downed={downed}, partition={partition}, "
+                  f"slowed={slowed}", flush=True)
 
     if downed is not None:
         transport.set_down(downed, False)
     if partition is not None:
         transport.set_partition(*partition, False)
+    if slowed is not None:
+        transport.set_slow(slowed, 0.0)
     for nd in live_nodes():
         HolderSyncer(nd).sync_holder()
     # final convergence: every node answers every row exactly
@@ -287,7 +309,8 @@ def main() -> int:
                 assert got == want, f"final divergence {f}={r} on " \
                     f"{nd.cluster.local_id}"
     print(f"soak PASSED: {iters} iters, {checks} oracle checks, "
-          f"{resizes} resizes, {partitions} partitions")
+          f"{resizes} resizes, {partitions} partitions, "
+          f"{slow_events} gray faults")
     return 0
 
 
