@@ -1440,15 +1440,16 @@ class Executor:
             converge_owner_deliveries, refusal_is_unowned)
 
         applied: set[str] = set()
-        changed = [False]
+        changed = False
 
         def delivery_pass() -> bool:
+            nonlocal changed
             refused = False
             for n in self.cluster.shard_nodes(idx.name, shard):
                 if n.id in applied:
                     continue
                 if n.id == self.cluster.local_id:
-                    changed[0] |= local_fn()
+                    changed |= local_fn()
                     applied.add(n.id)
                     continue
                 try:
@@ -1466,7 +1467,7 @@ class Executor:
                             f"write replication to node {n.id} "
                             f"failed: {e}")
                     raise
-                changed[0] |= bool(res[0])
+                changed |= bool(res[0])
                 applied.add(n.id)
             return refused
 
@@ -1477,7 +1478,7 @@ class Executor:
                 "converge; retry")
 
         converge_owner_deliveries(delivery_pass, on_timeout)
-        return changed[0]
+        return changed
 
     def _check_remote_write_owned(self, idx, shard: int,
                                   opt: ExecOptions | None) -> None:
