@@ -369,12 +369,22 @@ class Cluster:
             self._nodes.pop(node_id, None)
             self.save_topology()
 
-    def set_node_state(self, node_id: str, state: str) -> None:
+    def set_node_state(self, node_id: str, state: str) -> bool:
+        """Returns True when a DOWN claim about THIS node was
+        corrected (see apply_status's self-liveness authority) — the
+        caller should broadcast the correction so stale peers heal."""
+        corrected = False
+        if node_id == self.local_id and state == NODE_DOWN:
+            # a peer claiming WE are down is wrong by construction —
+            # we are executing this call; never adopt it
+            state = NODE_READY
+            corrected = True
         with self._lock:
             n = self._nodes.get(node_id)
             if n is not None:
                 n.state = state
             self._update_cluster_state()
+        return corrected
 
     def set_state(self, state: str) -> None:
         with self._lock:
@@ -475,9 +485,18 @@ class Cluster:
             "nodes": [n.to_dict() for n in self.sorted_nodes()],
         }
 
-    def apply_status(self, status: dict) -> None:
+    def apply_status(self, status: dict) -> bool:
         """Adopt a coordinator-broadcast ClusterStatus (server.go:569
-        receiveMessage ClusterStatus handling)."""
+        receiveMessage ClusterStatus handling).
+
+        Returns True when the status claimed THIS node is DOWN and the
+        claim was corrected: a live node is the authority on its own
+        liveness, and a snapshot can legitimately predate our restart
+        (found by the round-5 process soak: a killed-and-restarted
+        node adopted a stale self-DOWN, stayed DEGRADED forever, and
+        nothing could rehabilitate it — peers heal their view of us
+        via SWIM probes, but nobody probes us on our behalf)."""
+        corrected_self = False
         with self._lock:
             self.state = status.get("state", self.state)
             self.coordinator_id = status.get("coordinator", self.coordinator_id)
@@ -497,6 +516,12 @@ class Cluster:
                     # our join — the local node is always a member
                     if nid not in ids and nid != self.local_id:
                         del self._nodes[nid]
+            me = self._nodes.get(self.local_id)
+            if me is not None and me.state == NODE_DOWN:
+                me.state = NODE_READY
+                corrected_self = True
+                self._update_cluster_state()
             for n in self._nodes.values():
                 n.is_coordinator = n.id == self.coordinator_id
             self.save_topology()
+        return corrected_self

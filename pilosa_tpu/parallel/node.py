@@ -377,10 +377,17 @@ class ClusterNode:
         elif t == "node-status":
             self.apply_node_status(msg)
         elif t == "cluster-status":
-            self.cluster.apply_status(msg["status"])
+            if self.cluster.apply_status(msg["status"]):
+                # the snapshot claimed we are DOWN (stale, predating
+                # our restart): we corrected our own entry; tell the
+                # cluster so stale peer views heal too
+                self._broadcast_self_alive()
             self.update_translate_writability()
         elif t == "node-state":
-            self.cluster.set_node_state(msg["node"], msg["state"])
+            if self.cluster.set_node_state(msg["node"], msg["state"]):
+                # same healing for a direct stale claim about us —
+                # the claimer's OTHER recipients adopted it verbatim
+                self._broadcast_self_alive()
         else:
             return {"ok": False, "error": f"unknown message type: {t}"}
         return {"ok": True}
@@ -401,6 +408,19 @@ class ClusterNode:
             coord, {"type": "remove-node", "node": node_id})
         if not resp.get("ok", True):
             raise RuntimeError(resp.get("error", "remove-node failed"))
+
+    def _broadcast_self_alive(self) -> None:
+        """Push a node-state READY for ourselves after overruling a
+        stale self-DOWN claim (apply_status/set_node_state self-
+        liveness authority): peers that adopted the stale claim heal
+        immediately instead of waiting for their next SWIM sample of
+        us.  Receivers' set_node_state never re-broadcasts a READY,
+        so this cannot loop."""
+        from pilosa_tpu.parallel.cluster import NODE_READY
+
+        self.broadcast({"type": "node-state",
+                        "node": self.cluster.local_id,
+                        "state": NODE_READY})
 
     def _refuse_unowned_import(self, index: str,
                                shard: int) -> dict | None:

@@ -17,6 +17,7 @@ from pilosa_tpu.api import API
 from pilosa_tpu.models.holder import Holder
 from pilosa_tpu.parallel.cluster import (
     Cluster,
+    NODE_READY,
     STATE_NORMAL,
     TransportError,
 )
@@ -153,8 +154,16 @@ class Server:
                 try:
                     resp = client.send_message(
                         seed, {"type": "node-join", "node": me})
-                    if resp.get("status"):
-                        self.cluster.apply_status(resp["status"])
+                    if resp.get("status") and self.cluster.apply_status(
+                            resp["status"]):
+                        # the join response carried a stale self-DOWN
+                        # (predates this restart): heal stale peer
+                        # views too, or with SWIM disabled they route
+                        # reads away from us forever
+                        self.node.broadcast({
+                            "type": "node-state",
+                            "node": self.cluster.local_id,
+                            "state": NODE_READY})
                     # catch up on shards created while this node was
                     # away (the coordinator's NodeStatus)
                     if resp.get("nodeStatus"):

@@ -91,10 +91,18 @@ class TestTopology:
         c1.set_node_state("b", "DOWN")
         status = c1.to_status()
         c2 = Cluster("b", nodes=[Node(id="b")])
-        c2.apply_status(status)
+        corrected = c2.apply_status(status)
         assert [n.id for n in c2.sorted_nodes()] == ["a", "b"]
-        assert c2.node("b").state == "DOWN"
-        assert c2.state == status["state"]
+        # self-liveness authority (round 5): "b" is applying the
+        # status, so it is provably alive — the stale self-DOWN claim
+        # is corrected, not adopted
+        assert corrected and c2.node("b").state == "READY"
+        assert c2.state == "NORMAL"
+        # claims about OTHER nodes apply verbatim
+        c3 = Cluster("c", nodes=[Node(id="c")])
+        assert not c3.apply_status(status)
+        assert c3.node("b").state == "DOWN"
+        assert c3.state == status["state"]
 
     def test_degraded_state(self):
         c = Cluster("a", nodes=[Node(id="a"), Node(id="b")], replica_n=2)
@@ -435,3 +443,37 @@ class TestClusteredGroupByConstraints:
         gotd = {(g.group[0].row_id, g.group[1].row_id): g.count
                 for g in got}
         assert gotd == {(1, 7): 1, (5, 7): 3}, gotd
+
+
+def test_self_liveness_authority(tmp_path):
+    """A node is the authority on its own liveness (round-5 soak
+    find): a restarted node receiving a stale ClusterStatus that
+    predates its restart must never adopt DOWN for itself — it
+    corrects the entry, recomputes the cluster state, and broadcasts
+    the correction so stale peer views heal; a direct node-state
+    claim about self is corrected the same way."""
+    transport, nodes = make_cluster(tmp_path, n=3, replica_n=2)
+    n0, n1, _ = nodes
+    # node0 still believes node1 is down (it was, before its restart)
+    n0.cluster.set_node_state("node1", "DOWN")
+    assert n0.cluster.state == "DEGRADED"
+    # node1 receives that stale snapshot
+    n1.receive_message({"type": "cluster-status",
+                        "status": n0.cluster.to_status()})
+    assert n1.cluster.node("node1").state == "READY"
+    assert n1.cluster.state == "NORMAL"
+    # ...and its correction broadcast healed node0's view too
+    assert n0.cluster.node("node1").state == "READY"
+    assert n0.cluster.state == "NORMAL"
+    # a peer's direct node-state claim about US is equally overruled,
+    # and the correction broadcast heals peers that adopted the same
+    # stale claim verbatim
+    nodes[2].cluster.set_node_state("node1", "DOWN")
+    n1.receive_message({"type": "node-state", "node": "node1",
+                        "state": "DOWN"})
+    assert n1.cluster.node("node1").state == "READY"
+    assert nodes[2].cluster.node("node1").state == "READY"
+    # claims about OTHER nodes still apply normally
+    n1.receive_message({"type": "node-state", "node": "node2",
+                        "state": "DOWN"})
+    assert n1.cluster.node("node2").state == "DOWN"
