@@ -156,9 +156,12 @@ struct Parser {
     static bool is_digit(char c) { return c >= '0' && c <= '9'; }
     static bool is_alnum(char c) { return is_alpha(c) || is_digit(c); }
 
-    // [A-Za-z][A-Za-z0-9]*
+    // [A-Za-z_][A-Za-z0-9]* — the leading underscore admits the
+    // executor's internal sentinel calls (_Empty/_Noop/_EmptyRows),
+    // whose String() form must re-parse on remote scatter (mirrors
+    // the Python parser's _IDENT_RE)
     bool ident(std::string& out) {
-        if (!is_alpha(peek())) return false;
+        if (!is_alpha(peek()) && peek() != '_') return false;
         size_t start = pos;
         pos++;
         while (is_alnum(peek())) pos++;

@@ -760,8 +760,11 @@ def _resolve_open_time_ranges(node, idx, index_name: str, call):
 
 def _has_sentinel(call) -> bool:
     """True when translation produced an internal sentinel call
-    (_Empty/_EmptyRows/_Noop) anywhere in the tree — those have no PQL
-    spelling, so the query cannot ship to peers as text."""
+    (_Empty/_EmptyRows/_Noop) anywhere in the tree.  (Since round 5
+    the sentinels DO re-parse as text — the scatter path ships them to
+    peers directly — but the COLLECTIVE evaluator has no sentinel
+    stacks, so this plane still folds them out algebraically or
+    declines in favor of scatter.)"""
     if call.name.startswith("_"):
         return True
     filt = call.args.get("filter")
@@ -924,8 +927,10 @@ def _check_collective(node, index_name: str, pql: str,
         except Exception as e:  # noqa: BLE001 — scatter path owns the error
             return f"translation failed: {e!r}", None, None
         if _has_sentinel(call):
-            # a missing key translated to an _Empty/_Noop sentinel,
-            # which has no PQL spelling to ship to peers.  Fold it out
+            # a missing key translated to an _Empty/_Noop sentinel.
+            # The collective evaluator has no sentinel stacks (the
+            # scatter path evaluates them natively, and since round 5
+            # their text form even ships to peers), so fold them out
             # by set algebra where possible (Union drops empty
             # children, Intersect collapses, ...); only unfoldable
             # shapes — whole-tree-empty, Not(empty), _EmptyRows — fall

@@ -19,7 +19,12 @@ from pilosa_tpu.pql.ast import Call, Condition, Query
 # without it Python's \d admits Unicode digits the native parser
 # (and the reference) reject.
 _TIMESTAMP_RE = re.compile(r"\d{4}-[01]\d-[0-3]\dT\d\d:\d\d", re.ASCII)
-_IDENT_RE = re.compile(r"[A-Za-z][A-Za-z0-9]*")
+#: leading underscore admits the executor's internal sentinel calls
+#: (_Empty/_Noop/_EmptyRows, substituted for missing keys during
+#: translation) — their String() form must re-parse on remote nodes
+#: (remote scatter re-parses the translated tree; a replica reading a
+#: key that does not exist yet scatters such a tree)
+_IDENT_RE = re.compile(r"[A-Za-z_][A-Za-z0-9]*")
 _FIELD_RE = re.compile(r"[A-Za-z][A-Za-z0-9_-]*")
 _BARE_STR_RE = re.compile(r"[A-Za-z0-9:_-]+", re.ASCII)
 _NUMBER_RE = re.compile(r"-?(?:\d+(?:\.\d*)?|\.\d+)", re.ASCII)

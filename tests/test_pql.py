@@ -179,3 +179,19 @@ def test_min_max_sum():
     assert c.args["field"] == "size"
     c = one("Min(field=size)")
     assert c.args["field"] == "size"
+
+
+def test_sentinel_call_names_parse():
+    """Internal missing-key sentinels (_Empty/_Noop/_EmptyRows) must
+    re-parse from their String() form: remote scatter ships the
+    translated tree as text, and a replica reading a not-yet-existing
+    key scatters exactly such a tree (round-5 soak find)."""
+    from pilosa_tpu.pql import parse_python
+
+    for src in ("Count(_Empty())",
+                "Count(Intersect(Row(f=3), _Empty()))",
+                "_Noop()",
+                "_EmptyRows()",
+                "Union(_Empty(), Row(f=1))"):
+        q = parse_python(src)
+        assert q.calls and str(q) == src, src

@@ -165,3 +165,20 @@ class TestDifferential:
         q = pql.parse("Count(Row(f=1))")
         assert called.get("hit")
         assert q.calls[0].name == "Count"
+
+
+def test_sentinel_call_names_roundtrip_native():
+    """The executor's internal missing-key sentinels must parse
+    identically in both parsers — their String() form crosses the
+    wire on remote scatter.  (The Python-parser half lives ungated in
+    test_pql.py; this module is skipped without the native
+    toolchain.)"""
+    from pilosa_tpu.pql import parse_python
+    from pilosa_tpu.pql.native import parse_native
+
+    for src in ("Count(_Empty())",
+                "Count(Intersect(Row(f=3), _Empty()))",
+                "_Noop()",
+                "_EmptyRows()",
+                "Union(_Empty(), Row(f=1))"):
+        assert str(parse_native(src)) == str(parse_python(src)), src

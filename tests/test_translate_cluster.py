@@ -138,3 +138,30 @@ class TestReplicaReadThrough:
         nodes[0].executor.execute("k", "Set('colA', f='x')")
         row = nodes[1].executor.execute("k", "Row(f='never-set')")[0]
         assert list(row.columns()) == []
+
+
+def test_unknown_key_scatters_through_non_owner(tmp_path):
+    """Round-5 soak find: a replica that does NOT own the queried
+    shard must scatter the translated tree remotely — and the
+    missing-key sentinel's String() form must re-parse on the remote
+    (both parsers now admit the _-prefixed internal call names).
+    Before the fix this raised ParseError('expected field name')
+    instead of returning the empty result."""
+    transport, nodes = make_cluster(tmp_path, n=3, replica_n=2)
+    nodes[0].create_index("k", IndexOptions(keys=True))
+    nodes[0].create_field("k", "kf", FieldOptions.set_field(keys=True))
+    for i in range(8):
+        nodes[0].executor.execute("k", f'Set("u{i}", kf="r0")')
+    # find the node that owns NOTHING of shard 0 (replica_n=2 of 3)
+    owners = {n.id for n in nodes[0].cluster.shard_nodes("k", 0)}
+    outsider = next(nd for nd in nodes
+                    if nd.cluster.local_id not in owners)
+    assert int(outsider.executor.execute(
+        "k", 'Count(Row(kf="ghost"))')[0]) == 0
+    assert int(outsider.executor.execute(
+        "k", 'Count(Intersect(Row(kf="r0"), Row(kf="ghost")))')[0]) == 0
+    row = outsider.executor.execute("k", 'Row(kf="ghost")')[0]
+    assert list(row.columns()) == []
+    # known keys still exact through the outsider
+    assert int(outsider.executor.execute(
+        "k", 'Count(Row(kf="r0"))')[0]) == 8

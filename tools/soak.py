@@ -77,8 +77,15 @@ def main() -> int:
     for f in fields:
         coord.create_field("i", f)
     from pilosa_tpu.models.field import FieldOptions
+    from pilosa_tpu.models.index import IndexOptions
 
     coord.create_field("i", "v", options=FieldOptions.int_field(-1000, 1000))
+    # keyed surface: translation (coordinator-allocated ids, replica
+    # tailing, read-through) must stay exact under the same fault
+    # schedule as everything else
+    coord.create_index("k", options=IndexOptions(keys=True))
+    coord.create_field("k", "kf", options=FieldOptions(keys=True))
+    kbits: dict[str, set] = {f"r{j}": set() for j in range(4)}
 
     bits: dict[tuple[str, int], set] = {
         (f, r): set() for f in fields for r in range(5)}
@@ -156,6 +163,30 @@ def main() -> int:
                 ex.execute("i", f"Set({c}, v={v})")
                 vals[c] = v
                 universe.add(c)
+        elif action < 0.39:  # keyed write (translation allocates ids)
+            if quiesced:
+                rk = f"r{rng.randrange(4)}"
+                ck = f"u{rng.randrange(3000)}"
+                ex.execute("k", f'Set("{ck}", kf="{rk}")')
+                kbits[rk].add(ck)
+        elif action < 0.43:  # keyed read vs oracle — replicas serve
+            # via tailed stores + read-through; during faults the
+            # coordinator (never downed) answers, since a partitioned
+            # replica legitimately cannot resolve keys created across
+            # the cut (the reference's tailing replicas share that
+            # staleness window)
+            rk = f"r{rng.randrange(4)}"
+            node = coord if not quiesced else rng.choice(live_nodes())
+            got = node.executor.execute("k", f'Count(Row(kf="{rk}"))')[0]
+            assert int(got) == len(kbits[rk]), \
+                f"keyed divergence {rk} on {node.cluster.local_id}"
+            ra, rb = rng.sample(list(kbits), 2)
+            got = node.executor.execute(
+                "k", f'Count(Intersect(Row(kf="{ra}"), '
+                     f'Row(kf="{rb}")))')[0]
+            assert int(got) == len(kbits[ra] & kbits[rb]), \
+                f"keyed intersect divergence on {node.cluster.local_id}"
+            checks += 2
         elif action < 0.70:  # nested algebra vs oracle (any node)
             q = gen_query(rng)
             want = eval_set_algebra(parse_python(q).calls[0],
