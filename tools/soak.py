@@ -86,6 +86,13 @@ def main() -> int:
     coord.create_index("k", options=IndexOptions(keys=True))
     coord.create_field("k", "kf", options=FieldOptions(keys=True))
     kbits: dict[str, set] = {f"r{j}": set() for j in range(4)}
+    # time-quantum surface: every write lands in multiple views, AE
+    # reconciles per view, and resize transfers must move ALL views
+    coord.create_field("i", "t",
+                       options=FieldOptions.time_field("YM"))
+    # oracle: (row, month) -> cols; months 1..6 of 2024
+    tbits: dict[tuple[int, int], set] = {
+        (r, m): set() for r in range(3) for m in range(1, 7)}
 
     bits: dict[tuple[str, int], set] = {
         (f, r): set() for f in fields for r in range(5)}
@@ -156,13 +163,38 @@ def main() -> int:
                 else:
                     ex.execute("i", f"Clear({c}, {f}={row})")
                     bits[(f, row)].discard(c)
-        elif action < 0.36:  # BSI write
+        elif action < 0.32:  # BSI write
             c = col()
             v = rng.randrange(-1000, 1001)
             if quiesced:
                 ex.execute("i", f"Set({c}, v={v})")
                 vals[c] = v
                 universe.add(c)
+        elif action < 0.345:  # time-field write (multi-view)
+            if quiesced:
+                r_, m = rng.randrange(3), rng.randrange(1, 7)
+                c = col()
+                ex.execute("i",
+                           f"Set({c}, t={r_}, 2024-{m:02d}-15T00:00)")
+                tbits[(r_, m)].add(c)
+                universe.add(c)
+        elif action < 0.36:  # time-window read vs oracle (any node,
+            # races every fault: per-view failover + AE)
+            r_ = rng.randrange(3)
+            m0 = rng.randrange(1, 7)
+            m1 = rng.randrange(m0, 7)
+            node = rng.choice(live_nodes())
+            if downed is not None and node.cluster.local_id == downed:
+                node = coord
+            got = node.executor.execute(
+                "i", f"Count(Row(t={r_}, from='2024-{m0:02d}-01T00:00',"
+                     f" to='2024-{m1 + 1:02d}-01T00:00'))")[0]
+            want = len(set().union(*(tbits[(r_, m)]
+                                     for m in range(m0, m1 + 1))))
+            assert int(got) == want, \
+                f"time divergence t={r_} [{m0},{m1}] on " \
+                f"{node.cluster.local_id}"
+            checks += 1
         elif action < 0.39:  # keyed write (translation allocates ids)
             if quiesced:
                 rk = f"r{rng.randrange(4)}"
