@@ -93,6 +93,10 @@ def main() -> int:
     # oracle: (row, month) -> cols; months 1..6 of 2024
     tbits: dict[tuple[int, int], set] = {
         (r, m): set() for r in range(3) for m in range(1, 7)}
+    # Store/ClearRow target: a whole-row write forwarded to ALL nodes
+    # (a different replication shape from per-shard owner fan-out)
+    coord.create_field("i", "st")
+    stbits: dict[int, set] = {j: set() for j in range(3)}
 
     bits: dict[tuple[str, int], set] = {
         (f, r): set() for f in fields for r in range(5)}
@@ -219,6 +223,29 @@ def main() -> int:
             assert int(got) == len(kbits[ra] & kbits[rb]), \
                 f"keyed intersect divergence on {node.cluster.local_id}"
             checks += 2
+        elif action < 0.445:  # Store / ClearRow: whole-row writes
+            # forwarded to every node (executor.go:1739 / :1797 shape)
+            if quiesced:
+                sr = rng.randrange(3)
+                if rng.random() < 0.75:
+                    f = rng.choice(fields)
+                    r1, r2 = rng.sample(range(5), 2)
+                    ex.execute(
+                        "i", f"Store(Union(Row({f}={r1}), "
+                             f"Row({f}={r2})), st={sr})")
+                    stbits[sr] = bits[(f, r1)] | bits[(f, r2)]
+                else:
+                    ex.execute("i", f"ClearRow(st={sr})")
+                    stbits[sr] = set()
+        elif action < 0.46:  # stored-row read vs oracle (races faults)
+            sr = rng.randrange(3)
+            node = rng.choice(live_nodes())
+            if downed is not None and node.cluster.local_id == downed:
+                node = coord
+            got = node.executor.execute("i", f"Count(Row(st={sr}))")[0]
+            assert int(got) == len(stbits[sr]), \
+                f"Store divergence st={sr} on {node.cluster.local_id}"
+            checks += 1
         elif action < 0.70:  # nested algebra vs oracle (any node)
             q = gen_query(rng)
             want = eval_set_algebra(parse_python(q).calls[0],
