@@ -53,6 +53,12 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# the harness MUST agree with the spawned servers on shard width: the
+# roaring import path pre-encodes absolute positions (row*width+off)
+# with THIS process's width (tests/test_proc_cluster._spawn pins the
+# servers to 16)
+os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH_EXP", "16")
+
 from tests.test_proc_cluster import (  # noqa: E402
     _free_port, _get, _post, _spawn, _wait_status)
 from pilosa_tpu.shardwidth import SHARD_WIDTH  # noqa: E402
@@ -90,6 +96,38 @@ def main() -> int:
                 rows.append(r)
                 cols.append(c)
         return {"rowIDs": rows, "columnIDs": cols}
+
+    def roaring_import(port, b, timeout=180.0):
+        """Deliver a batch over the FASTEST wire: pre-encoded roaring
+        per shard via /import-roaring/{shard} (owner fan-out + WAL
+        roaring records — a different durability/replication path from
+        /import's JSON arrays)."""
+        import urllib.request
+
+        import numpy as np
+
+        from pilosa_tpu.storage import roaring as rcodec
+
+        rows_a = np.asarray(b["rowIDs"], dtype=np.int64)
+        cols_a = np.asarray(b["columnIDs"], dtype=np.int64)
+        shard_a = cols_a // SHARD_WIDTH
+        pos_a = (rows_a * SHARD_WIDTH
+                 + (cols_a % SHARD_WIDTH)).astype(np.uint64)
+        for s in np.unique(shard_a):
+            u = np.unique(pos_a[shard_a == s])
+            k_, w_ = rcodec.positions_to_containers(u)
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/index/i/field/f/"
+                f"import-roaring/{int(s)}",
+                data=rcodec.encode(k_, w_), method="POST")
+            req.add_header("Content-Type", "application/octet-stream")
+            urllib.request.urlopen(req, timeout=timeout).read()
+
+    def any_import(port, b, timeout=180.0):
+        if rng.random() < 0.5:
+            roaring_import(port, b, timeout)
+        else:
+            _post(port, "/index/i/field/f/import", b, timeout=timeout)
 
     def check_exact(port, rows=(0, 1)):
         q = "Count(Union(%s))" % ", ".join(f"Row(f={r})" for r in rows)
@@ -163,12 +201,34 @@ def main() -> int:
                 time.sleep(rng.uniform(0.1, 1.0))
                 err: list = []
 
+                use_roaring = rng.random() < 0.5
+
                 def do_import():
-                    try:
-                        _post(ports[0], "/index/i/field/f/import", b,
-                              timeout=180.0)
-                    except Exception as e:  # noqa: BLE001
-                        err.append(e)
+                    # the per-shard roaring sequence can observe the
+                    # DEGRADED write-block mid-freeze (405) where the
+                    # single JSON POST was already in flight — retry
+                    # through the window like a real client; the merge
+                    # is idempotent, so re-sending shards is exact
+                    import urllib.error
+
+                    deadline = time.time() + 180.0
+                    while True:
+                        try:
+                            if use_roaring:
+                                roaring_import(ports[0], b)
+                            else:
+                                _post(ports[0],
+                                      "/index/i/field/f/import",
+                                      b, timeout=180.0)
+                            return
+                        except urllib.error.HTTPError as e:
+                            if e.code != 405 or time.time() > deadline:
+                                err.append(e)
+                                return
+                            time.sleep(1.0)
+                        except Exception as e:  # noqa: BLE001
+                            err.append(e)
+                            return
 
                 t = threading.Thread(target=do_import, daemon=True)
                 t.start()
@@ -277,7 +337,7 @@ def main() -> int:
                 converge()
 
             else:  # ---- QUIET cycle: steady-state oracle pressure
-                _post(ports[0], "/index/i/field/f/import", batch(60))
+                any_import(ports[0], batch(60))
                 stats["imports"] += 1
                 check_exact(rng.choice(ports), rows=(0, 1, 2))
                 topn = _post(rng.choice(ports), "/index/i/query",
