@@ -41,9 +41,17 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = r'''
 import json, os, random, statistics, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"
+import re as _re
+_fl2 = _re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _fl2 + " --xla_force_host_platform_device_count=2").strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # jax < 0.5: the XLA_FLAGS override above covers it
 
 from pilosa_tpu.parallel import multihost, spmd
 from pilosa_tpu.pql import parse

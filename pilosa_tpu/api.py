@@ -93,7 +93,7 @@ class API:
 
     def query(self, index: str, pql, shards=None, remote: bool = False,
               column_attrs: bool = False, exclude_row_attrs: bool = False,
-              exclude_columns: bool = False):
+              exclude_columns: bool = False, coalesce: bool = True):
         """Execute PQL -> list of results (api.go:135 API.Query)."""
         from pilosa_tpu.parallel.executor import ExecOptions
 
@@ -114,7 +114,10 @@ class API:
         if self.max_writes_per_request > 0:
             from pilosa_tpu.pql import Query, parse as _parse
 
-            q = _parse(pql) if isinstance(pql, str) else pql
+            # the parsed Query skips the executor's re-parse, so the
+            # sentinel gate must apply here too (remote-only spellings)
+            q = (_parse(pql, allow_internal=remote)
+                 if isinstance(pql, str) else pql)
             if isinstance(q, Query) and (
                     q.write_call_n() > self.max_writes_per_request):
                 raise ApiError(
@@ -127,6 +130,7 @@ class API:
             exclude_row_attrs=exclude_row_attrs,
             exclude_columns=exclude_columns,
             shards=None if shards is None else list(shards),
+            coalesce=coalesce,
         )
         return self.executor.execute(index, pql, opt=opt)
 

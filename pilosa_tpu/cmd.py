@@ -175,6 +175,9 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         tls_skip_verify=cfg.tls.skip_verify,
         heap_profile=cfg.profile.heap,
         heap_profile_frames=cfg.profile.heap_frames,
+        coalescer_enabled=cfg.coalescer.enabled,
+        coalescer_window_ms=cfg.coalescer.window_ms,
+        coalescer_max_batch=cfg.coalescer.max_batch,
         logger=log,
         stats=stats,
     )

@@ -10,7 +10,11 @@ directly — the analog of the reference's functional ServerOptions
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ModuleNotFoundError:  # Python < 3.11: same API under the old name
+    import tomli as tomllib
 from dataclasses import dataclass, field, fields
 
 
@@ -71,6 +75,20 @@ class ProfileConfig:
 
 
 @dataclass
+class CoalescerConfig:
+    """[coalescer] — cross-query micro-batched dispatch (no reference
+    analog; the serving-side batching lever for the TPU dispatch
+    floor, parallel/coalescer.py).  ``enabled`` is tri-state:
+    ``"auto"`` turns batching on only when an accelerator is attached
+    (on a host-mode CPU dispatch is free and the window would only add
+    latency); TOML booleans / "true"/"false" force it."""
+
+    enabled: str = "auto"  # auto | true | false
+    window_ms: float = 2.0
+    max_batch: int = 32
+
+
+@dataclass
 class TLSConfig:
     """[tls] (server/tlsconfig.go; config server/config.go:58-66)."""
 
@@ -97,6 +115,7 @@ class Config:
     tracing: TracingConfig = field(default_factory=TracingConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
+    coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
 
     # ------------------------------------------------------------- access
 
@@ -132,7 +151,7 @@ class Config:
         for k, v in d.items():
             key = k.replace("-", "_")
             if key in ("cluster", "anti_entropy", "metric", "tracing",
-                       "profile", "tls") and isinstance(v, dict):
+                       "profile", "tls", "coalescer") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -144,7 +163,8 @@ class Config:
                                                         MetricConfig,
                                                         TracingConfig,
                                                         ProfileConfig,
-                                                        TLSConfig)):
+                                                        TLSConfig,
+                                                        CoalescerConfig)):
                 setattr(self, key, v)
 
     def _apply_env(self, env: dict) -> None:
@@ -152,7 +172,7 @@ class Config:
         (the reference's PILOSA_* envs, cmd/root.go:94)."""
         for f in fields(self):
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
-                          "profile", "tls"):
+                          "profile", "tls", "coalescer"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -203,6 +223,11 @@ class Config:
             "[profile]",
             f"heap = {str(self.profile.heap).lower()}",
             f"heap-frames = {self.profile.heap_frames}",
+            "",
+            "[coalescer]",
+            f'enabled = "{self.coalescer.enabled}"',
+            f"window-ms = {self.coalescer.window_ms}",
+            f"max-batch = {self.coalescer.max_batch}",
             "",
             "[tls]",
             f'certificate-path = "{self.tls.certificate_path}"',

@@ -25,6 +25,7 @@ regime is 32-bit and TPU has no native 64-bit integer path.
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +34,48 @@ from jax import lax
 
 WORD_BITS = 32
 _WORD_DTYPE = np.uint32
+
+
+# ---------------------------------------------------------------------------
+# Dispatch accounting — every public op below ticks once per kernel launch
+# (jit dispatch on device, native/numpy kernel pass on host), so tests can
+# assert how many launches a query actually cost.  The fused expression
+# compiler (ops/expr.py) ticks ONCE for a whole tree, which is the point:
+# the Count/Intersect hot path is dispatch-bound behind an RPC relay
+# (VERDICT round 5: 20 us trivial-dispatch floor vs 0.555 ms/query), so
+# launch count IS the perf model, and it must be regression-testable.
+# ---------------------------------------------------------------------------
+
+_dispatch = threading.local()  # .log: list[str] while a counter is active
+
+
+def note_dispatch(name: str) -> None:
+    """Record one kernel launch on this thread (no-op unless a
+    dispatch_counter is active on it)."""
+    log = getattr(_dispatch, "log", None)
+    if log is not None:
+        log.append(name)
+
+
+class dispatch_counter:
+    """Context manager counting kernel launches on the CURRENT thread.
+    Nested counters stack (the inner one shadows).  Thread-local by
+    design: the executor's fused paths run on the calling thread, which
+    is exactly the scope a dispatch-count regression test needs."""
+
+    def __enter__(self):
+        self._prev = getattr(_dispatch, "log", None)
+        self.launches: list[str] = []
+        _dispatch.log = self.launches
+        return self
+
+    def __exit__(self, *exc):
+        _dispatch.log = self._prev
+        return False
+
+    @property
+    def n(self) -> int:
+        return len(self.launches)
 
 
 def n_words(nbits: int) -> int:
@@ -165,6 +208,7 @@ def _jit_and(a, b):
 
 def b_and(a, b):
     """Intersect (roaring.Intersect, roaring/roaring.go:595)."""
+    note_dispatch("b_and")
     if _host(a, b):
         return np.bitwise_and(a, b)
     return _jit_and(a, b)
@@ -177,6 +221,7 @@ def _jit_or(a, b):
 
 def b_or(a, b):
     """Union (roaring.Union, roaring/roaring.go:620)."""
+    note_dispatch("b_or")
     if _host(a, b):
         return np.bitwise_or(a, b)
     return _jit_or(a, b)
@@ -189,6 +234,7 @@ def _jit_xor(a, b):
 
 def b_xor(a, b):
     """Symmetric difference (roaring.Xor, roaring/roaring.go:918)."""
+    note_dispatch("b_xor")
     if _host(a, b):
         return np.bitwise_xor(a, b)
     return _jit_xor(a, b)
@@ -201,6 +247,7 @@ def _jit_andnot(a, b):
 
 def b_andnot(a, b):
     """Difference a \\ b (roaring.Difference, roaring/roaring.go:891)."""
+    note_dispatch("b_andnot")
     if _host(a, b):
         return np.bitwise_and(a, np.bitwise_not(b))
     return _jit_andnot(a, b)
@@ -214,6 +261,7 @@ def _jit_not(a, existence):
 def b_not(a, existence):
     """Complement within an existence mask (executor Not uses the index's
     existence row as the universe, executor.go:1708)."""
+    note_dispatch("b_not")
     if _host(a, existence):
         return np.bitwise_and(np.bitwise_not(a), existence)
     return _jit_not(a, existence)
@@ -236,8 +284,33 @@ def b_flip_range(a, start: int, end: int):
     """Flip bits in [start, end) (roaring.Flip, roaring/roaring.go:1683)."""
     mask = _range_mask_np(a.shape[-1], start, end)
     if _host(a):
+        note_dispatch("b_flip_range")
         return np.bitwise_xor(a, mask)
-    return b_xor(a, jnp.asarray(mask))
+    return b_xor(a, jnp.asarray(mask))  # b_xor ticks the dispatch
+
+
+def shift_words(xp, a, n: int):
+    """The ONE shift body, over either array namespace (``xp`` = numpy
+    or jax.numpy; jax-traceable with static ``n``): bits move toward
+    higher columns and drop at the shard edge (roaring.Shift semantics
+    per shard, executor.go:1730).  Shared by the host/jit wrappers here
+    and the fused expression compiler (ops/expr.py) so the four shift
+    call sites cannot drift bit-for-bit."""
+    if n == 0:
+        return a
+    w, s = n // WORD_BITS, n % WORD_BITS
+    nw = a.shape[-1]
+    if w >= nw:
+        # every bit shifts past the shard edge; computing it would pad
+        # an O(n)-word intermediate and compile per distinct n
+        return xp.zeros_like(a)
+    pad = [(0, 0)] * (a.ndim - 1)
+    # words move up by w: out_word[i] = a[i - w]
+    shifted = xp.pad(a, pad + [(w, 0)])[..., :nw]
+    if s == 0:
+        return shifted
+    prev = xp.pad(shifted, pad + [(1, 0)])[..., :nw]
+    return (shifted << np.uint32(s)) | (prev >> np.uint32(WORD_BITS - s))
 
 
 def b_shift(a, n: int = 1):
@@ -246,19 +319,9 @@ def b_shift(a, n: int = 1):
     matching per-shard Shift execution (executor.go:1730)."""
     if n < 0:
         raise ValueError("shift distance must be non-negative")
+    note_dispatch("b_shift")
     if _host(a):
-        if n == 0:
-            return a
-        w, sh = n // WORD_BITS, n % WORD_BITS
-        nw = a.shape[-1]
-        if w >= nw:
-            return np.zeros_like(a)
-        pad = [(0, 0)] * (a.ndim - 1)
-        shifted = np.pad(a, pad + [(w, 0)])[..., :nw]
-        if sh == 0:
-            return shifted
-        prev = np.pad(shifted, pad + [(1, 0)])[..., :nw]
-        return (shifted << np.uint32(sh)) | (prev >> np.uint32(WORD_BITS - sh))
+        return shift_words(np, a, n)
     return _jit_shift(a, n)
 
 
@@ -268,21 +331,7 @@ def _jit_shift(a, n: int = 1):
         # a clean error instead of a cryptic negative-pad failure from
         # inside jit tracing; surfaces as a 400 at the query layer
         raise ValueError("shift distance must be non-negative")
-    if n == 0:
-        return a
-    w, s = n // WORD_BITS, n % WORD_BITS
-    nw = a.shape[-1]
-    if w >= nw:
-        # every bit shifts past the shard edge; computing it would pad
-        # an O(n)-word intermediate and compile per distinct n
-        return jnp.zeros_like(a)
-    pad = [(0, 0)] * (a.ndim - 1)
-    # words move up by w: out_word[i] = a[i - w]
-    shifted = jnp.pad(a, pad + [(w, 0)])[..., :nw]
-    if s == 0:
-        return shifted
-    prev = jnp.pad(shifted, pad + [(1, 0)])[..., :nw]
-    return (shifted << np.uint32(s)) | (prev >> np.uint32(WORD_BITS - s))
+    return shift_words(jnp, a, n)
 
 
 # ---------------------------------------------------------------------------
@@ -298,6 +347,7 @@ def _jit_popcount(a):
 def popcount(a):
     """Total set bits (roaring.Count, roaring/roaring.go:478) — int32
     scalar on device, Python int on host stacks (native kernel)."""
+    note_dispatch("popcount")
     if _host(a):
         from pilosa_tpu.ops import hostkernels as hk
 
@@ -315,6 +365,7 @@ def popcount_and(a, b):
     (roaring.IntersectionCount, roaring/roaring.go:570): one XLA kernel
     on device (AND + popcount + reduce, no intermediate materialized),
     one C++ pass on host stacks."""
+    note_dispatch("popcount_and")
     if _host(a, b):
         from pilosa_tpu.ops import hostkernels as hk
 
@@ -332,6 +383,7 @@ def row_counts(mat):
 
     The batched scan under TopN (fragment.top, fragment.go:1570) — one
     device-wide reduction instead of a per-row heap walk."""
+    note_dispatch("row_counts")
     if _host(mat):
         from pilosa_tpu.ops import hostkernels as hk
 
@@ -351,6 +403,7 @@ def row_counts_and(a, b):
     stacks — the Count(Intersect(x, y)) fast path over stacked shard
     operands (vs b_and + row_counts, which allocates the full
     intersection stack first)."""
+    note_dispatch("row_counts_and")
     if _host(a, b):
         from pilosa_tpu.ops import hostkernels as hk
 
@@ -370,6 +423,7 @@ def _jit_row_counts_masked(mat, filt):
 def row_counts_masked(mat, filt):
     """Per-row |row & filter| -> int32[rows]; TopN-with-filter / GroupBy
     inner loop (fragment.go:1600, groupByIterator executor.go:3058)."""
+    note_dispatch("row_counts_masked")
     if _host(mat, filt):
         from pilosa_tpu.ops import hostkernels as hk
 
@@ -380,6 +434,7 @@ def row_counts_masked(mat, filt):
 def row_counts_gathered(mat, filt_stack, shard_pos):
     """Per-row |mat[r] & filt_stack[shard_pos[r]]| -> int32[rows]; see
     _jit_row_counts_gathered for the device story."""
+    note_dispatch("row_counts_gathered")
     if _host(mat, filt_stack):
         from pilosa_tpu.ops import hostkernels as hk
 
@@ -407,6 +462,7 @@ def _jit_row_counts_gathered(mat, filt_stack, shard_pos):
 def masked_matrix_counts(mat, masks):
     """counts[g, r] = |mat[r] & masks[g]| -> int32[G, rows]; see
     _jit_masked_matrix_counts for the device story."""
+    note_dispatch("masked_matrix_counts")
     if _host(mat, masks):
         from pilosa_tpu.ops import hostkernels as hk
 
@@ -427,6 +483,7 @@ def _jit_masked_matrix_counts(mat, masks):
 
 def and_pairs(mat, masks, slots, group_idx):
     """out[p] = mat[slots[p]] & masks[group_idx[p]]; see _jit_and_pairs."""
+    note_dispatch("and_pairs")
     if _host(mat, masks):
         return np.bitwise_and(np.take(mat, np.asarray(slots), axis=0),
                               np.take(masks, np.asarray(group_idx), axis=0))
@@ -458,6 +515,7 @@ def _jit_set_bits(words, idx, or_vals):
 def set_bits(words, idx, or_vals):
     """OR ``or_vals`` into ``words`` at unique ``idx`` (fragment setBit batch
     apply; mirrors the opN batch design of fragment.go:84,2296)."""
+    note_dispatch("set_bits")
     if _host(words):
         out = words.copy()
         out[np.asarray(idx)] |= np.asarray(or_vals)
@@ -472,6 +530,7 @@ def _jit_clear_bits(words, idx, andnot_vals):
 
 def clear_bits(words, idx, andnot_vals):
     """Clear bits given per-word masks of bits to remove."""
+    note_dispatch("clear_bits")
     if _host(words):
         out = words.copy()
         out[np.asarray(idx)] &= ~np.asarray(andnot_vals)
@@ -487,6 +546,7 @@ def _jit_get_bits(words, positions):
 
 def get_bits(words, positions):
     """Read individual bits -> int32[len(positions)] of 0/1."""
+    note_dispatch("get_bits")
     if _host(words):
         pos = np.asarray(positions)
         w = words[pos // WORD_BITS]
@@ -507,6 +567,7 @@ def _jit_reduce_or_rows(mat):
 
 def reduce_or_rows(mat):
     """OR-reduce a [rows, words] matrix -> [words]."""
+    note_dispatch("reduce_or_rows")
     if _host(mat):
         return np.bitwise_or.reduce(mat, axis=0)
     return _jit_reduce_or_rows(mat)
@@ -519,6 +580,7 @@ def _jit_reduce_and_rows(mat):
 
 def reduce_and_rows(mat):
     """AND-reduce a [rows, words] matrix -> [words]."""
+    note_dispatch("reduce_and_rows")
     if _host(mat):
         return np.bitwise_and.reduce(mat, axis=0)
     return _jit_reduce_and_rows(mat)

@@ -130,13 +130,18 @@ class TransportError(RuntimeError):
 
 #: cross-transport marker for a replica write delivery refused by a
 #: non-owner (reference api.go ErrClusterDoesNotOwnShard).  Typed
-#: exceptions survive LocalTransport; over HTTP the refusal travels as
-#: an error STRING, so both write origins match on this substring.
-UNOWNED_MARKER = "does not own shard"
+#: exceptions survive LocalTransport and carry a structured
+#: ``.unowned`` flag; over HTTP the refusal travels as an error STRING,
+#: so the origin falls back to matching this token — DISTINCTIVE by
+#: construction (the reference's error name, which no organic error
+#: text contains), so an unrelated failure that merely mentions shards
+#: cannot be misread as a refusal and silently converted into the
+#: 10 s convergence-retry loop.
+UNOWNED_MARKER = "ErrClusterDoesNotOwnShard"
 
 
 def refusal_is_unowned(exc: BaseException) -> bool:
-    return UNOWNED_MARKER in str(exc)
+    return bool(getattr(exc, "unowned", False)) or UNOWNED_MARKER in str(exc)
 
 
 def converge_owner_deliveries(delivery_pass, on_timeout) -> None:

@@ -1,0 +1,180 @@
+"""Cross-query micro-batched dispatch: concurrent count-style queries
+share one device launch.
+
+The Count/Intersect hot path is dispatch-bound on a real chip behind an
+RPC boundary (VERDICT round 5: 0.555 ms/query against a 20 us
+trivial-dispatch floor, bw_util 0.148), and `bench.py`'s batched engine
+proves one fused B=32 launch recovers the headroom.  This module is that
+engine made product code — the serving-side batching lever TPU inference
+stacks pull (Ragged Paged Attention, arxiv 2604.15464) applied to our
+map-reduce-over-shards execution model (DrJAX, arxiv 2403.07128;
+reference executor.go:2455 scatter-gather).
+
+Mechanics
+---------
+Fused-eligible `Count(tree)` queries stage their operands on the calling
+thread (`Executor._fused_expr`: canonical tree SHAPE + leaf stacks),
+then meet in a bucket keyed by ``(index, shape, shards)``.  The first
+arrival becomes the bucket's LEADER and waits up to ``window_s`` for
+followers; hitting ``max_batch`` seals the bucket early.  The leader
+stacks each leaf slot across the batch ([B, S, W]), runs ops.expr's
+compiled program ONCE (the count root reduces inside the same program),
+and scatters the per-query count rows back to every waiter's future.
+Same ops, same integer arithmetic — results are bit-exact against the
+unbatched path; a batch of one takes the identical single-query program
+(passthrough).
+
+Keyed on shape, not query text: ``Count(Intersect(Row(f=3), Row(f=9)))``
+and ``Count(Intersect(Row(f=7), Row(f=2)))`` coalesce (distinct leaf
+VALUES, one compiled program); only structurally different trees (or
+different shard sets) dispatch separately.
+
+Enablement: OFF in host mode (single CPU device — dispatch is a Python
+call there, batching buys nothing and the window would only add
+latency); ON by default when an accelerator is attached.  The server
+knobs live under ``[coalescer]`` (docs/configuration.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from pilosa_tpu import stats as _stats
+from pilosa_tpu import tracing
+
+
+def resolve_enabled(mode) -> bool:
+    """``auto`` (accelerator-only) | true | false — TOML booleans and
+    env strings both accepted.  Anything else is a configuration error
+    and raises: a typo like ``enabled = "ture"`` silently falling back
+    to auto would invert the operator's explicit intent."""
+    if isinstance(mode, bool):
+        return mode
+    s = str(mode).strip().lower()
+    if s in ("1", "true", "yes", "on"):
+        return True
+    if s in ("0", "false", "no", "off"):
+        return False
+    if s != "auto":
+        raise ValueError(
+            f"coalescer.enabled must be auto/true/false, got {mode!r}")
+    from pilosa_tpu.ops import bitmap as bm
+
+    return not bm.host_mode()
+
+
+class _Bucket:
+    __slots__ = ("items", "full", "sealed")
+
+    def __init__(self):
+        self.items: list[tuple[tuple, Future]] = []  # (leaves, future)
+        self.full = threading.Event()
+        self.sealed = False
+
+
+class Coalescer:
+    """One per executor.  Thread-safe; queries block at most
+    ``window_s`` beyond their own execution time."""
+
+    def __init__(self, window_s: float = 0.002, max_batch: int = 32,
+                 enabled="auto", stats=None):
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self.enabled = resolve_enabled(enabled)
+        self.stats = stats if stats is not None else _stats.NOP
+        self._lock = threading.Lock()
+        self._pending: dict[tuple, _Bucket] = {}
+
+    # ------------------------------------------------------------- entry
+
+    def eligible(self, opt) -> bool:
+        """Gate consulted by the executor's fused Count path — the
+        caller has already established fusion eligibility and
+        single-node execution."""
+        return self.enabled and (opt is None or opt.coalesce)
+
+    def count(self, executor, idx, child, shards: tuple[int, ...]) -> int:
+        """One Count(tree) query through the batching window -> total.
+        Staging runs on the CALLER's thread (fragment locks, and a
+        staging error belongs to this query alone)."""
+        shape, leaves = executor._fused_expr(idx, child, shards)
+        key = (idx.name, shape, shards)
+        fut: Future = Future()
+        t0 = time.perf_counter_ns()
+        with self._lock:
+            bucket = self._pending.get(key)
+            leader = bucket is None
+            if leader:
+                bucket = _Bucket()
+                self._pending[key] = bucket
+            bucket.items.append((leaves, fut))
+            if len(bucket.items) >= self.max_batch:
+                bucket.sealed = True
+                del self._pending[key]
+                bucket.full.set()
+        if leader:
+            bucket.full.wait(self.window_s)
+            with self._lock:
+                if not bucket.sealed:
+                    bucket.sealed = True
+                    del self._pending[key]
+            self._flush(shape, bucket)
+        counts = fut.result()
+        self.stats.timing("coalescer.query_ns",
+                          time.perf_counter_ns() - t0)
+        # leaf stacks are padded to the device multiple — sum only the
+        # live shard rows, in Python ints (int32 could wrap)
+        return int(np.asarray(counts, dtype=np.int64)[:len(shards)].sum())
+
+    # ------------------------------------------------------------- flush
+
+    def _flush(self, shape, bucket: _Bucket) -> None:
+        """Leader-side: ONE launch for the sealed bucket, results
+        scattered to every waiter.  Appends are impossible once sealed
+        (sealing happens under the same lock that guards appends).
+        EVERYTHING here runs inside the try: any failure — including
+        stats/tracing backends or the ops import — must resolve every
+        waiter's future, or followers would block forever."""
+        items = bucket.items
+        n = len(items)
+        try:
+            from pilosa_tpu.ops import expr
+
+            self.stats.count("coalescer.dispatches", 1)
+            self.stats.histogram("coalescer.batch_occupancy", n)
+            with tracing.start_span("coalescer.flush") as span:
+                span.set_tag("batch", n)
+                if n == 1:
+                    # single-query passthrough: the identical program
+                    # the un-coalesced path would run
+                    results = [expr.evaluate(shape, items[0][0],
+                                             counts=True)]
+                else:
+                    stacked = tuple(
+                        _stack([it[0][j] for it in items])
+                        for j in range(len(items[0][0])))
+                    counts = np.asarray(
+                        expr.evaluate(shape, stacked, counts=True),
+                        dtype=np.int64)
+                    results = [counts[b] for b in range(n)]
+        except BaseException as e:  # noqa: BLE001 — every waiter fails
+            for _, fut in items:
+                fut.set_exception(e)
+            return
+        for (_, fut), row in zip(items, results):
+            fut.set_result(row)
+
+
+def _stack(arrs: list):
+    """Stack one leaf slot across the batch -> [B, S, W].  numpy for
+    host stacks; jnp on device (one gather launch per leaf slot,
+    amortized over the B queries it serves)."""
+    if all(isinstance(a, np.ndarray) for a in arrs):
+        return np.stack(arrs)
+    import jax.numpy as jnp
+
+    return jnp.stack(arrs)

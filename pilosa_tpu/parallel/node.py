@@ -154,10 +154,7 @@ class ClusterNode:
             if f is None:
                 return {"ok": False, "error": "field not found"}
             if msg["cols"]:
-                from pilosa_tpu.shardwidth import SHARD_WIDTH
-
-                refuse = self._refuse_unowned_import(
-                    msg["index"], int(msg["cols"][0]) // SHARD_WIDTH)
+                refuse = self._gate_import_cols(msg["index"], msg["cols"])
                 if refuse is not None:
                     return refuse
             ts = msg.get("timestamps")
@@ -176,10 +173,7 @@ class ClusterNode:
             if f is None:
                 return {"ok": False, "error": "field not found"}
             if msg["cols"]:
-                from pilosa_tpu.shardwidth import SHARD_WIDTH
-
-                refuse = self._refuse_unowned_import(
-                    msg["index"], int(msg["cols"][0]) // SHARD_WIDTH)
+                refuse = self._gate_import_cols(msg["index"], msg["cols"])
                 if refuse is not None:
                     return refuse
             f.import_values(msg["cols"], msg["values"])
@@ -339,7 +333,9 @@ class ClusterNode:
             # and promise without entering (parallel/spmd.py)
             from pilosa_tpu.parallel import spmd
 
-            return spmd.prepare_collective(self, msg["index"], msg["query"])
+            return spmd.prepare_collective(
+                self, msg["index"], msg["query"],
+                row_gather_bytes=msg.get("rowGatherBytes"))
         elif t == "collective-execute":
             # join a coordinator-initiated SPMD collective query: every
             # process must enter the same program (parallel/spmd.py);
@@ -348,7 +344,9 @@ class ClusterNode:
             from pilosa_tpu.parallel import spmd
 
             try:
-                spmd.join_collective(self, msg["index"], msg["query"])
+                spmd.join_collective(
+                    self, msg["index"], msg["query"],
+                    row_gather_bytes=msg.get("rowGatherBytes"))
             except Exception as e:  # noqa: BLE001 — report, don't crash the bus
                 return {"ok": False, "error": repr(e)}
             return {"ok": True}
@@ -422,6 +420,27 @@ class ClusterNode:
                         "node": self.cluster.local_id,
                         "state": NODE_READY})
 
+    def _gate_import_cols(self, index: str, cols) -> dict | None:
+        """Ownership gate for import/import-value deliveries.  The
+        origin fan-out groups bits by shard before sending, so a
+        well-formed delivery is single-shard — but the gate used to
+        check only ``cols[0]``'s shard, which would let a malformed (or
+        stale-client) multi-shard payload slip bits for OTHER shards
+        past the ownership check.  Validate every column lands in the
+        first column's shard before consulting ownership at all."""
+        import numpy as np
+
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        shards = np.unique(
+            np.asarray(cols, dtype=np.int64) // SHARD_WIDTH)
+        if len(shards) != 1:
+            return {"ok": False,
+                    "error": f"import delivery spans shards "
+                             f"{[int(s) for s in shards[:8]]}; replica "
+                             f"deliveries must be single-shard"}
+        return self._refuse_unowned_import(index, int(shards[0]))
+
     def _refuse_unowned_import(self, index: str,
                                shard: int) -> dict | None:
         """Reference api.go ErrClusterDoesNotOwnShard: a replica
@@ -435,8 +454,11 @@ class ClusterNode:
             return None
         if self.cluster.owns_shard(self.cluster.local_id, index, shard):
             return None
+        from pilosa_tpu.parallel.cluster import UNOWNED_MARKER
+
         return {"ok": False, "unowned": True,
-                "error": f"does not own shard {shard}"}
+                "error": f"{UNOWNED_MARKER}: node does not own shard "
+                         f"{shard}"}
 
     def cleanup_unowned(self) -> None:
         """Delete local fragments for shards this node no longer owns

@@ -1004,11 +1004,17 @@ def try_collective(node, index_name: str, pql: str,
         peers = [n for n in cluster.sorted_nodes()
                  if n.id != cluster.local_id]
 
-        # phase 1: every peer validates and promises (synchronous)
+        # phase 1: every peer validates and promises (synchronous).
+        # The coordinator's MAX_ROW_GATHER_BYTES rides along: the value
+        # shapes the windowed-gather program, so env drift between SPMD
+        # processes would mean different programs — a silent hang.  A
+        # mismatching peer REFUSES here and the query falls back to the
+        # scatter plane instead.
         def prepare(n):
             r = node.cluster.transport.send_message(
                 n, {"type": "collective-prepare",
-                    "index": index_name, "query": pql})
+                    "index": index_name, "query": pql,
+                    "rowGatherBytes": MAX_ROW_GATHER_BYTES})
             if not r.get("ok"):
                 raise CollectiveError(
                     f"peer {n.id} refused: {r.get('error')}")
@@ -1028,7 +1034,8 @@ def try_collective(node, index_name: str, pql: str,
             try:
                 node.cluster.transport.send_message(
                     n, {"type": "collective-execute",
-                        "index": index_name, "query": pql})
+                        "index": index_name, "query": pql,
+                        "rowGatherBytes": MAX_ROW_GATHER_BYTES})
             except Exception:  # noqa: BLE001 — bounded by the runtime timeout
                 pass
 
@@ -1101,21 +1108,44 @@ def try_collective(node, index_name: str, pql: str,
         return [result]
 
 
-def prepare_collective(node, index_name: str, pql: str) -> dict:
+def _gather_bytes_mismatch(row_gather_bytes) -> str | None:
+    """Cross-process agreement check for the env-derived window bound.
+    MAX_ROW_GATHER_BYTES is read from the environment at import time
+    and drives collective program shape — if the coordinator's value
+    differs from ours, entering the collective would hang every
+    participant (different windowed-gather programs), so the mismatch
+    must surface as a loud refusal instead."""
+    if row_gather_bytes is None:  # pre-upgrade coordinator: no claim
+        return None
+    if int(row_gather_bytes) == MAX_ROW_GATHER_BYTES:
+        return None
+    return (f"row-gather-bytes mismatch: coordinator has "
+            f"{int(row_gather_bytes)}, this process has "
+            f"{MAX_ROW_GATHER_BYTES}; set "
+            f"PILOSA_TPU_MAX_ROW_GATHER_BYTES identically on every "
+            f"process")
+
+
+def prepare_collective(node, index_name: str, pql: str,
+                       row_gather_bytes=None) -> dict:
     """Peer-side prepare: validate without entering (no lock, no device
     work) and promise to join.  The query text arrives PRE-TRANSLATED
     by the coordinator (origin-only translation)."""
-    reason, _, _ = _check_collective(node, index_name, pql)
+    reason = _gather_bytes_mismatch(row_gather_bytes)
+    if reason is None:
+        reason, _, _ = _check_collective(node, index_name, pql)
     if reason is not None:
         return {"ok": False, "error": reason}
     return {"ok": True}
 
 
-def join_collective(node, index_name: str, pql: str) -> None:
+def join_collective(node, index_name: str, pql: str,
+                    row_gather_bytes=None) -> None:
     """Peer-side entry: re-validate (state may have moved since the
     promise), then run the same collective program; the replicated
     result is discarded (the coordinator answers the client)."""
-    reason, _, _ = _check_collective(node, index_name, pql)
+    reason = (_gather_bytes_mismatch(row_gather_bytes)
+              or _check_collective(node, index_name, pql)[0])
     if reason is not None:
         raise CollectiveError(reason)
     with _collective_lock:

@@ -16,14 +16,50 @@ from pilosa_tpu.pql.parser import parse as parse_python, ParseError
 _USE_NATIVE = _os.environ.get("PILOSA_TPU_NATIVE_PQL", "1") != "0"
 
 
-def parse(src: str) -> Query:
-    """Parse a PQL string into a Query (reference pql.ParseString)."""
+def _reject_internal(call, src: str) -> None:
+    """Refuse the executor's sentinel spellings (_Empty/_Noop/
+    _EmptyRows — or any underscore-prefixed call) outside remote
+    semantics: they are the key-translation layer's node-to-node wire
+    detail, not public query surface.  Trust boundary caveat: the
+    ``remote`` flag itself is client-asserted (the reference's model —
+    there is no peer authentication), so this gate keeps sentinels out
+    of the ORDINARY query surface and blocks accidental/naive use; a
+    client that deliberately asserts remote semantics also accepts
+    remote behavior (no translation, no cluster fan-out)."""
+    if call.name.startswith("_"):
+        raise ParseError(f"unknown call: {call.name}", src, 0)
+    for child in call.children:
+        _reject_internal(child, src)
+    # the grammar admits Call values under ANY argument key and inside
+    # list args (parser.item's nested-call branch), not just the
+    # GroupBy "filter" slot — walk them all, or a sentinel smuggled as
+    # e.g. Row(f=_Empty()) would slip the gate
+    for v in call.args.values():
+        if isinstance(v, Call):
+            _reject_internal(v, src)
+        elif isinstance(v, list):
+            for item in v:
+                if isinstance(item, Call):
+                    _reject_internal(item, src)
+
+
+def parse(src: str, allow_internal: bool = True) -> Query:
+    """Parse a PQL string into a Query (reference pql.ParseString).
+    ``allow_internal=False`` (the public, non-remote surface) rejects
+    underscore-prefixed call names uniformly across both parser
+    engines."""
+    q = None
     if _USE_NATIVE:
         from pilosa_tpu.pql import native
 
         if native.available():
-            return native.parse_native(src)
-    return parse_python(src)
+            q = native.parse_native(src)
+    if q is None:
+        q = parse_python(src)
+    if not allow_internal:
+        for call in q.calls:
+            _reject_internal(call, src)
+    return q
 
 
 __all__ = [

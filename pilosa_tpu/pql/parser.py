@@ -481,7 +481,11 @@ class _Parser:
 
 
 def parse(src: str) -> Query:
-    """Parse a PQL string into a Query (reference pql.ParseString)."""
+    """Parse a PQL string into a Query (reference pql.ParseString).
+    Both engines accept the full language including the executor's
+    underscore sentinels; the PUBLIC-surface rejection of sentinel
+    spellings is the single post-parse gate in pql.__init__
+    (_reject_internal) so it cannot drift between engines."""
     if "\x00" in src:
         # NUL would truncate at the native parser's C-string boundary;
         # reject uniformly so both parsers accept the identical language

@@ -203,7 +203,13 @@ class Handler:
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
-        self.httpd = ThreadingHTTPServer((host, port), _Req)
+        class _Srv(ThreadingHTTPServer):
+            # the stdlib default listen backlog of 5 drops/resets
+            # connections under a burst of concurrent clients — exactly
+            # the arrival pattern the query coalescer exists to serve
+            request_queue_size = 128
+
+        self.httpd = _Srv((host, port), _Req)
         # close() must not block on handler threads parked in idle
         # keep-alive reads (daemon threads die with the process; bounded
         # by the per-connection timeout otherwise)
@@ -433,6 +439,9 @@ class Handler:
                 column_attrs=column_attrs,
                 exclude_row_attrs=exclude_row_attrs,
                 exclude_columns=exclude_columns,
+                # ?nocoalesce=true: opt this request out of cross-query
+                # micro-batching (debugging / latency-sensitive callers)
+                coalesce=params.get("nocoalesce") != "true",
             )
         except Exception as e:
             if not proto_accept:

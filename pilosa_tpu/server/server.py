@@ -52,6 +52,9 @@ class Server:
         tracer=None,
         heap_profile: bool = False,
         heap_profile_frames: int = 4,
+        coalescer_enabled="auto",
+        coalescer_window_ms: float = 2.0,
+        coalescer_max_batch: int = 32,
     ):
         from pilosa_tpu import logger as _logger
         from pilosa_tpu import stats as _stats
@@ -96,6 +99,16 @@ class Server:
         self.node.executor.stats = self.stats
         self.node.executor.logger = self.logger
         self.node.executor.long_query_time = long_query_time
+        # cross-query micro-batched dispatch ([coalescer] config);
+        # "auto" resolves to on-accelerator-only
+        from pilosa_tpu.parallel.coalescer import Coalescer
+
+        self.node.executor.coalescer = Coalescer(
+            window_s=coalescer_window_ms / 1e3,
+            max_batch=coalescer_max_batch,
+            enabled=coalescer_enabled,
+            stats=self.stats,
+        )
         if coordinator:
             # statically designated coordinator (reference
             # cluster.coordinator config, server/config.go:104)

@@ -23,7 +23,12 @@ os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH_EXP", "16")
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices option; the
+    # xla_force_host_platform_device_count flag above covers it there
+    pass
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -435,13 +435,20 @@ class TestStaleViewImport:
         ClientError (handler maps ExecutionError to 400), NOT
         TransportError — the origin's retry matcher must recognize the
         string contract on ANY exception type."""
-        from pilosa_tpu.parallel.cluster import refusal_is_unowned
+        from pilosa_tpu.parallel.cluster import (
+            UNOWNED_MARKER, refusal_is_unowned)
         from pilosa_tpu.parallel.executor import UnownedShardError
         from pilosa_tpu.server.client import ClientError
 
         assert refusal_is_unowned(UnownedShardError(7))
         assert refusal_is_unowned(
-            ClientError(400, "does not own shard 7"))
+            ClientError(400, f"{UNOWNED_MARKER}: node does not own "
+                             f"shard 7"))
+        # unrelated errors that merely TALK about shard ownership must
+        # not be mistaken for the refusal contract (it would convert
+        # them into a silent 10 s convergence-retry loop)
+        assert not refusal_is_unowned(
+            ClientError(400, "node does not own shard 7"))
         assert not refusal_is_unowned(ClientError(400, "bad query"))
         assert not refusal_is_unowned(TransportError("connection refused"))
 

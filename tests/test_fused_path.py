@@ -72,14 +72,16 @@ class TestFusedEquivalence:
                 assert fused == general, q
 
     def test_fused_path_engages(self, ex):
+        # _fused_expr is the shared staging point of every fused path
+        # (Count stages directly; Row/TopN/GroupBy go via _fused_eval)
         calls = {"n": 0}
-        orig = ex._fused_eval
+        orig = ex._fused_expr
 
         def spy(idx, call, shards):
             calls["n"] += 1
             return orig(idx, call, shards)
 
-        ex._fused_eval = spy
+        ex._fused_expr = spy
         ex.execute("i", "Count(Intersect(Row(f0=1), Row(f1=2)))")
         assert calls["n"] > 0
 
@@ -263,13 +265,13 @@ class TestFusedEquivalence:
         api.import_bits("i", "f", [1] * len(cols), cols)
         hits = {n.cluster.local_id: 0 for n in nodes}
         for nd in nodes:
-            orig = nd.executor._fused_eval
+            orig = nd.executor._fused_expr
 
             def spy(idx, call, shards, _o=orig, _id=nd.cluster.local_id):
                 hits[_id] += 1
                 return _o(idx, call, shards)
 
-            nd.executor._fused_eval = spy
+            nd.executor._fused_expr = spy
         got = nodes[0].executor.execute("i", "Count(Row(f=1))")[0]
         assert got == len(cols)
         # the ORIGINATOR's local group must fuse (placement is

