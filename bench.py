@@ -350,7 +350,7 @@ def _bench_batched_and_floor(a, b, a_np: np.ndarray,
     return extras
 
 
-def bench_coalescer(a_np: np.ndarray, b_np: np.ndarray) -> dict | None:
+def bench_coalescer(a_np: np.ndarray, b_np: np.ndarray) -> tuple[dict, dict] | None:
     """Serving-path benchmark of the PRODUCT batching layer: concurrent
     `Count(Intersect(Row, Row))` PQL queries through the executor with
     the cross-query coalescer (parallel/coalescer.py) enabled — the
@@ -362,8 +362,16 @@ def bench_coalescer(a_np: np.ndarray, b_np: np.ndarray) -> dict | None:
     Bandwidth accounting credits only each query's own row stack (the
     shared filter's re-reads are not credited), so ``achieved_gbps_lower``
     is a LOWER bound and the >roof memoization flag stays valid.
-    Returns None under a non-default shard width (the index rows are
-    built for 2^20-column shards)."""
+
+    The load runs TWICE — query flight recorder enabled (the product
+    default) and disabled — so the artifact carries the recorder's
+    overhead on this exact coalesced Count path (the <1% budget of the
+    observe layer).  The headline coalescer numbers come from the
+    recorder-ENABLED run, the shipping configuration.
+
+    Returns (coalescer_extras, observe_extras), or None under a
+    non-default shard width (the index rows are built for 2^20-column
+    shards)."""
     import tempfile
     import threading
 
@@ -409,49 +417,96 @@ def bench_coalescer(a_np: np.ndarray, b_np: np.ndarray) -> dict | None:
                 f"expected {expects[v]}")
 
     THREADS = 16
-    done = [0] * THREADS
-    errs: list = []
+
+    def run_load(seconds: float) -> float:
+        done = [0] * THREADS
+        errs: list = []
+        t0 = time.perf_counter()
+        stop = t0 + seconds
+
+        def worker(t: int) -> None:
+            i = t
+            try:
+                while time.perf_counter() < stop:
+                    v = i % N_VAR
+                    got = int(ex.execute("i", qs[v])[0])
+                    if got != expects[v]:
+                        raise AssertionError(
+                            f"coalesced query returned {got}, "
+                            f"expected {expects[v]}")
+                    i += THREADS
+                    done[t] += 1
+            except BaseException as e:  # noqa: BLE001 — fail loudly
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(THREADS)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        elapsed = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        return sum(done) / elapsed
+
+    # Recorder on/off A/B as INTERLEAVED median windows: a sequential
+    # off-then-on pair confounds the delta with load drift on a busy
+    # host (observed swings of tens of percent between identical runs,
+    # far above any real recorder cost), while medians of alternating
+    # short windows see the same ambient load on both sides.
+    ex.recorder.stats = stats
+    offs, ons = [], []
+    for _ in range(3):
+        ex.recorder.enabled = False
+        offs.append(run_load(0.6))
+        ex.recorder.enabled = True
+        ons.append(run_load(0.6))
+    qps_off = sorted(offs)[1]
+    qps_on = sorted(ons)[1]
+    # The noise-free overhead figure: the recorder's own begin+publish
+    # cost per query (histogram observation included), measured
+    # directly — the note_* calls on the hot path are list appends and
+    # perf_counter reads, dwarfed by this pair.
+    from pilosa_tpu import observe as _observe
+
+    r = _observe.FlightRecorder(stats=_stats.MemStatsClient())
+    n_rec = 20000
     t0 = time.perf_counter()
-    stop = t0 + 1.5
+    for _ in range(n_rec):
+        r.publish(r.begin("i", "Count(Row(f=1))"))
+    record_cost_us = (time.perf_counter() - t0) / n_rec * 1e6
 
-    def worker(t: int) -> None:
-        i = t
-        try:
-            while time.perf_counter() < stop:
-                v = i % N_VAR
-                got = int(ex.execute("i", qs[v])[0])
-                if got != expects[v]:
-                    raise AssertionError(
-                        f"coalesced query returned {got}, "
-                        f"expected {expects[v]}")
-                i += THREADS
-                done[t] += 1
-        except BaseException as e:  # noqa: BLE001 — fail the bench loudly
-            errs.append(e)
-
-    threads = [threading.Thread(target=worker, args=(t,))
-               for t in range(THREADS)]
-    for th in threads:
-        th.start()
-    for th in threads:
-        th.join()
-    elapsed = time.perf_counter() - t0
-    if errs:
-        raise errs[0]
-    qps = sum(done) / elapsed
-    snap = stats.snapshot()
-    occ = snap.get("coalescer.batch_occupancy") or {}
+    # headline run, shipping configuration (recorder on); occupancy
+    # must describe the SAME window as the headline qps, so delta the
+    # histogram across this run only
+    occ0 = dict(stats.snapshot().get("coalescer.batch_occupancy") or {})
+    qps = run_load(1.5)
+    occ = stats.snapshot().get("coalescer.batch_occupancy") or {}
+    occ_sum = occ.get("sum", 0) - occ0.get("sum", 0)
+    occ_n = occ.get("count", 0) - occ0.get("count", 0)
     out = {
         "qps": round(qps, 2),
         "threads": THREADS,
         "window_ms": 2.0,
-        "queries_per_dispatch_mean": round(
-            occ.get("sum", 0) / max(1, occ.get("count", 1)), 2),
+        "queries_per_dispatch_mean": round(occ_sum / max(1, occ_n), 2),
         # each query's own 32 MiB row stack only — lower bound
         "achieved_gbps_lower": round(qps * a_np.nbytes / 1e9, 1),
     }
+    obs = {
+        "qps_recorder_on": round(qps_on, 2),
+        "qps_recorder_off": round(qps_off, 2),
+        # medians of interleaved windows; negative = within noise
+        "overhead_pct": round((qps_off - qps_on) / qps_off * 100.0, 2),
+        # per-query recorder cost as a share of the measured per-query
+        # service time — the number the <1% budget is judged on
+        "record_cost_us": round(record_cost_us, 2),
+        "record_cost_pct_of_query": round(
+            record_cost_us / (THREADS / qps * 1e6) * 100.0, 3),
+        "budget_pct": 1.0,
+    }
     holder.close()
-    return out
+    return out, obs
 
 
 def verify_product_path(a_np: np.ndarray, b_np: np.ndarray,
@@ -566,9 +621,12 @@ def main():
      extras) = bench_device(a, b)
     assert dev_count == cpu_count, f"bit-exactness violated: {dev_count} != {cpu_count}"
     verify_product_path(a, b, cpu_count)
-    co = bench_coalescer(a, b)
-    if co is not None:
+    co_obs = bench_coalescer(a, b)
+    co = None
+    if co_obs is not None:
+        co, obs = co_obs
         extras["coalescer"] = co
+        extras["observe"] = obs
     bytes_per_query = a.nbytes + b.nbytes  # streamed once per query
     achieved_gbps = dev_qps * bytes_per_query / 1e9
     peak = _peak_gbps(platform)

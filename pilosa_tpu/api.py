@@ -105,12 +105,41 @@ class API:
             # This check runs BEFORE the write-limit branch below, which
             # rebinds pql to a parsed Query and would otherwise make the
             # upgrade unreachable on config-launched servers.
+            from pilosa_tpu import observe as _observe
+            from pilosa_tpu import tracing as _tracing
             from pilosa_tpu.parallel import spmd
 
-            res = spmd.try_collective(self.node, index, pql,
-                                      exclude_row_attrs=exclude_row_attrs)
+            # the collective upgrade bypasses the executor, so its
+            # flight record is opened (and, when the upgrade declines,
+            # discarded) here — but only when a collective runtime
+            # exists at all: on the default single-node path the
+            # executor opens the one record, and a begin/discard pair
+            # here would double the recorder cost per query
+            recorder = getattr(self.executor, "recorder", None)
+            rec = None
+            if (recorder is not None and recorder.enabled
+                    and spmd.collective_available()):
+                rec = recorder.begin(index, pql,
+                                     trace_id=_tracing.active_trace_id())
+            try:
+                with _observe.attach(rec):
+                    res = spmd.try_collective(
+                        self.node, index, pql,
+                        exclude_row_attrs=exclude_row_attrs)
+            except BaseException as e:
+                if rec is not None:
+                    recorder.publish(rec,
+                                     error=f"{type(e).__name__}: {e}")
+                raise
             if res is not None:
+                if rec is not None:
+                    rec.note_path("collective")
+                    rec.result_sizes = [_observe.result_size(r)
+                                        for r in res]
+                    recorder.publish(rec)
                 return res
+            if rec is not None:
+                recorder.discard(rec)
         if self.max_writes_per_request > 0:
             from pilosa_tpu.pql import Query, parse as _parse
 

@@ -36,6 +36,10 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--replicas", type=int)
     ps.add_argument("--anti-entropy-interval", type=float)
     ps.add_argument("--heartbeat-interval", type=float)
+    ps.add_argument("--long-query-time", type=float,
+                    help="seconds; log queries slower than this with "
+                         "their profile breakdown ([observe] "
+                         "long-query-time; 0 disables)")
     ps.add_argument("--verbose", action="store_true")
 
     pi = sub.add_parser("import", help="bulk-import CSV bits")
@@ -111,6 +115,8 @@ def cmd_server(args) -> int:
         cfg.cluster.replicas = args.replicas
     if args.anti_entropy_interval is not None:
         cfg.anti_entropy.interval = args.anti_entropy_interval
+    if args.long_query_time is not None:
+        cfg.observe.long_query_time = args.long_query_time
     return run_server(cfg)
 
 
@@ -178,6 +184,9 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         coalescer_enabled=cfg.coalescer.enabled,
         coalescer_window_ms=cfg.coalescer.window_ms,
         coalescer_max_batch=cfg.coalescer.max_batch,
+        observe_enabled=cfg.observe.enabled,
+        observe_recent=cfg.observe.recent,
+        observe_long_query_time=cfg.observe.long_query_time,
         logger=log,
         stats=stats,
     )

@@ -89,6 +89,22 @@ class CoalescerConfig:
 
 
 @dataclass
+class ObserveConfig:
+    """[observe] — the query flight recorder (pilosa_tpu.observe; no
+    reference analog beyond ``cluster.long-query-time``).  ``enabled``
+    keeps the per-query record assembly on (sub-1% of the coalesced
+    Count path, benchmarked in bench.py extras.observe); ``recent`` is
+    the ring-buffer depth behind ``GET /debug/queries``;
+    ``long_query_time`` (seconds, 0 = off) logs PQL + trace id + the
+    stage breakdown for queries over the threshold — the reference's
+    LongQueryTime with a profile attached."""
+
+    enabled: bool = True
+    recent: int = 256
+    long_query_time: float = 0.0  # seconds; 0 disables slow-query log
+
+
+@dataclass
 class TLSConfig:
     """[tls] (server/tlsconfig.go; config server/config.go:58-66)."""
 
@@ -116,6 +132,7 @@ class Config:
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
     coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
+    observe: ObserveConfig = field(default_factory=ObserveConfig)
 
     # ------------------------------------------------------------- access
 
@@ -151,7 +168,8 @@ class Config:
         for k, v in d.items():
             key = k.replace("-", "_")
             if key in ("cluster", "anti_entropy", "metric", "tracing",
-                       "profile", "tls", "coalescer") and isinstance(v, dict):
+                       "profile", "tls", "coalescer",
+                       "observe") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -164,7 +182,8 @@ class Config:
                                                         TracingConfig,
                                                         ProfileConfig,
                                                         TLSConfig,
-                                                        CoalescerConfig)):
+                                                        CoalescerConfig,
+                                                        ObserveConfig)):
                 setattr(self, key, v)
 
     def _apply_env(self, env: dict) -> None:
@@ -172,7 +191,7 @@ class Config:
         (the reference's PILOSA_* envs, cmd/root.go:94)."""
         for f in fields(self):
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
-                          "profile", "tls", "coalescer"):
+                          "profile", "tls", "coalescer", "observe"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -228,6 +247,11 @@ class Config:
             f'enabled = "{self.coalescer.enabled}"',
             f"window-ms = {self.coalescer.window_ms}",
             f"max-batch = {self.coalescer.max_batch}",
+            "",
+            "[observe]",
+            f"enabled = {str(self.observe.enabled).lower()}",
+            f"recent = {self.observe.recent}",
+            f"long-query-time = {self.observe.long_query_time}",
             "",
             "[tls]",
             f'certificate-path = "{self.tls.certificate_path}"',

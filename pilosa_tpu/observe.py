@@ -1,0 +1,293 @@
+"""Query flight recorder: per-query telemetry for the dispatch-bound
+serving path.
+
+The round-5 verdict's last big unknown is the dispatch window — the
+committed chip number understates the engine ~5.6x — yet process-wide
+stats (count/sum/min/max) cannot attribute latency to a QUERY.  This
+module holds one ``QueryRecord`` per in-flight query: stage timings at
+the executor's map/reduce boundaries, per-shard and per-node map
+timings, the device-launch count from the ``ops/bitmap.py`` dispatch
+hook, coalescer batch occupancy and queue-wait vs launch split, the
+fused-vs-fallback expression path, and result sizes — the per-stage
+timing discipline DrJAX (arxiv 2403.07128) and Ragged Paged Attention
+(arxiv 2604.15464) use to diagnose TPU dispatch overhead, applied to
+the reference's map-reduce executor (executor.go:2455).
+
+Exposure (server/handler.py):
+
+- ``GET /debug/queries`` — active-query table + ring buffer of recent
+  records (``?sort=``/``?min_ms=``).
+- ``?profile=1`` on ``POST /index/{index}/query`` — the breakdown
+  inline in the response.
+- slow-query log — ``[observe] long_query_time`` (config.py), logging
+  PQL + trace id + breakdown (the reference's ``LongQueryTime``,
+  api.go:1157, with a breakdown attached).
+
+Lock discipline: the record is assembled THREAD-LOCALLY (``attach``
+installs it on worker threads for the duration of one shard's
+evaluation; list appends are GIL-atomic) — no lock on the per-stage /
+per-launch hot path.  The recorder's own lock is touched once at
+begin and once at publish (keeping the active table and ring buffer
+safely iterable from /debug/queries), plus the stats registry's on
+the latency-histogram observation.  The recorder must stay under 1%
+of the coalesced Count path — benchmarked by ``bench.py``
+(extras.observe).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import Counter, deque
+
+_tls = threading.local()  # .rec: active QueryRecord; .last: last published
+
+#: PQL longer than this is truncated in records (a query string is
+#: operator-facing debug data, not an archive).
+MAX_PQL = 2048
+
+#: Detail-list caps: the ring buffer pins `recent` finished records,
+#: so a 10k-shard per-shard-path query must not make each record
+#: hundreds of KB.  Per-shard timings keep the first MAX_SHARD_TIMINGS
+#: entries (shards_n still reports the true fan-out); launch names cap
+#: at MAX_LAUNCHES — far above any real query, so deviceLaunches stays
+#: exact everywhere the regression tests pin it, while a pathological
+#: loop cannot grow a record without bound.
+MAX_SHARD_TIMINGS = 4096
+MAX_LAUNCHES = 65536
+
+
+def current() -> "QueryRecord | None":
+    """The query record being assembled on THIS thread, or None.  The
+    executor's map wrappers re-``attach`` it on pool workers, so shard
+    evaluations tick the right record."""
+    return getattr(_tls, "rec", None)
+
+
+class attach:
+    """Install a record (or None) as this thread's active record for a
+    scope.  Re-entrant: restores whatever was active before, so a
+    remote re-execution beginning its OWN record inside an IO thread
+    shadows rather than clobbers."""
+
+    __slots__ = ("rec", "_prev")
+
+    def __init__(self, rec: "QueryRecord | None"):
+        self.rec = rec
+
+    def __enter__(self):
+        self._prev = getattr(_tls, "rec", None)
+        _tls.rec = self.rec
+        return self.rec
+
+    def __exit__(self, *exc):
+        _tls.rec = self._prev
+        return False
+
+
+def take_last() -> "QueryRecord | None":
+    """Pop the record most recently PUBLISHED on this thread (the
+    ``?profile=1`` handoff: the handler thread that ran the query reads
+    its own record back).  Clears on read so a bypassed execution (the
+    SPMD collective path publishes its own record; a parse error
+    publishes none) can never serve a stale profile."""
+    rec = getattr(_tls, "last", None)
+    _tls.last = None
+    return rec
+
+
+def result_size(res) -> int:
+    """Cheap size proxy for one query result: list length, populated
+    shard-segment count for Row-shaped results (duck-typed on
+    ``.segments`` — materializing columns just to count them would cost
+    more than the query), 1 for scalars.  Never raises."""
+    if isinstance(res, list):
+        return len(res)
+    segments = getattr(res, "segments", None)
+    if segments is not None:
+        try:
+            return len(segments)
+        except TypeError:
+            return 1
+    return 1
+
+
+class QueryRecord:
+    """One query's telemetry, assembled lock-free on the threads that
+    execute it.  ``launches`` is a list (not an int) because list
+    appends are GIL-atomic while ``+= 1`` is a read-modify-write race
+    across map workers — and the launch NAMES are the breakdown."""
+
+    __slots__ = (
+        "qid", "trace_id", "index", "pql", "start_unix", "t0_ns",
+        "elapsed_ns", "shards_n", "stages", "shard_ns", "node_ns",
+        "launches", "path", "coalesce", "result_sizes", "error", "slow",
+    )
+
+    def __init__(self, qid: int, index: str, pql: str,
+                 trace_id: str | None = None):
+        self.qid = qid
+        self.index = index
+        self.pql = pql[:MAX_PQL]
+        now_ns = time.time_ns()
+        self.trace_id = trace_id or f"{now_ns:016x}{qid & 0xFFFF:04x}"
+        self.start_unix = now_ns / 1e9
+        self.t0_ns = time.perf_counter_ns()
+        self.elapsed_ns: int | None = None  # None while in flight
+        self.shards_n = 0
+        self.stages: list[tuple[str, int]] = []       # (name, ns)
+        self.shard_ns: list[tuple[int, int]] = []     # (shard, ns)
+        self.node_ns: list[tuple[str, int, int]] = [] # (node, ns, n_shards)
+        self.launches: list[str] = []
+        self.path: str | None = None  # fused|per-shard|coalesced|collective
+        self.coalesce: dict | None = None
+        self.result_sizes: list[int] = []
+        self.error: str | None = None
+        self.slow = False
+
+    # ------------------------------------------------------------ notes
+
+    def note_stage(self, name: str, ns: int) -> None:
+        self.stages.append((name, ns))
+
+    def note_launch(self, name: str) -> None:
+        """One kernel launch (called from ops/bitmap.note_dispatch).
+        List append is GIL-atomic; the len guard may overshoot the cap
+        by a few concurrent appends, which only bounds memory, never
+        undercounts below the cap."""
+        if len(self.launches) < MAX_LAUNCHES:
+            self.launches.append(name)
+
+    def note_shard(self, shard: int, ns: int) -> None:
+        if len(self.shard_ns) < MAX_SHARD_TIMINGS:
+            self.shard_ns.append((shard, ns))
+
+    def note_node(self, node: str, ns: int, n_shards: int) -> None:
+        self.node_ns.append((node, ns, n_shards))
+
+    def note_shards(self, n: int) -> None:
+        if n > self.shards_n:
+            self.shards_n = n
+
+    def note_path(self, path: str) -> None:
+        self.path = path
+
+    # ----------------------------------------------------------- export
+
+    def elapsed_live_ns(self) -> int:
+        """Elapsed so far (in-flight) or final elapsed (published)."""
+        if self.elapsed_ns is not None:
+            return self.elapsed_ns
+        return time.perf_counter_ns() - self.t0_ns
+
+    def to_dict(self) -> dict:
+        ms = 1e6
+        d = {
+            "id": self.qid,
+            "traceID": self.trace_id,
+            "index": self.index,
+            "pql": self.pql,
+            "startTime": self.start_unix,
+            "elapsedMs": round(self.elapsed_live_ns() / ms, 3),
+            "active": self.elapsed_ns is None,
+            "shards": self.shards_n,
+            "stages": [{"name": n, "ms": round(v / ms, 3)}
+                       for n, v in self.stages],
+            "shardTimings": [{"shard": s, "ms": round(v / ms, 3)}
+                             for s, v in self.shard_ns],
+            "nodeTimings": [{"node": n, "ms": round(v / ms, 3),
+                             "shards": k}
+                            for n, v, k in self.node_ns],
+            "deviceLaunches": len(self.launches),
+            "launchKinds": dict(Counter(self.launches)),
+            "resultSizes": list(self.result_sizes),
+        }
+        if len(self.shard_ns) >= MAX_SHARD_TIMINGS:
+            d["shardTimingsTruncated"] = True
+        if self.path is not None:
+            d["path"] = self.path
+        if self.coalesce is not None:
+            c = self.coalesce
+            d["coalescer"] = {
+                "batch": c["batch"],
+                "queueWaitMs": round(c["queue_wait_ns"] / ms, 3),
+                "launchMs": round(c["launch_ns"] / ms, 3),
+                "leader": c.get("leader", True),
+            }
+        if self.error is not None:
+            d["error"] = self.error
+        if self.slow:
+            d["slow"] = True
+        return d
+
+
+class FlightRecorder:
+    """Active-query table + ring buffer of recent records.
+
+    One per executor (the server wires config + logger + stats in).
+    Record ASSEMBLY (the note_* calls on the hot path) is lock-free;
+    the recorder's own lock is touched once per query transition
+    (begin/publish) to keep the active table and ring buffer safely
+    iterable from /debug/queries while queries publish."""
+
+    def __init__(self, recent: int = 256, long_query_time: float = 0.0,
+                 enabled: bool = True, logger=None, stats=None):
+        self.enabled = enabled
+        self.long_query_time = long_query_time  # seconds; 0 = log off
+        self.logger = logger
+        self.stats = stats
+        self._seq = itertools.count(1)  # next() is atomic
+        self._lock = threading.Lock()
+        self._active: dict[int, QueryRecord] = {}
+        self._recent: deque[QueryRecord] = deque(maxlen=recent)
+
+    # ----------------------------------------------------------- record
+
+    def begin(self, index: str, pql: str,
+              trace_id: str | None = None) -> QueryRecord:
+        rec = QueryRecord(next(self._seq), index, pql, trace_id)
+        with self._lock:
+            self._active[rec.qid] = rec
+        return rec
+
+    def discard(self, rec: QueryRecord) -> None:
+        """Drop an active record without publishing (a path that turned
+        out not to execute, e.g. the collective upgrade declining)."""
+        with self._lock:
+            self._active.pop(rec.qid, None)
+
+    def publish(self, rec: QueryRecord, error: str | None = None) -> None:
+        rec.elapsed_ns = time.perf_counter_ns() - rec.t0_ns
+        if error is not None:
+            rec.error = error
+        elapsed_s = rec.elapsed_ns / 1e9
+        if self.long_query_time > 0 and elapsed_s > self.long_query_time:
+            rec.slow = True
+        with self._lock:
+            self._active.pop(rec.qid, None)
+            self._recent.append(rec)
+        _tls.last = rec
+        if self.stats is not None:
+            # the /metrics + /debug/vars surface: a native Prometheus
+            # histogram with this query's trace id as the bucket
+            # exemplar (stats._Registry)
+            self.stats.histogram("pilosa_query_latency", elapsed_s,
+                                 exemplar=rec.trace_id)
+        if rec.slow and self.logger is not None:
+            self.logger.printf(
+                "slow query (%.3fs) trace=%s on %s: %s | stages=%s "
+                "shards=%d launches=%d path=%s",
+                elapsed_s, rec.trace_id, rec.index, rec.pql,
+                ",".join(f"{n}:{v / 1e6:.1f}ms" for n, v in rec.stages),
+                rec.shards_n, len(rec.launches), rec.path or "-")
+
+    # ------------------------------------------------------------- views
+
+    def active_records(self) -> list[QueryRecord]:
+        with self._lock:
+            return list(self._active.values())
+
+    def recent_records(self) -> list[QueryRecord]:
+        with self._lock:
+            return list(self._recent)

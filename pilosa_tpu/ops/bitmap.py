@@ -32,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from pilosa_tpu import observe as _observe
+
 WORD_BITS = 32
 _WORD_DTYPE = np.uint32
 
@@ -51,10 +53,16 @@ _dispatch = threading.local()  # .log: list[str] while a counter is active
 
 def note_dispatch(name: str) -> None:
     """Record one kernel launch on this thread (no-op unless a
-    dispatch_counter is active on it)."""
+    dispatch_counter — or a query flight record, pilosa_tpu.observe —
+    is active on it).  The flight recorder reuses THIS hook so a
+    query's profiled device-launch count is the dispatch-count the
+    regression tests pin, by construction."""
     log = getattr(_dispatch, "log", None)
     if log is not None:
         log.append(name)
+    rec = _observe.current()
+    if rec is not None:
+        rec.note_launch(name)
 
 
 class dispatch_counter:

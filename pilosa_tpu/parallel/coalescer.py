@@ -43,6 +43,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from pilosa_tpu import observe as _observe
 from pilosa_tpu import stats as _stats
 from pilosa_tpu import tracing
 
@@ -68,12 +69,20 @@ def resolve_enabled(mode) -> bool:
 
 
 class _Bucket:
-    __slots__ = ("items", "full", "sealed")
+    __slots__ = ("items", "full", "sealed",
+                 "n_final", "flush_t0", "launch_ns")
 
     def __init__(self):
         self.items: list[tuple[tuple, Future]] = []  # (leaves, future)
         self.full = threading.Event()
         self.sealed = False
+        # flight-recorder breakdown, written by the leader BEFORE the
+        # futures resolve (so every waiter may read them after
+        # fut.result() without a lock): final batch occupancy, flush
+        # start (perf_counter_ns), and device-launch duration
+        self.n_final = 0
+        self.flush_t0 = 0
+        self.launch_ns = 0
 
 
 class Coalescer:
@@ -126,6 +135,22 @@ class Coalescer:
         counts = fut.result()
         self.stats.timing("coalescer.query_ns",
                           time.perf_counter_ns() - t0)
+        rec = _observe.current()
+        if rec is not None:
+            # bucket fields are final once fut resolved (leader writes
+            # them before scattering results).  The batch's shared
+            # launch ticks the LEADER's deviceLaunches only (the hook
+            # is thread-local and honest — a follower never dispatched
+            # anything); followers carry the launch evidence here, in
+            # the batch context, with ``leader`` saying which record
+            # owns the tick.
+            rec.note_path("coalesced")
+            rec.coalesce = {
+                "batch": bucket.n_final,
+                "queue_wait_ns": max(0, bucket.flush_t0 - t0),
+                "launch_ns": bucket.launch_ns,
+                "leader": leader,
+            }
         # leaf stacks are padded to the device multiple — sum only the
         # live shard rows, in Python ints (int32 could wrap)
         return int(np.asarray(counts, dtype=np.int64)[:len(shards)].sum())
@@ -141,6 +166,8 @@ class Coalescer:
         waiter's future, or followers would block forever."""
         items = bucket.items
         n = len(items)
+        bucket.n_final = n
+        bucket.flush_t0 = time.perf_counter_ns()
         try:
             from pilosa_tpu.ops import expr
 
@@ -148,6 +175,7 @@ class Coalescer:
             self.stats.histogram("coalescer.batch_occupancy", n)
             with tracing.start_span("coalescer.flush") as span:
                 span.set_tag("batch", n)
+                t_launch = time.perf_counter_ns()
                 if n == 1:
                     # single-query passthrough: the identical program
                     # the un-coalesced path would run
@@ -161,6 +189,9 @@ class Coalescer:
                         expr.evaluate(shape, stacked, counts=True),
                         dtype=np.int64)
                     results = [counts[b] for b in range(n)]
+                bucket.launch_ns = time.perf_counter_ns() - t_launch
+                self.stats.timing("coalescer.launch_ns",
+                                  bucket.launch_ns)
         except BaseException as e:  # noqa: BLE001 — every waiter fails
             for _, fut in items:
                 fut.set_exception(e)

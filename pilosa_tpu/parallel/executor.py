@@ -21,6 +21,7 @@ import bisect
 import datetime as _dt
 import heapq
 import threading
+import time as _time
 from concurrent.futures import (
     FIRST_COMPLETED,
     Future,
@@ -46,6 +47,7 @@ from pilosa_tpu.parallel.results import (
 )
 from pilosa_tpu.pql import Call, Query, parse
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu import observe as _observe
 from pilosa_tpu import stats as _stats
 from pilosa_tpu import tracing
 
@@ -111,6 +113,9 @@ class Executor:
         # optional cross-query micro-batcher (parallel/coalescer.py),
         # injected by the server assembly; None = no coalescing
         self.coalescer = None
+        # query flight recorder (pilosa_tpu.observe); the server
+        # assembly replaces this with one carrying config/logger/stats
+        self.recorder = _observe.FlightRecorder()
         # pool size defaults to CPU count (reference worker pool =
         # NumCPU, executor.go:80-104)
         import os as _os
@@ -124,6 +129,7 @@ class Executor:
         """Execute a PQL query string or Query -> list of results
         (reference executor.Execute, executor.go:113)."""
         opt = opt or ExecOptions()
+        raw_query = query
         if isinstance(query, str):
             # sentinel call spellings (_Empty/_Noop/_EmptyRows) only
             # parse with remote semantics: they are the translation
@@ -134,32 +140,71 @@ class Executor:
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
-        import time as _time
-
+        rec = None
+        if self.recorder is not None and self.recorder.enabled:
+            # str() on a parsed Query re-serializes the AST — only pay
+            # it when a record is actually being assembled
+            pql_text = (raw_query if isinstance(raw_query, str)
+                        else str(raw_query))
+            rec = self.recorder.begin(index_name, pql_text,
+                                      trace_id=tracing.active_trace_id())
         t0 = _time.perf_counter()
-        with tracing.start_span("executor.Execute") as span:
-            span.set_tag("index", index_name)
-            # Key translation happens once at the originating node, never on
-            # remote re-execution (reference executor.Execute, executor.go:146).
-            calls = query.calls
-            if not opt.remote:
-                calls = [self._translate_call(idx, c) for c in calls]
-            results = []
-            for call in calls:
-                self.stats.count_with_tags(
-                    "query", 1, 1.0, [f"index:{index_name}",
-                                      f"call:{call.name}"])
-                # per-op latency via the shared timing surface
-                # (exception-safe: failed calls record too)
-                with _stats.Timer(self.stats, f"execute.{call.name}"), \
-                        tracing.start_span(
-                            f"executor.execute{call.name}", span):
-                    results.append(self._execute_call(idx, call, shards, opt))
-            if not opt.remote:
-                results = [
-                    self._translate_result(idx, call, res)
-                    for call, res in zip(calls, results)
-                ]
+        try:
+            with _observe.attach(rec), \
+                    tracing.start_span("executor.Execute") as span:
+                span.set_tag("index", index_name)
+                if rec is not None:
+                    # span -> record linkage: the record carries the
+                    # exported trace id, the span the record id
+                    if span.trace_id:
+                        rec.trace_id = span.trace_id
+                    span.set_tag("query.record", rec.qid)
+                # Key translation happens once at the originating node,
+                # never on remote re-execution (reference
+                # executor.Execute, executor.go:146).
+                calls = query.calls
+                if not opt.remote:
+                    ts = _time.perf_counter_ns()
+                    calls = [self._translate_call(idx, c) for c in calls]
+                    if rec is not None:
+                        rec.note_stage("translate",
+                                       _time.perf_counter_ns() - ts)
+                results = []
+                for call in calls:
+                    self.stats.count_with_tags(
+                        "query", 1, 1.0, [f"index:{index_name}",
+                                          f"call:{call.name}"])
+                    # per-op latency via the shared timing surface
+                    # (exception-safe: failed calls record too)
+                    tc = _time.perf_counter_ns()
+                    try:
+                        with _stats.Timer(self.stats,
+                                          f"execute.{call.name}"), \
+                                tracing.start_span(
+                                    f"executor.execute{call.name}", span):
+                            results.append(
+                                self._execute_call(idx, call, shards, opt))
+                    finally:
+                        if rec is not None:
+                            rec.note_stage(f"execute.{call.name}",
+                                           _time.perf_counter_ns() - tc)
+                if not opt.remote:
+                    ts = _time.perf_counter_ns()
+                    results = [
+                        self._translate_result(idx, call, res)
+                        for call, res in zip(calls, results)
+                    ]
+                    if rec is not None:
+                        rec.note_stage("translateResults",
+                                       _time.perf_counter_ns() - ts)
+        except BaseException as e:
+            if rec is not None:
+                self.recorder.publish(rec,
+                                      error=f"{type(e).__name__}: {e}")
+            raise
+        if rec is not None:
+            rec.result_sizes = [_observe.result_size(r) for r in results]
+            self.recorder.publish(rec)
         elapsed = _time.perf_counter() - t0
         if (self.long_query_time > 0 and elapsed > self.long_query_time
                 and self.logger is not None):
@@ -212,10 +257,17 @@ class Executor:
 
     def _target_shards(self, idx, shards, opt: ExecOptions) -> list[int]:
         if opt.shards is not None:
-            return sorted(opt.shards)
-        if shards is not None:
-            return sorted(shards)
-        return sorted(idx.available_shards())
+            out = sorted(opt.shards)
+        elif shards is not None:
+            out = sorted(shards)
+        else:
+            out = sorted(idx.available_shards())
+        rec = _observe.current()
+        if rec is not None:
+            # the chokepoint every op's shard resolution passes through:
+            # record the query's fan-out (max across calls)
+            rec.note_shards(len(out))
+        return out
 
     def _cluster_active(self, opt: ExecOptions | None) -> bool:
         return (
@@ -254,6 +306,19 @@ class Executor:
         return fut
 
     def _local_map(self, fn, shards):
+        rec = _observe.current()
+        if rec is not None:
+            # re-attach the flight record on the pool workers so their
+            # kernel launches tick it, and time each shard's evaluation
+            inner = fn
+
+            def fn(shard, _inner=inner, _rec=rec):
+                t0 = _time.perf_counter_ns()
+                with _observe.attach(_rec):
+                    out = _inner(shard)
+                _rec.note_shard(shard, _time.perf_counter_ns() - t0)
+                return out
+
         if len(shards) <= 1:
             return [fn(s) for s in shards]
         return list(self.pool.map(fn, shards))
@@ -272,6 +337,21 @@ class Executor:
         per-shard pool for the locally-owned group when the call has a
         fused all-shard evaluation (remote nodes fuse on their own side,
         since remote re-execution is non-clustered)."""
+        rec = _observe.current()
+        t_map = _time.perf_counter_ns() if rec is not None else 0
+        try:
+            return self._map_shards_inner(
+                fn, shards, idx, call, opt, adapt, remote_call,
+                local_batch_fn, rec)
+        finally:
+            if rec is not None:
+                # the map stage boundary (reference mapReduce,
+                # executor.go:2455); the enclosing execute.<Call> stage
+                # minus this is the reduce side
+                rec.note_stage("map", _time.perf_counter_ns() - t_map)
+
+    def _map_shards_inner(self, fn, shards, idx, call, opt, adapt,
+                          remote_call, local_batch_fn, rec):
         if not (self._cluster_active(opt) and idx is not None and call is not None
                 and adapt is not None):
             return self._local_map(fn, shards)
@@ -280,7 +360,7 @@ class Executor:
         partials = []
         tried: dict[int, set] = {s: set() for s in shards}
         pending = cluster.shards_by_node(idx.name, shards)
-        inflight: dict = {}  # future -> (node_id, node_shards)
+        inflight: dict = {}  # future -> (node_id, node_shards, t_submit)
         while pending or inflight:
             # fan out every remote group concurrently, then run local
             # shards inline while the remotes are in flight — distributed
@@ -292,18 +372,24 @@ class Executor:
                     cluster.transport.query_node,
                     cluster.node(node_id), idx.name, pql, node_shards,
                 )
-                inflight[fut] = (node_id, node_shards)
+                inflight[fut] = (node_id, node_shards,
+                                 _time.perf_counter_ns())
             if cluster.local_id in pending:
                 local_shards = pending.pop(cluster.local_id)
+                t_loc = _time.perf_counter_ns()
                 if local_batch_fn is not None and len(local_shards) > 1:
                     partials.extend(local_batch_fn(local_shards))
                 else:
                     partials.extend(self._local_map(fn, local_shards))
+                if rec is not None:
+                    rec.note_node("local",
+                                  _time.perf_counter_ns() - t_loc,
+                                  len(local_shards))
             if not inflight:
                 continue
             done, _ = futures_wait(list(inflight), return_when=FIRST_COMPLETED)
             for fut in done:
-                node_id, node_shards = inflight.pop(fut)
+                node_id, node_shards, t_sub = inflight.pop(fut)
                 try:
                     res = fut.result()
                 except TransportError:
@@ -316,6 +402,10 @@ class Executor:
                             )
                         pending.setdefault(nxt.id, []).append(s)
                     continue
+                if rec is not None:
+                    rec.note_node(node_id,
+                                  _time.perf_counter_ns() - t_sub,
+                                  len(node_shards))
                 partials.extend(adapt(res[0]))
         return partials
 
@@ -518,8 +608,14 @@ class Executor:
             return [(s, stack[i].copy())
                     for i, s in enumerate(group) if stack[i].any()]
 
+        rec = _observe.current()
+        if rec is not None:
+            rec.note_path("fused" if fused_ok else "per-shard")
         if fused_ok and not self._cluster_active(opt):
+            t_f = _time.perf_counter_ns()
             partials = batch_fn(shards)
+            if rec is not None:
+                rec.note_stage("map.fused", _time.perf_counter_ns() - t_f)
         else:
             def map_fn(shard):
                 return shard, self._bitmap_words_shard(idx, call, shard)
@@ -707,12 +803,21 @@ class Executor:
             return [int(c) for c in
                     np.asarray(counts, dtype=np.int64)[:len(group)]]
 
+        rec = _observe.current()
+        if rec is not None:
+            rec.note_path("fused" if fused_ok else "per-shard")
         if fused_ok and not self._cluster_active(opt):
             if (self.coalescer is not None
                     and self.coalescer.eligible(opt)):
+                # the coalescer stamps the record itself (path,
+                # batch occupancy, queue-wait vs launch split)
                 return self.coalescer.count(self, idx, child,
                                             tuple(shards))
-            return sum(batch_fn(shards))
+            t_f = _time.perf_counter_ns()
+            total = sum(batch_fn(shards))
+            if rec is not None:
+                rec.note_stage("map.fused", _time.perf_counter_ns() - t_f)
+            return total
 
         def map_fn(shard):
             words = self._bitmap_words_shard(idx, child, shard)

@@ -227,6 +227,10 @@ class Handler:
         self.port = self.httpd.server_address[1]
         self.host = host
         self._thread: threading.Thread | None = None
+        # /debug/pprof/profile serialization: a second concurrent
+        # sampler would double-count stacks and burn CPU for up to 30 s
+        # while holding an HTTP worker thread; try-lock -> 409
+        self._profile_lock = threading.Lock()
 
     @property
     def uri(self) -> str:
@@ -433,6 +437,15 @@ class Handler:
             exclude_columns = params.get("excludeColumns") == "true"
         if params.get("shards"):
             shards = [int(s) for s in params["shards"].split(",")]
+        # ?profile=1: attach this query's flight-recorder breakdown to
+        # the JSON response (protobuf responses have no profile slot).
+        # Clear this thread's last-published record FIRST so a bypassed
+        # execution can never serve a stale profile.
+        profile = params.get("profile") == "1"
+        if profile:
+            from pilosa_tpu import observe
+
+            observe.take_last()
         try:
             results = self.api.query(
                 path["index"], pql, shards=shards, remote=remote,
@@ -478,6 +491,11 @@ class Handler:
         resp = {"results": [serialize_result(r) for r in results]}
         if attr_sets is not None:
             resp["columnAttrs"] = attr_sets
+        if profile:
+            from pilosa_tpu import observe
+
+            rec = observe.take_last()
+            resp["profile"] = rec.to_dict() if rec is not None else None
         self._json(req, resp)
 
     def _import_ok(self, req) -> None:
@@ -710,9 +728,18 @@ class Handler:
 
     @route("GET", "/metrics")
     def handle_metrics(self, req, params, path, body):
-        """Prometheus text exposition (http/handler.go:282)."""
+        """Prometheus text exposition (http/handler.go:282).
+
+        Trace-id exemplars on histogram buckets are an OpenMetrics
+        feature the legacy 0.0.4 parser rejects, so they render only on
+        explicit request (``?exemplars=1`` — operators and tooling);
+        the scrape default stays a clean 0.0.4 exposition a stock
+        Prometheus accepts.  (Deliberately NOT keyed on the Accept
+        header: modern Prometheus offers openmetrics-text by default,
+        and this exposition is 0.0.4-shaped, not fully OpenMetrics.)"""
+        exemplars = params.get("exemplars") == "1"
         if self.stats is not None and hasattr(self.stats, "prometheus_text"):
-            text = self.stats.prometheus_text()
+            text = self.stats.prometheus_text(exemplars=exemplars)
         else:
             text = ""
         # Snapshot-queue health is process-wide (the queue is shared by
@@ -825,26 +852,68 @@ class Handler:
         if not math.isfinite(seconds):  # nan/inf defeat the clamp
             raise ApiError("invalid seconds parameter")
         seconds = min(max(seconds, 0.1), 30.0)
-        interval = 0.01
-        me = threading.get_ident()
-        counts: Counter = Counter()
-        deadline = _time.monotonic() + seconds
-        while _time.monotonic() < deadline:
-            for ident, frame in sys._current_frames().items():
-                if ident == me:
-                    continue  # the sampler itself is noise
-                stack = []
-                f = frame
-                while f is not None:
-                    code = f.f_code
-                    stack.append(f"{code.co_filename.rsplit('/', 1)[-1]}:"
-                                 f"{code.co_name}")
-                    f = f.f_back
-                counts[";".join(reversed(stack))] += 1
-            _time.sleep(interval)
-        out = "\n".join(f"{stack} {n}"
-                        for stack, n in counts.most_common())
+        # one sampler at a time: concurrent samplers double-count each
+        # other's stacks and pin CPU for the full window while holding
+        # HTTP worker threads; a busy signal beats a corrupt profile
+        if not self._profile_lock.acquire(blocking=False):
+            self._error(req, 409, "a profile is already running")
+            return
+        try:
+            interval = 0.01
+            me = threading.get_ident()
+            counts: Counter = Counter()
+            deadline = _time.monotonic() + seconds
+            while _time.monotonic() < deadline:
+                for ident, frame in sys._current_frames().items():
+                    if ident == me:
+                        continue  # the sampler itself is noise
+                    stack = []
+                    f = frame
+                    while f is not None:
+                        code = f.f_code
+                        stack.append(
+                            f"{code.co_filename.rsplit('/', 1)[-1]}:"
+                            f"{code.co_name}")
+                        f = f.f_back
+                    counts[";".join(reversed(stack))] += 1
+                _time.sleep(interval)
+            out = "\n".join(f"{stack} {n}"
+                            for stack, n in counts.most_common())
+        finally:
+            self._profile_lock.release()
         self._bytes(req, out.encode(), "text/plain")
+
+    @route("GET", "/debug/queries")
+    def handle_debug_queries(self, req, params, path, body):
+        """Query flight recorder: in-flight queries plus the ring
+        buffer of recent ones (pilosa_tpu.observe).  ``?min_ms=N``
+        keeps only records at least N ms long (in-flight records by
+        their elapsed-so-far); ``?sort=elapsed`` orders both lists
+        slowest-first (default ``start``: newest-first)."""
+        recorder = getattr(self.api.executor, "recorder", None)
+        if recorder is None:
+            self._json(req, {"active": [], "recent": []})
+            return
+        try:
+            min_ms = float(params.get("min_ms", 0))
+        except ValueError:
+            raise ApiError("invalid min_ms parameter")
+        sort = params.get("sort", "start")
+        if sort not in ("start", "elapsed"):
+            raise ApiError("sort must be 'start' or 'elapsed'")
+
+        def prepare(records):
+            out = [r.to_dict() for r in records]
+            if min_ms > 0:
+                out = [d for d in out if d["elapsedMs"] >= min_ms]
+            key = "elapsedMs" if sort == "elapsed" else "startTime"
+            out.sort(key=lambda d: d[key], reverse=True)
+            return out
+
+        self._json(req, {
+            "active": prepare(recorder.active_records()),
+            "recent": prepare(recorder.recent_records()),
+        })
 
     @route("GET", "/debug/vars")
     def handle_debug_vars(self, req, params, path, body):

@@ -55,6 +55,9 @@ class Server:
         coalescer_enabled="auto",
         coalescer_window_ms: float = 2.0,
         coalescer_max_batch: int = 32,
+        observe_enabled: bool = True,
+        observe_recent: int = 256,
+        observe_long_query_time: float = 0.0,
     ):
         from pilosa_tpu import logger as _logger
         from pilosa_tpu import stats as _stats
@@ -107,6 +110,17 @@ class Server:
             window_s=coalescer_window_ms / 1e3,
             max_batch=coalescer_max_batch,
             enabled=coalescer_enabled,
+            stats=self.stats,
+        )
+        # query flight recorder ([observe] config): /debug/queries,
+        # ?profile=1, slow-query log, pilosa_query_latency histogram
+        from pilosa_tpu import observe as _observe
+
+        self.node.executor.recorder = _observe.FlightRecorder(
+            recent=observe_recent,
+            long_query_time=observe_long_query_time,
+            enabled=observe_enabled,
+            logger=self.logger,
             stats=self.stats,
         )
         if coordinator:

@@ -53,13 +53,15 @@ class StatsdClient(StatsClient):
     def gauge(self, name, value, rate=1.0):
         self._send(name, value, "g", rate, self._tags)
 
-    def histogram(self, name, value, rate=1.0):
+    def histogram(self, name, value, rate=1.0, exemplar=None):
+        # statsd's wire format has no exemplar slot; dropped here, kept
+        # by the registry backend in a MultiStatsClient fan-out
         self._send(name, value, "h", rate, self._tags)
 
     def set(self, name, value, rate=1.0):
         self._send(name, value, "s", rate, self._tags)
 
-    def timing(self, name, value_ns, rate=1.0):
+    def timing(self, name, value_ns, rate=1.0, exemplar=None):
         self._send(name, value_ns / 1e6, "ms", rate, self._tags)
 
     def with_tags(self, *tags):

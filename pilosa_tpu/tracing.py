@@ -270,3 +270,13 @@ def set_global_tracer(t: Tracer) -> None:
 def start_span(name: str, parent: Span | None = None) -> Span:
     """(reference tracing.StartSpanFromContext, tracing/tracing.go:60)"""
     return _global.start_span(name, parent)
+
+
+def active_trace_id() -> str | None:
+    """Trace id of this thread's innermost active span, or None under
+    the nop tracer.  The query flight recorder (pilosa_tpu.observe)
+    stamps it on each QueryRecord so a /debug/queries entry, a slow-
+    query log line, and a histogram exemplar all share the id of the
+    exported span tree — the span -> record linkage."""
+    span = current_span()
+    return span.trace_id if span is not None else None

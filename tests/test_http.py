@@ -106,6 +106,22 @@ class TestSingleNodeHTTP:
         r = _post(srv.uri, "/index/i/query", {"query": 'Row(f="likes")'})
         assert r["results"][0]["keys"] == ["alice"]
 
+    def test_metrics_pass_strict_exposition_parser(self, srv):
+        """Any malformed /metrics line must fail HERE, in tier-1, not
+        in a production scraper (tools/check_metrics.py)."""
+        from tools import check_metrics
+
+        _post(srv.uri, "/index/im")
+        _post(srv.uri, "/index/im/field/f")
+        _post(srv.uri, "/index/im/query", {"query": "Set(1, f=10)"})
+        # two tagsets on the same metric (the duplicate-TYPE regression)
+        # and a latency histogram both land in the exposition
+        _post(srv.uri, "/index/im/query", {"query": "Count(Row(f=10))"})
+        text = _get(srv.uri, "/metrics", expect_json=False).decode()
+        summary = check_metrics.check_text(text)
+        assert summary["samples"] > 0
+        assert "# TYPE pilosa_query_latency histogram" in text
+
     def test_internal_fragment_endpoints(self, srv):
         _post(srv.uri, "/index/i")
         _post(srv.uri, "/index/i/field/f")

@@ -1,0 +1,555 @@
+"""Query flight recorder: record shape, ring buffer, slow-query log,
+latency histograms with exemplars, ?profile=1, /debug/queries, and the
+distributed profile whose device-launch count must match the
+ops/bitmap.py dispatch hook exactly."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import observe, stats as _stats
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.ops import bitmap as bm
+from pilosa_tpu.parallel.executor import Executor
+from pilosa_tpu.server.server import Server
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def _post(uri, path, obj=None):
+    body = json.dumps(obj or {}).encode()
+    req = urllib.request.Request(uri + path, data=body, method="POST")
+    req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read() or b"null")
+
+
+def _get(uri, path):
+    with urllib.request.urlopen(uri + path, timeout=35) as resp:
+        return json.loads(resp.read())
+
+
+class _CapturingLogger:
+    def __init__(self):
+        self.lines: list[str] = []
+
+    def printf(self, fmt, *args):
+        self.lines.append(fmt % args if args else fmt)
+
+
+@pytest.fixture
+def ex(tmp_path):
+    holder = Holder(str(tmp_path / "obs"))
+    idx = holder.create_index("i")
+    idx.create_field("f")
+    e = Executor(holder)
+    for s in range(3):
+        for k in range(4):
+            e.execute("i", f"Set({s * SHARD_WIDTH + k}, f=7)")
+    yield e
+    holder.close()
+
+
+class TestRecorder:
+    def test_record_shape(self, ex):
+        ex.execute("i", "Count(Row(f=7))")
+        rec = ex.recorder.recent_records()[-1]
+        d = rec.to_dict()
+        assert d["pql"] == "Count(Row(f=7))"
+        assert d["index"] == "i"
+        assert d["shards"] == 3
+        assert d["active"] is False
+        assert d["elapsedMs"] > 0
+        assert d["traceID"]
+        assert d["resultSizes"] == [1]
+        assert d["deviceLaunches"] >= 1
+        assert sum(d["launchKinds"].values()) == d["deviceLaunches"]
+        names = [s["name"] for s in d["stages"]]
+        assert "translate" in names
+        assert "execute.Count" in names
+        assert "translateResults" in names
+        # single-node host mode: the fused all-shard path
+        assert d["path"] == "fused"
+        assert any(s["name"] == "map.fused" for s in d["stages"])
+
+    def test_per_shard_timings_on_unfused_path(self, ex):
+        ex.fuse_shards = False
+        ex.execute("i", "Count(Row(f=7))")
+        d = ex.recorder.recent_records()[-1].to_dict()
+        assert d["path"] == "per-shard"
+        assert {t["shard"] for t in d["shardTimings"]} == {0, 1, 2}
+        assert any(s["name"] == "map" for s in d["stages"])
+
+    def test_error_recorded(self, ex):
+        with pytest.raises(Exception):
+            ex.execute("i", "Count(Row(nope=1))")
+        d = ex.recorder.recent_records()[-1].to_dict()
+        assert "error" in d and "nope" in d["error"]
+
+    def test_ring_buffer_eviction(self, ex):
+        ex.recorder = observe.FlightRecorder(recent=4)
+        for k in range(6):
+            ex.execute("i", f"Count(Row(f={k}))")
+        recs = ex.recorder.recent_records()
+        assert len(recs) == 4
+        # oldest two evicted
+        assert [r.pql for r in recs] == [
+            f"Count(Row(f={k}))" for k in range(2, 6)]
+        assert ex.recorder.active_records() == []
+
+    def test_disabled_recorder_records_nothing(self, ex):
+        ex.recorder = observe.FlightRecorder(enabled=False)
+        ex.execute("i", "Count(Row(f=7))")
+        assert ex.recorder.recent_records() == []
+        assert ex.recorder.active_records() == []
+
+    def test_slow_query_log_fires_and_not(self, ex):
+        log = _CapturingLogger()
+        ex.recorder = observe.FlightRecorder(
+            long_query_time=1e-9, logger=log)
+        ex.execute("i", "Count(Row(f=7))")
+        assert len(log.lines) == 1
+        line = log.lines[0]
+        rec = ex.recorder.recent_records()[-1]
+        assert "Count(Row(f=7))" in line
+        assert rec.trace_id in line
+        assert "execute.Count" in line  # the breakdown rides along
+        assert rec.slow and rec.to_dict()["slow"] is True
+        # above-threshold only: a generous threshold must not fire
+        ex.recorder = observe.FlightRecorder(
+            long_query_time=60.0, logger=log)
+        ex.execute("i", "Count(Row(f=7))")
+        assert len(log.lines) == 1
+        assert ex.recorder.recent_records()[-1].slow is False
+
+    def test_latency_histogram_and_exemplar_published(self, ex):
+        stats = _stats.MemStatsClient()
+        ex.recorder = observe.FlightRecorder(stats=stats)
+        ex.execute("i", "Count(Row(f=7))")
+        snap = stats.snapshot()
+        assert snap["pilosa_query_latency"]["count"] == 1
+        text = stats.prometheus_text(exemplars=True)
+        assert "# TYPE pilosa_query_latency histogram" in text
+        tid = ex.recorder.recent_records()[-1].trace_id
+        assert f'# {{trace_id="{tid}"}}' in text
+        # the scrape default stays clean 0.0.4 (no exemplar syntax)
+        assert "trace_id" not in stats.prometheus_text()
+
+    def test_span_record_linkage(self, ex):
+        from pilosa_tpu import tracing
+
+        tracer = tracing.MemTracer()
+        old = tracing.global_tracer()
+        tracing.set_global_tracer(tracer)
+        try:
+            ex.execute("i", "Count(Row(f=7))")
+        finally:
+            tracing.set_global_tracer(old)
+        rec = ex.recorder.recent_records()[-1]
+        spans = tracer.finished("executor.Execute")
+        assert spans, "no executor span recorded"
+        assert rec.trace_id == spans[-1].trace_id
+        assert spans[-1].tags["query.record"] == rec.qid
+
+
+class TestHistogramMath:
+    def test_pinned_bucket_counts(self):
+        reg = _stats.MemStatsClient()
+        # bounds ladder contains ... 0.25, 0.5, 1, 2.5, 5 ...
+        for v in (0.2, 0.5, 0.6, 4.0, 4.0):
+            reg.histogram("lat", v)
+        h = reg._registry._hists[("lat", ())]
+        import bisect
+
+        def bucket(v):
+            return bisect.bisect_left(_stats.BUCKETS, v)
+
+        assert h.counts[bucket(0.25)] == 1   # 0.2 -> le=0.25
+        assert h.counts[bucket(0.5)] == 1    # 0.5 -> le=0.5 (le inclusive)
+        assert h.counts[bucket(1.0)] == 1    # 0.6 -> le=1
+        assert h.counts[bucket(5.0)] == 2    # both 4.0 -> le=5
+        assert sum(h.counts) == 5
+
+    def test_pinned_quantiles(self):
+        reg = _stats.MemStatsClient()
+        for v in (0.2, 0.5, 0.6, 4.0, 4.0):
+            reg.histogram("lat", v)
+        snap = reg.snapshot()["lat"]
+        assert snap["count"] == 5 and snap["min"] == 0.2
+        # p50: rank 2.5 falls in the le=1 bucket (cum before: 2, c=1)
+        # -> 0.5 + (1 - 0.5) * 0.5 = 0.75
+        assert snap["p50"] == pytest.approx(0.75)
+        # p95: rank 4.75 in the le=5 bucket (cum before: 3, c=2)
+        # -> 2.5 + (5 - 2.5) * (1.75/2) = 4.6875, clamped <= max 4.0
+        assert snap["p95"] == pytest.approx(4.0)
+        assert snap["p99"] == pytest.approx(4.0)
+
+    def test_cumulative_bucket_rendering(self):
+        reg = _stats.MemStatsClient()
+        for v in (0.2, 0.5, 0.6, 4.0, 4.0):
+            reg.histogram("lat", v)
+        text = reg.prometheus_text()
+        assert 'lat_bucket{le="0.25"} 1' in text
+        assert 'lat_bucket{le="0.5"} 2' in text
+        assert 'lat_bucket{le="1"} 3' in text
+        assert 'lat_bucket{le="5"} 5' in text
+        assert 'lat_bucket{le="+Inf"} 5' in text
+        assert "lat_sum" in text and "lat_count 5" in text
+
+    def test_exemplar_on_hot_bucket(self):
+        reg = _stats.MemStatsClient()
+        reg.histogram("lat", 0.4, exemplar="trace-a")
+        reg.histogram("lat", 0.45, exemplar="trace-b")  # same bucket: last wins
+        text = reg.prometheus_text(exemplars=True)
+        assert 'lat_bucket{le="0.5"} 2 # {trace_id="trace-b"} 0.45' in text
+        assert "trace-a" not in text
+
+
+class TestSatelliteStats:
+    def test_type_emitted_once_per_metric_name(self):
+        s = _stats.MemStatsClient()
+        s.count_with_tags("reqs", 1, 1.0, ["index:a"])
+        s.count_with_tags("reqs", 2, 1.0, ["index:b"])
+        s.timing("lat", 5.0)
+        s.with_tags("index:a").timing("lat", 7.0)
+        text = s.prometheus_text()
+        assert text.count("# TYPE reqs counter") == 1
+        assert text.count("# TYPE lat histogram") == 1
+        assert 'reqs{index="a"} 1' in text
+        assert 'reqs{index="b"} 2' in text
+
+    def test_multi_stats_merges_backends(self):
+        a, b = _stats.MemStatsClient(), _stats.MemStatsClient()
+        multi = _stats.MultiStatsClient([a, b])
+        a.count("only_a", 1)
+        b.count("only_b", 2)
+        snap = multi.snapshot()
+        assert snap["only_a"] == 1 and snap["only_b"] == 2
+        text = multi.prometheus_text()
+        assert "only_a 1" in text and "only_b 2" in text
+
+    def test_multi_stats_dedupes_type_lines(self):
+        a, b = _stats.MemStatsClient(), _stats.MemStatsClient()
+        multi = _stats.MultiStatsClient([a, b])
+        multi.count("shared", 1)  # fans out: same name in both
+        text = multi.prometheus_text()
+        assert text.count("# TYPE shared counter") == 1
+
+
+@pytest.fixture
+def srv(tmp_path):
+    s = Server(str(tmp_path / "node0"))
+    s.open()
+    _post(s.uri, "/index/i")
+    _post(s.uri, "/index/i/field/f")
+    for k in range(3):
+        _post(s.uri, "/index/i/query",
+              {"query": f"Set({k * SHARD_WIDTH + k}, f=9)"})
+    yield s
+    s.close()
+
+
+class TestHTTPSurface:
+    def test_profile_param_returns_breakdown(self, srv):
+        r = _post(srv.uri, "/index/i/query?profile=1",
+                  {"query": "Count(Row(f=9))"})
+        assert r["results"] == [3]
+        prof = r["profile"]
+        assert prof["pql"] == "Count(Row(f=9))"
+        assert prof["shards"] == 3
+        assert prof["deviceLaunches"] >= 1
+        assert {s["name"] for s in prof["stages"]} >= {
+            "translate", "execute.Count", "translateResults"}
+        # no profile key without the param
+        r = _post(srv.uri, "/index/i/query", {"query": "Count(Row(f=9))"})
+        assert "profile" not in r
+
+    def test_debug_queries_roundtrip(self, srv):
+        for _ in range(2):
+            _post(srv.uri, "/index/i/query", {"query": "Count(Row(f=9))"})
+        d = _get(srv.uri, "/debug/queries")
+        assert d["active"] == []
+        assert len(d["recent"]) >= 2
+        last = d["recent"][0]  # newest-first by default
+        assert last["pql"] == "Count(Row(f=9))"
+        assert last["traceID"] and last["elapsedMs"] > 0
+        # min_ms filters everything at an absurd threshold
+        d = _get(srv.uri, "/debug/queries?min_ms=60000")
+        assert d["recent"] == [] and d["active"] == []
+        # sort=elapsed orders slowest-first
+        d = _get(srv.uri, "/debug/queries?sort=elapsed")
+        el = [r["elapsedMs"] for r in d["recent"]]
+        assert el == sorted(el, reverse=True)
+
+    def test_debug_vars_reports_quantiles(self, srv):
+        _post(srv.uri, "/index/i/query", {"query": "Count(Row(f=9))"})
+        snap = _get(srv.uri, "/debug/vars")
+        lat = snap["pilosa_query_latency"]
+        for k in ("count", "sum", "p50", "p95", "p99"):
+            assert k in lat
+        assert lat["count"] >= 1
+
+    def test_metrics_exposes_native_histogram(self, srv):
+        _post(srv.uri, "/index/i/query", {"query": "Count(Row(f=9))"})
+        with urllib.request.urlopen(srv.uri + "/metrics") as resp:
+            text = resp.read().decode()
+        assert "# TYPE pilosa_query_latency histogram" in text
+        assert 'pilosa_query_latency_bucket{le="+Inf"}' in text
+        assert "pilosa_query_latency_count" in text
+        # the scrape default is clean 0.0.4 — no exemplar syntax a
+        # stock Prometheus would reject
+        assert "trace_id" not in text
+        from tools import check_metrics
+
+        check_metrics.check_text(text)  # strict parser accepts it
+        # exemplars render on explicit request, still parser-valid
+        with urllib.request.urlopen(
+                srv.uri + "/metrics?exemplars=1") as resp:
+            annotated = resp.read().decode()
+        assert 'trace_id="' in annotated
+        check_metrics.check_text(annotated)
+
+    def test_pprof_profile_serialized(self, srv):
+        results: dict = {}
+
+        def long_profile():
+            try:
+                with urllib.request.urlopen(
+                        srv.uri + "/debug/pprof/profile?seconds=2",
+                        timeout=35) as resp:
+                    results["first"] = resp.status
+            except urllib.error.HTTPError as e:
+                results["first"] = e.code
+
+        t = threading.Thread(target=long_profile)
+        t.start()
+        time.sleep(0.4)  # first sampler is mid-window
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                srv.uri + "/debug/pprof/profile?seconds=1", timeout=10)
+        assert e.value.code == 409
+        t.join()
+        assert results["first"] == 200
+        # the lock released: a later profile succeeds
+        with urllib.request.urlopen(
+                srv.uri + "/debug/pprof/profile?seconds=0.1",
+                timeout=10) as resp:
+            assert resp.status == 200
+
+
+class TestDistributedProfile:
+    def _make_cluster(self, tmp_path, n=3):
+        from pilosa_tpu.parallel.cluster import (
+            Cluster, LocalTransport, Node)
+        from pilosa_tpu.parallel.node import ClusterNode
+
+        transport = LocalTransport()
+        node_ids = [f"node{i}" for i in range(n)]
+        nodes = []
+        for nid in node_ids:
+            holder = Holder(str(tmp_path / nid))
+            cluster = Cluster(
+                nid, nodes=[Node(id=x) for x in node_ids],
+                replica_n=1, transport=transport.bind(nid))
+            cluster.set_state("NORMAL")
+            nodes.append(ClusterNode(holder, cluster))
+        return nodes
+
+    def test_launch_count_matches_dispatch_hook_exactly(self, tmp_path):
+        """Acceptance pin: a distributed Count profile's deviceLaunches
+        equals the ops/bitmap.py dispatch-hook count for the same
+        execution.  Shard set: exactly ONE locally-owned shard (so the
+        local map runs inline on the calling thread, where both the
+        dispatch_counter and the flight record observe every launch)
+        plus one remote shard (whose launches belong to the remote
+        node's own record, and tick neither local mechanism)."""
+        nodes = self._make_cluster(tmp_path)
+        origin = nodes[0]
+        origin.create_index("i")
+        origin.create_field("i", "f")
+        n_shards = 6
+        for s in range(n_shards):
+            for k in range(3):
+                origin.executor.execute(
+                    "i", f"Set({s * SHARD_WIDTH + k}, f=1)")
+        by_node = origin.cluster.shards_by_node("i", list(range(n_shards)))
+        local = by_node.get(origin.cluster.local_id)
+        remote = [ss for nid, ss in by_node.items()
+                  if nid != origin.cluster.local_id]
+        assert local and remote, "placement left a side empty"
+        shards = [local[0], remote[0][0]]
+
+        with bm.dispatch_counter() as dc:
+            got = origin.executor.execute("i", "Count(Row(f=1))",
+                                          shards=shards)[0]
+        assert got == 6  # 3 bits in each of the two shards
+        rec = origin.executor.recorder.recent_records()[-1]
+        d = rec.to_dict()
+        assert d["deviceLaunches"] == dc.n > 0
+        assert d["launchKinds"] == dict(
+            __import__("collections").Counter(dc.launches))
+        # per-node: the local group and one remote node
+        node_names = {t["node"] for t in d["nodeTimings"]}
+        assert "local" in node_names and len(node_names) == 2
+        # per-shard: the locally-executed shard
+        assert [t["shard"] for t in d["shardTimings"]] == [shards[0]]
+        # per-stage: map/reduce boundaries present
+        names = [s["name"] for s in d["stages"]]
+        assert "map" in names and "execute.Count" in names
+        assert d["shards"] == 2
+        for h in (n.holder for n in nodes):
+            h.close()
+
+    def test_profile_param_on_http_cluster(self, tmp_path):
+        """?profile=1 through a real multi-node HTTP cluster returns
+        per-node, per-shard, and per-stage timings plus the launch
+        count."""
+        s0 = Server(str(tmp_path / "n0"), name="node0")
+        s0.open()
+        s1 = Server(str(tmp_path / "n1"), name="node1", seeds=[s0.uri])
+        s1.open()
+        s2 = Server(str(tmp_path / "n2"), name="node2", seeds=[s0.uri])
+        s2.open()
+        try:
+            _post(s0.uri, "/index/i")
+            _post(s0.uri, "/index/i/field/f")
+            n_shards = 6
+            for s in range(n_shards):
+                _post(s0.uri, "/index/i/query",
+                      {"query": f"Set({s * SHARD_WIDTH + 2}, f=1)"})
+            # per-shard map (the fused local batch is ONE launch with
+            # no per-shard boundary, by design)
+            s0.node.executor.fuse_shards = False
+            r = _post(s0.uri, "/index/i/query?profile=1",
+                      {"query": "Count(Row(f=1))"})
+            assert r["results"] == [n_shards]
+            prof = r["profile"]
+            assert prof is not None
+            assert prof["shards"] == n_shards
+            assert prof["deviceLaunches"] > 0
+            names = [st["name"] for st in prof["stages"]]
+            assert "map" in names and "execute.Count" in names
+            nodes_seen = {t["node"] for t in prof["nodeTimings"]}
+            assert "local" in nodes_seen and len(nodes_seen) >= 2
+            # origin-local shards carry per-shard timings when >0 local
+            local_shards = s0.cluster.local_shards(
+                "i", list(range(n_shards)))
+            if local_shards:
+                assert {t["shard"] for t in prof["shardTimings"]} == set(
+                    local_shards)
+        finally:
+            for s in (s2, s1, s0):
+                s.close()
+
+
+class TestCoalescerObservability:
+    def test_coalesced_record_carries_batch_context(self, tmp_path):
+        from pilosa_tpu.parallel.coalescer import Coalescer
+
+        holder = Holder(str(tmp_path / "co"))
+        idx = holder.create_index("i")
+        idx.create_field("f")
+        e = Executor(holder)
+        e.coalescer = Coalescer(window_s=0.01, max_batch=4, enabled=True)
+        for s in range(2):
+            for k in range(3):
+                e.execute("i", f"Set({s * SHARD_WIDTH + k}, f=1)")
+                e.execute("i", f"Set({s * SHARD_WIDTH + k + 8}, f=2)")
+        n_threads = 4
+        errs: list = []
+        barrier = threading.Barrier(n_threads)
+
+        def worker():
+            try:
+                barrier.wait()
+                got = e.execute(
+                    "i", "Count(Intersect(Row(f=1), Row(f=2)))")[0]
+                assert got == 0
+            except BaseException as exc:  # noqa: BLE001
+                errs.append(exc)
+
+        threads = [threading.Thread(target=worker)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        recs = [r for r in e.recorder.recent_records()
+                if r.path == "coalesced"]
+        assert len(recs) == n_threads
+        batches = [r.coalesce["batch"] for r in recs]
+        assert all(b >= 1 for b in batches)
+        d = recs[-1].to_dict()
+        assert set(d["coalescer"]) == {
+            "batch", "queueWaitMs", "launchMs", "leader"}
+        assert d["coalescer"]["queueWaitMs"] >= 0
+        # exactly one record per flush owns the shared launch
+        assert sum(1 for r in recs if r.coalesce["leader"]) >= 1
+        holder.close()
+
+
+class TestCheckMetricsParser:
+    def test_rejects_duplicate_type(self):
+        from tools.check_metrics import MetricsFormatError, check_text
+
+        bad = "# TYPE a counter\na 1\n# TYPE a counter\n"
+        with pytest.raises(MetricsFormatError, match="duplicate TYPE"):
+            check_text(bad)
+
+    def test_rejects_type_split_by_tagset(self):
+        """The exact satellite bug: TYPE re-emitted per tagset."""
+        from tools.check_metrics import MetricsFormatError, check_text
+
+        bad = ('# TYPE a counter\na{x="1"} 1\n'
+               '# TYPE a counter\na{x="2"} 2\n')
+        with pytest.raises(MetricsFormatError):
+            check_text(bad)
+
+    def test_rejects_non_cumulative_buckets(self):
+        from tools.check_metrics import MetricsFormatError, check_text
+
+        bad = ("# TYPE h histogram\n"
+               'h_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'
+               'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 5\n')
+        with pytest.raises(MetricsFormatError,
+                           match="not cumulative"):
+            check_text(bad)
+
+    def test_rejects_missing_inf_bucket(self):
+        from tools.check_metrics import MetricsFormatError, check_text
+
+        bad = ("# TYPE h histogram\n"
+               'h_bucket{le="1"} 5\nh_sum 1\nh_count 5\n')
+        with pytest.raises(MetricsFormatError, match=r"\+Inf"):
+            check_text(bad)
+
+    def test_rejects_bad_label_and_duplicate_series(self):
+        from tools.check_metrics import MetricsFormatError, check_text
+
+        with pytest.raises(MetricsFormatError):
+            check_text("# TYPE a counter\na{x=unquoted} 1\n")
+        with pytest.raises(MetricsFormatError, match="duplicate series"):
+            check_text('# TYPE a counter\na{x="1"} 1\na{x="1"} 2\n')
+
+    def test_rejects_exemplar_outside_bucket(self):
+        from tools.check_metrics import MetricsFormatError, check_text
+
+        bad = '# TYPE a counter\na 1 # {trace_id="t"} 1\n'
+        with pytest.raises(MetricsFormatError, match="exemplar"):
+            check_text(bad)
+
+    def test_accepts_valid_histogram_with_exemplar(self):
+        from tools.check_metrics import check_text
+
+        good = ("# TYPE h histogram\n"
+                'h_bucket{le="1"} 2 # {trace_id="t"} 0.5 123.0\n'
+                'h_bucket{le="+Inf"} 3\n'
+                "h_sum 4.5\nh_count 3\n")
+        out = check_text(good)
+        assert out["samples"] == 4

@@ -1,0 +1,260 @@
+"""Strict Prometheus text-exposition checker.
+
+A malformed /metrics line fails silently in-repo and loudly in a
+production scraper — strict servers (Prometheus with honor-labels off,
+the OpenMetrics ingest path) reject the whole scrape.  This module is
+the CI tripwire: tests feed a live server's ``/metrics`` body through
+``check_text`` so any future malformed line fails tier-1 instead of
+failing a scraper.
+
+Checked dialect: Prometheus text 0.0.4 plus the one OpenMetrics
+extension this codebase emits — trace-id exemplars on histogram
+``_bucket`` samples (``... # {trace_id="..."} value timestamp``).
+
+Rules enforced:
+
+- line grammar: ``# TYPE``/``# HELP``/comment/blank/sample only
+- metric and label names match the Prometheus charset
+- label values are double-quoted with only ``\\\\``/``\\"``/``\\n``
+  escapes; label blocks are well-formed
+- sample values parse as float (``+Inf``/``-Inf``/``NaN`` allowed)
+- at most one ``# TYPE`` per metric name, and it precedes the metric's
+  samples; TYPE values are the known set
+- all samples of one metric form a single contiguous group
+- no duplicate (name, labelset) sample
+- histograms: ``le`` present on every ``_bucket``, cumulative bucket
+  values non-decreasing per series, a ``+Inf`` bucket present and
+  equal to ``_count``
+- exemplars only on histogram ``_bucket`` samples
+
+Usage: ``python -m tools.check_metrics URL`` (exit 1 on violation), or
+``check_text(text)`` from tests.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+_TYPES = ("counter", "gauge", "histogram", "summary", "untyped")
+_HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+class MetricsFormatError(ValueError):
+    def __init__(self, lineno: int, line: str, reason: str):
+        super().__init__(f"line {lineno}: {reason}: {line!r}")
+        self.lineno = lineno
+        self.reason = reason
+
+
+def _parse_labels(lineno: int, line: str, raw: str) -> dict[str, str]:
+    """Parse the inside of a ``{...}`` label block."""
+    labels: dict[str, str] = {}
+    i = 0
+    n = len(raw)
+    while i < n:
+        m = re.match(r"[a-zA-Z_][a-zA-Z0-9_]*", raw[i:])
+        if m is None:
+            raise MetricsFormatError(lineno, line, "bad label name")
+        name = m.group(0)
+        i += len(name)
+        if raw[i:i + 1] != "=":
+            raise MetricsFormatError(lineno, line, "expected '=' in label")
+        i += 1
+        if raw[i:i + 1] != '"':
+            raise MetricsFormatError(lineno, line,
+                                     "label value must be quoted")
+        i += 1
+        val = []
+        while True:
+            if i >= n:
+                raise MetricsFormatError(lineno, line,
+                                         "unterminated label value")
+            ch = raw[i]
+            if ch == "\\":
+                esc = raw[i + 1:i + 2]
+                if esc not in ("\\", '"', "n"):
+                    raise MetricsFormatError(lineno, line,
+                                             f"bad escape \\{esc}")
+                val.append({"\\": "\\", '"': '"', "n": "\n"}[esc])
+                i += 2
+                continue
+            if ch == '"':
+                i += 1
+                break
+            val.append(ch)
+            i += 1
+        if name in labels:
+            raise MetricsFormatError(lineno, line,
+                                     f"duplicate label {name}")
+        labels[name] = "".join(val)
+        if i < n:
+            if raw[i] != ",":
+                raise MetricsFormatError(lineno, line,
+                                         "expected ',' between labels")
+            i += 1
+    return labels
+
+
+def _parse_value(lineno: int, line: str, raw: str) -> float:
+    if raw in ("+Inf", "Inf"):
+        return float("inf")
+    if raw == "-Inf":
+        return float("-inf")
+    if raw == "NaN":
+        return float("nan")
+    try:
+        return float(raw)
+    except ValueError:
+        raise MetricsFormatError(lineno, line, f"bad value {raw!r}")
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*?)\})?"
+    r" (?P<value>\S+)"
+    r"(?: (?P<ts>-?\d+))?"
+    r"(?P<exemplar> # \{.*\} \S+(?: \S+)?)?$"
+)
+
+
+def check_text(text: str) -> dict:
+    """Validate one exposition body.  Returns a summary dict
+    ({"samples": n, "metrics": n}) or raises MetricsFormatError."""
+    types: dict[str, str] = {}
+    sampled: set[str] = set()      # base names with >=1 sample
+    finished: set[str] = set()     # groups we've moved past
+    current: str | None = None
+    seen_series: set[tuple] = set()
+    # histogram accounting: series key -> data
+    buckets: dict[tuple, list[tuple[float, float]]] = {}
+    counts: dict[tuple, float] = {}
+    n_samples = 0
+
+    def base_name(name: str) -> str:
+        for suf in _HIST_SUFFIXES:
+            if name.endswith(suf):
+                stem = name[: -len(suf)]
+                if types.get(stem) in ("histogram", "summary"):
+                    return stem
+        return name
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) != 4:
+                    raise MetricsFormatError(lineno, line,
+                                             "malformed TYPE line")
+                _, _, name, mtype = parts
+                if not _NAME_RE.match(name):
+                    raise MetricsFormatError(lineno, line,
+                                             "bad metric name in TYPE")
+                if mtype not in _TYPES:
+                    raise MetricsFormatError(lineno, line,
+                                             f"unknown type {mtype!r}")
+                if name in types:
+                    raise MetricsFormatError(lineno, line,
+                                             f"duplicate TYPE for {name}")
+                if name in sampled:
+                    raise MetricsFormatError(
+                        lineno, line, f"TYPE after samples of {name}")
+                types[name] = mtype
+            # HELP and plain comments pass
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise MetricsFormatError(lineno, line, "unparsable sample")
+        name = m.group("name")
+        labels = _parse_labels(lineno, line, m.group("labels") or "") \
+            if m.group("labels") is not None else {}
+        value = _parse_value(lineno, line, m.group("value"))
+        stem = base_name(name)
+        mtype = types.get(stem)
+        if m.group("exemplar") is not None and not (
+                mtype == "histogram" and name.endswith("_bucket")):
+            raise MetricsFormatError(
+                lineno, line, "exemplar outside a histogram _bucket")
+        # contiguity: all of a metric's lines form one group
+        if stem != current:
+            if current is not None:
+                finished.add(current)
+            if stem in finished:
+                raise MetricsFormatError(
+                    lineno, line, f"interleaved samples for {stem}")
+            current = stem
+        sampled.add(stem)
+        series = (name, tuple(sorted(
+            (k, v) for k, v in labels.items() if k != "le")))
+        if mtype == "histogram" and name.endswith("_bucket"):
+            if "le" not in labels:
+                raise MetricsFormatError(lineno, line,
+                                         "_bucket without le label")
+            le = _parse_value(lineno, line, labels["le"])
+            key = (stem, series[1])
+            prior = buckets.setdefault(key, [])
+            if prior:
+                ple, pval = prior[-1]
+                if le <= ple:
+                    raise MetricsFormatError(
+                        lineno, line, "le not increasing")
+                if value < pval:
+                    raise MetricsFormatError(
+                        lineno, line, "bucket counts not cumulative")
+            prior.append((le, value))
+            bseries = (name, tuple(sorted(labels.items())))
+            if bseries in seen_series:
+                raise MetricsFormatError(lineno, line, "duplicate series")
+            seen_series.add(bseries)
+        else:
+            if series in seen_series:
+                raise MetricsFormatError(lineno, line, "duplicate series")
+            seen_series.add(series)
+            if mtype == "histogram" and name.endswith("_count"):
+                counts[(stem, series[1])] = value
+        n_samples += 1
+
+    for (stem, lbls), blist in buckets.items():
+        if not blist or blist[-1][0] != float("inf"):
+            raise MetricsFormatError(0, stem, "histogram missing +Inf bucket")
+        cnt = counts.get((stem, lbls))
+        if cnt is None:
+            raise MetricsFormatError(0, stem, "histogram missing _count")
+        if blist[-1][1] != cnt:
+            raise MetricsFormatError(
+                0, stem,
+                f"+Inf bucket {blist[-1][1]} != _count {cnt}")
+    return {"samples": n_samples, "metrics": len(sampled)}
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if len(argv) != 1:
+        print("usage: python -m tools.check_metrics URL|FILE",
+              file=sys.stderr)
+        return 2
+    src = argv[0]
+    if src.startswith("http://") or src.startswith("https://"):
+        import urllib.request
+
+        with urllib.request.urlopen(src, timeout=10) as resp:
+            text = resp.read().decode()
+    else:
+        with open(src) as f:
+            text = f.read()
+    try:
+        summary = check_text(text)
+    except MetricsFormatError as e:
+        print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    print(f"ok: {summary['samples']} samples, "
+          f"{summary['metrics']} metrics")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
