@@ -509,6 +509,32 @@ def bench_coalescer(a_np: np.ndarray, b_np: np.ndarray) -> tuple[dict, dict] | N
     return out, obs
 
 
+def bench_admission(coalescer_extras: dict | None) -> dict:
+    """Admission-layer overhead on the uncontended serving path: the
+    gate's acquire+release pair is what every admitted request pays on
+    top of execution, so its cost must stay under 1% of the coalesced
+    Count path's per-query service time (the [admission] budget).
+    Measured directly (one thread, free slots — the uncontended case);
+    ``pct_of_query`` is computed against the coalescer benchmark's
+    measured per-query time when that ran."""
+    from pilosa_tpu import stats as _stats
+    from pilosa_tpu.serve.admission import AdmissionController
+
+    ctrl = AdmissionController(stats=_stats.MemStatsClient())
+    n = 20000
+    ctrl.acquire("query").release()  # warm (lock, stats path)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ctrl.acquire("query").release()
+    cost_us = (time.perf_counter() - t0) / n * 1e6
+    out = {"acquire_release_us": round(cost_us, 3), "budget_pct": 1.0}
+    if coalescer_extras and coalescer_extras.get("qps"):
+        per_query_us = (coalescer_extras.get("threads", 16)
+                        / coalescer_extras["qps"] * 1e6)
+        out["pct_of_query"] = round(cost_us / per_query_us * 100.0, 3)
+    return out
+
+
 def verify_product_path(a_np: np.ndarray, b_np: np.ndarray,
                         expect: int) -> None:
     """Bit-exactness of the REAL path: the PQL string through the
@@ -627,6 +653,7 @@ def main():
         co, obs = co_obs
         extras["coalescer"] = co
         extras["observe"] = obs
+    extras["admission"] = bench_admission(co)
     bytes_per_query = a.nbytes + b.nbytes  # streamed once per query
     achieved_gbps = dev_qps * bytes_per_query / 1e9
     peak = _peak_gbps(platform)

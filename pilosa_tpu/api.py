@@ -96,8 +96,14 @@ class API:
               exclude_columns: bool = False, coalesce: bool = True):
         """Execute PQL -> list of results (api.go:135 API.Query)."""
         from pilosa_tpu.parallel.executor import ExecOptions
+        from pilosa_tpu.serve import deadline as _deadline
 
         self._validate("query")
+        # end-to-end deadline: the handler installed the request's
+        # X-Pilosa-Deadline scope on this thread; expired budgets shed
+        # here, before translate/collective work touches anything
+        dl = _deadline.current()
+        _deadline.check(dl, "query execution")
         if (not remote and shards is None and isinstance(pql, str)):
             # multi-process runtime: the coordinator upgrades supported
             # reads to one collective SPMD program over the global mesh
@@ -160,6 +166,7 @@ class API:
             exclude_columns=exclude_columns,
             shards=None if shards is None else list(shards),
             coalesce=coalesce,
+            deadline=dl,
         )
         return self.executor.execute(index, pql, opt=opt)
 
@@ -366,6 +373,7 @@ class API:
         dropping a write on an ex-owner (whose fragments the
         post-resize sweep deletes)."""
         from pilosa_tpu.parallel.cluster import converge_owner_deliveries
+        from pilosa_tpu.serve.admission import rpc_class
 
         applied: set[str] = set()
 
@@ -375,10 +383,14 @@ class API:
                 "non-owners and the membership view did not "
                 "converge; retry")
 
-        converge_owner_deliveries(
-            lambda: self._owner_pass(index, shard, payload, local_fn,
-                                     applied),
-            on_timeout)
+        # replica deliveries carry the ingest class on the wire so the
+        # receiving node admits them against its ingest gate, not the
+        # internal one anti-entropy competes in
+        with rpc_class("ingest"):
+            converge_owner_deliveries(
+                lambda: self._owner_pass(index, shard, payload, local_fn,
+                                         applied),
+                on_timeout)
 
     def _owner_pass(self, index: str, shard: int, payload: dict,
                     local_fn, applied: set) -> bool:

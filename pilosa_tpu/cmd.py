@@ -40,6 +40,22 @@ def main(argv: list[str] | None = None) -> int:
                     help="seconds; log queries slower than this with "
                          "their profile breakdown ([observe] "
                          "long-query-time; 0 disables)")
+    ps.add_argument("--no-admission", action="store_true",
+                    help="disable the admission gate ([admission] "
+                         "enabled=false): no per-class caps, no load "
+                         "shedding, no accept-side thread cap")
+    ps.add_argument("--admission-default-deadline", type=float,
+                    help="seconds applied to requests without an "
+                         "X-Pilosa-Deadline header ([admission] "
+                         "default-deadline; 0 = none)")
+    for _cls in ("query", "ingest", "internal"):
+        ps.add_argument(f"--admission-{_cls}-cap", type=int,
+                        help=f"concurrent {_cls}-class requests "
+                             f"([admission] {_cls}-cap)")
+        ps.add_argument(f"--admission-{_cls}-queue", type=int,
+                        help=f"queued {_cls}-class requests beyond the "
+                             f"cap; overflow sheds 429 "
+                             f"([admission] {_cls}-queue)")
     ps.add_argument("--verbose", action="store_true")
 
     pi = sub.add_parser("import", help="bulk-import CSV bits")
@@ -117,6 +133,15 @@ def cmd_server(args) -> int:
         cfg.anti_entropy.interval = args.anti_entropy_interval
     if args.long_query_time is not None:
         cfg.observe.long_query_time = args.long_query_time
+    if args.no_admission:
+        cfg.admission.enabled = False
+    if args.admission_default_deadline is not None:
+        cfg.admission.default_deadline = args.admission_default_deadline
+    for _cls in ("query", "ingest", "internal"):
+        for _kind in ("cap", "queue"):
+            v = getattr(args, f"admission_{_cls}_{_kind}", None)
+            if v is not None:
+                setattr(cfg.admission, f"{_cls}_{_kind}", v)
     return run_server(cfg)
 
 
@@ -187,6 +212,14 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         observe_enabled=cfg.observe.enabled,
         observe_recent=cfg.observe.recent,
         observe_long_query_time=cfg.observe.long_query_time,
+        admission_enabled=cfg.admission.enabled,
+        admission_query_cap=cfg.admission.query_cap,
+        admission_query_queue=cfg.admission.query_queue,
+        admission_ingest_cap=cfg.admission.ingest_cap,
+        admission_ingest_queue=cfg.admission.ingest_queue,
+        admission_internal_cap=cfg.admission.internal_cap,
+        admission_internal_queue=cfg.admission.internal_queue,
+        admission_default_deadline=cfg.admission.default_deadline,
         logger=log,
         stats=stats,
     )

@@ -105,6 +105,28 @@ class ObserveConfig:
 
 
 @dataclass
+class AdmissionConfig:
+    """[admission] — priority-classed admission control + load
+    shedding on the serving path (serve/admission.py; no reference
+    analog — the overload story Pilosa punts on).  Three classes, each
+    with a concurrency cap and a bounded FIFO wait queue: ``query``
+    (user PQL), ``ingest`` (imports), ``internal`` (anti-entropy,
+    resize transfer, translate replication).  ``default_deadline``
+    (seconds, 0 = none) applies to requests that carry no
+    ``X-Pilosa-Deadline`` header.  Overflow sheds with 429/503 +
+    Retry-After instead of queueing unboundedly."""
+
+    enabled: bool = True
+    query_cap: int = 32
+    query_queue: int = 128
+    ingest_cap: int = 16
+    ingest_queue: int = 64
+    internal_cap: int = 16
+    internal_queue: int = 64
+    default_deadline: float = 0.0  # seconds; 0 = no implied deadline
+
+
+@dataclass
 class TLSConfig:
     """[tls] (server/tlsconfig.go; config server/config.go:58-66)."""
 
@@ -133,6 +155,7 @@ class Config:
     tls: TLSConfig = field(default_factory=TLSConfig)
     coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
     observe: ObserveConfig = field(default_factory=ObserveConfig)
+    admission: AdmissionConfig = field(default_factory=AdmissionConfig)
 
     # ------------------------------------------------------------- access
 
@@ -168,8 +191,8 @@ class Config:
         for k, v in d.items():
             key = k.replace("-", "_")
             if key in ("cluster", "anti_entropy", "metric", "tracing",
-                       "profile", "tls", "coalescer",
-                       "observe") and isinstance(v, dict):
+                       "profile", "tls", "coalescer", "observe",
+                       "admission") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -183,7 +206,8 @@ class Config:
                                                         ProfileConfig,
                                                         TLSConfig,
                                                         CoalescerConfig,
-                                                        ObserveConfig)):
+                                                        ObserveConfig,
+                                                        AdmissionConfig)):
                 setattr(self, key, v)
 
     def _apply_env(self, env: dict) -> None:
@@ -191,7 +215,8 @@ class Config:
         (the reference's PILOSA_* envs, cmd/root.go:94)."""
         for f in fields(self):
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
-                          "profile", "tls", "coalescer", "observe"):
+                          "profile", "tls", "coalescer", "observe",
+                          "admission"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -252,6 +277,16 @@ class Config:
             f"enabled = {str(self.observe.enabled).lower()}",
             f"recent = {self.observe.recent}",
             f"long-query-time = {self.observe.long_query_time}",
+            "",
+            "[admission]",
+            f"enabled = {str(self.admission.enabled).lower()}",
+            f"query-cap = {self.admission.query_cap}",
+            f"query-queue = {self.admission.query_queue}",
+            f"ingest-cap = {self.admission.ingest_cap}",
+            f"ingest-queue = {self.admission.ingest_queue}",
+            f"internal-cap = {self.admission.internal_cap}",
+            f"internal-queue = {self.admission.internal_queue}",
+            f"default-deadline = {self.admission.default_deadline}",
             "",
             "[tls]",
             f'certificate-path = "{self.tls.certificate_path}"',

@@ -41,6 +41,8 @@ import threading
 import time
 from collections import Counter, deque
 
+from pilosa_tpu.serve.deadline import tls_scope as _tls_scope
+
 _tls = threading.local()  # .rec: active QueryRecord; .last: last published
 
 #: PQL longer than this is truncated in records (a query string is
@@ -65,25 +67,32 @@ def current() -> "QueryRecord | None":
     return getattr(_tls, "rec", None)
 
 
-class attach:
+class attach(_tls_scope):
     """Install a record (or None) as this thread's active record for a
     scope.  Re-entrant: restores whatever was active before, so a
     remote re-execution beginning its OWN record inside an IO thread
     shadows rather than clobbers."""
 
-    __slots__ = ("rec", "_prev")
+    __slots__ = ()
 
     def __init__(self, rec: "QueryRecord | None"):
-        self.rec = rec
+        super().__init__(_tls, "rec", rec)
 
-    def __enter__(self):
-        self._prev = getattr(_tls, "rec", None)
-        _tls.rec = self.rec
-        return self.rec
 
-    def __exit__(self, *exc):
-        _tls.rec = self._prev
-        return False
+class admission_scope(_tls_scope):
+    """Install an admission stamp ({"class", "queue_wait_ns"}) for a
+    request's scope; ``FlightRecorder.begin`` copies it onto every
+    record begun inside (the handler admits BEFORE the executor opens
+    the record, so the handoff is this thread-local).  Re-entrant."""
+
+    __slots__ = ()
+
+    def __init__(self, info: dict | None):
+        super().__init__(_tls, "admission", info)
+
+
+def current_admission() -> dict | None:
+    return getattr(_tls, "admission", None)
 
 
 def take_last() -> "QueryRecord | None":
@@ -123,6 +132,7 @@ class QueryRecord:
         "qid", "trace_id", "index", "pql", "start_unix", "t0_ns",
         "elapsed_ns", "shards_n", "stages", "shard_ns", "node_ns",
         "launches", "path", "coalesce", "result_sizes", "error", "slow",
+        "admission", "outcome",
     )
 
     def __init__(self, qid: int, index: str, pql: str,
@@ -145,6 +155,10 @@ class QueryRecord:
         self.result_sizes: list[int] = []
         self.error: str | None = None
         self.slow = False
+        # admission stamp ({"class", "queue_wait_ns"}) and outcome
+        # (ok | error | shed | expired; None resolves at to_dict time)
+        self.admission: dict | None = None
+        self.outcome: str | None = None
 
     # ------------------------------------------------------------ notes
 
@@ -202,7 +216,14 @@ class QueryRecord:
             "deviceLaunches": len(self.launches),
             "launchKinds": dict(Counter(self.launches)),
             "resultSizes": list(self.result_sizes),
+            "outcome": self.outcome or ("error" if self.error else "ok"),
         }
+        if self.admission is not None:
+            d["admission"] = {
+                "class": self.admission.get("class"),
+                "queueWaitMs": round(
+                    self.admission.get("queue_wait_ns", 0) / ms, 3),
+            }
         if len(self.shard_ns) >= MAX_SHARD_TIMINGS:
             d["shardTimingsTruncated"] = True
         if self.path is not None:
@@ -241,15 +262,60 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._active: dict[int, QueryRecord] = {}
         self._recent: deque[QueryRecord] = deque(maxlen=recent)
+        # shed-log throttle: overload sheds thousands/sec; one line
+        # per second (with a suppressed count) keeps the log honest
+        # without letting the log itself become the overload
+        self._shed_log_t = 0.0
+        self._shed_suppressed = 0
 
     # ----------------------------------------------------------- record
 
     def begin(self, index: str, pql: str,
               trace_id: str | None = None) -> QueryRecord:
         rec = QueryRecord(next(self._seq), index, pql, trace_id)
+        # the admission gate runs before the executor opens the record;
+        # its stamp (class + queue wait) rides a thread-local scope
+        rec.admission = current_admission()
         with self._lock:
             self._active[rec.qid] = rec
         return rec
+
+    def record_shed(self, index: str, pql: str, klass: str,
+                    outcome: str, reason: str,
+                    wait_ns: int = 0) -> None:
+        """A request refused at the admission gate never executes, so
+        no record is begun for it — synthesize one straight into the
+        ring buffer (outcome ``shed``/``expired``) so /debug/queries
+        and the slow-query log tell the overload story, and skip the
+        latency histogram (a refusal's sub-millisecond turnaround
+        would drag the admitted-query percentiles down)."""
+        if not self.enabled:
+            return
+        rec = QueryRecord(next(self._seq), index, pql)
+        rec.admission = {"class": klass, "queue_wait_ns": wait_ns}
+        rec.outcome = outcome
+        rec.error = reason
+        rec.elapsed_ns = wait_ns
+        suppressed = 0
+        with self._lock:
+            self._recent.append(rec)
+            if self.logger is not None:
+                now = time.monotonic()
+                if now - self._shed_log_t < 1.0:
+                    self._shed_suppressed += 1
+                    return
+                suppressed = self._shed_suppressed
+                self._shed_suppressed = 0
+                self._shed_log_t = now
+        if self.logger is not None:
+            # shed events ride the slow-query log: overload must be
+            # diagnosable from the same place slow queries are
+            self.logger.printf(
+                "%s query (class=%s, waited %.1fms) on %s: %s"
+                "%s",
+                outcome, klass, wait_ns / 1e6, index or "-", reason,
+                f" (+{suppressed} more shed in the last second)"
+                if suppressed else "")
 
     def discard(self, rec: QueryRecord) -> None:
         """Drop an active record without publishing (a path that turned

@@ -128,6 +128,22 @@ class TransportError(RuntimeError):
     replica failover in the executor (executor.go:2492)."""
 
 
+class ShedByPeerError(TransportError):
+    """The peer's admission gate refused the request (429/503 with
+    Retry-After, serve/admission.py) and the client's shed retries are
+    exhausted.  Subclasses TransportError on purpose: best-effort
+    fan-outs — broadcast, anti-entropy peer loops, resize source
+    fallback, the executor's replica failover — must SKIP an
+    overloaded peer exactly like an unreachable one (a later sweep or
+    another replica picks it up).  Liveness checks must test for this
+    FIRST: a shed response is proof of life, never evidence of death
+    (parallel/membership.py)."""
+
+    def __init__(self, msg: str, status: int):
+        super().__init__(msg)
+        self.status = status
+
+
 #: cross-transport marker for a replica write delivery refused by a
 #: non-owner (reference api.go ErrClusterDoesNotOwnShard).  Typed
 #: exceptions survive LocalTransport and carry a structured

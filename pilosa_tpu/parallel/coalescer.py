@@ -46,6 +46,7 @@ import numpy as np
 from pilosa_tpu import observe as _observe
 from pilosa_tpu import stats as _stats
 from pilosa_tpu import tracing
+from pilosa_tpu.serve.deadline import DeadlineExceededError
 
 
 def resolve_enabled(mode) -> bool:
@@ -73,7 +74,8 @@ class _Bucket:
                  "n_final", "flush_t0", "launch_ns")
 
     def __init__(self):
-        self.items: list[tuple[tuple, Future]] = []  # (leaves, future)
+        # (leaves, future, deadline-or-None) per enqueued query
+        self.items: list[tuple] = []
         self.full = threading.Event()
         self.sealed = False
         # flight-recorder breakdown, written by the leader BEFORE the
@@ -103,10 +105,16 @@ class Coalescer:
     def eligible(self, opt) -> bool:
         """Gate consulted by the executor's fused Count path — the
         caller has already established fusion eligibility and
-        single-node execution."""
-        return self.enabled and (opt is None or opt.coalesce)
+        single-node execution.  A query whose remaining deadline is
+        within two batching windows bypasses the coalescer entirely:
+        never hold a query past its budget just to share a launch."""
+        if not (self.enabled and (opt is None or opt.coalesce)):
+            return False
+        dl = None if opt is None else getattr(opt, "deadline", None)
+        return dl is None or dl.remaining() > 2 * self.window_s
 
-    def count(self, executor, idx, child, shards: tuple[int, ...]) -> int:
+    def count(self, executor, idx, child, shards: tuple[int, ...],
+              deadline=None) -> int:
         """One Count(tree) query through the batching window -> total.
         Staging runs on the CALLER's thread (fragment locks, and a
         staging error belongs to this query alone)."""
@@ -120,7 +128,7 @@ class Coalescer:
             if leader:
                 bucket = _Bucket()
                 self._pending[key] = bucket
-            bucket.items.append((leaves, fut))
+            bucket.items.append((leaves, fut, deadline))
             if len(bucket.items) >= self.max_batch:
                 bucket.sealed = True
                 del self._pending[key]
@@ -164,10 +172,31 @@ class Coalescer:
         EVERYTHING here runs inside the try: any failure — including
         stats/tracing backends or the ops import — must resolve every
         waiter's future, or followers would block forever."""
-        items = bucket.items
-        n = len(items)
+        # deadline-aware launch: entries whose budget died while the
+        # window was open are dropped from the batch BEFORE launch —
+        # their futures resolve to DeadlineExceededError, and their
+        # batchmates' results are unaffected (the stack simply omits
+        # the expired rows)
+        live: list[tuple] = []
+        expired: list = []
+        for it in bucket.items:
+            dl = it[2]
+            (expired if dl is not None and dl.expired()
+             else live).append(it)
+        for it in expired:
+            it[1].set_exception(DeadlineExceededError(
+                "deadline expired in the coalescer window"))
+        n = len(live)
         bucket.n_final = n
         bucket.flush_t0 = time.perf_counter_ns()
+        if expired:
+            try:
+                self.stats.count("coalescer.deadline_dropped",
+                                 len(expired))
+            except Exception:  # noqa: BLE001 — telemetry must never
+                pass  # strand the live waiters below
+        if n == 0:
+            return
         try:
             from pilosa_tpu.ops import expr
 
@@ -179,12 +208,12 @@ class Coalescer:
                 if n == 1:
                     # single-query passthrough: the identical program
                     # the un-coalesced path would run
-                    results = [expr.evaluate(shape, items[0][0],
+                    results = [expr.evaluate(shape, live[0][0],
                                              counts=True)]
                 else:
                     stacked = tuple(
-                        _stack([it[0][j] for it in items])
-                        for j in range(len(items[0][0])))
+                        _stack([it[0][j] for it in live])
+                        for j in range(len(live[0][0])))
                     counts = np.asarray(
                         expr.evaluate(shape, stacked, counts=True),
                         dtype=np.int64)
@@ -193,11 +222,11 @@ class Coalescer:
                 self.stats.timing("coalescer.launch_ns",
                                   bucket.launch_ns)
         except BaseException as e:  # noqa: BLE001 — every waiter fails
-            for _, fut in items:
-                fut.set_exception(e)
+            for it in live:
+                it[1].set_exception(e)
             return
-        for (_, fut), row in zip(items, results):
-            fut.set_result(row)
+        for it, row in zip(live, results):
+            it[1].set_result(row)
 
 
 def _stack(arrs: list):

@@ -34,7 +34,11 @@ import numpy as np
 
 from pilosa_tpu.models.field import FieldType
 from pilosa_tpu.models.row import Row
-from pilosa_tpu.parallel.cluster import UNOWNED_MARKER, TransportError
+from pilosa_tpu.parallel.cluster import (
+    UNOWNED_MARKER,
+    ShedByPeerError,
+    TransportError,
+)
 from pilosa_tpu.models.timequantum import parse_time
 from pilosa_tpu.models.view import VIEW_STANDARD
 from pilosa_tpu.ops import bitmap as bm
@@ -46,6 +50,8 @@ from pilosa_tpu.parallel.results import (
     sort_pairs,
 )
 from pilosa_tpu.pql import Call, Query, parse
+from pilosa_tpu.serve import deadline as _deadline
+from pilosa_tpu.serve.deadline import DeadlineExceededError
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 from pilosa_tpu import observe as _observe
 from pilosa_tpu import stats as _stats
@@ -71,6 +77,11 @@ class ExecOptions:
     # per-request opt-out of cross-query micro-batching (the HTTP
     # layer's ?nocoalesce=true — debugging / latency-sensitive callers)
     coalesce: bool = True
+    # end-to-end deadline (serve/deadline.Deadline), propagated from
+    # the X-Pilosa-Deadline header; checked at translate, before each
+    # per-shard map, and before reduce so expired work never reaches
+    # device dispatch
+    deadline: object | None = None
 
 
 class ExecutionError(ValueError):
@@ -162,6 +173,7 @@ class Executor:
                 # Key translation happens once at the originating node,
                 # never on remote re-execution (reference
                 # executor.Execute, executor.go:146).
+                _deadline.check(opt.deadline, "translate")
                 calls = query.calls
                 if not opt.remote:
                     ts = _time.perf_counter_ns()
@@ -199,6 +211,8 @@ class Executor:
                                        _time.perf_counter_ns() - ts)
         except BaseException as e:
             if rec is not None:
+                if isinstance(e, DeadlineExceededError):
+                    rec.outcome = "expired"
                 self.recorder.publish(rec,
                                       error=f"{type(e).__name__}: {e}")
             raise
@@ -285,34 +299,44 @@ class Executor:
         fan-out must never queue behind the compute pool or behind other
         nodes' sub-queries — distributed latency is max(per-node)."""
         fut = Future()
-        # carry the caller's active span into the IO thread so the
-        # outbound RPC injects the right trace context
+        # carry the caller's active span AND deadline into the IO
+        # thread so the outbound RPC injects the right trace context
+        # and re-serializes the remaining budget on the wire
         parent_span = tracing.current_span()
+        dl = _deadline.current()
 
         def run():
             if not fut.set_running_or_notify_cancel():
                 return
             try:
-                if parent_span is not None:
-                    with tracing.start_span("executor.remoteExec",
-                                            parent=parent_span):
+                with _deadline.scope(dl):
+                    if parent_span is not None:
+                        with tracing.start_span("executor.remoteExec",
+                                                parent=parent_span):
+                            fut.set_result(fn(*args))
+                    else:
                         fut.set_result(fn(*args))
-                else:
-                    fut.set_result(fn(*args))
             except BaseException as e:  # delivered via fut.result()
                 fut.set_exception(e)
 
         threading.Thread(target=run, daemon=True).start()
         return fut
 
-    def _local_map(self, fn, shards):
+    def _local_map(self, fn, shards, deadline=None):
         rec = _observe.current()
-        if rec is not None:
+        if rec is not None or deadline is not None:
             # re-attach the flight record on the pool workers so their
-            # kernel launches tick it, and time each shard's evaluation
+            # kernel launches tick it, time each shard's evaluation,
+            # and bail before a shard whose deadline already expired —
+            # expired work must never reach device dispatch
             inner = fn
 
-            def fn(shard, _inner=inner, _rec=rec):
+            def fn(shard, _inner=inner, _rec=rec, _dl=deadline):
+                if _dl is not None and _dl.expired():
+                    raise DeadlineExceededError(
+                        f"deadline expired before map of shard {shard}")
+                if _rec is None:
+                    return _inner(shard)
                 t0 = _time.perf_counter_ns()
                 with _observe.attach(_rec):
                     out = _inner(shard)
@@ -338,11 +362,17 @@ class Executor:
         fused all-shard evaluation (remote nodes fuse on their own side,
         since remote re-execution is non-clustered)."""
         rec = _observe.current()
+        dl = opt.deadline if opt is not None else None
+        _deadline.check(dl, "map")
         t_map = _time.perf_counter_ns() if rec is not None else 0
         try:
-            return self._map_shards_inner(
+            partials = self._map_shards_inner(
                 fn, shards, idx, call, opt, adapt, remote_call,
                 local_batch_fn, rec)
+            # the reduce boundary: partials whose deadline died in
+            # flight are dropped here, never folded
+            _deadline.check(dl, "reduce")
+            return partials
         finally:
             if rec is not None:
                 # the map stage boundary (reference mapReduce,
@@ -352,9 +382,10 @@ class Executor:
 
     def _map_shards_inner(self, fn, shards, idx, call, opt, adapt,
                           remote_call, local_batch_fn, rec):
+        dl = opt.deadline if opt is not None else None
         if not (self._cluster_active(opt) and idx is not None and call is not None
                 and adapt is not None):
-            return self._local_map(fn, shards)
+            return self._local_map(fn, shards, deadline=dl)
         cluster = self.cluster
         pql = str(call if remote_call is None else remote_call)
         partials = []
@@ -377,10 +408,12 @@ class Executor:
             if cluster.local_id in pending:
                 local_shards = pending.pop(cluster.local_id)
                 t_loc = _time.perf_counter_ns()
+                _deadline.check(dl, "local map")
                 if local_batch_fn is not None and len(local_shards) > 1:
                     partials.extend(local_batch_fn(local_shards))
                 else:
-                    partials.extend(self._local_map(fn, local_shards))
+                    partials.extend(self._local_map(fn, local_shards,
+                                                    deadline=dl))
                 if rec is not None:
                     rec.note_node("local",
                                   _time.perf_counter_ns() - t_loc,
@@ -392,11 +425,18 @@ class Executor:
                 node_id, node_shards, t_sub = inflight.pop(fut)
                 try:
                     res = fut.result()
-                except TransportError:
+                except TransportError as te:
                     for s in node_shards:
                         tried[s].add(node_id)
                         nxt = cluster.next_replica(idx.name, s, tried[s])
                         if nxt is None:
+                            if isinstance(te, ShedByPeerError):
+                                # every replica SHED (admission gates
+                                # saturated cluster-wide): transient
+                                # overload, not missing data — let it
+                                # surface as 503 + Retry-After, never
+                                # the 400 an ExecutionError maps to
+                                raise
                             raise ExecutionError(
                                 f"shard {s} unavailable: all replicas exhausted"
                             )
@@ -612,6 +652,7 @@ class Executor:
         if rec is not None:
             rec.note_path("fused" if fused_ok else "per-shard")
         if fused_ok and not self._cluster_active(opt):
+            _deadline.check(opt.deadline, "map")
             t_f = _time.perf_counter_ns()
             partials = batch_fn(shards)
             if rec is not None:
@@ -807,12 +848,16 @@ class Executor:
         if rec is not None:
             rec.note_path("fused" if fused_ok else "per-shard")
         if fused_ok and not self._cluster_active(opt):
+            _deadline.check(opt.deadline, "map")
             if (self.coalescer is not None
                     and self.coalescer.eligible(opt)):
                 # the coalescer stamps the record itself (path,
-                # batch occupancy, queue-wait vs launch split)
+                # batch occupancy, queue-wait vs launch split) and
+                # drops this entry from the batch if its deadline
+                # dies in the window
                 return self.coalescer.count(self, idx, child,
-                                            tuple(shards))
+                                            tuple(shards),
+                                            deadline=opt.deadline)
             t_f = _time.perf_counter_ns()
             total = sum(batch_fn(shards))
             if rec is not None:
@@ -911,6 +956,7 @@ class Executor:
                                             tuple(group))]
 
         if fused_ok and not self._cluster_active(opt):
+            _deadline.check(opt.deadline, "map")
             parts = batch_fn(shards)
         else:
             parts = self._map_shards(
@@ -1365,6 +1411,7 @@ class Executor:
                 return [self._fused_extreme(idx, f, call, tuple(group))]
 
         if fused_ok and not self._cluster_active(opt):
+            _deadline.check(opt.deadline, "map")
             return batch_fn(shards)[0]
 
         filter_row = self._local_filter_row(idx, call, shards, opt)

@@ -42,6 +42,7 @@ import time
 from pilosa_tpu.parallel.cluster import (
     NODE_DOWN,
     NODE_READY,
+    ShedByPeerError,
     TransportError,
 )
 
@@ -62,6 +63,23 @@ PROBE_DEADLINE_S = float(
 # Dial attempts before declaring a node DOWN (cluster.go:1724 uses 10
 # ×1s; the control plane here is request/response so 3 suffices).
 CONFIRM_RETRIES = 3
+
+
+def _probe_alive_hint(e: Exception) -> bool | None:
+    """Shared liveness classification for probe exceptions: True =
+    the peer ANSWERED over HTTP (any status — shed 429/503, even a
+    500 mid-rolling-upgrade) and is therefore alive; False = the
+    probe was inconclusive (the client's own deadline spent); None =
+    a programming error that must propagate loudly, never silently
+    become a DOWN marking."""
+    from pilosa_tpu.serve.deadline import DeadlineExceededError
+    from pilosa_tpu.server.client import ClientError
+
+    if isinstance(e, ClientError):
+        return True
+    if isinstance(e, DeadlineExceededError):
+        return False
+    return None
 
 
 def _send(transport, target, msg, timeout=None):
@@ -92,8 +110,20 @@ def ping_with_states(node, target, piggyback: bool = True,
     try:
         resp = _send(node.cluster.transport, target, msg, timeout)
         return bool(resp.get("ok")), resp.get("node_states")
+    except ShedByPeerError:
+        # An admission-shed probe (429/503 from the peer's gate) is
+        # PROOF OF LIFE: the peer answered.  Overload must never read
+        # as death, or load shedding would amplify into false DOWN
+        # markings and resize churn.  Checked BEFORE TransportError —
+        # it subclasses it so fan-outs can skip shed peers.
+        return True, None
     except TransportError:
         return False, None
+    except Exception as e:
+        alive = _probe_alive_hint(e)
+        if alive is None:
+            raise
+        return alive, None
 
 
 def indirect_probe(node, target, peers, rng,
@@ -111,6 +141,13 @@ def indirect_probe(node, target, peers, rng,
             if resp.get("ok") and resp.get("alive"):
                 return True
         except TransportError:
+            continue
+        except Exception as e:
+            # a relay that ANSWERED (even with a shed/error status)
+            # could not vouch for the target — try the next relay;
+            # programming errors propagate (_probe_alive_hint None)
+            if _probe_alive_hint(e) is None:
+                raise
             continue
     return False
 

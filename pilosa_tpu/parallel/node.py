@@ -15,6 +15,11 @@ import threading
 from pilosa_tpu.models.field import FieldOptions
 from pilosa_tpu.models.index import IndexOptions
 from pilosa_tpu.parallel.cluster import Cluster, Transport, TransportError
+from pilosa_tpu.serve.admission import tagged
+
+# translate tailing + cleanup verification RPC rides the internal
+# admission class (serve/admission.py)
+_tagged_internal = tagged("internal")
 
 
 class ClusterNode:
@@ -41,16 +46,22 @@ class ClusterNode:
         server.go:666-704).  Unreachable nodes are skipped — anti-entropy
         reconciles them later (the reference returns an error but has no
         rollback either)."""
+        from pilosa_tpu.serve.admission import current_rpc_class, rpc_class
+
         t = self.cluster.transport
         if t is None:
             return
-        for n in self.cluster.sorted_nodes():
-            if n.id == self.cluster.local_id:
-                continue
-            try:
-                t.send_message(n, message)
-            except TransportError:
-                pass
+        # control-plane broadcasts default to the internal class; a
+        # caller that already tagged its scope (the import fan-out's
+        # ingest) keeps its tag
+        with rpc_class(current_rpc_class() or "internal"):
+            for n in self.cluster.sorted_nodes():
+                if n.id == self.cluster.local_id:
+                    continue
+                try:
+                    t.send_message(n, message)
+                except TransportError:
+                    pass
 
     # ----------------------------------------------------- schema helpers
 
@@ -522,6 +533,7 @@ class ClusterNode:
         return self._any_owner_matches(index, field, vname, shard,
                                        local)
 
+    @_tagged_internal
     def _any_owner_matches(self, index: str, field: str, vname: str,
                            shard: int, local: dict) -> bool:
         from pilosa_tpu.parallel.cluster import TransportError
@@ -685,10 +697,18 @@ class ClusterNode:
                      and len(self.cluster.sorted_nodes()) > 1)
         if not clustered or self.cluster.is_coordinator:
             return store.translate_keys(list(keys), create=True)
-        resp = self._forward_to_coordinator({
-            "type": "translate-keys", "index": index, "field": field,
-            "keys": missing,
-        })
+        from pilosa_tpu.serve.admission import current_rpc_class, rpc_class
+
+        # key ALLOCATION serves writes: ride the caller's class when
+        # tagged (import fan-out = ingest), default ingest — never
+        # internal, which yields under query pressure and would make
+        # an already-admitted keyed query fail precisely because the
+        # coordinator is busy with queries (priority inversion)
+        with rpc_class(current_rpc_class() or "ingest"):
+            resp = self._forward_to_coordinator({
+                "type": "translate-keys", "index": index, "field": field,
+                "keys": missing,
+            })
         if not resp.get("ok"):
             raise RuntimeError(
                 f"coordinator key allocation failed: {resp.get('error')}")
@@ -766,7 +786,11 @@ class ClusterNode:
             self._tail_last.pop(key, None)
         return applied
 
+    @_tagged_internal
     def _tail_store(self, index: str, field: str | None, store) -> int:
+        # translate replication (tailing the primary's entry stream)
+        # is internal-class traffic: it may yield under query pressure
+        # and catch up on the next tail, never starving user queries
         coord = self.cluster.node(self.cluster.coordinator_id)
         if coord is None:
             return 0

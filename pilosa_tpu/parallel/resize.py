@@ -29,6 +29,7 @@ from pilosa_tpu.parallel.cluster import (
     TransportError,
     shard_owners,
 )
+from pilosa_tpu.serve.admission import tagged
 
 
 class ResizeError(RuntimeError):
@@ -83,10 +84,13 @@ class Resizer:
         self.node.broadcast({"type": "cluster-status",
                              "status": self.cluster.to_status()})
 
+    @tagged("internal")
     def run(self, add: Node | None = None,
             remove_id: str | None = None) -> dict:
         """Admit/remove a node with data movement.  Returns a summary
-        {transfers: N, nodes: [...]}."""
+        {transfers: N, nodes: [...]}.  Resize control + fragment
+        transfer RPC rides the internal class end to end, so a resize
+        can never starve user queries."""
         c = self.cluster
         if not c.is_coordinator:
             raise ResizeError("resize must run on the coordinator")
@@ -196,6 +200,7 @@ class Resizer:
         self.aborted = True
 
 
+@tagged("internal")
 def follow_resize_instruction(node, msg: dict) -> dict:
     """Destination-side: apply schema, fetch each assigned fragment (all
     views) from its source, import, ack (cluster.go:1297

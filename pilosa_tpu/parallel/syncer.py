@@ -18,6 +18,7 @@ message to that peer alone.
 from __future__ import annotations
 
 from pilosa_tpu.parallel.cluster import TransportError
+from pilosa_tpu.serve.admission import tagged
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
@@ -41,8 +42,12 @@ class FragmentSyncer:
         return self.node.local_fragment(self.index, self.field, self.view,
                                         self.shard, create)
 
+    @tagged("internal")
     def sync(self) -> int:
-        """Returns the number of blocks reconciled (0 = replicas agree)."""
+        """Returns the number of blocks reconciled (0 = replicas
+        agree).  Anti-entropy RPC rides the internal class: it can
+        shed under query pressure (the next AE round reconverges) but
+        can never occupy a query slot on the peer."""
         frag = self._local_fragment()
         local_blocks = {} if frag is None else {
             b["id"]: b["checksum"] for b in frag.blocks()
@@ -132,6 +137,7 @@ class HolderSyncer:
         self.node = node
         self.cluster = node.cluster
 
+    @tagged("internal")
     def sync_holder(self) -> int:
         if self.cluster.replica_n < 2:
             return 0

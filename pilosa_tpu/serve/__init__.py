@@ -1,0 +1,20 @@
+"""Serving-path protection: admission control + deadline propagation.
+
+The accept-side gate between the HTTP surface (server/handler.py) and
+the device dispatch path (parallel/executor.py, parallel/coalescer.py):
+per-class concurrency caps with bounded wait queues (admission.py) and
+end-to-end request deadlines (deadline.py) so overload degrades to
+honest 429/503 + Retry-After instead of unbounded queueing, and
+expired work is dropped before it ever reaches a device launch.
+"""
+
+from pilosa_tpu.serve.admission import (  # noqa: F401
+    AdmissionController,
+    CLASSES,
+    ShedError,
+    rpc_class,
+)
+from pilosa_tpu.serve.deadline import (  # noqa: F401
+    Deadline,
+    DeadlineExceededError,
+)

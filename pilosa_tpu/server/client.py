@@ -9,9 +9,19 @@ Transport adapter that plugs it into the cluster layer.
 from __future__ import annotations
 
 import json
+import random
 import threading
+import time
 
-from pilosa_tpu.parallel.cluster import Node, Transport, TransportError
+from pilosa_tpu.parallel.cluster import (
+    Node,
+    ShedByPeerError,
+    Transport,
+    TransportError,
+)
+from pilosa_tpu.serve import admission as _admission
+from pilosa_tpu.serve import deadline as _deadline
+from pilosa_tpu.serve.deadline import DeadlineExceededError
 
 
 class ClientError(RuntimeError):
@@ -26,6 +36,16 @@ class InternalClient:
 
     #: idle keep-alive connections retained per (scheme, host)
     MAX_IDLE_PER_HOST = 8
+
+    #: shed-retry policy: a 429/503 with Retry-After is retried at most
+    #: this many times, each sleep capped here (with up-to-25% jitter
+    #: so a shed burst does not re-arrive in lockstep) and always
+    #: bounded by the caller's remaining deadline
+    MAX_SHED_RETRIES = 3
+    RETRY_AFTER_CAP_S = 2.0
+
+    #: injectable for tests (class attr so instances share the default)
+    _sleep = staticmethod(time.sleep)
 
     def __init__(self, timeout: float = 30.0,
                  tls_skip_verify: bool = False):
@@ -105,7 +125,8 @@ class InternalClient:
                  ctype: str = "application/json",
                  accept: str | None = None,
                  error_decoder=None,
-                 timeout: float | None = None) -> bytes:
+                 timeout: float | None = None,
+                 retry_shed: bool = True) -> bytes:
         """One transport path for JSON and protobuf requests over
         pooled keep-alive connections; ``error_decoder(raw) -> str``
         extracts the error detail from a non-2xx body (default: JSON
@@ -122,6 +143,22 @@ class InternalClient:
         from pilosa_tpu import tracing
 
         headers.update(tracing.inject_headers())  # trace follows the RPC
+        # admission class follows the RPC too: call sites wrapped in
+        # serve.admission.rpc_class (syncer/resize/replication =
+        # internal, import fan-out = ingest) land in the right gate on
+        # the receiving node
+        klass = _admission.current_rpc_class()
+        if klass is not None:
+            headers["X-Pilosa-Class"] = klass
+        # the caller's budget: an active deadline scope wins; otherwise
+        # the request timeout IS the budget (the deadline header is
+        # derived from it so the server never works past the point this
+        # client would have hung up)
+        dl = _deadline.current()
+        budget_end = (dl.expires_mono if dl is not None
+                      else time.monotonic()
+                      + (self.timeout if timeout is None else timeout))
+        shed_retries = 0
         import http.client as _hc
 
         # Disconnect-class failures on a POOLED connection retry on the
@@ -136,22 +173,33 @@ class InternalClient:
                   _hc.CannotSendRequest, BrokenPipeError,
                   ConnectionResetError, ConnectionAbortedError)
         while True:
+            remaining = budget_end - time.monotonic()
+            if remaining <= 0:
+                # the caller's deadline is spent: stop, never silently
+                # outlive short budgets on the flat request timeout
+                raise DeadlineExceededError(
+                    f"caller deadline spent before request to {url}")
+            headers[_deadline.HEADER] = f"{remaining:.3f}"
+            # effective socket timeout: the per-call override (or the
+            # pooled default) CLAMPED to the caller's remaining budget
+            # — a stalled peer must not hold this thread (and its
+            # admission slot) 30s past an expired deadline
+            eff_timeout = min(self.timeout if timeout is None
+                              else timeout, remaining)
             conn = None
             pooled = False
             try:
                 # _acquire may CONNECT (refused/unreachable raises here,
                 # inside the same error mapping as request IO)
                 conn, pooled = self._acquire(parts.scheme, parts.netloc,
-                                             timeout)
-                if timeout is not None and conn.sock is not None:
-                    # per-call override (membership probes need dials
-                    # far shorter than the pooled default); restored
-                    # before the connection re-pools below
-                    conn.sock.settimeout(timeout)
+                                             eff_timeout)
+                if conn.sock is not None:
+                    # restored before the connection re-pools below
+                    conn.sock.settimeout(eff_timeout)
                 conn.request(method, path, body=body, headers=headers)
                 resp = conn.getresponse()
                 raw = resp.read()
-                if timeout is not None and conn.sock is not None:
+                if conn.sock is not None:
                     conn.sock.settimeout(self.timeout)
             except (ConnectionError, TimeoutError, OSError,
                     _hc.HTTPException) as e:
@@ -168,6 +216,17 @@ class InternalClient:
                 conn.close()
             else:
                 self._release(parts.scheme, parts.netloc, conn)
+            if retry_shed and resp.status in (429, 503):
+                # the peer shed this request (admission control);
+                # honor Retry-After with a cap + jitter, bounded by
+                # the caller's remaining budget
+                delay = self._shed_delay(resp.getheader("Retry-After"))
+                if (shed_retries < self.MAX_SHED_RETRIES
+                        and delay is not None
+                        and budget_end - time.monotonic() > delay):
+                    shed_retries += 1
+                    self._sleep(delay)
+                    continue
             if resp.status >= 400:
                 detail = ""
                 try:
@@ -177,9 +236,34 @@ class InternalClient:
                         detail = json.loads(raw).get("error", "")
                 except Exception:
                     pass
+                if (resp.status in (429, 503)
+                        and resp.getheader("Retry-After") is not None):
+                    # the peer's admission gate shed this request:
+                    # a TransportError subclass so best-effort
+                    # fan-outs skip the overloaded peer like an
+                    # unreachable one, while liveness checks can
+                    # still read it as proof of life
+                    raise ShedByPeerError(
+                        f"shed by peer: {url}: "
+                        f"{detail or f'http {resp.status}'}",
+                        resp.status)
                 raise ClientError(resp.status,
                                   detail or f"http {resp.status}")
             return raw
+
+    @classmethod
+    def _shed_delay(cls, retry_after: str | None) -> float | None:
+        """Retry-After header -> sleep seconds (capped, jittered), or
+        None when the response carried no usable hint — a 503 without
+        Retry-After is not an admission shed and is not retried."""
+        if retry_after is None:
+            return None
+        try:
+            base = float(retry_after)
+        except ValueError:
+            return None
+        base = min(max(base, 0.0), cls.RETRY_AFTER_CAP_S)
+        return base * (1.0 + 0.25 * random.random())
 
     def _json(self, method: str, url: str, obj=None):
         body = None if obj is None else json.dumps(obj).encode()
@@ -217,10 +301,12 @@ class InternalClient:
         return [proto.proto_to_result(r) for r in d["results"]]
 
     def send_message(self, uri: str, message: dict,
-                     timeout: float | None = None) -> dict:
+                     timeout: float | None = None,
+                     retry_shed: bool = True) -> dict:
         body = json.dumps(message).encode()
         raw = self._request("POST", f"{uri}/internal/cluster/message",
-                            body, timeout=timeout)
+                            body, timeout=timeout,
+                            retry_shed=retry_shed)
         return json.loads(raw or b"null")
 
     # ------------------------------------------------------------- schema
@@ -332,6 +418,11 @@ class HTTPTransport(Transport):
                              timeout: float) -> dict:
         """Bounded-dial variant for membership probes: a dead host
         that swallows packets must fail the ping at the probe budget,
-        not the pooled connection's 30 s default."""
+        not the pooled connection's 30 s default.  Shed responses are
+        NOT retried here — a 429/503 from the peer's admission gate is
+        already proof of life (membership treats it as such), and
+        sleeping out Retry-After inside the failure detector would
+        stall the SWIM round exactly when the cluster is overloaded."""
         return self.client.send_message(node.uri, message,
-                                        timeout=timeout)
+                                        timeout=timeout,
+                                        retry_shed=False)

@@ -34,7 +34,11 @@ from pilosa_tpu.api import (
 from pilosa_tpu.models.field import FieldOptions
 from pilosa_tpu.models.index import IndexOptions
 from pilosa_tpu.models.row import Row
+from pilosa_tpu.parallel.cluster import ShedByPeerError
 from pilosa_tpu.parallel.results import GroupCount, Pair, PairField, ValCount
+from pilosa_tpu.serve import admission as _admission
+from pilosa_tpu.serve import deadline as _deadline
+from pilosa_tpu.serve.deadline import DeadlineExceededError
 
 
 def serialize_result(res):
@@ -136,19 +140,23 @@ def _field_row_dict(fr) -> dict:
 # imports, small enough that one request cannot exhaust host memory.
 MAX_REQUEST_BYTES = 256 << 20
 
-# (method, compiled path regex) -> handler-method name
-_ROUTES: list[tuple[str, re.Pattern, str]] = []
+# (method, compiled path regex, handler-method name, admission class)
+_ROUTES: list[tuple[str, re.Pattern, str, str | None]] = []
 
 
-def route(method: str, pattern: str):
+def route(method: str, pattern: str, klass: str | None = None):
     """Register a route; `{name}` segments capture path params
-    (the gorilla/mux analog, http/handler.go:273)."""
+    (the gorilla/mux analog, http/handler.go:273).  ``klass`` assigns
+    the route's admission class (serve/admission.py): ``query`` for
+    user PQL, ``ingest`` for imports, ``internal`` for node-to-node
+    RPC; None leaves the route ungated (cheap control-plane and debug
+    surfaces)."""
     rx = re.compile(
         "^" + re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern) + "$"
     )
 
     def deco(fn):
-        _ROUTES.append((method, rx, fn.__name__))
+        _ROUTES.append((method, rx, fn.__name__, klass))
         return fn
 
     return deco
@@ -158,13 +166,39 @@ class Handler:
     """Routes HTTP requests to an API instance and serves forever on a
     background thread (http/handler.go:46)."""
 
+    #: accept-side headroom above the admission gate's capacity for
+    #: ungated infra routes (/metrics, /debug/*, schema) and idle
+    #: keep-alive connections.  NOTE the cap counts CONNECTIONS (each
+    #: holds one handler thread for its lifetime — that is the
+    #: resource being bounded), not active requests: a large fleet of
+    #: idle keep-alive clients consumes headroom even while the
+    #: admission gate is empty.  Idle connections are reaped by the
+    #: per-connection 60 s read timeout, so the steady state tracks
+    #: live clients; size the headroom for the expected client pool
+    #: (MAX_IDLE_PER_HOST per peer node + monitoring scrapers).
+    ACCEPT_HEADROOM = 64
+
     def __init__(self, api: API, host: str = "127.0.0.1", port: int = 0,
                  stats=None, tracer=None, tls_cert: str | None = None,
-                 tls_key: str | None = None, heap_frames: int = 4):
+                 tls_key: str | None = None, heap_frames: int = 4,
+                 admission=None, max_threads: int | None = None):
         self.api = api
         self.stats = stats
         self.tracer = tracer
         self.heap_frames = heap_frames  # ?start=1 tracemalloc depth
+        # admission gate (serve/admission.AdmissionController) — the
+        # only accept-side gate between HTTP and device dispatch
+        self.admission = admission
+        # cap on in-flight handler threads: a connection flood degrades
+        # to fast 503s instead of thread exhaustion.  Defaults to the
+        # admission gate's total capacity (sum of class caps + queue
+        # depths) + headroom; None disables the cap.
+        if max_threads is None and admission is not None \
+                and admission.enabled:
+            max_threads = admission.total_capacity() + self.ACCEPT_HEADROOM
+        self.max_threads = max_threads
+        self._threads_lock = threading.Lock()
+        self._threads_active = 0
         # optional zero-arg callable returning the latest released
         # version string (diagnostics.check_version); None = the
         # local-only default, never phones home
@@ -209,6 +243,31 @@ class Handler:
             # the arrival pattern the query coalescer exists to serve
             request_queue_size = 128
 
+            def process_request(self, request, client_address):
+                # accept-side thread cap: past the limit, refuse with a
+                # fast 503 written from the accept loop (bounded by a
+                # short socket timeout) instead of spawning yet another
+                # thread — a connection flood degrades to fast refusals
+                # rather than thread exhaustion
+                if not handler_self._thread_slot_acquire():
+                    handler_self._refuse_connection(request)
+                    self.shutdown_request(request)
+                    return
+                try:
+                    super().process_request(request, client_address)
+                except BaseException:
+                    # the worker thread never started; its release in
+                    # process_request_thread will not run
+                    handler_self._thread_slot_release()
+                    raise
+
+            def process_request_thread(self, request, client_address):
+                try:
+                    super().process_request_thread(request,
+                                                   client_address)
+                finally:
+                    handler_self._thread_slot_release()
+
         self.httpd = _Srv((host, port), _Req)
         # close() must not block on handler threads parked in idle
         # keep-alive reads (daemon threads die with the process; bounded
@@ -248,13 +307,59 @@ class Handler:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    # --------------------------------------------------- accept-side cap
+
+    def _thread_slot_acquire(self) -> bool:
+        if self.max_threads is None:
+            return True
+        with self._threads_lock:
+            if self._threads_active < self.max_threads:
+                self._threads_active += 1
+                return True
+        # stats OUTSIDE the lock every accept contends on, and
+        # exception-guarded: a slow or raising backend must neither
+        # serialize the accept path nor swallow the raw 503 refusal
+        if self.stats is not None:
+            try:
+                self.stats.count("admission.accept_503", 1)
+            except Exception:  # noqa: BLE001
+                pass
+        return False
+
+    def _thread_slot_release(self) -> None:
+        if self.max_threads is None:
+            return
+        with self._threads_lock:
+            self._threads_active -= 1
+
+    _REFUSE_BODY = b'{"error":"server overloaded"}'
+    _REFUSE_RESPONSE = (
+        b"HTTP/1.1 503 Service Unavailable\r\n"
+        b"Content-Type: application/json\r\n"
+        b"Content-Length: " + str(len(_REFUSE_BODY)).encode() + b"\r\n"
+        b"Retry-After: 1\r\n"
+        b"Connection: close\r\n\r\n" + _REFUSE_BODY)
+
+    def _refuse_connection(self, request) -> None:
+        """Best-effort raw 503 from the accept loop (short timeout so a
+        stalled client cannot hang accepts).  TLS sockets have not
+        handshaken yet (do_handshake_on_connect=False), so they just
+        close — a plaintext 503 would read as a protocol error."""
+        if self.tls:
+            return
+        try:
+            request.settimeout(1.0)
+            request.sendall(self._REFUSE_RESPONSE)
+        except OSError:
+            pass
+
     # ------------------------------------------------------------ plumbing
 
     def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
         parsed = urlparse(req.path)
         path = parsed.path.rstrip("/") or "/"
         params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
-        for m, rx, name in _ROUTES:
+        for m, rx, name, klass in _ROUTES:
             if m != method:
                 continue
             match = rx.match(path)
@@ -263,6 +368,49 @@ class Handler:
             if self.stats is not None:
                 self.stats.count_with_tags("http.request", 1, 1.0,
                                            [f"useragent:{req.headers.get('User-Agent', '')}"])
+            # deadline + admission run BEFORE the body is read: a shed
+            # request must not pay a 256MB body upload first (the
+            # unread body forces the connection closed, like 413)
+            dl_hdr = req.headers.get(_deadline.HEADER)
+            dl = None
+            if dl_hdr is not None:
+                try:
+                    dl = _deadline.parse_header(dl_hdr)
+                except ValueError:
+                    # the body stays unread (like 413/shed): the
+                    # keep-alive connection must close or its bytes
+                    # would parse as the next request
+                    req.close_connection = True
+                    self._error(req, 400,
+                                f"invalid {_deadline.HEADER} header: "
+                                f"{dl_hdr!r}")
+                    return
+            ticket = None
+            if self.admission is not None and klass is not None:
+                k = klass
+                if klass == "internal":
+                    # node-to-node routes accept ONE class re-tag (the
+                    # X-Pilosa-Class stamped by serve.admission
+                    # rpc_class at the call site) so import replica
+                    # deliveries and key allocation ride the ingest
+                    # gate, not internal.  "query" is deliberately NOT
+                    # honored — a header must never let internal
+                    # traffic jump into the highest-priority gate.
+                    if req.headers.get("X-Pilosa-Class") == "ingest":
+                        k = "ingest"
+                if dl is None and self.admission.default_deadline > 0:
+                    dl = _deadline.Deadline(
+                        self.admission.default_deadline)
+                try:
+                    ticket = self.admission.acquire(k, dl)
+                except _admission.ShedError as e:
+                    self._record_shed(
+                        match.groupdict().get("index", path), k, e)
+                    req.close_connection = True
+                    self._error(req, e.status, str(e),
+                                headers={"Retry-After":
+                                         str(e.retry_after)})
+                    return
             try:
                 body = b""
                 length = int(req.headers.get("Content-Length") or 0)
@@ -281,11 +429,14 @@ class Handler:
                 # reference's tracing middleware, http/handler.go:321);
                 # entering the span makes it the parent of every span
                 # the handler starts (api.*, executor.*)
-                from pilosa_tpu import tracing
+                from pilosa_tpu import observe, tracing
 
                 parent = tracing.extract_headers(req.headers)
+                adm = ticket.info() if ticket is not None else None
                 with tracing.start_span(f"http.{name}",
-                                        parent=parent) as span:
+                                        parent=parent) as span, \
+                        _deadline.scope(dl), \
+                        observe.admission_scope(adm):
                     span.set_tag("http.path", path)
                     getattr(self, name)(req, params, match.groupdict(),
                                         body)
@@ -295,18 +446,56 @@ class Handler:
                 self._error(req, 409, str(e))
             except ApiMethodNotAllowedError as e:
                 self._error(req, 405, str(e))
+            except DeadlineExceededError as e:
+                # admitted but expired mid-execution: the executor's
+                # stage checks dropped it before device dispatch
+                if self.admission is not None and ticket is not None:
+                    self.admission.count_expired(ticket.klass)
+                self._error(req, 503, str(e))
             except (ApiError, ValueError, KeyError, TypeError) as e:
                 self._error(req, 400, str(e))
+            except ShedByPeerError as e:
+                # a remote sub-request was shed by a peer's admission
+                # gate (and the client's retries are exhausted):
+                # surface overload honestly, with a back-off signal,
+                # instead of masking it as a 500
+                self._error(req, 503, str(e),
+                            headers={"Retry-After": "1"})
             except Exception as e:  # internal error; keep serving
-                self._error(req, 500, f"{type(e).__name__}: {e}")
+                from pilosa_tpu.server.client import ClientError
+
+                if (isinstance(e, ClientError)
+                        and e.status in (429, 503)):
+                    # a shed that reached us as a raw ClientError
+                    # (non-standard transport) still reads as overload
+                    self._error(req, 503, str(e))
+                else:
+                    self._error(req, 500, f"{type(e).__name__}: {e}")
+            finally:
+                if ticket is not None:
+                    ticket.release()
             return
         self._error(req, 404, "not found")
 
-    def _json(self, req, obj, status: int = 200) -> None:
+    def _record_shed(self, index: str, klass: str,
+                     e: "_admission.ShedError") -> None:
+        """Shed requests never execute, so the flight recorder is told
+        directly — /debug/queries and the slow-query log must show the
+        overload story (outcome ``shed``/``expired``, with the queue
+        wait the request burned before the refusal)."""
+        recorder = getattr(self.api.executor, "recorder", None)
+        if recorder is not None:
+            recorder.record_shed(index, "", klass, e.outcome, str(e),
+                                 wait_ns=e.wait_ns)
+
+    def _json(self, req, obj, status: int = 200,
+              headers: dict | None = None) -> None:
         data = json.dumps(obj).encode()
         req.send_response(status)
         req.send_header("Content-Type", "application/json")
         req.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            req.send_header(k, v)
         req.end_headers()
         req.wfile.write(data)
 
@@ -318,9 +507,10 @@ class Handler:
         req.end_headers()
         req.wfile.write(data)
 
-    def _error(self, req, status: int, msg: str) -> None:
+    def _error(self, req, status: int, msg: str,
+               headers: dict | None = None) -> None:
         try:
-            self._json(req, {"error": msg}, status)
+            self._json(req, {"error": msg}, status, headers=headers)
         except (BrokenPipeError, ConnectionResetError):
             pass
 
@@ -372,7 +562,7 @@ class Handler:
             remote=params.get("remote") == "true")
         self._json(req, {})
 
-    @route("POST", "/internal/translate/keys")
+    @route("POST", "/internal/translate/keys", klass="ingest")
     def handle_translate_keys(self, req, params, path, body):
         """Key -> id translation RPC (reference handlePostTranslateKeys;
         wire form TranslateKeysRequest/Response).  Accepts protobuf or
@@ -408,7 +598,7 @@ class Handler:
         self.api.apply_schema(d.get("indexes", []))
         self._json(req, {})
 
-    @route("POST", "/index/{index}/query")
+    @route("POST", "/index/{index}/query", klass="query")
     def handle_post_query(self, req, params, path, body):
         """PQL query with content negotiation: raw-PQL or JSON bodies
         answered in JSON, ``application/x-protobuf`` QueryRequest bodies
@@ -570,7 +760,8 @@ class Handler:
         self.api.delete_field(path["index"], path["field"])
         self._json(req, {})
 
-    @route("POST", "/index/{index}/field/{field}/import")
+    @route("POST", "/index/{index}/field/{field}/import",
+       klass="ingest")
     def handle_import(self, req, params, path, body):
         """Bit import: JSON {"rowIDs": [...], "columnIDs": [...],
         "timestamps": [...], "rowKeys": [...], "columnKeys": [...]} or a
@@ -612,7 +803,8 @@ class Handler:
         )
         self._import_ok(req)
 
-    @route("POST", "/index/{index}/field/{field}/import-value")
+    @route("POST", "/index/{index}/field/{field}/import-value",
+       klass="ingest")
     def handle_import_value(self, req, params, path, body):
         if "protobuf" in req.headers.get("Content-Type", ""):
             from pilosa_tpu import proto
@@ -629,7 +821,8 @@ class Handler:
         )
         self._import_ok(req)
 
-    @route("POST", "/index/{index}/field/{field}/import-roaring/{shard}")
+    @route("POST", "/index/{index}/field/{field}/import-roaring/{shard}",
+       klass="ingest")
     def handle_import_roaring(self, req, params, path, body):
         """Binary roaring import.  Body: raw roaring bytes for the
         standard view, or JSON {"views": {name: base64}}
@@ -654,7 +847,7 @@ class Handler:
                                 remote=params.get("remote") == "true")
         self._import_ok(req)
 
-    @route("GET", "/export")
+    @route("GET", "/export", klass="query")
     def handle_export(self, req, params, path, body):
         buf = io.StringIO()
         self.api.export_csv(params["index"], params["field"],
@@ -663,7 +856,7 @@ class Handler:
 
     # ---------------------------------------------------- internal routes
 
-    @route("POST", "/internal/cluster/message")
+    @route("POST", "/internal/cluster/message", klass="internal")
     def handle_cluster_message(self, req, params, path, body):
         resp = self.api.node.receive_message(json.loads(body))
         self._json(req, resp)
@@ -677,28 +870,28 @@ class Handler:
         self._json(req, self.api.shard_nodes(params["index"],
                                              int(params["shard"])))
 
-    @route("GET", "/internal/fragment/blocks")
+    @route("GET", "/internal/fragment/blocks", klass="internal")
     def handle_fragment_blocks(self, req, params, path, body):
         blocks = self.api.fragment_blocks(
             params["index"], params["field"], params["view"],
             int(params["shard"]))
         self._json(req, {"blocks": blocks})
 
-    @route("GET", "/internal/fragment/block/data")
+    @route("GET", "/internal/fragment/block/data", klass="internal")
     def handle_fragment_block_data(self, req, params, path, body):
         rows, cols = self.api.fragment_block_data(
             params["index"], params["field"], params["view"],
             int(params["shard"]), int(params["block"]))
         self._json(req, {"rowIDs": rows, "columnIDs": cols})
 
-    @route("GET", "/internal/fragment/data")
+    @route("GET", "/internal/fragment/data", klass="internal")
     def handle_fragment_data(self, req, params, path, body):
         data = self.api.fragment_data(
             params["index"], params["field"], params["view"],
             int(params["shard"]))
         self._bytes(req, data)
 
-    @route("GET", "/internal/translate/data")
+    @route("GET", "/internal/translate/data", klass="internal")
     def handle_translate_data(self, req, params, path, body):
         entries = self.api.translate_data(
             params["index"], params.get("field"),
@@ -914,6 +1107,21 @@ class Handler:
             "active": prepare(recorder.active_records()),
             "recent": prepare(recorder.recent_records()),
         })
+
+    @route("GET", "/debug/admission")
+    def handle_debug_admission(self, req, params, path, body):
+        """Admission-gate state: per-class caps, in-flight counts,
+        queue depths, EWMA service times, and shed/expired totals
+        (serve/admission.AdmissionController.debug)."""
+        if self.admission is None:
+            self._json(req, {"enabled": False})
+            return
+        out = self.admission.debug()
+        out["acceptThreads"] = {
+            "active": self._threads_active,
+            "max": self.max_threads,
+        }
+        self._json(req, out)
 
     @route("GET", "/debug/vars")
     def handle_debug_vars(self, req, params, path, body):

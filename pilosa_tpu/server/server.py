@@ -58,6 +58,14 @@ class Server:
         observe_enabled: bool = True,
         observe_recent: int = 256,
         observe_long_query_time: float = 0.0,
+        admission_enabled: bool = True,
+        admission_query_cap: int = 32,
+        admission_query_queue: int = 128,
+        admission_ingest_cap: int = 16,
+        admission_ingest_queue: int = 64,
+        admission_internal_cap: int = 16,
+        admission_internal_queue: int = 64,
+        admission_default_deadline: float = 0.0,
     ):
         from pilosa_tpu import logger as _logger
         from pilosa_tpu import stats as _stats
@@ -130,10 +138,26 @@ class Server:
             self.cluster.local_node.is_coordinator = True
         self.api = API(self.node)
         self.api.max_writes_per_request = max_writes_per_request
+        # admission control ([admission] config): priority-classed
+        # gating + load shedding between accept and device dispatch
+        from pilosa_tpu.serve.admission import AdmissionController
+
+        self.admission = AdmissionController(
+            query_cap=admission_query_cap,
+            query_queue=admission_query_queue,
+            ingest_cap=admission_ingest_cap,
+            ingest_queue=admission_ingest_queue,
+            internal_cap=admission_internal_cap,
+            internal_queue=admission_internal_queue,
+            default_deadline=admission_default_deadline,
+            enabled=admission_enabled,
+            stats=self.stats,
+        )
         self.handler = Handler(self.api, host=host, port=port,
                                stats=self.stats, tracer=tracer,
                                tls_cert=tls_cert, tls_key=tls_key,
-                               heap_frames=heap_profile_frames)
+                               heap_frames=heap_profile_frames,
+                               admission=self.admission)
         self.cluster.local_node.uri = self.handler.uri
         from pilosa_tpu.diagnostics import RuntimeMonitor
 

@@ -1,0 +1,233 @@
+"""Open-loop load generator for the admission-controlled serving path.
+
+Open-loop means arrivals are scheduled by a fixed clock (target QPS),
+NOT by completions — the generator keeps firing even while earlier
+requests queue or shed, which is what real overload looks like (a
+closed-loop generator self-throttles and can never push a server past
+saturation, hiding exactly the regime admission control exists for).
+
+Each request draws an admission class from the configured mix and a
+deadline from the configured distribution (sent as the
+``X-Pilosa-Deadline`` header).  The report carries goodput (completed
+OK per second), shed/expired rates, and latency percentiles of the
+*admitted* requests — the numbers the [admission] acceptance criteria
+pin (p99 of admitted stays bounded under 2x-capacity overload while
+overflow sheds with 429/503 + Retry-After).
+
+CLI::
+
+    python -m tools.loadgen --host http://127.0.0.1:10101 -i myindex \
+        --qps 200 --seconds 5 --query 'Count(Row(f=1))' \
+        --mix query=0.9,ingest=0.1 --deadline-ms 50,500
+
+Importable: ``run_load(...)`` returns the report dict (used by
+tests/test_admission.py to drive a server at 2x capacity).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+#: class -> request builder is fixed: queries POST PQL, ingest POSTs a
+#: tiny import.  ``internal`` posts a cluster control message (a cheap
+#: attr-blocks probe) — enough to occupy an internal slot.
+DEFAULT_MIX = {"query": 1.0}
+
+
+class _Stats:
+    """Thread-safe accumulation of per-request outcomes."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.ok_latencies: list[float] = []
+        self.sent = 0
+        self.ok = 0
+        self.shed = 0
+        self.expired = 0
+        self.errors = 0
+        self.retry_after_seen = 0
+
+    def note(self, outcome: str, latency_s: float,
+             retry_after: bool) -> None:
+        with self.lock:
+            self.sent += 1
+            if retry_after:
+                self.retry_after_seen += 1
+            if outcome == "ok":
+                self.ok += 1
+                self.ok_latencies.append(latency_s)
+            elif outcome == "shed":
+                self.shed += 1
+            elif outcome == "expired":
+                self.expired += 1
+            else:
+                self.errors += 1
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return sorted_vals[i]
+
+
+def _build_request(host: str, index: str, klass: str, query: str,
+                   deadline_s: float | None):
+    if klass == "ingest":
+        url = f"{host}/index/{index}/field/loadgen/import"
+        col = random.randrange(1 << 20)
+        body = json.dumps({"rowIDs": [1], "columnIDs": [col]}).encode()
+    elif klass == "internal":
+        url = f"{host}/internal/cluster/message"
+        body = json.dumps({"type": "attr-blocks", "index": index,
+                           "field": None}).encode()
+    else:
+        url = f"{host}/index/{index}/query"
+        body = json.dumps({"query": query}).encode()
+    req = urllib.request.Request(url, data=body, method="POST")
+    req.add_header("Content-Type", "application/json")
+    if deadline_s is not None:
+        req.add_header("X-Pilosa-Deadline", f"{deadline_s:.3f}")
+    return req
+
+
+def _fire(req, timeout: float, stats: _Stats) -> None:
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            resp.read()
+        stats.note("ok", time.perf_counter() - t0, False)
+    except urllib.error.HTTPError as e:
+        body = b""
+        try:
+            body = e.read()
+        except OSError:
+            pass
+        retry_after = e.headers.get("Retry-After") is not None
+        if e.code in (429, 503):
+            outcome = "expired" if b"expired" in body else "shed"
+        else:
+            outcome = "error"
+        stats.note(outcome, time.perf_counter() - t0, retry_after)
+    except Exception:
+        stats.note("error", time.perf_counter() - t0, False)
+
+
+def run_load(host: str, index: str, qps: float, seconds: float,
+             query: str = "Count(Row(f=1))",
+             mix: dict[str, float] | None = None,
+             deadline_s: tuple[float, float] | None = None,
+             timeout: float = 10.0, pool: int = 32) -> dict:
+    """Drive ``host`` open-loop at ``qps`` for ``seconds``; returns the
+    report dict.  ``mix`` maps class -> weight; ``deadline_s`` is a
+    (lo, hi) uniform range for the per-request deadline header (None =
+    no deadline sent).
+
+    A fixed pool of ``pool`` workers fires the scheduled arrivals —
+    NOT a thread per request: hundreds of short-lived Python threads
+    distort the latency measurement itself (threads get descheduled
+    between their start and their send, inflating p99 with client-side
+    GIL waits that have nothing to do with the server).  The pool stays
+    open-loop as long as in-flight requests < pool — true under
+    admission control, where overflow is refused in milliseconds; when
+    the pool ever falls behind an arrival by >50ms the report's
+    ``late`` counter says so instead of silently closing the loop."""
+    import queue as _queue
+
+    mix = mix or DEFAULT_MIX
+    classes = list(mix)
+    weights = [mix[c] for c in classes]
+    stats = _Stats()
+    n = int(qps * seconds)
+    jobs: _queue.Queue = _queue.Queue()
+    late = [0]
+    late_lock = threading.Lock()
+
+    def worker():
+        while True:
+            item = jobs.get()
+            if item is None:
+                return
+            due, req = item
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            elif delay < -0.05:
+                with late_lock:
+                    late[0] += 1
+            _fire(req, timeout, stats)
+
+    workers = [threading.Thread(target=worker, daemon=True)
+               for _ in range(pool)]
+    for w in workers:
+        w.start()
+    start = time.perf_counter()
+    for i in range(n):
+        due = start + i / qps
+        klass = random.choices(classes, weights)[0]
+        dl = (random.uniform(*deadline_s)
+              if deadline_s is not None else None)
+        jobs.put((due, _build_request(host, index, klass, query, dl)))
+    for _ in workers:
+        jobs.put(None)
+    for w in workers:
+        w.join(seconds + n * timeout)
+    elapsed = time.perf_counter() - start
+    lat = sorted(stats.ok_latencies)
+    return {
+        "target_qps": qps,
+        "seconds": round(elapsed, 3),
+        "sent": stats.sent,
+        "ok": stats.ok,
+        "shed": stats.shed,
+        "expired": stats.expired,
+        "errors": stats.errors,
+        "late": late[0],
+        "goodput_qps": round(stats.ok / elapsed, 2) if elapsed else 0.0,
+        "shed_rate": round((stats.shed + stats.expired)
+                           / max(1, stats.sent), 4),
+        "retry_after_seen": stats.retry_after_seen,
+        "p50_ms": round(_percentile(lat, 0.50) * 1e3, 2),
+        "p99_ms": round(_percentile(lat, 0.99) * 1e3, 2),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        description="open-loop load generator (admission control)")
+    p.add_argument("--host", default="http://127.0.0.1:10101")
+    p.add_argument("-i", "--index", required=True)
+    p.add_argument("--qps", type=float, default=100.0)
+    p.add_argument("--seconds", type=float, default=5.0)
+    p.add_argument("--query", default="Count(Row(f=1))")
+    p.add_argument("--mix", default="query=1.0",
+                   help="class=weight[,class=weight...] over "
+                        "query/ingest/internal")
+    p.add_argument("--deadline-ms", default=None,
+                   help="lo,hi uniform per-request deadline in ms "
+                        "(default: none sent)")
+    p.add_argument("--timeout", type=float, default=10.0)
+    args = p.parse_args(argv)
+    mix = {}
+    for part in args.mix.split(","):
+        k, _, w = part.partition("=")
+        mix[k.strip()] = float(w or 1.0)
+    deadline_s = None
+    if args.deadline_ms:
+        lo, _, hi = args.deadline_ms.partition(",")
+        deadline_s = (float(lo) / 1e3, float(hi or lo) / 1e3)
+    report = run_load(args.host.rstrip("/"), args.index, args.qps,
+                      args.seconds, query=args.query, mix=mix,
+                      deadline_s=deadline_s, timeout=args.timeout)
+    print(json.dumps(report, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
