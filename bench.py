@@ -350,7 +350,8 @@ def _bench_batched_and_floor(a, b, a_np: np.ndarray,
     return extras
 
 
-def bench_coalescer(a_np: np.ndarray, b_np: np.ndarray) -> tuple[dict, dict] | None:
+def bench_coalescer(a_np: np.ndarray,
+                    b_np: np.ndarray) -> tuple[dict, dict, dict] | None:
     """Serving-path benchmark of the PRODUCT batching layer: concurrent
     `Count(Intersect(Row, Row))` PQL queries through the executor with
     the cross-query coalescer (parallel/coalescer.py) enabled — the
@@ -369,9 +370,9 @@ def bench_coalescer(a_np: np.ndarray, b_np: np.ndarray) -> tuple[dict, dict] | N
     observe layer).  The headline coalescer numbers come from the
     recorder-ENABLED run, the shipping configuration.
 
-    Returns (coalescer_extras, observe_extras), or None under a
-    non-default shard width (the index rows are built for 2^20-column
-    shards)."""
+    Returns (coalescer_extras, observe_extras, devobs_extras), or None
+    under a non-default shard width (the index rows are built for
+    2^20-column shards)."""
     import tempfile
     import threading
 
@@ -477,6 +478,39 @@ def bench_coalescer(a_np: np.ndarray, b_np: np.ndarray) -> tuple[dict, dict] | N
         r.publish(r.begin("i", "Count(Row(f=1))"))
     record_cost_us = (time.perf_counter() - t0) / n_rec * 1e6
 
+    # Device-runtime telemetry A/B on the same coalesced path (the
+    # [observe] devobs budget): interleaved median windows with the
+    # observer on (shipping default) vs off, plus the noise-free
+    # per-dispatch probe cost measured directly — two _cache_size C
+    # calls and a perf_counter pair around a cached jit dispatch.
+    from pilosa_tpu import devobs as _devobs
+
+    dv_obs = _devobs.observer()
+    dv_offs, dv_ons = [], []
+    for _ in range(3):
+        dv_obs.enabled = False
+        dv_offs.append(run_load(0.6))
+        dv_obs.enabled = True
+        dv_ons.append(run_load(0.6))
+    dv_qps_off = sorted(dv_offs)[1]
+    dv_qps_on = sorted(dv_ons)[1]
+    import jax.numpy as jnp
+
+    probe_a = jnp.zeros(256, dtype=jnp.uint32)
+    wrapped = bm._jit_popcount_and      # devobs-instrumented
+    raw = getattr(wrapped, "fn", wrapped)  # the underlying jit
+    n_probe = 20000
+    wrapped(probe_a, probe_a)  # warm
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        wrapped(probe_a, probe_a)
+    t_wrapped = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(n_probe):
+        raw(probe_a, probe_a)
+    t_raw = time.perf_counter() - t0
+    probe_cost_us = max(0.0, (t_wrapped - t_raw) / n_probe * 1e6)
+
     # headline run, shipping configuration (recorder on); occupancy
     # must describe the SAME window as the headline qps, so delta the
     # histogram across this run only
@@ -505,8 +539,23 @@ def bench_coalescer(a_np: np.ndarray, b_np: np.ndarray) -> tuple[dict, dict] | N
             record_cost_us / (THREADS / qps * 1e6) * 100.0, 3),
         "budget_pct": 1.0,
     }
+    dv = {
+        "qps_devobs_on": round(dv_qps_on, 2),
+        "qps_devobs_off": round(dv_qps_off, 2),
+        # medians of interleaved windows; negative = within noise
+        "overhead_pct": round(
+            (dv_qps_off - dv_qps_on) / dv_qps_off * 100.0, 2),
+        # per-dispatch probe cost as a share of the measured per-query
+        # service time — the number the <1% budget is judged on (one
+        # coalesced dispatch serves a whole batch, so the per-QUERY
+        # share is smaller still)
+        "probe_cost_us": round(probe_cost_us, 3),
+        "probe_cost_pct_of_query": round(
+            probe_cost_us / (THREADS / qps * 1e6) * 100.0, 3),
+        "budget_pct": 1.0,
+    }
     holder.close()
-    return out, obs
+    return out, obs, dv
 
 
 def bench_admission(coalescer_extras: dict | None) -> dict:
@@ -650,9 +699,10 @@ def main():
     co_obs = bench_coalescer(a, b)
     co = None
     if co_obs is not None:
-        co, obs = co_obs
+        co, obs, dv = co_obs
         extras["coalescer"] = co
         extras["observe"] = obs
+        extras["devobs"] = dv
     extras["admission"] = bench_admission(co)
     bytes_per_query = a.nbytes + b.nbytes  # streamed once per query
     achieved_gbps = dev_qps * bytes_per_query / 1e9

@@ -212,6 +212,8 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         observe_enabled=cfg.observe.enabled,
         observe_recent=cfg.observe.recent,
         observe_long_query_time=cfg.observe.long_query_time,
+        observe_device_sample_interval=cfg.observe.device_sample_interval,
+        observe_fanin_timeout=cfg.observe.fanin_timeout,
         admission_enabled=cfg.admission.enabled,
         admission_query_cap=cfg.admission.query_cap,
         admission_query_queue=cfg.admission.query_queue,

@@ -97,11 +97,21 @@ class ObserveConfig:
     the ring-buffer depth behind ``GET /debug/queries``;
     ``long_query_time`` (seconds, 0 = off) logs PQL + trace id + the
     stage breakdown for queries over the threshold — the reference's
-    LongQueryTime with a profile attached."""
+    LongQueryTime with a profile attached.
+
+    Device-runtime telemetry (pilosa_tpu.devobs):
+    ``device_sample_interval`` (seconds, 0 = off) runs the background
+    sampler that pushes ``device.*``/``compile.*``/``residency.*``
+    gauges into the stats backends — pull scrapers get fresh gauges at
+    /metrics anyway, so the loop only matters for push (statsd)
+    deployments; ``fanin_timeout`` (seconds) bounds each peer fetch of
+    the cluster-wide ``GET /debug/cluster/*`` merge."""
 
     enabled: bool = True
     recent: int = 256
     long_query_time: float = 0.0  # seconds; 0 disables slow-query log
+    device_sample_interval: float = 0.0  # seconds; 0 = scrape-time only
+    fanin_timeout: float = 2.0  # seconds per peer in /debug/cluster/*
 
 
 @dataclass
@@ -277,6 +287,9 @@ class Config:
             f"enabled = {str(self.observe.enabled).lower()}",
             f"recent = {self.observe.recent}",
             f"long-query-time = {self.observe.long_query_time}",
+            f"device-sample-interval = "
+            f"{self.observe.device_sample_interval}",
+            f"fanin-timeout = {self.observe.fanin_timeout}",
             "",
             "[admission]",
             f"enabled = {str(self.admission.enabled).lower()}",

@@ -1,0 +1,381 @@
+"""Device-runtime telemetry: compile tracking, transfer metering, and
+memory/residency sampling.
+
+The flight recorder (pilosa_tpu.observe) explains where a query spent
+its time; this module explains WHY the device made it slow — the three
+failure classes that dominate TPU serving stacks and are invisible to
+request-path timings alone (the per-kernel/per-shape compile and memory
+telemetry Ragged Paged Attention, arxiv 2604.15464, motivates; DrJAX,
+arxiv 2403.07128, makes the per-node runtime-visibility case for the
+map-reduce fan-out):
+
+- **XLA recompiles** — a query hitting a fresh canonical shape pays a
+  trace+lower+compile (tens of ms to seconds) that looks like an
+  inexplicable latency spike.  Every ``_jit_*`` kernel in ``ops/`` is
+  wrapped by :func:`instrument`, which detects a jit cache miss (via
+  the jitted callable's ``_cache_size``, falling back to first-seen
+  shape keys on jax versions without it) and times the first-lowering
+  call, keyed per (kernel, canonical operand shape).
+- **Host→device transfer bursts** — ``ops/bitmap.chunked_device_put``
+  (the one staging funnel for fragment matrices, BSI planes, and field
+  row stacks) reports bytes/chunks per labeled owner through
+  :func:`note_transfer`.
+- **Residency churn / HBM pressure** — the process-wide residency
+  manager's usage/budget/evictions/high-water plus each device's
+  ``memory_stats()`` (bytes_in_use vs bytes_limit, where the backend
+  reports them) are sampled on demand and by the optional background
+  sampler.
+
+Exposure: ``GET /debug/devices`` (snapshot()), ``device.*`` /
+``compile.*`` / ``residency.*`` gauges+histograms in the stats
+registry (publish_gauges(), called at /metrics and /debug/vars scrape
+time and by the ``[observe] device-sample-interval`` sampler), and
+compile attribution stamped onto the active QueryRecord so a slow
+query answers "slow because it compiled" in one request.
+
+Lock discipline mirrors observe.py: the per-dispatch fast path is one
+attribute read + two C calls (``_cache_size``), no locks; the
+observer's lock is touched only on the rare compile/transfer events
+and on snapshot.  Budget: < 1% of the coalesced Count path
+(bench.py extras.devobs).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from pilosa_tpu import observe as _observe
+
+
+class _CompileStat:
+    """Per-(kernel, canonical shape) compile accounting."""
+
+    __slots__ = ("count", "total_ns", "last_ns", "first_unix")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ns = 0
+        self.last_ns = 0
+        self.first_unix = time.time()
+
+
+class DeviceObserver:
+    """Process-wide device-runtime registry (one per process, like the
+    residency manager — compiles and transfers are process-wide by
+    nature: the jit caches and the staging funnel are shared)."""
+
+    def __init__(self):
+        self.enabled = True
+        # optional stats client (server assembly wires it in) so
+        # compile events publish a compile.ms histogram live
+        self.stats = None
+        self._lock = threading.Lock()
+        # kernel -> shape key -> _CompileStat
+        self._compiles: dict[str, dict[str, _CompileStat]] = {}
+        self.compile_count = 0
+        self.compile_ns = 0
+        # transfer metering: label -> [bytes, chunks, puts]
+        self._transfers: dict[str, list[int]] = {}
+        self.transfer_bytes = 0
+        self.transfer_chunks = 0
+        self.transfer_puts = 0
+
+    # -------------------------------------------------------------- events
+
+    def note_compile(self, kernel: str, shape_key: str, ns: int) -> None:
+        """One detected compile (cache-miss first lowering) of
+        ``kernel`` at ``shape_key``, costing ``ns`` wall time.  Also
+        stamps the query record active on this thread, so the query
+        that PAID the compile carries it."""
+        with self._lock:
+            per_shape = self._compiles.setdefault(kernel, {})
+            st = per_shape.get(shape_key)
+            if st is None:
+                # bound the per-kernel shape table: a pathological
+                # shape churn must not grow the registry without limit
+                if len(per_shape) >= 256:
+                    shape_key = "<overflow>"
+                    st = per_shape.get(shape_key)
+                if st is None:
+                    st = per_shape[shape_key] = _CompileStat()
+            st.count += 1
+            st.total_ns += ns
+            st.last_ns = ns
+            self.compile_count += 1
+            self.compile_ns += ns
+        rec = _observe.current()
+        if rec is not None:
+            rec.note_compile(kernel, ns)
+        stats = self.stats
+        if stats is not None:
+            try:
+                stats.with_tags(f"kernel:{kernel}").histogram(
+                    "compile.ms", ns / 1e6)
+            except Exception:  # noqa: BLE001 — telemetry never raises
+                pass
+
+    def note_transfer(self, nbytes: int, chunks: int,
+                      label: str = "other") -> None:
+        """One host→device staging put of ``nbytes`` in ``chunks``
+        pieces, attributed to ``label`` (the owning cache)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            t = self._transfers.setdefault(label, [0, 0, 0])
+            t[0] += nbytes
+            t[1] += chunks
+            t[2] += 1
+            self.transfer_bytes += nbytes
+            self.transfer_chunks += chunks
+            self.transfer_puts += 1
+
+    # ------------------------------------------------------------- exports
+
+    @staticmethod
+    def device_memory() -> list[dict]:
+        """Per-device memory stats where the backend reports them (TPU
+        does; CPU returns none — the entry still lists the device so
+        the operator sees the topology)."""
+        out = []
+        try:
+            import jax
+
+            for d in jax.devices():
+                entry: dict = {"id": d.id, "platform": d.platform}
+                try:
+                    ms = d.memory_stats()
+                except Exception:  # noqa: BLE001
+                    ms = None
+                if ms:
+                    entry["bytesInUse"] = ms.get("bytes_in_use")
+                    entry["bytesLimit"] = ms.get("bytes_limit")
+                    entry["peakBytesInUse"] = ms.get("peak_bytes_in_use")
+                out.append(entry)
+        except Exception:  # noqa: BLE001 — backend init failure ≠ 500
+            pass
+        return out
+
+    def snapshot(self) -> dict:
+        """The /debug/devices document: per-kernel/per-shape compiles,
+        per-label transfers, residency accounting, device memory."""
+        from pilosa_tpu.runtime import residency
+
+        with self._lock:
+            kernels = {}
+            for kernel, per_shape in self._compiles.items():
+                shapes = {
+                    key: {"compiles": st.count,
+                          "totalMs": round(st.total_ns / 1e6, 3),
+                          "lastMs": round(st.last_ns / 1e6, 3)}
+                    for key, st in per_shape.items()
+                }
+                kernels[kernel] = {
+                    "compiles": sum(s.count for s in per_shape.values()),
+                    "totalMs": round(sum(s.total_ns
+                                         for s in per_shape.values())
+                                     / 1e6, 3),
+                    "shapes": shapes,
+                }
+            transfers = {
+                label: {"bytes": b, "chunks": c, "puts": p}
+                for label, (b, c, p) in self._transfers.items()
+            }
+            out = {
+                "enabled": self.enabled,
+                "compile": {
+                    "total": self.compile_count,
+                    "totalMs": round(self.compile_ns / 1e6, 3),
+                    "kernels": kernels,
+                },
+                "transfer": {
+                    "bytes": self.transfer_bytes,
+                    "chunks": self.transfer_chunks,
+                    "puts": self.transfer_puts,
+                    "byLabel": transfers,
+                },
+            }
+        out["residency"] = residency.manager().stats()
+        out["devices"] = self.device_memory()
+        return out
+
+    def publish_gauges(self, stats) -> None:
+        """Push the device.*/compile.*/residency.* gauge families into
+        a stats registry — called at /metrics and /debug/vars scrape
+        time (so the surface is never stale) and by the background
+        sampler (so statsd-only deployments see them too).  Totals are
+        gauges, not counters: they are already cumulative here, and
+        re-publishing a cumulative value through a counter would
+        double-count."""
+        from pilosa_tpu.runtime import residency
+
+        with self._lock:
+            stats.gauge("compile.count", self.compile_count)
+            stats.gauge("compile.total_ms",
+                        round(self.compile_ns / 1e6, 3))
+            stats.gauge("device.transfer_bytes", self.transfer_bytes)
+            stats.gauge("device.transfer_chunks", self.transfer_chunks)
+            stats.gauge("device.transfer_puts", self.transfer_puts)
+        r = residency.manager().stats()
+        stats.gauge("residency.usage_bytes", r["total"])
+        stats.gauge("residency.budget_bytes", r["budget"])
+        stats.gauge("residency.entries", r["entries"])
+        stats.gauge("residency.evictions", r["evictions"])
+        stats.gauge("residency.admits", r.get("admits", 0))
+        stats.gauge("residency.high_water_bytes",
+                    r.get("high_water", r["total"]))
+        for d in self.device_memory():
+            if d.get("bytesInUse") is None:
+                continue
+            tagged = stats.with_tags(f"device:{d['id']}",
+                                     f"platform:{d['platform']}")
+            tagged.gauge("device.bytes_in_use", d["bytesInUse"])
+            if d.get("bytesLimit") is not None:
+                tagged.gauge("device.bytes_limit", d["bytesLimit"])
+
+
+_global = DeviceObserver()
+_global_lock = threading.Lock()
+
+
+def observer() -> DeviceObserver:
+    """The process-wide observer (compiles/transfers are process-wide,
+    like the residency budget)."""
+    return _global
+
+
+def reset() -> DeviceObserver:
+    """Replace the global observer (tests)."""
+    global _global
+    with _global_lock:
+        _global = DeviceObserver()
+        return _global
+
+
+def note_transfer(nbytes: int, chunks: int, label: str = "other") -> None:
+    _global.note_transfer(nbytes, chunks, label)
+
+
+# --------------------------------------------------------------- instrument
+
+
+def _shape_key(args, kwargs) -> str:
+    """Canonical-shape key for one call: dtype[dims] per array operand,
+    repr for static scalars — the per-kernel axis compile telemetry is
+    bucketed on."""
+    parts = []
+    for a in args:
+        shp = getattr(a, "shape", None)
+        if shp is not None:
+            parts.append(f"{getattr(a, 'dtype', '?')}"
+                         f"[{','.join(str(s) for s in shp)}]")
+        else:
+            parts.append(repr(a))
+    for k in sorted(kwargs):
+        parts.append(f"{k}={kwargs[k]!r}")
+    return "(" + ", ".join(parts) + ")"
+
+
+class _InstrumentedJit:
+    """Wraps one jitted callable with compile-event detection.
+
+    Fast path (cache hit, observer disabled): one attribute read and at
+    most two ``_cache_size`` C calls on top of the dispatch — ~0.3 us,
+    vs the ~20 us device-dispatch floor the serving path is built
+    around (VERDICT round 5), so the <1% budget holds by construction.
+
+    Detection is the jit cache-size delta around the call: jit only
+    grows its cache on a genuine trace+lower+compile, so canonical-form
+    aliasing (weak types, distinct-but-equal shapes) can never
+    double-count the way a homegrown shape table would.  On jax builds
+    without ``_cache_size`` the wrapper falls back to first-seen shape
+    keys — approximate: the per-wrapper ``_seen`` set outlives
+    ``jax.clear_caches``, so a recompile of an already-seen shape goes
+    undetected there (the primary cache-size path has no such blind
+    spot).  Concurrent first calls may attribute one compile to two
+    threads — compile events are rare and the count stays within ±1 of
+    truth, which the telemetry (not billing) use tolerates."""
+
+    __slots__ = ("fn", "name", "_seen", "_has_cache_size")
+
+    def __init__(self, name: str, fn):
+        self.fn = fn
+        self.name = name
+        self._seen: set[str] = set()
+        self._has_cache_size = hasattr(fn, "_cache_size")
+
+    def __call__(self, *args, **kwargs):
+        obs = _global
+        if not obs.enabled:
+            return self.fn(*args, **kwargs)
+        if self._has_cache_size:
+            try:
+                s0 = self.fn._cache_size()
+            except Exception:  # noqa: BLE001
+                s0 = -1
+            t0 = time.perf_counter_ns()
+            out = self.fn(*args, **kwargs)
+            if s0 >= 0:
+                try:
+                    grew = self.fn._cache_size() > s0
+                except Exception:  # noqa: BLE001
+                    grew = False
+                if grew:
+                    obs.note_compile(self.name, _shape_key(args, kwargs),
+                                     time.perf_counter_ns() - t0)
+            return out
+        key = _shape_key(args, kwargs)
+        if key in self._seen:
+            return self.fn(*args, **kwargs)
+        t0 = time.perf_counter_ns()
+        out = self.fn(*args, **kwargs)
+        self._seen.add(key)
+        obs.note_compile(self.name, key, time.perf_counter_ns() - t0)
+        return out
+
+    def __getattr__(self, item):
+        # lower(), clear_cache(), _cache_size etc. reach the jit object
+        return getattr(self.fn, item)
+
+
+def instrument(name: str, fn):
+    """Wrap a jitted callable so cache-miss compiles are detected,
+    timed, and recorded under ``name`` — the one hook every ``_jit_*``
+    kernel (ops/bitmap.py, ops/bsi.py, the fused expression programs,
+    the Pallas entry points) routes through."""
+    return _InstrumentedJit(name, fn)
+
+
+# ------------------------------------------------------------------ sampler
+
+
+class DeviceSampler:
+    """Background gauge loop for the device families ([observe]
+    device-sample-interval) — the statsd-shipping analog of scrape-time
+    publishing (a pull scraper gets fresh gauges at /metrics anyway;
+    push backends need the loop)."""
+
+    def __init__(self, stats, interval: float):
+        self.stats = stats
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        if self.interval <= 0 or self.stats is None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="device-sampler")
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                observer().publish_gauges(self.stats)
+            except Exception:  # noqa: BLE001 — never take the loop down
+                pass
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

@@ -437,13 +437,21 @@ class Field:
 
             local = jax.local_devices()
             if len(local) > 1:
+                from pilosa_tpu import devobs
+
+                devobs.note_transfer(stack.nbytes, len(local),
+                                     "field.shard_stack")
                 return pmesh.shard_stack(pmesh.local_device_mesh(), stack)
-            return bm.chunked_device_put(stack, local[0])
+            return bm.chunked_device_put(stack, local[0],
+                                         label="field.stack")
         if len(jax.devices()) > 1:
+            from pilosa_tpu import devobs
             from pilosa_tpu.parallel import mesh as pmesh
 
+            devobs.note_transfer(stack.nbytes, len(jax.devices()),
+                                 "field.shard_stack")
             return pmesh.shard_stack(pmesh.device_mesh(), stack)
-        return bm.chunked_device_put(stack)
+        return bm.chunked_device_put(stack, label="field.stack")
 
     def device_time_row_stack(self, row_id: int, shards: tuple[int, ...],
                               view_names: tuple[str, ...]):

@@ -931,7 +931,8 @@ class Fragment:
             from pilosa_tpu.ops import bitmap as bm
 
             dev = (np.ascontiguousarray(matrix) if bm.host_mode()
-                   else bm.chunked_device_put(matrix))
+                   else bm.chunked_device_put(matrix,
+                                              label="fragment.matrix"))
             self._device_cache[key] = (self._gen, ids, dev)
             residency.manager().admit(self._device_cache, key,
                                       matrix.nbytes)
@@ -970,7 +971,8 @@ class Fragment:
                     P[i] = arr
             from pilosa_tpu.ops import bitmap as bm
 
-            dev = P if bm.host_mode() else jax.device_put(P)
+            dev = (P if bm.host_mode()
+                   else bm.chunked_device_put(P, label="fragment.planes"))
             self._device_cache[key] = (self._gen, dev)
             residency.manager().admit(self._device_cache, key, P.nbytes)
             return dev

@@ -132,7 +132,7 @@ class QueryRecord:
         "qid", "trace_id", "index", "pql", "start_unix", "t0_ns",
         "elapsed_ns", "shards_n", "stages", "shard_ns", "node_ns",
         "launches", "path", "coalesce", "result_sizes", "error", "slow",
-        "admission", "outcome",
+        "admission", "outcome", "compiles",
     )
 
     def __init__(self, qid: int, index: str, pql: str,
@@ -159,6 +159,10 @@ class QueryRecord:
         # (ok | error | shed | expired; None resolves at to_dict time)
         self.admission: dict | None = None
         self.outcome: str | None = None
+        # XLA compiles this query triggered: (kernel, ns) pairs stamped
+        # by pilosa_tpu.devobs — list appends are GIL-atomic, matching
+        # the launches discipline
+        self.compiles: list[tuple[str, int]] = []
 
     # ------------------------------------------------------------ notes
 
@@ -172,6 +176,12 @@ class QueryRecord:
         undercounts below the cap."""
         if len(self.launches) < MAX_LAUNCHES:
             self.launches.append(name)
+
+    def note_compile(self, kernel: str, ns: int) -> None:
+        """One XLA compile paid by this query (devobs.instrument) —
+        the "slow because it compiled" attribution."""
+        if len(self.compiles) < 256:
+            self.compiles.append((kernel, ns))
 
     def note_shard(self, shard: int, ns: int) -> None:
         if len(self.shard_ns) < MAX_SHARD_TIMINGS:
@@ -215,6 +225,9 @@ class QueryRecord:
                             for n, v, k in self.node_ns],
             "deviceLaunches": len(self.launches),
             "launchKinds": dict(Counter(self.launches)),
+            "compiled": bool(self.compiles),
+            "compileMs": round(sum(ns for _, ns in self.compiles) / ms,
+                               3),
             "resultSizes": list(self.result_sizes),
             "outcome": self.outcome or ("error" if self.error else "ok"),
         }
@@ -224,6 +237,9 @@ class QueryRecord:
                 "queueWaitMs": round(
                     self.admission.get("queue_wait_ns", 0) / ms, 3),
             }
+        if self.compiles:
+            d["compileKernels"] = dict(
+                Counter(k for k, _ in self.compiles))
         if len(self.shard_ns) >= MAX_SHARD_TIMINGS:
             d["shardTimingsTruncated"] = True
         if self.path is not None:
@@ -341,12 +357,15 @@ class FlightRecorder:
             self.stats.histogram("pilosa_query_latency", elapsed_s,
                                  exemplar=rec.trace_id)
         if rec.slow and self.logger is not None:
+            compile_ms = sum(ns for _, ns in rec.compiles) / 1e6
             self.logger.printf(
                 "slow query (%.3fs) trace=%s on %s: %s | stages=%s "
-                "shards=%d launches=%d path=%s",
+                "shards=%d launches=%d path=%s compiled=%s%s",
                 elapsed_s, rec.trace_id, rec.index, rec.pql,
                 ",".join(f"{n}:{v / 1e6:.1f}ms" for n, v in rec.stages),
-                rec.shards_n, len(rec.launches), rec.path or "-")
+                rec.shards_n, len(rec.launches), rec.path or "-",
+                "true" if rec.compiles else "false",
+                f" compile_ms={compile_ms:.1f}" if rec.compiles else "")
 
     # ------------------------------------------------------------- views
 

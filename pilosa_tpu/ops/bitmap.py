@@ -157,7 +157,8 @@ def unpack_positions(words: np.ndarray) -> np.ndarray:
     return np.nonzero(bits)[0].astype(np.int64)
 
 
-def chunked_device_put(stack: np.ndarray, device=None):
+def chunked_device_put(stack: np.ndarray, device=None,
+                       label: str = "other"):
     """device_put in bounded pieces (axis 0), concatenated ON device.
     A single multi-GB transfer can wedge a constrained transport
     end-to-end (the axon relay tunnel died mid-2.5 GB prewarm and took
@@ -175,8 +176,11 @@ def chunked_device_put(stack: np.ndarray, device=None):
         "PILOSA_TPU_STAGE_CHUNK_MB", "0")) * 1e6)
     put = (lambda a: jax.device_put(a, device)) if device is not None \
         else jax.device_put
+    from pilosa_tpu import devobs as _devobs
+
     if (not chunk_bytes or stack.nbytes <= chunk_bytes
             or stack.ndim < 2):
+        _devobs.note_transfer(stack.nbytes, 1, label)
         return put(stack)
     row_bytes = max(1, stack.nbytes // max(1, stack.shape[0]))
     rows_per = max(1, chunk_bytes // row_bytes)
@@ -185,6 +189,7 @@ def chunked_device_put(stack: np.ndarray, device=None):
         d = put(np.ascontiguousarray(stack[i:i + rows_per]))
         d.block_until_ready()
         parts.append(d)
+    _devobs.note_transfer(stack.nbytes, len(parts), label)
     return jnp.concatenate(parts, axis=0)
 
 
@@ -592,3 +597,23 @@ def reduce_and_rows(mat):
     if _host(mat):
         return np.bitwise_and.reduce(mat, axis=0)
     return _jit_reduce_and_rows(mat)
+
+
+# ---------------------------------------------------------------------------
+# Compile telemetry — every _jit_* kernel above routes through the
+# device-runtime observer (pilosa_tpu.devobs), which detects and times
+# jit cache-miss first lowerings per canonical operand shape.  One loop,
+# so a new kernel added above is instrumented by adding its name here.
+# ---------------------------------------------------------------------------
+
+from pilosa_tpu import devobs as _devobs  # noqa: E402
+
+for _n in ("_jit_and", "_jit_or", "_jit_xor", "_jit_andnot", "_jit_not",
+           "_jit_shift", "_jit_popcount", "_jit_popcount_and",
+           "_jit_row_counts", "_jit_row_counts_and",
+           "_jit_row_counts_masked", "_jit_row_counts_gathered",
+           "_jit_masked_matrix_counts", "_jit_and_pairs",
+           "_jit_set_bits", "_jit_clear_bits", "_jit_get_bits",
+           "_jit_reduce_or_rows", "_jit_reduce_and_rows"):
+    globals()[_n] = _devobs.instrument(f"bitmap.{_n[5:]}", globals()[_n])
+del _n

@@ -359,3 +359,16 @@ def assemble_value(taken) -> int:
         if int(t):
             v |= 1 << i
     return v
+
+
+# Compile telemetry (pilosa_tpu.devobs): cache-miss first lowerings of
+# the BSI kernels are detected and timed per canonical shape, mirroring
+# the ops/bitmap.py instrumentation loop.
+from pilosa_tpu import devobs as _devobs  # noqa: E402
+
+for _n in ("_jit_compare", "_jit_plane_counts",
+           "_jit_plane_counts_stacked", "_jit_extremes_stacked",
+           "extreme_max", "extreme_min"):
+    globals()[_n] = _devobs.instrument(f"bsi.{_n.removeprefix('_jit_')}",
+                                       globals()[_n])
+del _n

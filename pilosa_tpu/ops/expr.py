@@ -124,7 +124,14 @@ def _compiled(shape, counts: bool):
     else:
         def run(*leaves):
             return ev(leaves)
-    return jax.jit(run)
+    # compile telemetry (pilosa_tpu.devobs): fused-program first
+    # lowerings are the ones a fresh tree SHAPE pays — exactly the
+    # per-canonical-shape compile events the /debug/devices surface
+    # exists to attribute
+    from pilosa_tpu import devobs as _devobs
+
+    name = "expr.fused_counts" if counts else "expr.fused"
+    return _devobs.instrument(name, jax.jit(run))
 
 
 # ----------------------------------------------------------- host engine

@@ -391,3 +391,15 @@ def _bsi_compare_jnp(planes, filt, upred: int, depth: int):
     lo, hi = bsi.split_predicate(upred)
     lt, eq = bsi.compare(planes, consider, lo, hi)
     return lt, consider & ~lt & ~eq
+
+
+# Compile telemetry (pilosa_tpu.devobs): Mosaic lowerings are the most
+# expensive compiles in the process, so the Pallas entry points carry
+# the same cache-miss detection as the XLA kernels (ops/bitmap.py).
+from pilosa_tpu import devobs as _devobs  # noqa: E402
+
+for _n in ("_row_counts_masked_pallas", "_count_and_pallas",
+           "_mmc_pallas", "_bsi_compare_pallas"):
+    globals()[_n] = _devobs.instrument(f"pallas.{_n.strip('_')}",
+                                       globals()[_n])
+del _n

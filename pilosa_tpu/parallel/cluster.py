@@ -184,6 +184,43 @@ def converge_owner_deliveries(delivery_pass, on_timeout) -> None:
         time.sleep(0.2)
 
 
+def fan_in(nodes: list, fetch, timeout: float) -> tuple[dict, dict]:
+    """Best-effort concurrent fan-out: run ``fetch(node)`` for every
+    node on its own thread, bounded by ``timeout`` seconds overall.
+    Returns ``(results, errors)`` keyed by node id — a node that errors
+    or misses the window lands in ``errors`` instead of failing the
+    whole merge.  The cluster-wide debug surfaces
+    (``/debug/cluster/*``) ride this: one slow or dead peer must cost
+    its own section, never the operator's merged view."""
+    import time
+
+    results: dict = {}
+    errors: dict = {}
+    lock = threading.Lock()
+
+    def run(node):
+        try:
+            out = fetch(node)
+            with lock:
+                results[node.id] = out
+        except Exception as e:  # noqa: BLE001 — per-node best effort
+            with lock:
+                errors[node.id] = f"{type(e).__name__}: {e}"
+
+    threads = [threading.Thread(target=run, args=(n,), daemon=True)
+               for n in nodes]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + timeout
+    for t in threads:
+        t.join(max(0.0, deadline - time.monotonic()))
+    with lock:
+        for node in nodes:
+            if node.id not in results and node.id not in errors:
+                errors[node.id] = f"timeout after {timeout:g}s"
+        return dict(results), dict(errors)
+
+
 class Transport:
     """Node-to-node fabric (the reference's InternalClient role,
     http/client.go:37)."""

@@ -83,6 +83,10 @@ class ResidencyManager:
         self._entries: dict[tuple, tuple[dict, object, int]] = {}
         self.total = 0
         self.evictions = 0
+        self.admits = 0
+        # max SETTLED bytes (post-eviction; the mid-admit transient
+        # spike is excluded — see the update site in admit())
+        self.high_water = 0
 
     @staticmethod
     def _id(cache: dict, key) -> tuple:
@@ -103,6 +107,7 @@ class ResidencyManager:
                 self.total -= old[2]
             self._entries[eid] = (cache, key, nbytes)
             self.total += nbytes
+            self.admits += 1
             while self.total > self.budget and len(self._entries) > 1:
                 victim_id = next(iter(self._entries))
                 if victim_id == eid:
@@ -113,6 +118,12 @@ class ResidencyManager:
                 self.total -= vbytes
                 self.evictions += 1
                 vcache.pop(vkey, None)
+            # high-water marks the SETTLED residency level (the number
+            # an operator sizes the budget against), so it updates
+            # after eviction reclaims — the transient mid-admit spike
+            # is an accounting artifact, not held bytes
+            if self.total > self.high_water:
+                self.high_water = self.total
 
     def touch(self, cache: dict, key) -> None:
         """Mark an entry recently used (cache hit)."""
@@ -135,7 +146,9 @@ class ResidencyManager:
         with self._lock:
             return {"budget": self.budget, "total": self.total,
                     "entries": len(self._entries),
-                    "evictions": self.evictions}
+                    "evictions": self.evictions,
+                    "admits": self.admits,
+                    "high_water": self.high_water}
 
     def top_entries(self, n: int = 20) -> list[dict]:
         """Largest tracked device/host cache entries, for the heap

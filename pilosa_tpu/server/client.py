@@ -390,6 +390,18 @@ class InternalClient:
             f"{uri}/internal/fragment/data?index={index}&field={field}"
             f"&view={view}&shard={shard}")
 
+    def debug_json(self, uri: str, path: str,
+                   timeout: float | None = None) -> dict:
+        """GET a peer's JSON debug surface (/debug/queries,
+        /debug/devices) for the cluster-wide fan-in routes.  Tagged
+        ``rpc_class("internal")`` at the call site and bounded by the
+        fan-in timeout; the deadline header rides the request like any
+        other RPC, so a peer drowning in queries sheds this probe
+        instead of queueing it."""
+        raw = self._request("GET", f"{uri}{path}", timeout=timeout,
+                            retry_shed=False)
+        return json.loads(raw or b"null")
+
     def translate_data(self, uri: str, index: str, field: str | None,
                        offset: int):
         q = f"?index={index}&offset={offset}"

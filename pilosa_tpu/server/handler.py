@@ -181,11 +181,19 @@ class Handler:
     def __init__(self, api: API, host: str = "127.0.0.1", port: int = 0,
                  stats=None, tracer=None, tls_cert: str | None = None,
                  tls_key: str | None = None, heap_frames: int = 4,
-                 admission=None, max_threads: int | None = None):
+                 admission=None, max_threads: int | None = None,
+                 peer_client=None, fanin_timeout: float = 2.0):
         self.api = api
         self.stats = stats
         self.tracer = tracer
         self.heap_frames = heap_frames  # ?start=1 tracemalloc depth
+        # cluster-wide debug fan-in (/debug/cluster/*): the server
+        # assembly passes its pooled InternalClient; None builds one
+        # lazily on first use ([observe] fanin-timeout bounds each peer)
+        self.peer_client = peer_client
+        self._peer_client_lock = threading.Lock()
+        self._owns_peer_client = False  # lazily built -> closed here
+        self.fanin_timeout = fanin_timeout
         # admission gate (serve/admission.AdmissionController) — the
         # only accept-side gate between HTTP and device dispatch
         self.admission = admission
@@ -306,6 +314,13 @@ class Handler:
         self.httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        with self._peer_client_lock:
+            # only a client this handler lazily built is its to close;
+            # a server-injected one is closed by the server
+            if self._owns_peer_client and self.peer_client is not None:
+                self.peer_client.close()
+                self.peer_client = None
+                self._owns_peer_client = False
 
     # --------------------------------------------------- accept-side cap
 
@@ -932,6 +947,16 @@ class Handler:
         and this exposition is 0.0.4-shaped, not fully OpenMetrics.)"""
         exemplars = params.get("exemplars") == "1"
         if self.stats is not None and hasattr(self.stats, "prometheus_text"):
+            # refresh the device.*/compile.*/residency.* gauge families
+            # at scrape time so the exposition is never stale
+            # (pilosa_tpu.devobs; push backends get the same families
+            # from the [observe] device-sample-interval loop)
+            from pilosa_tpu import devobs
+
+            try:
+                devobs.observer().publish_gauges(self.stats)
+            except Exception:  # noqa: BLE001 — telemetry never fails a scrape
+                pass
             text = self.stats.prometheus_text(exemplars=exemplars)
         else:
             text = ""
@@ -1076,17 +1101,14 @@ class Handler:
             self._profile_lock.release()
         self._bytes(req, out.encode(), "text/plain")
 
-    @route("GET", "/debug/queries")
-    def handle_debug_queries(self, req, params, path, body):
-        """Query flight recorder: in-flight queries plus the ring
-        buffer of recent ones (pilosa_tpu.observe).  ``?min_ms=N``
-        keeps only records at least N ms long (in-flight records by
-        their elapsed-so-far); ``?sort=elapsed`` orders both lists
-        slowest-first (default ``start``: newest-first)."""
+    def _debug_queries_payload(self, params) -> dict:
+        """The /debug/queries document — factored out so the
+        cluster-wide fan-in assembles the LOCAL node's section
+        in-process instead of HTTP-calling itself (a self-call would
+        burn a handler thread while holding one)."""
         recorder = getattr(self.api.executor, "recorder", None)
         if recorder is None:
-            self._json(req, {"active": [], "recent": []})
-            return
+            return {"active": [], "recent": []}
         try:
             min_ms = float(params.get("min_ms", 0))
         except ValueError:
@@ -1103,9 +1125,126 @@ class Handler:
             out.sort(key=lambda d: d[key], reverse=True)
             return out
 
-        self._json(req, {
+        return {
             "active": prepare(recorder.active_records()),
             "recent": prepare(recorder.recent_records()),
+        }
+
+    @route("GET", "/debug/queries")
+    def handle_debug_queries(self, req, params, path, body):
+        """Query flight recorder: in-flight queries plus the ring
+        buffer of recent ones (pilosa_tpu.observe).  ``?min_ms=N``
+        keeps only records at least N ms long (in-flight records by
+        their elapsed-so-far); ``?sort=elapsed`` orders both lists
+        slowest-first (default ``start``: newest-first)."""
+        self._json(req, self._debug_queries_payload(params))
+
+    @route("GET", "/debug/devices")
+    def handle_debug_devices(self, req, params, path, body):
+        """Device-runtime telemetry (pilosa_tpu.devobs): per-kernel /
+        per-canonical-shape XLA compile counts and wall times,
+        host→device transfer bytes and chunk counts by owner,
+        residency usage/budget/evictions/high-water, and per-device
+        memory_stats (bytes_in_use vs bytes_limit where the backend
+        reports them)."""
+        from pilosa_tpu import devobs
+
+        self._json(req, devobs.observer().snapshot())
+
+    # ------------------------------------------------- cluster-wide fan-in
+
+    def _fan_in(self, path: str) -> tuple[dict, dict, dict]:
+        """Fan ``GET path`` out to every peer over the internal client
+        (tagged ``rpc_class("internal")``, deadline-propagated) and
+        return (local_id, sections, errors) — sections keyed by node
+        id, the local node's section assembled in-process."""
+        from pilosa_tpu.parallel.cluster import fan_in
+        from pilosa_tpu.serve.admission import rpc_class
+
+        local_id = self.api.cluster.local_id
+        peers = [n for n in self.api.cluster.sorted_nodes()
+                 if n.id != local_id and n.uri]
+        with self._peer_client_lock:
+            client = self.peer_client
+            if client is None:
+                from pilosa_tpu.server.client import InternalClient
+
+                client = self.peer_client = InternalClient()
+                self._owns_peer_client = True
+
+        def fetch(node):
+            with rpc_class("internal"):
+                out = client.debug_json(node.uri, path,
+                                        timeout=self.fanin_timeout)
+                if not isinstance(out, dict):
+                    # a 200 with an empty/None body (peer mid-restart
+                    # behind a proxy) must degrade like an error, not
+                    # crash the whole merge downstream
+                    raise ValueError(f"peer returned non-JSON-object "
+                                     f"debug body: {out!r}")
+                return out
+
+        sections, errors = fan_in(peers, fetch, self.fanin_timeout + 0.5)
+        return local_id, sections, errors
+
+    @route("GET", "/debug/cluster/queries")
+    def handle_debug_cluster_queries(self, req, params, path, body):
+        """One merged view of query records across the cluster: every
+        node's /debug/queries section plus a flat ``recent`` merge
+        (each record stamped with its node) sorted newest-first, and
+        the cluster's ``slow`` records sorted slowest-first.  A dead
+        or drowning peer degrades to an entry in ``errors``."""
+        qs = ""
+        passthrough = {k: v for k, v in params.items()
+                       if k in ("min_ms", "sort")}
+        if passthrough:
+            from urllib.parse import urlencode
+
+            qs = "?" + urlencode(passthrough)
+        # assemble the local section FIRST: it validates the params, so
+        # a bad min_ms 400s before any peer traffic is spent
+        local_section = self._debug_queries_payload(params)
+        local_id, sections, errors = self._fan_in("/debug/queries" + qs)
+        sections[local_id] = local_section
+        merged = []
+        for node_id, sec in sections.items():
+            for rec in (sec.get("recent") or []):
+                merged.append({**rec, "node": node_id})
+        merged.sort(key=lambda d: d.get("startTime", 0), reverse=True)
+        slow = sorted((d for d in merged if d.get("slow")),
+                      key=lambda d: d.get("elapsedMs", 0), reverse=True)
+        self._json(req, {
+            "nodes": sections,
+            "errors": errors,
+            "recent": merged[:512],
+            "slow": slow[:128],
+        })
+
+    @route("GET", "/debug/cluster/devices")
+    def handle_debug_cluster_devices(self, req, params, path, body):
+        """One merged view of device health across the cluster: every
+        node's /debug/devices section plus cluster totals (compiles,
+        compile wall time, transfer bytes, residency usage/evictions)."""
+        from pilosa_tpu import devobs
+
+        local_id, sections, errors = self._fan_in("/debug/devices")
+        sections[local_id] = devobs.observer().snapshot()
+        totals = {"compiles": 0, "compileMs": 0.0, "transferBytes": 0,
+                  "residencyBytes": 0, "evictions": 0}
+        for sec in sections.values():
+            totals["compiles"] += (sec.get("compile") or {}).get("total", 0)
+            totals["compileMs"] += (sec.get("compile") or {}).get(
+                "totalMs", 0.0)
+            totals["transferBytes"] += (sec.get("transfer") or {}).get(
+                "bytes", 0)
+            res = sec.get("residency") or {}
+            totals["residencyBytes"] += res.get("total", 0)
+            totals["evictions"] += res.get("evictions", 0)
+        totals["compileMs"] = round(totals["compileMs"], 3)
+        self._json(req, {
+            "nodes": sections,
+            "errors": errors,
+            "totals": totals,
         })
 
     @route("GET", "/debug/admission")
@@ -1127,6 +1266,12 @@ class Handler:
     def handle_debug_vars(self, req, params, path, body):
         snap = {}
         if self.stats is not None and hasattr(self.stats, "snapshot"):
+            from pilosa_tpu import devobs
+
+            try:
+                devobs.observer().publish_gauges(self.stats)
+            except Exception:  # noqa: BLE001
+                pass
             snap = self.stats.snapshot()
         self._json(req, snap)
 

@@ -58,6 +58,8 @@ class Server:
         observe_enabled: bool = True,
         observe_recent: int = 256,
         observe_long_query_time: float = 0.0,
+        observe_device_sample_interval: float = 0.0,
+        observe_fanin_timeout: float = 2.0,
         admission_enabled: bool = True,
         admission_query_cap: int = 32,
         admission_query_queue: int = 128,
@@ -131,6 +133,14 @@ class Server:
             logger=self.logger,
             stats=self.stats,
         )
+        # device-runtime telemetry (pilosa_tpu.devobs): wire the stats
+        # backend in (compile.ms histograms publish live) and start the
+        # optional background gauge sampler
+        from pilosa_tpu import devobs as _devobs
+
+        _devobs.observer().stats = self.stats
+        self.device_sampler = _devobs.DeviceSampler(
+            self.stats, observe_device_sample_interval)
         if coordinator:
             # statically designated coordinator (reference
             # cluster.coordinator config, server/config.go:104)
@@ -157,7 +167,9 @@ class Server:
                                stats=self.stats, tracer=tracer,
                                tls_cert=tls_cert, tls_key=tls_key,
                                heap_frames=heap_profile_frames,
-                               admission=self.admission)
+                               admission=self.admission,
+                               peer_client=self._client,
+                               fanin_timeout=observe_fanin_timeout)
         self.cluster.local_node.uri = self.handler.uri
         from pilosa_tpu.diagnostics import RuntimeMonitor
 
@@ -195,6 +207,7 @@ class Server:
             t = threading.Thread(target=self._heartbeat_loop, daemon=True)
             t.start()
         self.runtime_monitor.start()
+        self.device_sampler.start()
 
     def _join_via_seeds(self) -> None:
         client = self._client
@@ -248,6 +261,7 @@ class Server:
     def close(self) -> None:
         self._stop.set()
         self.runtime_monitor.stop()
+        self.device_sampler.stop()
         self.handler.close()
         self._client.close()  # drop pooled keep-alive sockets
         self.holder.close()

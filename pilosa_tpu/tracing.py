@@ -249,8 +249,19 @@ class OtlpExporter(MemTracer):
                 return
 
     def close(self) -> None:
+        """Stop the exporter thread and ship the final span batch.
+        Idempotent; wired into the server's shutdown closers
+        (cmd.run_server) — without the explicit final flush the batch
+        recorded since the last 2 s tick would die with the daemon
+        thread.  The post-join flush also covers a thread that died or
+        missed the join window, and the global tracer is reset so
+        spans finished after shutdown stop buffering into a dead
+        exporter."""
         self._stop.set()
         self._thread.join(timeout=10)
+        self.flush()
+        if global_tracer() is self:
+            set_global_tracer(Tracer())
 
 
 _global = Tracer()

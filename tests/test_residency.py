@@ -140,6 +140,50 @@ class TestProductIntegration:
         assert residency.manager().evictions > 0
         holder.close()
 
+    def test_churn_bit_exact_vs_host_with_high_water(self, tmp_path):
+        """Eviction+rebuild cycles under a tiny budget: usage stays
+        within the budget bound, evictions and the high-water mark are
+        counted, and every device-path result stays bit-exact against
+        a host (numpy) recomputation from the fragments' own rows —
+        eviction may only ever cost warmth."""
+        residency.reset(100 << 10)
+        holder, ex = self._build(tmp_path)
+        f = holder.index("i").field("f")
+        view = f.view("standard")
+
+        def host_row_positions(row: int) -> set[int]:
+            out = set()
+            for shard, frag in view.fragments.items():
+                arr = frag._rows.get(row)
+                if arr is not None:
+                    from pilosa_tpu.ops import bitmap as bm
+
+                    out.update(int(p) + shard * SHARD_WIDTH
+                               for p in bm.unpack_positions(arr))
+            return out
+
+        want = {r: host_row_positions(r) for r in range(6)}
+        mgr = residency.manager()
+        ev0 = mgr.evictions
+        # round-robin distinct rows: the working set exceeds the
+        # budget, so every pass rebuilds entries the last pass evicted
+        for _ in range(3):
+            for r in range(6):
+                row = ex.execute("i", f"Row(f={r})")[0]
+                assert {int(c) for c in row.columns()} == want[r]
+                got = int(ex.execute("i", f"Count(Row(f={r}))")[0])
+                assert got == len(want[r])
+                s = mgr.stats()
+                # bounded by the budget (modulo one oversized entry,
+                # which this working set does not produce)
+                assert s["total"] <= s["budget"]
+                assert s["high_water"] >= s["total"]
+        s = mgr.stats()
+        assert mgr.evictions > ev0  # churn actually happened
+        assert s["admits"] > 6  # rebuild cycles re-admitted entries
+        assert s["high_water"] <= s["budget"]
+        holder.close()
+
     def test_budget_bounds_total_across_fields(self, tmp_path):
         residency.reset(1 << 20)
         holder, ex = self._build(tmp_path)

@@ -148,3 +148,51 @@ def test_collector_outage_never_affects_serving():
         pass
     exp.flush()  # swallowed connection error
     exp.close()
+
+
+def test_close_flushes_final_batch_and_resets_global():
+    """The shutdown satellite: spans recorded AFTER the last periodic
+    tick must ship on close() — a long flush_interval means the final
+    batch would otherwise die with the daemon thread — and a closed
+    exporter must stop being the global tracer so post-shutdown spans
+    don't buffer into it."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    got: list[dict] = []
+
+    class Collector(BaseHTTPRequestHandler):
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            got.append(json.loads(self.rfile.read(n)))
+            self.send_response(200)
+            self.end_headers()
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Collector)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        # flush_interval far beyond the test: ONLY close() can ship it
+        exp = tracing.OtlpExporter(
+            f"http://127.0.0.1:{httpd.server_address[1]}",
+            flush_interval=3600.0)
+        tracing.set_global_tracer(exp)
+        with tracing.start_span("final-batch"):
+            pass
+        assert not got  # nothing shipped yet: the loop is asleep
+        exp.close()
+        names = [sp["name"]
+                 for payload in got
+                 for rs in payload["resourceSpans"]
+                 for ss in rs["scopeSpans"]
+                 for sp in ss["spans"]]
+        assert "final-batch" in names
+        # the global tracer was reset: new spans are no-ops, not
+        # buffered into a dead exporter
+        assert not isinstance(tracing.global_tracer(),
+                              tracing.OtlpExporter)
+        exp.close()  # idempotent
+    finally:
+        httpd.shutdown()
+        tracing.set_global_tracer(tracing.Tracer())

@@ -231,11 +231,46 @@ def check_text(text: str) -> dict:
     return {"samples": n_samples, "metrics": len(sampled)}
 
 
+#: Metric-family prefixes (registry dot-names rendered with ``_``) the
+#: device-runtime telemetry must keep on /metrics — the live-server
+#: family check (``check_families``) pins these in tests/test_http.py.
+DEVICE_FAMILIES = ("device_", "compile_", "residency_")
+
+
+def check_families(text: str, prefixes=DEVICE_FAMILIES) -> dict[str, int]:
+    """Strict-parse one exposition body AND require at least one
+    sampled metric under every prefix in ``prefixes``.  Returns
+    {prefix: n_metrics}; raises MetricsFormatError on a malformed body
+    or ValueError naming the missing family — so a refactor that
+    silently drops a whole telemetry family fails in CI, not in the
+    operator's dashboard."""
+    check_text(text)
+    names = set()
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is not None:
+            names.add(m.group("name"))
+    out = {}
+    for prefix in prefixes:
+        n = sum(1 for name in names if name.startswith(prefix))
+        if n == 0:
+            raise ValueError(
+                f"no metrics under family prefix {prefix!r}")
+        out[prefix] = n
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    families = None
+    if "--families" in argv:
+        argv = [a for a in argv if a != "--families"]
+        families = DEVICE_FAMILIES
     if len(argv) != 1:
-        print("usage: python -m tools.check_metrics URL|FILE",
-              file=sys.stderr)
+        print("usage: python -m tools.check_metrics [--families] "
+              "URL|FILE", file=sys.stderr)
         return 2
     src = argv[0]
     if src.startswith("http://") or src.startswith("https://"):
@@ -248,7 +283,9 @@ def main(argv: list[str] | None = None) -> int:
             text = f.read()
     try:
         summary = check_text(text)
-    except MetricsFormatError as e:
+        if families is not None:
+            check_families(text, families)
+    except (MetricsFormatError, ValueError) as e:
         print(f"INVALID: {e}", file=sys.stderr)
         return 1
     print(f"ok: {summary['samples']} samples, "
