@@ -145,7 +145,11 @@ def bench_device(a_np: np.ndarray, b_np: np.ndarray):
             iters += 1
         dt = time.perf_counter() - t0
         qps = iters / dt
-        return qps, expect, platform, engine, {engine: qps}, {}
+        # context fields on the CPU fallback too (VERDICT #1: the
+        # committed artifact must not drop the fields the capture
+        # instrumentation computes just because the chip was away)
+        extras = _bench_batched_and_floor_host(a_np, b_np)
+        return qps, expect, platform, engine, {engine: qps}, extras
 
     a = jax.device_put(a_np)
     b = jax.device_put(b_np)
@@ -350,6 +354,70 @@ def _bench_batched_and_floor(a, b, a_np: np.ndarray,
     return extras
 
 
+def _bench_batched_and_floor_host(a_np: np.ndarray,
+                                  b_np: np.ndarray) -> dict:
+    """CPU-fallback analogs of the chip context measurements, same
+    field names and shapes so artifact consumers never branch:
+
+    ``dispatch_floor_us`` — per-call floor of the host kernel entry
+    point (a trivial 8-word count through the same native/numpy path
+    every query pays; the host's analog of launch overhead).
+
+    ``batch32`` — B=32 distinct intersect-counts back-to-back; the
+    host has no executable-launch batching to amortize, so this is the
+    honest per-query cost at the batched shape, bandwidth-credited
+    like the chip version (each query's own operand bytes only)."""
+    from pilosa_tpu.ops import hostkernels as hk
+
+    extras: dict = {}
+    tiny = np.arange(8, dtype=np.uint32)
+    if hk.native_available():
+        def tiny_fn():
+            return hk.count_and(tiny, tiny)
+
+        def count(x):
+            return int(hk.count_and(x, b_np))
+    else:
+        def tiny_fn():
+            return int(np.bitwise_count(tiny).sum())
+
+        def count(x):
+            return int(np.bitwise_count(x & b_np).sum(dtype=np.uint64))
+
+    for _ in range(256):
+        tiny_fn()
+    iters = 20000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        tiny_fn()
+    extras["dispatch_floor_us"] = round(
+        (time.perf_counter() - t0) / iters * 1e6, 1)
+
+    B = 32
+    salts = (np.arange(1, B + 1, dtype=np.uint64)
+             * np.uint64(0x9E3779B9)).astype(np.uint32)
+    expects = [count(a_np ^ s) for s in salts]
+    reps = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        got = [count(a_np ^ s) for s in salts]
+        dt = time.perf_counter() - t0
+        if got != expects:
+            extras["batch32"] = "WRONG COUNTS"
+            return extras
+        reps.append(B / dt)
+    reps.sort()
+    qps_b = reps[1]
+    extras["batch32"] = {
+        "qps": round(qps_b, 2),
+        "queries_per_dispatch": B,
+        # each query's own operand bytes only — lower bound, matching
+        # the chip accounting
+        "achieved_gbps_lower": round(qps_b * a_np.nbytes / 1e9, 1),
+    }
+    return extras
+
+
 def bench_coalescer(a_np: np.ndarray,
                     b_np: np.ndarray) -> tuple[dict, dict, dict] | None:
     """Serving-path benchmark of the PRODUCT batching layer: concurrent
@@ -408,6 +476,13 @@ def bench_coalescer(a_np: np.ndarray,
     stats = _stats.MemStatsClient()
     ex.coalescer = Coalescer(window_s=0.002, max_batch=32,
                              enabled=True, stats=stats)
+    # this benchmark measures the coalesced DISPATCH path; with the
+    # result cache on, the 8-variant rotation would turn into pure
+    # cache hits after one window (bench_resultcache measures that
+    # side separately)
+    from pilosa_tpu.runtime import resultcache as _resultcache
+
+    _resultcache.cache().enabled = False
     qs = [f"Count(Intersect(Row(f={100 + v}), Row(f=2)))"
           for v in range(N_VAR)]
     for v, q in enumerate(qs):  # warm (stacks + jit) and verify each
@@ -555,7 +630,118 @@ def bench_coalescer(a_np: np.ndarray,
         "budget_pct": 1.0,
     }
     holder.close()
+    _resultcache.cache().enabled = True
     return out, obs, dv
+
+
+def bench_resultcache(a_np: np.ndarray,
+                      b_np: np.ndarray) -> dict | None:
+    """Cold/warm A/B of the generation-stamped result cache on the
+    coalesced Count path (the acceptance pin of the resultcache
+    round): per-query p50 of the UNCACHED fused-dispatch path
+    (?nocache semantics — every query stages leaves and launches) vs
+    the warm-hit p50 (parse + translate + generation probe, zero
+    device work), plus the cache's per-query cost on a 0%-hit-rate
+    workload measured directly (probe -> miss -> fill, the exact work
+    a never-repeating query stream adds).
+
+    Artifact pins: ``speedup_p50`` must be >= 10 (``pin_10x_ok``), and
+    ``miss_overhead_pct_of_query`` must stay under the 1% budget."""
+    import statistics
+    import tempfile
+
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.ops import bitmap as bm
+    from pilosa_tpu.parallel.coalescer import Coalescer
+    from pilosa_tpu.parallel.executor import ExecOptions, Executor
+    from pilosa_tpu.pql import parse
+    from pilosa_tpu.runtime import resultcache
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    if bm.n_words(SHARD_WIDTH) != WORDS:
+        return None
+
+    N_VAR = 4
+    salts = (np.arange(1, N_VAR + 1, dtype=np.uint64)
+             * np.uint64(0x9E3779B9)).astype(np.uint32)
+    holder = Holder(tempfile.mkdtemp() + "/bench-rc")
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    view = f.create_view_if_not_exists("standard")
+    for s in range(N_SHARDS):
+        frag = view.create_fragment_if_not_exists(s)
+        with frag._lock:
+            frag._rows[2] = b_np[s].copy()
+            for v in range(N_VAR):
+                frag._rows[100 + v] = a_np[s] ^ salts[v]
+            frag._gen += 1
+        f._note_shard(s)
+    expects = [int(np.bitwise_count((a_np ^ salts[v]) & b_np)
+                   .sum(dtype=np.uint64)) for v in range(N_VAR)]
+    ex = Executor(holder)
+    ex.coalescer = Coalescer(window_s=0.002, max_batch=32,
+                             enabled="auto")
+    resultcache.reset()
+    qs = [f"Count(Intersect(Row(f={100 + v}), Row(f=2)))"
+          for v in range(N_VAR)]
+    nocache = ExecOptions(cache=False)
+    for v, q in enumerate(qs):  # warm stacks + jit, verify, fill cache
+        for opt in (nocache, None):
+            got = int(ex.execute("i", q, opt=opt)[0])
+            if got != expects[v]:
+                raise AssertionError(
+                    f"resultcache variant {v} returned {got}, "
+                    f"expected {expects[v]}")
+
+    def p50_us(n: int, run) -> float:
+        lats = []
+        for i in range(n):
+            t0 = time.perf_counter_ns()
+            run(i)
+            lats.append(time.perf_counter_ns() - t0)
+        return statistics.median(lats) / 1e3
+
+    uncached_p50 = p50_us(
+        40, lambda i: ex.execute("i", qs[i % N_VAR], opt=nocache))
+    warm_p50 = p50_us(2000, lambda i: ex.execute("i", qs[i % N_VAR]))
+
+    # 0%-hit-rate added cost, measured directly: canonical signature +
+    # generation capture + key digest + miss lookup + fill — what a
+    # never-repeating query stream pays per query on top of execution
+    call = parse(qs[0]).calls[0]
+    shards_t = tuple(range(N_SHARDS))
+    scratch = resultcache.ResultCache()
+    n_probe = 2000
+    reps = []
+    for _ in range(5):  # median-of-5: host timing jitter dominates
+        t0 = time.perf_counter()
+        for i in range(n_probe):
+            rc, key, gens = ex._rc_probe(idx, "count", shards_t, None,
+                                         tree=call.children[0])
+            # distinct keys, like a never-repeating stream: every get
+            # is a genuine miss and every put a genuine fill
+            scratch.get((key, i), gens)
+            scratch.put((key, i), gens, 1, 32)
+        reps.append((time.perf_counter() - t0) / n_probe * 1e6)
+        scratch.invalidate_all()
+    miss_cost_us = statistics.median(reps)
+
+    out = {
+        "uncached_p50_us": round(uncached_p50, 1),
+        "warm_hit_p50_us": round(warm_p50, 1),
+        "speedup_p50": round(uncached_p50 / warm_p50, 1),
+        "pin_10x_ok": uncached_p50 >= 10 * warm_p50,
+        "miss_overhead_us": round(miss_cost_us, 2),
+        "miss_overhead_pct_of_query": round(
+            miss_cost_us / uncached_p50 * 100.0, 3),
+        "budget_pct": 1.0,
+    }
+    if not out["pin_10x_ok"]:
+        print(f"bench: resultcache warm-hit p50 {warm_p50:.0f}us is "
+              f"NOT >=10x under the uncached path "
+              f"{uncached_p50:.0f}us", file=sys.stderr)
+    holder.close()
+    return out
 
 
 def bench_admission(coalescer_extras: dict | None) -> dict:
@@ -704,6 +890,9 @@ def main():
         extras["observe"] = obs
         extras["devobs"] = dv
     extras["admission"] = bench_admission(co)
+    rc = bench_resultcache(a, b)
+    if rc is not None:
+        extras["resultcache"] = rc
     bytes_per_query = a.nbytes + b.nbytes  # streamed once per query
     achieved_gbps = dev_qps * bytes_per_query / 1e9
     peak = _peak_gbps(platform)

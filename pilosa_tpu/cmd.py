@@ -56,6 +56,19 @@ def main(argv: list[str] | None = None) -> int:
                         help=f"queued {_cls}-class requests beyond the "
                              f"cap; overflow sheds 429 "
                              f"([admission] {_cls}-queue)")
+    ps.add_argument("--no-result-cache", action="store_true",
+                    help="disable the generation-stamped query result "
+                         "cache ([cache] enabled=false): every read "
+                         "re-executes on the device")
+    ps.add_argument("--cache-budget-bytes", type=int,
+                    help="host-memory budget for cached query results "
+                         "([cache] budget-bytes)")
+    ps.add_argument("--cache-max-entry-bytes", type=int,
+                    help="largest single cacheable result "
+                         "([cache] max-entry-bytes)")
+    ps.add_argument("--cache-ttl", type=float,
+                    help="seconds before a cached result ages out even "
+                         "unmutated ([cache] ttl; 0 = generations only)")
     ps.add_argument("--verbose", action="store_true")
 
     pi = sub.add_parser("import", help="bulk-import CSV bits")
@@ -142,6 +155,12 @@ def cmd_server(args) -> int:
             v = getattr(args, f"admission_{_cls}_{_kind}", None)
             if v is not None:
                 setattr(cfg.admission, f"{_cls}_{_kind}", v)
+    if args.no_result_cache:
+        cfg.cache.enabled = False
+    for key in ("budget_bytes", "max_entry_bytes", "ttl"):
+        v = getattr(args, f"cache_{key}", None)
+        if v is not None:
+            setattr(cfg.cache, key, v)
     return run_server(cfg)
 
 
@@ -222,6 +241,10 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         admission_internal_cap=cfg.admission.internal_cap,
         admission_internal_queue=cfg.admission.internal_queue,
         admission_default_deadline=cfg.admission.default_deadline,
+        cache_enabled=cfg.cache.enabled,
+        cache_budget_bytes=cfg.cache.budget_bytes,
+        cache_max_entry_bytes=cfg.cache.max_entry_bytes,
+        cache_ttl=cfg.cache.ttl,
         logger=log,
         stats=stats,
     )

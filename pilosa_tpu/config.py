@@ -115,6 +115,26 @@ class ObserveConfig:
 
 
 @dataclass
+class CacheConfig:
+    """[cache] — the generation-stamped query result cache
+    (runtime/resultcache.py; the reference's per-fragment rank cache,
+    cache.go:136, generalized to whole PQL subtrees).  ``budget-bytes``
+    bounds total host memory held by cached results (strict — never
+    exceeded, LRU evicts); ``max-entry-bytes`` refuses any single
+    result larger than this (a giant Row result must not flush the
+    warm working set); ``ttl`` (seconds, 0 = none) ages entries out on
+    top of generation invalidation — generations already catch every
+    local write, so a TTL only matters as a backstop against external
+    clock-based staleness policies.  Per-request opt-out: ``?nocache=1``
+    on the query route."""
+
+    enabled: bool = True
+    budget_bytes: int = 128 << 20
+    max_entry_bytes: int = 8 << 20
+    ttl: float = 0.0  # seconds; 0 disables age-based expiry
+
+
+@dataclass
 class AdmissionConfig:
     """[admission] — priority-classed admission control + load
     shedding on the serving path (serve/admission.py; no reference
@@ -166,6 +186,7 @@ class Config:
     coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
     observe: ObserveConfig = field(default_factory=ObserveConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
+    cache: CacheConfig = field(default_factory=CacheConfig)
 
     # ------------------------------------------------------------- access
 
@@ -202,7 +223,7 @@ class Config:
             key = k.replace("-", "_")
             if key in ("cluster", "anti_entropy", "metric", "tracing",
                        "profile", "tls", "coalescer", "observe",
-                       "admission") and isinstance(v, dict):
+                       "admission", "cache") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -217,7 +238,8 @@ class Config:
                                                         TLSConfig,
                                                         CoalescerConfig,
                                                         ObserveConfig,
-                                                        AdmissionConfig)):
+                                                        AdmissionConfig,
+                                                        CacheConfig)):
                 setattr(self, key, v)
 
     def _apply_env(self, env: dict) -> None:
@@ -226,7 +248,7 @@ class Config:
         for f in fields(self):
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
                           "profile", "tls", "coalescer", "observe",
-                          "admission"):
+                          "admission", "cache"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -300,6 +322,12 @@ class Config:
             f"internal-cap = {self.admission.internal_cap}",
             f"internal-queue = {self.admission.internal_queue}",
             f"default-deadline = {self.admission.default_deadline}",
+            "",
+            "[cache]",
+            f"enabled = {str(self.cache.enabled).lower()}",
+            f"budget-bytes = {self.cache.budget_bytes}",
+            f"max-entry-bytes = {self.cache.max_entry_bytes}",
+            f"ttl = {self.cache.ttl}",
             "",
             "[tls]",
             f'certificate-path = "{self.tls.certificate_path}"',

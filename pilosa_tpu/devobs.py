@@ -186,6 +186,7 @@ class DeviceObserver:
                 "compile": {
                     "total": self.compile_count,
                     "totalMs": round(self.compile_ns / 1e6, 3),
+                    "programEvictions": _program_evictions(),
                     "kernels": kernels,
                 },
                 "transfer": {
@@ -213,6 +214,11 @@ class DeviceObserver:
             stats.gauge("compile.count", self.compile_count)
             stats.gauge("compile.total_ms",
                         round(self.compile_ns / 1e6, 3))
+            # fused-program cache pressure (ops/expr._compiled): a
+            # nonzero value means live tree shapes outnumber retained
+            # programs and evicted shapes silently re-trace on reuse
+            stats.gauge("compile.program_evictions",
+                        _program_evictions())
             stats.gauge("device.transfer_bytes", self.transfer_bytes)
             stats.gauge("device.transfer_chunks", self.transfer_chunks)
             stats.gauge("device.transfer_puts", self.transfer_puts)
@@ -232,6 +238,17 @@ class DeviceObserver:
             tagged.gauge("device.bytes_in_use", d["bytesInUse"])
             if d.get("bytesLimit") is not None:
                 tagged.gauge("device.bytes_limit", d["bytesLimit"])
+
+
+def _program_evictions() -> int:
+    """Evictions from the fused-program lru cache — imported lazily so
+    reading device telemetry never forces the ops stack in."""
+    import sys
+
+    expr = sys.modules.get("pilosa_tpu.ops.expr")
+    if expr is None:
+        return 0
+    return expr.program_evictions()
 
 
 _global = DeviceObserver()

@@ -6,6 +6,7 @@ directory under the data path, exposes schema, and owns node identity.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -16,8 +17,16 @@ from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 
 class Holder:
+    #: process-unique identity; the result cache (runtime/resultcache)
+    #: keys on it so in-process multi-node clusters (tests, soaks) keep
+    #: per-node entries apart — two holders' fragments for the same
+    #: (index, field, shard) are distinct objects with distinct
+    #: generation tokens, and sharing a key would only thrash
+    _UID = itertools.count(1)
+
     def __init__(self, path: str | None = None):
         self.path = path
+        self.uid = next(Holder._UID)
         self.indexes: dict[str, Index] = {}
         self._lock = threading.RLock()
         self.node_id: str = ""

@@ -132,7 +132,7 @@ class QueryRecord:
         "qid", "trace_id", "index", "pql", "start_unix", "t0_ns",
         "elapsed_ns", "shards_n", "stages", "shard_ns", "node_ns",
         "launches", "path", "coalesce", "result_sizes", "error", "slow",
-        "admission", "outcome", "compiles",
+        "admission", "outcome", "compiles", "cached", "cache_key",
     )
 
     def __init__(self, qid: int, index: str, pql: str,
@@ -163,6 +163,15 @@ class QueryRecord:
         # by pilosa_tpu.devobs — list appends are GIL-atomic, matching
         # the launches discipline
         self.compiles: list[tuple[str, int]] = []
+        # result-cache outcome (runtime/resultcache): ``cached`` is
+        # set when a cache hit served (part of) the query; the rendered
+        # flag (to_dict) additionally requires zero device launches so
+        # it keeps the documented "answered without device work on this
+        # node" meaning.  ``cache_key`` (a stable digest) is stamped
+        # whenever a canonical key was computed — hit or miss, so
+        # /debug/queries correlates repeated shapes either way
+        self.cached = False
+        self.cache_key: str | None = None
 
     # ------------------------------------------------------------ notes
 
@@ -230,7 +239,17 @@ class QueryRecord:
                                3),
             "resultSizes": list(self.result_sizes),
             "outcome": self.outcome or ("error" if self.error else "ok"),
+            # rendered ``cached`` keeps the documented meaning — served
+            # without device work on this node.  A PARTIAL hit (e.g.
+            # filtered TopN whose unfiltered full-counts pass hit while
+            # the filtered scan dispatched) marks the flag internally
+            # but still launched, so it must not read as fully
+            # cache-served; the "cached" path note records the partial
+            # hit either way
+            "cached": self.cached and not self.launches,
         }
+        if self.cache_key is not None:
+            d["cacheKey"] = self.cache_key
         if self.admission is not None:
             d["admission"] = {
                 "class": self.admission.get("class"),

@@ -35,7 +35,8 @@ accounting meaningful across engines.
 
 from __future__ import annotations
 
-import functools
+import threading
+from collections import namedtuple
 
 import numpy as np
 
@@ -107,11 +108,23 @@ def _build_jnp(shape):
     return lambda leaves: bm.shift_words(jnp, kid(leaves), n)
 
 
-@functools.lru_cache(maxsize=512)
-def _compiled(shape, counts: bool):
-    """One jitted program per (canonical shape, root kind).  The cache
-    is what makes tree fusion pay: distinct row ids (distinct leaf
-    VALUES) reuse the program; only a new tree SHAPE traces."""
+#: Compiled-program cache capacity.  Tests shrink it via
+#: ``set_program_cache_size``; eviction past it means live tree shapes
+#: outnumber retained programs and EVERY evicted shape re-traces +
+#: re-lowers on its next query — tens of ms of invisible recompile per
+#: hit, which is why evictions surface through devobs
+#: (``compile.program_evictions``) instead of staying silent.
+DEFAULT_PROGRAM_CACHE_SIZE = 512
+
+
+_CacheInfo = namedtuple("_CacheInfo",
+                        ("hits", "misses", "maxsize", "currsize"))
+
+
+def _build_program(shape, counts: bool):
+    """One jitted program per (canonical shape, root kind).  The
+    cache is what makes tree fusion pay: distinct row ids (distinct
+    leaf VALUES) reuse the program; only a new tree SHAPE traces."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -132,6 +145,101 @@ def _compiled(shape, counts: bool):
 
     name = "expr.fused_counts" if counts else "expr.fused"
     return _devobs.instrument(name, jax.jit(run))
+
+
+def _make_compiled(maxsize: int):
+    """An explicit LRU over compiled programs with an EXACT eviction
+    count.  ``functools.lru_cache`` was abandoned here because its
+    counters can't express evictions: ``misses - currsize`` over-counts
+    whenever two threads race the same fresh shape (both count a miss,
+    one entry lands) or a build raises — which made the one-line
+    overflow warning and the ``compile.program_evictions`` gauge fire
+    spuriously.  Here an eviction increments exactly when a resident
+    program is popped for capacity, nothing else."""
+    lock = threading.Lock()
+    cache: dict = {}  # insertion order == LRU order (move-to-end on hit)
+    counters = {"hits": 0, "misses": 0, "evictions": 0}
+
+    def _compiled(shape, counts: bool):
+        key = (shape, counts)
+        with lock:
+            prog = cache.get(key)
+            if prog is not None:
+                cache[key] = cache.pop(key)
+                counters["hits"] += 1
+                return prog
+            counters["misses"] += 1
+        # trace/lower outside the lock — tens of ms for a fresh shape;
+        # a concurrent duplicate build is wasted work, never a wrong
+        # count: only the first insert lands and no eviction is charged
+        prog = _build_program(shape, counts)
+        with lock:
+            if key in cache:
+                return cache[key]
+            cache[key] = prog
+            while len(cache) > maxsize:
+                cache.pop(next(iter(cache)))
+                counters["evictions"] += 1
+        return prog
+
+    def cache_info() -> _CacheInfo:
+        with lock:
+            return _CacheInfo(counters["hits"], counters["misses"],
+                              maxsize, len(cache))
+
+    def cache_clear() -> None:
+        with lock:
+            cache.clear()
+            counters["hits"] = counters["misses"] = 0
+            counters["evictions"] = 0
+
+    def cache_evictions() -> int:
+        with lock:
+            return counters["evictions"]
+
+    _compiled.cache_info = cache_info
+    _compiled.cache_clear = cache_clear
+    _compiled.cache_evictions = cache_evictions
+    return _compiled
+
+
+_compiled = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE)
+_eviction_warned = False
+
+
+def program_evictions() -> int:
+    """Capacity evictions from the compiled-program cache so far —
+    counted exactly at the point a resident program is popped (see
+    ``_make_compiled``), so concurrent same-shape builds and failed
+    builds never inflate it."""
+    return _compiled.cache_evictions()
+
+
+def set_program_cache_size(maxsize: int) -> None:
+    """Swap in a fresh program cache of the given capacity (tests —
+    forcing 512 distinct shapes to exercise eviction would dominate a
+    test run with tracing)."""
+    global _compiled, _eviction_warned
+    _compiled = _make_compiled(maxsize)
+    _eviction_warned = False
+
+
+def _note_program_cache_pressure() -> None:
+    """One-line warning the FIRST time a compiled program is evicted:
+    shape thrash otherwise shows up only as inexplicable recompile
+    latency (the devobs gauge carries the running count)."""
+    global _eviction_warned
+    if _eviction_warned:
+        return
+    if program_evictions() > 0:
+        _eviction_warned = True
+        import logging
+
+        ci = _compiled.cache_info()
+        logging.getLogger("pilosa_tpu.ops.expr").warning(
+            "fused-program cache overflowed (maxsize=%d): tree shapes "
+            "now evict each other and re-trace on reuse; see "
+            "compile.program_evictions on /metrics", ci.maxsize)
 
 
 # ----------------------------------------------------------- host engine
@@ -192,4 +300,6 @@ def evaluate(shape, leaves: tuple, counts: bool = False):
         if counts:
             return _host_counts(shape, leaves)
         return _host_tree(shape, leaves)
-    return _compiled(shape, counts)(*leaves)
+    fn = _compiled(shape, counts)
+    _note_program_cache_pressure()
+    return fn(*leaves)

@@ -225,10 +225,13 @@ class Transport:
     """Node-to-node fabric (the reference's InternalClient role,
     http/client.go:37)."""
 
-    def query_node(self, node: Node, index: str, pql: str, shards: list[int]):
+    def query_node(self, node: Node, index: str, pql: str, shards: list[int],
+                   nocache: bool = False):
         """Execute pql on the remote node restricted to `shards` with
         remote semantics (no re-translation).  Returns the result list.
-        Raises TransportError if the node is unreachable."""
+        Raises TransportError if the node is unreachable.  ``nocache``
+        forwards the origin request's ?nocache=1 so an opted-out query
+        forces a real execution on every node, not just the origin."""
         raise NotImplementedError
 
     def send_message(self, node: Node, message: dict) -> dict:
@@ -292,7 +295,8 @@ class LocalTransport(Transport):
         if frozenset((src, dst)) in self.partitions:
             raise TransportError(f"partitioned: {src} <-/-> {dst}")
 
-    def query_node(self, node: Node, index: str, pql: str, shards: list[int]):
+    def query_node(self, node: Node, index: str, pql: str, shards: list[int],
+                   nocache: bool = False):
         from pilosa_tpu.parallel.executor import ExecOptions
 
         if node.id in self.down or node.id not in self.handles:
@@ -302,7 +306,8 @@ class LocalTransport(Transport):
         return h.executor.execute(
             index, pql,
             opt=ExecOptions(
-                remote=True, shards=None if shards is None else list(shards)
+                remote=True, shards=None if shards is None else list(shards),
+                cache=not nocache,
             ),
         )
 
@@ -329,8 +334,14 @@ class BoundTransport(Transport):
         # delegates to the shared parent (registry, down set, bind...)
         return getattr(self.parent, name)
 
-    def query_node(self, node: Node, index: str, pql: str, shards: list[int]):
+    def query_node(self, node: Node, index: str, pql: str, shards: list[int],
+                   nocache: bool = False):
         self.parent._check_partition(self.src, node.id)
+        if nocache:
+            return self.parent.query_node(node, index, pql, shards,
+                                          nocache=True)
+        # cache-enabled calls keep the original 4-arg shape so tests
+        # that monkeypatch parent.query_node stay compatible
         return self.parent.query_node(node, index, pql, shards)
 
     def send_message(self, node: Node, message: dict) -> dict:

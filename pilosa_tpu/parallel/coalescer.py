@@ -114,10 +114,19 @@ class Coalescer:
         return dl is None or dl.remaining() > 2 * self.window_s
 
     def count(self, executor, idx, child, shards: tuple[int, ...],
-              deadline=None) -> int:
+              deadline=None, cache_fill=None) -> int:
         """One Count(tree) query through the batching window -> total.
         Staging runs on the CALLER's thread (fragment locks, and a
-        staging error belongs to this query alone)."""
+        staging error belongs to this query alone).
+
+        ``cache_fill`` is the executor's result-cache probe triple
+        ``(cache, key, gens)`` for THIS query — the executor already
+        probed (a hit never reaches the window), so a flushed batch
+        fills the cache for every member: each waiter stores its own
+        total under its own key, stamped with the generations captured
+        before its leaves were staged.  Entries dropped from the batch
+        (deadline death, flush failure) raise out of ``fut.result()``
+        and never fill."""
         shape, leaves = executor._fused_expr(idx, child, shards)
         key = (idx.name, shape, shards)
         fut: Future = Future()
@@ -161,7 +170,11 @@ class Coalescer:
             }
         # leaf stacks are padded to the device multiple — sum only the
         # live shard rows, in Python ints (int32 could wrap)
-        return int(np.asarray(counts, dtype=np.int64)[:len(shards)].sum())
+        total = int(np.asarray(counts, dtype=np.int64)[:len(shards)].sum())
+        if cache_fill is not None:
+            rc, key, gens = cache_fill
+            rc.put(key, gens, total, 32)
+        return total
 
     # ------------------------------------------------------------- flush
 

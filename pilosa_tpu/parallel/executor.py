@@ -22,6 +22,7 @@ import datetime as _dt
 import heapq
 import threading
 import time as _time
+from operator import itemgetter as _itemgetter
 from concurrent.futures import (
     FIRST_COMPLETED,
     Future,
@@ -50,6 +51,7 @@ from pilosa_tpu.parallel.results import (
     sort_pairs,
 )
 from pilosa_tpu.pql import Call, Query, parse
+from pilosa_tpu.runtime import resultcache
 from pilosa_tpu.serve import deadline as _deadline
 from pilosa_tpu.serve.deadline import DeadlineExceededError
 from pilosa_tpu.shardwidth import SHARD_WIDTH
@@ -77,6 +79,9 @@ class ExecOptions:
     # per-request opt-out of cross-query micro-batching (the HTTP
     # layer's ?nocoalesce=true — debugging / latency-sensitive callers)
     coalesce: bool = True
+    # per-request opt-out of the generation-stamped result cache (the
+    # HTTP layer's ?nocache=1 — symmetric with ?nocoalesce)
+    cache: bool = True
     # end-to-end deadline (serve/deadline.Deadline), propagated from
     # the X-Pilosa-Deadline header; checked at translate, before each
     # per-shard map, and before reduce so expired work never reaches
@@ -399,10 +404,22 @@ class Executor:
             # goroutines)
             for node_id in [k for k in list(pending) if k != cluster.local_id]:
                 node_shards = pending.pop(node_id)
-                fut = self._submit_io(
-                    cluster.transport.query_node,
-                    cluster.node(node_id), idx.name, pql, node_shards,
-                )
+                if opt is not None and not opt.cache:
+                    # forward the origin's ?nocache=1: peers must do a
+                    # real execution too, not answer from their
+                    # per-shard result caches
+                    fut = self._submit_io(
+                        lambda n, i, p, s:
+                        cluster.transport.query_node(n, i, p, s,
+                                                     nocache=True),
+                        cluster.node(node_id), idx.name, pql,
+                        node_shards,
+                    )
+                else:
+                    fut = self._submit_io(
+                        cluster.transport.query_node,
+                        cluster.node(node_id), idx.name, pql, node_shards,
+                    )
                 inflight[fut] = (node_id, node_shards,
                                  _time.perf_counter_ns())
             if cluster.local_id in pending:
@@ -634,6 +651,155 @@ class Executor:
         shape, leaves = self._fused_expr(idx, call, shards)
         return expr.evaluate(shape, leaves)
 
+    # ------------------------------------------- result cache (read paths)
+
+    def _rc_collect_gens(self, f, view_name: str,
+                         shards: tuple[int, ...], out: dict) -> None:
+        """Record the invalidation stamp for one (field, view) pair
+        over the shard set: the aggregate ``(count, sum_gen, sum_uid,
+        max_uid)`` of the participating fragments' generation tokens.
+
+        The aggregate is change-DETECTING, not just change-likely,
+        because of two monotonicity invariants: a surviving fragment's
+        ``_gen`` only ever increases (every mutation path bumps it —
+        audited in tests/test_resultcache.py), and ``_uid`` comes from
+        a process-global increasing counter, so a newly created
+        fragment's uid exceeds every uid that ever existed.  Case
+        analysis between fill and probe: any fragment CREATION (incl.
+        a resize/restore replacement) raises ``max_uid`` past the old
+        all-time high; any DELETION without a creation changes
+        ``count``; any MUTATION of a surviving fragment raises
+        ``sum_gen`` (which nothing can lower — gen "resets" only occur
+        via replacement, caught by ``max_uid``).  So every state
+        change flips at least one component, while an unchanged view
+        reproduces the stamp exactly.
+
+        Memoized per (field, view): ``Intersect(Row(f=a), Row(f=b))``
+        touches the same view twice but needs one stamp.  The single
+        pass is what keeps the 0%-hit-rate probe within its <1% budget
+        at wide shard counts (bench.py extras.resultcache): the common
+        fully-populated case batches all dict lookups into one C-level
+        ``itemgetter`` call (~35% cheaper than per-shard ``.get`` at
+        256 shards on the bench box), falling back to the filtering
+        loop only when some shard has no fragment."""
+        mkey = (id(f), view_name)
+        if mkey in out:
+            return
+        view = None if f is None else f.view(view_name)
+        if view is None:
+            out[mkey] = 0
+            return
+        frags = view.fragments
+        fs = None
+        if len(shards) > 1:
+            try:
+                fs = _itemgetter(*shards)(frags)
+            except KeyError:
+                fs = None
+        if fs is None:
+            g = frags.get
+            fs = [fr for s in shards if (fr := g(s)) is not None]
+        sg = su = mu = 0
+        for fr in fs:
+            u = fr._uid
+            sg += fr._gen
+            su += u
+            if u > mu:
+                mu = u
+        out[mkey] = (len(fs), sg, su, mu)
+
+    def _rc_sig(self, idx, call: Call, shards: tuple[int, ...],
+                gens_out: list):
+        """Canonical identity of one fused-supported bitmap tree: the
+        expression shape with leaf identities (field, view, row /
+        op+value) substituted at the slots — distinct queries over the
+        same shape get distinct keys, unlike the coalescer's value-
+        erased bucket key.  Collects every participating fragment's
+        generation token into ``gens_out``; the caller captures this
+        stamp BEFORE any fragment data is read (resultcache
+        stamp-before-read discipline — the reverse order could stamp
+        fresh generations onto stale data)."""
+        name = call.name
+        if name == "Row":
+            cond = call.condition_arg()
+            if cond is not None:
+                fname, condition = cond
+                f = idx.field(fname)
+                self._rc_collect_gens(f, f.bsi_view_name, shards,
+                                      gens_out)
+                value = (condition.int_slice_value()
+                         if condition.op == "><" else condition.value)
+                if isinstance(value, list):
+                    value = tuple(value)
+                return ("range", fname, condition.op, value)
+            fname = call.field_arg()
+            f = idx.field(fname)
+            if "from" in call.args or "to" in call.args:
+                # the covering views are part of the identity: a new
+                # time view (first write into a fresh quantum) changes
+                # the cover, so the old entry simply stops being
+                # addressed
+                views = tuple(self._time_range_views(f, call) or ())
+                for vn in views:
+                    self._rc_collect_gens(f, vn, shards, gens_out)
+                return ("time", fname, call.args[fname], views)
+            self._rc_collect_gens(f, VIEW_STANDARD, shards, gens_out)
+            return ("row", fname, call.args[fname])
+        if name in ("Union", "Intersect", "Difference", "Xor"):
+            return (name, *(self._rc_sig(idx, c, shards, gens_out)
+                            for c in call.children))
+        if name == "Not":
+            ef = idx.existence_field()
+            self._rc_collect_gens(ef, VIEW_STANDARD, shards, gens_out)
+            return ("not", ef.name,
+                    self._rc_sig(idx, call.children[0], shards,
+                                 gens_out))
+        if name == "Shift":
+            n = call.int_arg("n")
+            return ("shift", 1 if n is None else n,
+                    self._rc_sig(idx, call.children[0], shards,
+                                 gens_out))
+        raise ExecutionError(f"uncacheable call: {name}")
+
+    def _rc_probe(self, idx, kind: str, shards: tuple[int, ...],
+                  opt: ExecOptions | None, tree: Call | None = None,
+                  extra=None, gen_fields=()):
+        """(cache, key, gens) for one fused read, or None when caching
+        is off (process config or the request's ?nocache=1) or the
+        tree has no canonical signature.  ``extra`` joins the key
+        (e.g. the TopN field and truncation args); ``gen_fields`` is
+        (field, view_name) pairs whose fragments participate beyond
+        the tree leaves (e.g. the scanned TopN matrix).  Stamps the
+        key digest onto the active flight record so every record
+        carries its cacheKey, hit or miss."""
+        rc = resultcache.cache()
+        if not rc.enabled or (opt is not None and not opt.cache):
+            return None
+        gens_out: dict = {}
+        try:
+            sig = (None if tree is None
+                   else self._rc_sig(idx, tree, shards, gens_out))
+            for f, vn in gen_fields:
+                self._rc_collect_gens(f, vn, shards, gens_out)
+        except (ExecutionError, ValueError, KeyError, TypeError,
+                AttributeError):
+            return None
+        key = resultcache.Key(
+            (self.holder.uid, idx.name, kind, sig, extra, shards))
+        rec = _observe.current()
+        if rec is not None:
+            rec.cache_key = resultcache.key_digest(key)
+        # dict values in traversal (insertion) order — deterministic
+        # per shape, so fill and probe stamps always align slot-wise
+        return rc, key, tuple(gens_out.values())
+
+    @staticmethod
+    def _rc_mark_hit() -> None:
+        rec = _observe.current()
+        if rec is not None:
+            rec.cached = True
+            rec.note_path("cached")
+
     def _execute_bitmap_call(self, idx, call: Call, shards, opt: ExecOptions) -> Row:
         self._validate_call_fields(idx, call)
         shards = self._target_shards(idx, shards, opt)
@@ -642,11 +808,28 @@ class Executor:
         fused_ok = self._fuse_eligible(idx, shards, call)
 
         def batch_fn(group):
+            # probe the result cache FIRST (stamp captured before any
+            # fragment read); a hit skips the device entirely
+            g = tuple(group)
+            probe = self._rc_probe(idx, "row", g, opt, tree=call)
+            if probe is not None:
+                rc, key, gens = probe
+                hit, val = rc.get(key, gens)
+                if hit:
+                    self._rc_mark_hit()
+                    # copies both ways (fill and hit): cached words
+                    # must never alias a Row a caller may mutate
+                    return [(s, w.copy()) for s, w in val]
             # copies: a view would pin the whole stack in memory for as
             # long as one sparse segment lives
-            stack = np.asarray(self._fused_eval(idx, call, tuple(group)))
-            return [(s, stack[i].copy())
-                    for i, s in enumerate(group) if stack[i].any()]
+            stack = np.asarray(self._fused_eval(idx, call, g))
+            partials = [(s, stack[i].copy())
+                        for i, s in enumerate(group) if stack[i].any()]
+            if probe is not None:
+                value = [(s, w.copy()) for s, w in partials]
+                rc.put(key, gens, value,
+                       sum(w.nbytes for _, w in value) + 32 * len(value))
+            return partials
 
         rec = _observe.current()
         if rec is not None:
@@ -829,7 +1012,7 @@ class Executor:
         child = call.children[0]
         fused_ok = self._fuse_eligible(idx, shards, child)
 
-        def batch_fn(group):
+        def compute_counts(group):
             # the whole tree INCLUDING the popcount root as one compiled
             # program (ops.expr) — a single dispatch for the group, with
             # XLA fusing AND+popcount so no intersection stack
@@ -844,24 +1027,58 @@ class Executor:
             return [int(c) for c in
                     np.asarray(counts, dtype=np.int64)[:len(group)]]
 
+        def batch_fn(group):
+            # the clustered local-group path: per-shard counts for the
+            # shards THIS node owns, cached under their own key so
+            # every owner (replicas included) warms independently —
+            # the remote map path caches on the remote side through
+            # the single-node branch below when the sub-query arrives
+            g = tuple(group)
+            probe = self._rc_probe(idx, "count_shards", g, opt,
+                                   tree=child)
+            if probe is not None:
+                rc, key, gens = probe
+                hit, val = rc.get(key, gens)
+                if hit:
+                    self._rc_mark_hit()
+                    return list(val)
+            vals = compute_counts(group)
+            if probe is not None:
+                rc.put(key, gens, tuple(vals), 16 * len(vals))
+            return vals
+
         rec = _observe.current()
         if rec is not None:
             rec.note_path("fused" if fused_ok else "per-shard")
         if fused_ok and not self._cluster_active(opt):
             _deadline.check(opt.deadline, "map")
+            # result-cache probe BEFORE the coalescer: a hit answers
+            # pre-window and never occupies a batch slot
+            probe = self._rc_probe(idx, "count", tuple(shards), opt,
+                                   tree=child)
+            if probe is not None:
+                rc, ckey, cgens = probe
+                hit, val = rc.get(ckey, cgens)
+                if hit:
+                    self._rc_mark_hit()
+                    return val
             if (self.coalescer is not None
                     and self.coalescer.eligible(opt)):
                 # the coalescer stamps the record itself (path,
-                # batch occupancy, queue-wait vs launch split) and
-                # drops this entry from the batch if its deadline
-                # dies in the window
+                # batch occupancy, queue-wait vs launch split), drops
+                # this entry from the batch if its deadline dies in
+                # the window, and fills the cache for every flushed
+                # batch member
                 return self.coalescer.count(self, idx, child,
                                             tuple(shards),
-                                            deadline=opt.deadline)
+                                            deadline=opt.deadline,
+                                            cache_fill=probe)
             t_f = _time.perf_counter_ns()
-            total = sum(batch_fn(shards))
+            total = sum(compute_counts(shards))
             if rec is not None:
                 rec.note_stage("map.fused", _time.perf_counter_ns() - t_f)
+            if probe is not None:
+                rc.put(ckey, cgens, total, 32)
             return total
 
         def map_fn(shard):
@@ -953,7 +1170,7 @@ class Executor:
             # same hook shape as the Count/Row fused paths: one stacked
             # dispatch for the whole locally-owned group
             return [self._fused_topn_counts(idx, f, filter_call,
-                                            tuple(group))]
+                                            tuple(group), opt=opt)]
 
         if fused_ok and not self._cluster_active(opt):
             _deadline.check(opt.deadline, "map")
@@ -1002,7 +1219,8 @@ class Executor:
                 # unfiltered pass is one more dispatch (and fragment
                 # caches make repeats free); no Pair-sort detour
                 full_counts = self._fused_topn_counts(idx, f, None,
-                                                      tuple(shards))
+                                                      tuple(shards),
+                                                      opt=opt)
             else:
                 full = self._execute_topn(
                     idx, Call("TopN", {"_field": fname}), shards, opt)
@@ -1028,7 +1246,32 @@ class Executor:
         return pairs
 
     def _fused_topn_counts(self, idx, f, filter_call,
-                           shards: tuple[int, ...]) -> dict[int, int]:
+                           shards: tuple[int, ...],
+                           opt: ExecOptions | None = None
+                           ) -> dict[int, int]:
+        """All shards' TopN row counts, answered from the result cache
+        when the scan (field matrix + filter leaves) is still at the
+        stamped generations, else in ONE device dispatch — the per-
+        fragment TopNCache generalized to the whole cross-shard scan."""
+        probe = self._rc_probe(idx, "topn", shards, opt,
+                               tree=filter_call, extra=f.name,
+                               gen_fields=((f, VIEW_STANDARD),))
+        if probe is not None:
+            rc, key, gens = probe
+            hit, val = rc.get(key, gens)
+            if hit:
+                self._rc_mark_hit()
+                return dict(val)
+        totals = self._fused_topn_counts_uncached(idx, f, filter_call,
+                                                  shards)
+        if probe is not None:
+            rc.put(key, gens, dict(totals),
+                   resultcache.result_nbytes(totals))
+        return totals
+
+    def _fused_topn_counts_uncached(self, idx, f, filter_call,
+                                    shards: tuple[int, ...]
+                                    ) -> dict[int, int]:
         """All shards' TopN row counts in ONE device dispatch over the
         field's concatenated matrix stack (vs one scan per fragment).
         Unfiltered results also warm every fragment's TopN cache, so
@@ -1198,6 +1441,25 @@ class Executor:
         limit = call.uint_arg("limit")
         filter_call = call.call_arg("filter")
         shards = self._target_shards(idx, shards, opt)
+        # result cache: a GroupBy's value depends on EVERY row of its
+        # child fields, so the stamp covers the whole standard view of
+        # each child (plus the filter leaves); eligibility is
+        # conservative — plain standard-view children only, filter
+        # absent or fused-supported — and the truncation args ride the
+        # key, so the post-limit result caches directly
+        probe = None
+        if not self._cluster_active(opt):
+            probe = self._groupby_cache_probe(idx, call, filter_call,
+                                              tuple(shards), opt)
+            if probe is not None:
+                rc, ckey, cgens = probe
+                hit, val = rc.get(ckey, cgens)
+                if hit:
+                    self._rc_mark_hit()
+                    # deep copy: result translation writes row_key onto
+                    # the returned objects and must not mutate the
+                    # cached value
+                    return self._copy_group_counts(val)
         child_fields = []
         child_allowed: list[set | None] = []
         for child in call.children:
@@ -1374,7 +1636,50 @@ class Executor:
             out = out[offset:] if offset < len(out) else out
         if limit is not None:
             out = out[:limit]
+        if probe is not None:
+            rc.put(ckey, cgens, self._copy_group_counts(out),
+                   resultcache.result_nbytes(out) * 2)
         return out
+
+    def _groupby_cache_probe(self, idx, call: Call, filter_call,
+                             shards: tuple[int, ...],
+                             opt: ExecOptions):
+        """The GroupBy cache key/stamp, or None when ineligible: every
+        child must be a plain standard-view Rows (time-view covers and
+        no-standard-view fields change shape under writes in ways the
+        per-view stamp would have to chase), the filter absent or a
+        fused-supported tree (anything else has no canonical leaf
+        signature to stamp)."""
+        sig_children = []
+        gen_fields = []
+        for child in call.children:
+            if child.name != "Rows":
+                return None
+            fname = child.args.get("_field") or child.args.get("field")
+            if not fname:
+                return None
+            f = idx.field(fname)
+            if (f is None or f.time_quantum
+                    or f.options.no_standard_view
+                    or "from" in child.args or "to" in child.args):
+                return None
+            sig_children.append((fname, child.uint_arg("limit"),
+                                 child.uint_arg("column"),
+                                 child.uint_arg("previous")))
+            gen_fields.append((f, VIEW_STANDARD))
+        if filter_call is not None and not self._fused_supported(
+                idx, filter_call):
+            return None
+        extra = (tuple(sig_children), call.uint_arg("limit"),
+                 call.uint_arg("offset"))
+        return self._rc_probe(idx, "groupby", shards, opt,
+                              tree=filter_call, extra=extra,
+                              gen_fields=gen_fields)
+
+    @staticmethod
+    def _copy_group_counts(res: list) -> list:
+        return [replace(gc, group=[replace(fr) for fr in gc.group])
+                for gc in res]
 
     # --------------------------------------------------- BSI aggregates
 
@@ -1528,7 +1833,7 @@ class Executor:
             # then a host argmin/argmax over the row totals — replaces
             # the per-row device round-trips of the old walk
             totals = self._fused_topn_counts(idx, f, filter_call,
-                                             tuple(group))
+                                             tuple(group), opt=opt)
             live = [r for r, c in totals.items() if c > 0]
             if not live:
                 return [Pair()]

@@ -1,0 +1,341 @@
+"""Generation-stamped query result cache: repeated reads skip the
+device entirely.
+
+VERDICT round 5 established the Count/Intersect hot path is
+dispatch-bound, not HBM-bound (a ~20 us trivial-dispatch floor under a
+0.555 ms/query chip capture, bw_util 0.148) — so for read-heavy traffic
+the biggest remaining win is to not launch at all.  The reference ships
+only the per-fragment rank cache (cache.go:136, ported as
+models/cache.py with exact generation-stamped counts); this module
+generalizes the same idiom to whole PQL subtrees, the classic
+recomputation-vs-retained-state trade of the Roaring line of work
+(Chambi et al.; Lemire et al., "Roaring Bitmaps: Implementation of an
+Optimized Software Library").
+
+One process-wide, memory-budgeted LRU cache maps a canonical query key
+— (holder identity, index, root kind, fused expression shape with leaf
+identities ``(field, view, row)`` substituted at the slots, shard set)
+— to its result, stamped with the participating fragments' generation
+state: per (field, view) an aggregate ``(count, sum_gen, sum_uid,
+max_uid)`` over the shard set (change-detecting under the monotone
+uid/gen discipline — see ``Executor._rc_collect_gens``).
+**Invalidation is free**: every mutation path bumps the fragment
+generation (import, import-value, import-roaring, Set/Clear, Store,
+ClearRow, BSI set/clear-value — audited by tests/test_resultcache.py),
+so a stale entry simply misses, exactly like ``TopNCache.get(gen)``
+today.  The uid components make a fragment replaced by resize/restore
+(a NEW object whose ``_gen`` can collide) unhittable.
+
+Stamp-before-read discipline (the correctness core): callers capture
+the generation tuple BEFORE reading any fragment data, and fill with
+that same stamp.  A mutation that lands between capture and read
+leaves the entry stamped with the OLD generations while the live
+fragments carry new ones — the entry can never be served, only
+refilled.  The reverse order (stamp after read) would serve stale data
+and is therefore forbidden.
+
+Results live on host: Count totals and per-shard count tuples are a
+few machine words, TopN/GroupBy results small dicts, Row results numpy
+word-array copies accounted against this cache's own byte budget
+(separate from the device ResidencyManager budget — an evicted result
+recomputes from the still-resident device stacks, so eviction here
+costs one dispatch, not a transfer).
+
+Surface: ``[cache]`` config (budget bytes, max entry bytes, ttl,
+enabled), ``?nocache=1`` on the query route (symmetric with
+``?nocoalesce``), ``cached``/``cacheKey`` on every flight record,
+``cache.{hits,misses,fills,evictions,invalidations,bytes}`` gauge
+families on /metrics, and ``GET /debug/resultcache``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+import time
+
+
+#: Defaults; the server assembly reconfigures from [cache] config.
+DEFAULT_BUDGET_BYTES = 128 << 20
+DEFAULT_MAX_ENTRY_BYTES = 8 << 20
+
+#: Accounting floor per entry: key tuple + stamp tuple + dict slot.
+#: Prevents a flood of "free" scalar entries from reading as zero
+#: bytes while really holding megabytes of Python structure.
+ENTRY_OVERHEAD_BYTES = 256
+
+
+class Key:
+    """Hash-once wrapper for cache keys.  A key is a deep nested tuple
+    whose tail is the full shard tuple (256+ ints at production shard
+    counts), and tuples do not cache their hash — the probe's
+    get / pop / insert sequence would rehash it three times.  Wrapping
+    computes it once; equality (only reached when hashes already
+    match) delegates to the C tuple compare."""
+
+    __slots__ = ("k", "h")
+
+    def __init__(self, k):
+        self.k = k
+        self.h = hash(k)
+
+    def __hash__(self) -> int:
+        return self.h
+
+    def __eq__(self, other):
+        if self is other:
+            return True
+        if isinstance(other, Key):
+            return self.k == other.k
+        return NotImplemented
+
+    def __repr__(self) -> str:  # key_digest / debug stability
+        return repr(self.k)
+
+
+class _Entry:
+    __slots__ = ("gens", "value", "nbytes", "t", "hits")
+
+    def __init__(self, gens, value, nbytes: int):
+        self.gens = gens
+        self.value = value
+        self.nbytes = nbytes
+        self.t = time.monotonic()
+        self.hits = 0
+
+
+class ResultCache:
+    """Memory-budgeted LRU of generation-stamped query results.
+
+    ``get(key, gens)`` hits only when the stored stamp equals the
+    caller's freshly-computed generation tuple; a mismatched entry is
+    dropped on the spot (counted as an invalidation) so mutated keys
+    free their bytes immediately instead of waiting for LRU churn.
+    ``put`` enforces the byte budget strictly — the cache NEVER holds
+    more than ``budget`` bytes, even transiently after the insert
+    (acceptance: the churn test mirrors test_residency's tiny-budget
+    pattern)."""
+
+    def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES,
+                 max_entry_bytes: int = DEFAULT_MAX_ENTRY_BYTES,
+                 ttl_s: float = 0.0, enabled: bool = True):
+        self.budget = int(budget_bytes)
+        self.max_entry_bytes = int(max_entry_bytes)
+        self.ttl_s = float(ttl_s)
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        # insertion order == LRU order (move-to-end on hit)
+        self._entries: dict = {}
+        self.bytes = 0
+        self.hits = 0
+        self.misses = 0
+        self.fills = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.skipped_oversize = 0
+
+    # -------------------------------------------------------------- access
+
+    def get(self, key, gens) -> tuple[bool, object]:
+        """(hit, value).  ``gens`` is the CURRENT generation tuple the
+        caller just computed from the live fragments; a stored stamp
+        that differs means some participating fragment mutated (or was
+        replaced) since the fill — the entry is dropped and the call
+        counts as a miss."""
+        if not self.enabled:
+            return False, None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                return False, None
+            if e.gens != gens or (
+                    self.ttl_s > 0
+                    and time.monotonic() - e.t > self.ttl_s):
+                del self._entries[key]
+                self.bytes -= e.nbytes
+                self.invalidations += 1
+                self.misses += 1
+                return False, None
+            self._entries[key] = self._entries.pop(key)  # move-to-end
+            e.hits += 1
+            self.hits += 1
+            return True, e.value
+
+    def put(self, key, gens, value, nbytes: int) -> bool:
+        """Insert one result stamped with the generations captured
+        BEFORE its inputs were read.  Returns False when the entry was
+        refused (disabled / oversize / bigger than the whole budget)."""
+        if not self.enabled:
+            return False
+        nbytes = int(nbytes) + ENTRY_OVERHEAD_BYTES
+        if nbytes > self.max_entry_bytes or nbytes > self.budget:
+            with self._lock:
+                self.skipped_oversize += 1
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.bytes -= old.nbytes
+            self._entries[key] = _Entry(gens, value, nbytes)
+            self.bytes += nbytes
+            self.fills += 1
+            # strict budget: evict LRU until under — the entry just
+            # inserted is newest and falls last, and since it fits the
+            # budget on its own (checked above) the loop terminates
+            # with it retained
+            while self.bytes > self.budget and self._entries:
+                vk = next(iter(self._entries))
+                ve = self._entries.pop(vk)
+                self.bytes -= ve.nbytes
+                self.evictions += 1
+            return True
+
+    def invalidate_all(self) -> int:
+        """Drop everything (operator escape hatch / tests).  Counted
+        as invalidations."""
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+            self.bytes = 0
+            self.invalidations += n
+            return n
+
+    # ------------------------------------------------------------- exports
+
+    def stats_dict(self) -> dict:
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "budget": self.budget,
+                "maxEntryBytes": self.max_entry_bytes,
+                "ttlS": self.ttl_s,
+                "bytes": self.bytes,
+                "entries": len(self._entries),
+                "hits": self.hits,
+                "misses": self.misses,
+                "fills": self.fills,
+                "evictions": self.evictions,
+                "invalidations": self.invalidations,
+                "skippedOversize": self.skipped_oversize,
+            }
+
+    def debug(self, top_n: int = 32) -> dict:
+        """The /debug/resultcache document: totals plus the largest
+        entries (key digest + human-readable key, bytes, age, hits)."""
+        out = self.stats_dict()
+        now = time.monotonic()
+        with self._lock:
+            entries = sorted(self._entries.items(),
+                             key=lambda kv: -kv[1].nbytes)[:top_n]
+            out["top"] = [{
+                "key": key_digest(k),
+                "repr": repr(k)[:200],
+                "bytes": e.nbytes,
+                "ageS": round(now - e.t, 3),
+                "hits": e.hits,
+            } for k, e in entries]
+        return out
+
+    def publish_gauges(self, stats) -> None:
+        """Push the cache.* families into a stats registry at scrape
+        time (/metrics, /debug/vars).  Cumulative totals render as
+        gauges, not counters — re-publishing a cumulative value
+        through a counter would double-count (same rule as
+        devobs.publish_gauges)."""
+        s = self.stats_dict()
+        stats.gauge("cache.hits", s["hits"])
+        stats.gauge("cache.misses", s["misses"])
+        stats.gauge("cache.fills", s["fills"])
+        stats.gauge("cache.evictions", s["evictions"])
+        stats.gauge("cache.invalidations", s["invalidations"])
+        stats.gauge("cache.bytes", s["bytes"])
+        stats.gauge("cache.entries", s["entries"])
+        stats.gauge("cache.budget_bytes", s["budget"])
+
+
+def key_digest(key) -> str:
+    """Stable short digest of a cache key for flight records and the
+    debug surface (the full tuple is structured but verbose)."""
+    return hashlib.blake2b(repr(key).encode(),
+                           digest_size=8).hexdigest()
+
+
+def result_nbytes(value) -> int:
+    """Byte estimate for one cached result: numpy buffers by .nbytes,
+    containers and result dataclasses (GroupCount rows of FieldRow,
+    Pair, ValCount...) recursively, scalars a machine word.  An
+    estimate — the budget bounds order-of-magnitude memory, not
+    malloc'd bytes.  Charging a GroupCount as a bare scalar would let
+    a GroupBy-heavy workload exceed the budget by an order of
+    magnitude in real memory, so dataclasses recurse into their
+    fields."""
+    nb = getattr(value, "nbytes", None)
+    if nb is not None:
+        return int(nb)
+    if isinstance(value, dict):
+        return 64 + sum(result_nbytes(k) + result_nbytes(v)
+                        for k, v in value.items())
+    if isinstance(value, (list, tuple)):
+        return 64 + sum(result_nbytes(v) for v in value)
+    if isinstance(value, (bytes, str)):
+        return 48 + len(value)
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        return 64 + sum(
+            result_nbytes(getattr(value, f.name))
+            for f in dataclasses.fields(value))
+    return 32
+
+
+# ----------------------------------------------------------- process-wide
+
+
+_global: ResultCache | None = None
+_global_lock = threading.Lock()
+
+
+def cache() -> ResultCache:
+    """The process-wide cache (one budget per process, like the
+    residency manager and the jit caches the results shortcut).
+    Lock-free on the hot path — every query probe calls this; the
+    lock only guards first construction."""
+    global _global
+    c = _global
+    if c is not None:
+        return c
+    with _global_lock:
+        if _global is None:
+            _global = ResultCache()
+        return _global
+
+
+def configure(budget_bytes: int | None = None,
+              max_entry_bytes: int | None = None,
+              ttl_s: float | None = None,
+              enabled: bool | None = None) -> ResultCache:
+    """Apply [cache] config to the process-wide cache in place
+    (counters and live entries survive — a second in-process server
+    must not wipe the first's warm cache)."""
+    c = cache()
+    with c._lock:
+        if budget_bytes is not None:
+            c.budget = int(budget_bytes)
+        if max_entry_bytes is not None:
+            c.max_entry_bytes = int(max_entry_bytes)
+        if ttl_s is not None:
+            c.ttl_s = float(ttl_s)
+        if enabled is not None:
+            c.enabled = bool(enabled)
+    return c
+
+
+def reset(budget_bytes: int = DEFAULT_BUDGET_BYTES,
+          max_entry_bytes: int = DEFAULT_MAX_ENTRY_BYTES,
+          ttl_s: float = 0.0, enabled: bool = True) -> ResultCache:
+    """Replace the process-wide cache (tests)."""
+    global _global
+    with _global_lock:
+        _global = ResultCache(budget_bytes, max_entry_bytes, ttl_s,
+                              enabled)
+        return _global

@@ -277,12 +277,15 @@ class InternalClient:
     # -------------------------------------------------------------- query
 
     def query_node(self, uri: str, index: str, pql: str,
-                   shards: list[int] | None = None, remote: bool = True):
+                   shards: list[int] | None = None, remote: bool = True,
+                   nocache: bool = False):
         """POST /index/{i}/query with Remote semantics over the
         protobuf wire — node-to-node RPC speaks protobuf like the
         reference's InternalClient (http/client.go:268 QueryNode;
         external clients may still POST JSON).  Returns decoded result
-        objects."""
+        objects.  ``nocache`` rides as the same ?nocache=1 query param
+        external clients use, so the peer's handler opts the sub-query
+        out of its result cache."""
         from pilosa_tpu import proto
 
         body = proto.encode(proto.QUERY_REQUEST, {
@@ -290,8 +293,11 @@ class InternalClient:
             "shards": [int(s) for s in (shards or [])],
             "remote": remote,
         })
+        path = f"{uri}/index/{index}/query"
+        if nocache:
+            path += "?nocache=1"
         raw = self._request(
-            "POST", f"{uri}/index/{index}/query", body,
+            "POST", path, body,
             ctype="application/x-protobuf",
             accept="application/x-protobuf",
             error_decoder=lambda b: proto.decode(proto.QUERY_RESPONSE,
@@ -419,9 +425,11 @@ class HTTPTransport(Transport):
     def __init__(self, client: InternalClient | None = None):
         self.client = client or InternalClient()
 
-    def query_node(self, node: Node, index: str, pql: str, shards):
+    def query_node(self, node: Node, index: str, pql: str, shards,
+                   nocache: bool = False):
         # the protobuf client already returns decoded result objects
-        return self.client.query_node(node.uri, index, pql, shards)
+        return self.client.query_node(node.uri, index, pql, shards,
+                                      nocache=nocache)
 
     def send_message(self, node: Node, message: dict) -> dict:
         return self.client.send_message(node.uri, message)

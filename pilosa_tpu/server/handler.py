@@ -660,6 +660,9 @@ class Handler:
                 # ?nocoalesce=true: opt this request out of cross-query
                 # micro-batching (debugging / latency-sensitive callers)
                 coalesce=params.get("nocoalesce") != "true",
+                # ?nocache=1: opt this request out of the result cache
+                # (symmetric with ?nocoalesce — force a real execution)
+                cache=params.get("nocache") not in ("1", "true"),
             )
         except Exception as e:
             if not proto_accept:
@@ -952,9 +955,11 @@ class Handler:
             # (pilosa_tpu.devobs; push backends get the same families
             # from the [observe] device-sample-interval loop)
             from pilosa_tpu import devobs
+            from pilosa_tpu.runtime import resultcache
 
             try:
                 devobs.observer().publish_gauges(self.stats)
+                resultcache.cache().publish_gauges(self.stats)
             except Exception:  # noqa: BLE001 — telemetry never fails a scrape
                 pass
             text = self.stats.prometheus_text(exemplars=exemplars)
@@ -1139,6 +1144,17 @@ class Handler:
         slowest-first (default ``start``: newest-first)."""
         self._json(req, self._debug_queries_payload(params))
 
+    @route("GET", "/debug/resultcache")
+    def handle_debug_resultcache(self, req, params, path, body):
+        """Query result cache state (runtime/resultcache): budget /
+        bytes / entry count, hit / miss / fill / eviction /
+        invalidation totals, and the largest entries (key digest —
+        matching the ``cacheKey`` on flight records — bytes, age,
+        hits)."""
+        from pilosa_tpu.runtime import resultcache
+
+        self._json(req, resultcache.cache().debug())
+
     @route("GET", "/debug/devices")
     def handle_debug_devices(self, req, params, path, body):
         """Device-runtime telemetry (pilosa_tpu.devobs): per-kernel /
@@ -1267,9 +1283,11 @@ class Handler:
         snap = {}
         if self.stats is not None and hasattr(self.stats, "snapshot"):
             from pilosa_tpu import devobs
+            from pilosa_tpu.runtime import resultcache
 
             try:
                 devobs.observer().publish_gauges(self.stats)
+                resultcache.cache().publish_gauges(self.stats)
             except Exception:  # noqa: BLE001
                 pass
             snap = self.stats.snapshot()

@@ -68,6 +68,10 @@ class Server:
         admission_internal_cap: int = 16,
         admission_internal_queue: int = 64,
         admission_default_deadline: float = 0.0,
+        cache_enabled: bool = True,
+        cache_budget_bytes: int | None = None,
+        cache_max_entry_bytes: int | None = None,
+        cache_ttl: float | None = None,
     ):
         from pilosa_tpu import logger as _logger
         from pilosa_tpu import stats as _stats
@@ -132,6 +136,18 @@ class Server:
             enabled=observe_enabled,
             logger=self.logger,
             stats=self.stats,
+        )
+        # generation-stamped query result cache ([cache] config):
+        # process-wide like the residency manager — configure in place
+        # so a second in-process server cannot wipe the first's warm
+        # entries
+        from pilosa_tpu.runtime import resultcache as _resultcache
+
+        _resultcache.configure(
+            budget_bytes=cache_budget_bytes,
+            max_entry_bytes=cache_max_entry_bytes,
+            ttl_s=cache_ttl,
+            enabled=cache_enabled,
         )
         # device-runtime telemetry (pilosa_tpu.devobs): wire the stats
         # backend in (compile.ms histograms publish live) and start the

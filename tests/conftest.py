@@ -67,3 +67,9 @@ def _hermetic_residency_accounting():
     # three tests later.
     assert prewarm.drain(timeout=30), "prewarm drain timed out in teardown"
     residency.reset()
+    # the query result cache is process-wide too; holder uids make
+    # cross-test hits impossible, but a test that shrinks the budget
+    # or disables it must not leak that config into the next test
+    from pilosa_tpu.runtime import resultcache
+
+    resultcache.reset()

@@ -336,7 +336,11 @@ class TestHTTPConcurrency:
 
             qs = [f"Count(Intersect(Row(f0={a}), Row(f1={b})))"
                   for a in range(4) for b in range(4)]
-            expected = [srv.api.query("i", q, coalesce=False)[0]
+            # ground truth must not warm the result cache, or the
+            # concurrent wave would answer from it and never reach the
+            # coalescer this test exists to exercise
+            expected = [srv.api.query("i", q, coalesce=False,
+                                      cache=False)[0]
                         for q in qs]
 
             def post(q):

@@ -19,7 +19,7 @@ from pilosa_tpu import devobs, observe, stats as _stats
 from pilosa_tpu.models.holder import Holder
 from pilosa_tpu.ops import bitmap as bm
 from pilosa_tpu.ops import expr
-from pilosa_tpu.parallel.executor import Executor
+from pilosa_tpu.parallel.executor import ExecOptions, Executor
 from pilosa_tpu.server.server import Server
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
@@ -149,7 +149,9 @@ class TestCompileAttribution:
         carries compiled=true with nonzero compile_ms; an identical
         follow-up (same canonical shape, warm jit cache) carries
         compiled=false."""
-        ex.execute("i", "Count(Row(f=3))")  # warm stacks + translation
+        # warm stacks + translation WITHOUT filling the result cache —
+        # the measured run must really execute (and compile)
+        ex.execute("i", "Count(Row(f=3))", opt=ExecOptions(cache=False))
         _fresh_compile_state()
         assert int(ex.execute("i", "Count(Row(f=3))")[0]) == 10
         first = ex.recorder.recent_records()[-1].to_dict()
@@ -168,7 +170,8 @@ class TestCompileAttribution:
             def printf(self, fmt, *args):
                 self.lines.append(fmt % args if args else fmt)
 
-        ex.execute("i", "Count(Row(f=3))")
+        # warm without filling the result cache (see the test above)
+        ex.execute("i", "Count(Row(f=3))", opt=ExecOptions(cache=False))
         _fresh_compile_state()
         log = _Log()
         ex.recorder.logger = log
@@ -246,7 +249,8 @@ class TestDebugDevices:
         self._prime(srv.uri)
         d = _get(srv.uri, "/debug/devices")
         assert d["enabled"] is True
-        assert set(d["compile"]) == {"total", "totalMs", "kernels"}
+        assert set(d["compile"]) == {"total", "totalMs", "kernels",
+                                     "programEvictions"}
         for k in d["compile"]["kernels"].values():
             assert k["compiles"] >= 1 and "shapes" in k
         assert d["transfer"]["bytes"] > 0

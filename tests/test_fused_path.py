@@ -457,7 +457,8 @@ class TestFusedTopNGroupBy:
         hits = {"n": 0}
         orig = nodes[0].executor._fused_topn_counts
         nodes[0].executor._fused_topn_counts = (
-            lambda *a: (hits.__setitem__("n", hits["n"] + 1), orig(*a))[1])
+            lambda *a, **k: (hits.__setitem__("n", hits["n"] + 1),
+                             orig(*a, **k))[1])
         got = nodes[0].executor.execute("i", "TopN(f)")[0]
         assert hits["n"] > 0, "local group did not use the fused TopN scan"
         want = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
@@ -538,9 +539,9 @@ class TestFusedExtremeRowAndRows:
         calls = []
         orig = Executor._fused_topn_counts
 
-        def spy(self, idx, f, filter_call, shards):
+        def spy(self, idx, f, filter_call, shards, opt=None):
             calls.append(shards)
-            return orig(self, idx, f, filter_call, shards)
+            return orig(self, idx, f, filter_call, shards, opt=opt)
 
         monkeypatch.setattr(Executor, "_fused_topn_counts", spy)
         ex.execute("i", "MinRow(field=f0)")

@@ -123,10 +123,11 @@ class TestSingleNodeHTTP:
         assert "# TYPE pilosa_query_latency histogram" in text
 
     def test_metrics_device_families_present(self, srv):
-        """The device-runtime telemetry families (device.*/compile.*/
-        residency.*, pilosa_tpu.devobs) must render on a live server's
-        /metrics and survive the strict exposition parser — a refactor
-        that drops a family fails here, not in a dashboard."""
+        """The telemetry families (device.*/compile.*/residency.* from
+        pilosa_tpu.devobs, cache.* from runtime/resultcache — the
+        `--families` CLI set) must render on a live server's /metrics
+        and survive the strict exposition parser — a refactor that
+        drops a family fails here, not in a dashboard."""
         from tools import check_metrics
 
         _post(srv.uri, "/index/df")
@@ -134,8 +135,9 @@ class TestSingleNodeHTTP:
         _post(srv.uri, "/index/df/query", {"query": "Set(1, f=4)"})
         _post(srv.uri, "/index/df/query", {"query": "Count(Row(f=4))"})
         text = _get(srv.uri, "/metrics", expect_json=False).decode()
-        fams = check_metrics.check_families(text)
-        assert set(fams) == set(check_metrics.DEVICE_FAMILIES)
+        fams = check_metrics.check_families(text,
+                                            check_metrics.ALL_FAMILIES)
+        assert set(fams) == set(check_metrics.ALL_FAMILIES)
         assert all(n >= 1 for n in fams.values())
 
     def test_internal_fragment_endpoints(self, srv):

@@ -146,6 +146,12 @@ class TestProductIntegration:
         counted, and every device-path result stays bit-exact against
         a host (numpy) recomputation from the fragments' own rows —
         eviction may only ever cost warmth."""
+        # this test exercises the device-residency rebuild cycle; the
+        # result cache would answer the repeated passes without ever
+        # touching the stacks being churned
+        from pilosa_tpu.runtime import resultcache
+
+        resultcache.cache().enabled = False
         residency.reset(100 << 10)
         holder, ex = self._build(tmp_path)
         f = holder.index("i").field("f")

@@ -236,6 +236,14 @@ def check_text(text: str) -> dict:
 #: family check (``check_families``) pins these in tests/test_http.py.
 DEVICE_FAMILIES = ("device_", "compile_", "residency_")
 
+#: The query result cache's families (runtime/resultcache
+#: publish_gauges): cache.{hits,misses,fills,evictions,invalidations,
+#: bytes,...} rendered as cache_*.
+CACHE_FAMILIES = ("cache_",)
+
+#: Everything the ``--families`` CLI mode requires of a live server.
+ALL_FAMILIES = DEVICE_FAMILIES + CACHE_FAMILIES
+
 
 def check_families(text: str, prefixes=DEVICE_FAMILIES) -> dict[str, int]:
     """Strict-parse one exposition body AND require at least one
@@ -267,7 +275,7 @@ def main(argv: list[str] | None = None) -> int:
     families = None
     if "--families" in argv:
         argv = [a for a in argv if a != "--families"]
-        families = DEVICE_FAMILIES
+        families = ALL_FAMILIES
     if len(argv) != 1:
         print("usage: python -m tools.check_metrics [--families] "
               "URL|FILE", file=sys.stderr)
