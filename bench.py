@@ -744,6 +744,152 @@ def bench_resultcache(a_np: np.ndarray,
     return out
 
 
+def bench_ingest(a_np: np.ndarray, b_np: np.ndarray) -> dict | None:
+    """Read-under-ingest A/B on the coalesced Count path (the
+    streaming-ingest round): p50 of a repeated
+    ``Count(Intersect(Row, Row))`` measured in three phases —
+    read-only baseline, reads while a writer thread sustains batched
+    same-field imports with DELTA PLANES ON (writes land beside the
+    base; only compaction bumps the generation, so the queried rows'
+    device stacks stay resident), and the same write load with deltas
+    OFF (every import bumps the generation: per-read stack rebuild +
+    re-upload, the pre-ingest-subsystem behavior).
+
+    Every sampled read is verified bit-exact (the write load touches
+    rows the query never reads, so the count is invariant), background
+    compactions run mid-phase to exercise the merge-vs-read race, and
+    each phase reports the result-cache hit rate over its window.
+    Artifact pin: ``pin_2x_ok`` — the delta-path p50 under ingest
+    stays within 2x of the read-only baseline (the bench-local analog
+    of the loadgen acceptance run's read-p99 bound)."""
+    import statistics
+    import tempfile
+    import threading
+
+    from pilosa_tpu import ingest as _ingest
+    from pilosa_tpu.ingest import compactor as _compactor
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.ops import bitmap as bm
+    from pilosa_tpu.parallel.coalescer import Coalescer
+    from pilosa_tpu.parallel.executor import Executor
+    from pilosa_tpu.runtime import resultcache
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    if bm.n_words(SHARD_WIDTH) != WORDS:
+        return None
+
+    SH = 32  # shards: enough for a real fan-out, small enough to A/B
+    holder = Holder(tempfile.mkdtemp() + "/bench-ing")
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    view = f.create_view_if_not_exists("standard")
+    for s in range(SH):
+        frag = view.create_fragment_if_not_exists(s)
+        with frag._lock:
+            frag._rows[1] = a_np[s].copy()
+            frag._rows[2] = b_np[s].copy()
+            frag._gen += 1
+        f._note_shard(s)
+    expect = int(np.bitwise_count(a_np[:SH] & b_np[:SH])
+                 .sum(dtype=np.uint64))
+    ex = Executor(holder)
+    ex.coalescer = Coalescer(window_s=0.002, max_batch=32,
+                             enabled="auto")
+    q = "Count(Intersect(Row(f=1), Row(f=2)))"
+    rng = np.random.default_rng(4242)
+
+    def phase(delta_on: bool, write: bool, seconds: float) -> dict:
+        # short compact interval: at the default 2.0s age bound (and
+        # 128k-bit threshold vs ~16 bits/fragment/batch here) nothing
+        # would be _due() inside a 2s phase and run_once() below would
+        # be a no-op — the merge-vs-read race this phase exists to
+        # exercise needs age-due fragments mid-phase (reset() in the
+        # outer finally restores the defaults)
+        _ingest.configure(delta_enabled=delta_on,
+                          compact_interval=0.2)
+        _compactor.reset()
+        resultcache.reset()
+        rc0 = resultcache.cache().stats_dict()
+        stop = threading.Event()
+        bits = [0]
+
+        def writer():
+            batch = 0
+            while not stop.is_set():
+                rows = rng.integers(10, 18, size=512).tolist()
+                cols = rng.integers(0, SH * SHARD_WIDTH,
+                                    size=512).tolist()
+                f.import_bits(rows, cols)
+                bits[0] += 512
+                batch += 1
+                if delta_on and batch % 50 == 0:
+                    # background merge racing the reads (what the
+                    # compactor thread does in production)
+                    _compactor.compactor().run_once()
+                time.sleep(0.001)
+
+        t = threading.Thread(target=writer, daemon=True)
+        if write:
+            t.start()
+        lats = []
+        end = time.perf_counter() + seconds
+        while time.perf_counter() < end:
+            t0 = time.perf_counter_ns()
+            got = int(ex.execute("i", q)[0])
+            lats.append(time.perf_counter_ns() - t0)
+            if got != expect:
+                stop.set()
+                raise AssertionError(
+                    f"ingest A/B bit-exactness violated: {got} != "
+                    f"{expect} (delta_on={delta_on})")
+        stop.set()
+        if write:
+            t.join(timeout=10)
+        merged = f.flush_deltas()
+        if int(ex.execute("i", q)[0]) != expect:
+            raise AssertionError("post-flush count diverged")
+        rc1 = resultcache.cache().stats_dict()
+        dh = rc1["hits"] - rc0["hits"]
+        dm = rc1["misses"] - rc0["misses"]
+        elapsed_bits = bits[0] / seconds
+        return {
+            "p50_us": round(statistics.median(lats) / 1e3, 1),
+            "reads": len(lats),
+            "ingest_bits_per_s": round(elapsed_bits, 0),
+            "cache_hit_rate": round(dh / (dh + dm), 3)
+            if dh + dm else None,
+            "flushed_bits": merged,
+            # proof the merge-vs-read race actually ran mid-phase
+            "compactions": _compactor.compactor().compactions,
+        }
+
+    try:
+        read_only = phase(True, write=False, seconds=1.0)
+        under_delta = phase(True, write=True, seconds=2.0)
+        under_base = phase(False, write=True, seconds=2.0)
+    finally:
+        _ingest.reset()
+        _compactor.reset()
+        holder.close()
+    out = {
+        "read_only": read_only,
+        "under_ingest_delta": under_delta,
+        "under_ingest_base": under_base,
+        "delta_vs_readonly": round(
+            under_delta["p50_us"] / read_only["p50_us"], 2),
+        "base_vs_readonly": round(
+            under_base["p50_us"] / read_only["p50_us"], 2),
+        "pin_2x_ok": under_delta["p50_us"]
+        <= 2.0 * read_only["p50_us"],
+    }
+    if not out["pin_2x_ok"]:
+        print(f"bench: ingest read-under-write p50 "
+              f"{under_delta['p50_us']:.0f}us is NOT within 2x of the "
+              f"read-only baseline {read_only['p50_us']:.0f}us",
+              file=sys.stderr)
+    return out
+
+
 def bench_admission(coalescer_extras: dict | None) -> dict:
     """Admission-layer overhead on the uncontended serving path: the
     gate's acquire+release pair is what every admitted request pays on
@@ -893,6 +1039,9 @@ def main():
     rc = bench_resultcache(a, b)
     if rc is not None:
         extras["resultcache"] = rc
+    ing = bench_ingest(a, b)
+    if ing is not None:
+        extras["ingest"] = ing
     bytes_per_query = a.nbytes + b.nbytes  # streamed once per query
     achieved_gbps = dev_qps * bytes_per_query / 1e9
     peak = _peak_gbps(platform)

@@ -94,7 +94,7 @@ class API:
     def query(self, index: str, pql, shards=None, remote: bool = False,
               column_attrs: bool = False, exclude_row_attrs: bool = False,
               exclude_columns: bool = False, coalesce: bool = True,
-              cache: bool = True):
+              cache: bool = True, delta: bool = True):
         """Execute PQL -> list of results (api.go:135 API.Query)."""
         from pilosa_tpu.parallel.executor import ExecOptions
         from pilosa_tpu.serve import deadline as _deadline
@@ -168,6 +168,7 @@ class API:
             shards=None if shards is None else list(shards),
             coalesce=coalesce,
             cache=cache,
+            delta=delta,
             deadline=dl,
         )
         return self.executor.execute(index, pql, opt=opt)

@@ -69,6 +69,23 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--cache-ttl", type=float,
                     help="seconds before a cached result ages out even "
                          "unmutated ([cache] ttl; 0 = generations only)")
+    ps.add_argument("--no-ingest-delta", action="store_true",
+                    help="disable streaming-ingest delta planes "
+                         "([ingest] delta-enabled=false): every write "
+                         "mutates base state and bumps the generation "
+                         "(pre-delta semantics)")
+    ps.add_argument("--ingest-delta-budget-bytes", type=int,
+                    help="process-wide bound on pending delta bytes; "
+                         "past it writers flush their own fragment "
+                         "inline ([ingest] delta-budget-bytes)")
+    ps.add_argument("--ingest-compact-threshold-bits", type=int,
+                    help="pending bit positions that trigger a "
+                         "fragment's compaction on the next scan "
+                         "([ingest] compact-threshold-bits)")
+    ps.add_argument("--ingest-compact-interval", type=float,
+                    help="compactor scan period in seconds, and the "
+                         "age bound for small deltas ([ingest] "
+                         "compact-interval)")
     ps.add_argument("--verbose", action="store_true")
 
     pi = sub.add_parser("import", help="bulk-import CSV bits")
@@ -161,6 +178,13 @@ def cmd_server(args) -> int:
         v = getattr(args, f"cache_{key}", None)
         if v is not None:
             setattr(cfg.cache, key, v)
+    if args.no_ingest_delta:
+        cfg.ingest.delta_enabled = False
+    for key in ("delta_budget_bytes", "compact_threshold_bits",
+                "compact_interval"):
+        v = getattr(args, f"ingest_{key}", None)
+        if v is not None:
+            setattr(cfg.ingest, key, v)
     return run_server(cfg)
 
 
@@ -245,6 +269,10 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         cache_budget_bytes=cfg.cache.budget_bytes,
         cache_max_entry_bytes=cfg.cache.max_entry_bytes,
         cache_ttl=cfg.cache.ttl,
+        ingest_delta_enabled=cfg.ingest.delta_enabled,
+        ingest_delta_budget_bytes=cfg.ingest.delta_budget_bytes,
+        ingest_compact_threshold_bits=cfg.ingest.compact_threshold_bits,
+        ingest_compact_interval=cfg.ingest.compact_interval,
         logger=log,
         stats=stats,
     )

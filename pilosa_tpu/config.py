@@ -135,6 +135,30 @@ class CacheConfig:
 
 
 @dataclass
+class IngestConfig:
+    """[ingest] — the streaming write path (pilosa_tpu.ingest; the
+    reference's roaring op-log appended ahead of snapshots,
+    fragment.go import paths).  With ``delta-enabled`` on, batched
+    imports and set/clear land in a bounded per-fragment DELTA PLANE
+    without bumping the base generation — device residency and
+    result-cache entries stay warm under sustained ingest — and the
+    background compactor merges deltas into base roaring state under
+    admission's ``internal`` class.  ``delta-budget-bytes`` bounds
+    process-wide pending delta memory (past it the writer flushes its
+    own fragment inline); ``compact-threshold-bits`` merges a fragment
+    once its delta holds that many pending bit positions;
+    ``compact-interval`` (seconds) is both the compactor scan period
+    and the age bound (a delta older than one interval merges even
+    when small).  Per-request escape: ``?nodelta=1`` on the query
+    route compacts up front and reads pure base state."""
+
+    delta_enabled: bool = True
+    delta_budget_bytes: int = 64 << 20
+    compact_threshold_bits: int = 1 << 17
+    compact_interval: float = 2.0
+
+
+@dataclass
 class AdmissionConfig:
     """[admission] — priority-classed admission control + load
     shedding on the serving path (serve/admission.py; no reference
@@ -187,6 +211,7 @@ class Config:
     observe: ObserveConfig = field(default_factory=ObserveConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     cache: CacheConfig = field(default_factory=CacheConfig)
+    ingest: IngestConfig = field(default_factory=IngestConfig)
 
     # ------------------------------------------------------------- access
 
@@ -223,7 +248,7 @@ class Config:
             key = k.replace("-", "_")
             if key in ("cluster", "anti_entropy", "metric", "tracing",
                        "profile", "tls", "coalescer", "observe",
-                       "admission", "cache") and isinstance(v, dict):
+                       "admission", "cache", "ingest") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -239,7 +264,8 @@ class Config:
                                                         CoalescerConfig,
                                                         ObserveConfig,
                                                         AdmissionConfig,
-                                                        CacheConfig)):
+                                                        CacheConfig,
+                                                        IngestConfig)):
                 setattr(self, key, v)
 
     def _apply_env(self, env: dict) -> None:
@@ -248,7 +274,7 @@ class Config:
         for f in fields(self):
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
                           "profile", "tls", "coalescer", "observe",
-                          "admission", "cache"):
+                          "admission", "cache", "ingest"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -328,6 +354,13 @@ class Config:
             f"budget-bytes = {self.cache.budget_bytes}",
             f"max-entry-bytes = {self.cache.max_entry_bytes}",
             f"ttl = {self.cache.ttl}",
+            "",
+            "[ingest]",
+            f"delta-enabled = {str(self.ingest.delta_enabled).lower()}",
+            f"delta-budget-bytes = {self.ingest.delta_budget_bytes}",
+            f"compact-threshold-bits = "
+            f"{self.ingest.compact_threshold_bits}",
+            f"compact-interval = {self.ingest.compact_interval}",
             "",
             "[tls]",
             f'certificate-path = "{self.tls.certificate_path}"',

@@ -1,0 +1,358 @@
+"""Background delta compaction: merges pending delta planes into base
+roaring state without ever blocking a writer.
+
+Policy lives here, mechanism in ``Fragment.flush_delta``: a fragment
+registers on its first delta write (``note_delta``), and the
+compactor's scan thread merges it once the delta crosses the size
+threshold ([ingest] compact-threshold-bits), exceeds one scan interval
+in age ([ingest] compact-interval — trickle writes never pend
+forever), or the process-wide pending-byte budget is exceeded ([ingest]
+delta-budget-bytes; past it the WRITER flushes its own fragment inline,
+the same backpressure shape as snapqueue's inline overflow).
+
+The scan runs under admission's ``internal`` class when a controller is
+wired (Server assembly): each round acquires one internal ticket with a
+one-interval deadline, so compaction yields to user queries exactly the
+way anti-entropy does — saturating query traffic PAUSES compaction
+(counted in ``ingest.compact_skipped``) rather than competing with it.
+``pause()``/``resume()`` give operators/tests a hard switch.
+
+Lock order is fragment -> compactor everywhere: ``note_delta`` /
+``note_flushed`` run under the fragment lock and take the registry lock
+inside; the scan thread snapshots the registry under its own lock,
+RELEASES, then calls ``flush_delta`` (which takes fragment -> registry)
+— no cycle.
+
+Stats families (``ingest.*``, published at /metrics + /debug/vars
+scrape time like cache.*): delta_writes, delta_bits, delta_rows,
+delta_bytes (pending gauges), fragments_pending, compactions,
+compacted_bits, inline_flushes, compact_skipped.  Debug surface:
+``GET /debug/ingest``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+
+from pilosa_tpu import ingest as _ingest
+from pilosa_tpu.serve.deadline import Deadline
+
+
+class Compactor:
+    """Process-wide delta-compaction policy + scan thread."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: id(frag) -> (weakref, last-known pending bytes)
+        self._frags: dict[int, tuple] = {}
+        self._pending_bytes = 0
+        self.admission = None  # serve.admission.AdmissionController
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self._paused = False
+        self.compactions = 0
+        self.compacted_bits = 0
+        self.inline_flushes = 0
+        self.compact_skipped = 0
+        self.delta_writes = 0
+
+    # -------------------------------------------------- fragment callbacks
+
+    def note_delta(self, frag) -> bool:
+        """A delta write landed on ``frag`` (caller holds the fragment
+        lock).  Registers the fragment and returns True when the
+        process-wide pending-byte budget is exceeded — the caller then
+        flushes ITS OWN fragment inline (bounded memory; the writer
+        pays, queued readers don't)."""
+        d = frag._delta
+        nbytes = 0 if d is None else d.nbytes
+        budget = _ingest.config().delta_budget_bytes
+        with self._lock:
+            self.delta_writes += 1
+            fid = id(frag)
+            prev = self._frags.get(fid)
+            self._pending_bytes += nbytes - (prev[1] if prev else 0)
+            self._frags[fid] = (weakref.ref(frag), nbytes)
+            return self._pending_bytes > budget > 0
+
+    def note_flushed(self, frag, bits: int, inline: bool = False) -> None:
+        """``frag`` merged its delta (caller holds the fragment lock)."""
+        with self._lock:
+            prev = self._frags.pop(id(frag), None)
+            if prev is not None:
+                self._pending_bytes -= prev[1]
+                if self._pending_bytes < 0:
+                    self._pending_bytes = 0
+            # cumulative counters exposed as gauges at scrape time
+            # (publish_gauges) — never ALSO pushed as counts, which
+            # would render a second TYPE line for the same family and
+            # fail the strict exposition parser
+            self.compactions += 1
+            self.compacted_bits += bits
+            if inline:
+                self.inline_flushes += 1
+
+    def forget(self, frag) -> None:
+        """Drop a closing fragment from the registry (Fragment.close);
+        its WAL carries the pending bits durably."""
+        with self._lock:
+            prev = self._frags.pop(id(frag), None)
+            if prev is not None:
+                self._pending_bytes -= prev[1]
+                if self._pending_bytes < 0:
+                    self._pending_bytes = 0
+
+    # ------------------------------------------------------------- policy
+
+    def _due(self, frag, cfg) -> bool:
+        d = frag._delta
+        if d is None or d.empty():
+            return True  # flush_delta no-ops; dereg happens in run_once
+        return (d.bits >= cfg.compact_threshold_bits
+                or d.age_s() >= cfg.compact_interval
+                or d.nbytes > cfg.delta_budget_bytes)
+
+    def run_once(self, force: bool = False) -> int:
+        """One scan: merge every due (or, with ``force``, every
+        pending) delta.  Returns the number of fragments flushed.
+        Tests call this directly for determinism; the thread calls it
+        per interval."""
+        cfg = _ingest.config()
+        with self._lock:
+            if self._paused and not force:
+                return 0
+            snapshot = [(fid, ref) for fid, (ref, _) in
+                        self._frags.items()]
+        flushed = 0
+        for fid, ref in snapshot:
+            frag = ref()
+            if frag is None:
+                with self._lock:
+                    prev = self._frags.pop(fid, None)
+                    if prev is not None:
+                        self._pending_bytes -= prev[1]
+                continue
+            if force or self._due(frag, cfg):
+                # flush_delta takes fragment -> registry (note_flushed);
+                # no compactor lock is held here
+                if frag.flush_delta() == 0:
+                    # already empty (raced a read-side flush): deregister
+                    # — but only while the delta is STILL empty under the
+                    # fragment lock.  A writer landing between
+                    # flush_delta's return and an unconditional forget()
+                    # re-registers the fragment (note_delta), and popping
+                    # that fresh entry would hide its pending delta from
+                    # every future scan until another write happened by.
+                    # Holding frag._lock across check+forget excludes
+                    # note_delta (writers hold the same lock); order is
+                    # fragment -> registry, same as note_delta itself.
+                    with frag._lock:
+                        d = frag._delta
+                        if d is None or d.empty():
+                            self.forget(frag)
+                else:
+                    flushed += 1
+        return flushed
+
+    # ------------------------------------------------------------- thread
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return
+            self._stop = threading.Event()
+            self._thread = threading.Thread(target=self._loop,
+                                            daemon=True,
+                                            name="ingest-compactor")
+            self._thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            thread = self._thread
+            self._thread = None
+        self._stop.set()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    def pause(self) -> None:
+        with self._lock:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+
+    def _loop(self) -> None:
+        while not self._stop.wait(_ingest.config().compact_interval):
+            try:
+                self._run_gated()
+            except Exception:  # noqa: BLE001 — scan must never die; the
+                pass  # next interval retries (WAL holds durability)
+
+    def _run_gated(self) -> None:
+        """One scan under the internal admission class: shed by the
+        gate (query pressure / saturation) means SKIP this round — the
+        deltas stay pending and the next interval retries."""
+        adm = self.admission
+        if adm is None or not getattr(adm, "enabled", False):
+            self.run_once()
+            return
+        from pilosa_tpu.serve.admission import ShedError
+
+        try:
+            ticket = adm.acquire(
+                "internal", Deadline(_ingest.config().compact_interval))
+        except ShedError:
+            with self._lock:
+                self.compact_skipped += 1
+            return
+        try:
+            self.run_once()
+        finally:
+            ticket.release()
+
+    # -------------------------------------------------------------- views
+
+    def pending(self) -> list[tuple]:
+        """Live (fragment, delta-stats) pairs, largest pending first."""
+        with self._lock:
+            refs = [ref for ref, _ in self._frags.values()]
+        out = []
+        for ref in refs:
+            frag = ref()
+            if frag is None:
+                continue
+            with frag._lock:
+                d = frag._delta
+                if d is None or d.empty():
+                    continue
+                out.append((frag, d.stats()))
+        out.sort(key=lambda fs: -fs[1]["bits"])
+        return out
+
+    def totals(self, pend: list[tuple] | None = None) -> dict:
+        """Aggregate view; pass a precomputed ``pending()`` snapshot to
+        avoid a second per-fragment lock sweep (debug() does)."""
+        if pend is None:
+            pend = self.pending()
+        with self._lock:
+            return {
+                "fragmentsPending": len(pend),
+                "pendingBits": sum(s["bits"] for _, s in pend),
+                "pendingRows": sum(s["rows"] for _, s in pend),
+                "pendingBytes": sum(s["bytes"] for _, s in pend),
+                "deltaWrites": self.delta_writes,
+                "compactions": self.compactions,
+                "compactedBits": self.compacted_bits,
+                "inlineFlushes": self.inline_flushes,
+                "compactSkipped": self.compact_skipped,
+                "paused": self._paused,
+                "running": (self._thread is not None
+                            and self._thread.is_alive()),
+            }
+
+    def debug(self, top_n: int = 32) -> dict:
+        """The /debug/ingest document: config, totals, and the largest
+        pending deltas (fragment identity + size/age)."""
+        cfg = _ingest.config()
+        out = {
+            "config": {
+                "deltaEnabled": cfg.delta_enabled,
+                "deltaBudgetBytes": cfg.delta_budget_bytes,
+                "compactThresholdBits": cfg.compact_threshold_bits,
+                "compactInterval": cfg.compact_interval,
+            },
+        }
+        pend = self.pending()
+        out.update(self.totals(pend))
+        out["top"] = [{
+            "index": frag.index, "field": frag.field,
+            "view": frag.view, "shard": frag.shard,
+            "deltaSeq": frag._delta_seq, **s,
+        } for frag, s in pend[:top_n]]
+        return out
+
+    def publish_gauges(self, stats) -> None:
+        """Push the ingest.* families into a stats registry at scrape
+        time (/metrics, /debug/vars) — cumulative totals as gauges,
+        same rule as resultcache.publish_gauges."""
+        t = self.totals()
+        stats.gauge("ingest.delta_writes", t["deltaWrites"])
+        stats.gauge("ingest.delta_bits", t["pendingBits"])
+        stats.gauge("ingest.delta_rows", t["pendingRows"])
+        stats.gauge("ingest.delta_bytes", t["pendingBytes"])
+        stats.gauge("ingest.fragments_pending", t["fragmentsPending"])
+        stats.gauge("ingest.compactions", t["compactions"])
+        stats.gauge("ingest.compacted_bits", t["compactedBits"])
+        stats.gauge("ingest.inline_flushes", t["inlineFlushes"])
+        stats.gauge("ingest.compact_skipped", t["compactSkipped"])
+
+
+# ----------------------------------------------------------- process-wide
+
+
+_global: Compactor | None = None
+_global_lock = threading.Lock()
+
+
+def compactor() -> Compactor:
+    """The process-wide compactor (one per process, like the snapshot
+    queue the design mirrors)."""
+    global _global
+    c = _global
+    if c is not None:
+        return c
+    with _global_lock:
+        if _global is None:
+            _global = Compactor()
+        return _global
+
+
+def reset() -> Compactor:
+    """Replace the process-wide compactor (tests)."""
+    global _global, _refs
+    with _global_lock:
+        if _global is not None:
+            _global.stop()
+        _global = Compactor()
+        _refs = 0
+        return _global
+
+
+# The scan thread and the [ingest] config are process-wide but servers
+# open and close independently (in-process clusters, embedders):
+# reference-count the ingest-enabled servers so an early closer cannot
+# stop the thread — or restore the config — out from under a still-open
+# one.
+
+_refs = 0
+
+
+def retain() -> Compactor:
+    """One more open ingest-enabled server: start (or keep) the shared
+    scan thread."""
+    global _refs
+    with _global_lock:
+        _refs += 1
+    c = compactor()
+    c.start()
+    return c
+
+
+def release() -> bool:
+    """Drop one reference.  Stops the shared thread and returns True
+    only when this was the LAST open ingest-enabled server — the
+    caller may then restore the process-wide [ingest] config."""
+    global _refs
+    with _global_lock:
+        _refs = max(0, _refs - 1)
+        last = _refs == 0
+    if last:
+        compactor().stop()
+    return last
+
+
+def refs() -> int:
+    return _refs

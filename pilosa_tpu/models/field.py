@@ -45,11 +45,24 @@ TRUE_ROW_ID = 1
 
 
 def _frag_gen(fr):
-    """Cache-invalidation token for one fragment slot: (uid, gen), or 0
-    for an absent fragment.  The uid half guards against object
-    replacement — a fragment deleted by resize cleanup and re-fetched
-    later is a new object whose _gen can collide with a cached tuple,
-    which a bare-gen comparison would treat as a (stale) hit."""
+    """Cache-invalidation token for one fragment slot: (uid, gen,
+    delta_seq), or 0 for an absent fragment.  The uid half guards
+    against object replacement — a fragment deleted by resize cleanup
+    and re-fetched later is a new object whose _gen can collide with a
+    cached tuple, which a bare-gen comparison would treat as a (stale)
+    hit.  The delta_seq half covers the streaming-ingest path
+    (pilosa_tpu.ingest): delta-landing writes bump the monotone
+    ``_delta_seq`` instead of ``_gen``, so any token consumer whose
+    content reflects base ⊕ delta invalidates on either."""
+    return 0 if fr is None else (fr._uid, fr._gen, fr._delta_seq)
+
+
+def _frag_base_gen(fr):
+    """Token for caches holding BASE-ONLY content (the fused row
+    stacks, whose pending delta the executor fuses on top as separate
+    ``dfuse`` leaves): deliberately blind to ``_delta_seq``, so
+    streaming writes leave the big resident base stacks warm — the
+    entire point of the delta plane."""
     return 0 if fr is None else (fr._uid, fr._gen)
 
 
@@ -377,9 +390,11 @@ class Field:
         view = self.view(VIEW_STANDARD)
         key = (row_id, shards)
         # bind each fragment once: a concurrent delete_fragment between
-        # two lookups must read as "empty", not crash
+        # two lookups must read as "empty", not crash.  BASE token: a
+        # pending delta must NOT invalidate this stack — the executor
+        # fuses it on top (device_delta_stacks + expr "dfuse")
         frags = [None if view is None else view.fragment(s) for s in shards]
-        gens = tuple(_frag_gen(fr) for fr in frags)
+        gens = tuple(_frag_base_gen(fr) for fr in frags)
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if hit is not None and hit[0] == gens and _live(hit[1]):
@@ -489,7 +504,12 @@ class Field:
                 if fr is None:
                     continue
                 with fr._lock:
-                    arr = fr._rows.get(row_id)
+                    # EFFECTIVE words (base ⊕ pending delta): the time
+                    # union happens host-side, so the overlay applies
+                    # here rather than as device leaves — the cache key
+                    # (_frag_gen, delta_seq included) invalidates on
+                    # every delta write to a covering fragment
+                    arr, _ = fr._row_words_effective_locked(row_id)
                     if arr is not None:
                         if wrote:
                             np.bitwise_or(stack[i], arr, out=stack[i])
@@ -526,6 +546,78 @@ class Field:
             self._row_stack_cache, key, (gens, dev), entry_bytes,
             max_entries=64)
         return dev
+
+    def device_delta_stacks(self, row_id: int, shards: tuple[int, ...]):
+        """The fused read side of streaming ingest: pending delta
+        overlays for one standard-view row across the shard set, as a
+        pair of device uint32 [n_shards, words] stacks ``(set_stack,
+        clear_stack)`` — the operands of ops.expr's ``dfuse`` node
+        ``(base & ~clear) | set``.  Returns None when NO fragment has a
+        pending overlay for this row (the common post-compaction case:
+        the tree shape stays the plain leaf and nothing recompiles).
+
+        Cached per (row, shards) keyed on the per-fragment ``(uid,
+        row_seq)`` tokens — a delta write to a DIFFERENT row leaves a
+        cached pair valid, so only the written row's stacks rebuild.
+        Safe under a concurrent compaction because delta application
+        is idempotent: the executor stages these BEFORE the base stack,
+        and re-applying an already-merged overlay reproduces the same
+        effective words ((b&~c|s)&~c|s == b&~c|s)."""
+        from pilosa_tpu.ops import bitmap as bm
+
+        view = self.view(VIEW_STANDARD)
+        frags = [None if view is None else view.fragment(s)
+                 for s in shards]
+        toks = tuple(0 if fr is None
+                     else (fr._uid, fr._delta_row_seq(row_id))
+                     for fr in frags)
+        if not any(t and t[1] for t in toks):
+            return None
+        key = ("delta", row_id, shards)
+        with self._lock:
+            hit = self._row_stack_cache.get(key)
+            if (hit is not None and hit[0] == toks
+                    and _live(hit[1][0]) and _live(hit[1][1])):
+                self._touch(self._row_stack_cache, key)
+                return hit[1]
+        n_words = bm.n_words(SHARD_WIDTH)
+        rows = _padded_rows(len(shards))
+        set_stack = np.zeros((rows, n_words), dtype=np.uint32)
+        clear_stack = np.zeros((rows, n_words), dtype=np.uint32)
+        for i, fr in enumerate(frags):
+            if fr is None:
+                continue
+            with fr._lock:
+                d = fr._delta
+                if d is None or not d.row_touched(row_id):
+                    continue
+                s = d.sets.get(row_id)
+                if s is not None:
+                    set_stack[i] = s
+                c = d.clears.get(row_id)
+                if c is not None:
+                    clear_stack[i] = c
+        pair = (self._place_on_devices(set_stack),
+                self._place_on_devices(clear_stack))
+        entry_bytes = set_stack.nbytes + clear_stack.nbytes
+        if entry_bytes <= self._entry_cap(self.ROW_STACK_CACHE_BYTES):
+            self._evict_and_insert(self._row_stack_cache, key,
+                                   (toks, pair), entry_bytes,
+                                   max_entries=64)
+        return pair
+
+    def flush_deltas(self, shards=None) -> int:
+        """Merge every pending delta of this field's fragments into
+        base state (the ``?nodelta=1`` escape and test barrier).
+        Returns the number of bit positions merged."""
+        merged = 0
+        for view in list(self.views.values()):
+            frags = (list(view.fragments.values()) if shards is None
+                     else [view.fragment(s) for s in shards])
+            for frag in frags:
+                if frag is not None:
+                    merged += frag.flush_delta()
+        return merged
 
     def _evict_and_insert(self, cache: dict, key, entry, entry_bytes: int,
                           max_entries: int) -> None:
@@ -584,8 +676,11 @@ class Field:
                 gens.append(0)
                 continue
             with frag._lock:
-                gens.append(_frag_gen(frag))
+                # _stacked merges any pending delta (bumping _gen), so
+                # the token must be read AFTER it or the cache entry is
+                # stamped with a pre-merge token that can never hit
                 ids, mat = frag._stacked()
+                gens.append(_frag_gen(frag))
             if len(ids):
                 parts.append((i, ids, mat))
         gens = tuple(gens)

@@ -81,6 +81,16 @@ class Fragment:
 
         self._rows: dict[int, np.ndarray] = {}
         self._gen = 0
+        # streaming-ingest delta plane (pilosa_tpu.ingest): pending
+        # set/clear overlays that batched imports and set/clear_bit
+        # land in WITHOUT bumping _gen, so device residency of the
+        # base stays warm under sustained writes.  _delta_seq is the
+        # monotone delta sequence the result-cache stamps carry: it
+        # bumps on every delta-landing write and is NEVER reset —
+        # compaction (flush_delta) merges the plane into _rows and
+        # bumps _gen instead.
+        self._delta = None  # ingest.deltaplane.DeltaPlane | None
+        self._delta_seq = 0
         # process-unique identity for cache keys: a fragment deleted
         # (resize cleanup) and later re-fetched is a NEW object whose
         # _gen can collide with a stale cached tuple — uid makes a
@@ -345,6 +355,13 @@ class Fragment:
             if self._wal is not None:
                 self._wal.close()
                 self._wal = None
+            # pending delta bits are WAL-durable (replayed into base on
+            # reopen); just drop the compactor registration
+            if self._delta is not None:
+                from pilosa_tpu.ingest import compactor
+
+                compactor.compactor().forget(self)
+                self._delta = None
             # release device residency accounting (drops the cache refs;
             # the jax buffers free once no computation holds them)
             mgr = residency.manager()
@@ -368,13 +385,19 @@ class Fragment:
                 if arr.shape != (self.n_words,):
                     raise ValueError(
                         f"row {rid}: shape {arr.shape} != ({self.n_words},)")
+            if self._delta is not None:
+                self._delta.check()
             if self._stack_cache is not None:
                 gen, ids, matrix = self._stack_cache
                 if gen == self._gen:
-                    # row_ids() (not len(_rows)): rows cleared to
-                    # all-zero stay in _rows but are excluded from the
-                    # stack, by design
-                    if len(ids) != len(self.row_ids()):
+                    # BASE row ids (not row_ids(), which overlays the
+                    # pending delta): the stack cache is stamped with
+                    # the base generation and holds base content; rows
+                    # cleared to all-zero stay in _rows but are
+                    # excluded from the stack, by design
+                    base_ids = [r for r, a in self._rows.items()
+                                if a.any()]
+                    if len(ids) != len(base_ids):
                         raise ValueError(
                             "stack cache row count diverged from rows")
                     if not np.all(ids[:-1] < ids[1:]):
@@ -403,6 +426,131 @@ class Fragment:
             from pilosa_tpu.runtime import snapqueue
 
             snapqueue.enqueue(self)
+
+    # ------------------------------------------------ streaming delta plane
+
+    #: BSI views (bsig_<field>) never take the delta path: their reads
+    #: go through plane stacks and per-plane arithmetic that would all
+    #: need fusing.  Literal mirrors models.view.VIEW_BSI_PREFIX
+    #: (importing view here would cycle).
+    _BSI_VIEW_PREFIX = "bsig_"
+
+    def _delta_eligible(self) -> bool:
+        """Whether writes land in the delta plane: [ingest] deltas on,
+        and this fragment's semantics are overlay-safe — mutex/bool
+        fragments mutate OTHER rows on set (cross-row read-modify-
+        write) and BSI views read whole plane stacks, so both stay on
+        the base path."""
+        from pilosa_tpu import ingest
+
+        return (ingest.config().delta_enabled and not self.mutex
+                and not self.view.startswith(self._BSI_VIEW_PREFIX))
+
+    def _delta_or_new(self):
+        d = self._delta
+        if d is None:
+            from pilosa_tpu.ingest.deltaplane import DeltaPlane
+
+            d = self._delta = DeltaPlane(self.n_words, self.width)
+        return d
+
+    def _delta_after_write_locked(self) -> None:
+        """Post-delta-write bookkeeping (caller holds the lock):
+        register with the compactor; past the process-wide pending
+        budget the WRITER merges its own fragment inline (bounded
+        memory — backpressure lands on the writer, never on readers)."""
+        from pilosa_tpu.ingest import compactor
+
+        if compactor.compactor().note_delta(self):
+            self._flush_delta_locked(inline=True)
+
+    def _delta_row_seq(self, row: int) -> int:
+        """Per-row delta token (0 = no pending overlay): the executor's
+        delta-stack caches key on (uid, row_seq) so writes to OTHER
+        rows never invalidate a cached delta stack."""
+        d = self._delta
+        return 0 if d is None else d.row_seq.get(row, 0)
+
+    def delta_stats(self) -> dict | None:
+        with self._lock:
+            d = self._delta
+            if d is None or d.empty():
+                return None
+            return d.stats()
+
+    def flush_delta(self) -> int:
+        """Merge the pending delta plane into the base rows (the
+        compaction step): bumps ``_gen`` (device residency of this
+        fragment refreshes once), leaves ``_delta_seq`` alone (the
+        effective content did not change, so result-cache entries
+        stamped (gen, seq) miss exactly once and refill from the
+        freshly-merged base).  No WAL append — the delta's records
+        were written at delta-write time and replay idempotently.
+        Returns the number of pending bit positions merged."""
+        with self._lock:
+            return self._flush_delta_locked()
+
+    def _flush_delta_locked(self, inline: bool = False) -> int:
+        d = self._delta
+        if d is None or d.empty():
+            self._delta = None
+            return 0
+        # sets first, clears second — matching _apply_bulk's order so a
+        # position present in both planes (impossible by the disjoint
+        # invariant, but belt-and-braces) resolves the same way
+        for row, words in d.sets.items():
+            arr = self._row_array(row, create=True)
+            np.bitwise_or(arr, words, out=arr)
+        for row, words in d.clears.items():
+            arr = self._rows.get(row)
+            if arr is not None:
+                np.bitwise_and(arr, ~words, out=arr)
+        bits = d.bits
+        self._delta = None
+        self._gen += 1
+        from pilosa_tpu.ingest import compactor
+
+        compactor.compactor().note_flushed(self, bits, inline=inline)
+        # flight-record annotation: a read-triggered merge inside a
+        # query shows up as compacted=true on its record
+        from pilosa_tpu import observe
+
+        rec = observe.current()
+        if rec is not None:
+            rec.compacted = True
+        return bits
+
+    def _bit_off_locked(self, row: int, off: int) -> bool:
+        """Effective bit (base ⊕ delta) at one offset; caller holds
+        the lock (or accepts the same torn-read semantics bit() always
+        had)."""
+        d = self._delta
+        if d is not None:
+            ov = d.override(row, off)
+            if ov is not None:
+                return ov
+        arr = self._rows.get(row)
+        if arr is None:
+            return False
+        return bool(arr[off // bm.WORD_BITS]
+                    & (np.uint32(1) << np.uint32(off % bm.WORD_BITS)))
+
+    def _delta_set_bit(self, row: int, off: int, clear: bool) -> bool:
+        """Single-bit write landing in the delta plane (caller holds
+        the lock).  WAL record + op count identical to the base path;
+        only the in-memory landing zone differs."""
+        cur = self._bit_off_locked(row, off)
+        if cur == (not clear):
+            return False  # no-op write: no WAL, no seq bump, caches warm
+        self._wal_append(_WAL_REC.pack(
+            _WAL_CLEAR if clear else _WAL_SET, row, off))
+        self._op_n += 1
+        self._delta_seq += 1
+        self._delta_or_new().add_bit(row, off, clear, self._delta_seq)
+        self._delta_after_write_locked()
+        self._maybe_snapshot()
+        self._paranoia_check()
+        return True
 
     # ------------------------------------------------------- host mutation
 
@@ -452,6 +600,12 @@ class Fragment:
         mutex/bool field (reference handleMutex, fragment.go:670,3096)."""
         with self._lock:
             off = self._offset(col)
+            if self._delta_eligible():
+                return self._delta_set_bit(row, off, clear=False)
+            # base path: pending delta merges FIRST so in-memory apply
+            # order matches WAL order (a delta write followed by a base
+            # write must not resurrect later)
+            self._flush_delta_locked()
             changed = False
             if self.mutex:
                 for other_id, arr in self._rows.items():
@@ -476,6 +630,9 @@ class Fragment:
     def clear_bit(self, row: int, col: int) -> bool:
         with self._lock:
             off = self._offset(col)
+            if self._delta_eligible():
+                return self._delta_set_bit(row, off, clear=True)
+            self._flush_delta_locked()
             if self._apply_clear(row, off):
                 self._wal_append(_WAL_REC.pack(_WAL_CLEAR, row, off))
                 self._op_n += 1
@@ -488,6 +645,10 @@ class Fragment:
     def clear_row(self, row: int) -> bool:
         """Remove all bits in a row (ClearRow support, fragment clearRow)."""
         with self._lock:
+            # whole-row base mutation: merge any pending delta first so
+            # the pop sees (and the WAL order preserves) the effective
+            # row — an unflushed delta would resurrect its bits later
+            self._flush_delta_locked()
             arr = self._rows.pop(row, None)
             if arr is None or not arr.any():
                 return False
@@ -505,6 +666,7 @@ class Fragment:
     def set_row(self, row: int, words: np.ndarray) -> bool:
         """Replace a row wholesale (Store() support, fragment setRow)."""
         with self._lock:
+            self._flush_delta_locked()  # base ordering (see clear_row)
             old = self._rows.get(row)
             new = np.asarray(words, dtype=np.uint32).copy()
             if old is None and not new.any():
@@ -539,7 +701,28 @@ class Fragment:
             sets = np.unique(np.asarray(set_pos, dtype=np.uint64))
             clears = np.unique(np.asarray(clear_pos, dtype=np.uint64))
             if len(sets) == 0 and len(clears) == 0:
+                # empty import: a strict no-op — no WAL record, no
+                # _gen/_delta_seq bump, no cache eviction (regression-
+                # pinned in tests/test_ingest.py)
                 return
+            if self._delta_eligible():
+                # streaming path: same WAL record, same op count; bits
+                # land in the delta plane so _gen (and the device-
+                # resident base) stays put until compaction
+                self._wal_append(
+                    _WAL_BULK_HDR.pack(_WAL_BULK, len(sets), len(clears))
+                    + sets.tobytes() + clears.tobytes()
+                )
+                self._op_n += len(sets) + len(clears)
+                self._delta_seq += 1
+                d = self._delta_or_new()
+                d.add_positions(sets, False, self._delta_seq)
+                d.add_positions(clears, True, self._delta_seq)
+                self._delta_after_write_locked()
+                self._maybe_snapshot()
+                self._paranoia_check()
+                return
+            self._flush_delta_locked()
             self._apply_bulk(sets.astype(np.int64), clears.astype(np.int64))
             self._wal_append(
                 _WAL_BULK_HDR.pack(_WAL_BULK, len(sets), len(clears))
@@ -563,6 +746,27 @@ class Fragment:
         free of bit-position expansion AND writes ~15x less WAL than
         8-byte-per-bit delta records at typical densities."""
         with self._lock:
+            if not data:
+                # empty payload: a strict no-op, not a decode error —
+                # bulk loaders ship empty view shells routinely
+                return
+            if self._delta_eligible():
+                pos = self._delta_roaring_positions(data)
+                if pos is not None:
+                    if len(pos) == 0:
+                        return  # empty-but-valid payload: no-op
+                    self._wal_append(
+                        _WAL_ROARING_HDR.pack(_WAL_ROARING, len(data),
+                                              1 if clear else 0) + data)
+                    self._op_n += len(pos)
+                    self._delta_seq += 1
+                    self._delta_or_new().add_positions(
+                        pos, clear, self._delta_seq)
+                    self._delta_after_write_locked()
+                    self._maybe_snapshot()
+                    self._paranoia_check()
+                    return
+            self._flush_delta_locked()
             changed = self._merge_roaring(data, clear)
             if changed:
                 self._wal_append(
@@ -581,6 +785,30 @@ class Fragment:
     _SPARSE_BITS_PER_CONTAINER = 512
     #: absolute positions-path ceiling (u64 positions materialized)
     _SPARSE_MAX_BITS = 1 << 25
+
+    #: roaring payloads above this many bits skip the delta plane and
+    #: merge dense into the base directly (a delta that large would be
+    #: flushed immediately anyway — routing it through the overlay
+    #: would just double the work)
+    _DELTA_MAX_ROARING_BITS = 1 << 22
+
+    def _delta_roaring_positions(self, data: bytes):
+        """Decode a roaring payload to absolute bit positions for the
+        delta plane, or None when the payload is too dense/large (or
+        malformed in a way the dense path owns reporting for)."""
+        from pilosa_tpu.storage import roaring as rcodec
+
+        stats = rcodec.payload_stats(data)
+        if stats is None:
+            return None
+        _n_cont, n_bits = stats
+        if n_bits > self._DELTA_MAX_ROARING_BITS:
+            return None
+        try:
+            return rcodec.decode_positions(
+                data, max_positions=2 * self._DELTA_MAX_ROARING_BITS)
+        except rcodec.RoaringError:
+            return None
 
     def _merge_roaring(self, data: bytes, clear: bool) -> int:
         """In-memory merge of a roaring payload; returns the number of
@@ -769,6 +997,7 @@ class Fragment:
         keys = []
         blocks = []
         with self._lock:
+            self._flush_delta_locked()  # export effective content
             for row in self.row_ids():
                 w64 = self._rows[row].view(np.uint64)
                 for b in range(cpr):
@@ -788,25 +1017,55 @@ class Fragment:
     # -------------------------------------------------------- host queries
 
     def bit(self, row: int, col: int) -> bool:
-        off = self._offset(col)
-        arr = self._rows.get(row)
-        if arr is None:
-            return False
-        return bool(arr[off // bm.WORD_BITS] & (np.uint32(1) << np.uint32(off % bm.WORD_BITS)))
+        return self._bit_off_locked(row, self._offset(col))
 
     def row(self, row: int) -> np.ndarray:
-        """Packed words for one row (copy)."""
+        """Packed EFFECTIVE words for one row (base ⊕ delta; copy).
+
+        Takes the lock (RLock — internal under-lock callers recurse
+        fine): the background compactor can move a delta-only row from
+        the plane into ``_rows`` at any moment, and an unlocked
+        base-then-delta read would see neither half — a transient
+        all-zeros answer for WAL-acknowledged bits."""
+        with self._lock:
+            arr, owned = self._row_words_effective_locked(row)
+            if arr is None:
+                return np.zeros(self.n_words, dtype=np.uint32)
+            return arr if owned else arr.copy()
+
+    def _row_words_effective_locked(self, row: int):
+        """(words-or-None, owned) for one effective row — caller holds
+        the lock.  ``owned`` says the array is a private overlay copy
+        (safe to keep); otherwise it aliases the live base row and must
+        be copied before the lock releases."""
         arr = self._rows.get(row)
-        if arr is None:
-            return np.zeros(self.n_words, dtype=np.uint32)
-        return arr.copy()
+        d = self._delta
+        if d is not None and d.row_touched(row):
+            out = (arr.copy() if arr is not None
+                   else np.zeros(self.n_words, dtype=np.uint32))
+            d.apply_row(row, out)
+            return out, True
+        return arr, False
 
     def row_ids(self) -> list[int]:
-        return sorted(r for r, a in self._rows.items() if a.any())
+        # Locked like row(): the background compactor mutates _rows /
+        # _delta, and unlocked iteration over _rows can raise
+        # "dictionary changed size during iteration" mid-flush.
+        with self._lock:
+            d = self._delta
+            if d is None or d.empty():
+                return sorted(r for r, a in self._rows.items() if a.any())
+            touched = set(d.touched_rows())
+            out = [r for r, a in self._rows.items()
+                   if r not in touched and a.any()]
+            out.extend(r for r in touched
+                       if d.row_any(r, self._rows.get(r)))
+            return sorted(out)
 
     def row_count(self, row: int) -> int:
-        arr = self._rows.get(row)
-        return 0 if arr is None else int(np.bitwise_count(arr).sum())
+        with self._lock:
+            arr, _ = self._row_words_effective_locked(row)
+            return 0 if arr is None else int(np.bitwise_count(arr).sum())
 
     # ----------------------------------------------- anti-entropy blocks
 
@@ -821,6 +1080,9 @@ class Fragment:
 
         out = []
         with self._lock:
+            # replica reconciliation hashes base rows: merge the
+            # pending overlay so checksums reflect effective content
+            self._flush_delta_locked()
             by_block: dict[int, list[int]] = {}
             for r in self.row_ids():
                 by_block.setdefault(r // HASH_BLOCK_SIZE, []).append(r)
@@ -838,6 +1100,7 @@ class Fragment:
         rows_out: list[int] = []
         cols_out: list[int] = []
         with self._lock:
+            self._flush_delta_locked()  # same contract as blocks()
             lo, hi = block * HASH_BLOCK_SIZE, (block + 1) * HASH_BLOCK_SIZE
             for r in self.row_ids():
                 if r < lo or r >= hi:
@@ -854,6 +1117,11 @@ class Fragment:
         current generation and sufficient to answer TopN(n) exactly
         (n=0 demands a complete cache); else None."""
         with self._lock:
+            if self._delta is not None and not self._delta.empty():
+                # cached counts describe base content; the pending
+                # overlay makes them stale — the caller's scan path
+                # (device_matrix/_stacked) merges and recounts
+                return None
             counts = self.topn_cache.get(self._gen)
             if counts is None or not self.topn_cache.exact_for(n):
                 return None
@@ -868,6 +1136,7 @@ class Fragment:
         if self.topn_cache.cache_type == CACHE_TYPE_NONE:
             return  # put() would discard the counts unread
         with self._lock:
+            self._flush_delta_locked()  # counts must cover the overlay
             counts = {}
             for r, arr in self._rows.items():
                 c = int(np.bitwise_count(arr).sum(dtype=np.uint64))
@@ -901,8 +1170,14 @@ class Fragment:
     # ------------------------------------------------------ device tensors
 
     def _stacked(self) -> tuple[np.ndarray, np.ndarray]:
-        """(row_ids int64[R], matrix uint32[R, words]) — cached per generation."""
+        """(row_ids int64[R], matrix uint32[R, words]) — cached per
+        generation.  Merges any pending delta first: every whole-matrix
+        consumer (snapshot, device_matrix, TopN scans, Rows column
+        filters, resize export) then sees effective content at a single
+        coherent generation — the delta plane only stays pending on the
+        fused row paths that know how to fuse it."""
         with self._lock:
+            self._flush_delta_locked()
             if self._stack_cache is not None and self._stack_cache[0] == self._gen:
                 return self._stack_cache[1], self._stack_cache[2]
             ids = np.array(self.row_ids(), dtype=np.int64)

@@ -121,6 +121,24 @@ class View:
         frag = self.fragment(col // SHARD_WIDTH)
         return (0, False) if frag is None else frag.value(col, depth)
 
+    # -- streaming ingest (pilosa_tpu.ingest) -------------------------------
+
+    def flush_deltas(self) -> int:
+        """Merge every fragment's pending delta plane into base state;
+        returns bit positions merged (0 when nothing pended)."""
+        return sum(frag.flush_delta()
+                   for frag in list(self.fragments.values()))
+
+    def delta_stats(self) -> dict:
+        """Pending-delta audit for this view: per-shard delta stats
+        (the /debug/ingest per-fragment section aggregates these)."""
+        out = {}
+        for shard, frag in list(self.fragments.items()):
+            s = frag.delta_stats()
+            if s is not None:
+                out[shard] = s
+        return out
+
     def close(self) -> None:
         for frag in self.fragments.values():
             frag.close()

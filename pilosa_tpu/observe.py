@@ -133,6 +133,7 @@ class QueryRecord:
         "elapsed_ns", "shards_n", "stages", "shard_ns", "node_ns",
         "launches", "path", "coalesce", "result_sizes", "error", "slow",
         "admission", "outcome", "compiles", "cached", "cache_key",
+        "delta_notes", "compacted",
     )
 
     def __init__(self, qid: int, index: str, pql: str,
@@ -172,6 +173,18 @@ class QueryRecord:
         # /debug/queries correlates repeated shapes either way
         self.cached = False
         self.cache_key: str | None = None
+        # streaming-ingest annotations (pilosa_tpu.ingest): rendered
+        # ``deltaDepth`` counts the fused leaves this query evaluated
+        # WITH a pending delta overlay (``dfuse`` nodes staged — how
+        # much un-compacted write traffic the read absorbed); a list
+        # because leaves stage on concurrent map workers and appends
+        # are GIL-atomic (the launches discipline).  ``compacted``
+        # marks that a merge of a pending delta ran inside this query
+        # (a ?nodelta=1 escape, a whole-matrix path, or an export) —
+        # "slow because it compacted", symmetric with ``compiled``;
+        # a single idempotent True store, race-free
+        self.delta_notes: list[int] = []
+        self.compacted = False
 
     # ------------------------------------------------------------ notes
 
@@ -191,6 +204,12 @@ class QueryRecord:
         the "slow because it compiled" attribution."""
         if len(self.compiles) < 256:
             self.compiles.append((kernel, ns))
+
+    def note_delta(self, n: int = 1) -> None:
+        """``n`` fused leaves staged with a pending delta overlay
+        (Executor._fused_row_leaf) — list append, GIL-atomic."""
+        if len(self.delta_notes) < MAX_SHARD_TIMINGS:
+            self.delta_notes.append(n)
 
     def note_shard(self, shard: int, ns: int) -> None:
         if len(self.shard_ns) < MAX_SHARD_TIMINGS:
@@ -250,6 +269,12 @@ class QueryRecord:
         }
         if self.cache_key is not None:
             d["cacheKey"] = self.cache_key
+        # streaming-ingest annotations: present only when the query
+        # actually met a delta (the common no-ingest record stays small)
+        if self.delta_notes:
+            d["deltaDepth"] = sum(self.delta_notes)
+        if self.compacted:
+            d["compacted"] = True
         if self.admission is not None:
             d["admission"] = {
                 "class": self.admission.get("class"),

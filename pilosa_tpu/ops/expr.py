@@ -17,6 +17,13 @@ operand tuple, so distinct row ids share one compiled program:
     ("and"|"or"|"xor"|"andnot", c, ...)   left-fold over children
     ("not", ("leaf", i_exist), child)     exist & ~child
     ("shift", n, child)                   static shift by n words/bits
+    ("dfuse", child, set_c, clear_c)      (child & ~clear) | set
+
+``dfuse`` is the streaming-ingest delta fusion (pilosa_tpu.ingest): the
+child is a base row stack resident since its last compaction, the
+set/clear leaves are the fragment delta planes — the whole overlay
+evaluates inside the same single launch, so sustained writes never
+force the base stack off the device.
 
 ``evaluate(shape, leaves)`` returns the uint32 bitmap stack;
 ``evaluate(shape, leaves, counts=True)`` returns int32 per-row popcounts
@@ -61,6 +68,12 @@ def _validate(shape, n_leaves: int) -> None:
         _validate(shape[1], n_leaves)
         _validate(shape[2], n_leaves)
         return
+    if kind == "dfuse":
+        if len(shape) != 4:
+            raise ValueError("dfuse needs (child, set, clear)")
+        for c in shape[1:]:
+            _validate(c, n_leaves)
+        return
     if kind == "shift":
         if shape[1] < 0:
             raise ValueError("shift distance must be non-negative")
@@ -101,6 +114,14 @@ def _build_jnp(shape):
         kid = _build_jnp(shape[2])
         return lambda leaves: jnp.bitwise_and(
             exist(leaves), jnp.bitwise_not(kid(leaves)))
+    if kind == "dfuse":
+        kid = _build_jnp(shape[1])
+        dset = _build_jnp(shape[2])
+        dclear = _build_jnp(shape[3])
+        return lambda leaves: jnp.bitwise_or(
+            jnp.bitwise_and(kid(leaves),
+                            jnp.bitwise_not(dclear(leaves))),
+            dset(leaves))
     # shift: the ONE shared body (bm.shift_words), traced into the
     # fused program with static n — cannot drift from the unfused path
     n = shape[1]
@@ -263,6 +284,11 @@ def _host_tree(shape, leaves) -> np.ndarray:
     if kind == "not":
         return np.bitwise_and(_host_tree(shape[1], leaves),
                               np.bitwise_not(_host_tree(shape[2], leaves)))
+    if kind == "dfuse":
+        return np.bitwise_or(
+            np.bitwise_and(_host_tree(shape[1], leaves),
+                           np.bitwise_not(_host_tree(shape[3], leaves))),
+            _host_tree(shape[2], leaves))
     # shift — the shared body, numpy namespace
     return bm.shift_words(np, _host_tree(shape[2], leaves), shape[1])
 

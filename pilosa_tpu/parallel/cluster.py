@@ -226,12 +226,14 @@ class Transport:
     http/client.go:37)."""
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
-                   nocache: bool = False):
+                   nocache: bool = False, nodelta: bool = False):
         """Execute pql on the remote node restricted to `shards` with
         remote semantics (no re-translation).  Returns the result list.
         Raises TransportError if the node is unreachable.  ``nocache``
         forwards the origin request's ?nocache=1 so an opted-out query
-        forces a real execution on every node, not just the origin."""
+        forces a real execution on every node, not just the origin;
+        ``nodelta`` forwards ?nodelta=1 the same way (peers compact
+        their pending ingest deltas and answer from pure base)."""
         raise NotImplementedError
 
     def send_message(self, node: Node, message: dict) -> dict:
@@ -296,7 +298,7 @@ class LocalTransport(Transport):
             raise TransportError(f"partitioned: {src} <-/-> {dst}")
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
-                   nocache: bool = False):
+                   nocache: bool = False, nodelta: bool = False):
         from pilosa_tpu.parallel.executor import ExecOptions
 
         if node.id in self.down or node.id not in self.handles:
@@ -307,7 +309,7 @@ class LocalTransport(Transport):
             index, pql,
             opt=ExecOptions(
                 remote=True, shards=None if shards is None else list(shards),
-                cache=not nocache,
+                cache=not nocache, delta=not nodelta,
             ),
         )
 
@@ -335,13 +337,18 @@ class BoundTransport(Transport):
         return getattr(self.parent, name)
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
-                   nocache: bool = False):
+                   nocache: bool = False, nodelta: bool = False):
         self.parent._check_partition(self.src, node.id)
+        extra = {}
         if nocache:
+            extra["nocache"] = True
+        if nodelta:
+            extra["nodelta"] = True
+        if extra:
             return self.parent.query_node(node, index, pql, shards,
-                                          nocache=True)
-        # cache-enabled calls keep the original 4-arg shape so tests
-        # that monkeypatch parent.query_node stay compatible
+                                          **extra)
+        # default calls keep the original 4-arg shape so tests that
+        # monkeypatch parent.query_node stay compatible
         return self.parent.query_node(node, index, pql, shards)
 
     def send_message(self, node: Node, message: dict) -> dict:

@@ -114,7 +114,8 @@ class Coalescer:
         return dl is None or dl.remaining() > 2 * self.window_s
 
     def count(self, executor, idx, child, shards: tuple[int, ...],
-              deadline=None, cache_fill=None) -> int:
+              deadline=None, cache_fill=None,
+              use_delta: bool = True) -> int:
         """One Count(tree) query through the batching window -> total.
         Staging runs on the CALLER's thread (fragment locks, and a
         staging error belongs to this query alone).
@@ -126,8 +127,17 @@ class Coalescer:
         total under its own key, stamped with the generations captured
         before its leaves were staged.  Entries dropped from the batch
         (deadline death, flush failure) raise out of ``fut.result()``
-        and never fill."""
-        shape, leaves = executor._fused_expr(idx, child, shards)
+        and never fill.
+
+        ``use_delta=False`` is the ?nodelta=1 escape, forwarded to
+        staging.  The bucket key stays delta-aware for free: a pending
+        ingest delta puts ``dfuse`` nodes in the canonical SHAPE, so a
+        delta-carrying query can only batch with queries fusing the
+        same overlay structure — and a ?nodelta=1 query (which compacts
+        up front and stages plain leaves) with a delta-reading one only
+        when no delta is pending, where the programs are identical."""
+        shape, leaves = executor._fused_expr(idx, child, shards,
+                                             use_delta=use_delta)
         key = (idx.name, shape, shards)
         fut: Future = Future()
         t0 = time.perf_counter_ns()
@@ -227,6 +237,20 @@ class Coalescer:
                     stacked = tuple(
                         _stack([it[0][j] for it in live])
                         for j in range(len(live[0][0])))
+                    # device batches pad to the next power of two: the
+                    # jitted program re-lowers per INPUT shape, so
+                    # free-running occupancies (2, 3, 5, ...) each pay
+                    # a fresh XLA compile in the serving path — under
+                    # sustained ingest the misses arrive at arbitrary
+                    # batch sizes and the compiles convoy every other
+                    # query in the process.  Bucketing holds the
+                    # variant count at log2(max_batch); the zero pad
+                    # rows count to zero and are never scattered back.
+                    # Host stacks skip it (the host engine never jits).
+                    pad = _pow2(n) - n
+                    if pad and not isinstance(stacked[0], np.ndarray):
+                        stacked = tuple(_pad_batch(s, pad)
+                                        for s in stacked)
                     counts = np.asarray(
                         expr.evaluate(shape, stacked, counts=True),
                         dtype=np.int64)
@@ -251,3 +275,18 @@ def _stack(arrs: list):
     import jax.numpy as jnp
 
     return jnp.stack(arrs)
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _pad_batch(stack, pad: int):
+    """Append ``pad`` zero rows along the batch dim (device stacks)."""
+    import jax.numpy as jnp
+
+    return jnp.concatenate(
+        [stack, jnp.zeros((pad,) + stack.shape[1:], stack.dtype)])

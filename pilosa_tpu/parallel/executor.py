@@ -82,6 +82,12 @@ class ExecOptions:
     # per-request opt-out of the generation-stamped result cache (the
     # HTTP layer's ?nocache=1 — symmetric with ?nocoalesce)
     cache: bool = True
+    # per-request opt-out of streaming-ingest delta fusion (the HTTP
+    # layer's ?nodelta=1 — symmetric with ?nocoalesce/?nocache): the
+    # touched fragments' pending deltas are compacted up front and the
+    # query runs against pure base state (a debugging escape; results
+    # are bit-exact either way)
+    delta: bool = True
     # end-to-end deadline (serve/deadline.Deadline), propagated from
     # the X-Pilosa-Deadline header; checked at translate, before each
     # per-shard map, and before reduce so expired work never reaches
@@ -404,14 +410,20 @@ class Executor:
             # goroutines)
             for node_id in [k for k in list(pending) if k != cluster.local_id]:
                 node_shards = pending.pop(node_id)
+                extra = {}
                 if opt is not None and not opt.cache:
                     # forward the origin's ?nocache=1: peers must do a
                     # real execution too, not answer from their
                     # per-shard result caches
+                    extra["nocache"] = True
+                if opt is not None and not opt.delta:
+                    # forward ?nodelta=1: peers compact their own
+                    # pending deltas and run against pure base too
+                    extra["nodelta"] = True
+                if extra:
                     fut = self._submit_io(
-                        lambda n, i, p, s:
-                        cluster.transport.query_node(n, i, p, s,
-                                                     nocache=True),
+                        lambda n, i, p, s, _e=extra:
+                        cluster.transport.query_node(n, i, p, s, **_e),
                         cluster.node(node_id), idx.name, pql,
                         node_shards,
                     )
@@ -583,20 +595,50 @@ class Executor:
                 else list(views_by_time_range(VIEW_STANDARD, start, end,
                                               f.time_quantum)))
 
-    def _fused_expr(self, idx, call: Call, shards: tuple[int, ...]):
+    def _fused_expr(self, idx, call: Call, shards: tuple[int, ...],
+                    use_delta: bool = True):
         """Stage a supported tree for ONE-launch evaluation: returns
         ``(shape, leaves)`` where ``shape`` is the canonical structure
         key (row ids and values erased into leaf slots — distinct rows
         share a compiled program) and ``leaves`` the operand stacks, for
         ops.expr.  Leaf staging is the cached stack builders
         (device_row_stack & friends); no compute dispatches here beyond
-        what BSI range leaves inherently cost."""
+        what BSI range leaves inherently cost.
+
+        ``use_delta=False`` is the ?nodelta=1 escape: pending delta
+        planes on the touched fragments are compacted up front and
+        every leaf stays a plain base leaf."""
         leaves: list = []
-        shape = self._fused_shape(idx, call, shards, leaves)
+        shape = self._fused_shape(idx, call, shards, leaves, use_delta)
         return shape, tuple(leaves)
 
+    def _fused_row_leaf(self, f, row_id, shards: tuple[int, ...],
+                        leaves: list, use_delta: bool):
+        """One standard-view row leaf, delta-aware: the base stack is
+        resident under its base token (delta writes don't evict it);
+        when a pending delta touches this row in any fragment, the
+        overlay stacks join as ``dfuse`` operands — staged BEFORE the
+        base stack, so a compaction racing the two reads can only
+        double-apply the (idempotent) overlay, never drop it."""
+        if not use_delta:
+            f.flush_deltas(shards)
+            ds = None
+        else:
+            ds = f.device_delta_stacks(row_id, shards)
+        leaves.append(f.device_row_stack(row_id, shards))
+        shape = ("leaf", len(leaves) - 1)
+        if ds is not None:
+            leaves.append(ds[0])
+            si = len(leaves) - 1
+            leaves.append(ds[1])
+            shape = ("dfuse", shape, ("leaf", si), ("leaf", len(leaves) - 1))
+            rec = _observe.current()
+            if rec is not None:
+                rec.note_delta(1)
+        return shape
+
     def _fused_shape(self, idx, call: Call, shards: tuple[int, ...],
-                     leaves: list):
+                     leaves: list, use_delta: bool = True):
         name = call.name
         if name == "Row":
             cond = call.condition_arg()
@@ -612,35 +654,41 @@ class Executor:
             if "from" in call.args or "to" in call.args:
                 # time-range Row: ONE cached stack holding the
                 # host-side union over the covering views (f.row_time's
-                # union, batched across shards)
+                # union, batched across shards).  Delta overlays apply
+                # inside the builder (effective reads; token carries
+                # the delta seq) — no dfuse leaves needed.
                 views = self._time_range_views(f, call) or []
                 leaves.append(f.device_time_row_stack(
                     call.args[fname], shards, tuple(views)))
                 return ("leaf", len(leaves) - 1)
             # arg is a plain int row id (bool literals were excluded by
             # _fused_supported)
-            leaves.append(f.device_row_stack(call.args[fname], shards))
-            return ("leaf", len(leaves) - 1)
+            return self._fused_row_leaf(f, call.args[fname], shards,
+                                        leaves, use_delta)
         if name in ("Union", "Intersect", "Difference", "Xor"):
             op = {"Union": "or", "Intersect": "and",
                   "Difference": "andnot", "Xor": "xor"}[name]
-            return (op, *(self._fused_shape(idx, c, shards, leaves)
+            return (op, *(self._fused_shape(idx, c, shards, leaves,
+                                            use_delta)
                           for c in call.children))
         if name == "Not":
-            leaves.append(idx.existence_field().device_row_stack(0, shards))
-            exist = ("leaf", len(leaves) - 1)
+            exist = self._fused_row_leaf(idx.existence_field(), 0,
+                                         shards, leaves, use_delta)
             return ("not", exist,
-                    self._fused_shape(idx, call.children[0], shards, leaves))
+                    self._fused_shape(idx, call.children[0], shards,
+                                      leaves, use_delta))
         if name == "Shift":
             n = call.int_arg("n")
             # per-shard semantics batch directly: bits shift within
             # each shard's row and drop at the shard edge, exactly as
             # the per-shard path does (executor.go:1730)
             return ("shift", 1 if n is None else n,
-                    self._fused_shape(idx, call.children[0], shards, leaves))
+                    self._fused_shape(idx, call.children[0], shards,
+                                      leaves, use_delta))
         raise ExecutionError(f"unsupported fused call: {name}")
 
-    def _fused_eval(self, idx, call: Call, shards: tuple[int, ...]):
+    def _fused_eval(self, idx, call: Call, shards: tuple[int, ...],
+                    use_delta: bool = True):
         """Evaluate a supported tree -> uint32 [n_shards, words] device
         stack, as ONE compiled program over the leaf stacks (ops.expr) —
         tree depth no longer multiplies the launch count, the dominant
@@ -648,7 +696,7 @@ class Executor:
         boundary; the 20 us dispatch floor of VERDICT round 5)."""
         from pilosa_tpu.ops import expr
 
-        shape, leaves = self._fused_expr(idx, call, shards)
+        shape, leaves = self._fused_expr(idx, call, shards, use_delta)
         return expr.evaluate(shape, leaves)
 
     # ------------------------------------------- result cache (read paths)
@@ -656,23 +704,29 @@ class Executor:
     def _rc_collect_gens(self, f, view_name: str,
                          shards: tuple[int, ...], out: dict) -> None:
         """Record the invalidation stamp for one (field, view) pair
-        over the shard set: the aggregate ``(count, sum_gen, sum_uid,
-        max_uid)`` of the participating fragments' generation tokens.
+        over the shard set: the aggregate ``(count, sum_gen, sum_seq,
+        sum_uid, max_uid)`` of the participating fragments' generation
+        tokens — ``(base_gen, delta_seq)`` per fragment, the streaming-
+        ingest extension (pilosa_tpu.ingest).
 
         The aggregate is change-DETECTING, not just change-likely,
-        because of two monotonicity invariants: a surviving fragment's
-        ``_gen`` only ever increases (every mutation path bumps it —
-        audited in tests/test_resultcache.py), and ``_uid`` comes from
-        a process-global increasing counter, so a newly created
+        because of monotonicity invariants: a surviving fragment's
+        ``_gen`` only ever increases (every base mutation and every
+        compaction bumps it), ``_delta_seq`` only ever increases (every
+        delta-landing write bumps it; compaction leaves it alone — so
+        an entry filled against base ⊕ delta stays valid until *its*
+        fragment's delta actually changes, and a compaction costs one
+        conservative miss, not an eviction storm), and ``_uid`` comes
+        from a process-global increasing counter, so a newly created
         fragment's uid exceeds every uid that ever existed.  Case
         analysis between fill and probe: any fragment CREATION (incl.
         a resize/restore replacement) raises ``max_uid`` past the old
         all-time high; any DELETION without a creation changes
         ``count``; any MUTATION of a surviving fragment raises
-        ``sum_gen`` (which nothing can lower — gen "resets" only occur
-        via replacement, caught by ``max_uid``).  So every state
-        change flips at least one component, while an unchanged view
-        reproduces the stamp exactly.
+        ``sum_gen`` or ``sum_seq`` (which nothing can lower — resets
+        only occur via replacement, caught by ``max_uid``).  So every
+        state change flips at least one component, while an unchanged
+        view reproduces the stamp exactly.
 
         Memoized per (field, view): ``Intersect(Row(f=a), Row(f=b))``
         touches the same view twice but needs one stamp.  The single
@@ -699,14 +753,15 @@ class Executor:
         if fs is None:
             g = frags.get
             fs = [fr for s in shards if (fr := g(s)) is not None]
-        sg = su = mu = 0
+        sg = sq = su = mu = 0
         for fr in fs:
             u = fr._uid
             sg += fr._gen
+            sq += fr._delta_seq
             su += u
             if u > mu:
                 mu = u
-        out[mkey] = (len(fs), sg, su, mu)
+        out[mkey] = (len(fs), sg, sq, su, mu)
 
     def _rc_sig(self, idx, call: Call, shards: tuple[int, ...],
                 gens_out: list):
@@ -771,15 +826,29 @@ class Executor:
         (field, view_name) pairs whose fragments participate beyond
         the tree leaves (e.g. the scanned TopN matrix).  Stamps the
         key digest onto the active flight record so every record
-        carries its cacheKey, hit or miss."""
+        carries its cacheKey, hit or miss.
+
+        ``?nodelta=1`` bypasses the probe too: its contract is an
+        up-front compaction and a REAL pure-base read — a cached value
+        (bit-identical, but filled through the delta path) would
+        short-circuit the escape into a no-op whenever the stamp
+        hasn't moved."""
         rc = resultcache.cache()
-        if not rc.enabled or (opt is not None and not opt.cache):
+        if not rc.enabled or (opt is not None
+                              and not (opt.cache and opt.delta)):
             return None
         gens_out: dict = {}
         try:
             sig = (None if tree is None
                    else self._rc_sig(idx, tree, shards, gens_out))
             for f, vn in gen_fields:
+                # gen_fields means a whole-matrix read (TopN refresh,
+                # GroupBy Rows scan), and those merge pending deltas
+                # during the read — merge BEFORE stamping instead, or
+                # the fill carries pre-merge generations our own flush
+                # just invalidated (dead on arrival: the next identical
+                # query would re-execute instead of hitting)
+                f.flush_deltas(shards)
                 self._rc_collect_gens(f, vn, shards, gens_out)
         except (ExecutionError, ValueError, KeyError, TypeError,
                 AttributeError):
@@ -800,6 +869,18 @@ class Executor:
             rec.cached = True
             rec.note_path("cached")
 
+    @staticmethod
+    def _rc_wait(opt) -> float:
+        """Single-flight wait budget for a cache probe: never park a
+        query on another reader's in-progress fill beyond its own
+        deadline (the deadline checks run after the probe returns, so
+        an uncapped wait could hold an admission slot 10x past a
+        short budget just to report expiry)."""
+        dl = None if opt is None else getattr(opt, "deadline", None)
+        if dl is None:
+            return resultcache.FLIGHT_WAIT_S
+        return max(0.0, min(resultcache.FLIGHT_WAIT_S, dl.remaining()))
+
     def _execute_bitmap_call(self, idx, call: Call, shards, opt: ExecOptions) -> Row:
         self._validate_call_fields(idx, call)
         shards = self._target_shards(idx, shards, opt)
@@ -814,7 +895,7 @@ class Executor:
             probe = self._rc_probe(idx, "row", g, opt, tree=call)
             if probe is not None:
                 rc, key, gens = probe
-                hit, val = rc.get(key, gens)
+                hit, val = rc.get(key, gens, self._rc_wait(opt))
                 if hit:
                     self._rc_mark_hit()
                     # copies both ways (fill and hit): cached words
@@ -822,7 +903,8 @@ class Executor:
                     return [(s, w.copy()) for s, w in val]
             # copies: a view would pin the whole stack in memory for as
             # long as one sparse segment lives
-            stack = np.asarray(self._fused_eval(idx, call, g))
+            stack = np.asarray(self._fused_eval(idx, call, g,
+                                                use_delta=opt.delta))
             partials = [(s, stack[i].copy())
                         for i, s in enumerate(group) if stack[i].any()]
             if probe is not None:
@@ -842,7 +924,8 @@ class Executor:
                 rec.note_stage("map.fused", _time.perf_counter_ns() - t_f)
         else:
             def map_fn(shard):
-                return shard, self._bitmap_words_shard(idx, call, shard)
+                return shard, self._bitmap_words_shard(idx, call, shard,
+                                                        opt.delta)
 
             partials = self._map_shards(
                 map_fn, shards, idx=idx, call=call, opt=opt,
@@ -867,19 +950,25 @@ class Executor:
                 pass
         return row
 
-    def _bitmap_words_shard(self, idx, call: Call, shard: int):
+    def _bitmap_words_shard(self, idx, call: Call, shard: int,
+                            use_delta: bool = True):
         """Evaluate a bitmap call tree for one shard.  Returns packed words
         (device or numpy) or None for empty (reference
-        executeBitmapCallShard, executor.go:651)."""
+        executeBitmapCallShard, executor.go:651).
+
+        ``use_delta`` threads the ?nodelta=1 escape down the per-shard
+        recursion (the remote map path and sub-fusion-width shard
+        sets): True reads base ⊕ delta through the host overlay, False
+        compacts up front and reads pure base."""
         name = call.name
         if name == _EMPTY_CALL:
             return None
         if name == "Row" or name == "Range":
-            return self._row_words_shard(idx, call, shard)
+            return self._row_words_shard(idx, call, shard, use_delta)
         if name == "Union":
             out = None
             for child in call.children:
-                w = self._bitmap_words_shard(idx, child, shard)
+                w = self._bitmap_words_shard(idx, child, shard, use_delta)
                 if w is None:
                     continue
                 out = w if out is None else bm.b_or(out, w)
@@ -887,11 +976,12 @@ class Executor:
         if name == "Intersect":
             if not call.children:
                 raise ExecutionError("Intersect() requires at least one row query")
-            out = self._bitmap_words_shard(idx, call.children[0], shard)
+            out = self._bitmap_words_shard(idx, call.children[0], shard,
+                                           use_delta)
             for child in call.children[1:]:
                 if out is None:
                     return None
-                w = self._bitmap_words_shard(idx, child, shard)
+                w = self._bitmap_words_shard(idx, child, shard, use_delta)
                 if w is None:
                     return None
                 out = bm.b_and(out, w)
@@ -899,18 +989,19 @@ class Executor:
         if name == "Difference":
             if not call.children:
                 raise ExecutionError("Difference() requires at least one row query")
-            out = self._bitmap_words_shard(idx, call.children[0], shard)
+            out = self._bitmap_words_shard(idx, call.children[0], shard,
+                                           use_delta)
             for child in call.children[1:]:
                 if out is None:
                     return None
-                w = self._bitmap_words_shard(idx, child, shard)
+                w = self._bitmap_words_shard(idx, child, shard, use_delta)
                 if w is not None:
                     out = bm.b_andnot(out, w)
             return out
         if name == "Xor":
             out = None
             for child in call.children:
-                w = self._bitmap_words_shard(idx, child, shard)
+                w = self._bitmap_words_shard(idx, child, shard, use_delta)
                 if w is None:
                     continue
                 out = w if out is None else bm.b_xor(out, w)
@@ -923,10 +1014,11 @@ class Executor:
                 raise ExecutionError(
                     "Not() queries require the index to have 'trackExistence' enabled"
                 )
-            exist = self._field_row_words(ef, 0, shard)
+            exist = self._field_row_words(ef, 0, shard, use_delta)
             if exist is None:
                 return None
-            child = self._bitmap_words_shard(idx, call.children[0], shard)
+            child = self._bitmap_words_shard(idx, call.children[0], shard,
+                                             use_delta)
             if child is None:
                 return exist
             return bm.b_not(child, exist)
@@ -935,7 +1027,8 @@ class Executor:
                 raise ExecutionError("Shift() requires a single row query")
             n = call.int_arg("n")
             n = 1 if n is None else n
-            child = self._bitmap_words_shard(idx, call.children[0], shard)
+            child = self._bitmap_words_shard(idx, call.children[0], shard,
+                                             use_delta)
             if child is None:
                 return None
             return bm.b_shift(child, n)
@@ -943,16 +1036,38 @@ class Executor:
             raise ExecutionError("Distinct() is not supported")
         raise ExecutionError(f"unknown call: {name}")
 
-    def _field_row_words(self, f, row_id: int, shard: int):
+    def _field_row_words(self, f, row_id: int, shard: int,
+                         use_delta: bool = True):
         view = f.view(VIEW_STANDARD)
         if view is None:
             return None
         frag = view.fragment(shard)
         if frag is None:
             return None
+        d = frag._delta
+        if d is not None and not d.empty() and use_delta:
+            # pending streaming delta: answer from the effective host
+            # words rather than device_row, whose matrix restack would
+            # MERGE the plane — per-shard reads must not compact, or
+            # sustained ingest turns every read into a generation bump
+            # (exactly the churn the delta plane exists to absorb).
+            # The resident base matrix stays untouched either way.
+            with frag._lock:
+                arr, owned = frag._row_words_effective_locked(row_id)
+                if arr is None:
+                    return None
+                words = arr if owned else arr.copy()
+            if d.row_touched(row_id):
+                rec = _observe.current()
+                if rec is not None:
+                    rec.note_delta(1)
+            return words
+        # no pending delta — or ?nodelta=1, where device_row's stack
+        # merge IS the requested up-front compaction (pure base read)
         return frag.device_row(row_id)
 
-    def _row_words_shard(self, idx, call: Call, shard: int):
+    def _row_words_shard(self, idx, call: Call, shard: int,
+                         use_delta: bool = True):
         """Row() in its three forms: standard, time-range, BSI condition
         (reference executeRowShard, executor.go:1441)."""
         cond = call.condition_arg()
@@ -979,7 +1094,7 @@ class Executor:
         from_arg = call.args.get("from")
         to_arg = call.args.get("to")
         if from_arg is None and to_arg is None:
-            return self._field_row_words(f, row_id, shard)
+            return self._field_row_words(f, row_id, shard, use_delta)
 
         if not f.time_quantum:
             raise ExecutionError(f"field {fname!r} does not support time-range queries")
@@ -1022,7 +1137,8 @@ class Executor:
             # could wrap past 2^31 set bits
             from pilosa_tpu.ops import expr
 
-            shape, leaves = self._fused_expr(idx, child, tuple(group))
+            shape, leaves = self._fused_expr(idx, child, tuple(group),
+                                             use_delta=opt.delta)
             counts = expr.evaluate(shape, leaves, counts=True)
             return [int(c) for c in
                     np.asarray(counts, dtype=np.int64)[:len(group)]]
@@ -1038,7 +1154,7 @@ class Executor:
                                    tree=child)
             if probe is not None:
                 rc, key, gens = probe
-                hit, val = rc.get(key, gens)
+                hit, val = rc.get(key, gens, self._rc_wait(opt))
                 if hit:
                     self._rc_mark_hit()
                     return list(val)
@@ -1058,7 +1174,7 @@ class Executor:
                                    tree=child)
             if probe is not None:
                 rc, ckey, cgens = probe
-                hit, val = rc.get(ckey, cgens)
+                hit, val = rc.get(ckey, cgens, self._rc_wait(opt))
                 if hit:
                     self._rc_mark_hit()
                     return val
@@ -1072,7 +1188,8 @@ class Executor:
                 return self.coalescer.count(self, idx, child,
                                             tuple(shards),
                                             deadline=opt.deadline,
-                                            cache_fill=probe)
+                                            cache_fill=probe,
+                                            use_delta=opt.delta)
             t_f = _time.perf_counter_ns()
             total = sum(compute_counts(shards))
             if rec is not None:
@@ -1082,7 +1199,8 @@ class Executor:
             return total
 
         def map_fn(shard):
-            words = self._bitmap_words_shard(idx, child, shard)
+            words = self._bitmap_words_shard(idx, child, shard,
+                                             opt.delta)
             if words is None:
                 return 0
             return int(bm.popcount(words))
@@ -1138,7 +1256,8 @@ class Executor:
             if len(row_ids) == 0:
                 return {}
             if filter_call is not None:
-                fw = self._bitmap_words_shard(idx, filter_call, shard)
+                fw = self._bitmap_words_shard(idx, filter_call, shard,
+                                              opt.delta)
                 if fw is None:
                     return {}
                 # Pallas single-pass kernel on TPU for large matrices,
@@ -1258,19 +1377,20 @@ class Executor:
                                gen_fields=((f, VIEW_STANDARD),))
         if probe is not None:
             rc, key, gens = probe
-            hit, val = rc.get(key, gens)
+            hit, val = rc.get(key, gens, self._rc_wait(opt))
             if hit:
                 self._rc_mark_hit()
                 return dict(val)
         totals = self._fused_topn_counts_uncached(idx, f, filter_call,
-                                                  shards)
+                                                  shards, opt=opt)
         if probe is not None:
             rc.put(key, gens, dict(totals),
                    resultcache.result_nbytes(totals))
         return totals
 
     def _fused_topn_counts_uncached(self, idx, f, filter_call,
-                                    shards: tuple[int, ...]
+                                    shards: tuple[int, ...],
+                                    opt: ExecOptions | None = None
                                     ) -> dict[int, int]:
         """All shards' TopN row counts in ONE device dispatch over the
         field's concatenated matrix stack (vs one scan per fragment).
@@ -1303,7 +1423,9 @@ class Executor:
         if mat_dev is None:
             return totals
         if filter_call is not None:
-            filt = self._fused_eval(idx, filter_call, shards)
+            filt = self._fused_eval(
+                idx, filter_call, shards,
+                use_delta=opt is None or opt.delta)
             counts = bm.row_counts_gathered(mat_dev, filt, pos_dev)
         else:
             counts = bm.row_counts(mat_dev)
@@ -1453,7 +1575,7 @@ class Executor:
                                               tuple(shards), opt)
             if probe is not None:
                 rc, ckey, cgens = probe
-                hit, val = rc.get(ckey, cgens)
+                hit, val = rc.get(ckey, cgens, self._rc_wait(opt))
                 if hit:
                     self._rc_mark_hit()
                     # deep copy: result translation writes row_key onto
@@ -1502,7 +1624,8 @@ class Executor:
             if len(group) > 1:
                 shard_pos = {s: i for i, s in enumerate(group)}
                 filt_stack = self._fused_eval(idx, filter_call,
-                                              tuple(group))
+                                              tuple(group),
+                                              use_delta=opt.delta)
 
         def map_fn(shard):
             import jax.numpy as jnp
@@ -1537,7 +1660,8 @@ class Executor:
             if filt_stack is not None and shard in shard_pos:
                 masks = filt_stack[shard_pos[shard]][None, :]
             elif filter_call is not None:
-                base = self._bitmap_words_shard(idx, filter_call, shard)
+                base = self._bitmap_words_shard(idx, filter_call, shard,
+                                                opt.delta)
                 if base is None:
                     return {}
                 # keep the filter on the same engine as the child
@@ -1710,10 +1834,12 @@ class Executor:
             extra=f.options.type == FieldType.INT)
         if call.name == "Sum":
             def batch_fn(group):
-                return [self._fused_sum(idx, f, call, tuple(group))]
+                return [self._fused_sum(idx, f, call, tuple(group),
+                                        use_delta=opt.delta)]
         else:
             def batch_fn(group):
-                return [self._fused_extreme(idx, f, call, tuple(group))]
+                return [self._fused_extreme(idx, f, call, tuple(group),
+                                            use_delta=opt.delta)]
 
         if fused_ok and not self._cluster_active(opt):
             _deadline.check(opt.deadline, "map")
@@ -1753,7 +1879,8 @@ class Executor:
             out = getattr(out, reducer)(vc)
         return out
 
-    def _fused_sum(self, idx, f, call: Call, shards: tuple[int, ...]) -> ValCount:
+    def _fused_sum(self, idx, f, call: Call, shards: tuple[int, ...],
+                   use_delta: bool = True) -> ValCount:
         """Sum over all shards in one stacked dispatch: plane counts from
         the [S, planes, W] BSI stack, exact assembly in Python ints
         (reference fragment.sum per shard, fragment.go:1111; here the
@@ -1763,7 +1890,8 @@ class Executor:
         P = f.device_plane_stack(shards)
         consider = P[:, bsi_ops.EXISTS_PLANE]
         if call.children:
-            filt = self._fused_eval(idx, call.children[0], shards)
+            filt = self._fused_eval(idx, call.children[0], shards,
+                                    use_delta=use_delta)
             # the filter stack is padded to the same device multiple
             consider = consider & filt
         pos, neg, count = bsi_ops.plane_counts_stacked(P, consider)
@@ -1775,7 +1903,8 @@ class Executor:
         return ValCount(total + total_count * f.options.base, total_count)
 
     def _fused_extreme(self, idx, f, call: Call,
-                       shards: tuple[int, ...]) -> ValCount:
+                       shards: tuple[int, ...],
+                       use_delta: bool = True) -> ValCount:
         """Min/Max over all shards from one stacked dispatch: the
         vmapped extreme scans produce every per-shard candidate; the
         host applies the sign-branching of fragment.min/max
@@ -1785,8 +1914,8 @@ class Executor:
         P = f.device_plane_stack(shards)
         consider = P[:, bsi_ops.EXISTS_PLANE]
         if call.children:
-            consider = consider & self._fused_eval(idx, call.children[0],
-                                                   shards)
+            consider = consider & self._fused_eval(
+                idx, call.children[0], shards, use_delta=use_delta)
         is_min = call.name == "Min"
         want = "min" if is_min else "max"
         (signed_cnt, all_cnt, primary_taken, fallback_taken,

@@ -200,7 +200,10 @@ def global_row_stack(field, row_id: int, plan: Plan):
         frag = view.fragment(s) if view is not None else None
         if frag is not None:
             with frag._lock:
-                arr = frag._rows.get(row_id)
+                # EFFECTIVE words (base ⊕ pending ingest delta): the
+                # collective path has no dfuse staging, so the overlay
+                # applies at fill time
+                arr, _ = frag._row_words_effective_locked(row_id)
                 if arr is not None:
                     buf[:] = arr
 
@@ -227,7 +230,7 @@ def global_time_row_stack(field, row_id: int, view_names, plan: Plan):
             if frag is None:
                 continue
             with frag._lock:  # OR under the lock: rows mutate in place
-                arr = frag._rows.get(row_id)
+                arr, _ = frag._row_words_effective_locked(row_id)
                 if arr is not None:
                     np.bitwise_or(buf, arr, out=buf)
 
@@ -282,7 +285,7 @@ def global_matrix_stack(field, row_ids, plan: Plan,
                 continue
             with frag._lock:  # OR under the lock: rows mutate in place
                 for j, rid in enumerate(rid_list):
-                    arr = frag._rows.get(rid)
+                    arr, _ = frag._row_words_effective_locked(rid)
                     if arr is not None:
                         np.bitwise_or(buf[j], arr, out=buf[j])
 
@@ -384,7 +387,6 @@ def global_column_bits(field, row_ids, column: int, plan: Plan,
 
     shard = column // SHARD_WIDTH
     off = column % SHARD_WIDTH
-    w, b = off // bm.WORD_BITS, off % bm.WORD_BITS
     views = [field.view(vn) for vn in view_names]
 
     def fill(buf, s):
@@ -396,9 +398,9 @@ def global_column_bits(field, row_ids, column: int, plan: Plan,
                 continue
             with frag._lock:
                 for i, r in enumerate(row_ids):
-                    arr = frag._rows.get(r)
-                    if arr is not None:
-                        buf[i] |= np.uint32((int(arr[w]) >> b) & 1)
+                    # effective bit: honors a pending delta override
+                    if frag._bit_off_locked(r, off):
+                        buf[i] |= np.uint32(1)
 
     stack = jax.make_array_from_callback(
         (len(plan.order), len(row_ids)), _sharding(plan, 1),

@@ -41,11 +41,28 @@ word-array copies accounted against this cache's own byte budget
 recomputes from the still-resident device stacks, so eviction here
 costs one dispatch, not a transfer).
 
+Single-flight fills (the streaming-ingest round): under sustained
+ingest every delta write invalidates its key, and all concurrently
+arriving readers miss TOGETHER — without coordination each one
+re-executes the identical query, multiplying device work by the
+convoy depth exactly when the system is busiest (the classic cache
+stampede).  ``get`` therefore registers the FIRST misser of a
+``(key, stamp)`` as the flight leader; same-stamp missers arriving
+while the flight is open wait (bounded by ``FLIGHT_WAIT_S`` and the
+flight's age) for the leader's ``put`` and then serve the fill as a
+hit.  A leader that dies never wedges followers: the wait is bounded,
+an expired flight (``FLIGHT_TTL_S``) is replaced by the next misser,
+and a waiter whose wait runs out simply computes — the fallback is
+the uncoordinated behavior, never an error.  A stamp moved by a newer
+write never joins an older flight (and vice versa): mismatched stamps
+compute independently, so single-flight can not serve stale data.
+
 Surface: ``[cache]`` config (budget bytes, max entry bytes, ttl,
 enabled), ``?nocache=1`` on the query route (symmetric with
 ``?nocoalesce``), ``cached``/``cacheKey`` on every flight record,
-``cache.{hits,misses,fills,evictions,invalidations,bytes}`` gauge
-families on /metrics, and ``GET /debug/resultcache``.
+``cache.{hits,misses,fills,evictions,invalidations,bytes,
+flight_joins,flight_served}`` gauge families on /metrics, and
+``GET /debug/resultcache``.
 """
 
 from __future__ import annotations
@@ -64,6 +81,15 @@ DEFAULT_MAX_ENTRY_BYTES = 8 << 20
 #: Prevents a flood of "free" scalar entries from reading as zero
 #: bytes while really holding megabytes of Python structure.
 ENTRY_OVERHEAD_BYTES = 256
+
+#: How long a same-stamp misser waits for an open flight's fill before
+#: giving up and computing itself.  Fills normally land in
+#: milliseconds; the cap only matters when the leader is wedged.
+FLIGHT_WAIT_S = 1.0
+
+#: A flight older than this is presumed dead (leader errored without
+#: filling) and is replaced by the next misser.
+FLIGHT_TTL_S = 5.0
 
 
 class Key:
@@ -105,6 +131,22 @@ class _Entry:
         self.hits = 0
 
 
+class _Flight:
+    """One in-progress fill: the leader computes, same-stamp missers
+    wait on the event.  ``put`` (any outcome, including an oversize
+    refusal) resolves it.  ``tid`` identifies the leader — a thread
+    never waits on its own flight (a leader re-probing before its
+    fill, e.g. a retried miss, must compute, not self-deadlock)."""
+
+    __slots__ = ("gens", "t0", "event", "tid")
+
+    def __init__(self, gens):
+        self.gens = gens
+        self.t0 = time.monotonic()
+        self.event = threading.Event()
+        self.tid = threading.get_ident()
+
+
 class ResultCache:
     """Memory-budgeted LRU of generation-stamped query results.
 
@@ -127,6 +169,13 @@ class ResultCache:
         self._lock = threading.Lock()
         # insertion order == LRU order (move-to-end on hit)
         self._entries: dict = {}
+        #: key -> _Flight: fills in progress (single-flight gate)
+        self._flights: dict = {}
+        #: keys whose last fill was refused as oversize — such a key
+        #: can never serve a flight's waiters, so followers must not
+        #: queue behind a leader whose put is doomed (bounded FIFO;
+        #: a later successful fill readmits the key)
+        self._noflight: dict = {}
         self.bytes = 0
         self.hits = 0
         self.misses = 0
@@ -134,53 +183,120 @@ class ResultCache:
         self.evictions = 0
         self.invalidations = 0
         self.skipped_oversize = 0
+        self.flight_joins = 0
+        self.flight_served = 0
 
     # -------------------------------------------------------------- access
 
-    def get(self, key, gens) -> tuple[bool, object]:
+    def get(self, key, gens,
+            wait_s: float = FLIGHT_WAIT_S) -> tuple[bool, object]:
         """(hit, value).  ``gens`` is the CURRENT generation tuple the
         caller just computed from the live fragments; a stored stamp
         that differs means some participating fragment mutated (or was
         replaced) since the fill — the entry is dropped and the call
-        counts as a miss."""
+        counts as a miss.
+
+        Single-flight: a miss with no open same-stamp flight registers
+        one (the caller is the leader and is expected to ``put``); a
+        miss while a same-stamp fill is already in progress waits up
+        to ``wait_s`` for it and serves the fill as a hit.  Pass
+        ``wait_s=0`` to never wait (pure probe)."""
         if not self.enabled:
             return False, None
-        with self._lock:
-            e = self._entries.get(key)
-            if e is None:
-                self.misses += 1
-                return False, None
-            if e.gens != gens or (
-                    self.ttl_s > 0
-                    and time.monotonic() - e.t > self.ttl_s):
-                del self._entries[key]
-                self.bytes -= e.nbytes
-                self.invalidations += 1
-                self.misses += 1
-                return False, None
-            self._entries[key] = self._entries.pop(key)  # move-to-end
-            e.hits += 1
-            self.hits += 1
-            return True, e.value
+        budget = wait_s
+        while True:
+            with self._lock:
+                e = self._entries.get(key)
+                if e is not None:
+                    if e.gens == gens and not (
+                            self.ttl_s > 0
+                            and time.monotonic() - e.t > self.ttl_s):
+                        self._entries[key] = self._entries.pop(key)
+                        e.hits += 1
+                        self.hits += 1
+                        return True, e.value
+                    del self._entries[key]
+                    self.bytes -= e.nbytes
+                    self.invalidations += 1
+                if key in self._noflight:
+                    # last fill for this key was refused (oversize):
+                    # waiting could never turn into a hit
+                    self.misses += 1
+                    return False, None
+                fl = self._flights.get(key)
+                now = time.monotonic()
+                if (fl is None or fl.gens != gens
+                        or fl.tid == threading.get_ident()
+                        or now - fl.t0 > FLIGHT_TTL_S):
+                    # no joinable fill: this caller computes.  A
+                    # mismatched-stamp flight is left to its own
+                    # waiters (its fill will simply never match ours);
+                    # an expired one is presumed dead and replaced;
+                    # our own open flight means WE are the leader.
+                    if fl is None or now - fl.t0 > FLIGHT_TTL_S:
+                        # leaders that die before put() (query error,
+                        # deadline expiry) leave orphans only a
+                        # same-key miss would replace — sweep expired
+                        # flights here so diverse errored keys cannot
+                        # grow the registry without bound
+                        if len(self._flights) >= 64:
+                            for k in [k for k, f in self._flights.items()
+                                      if now - f.t0 > FLIGHT_TTL_S]:
+                                self._flights.pop(k).event.set()
+                        self._flights[key] = _Flight(gens)
+                    self.misses += 1
+                    return False, None
+                if budget <= 0:
+                    # joinable fill but the caller can't wait
+                    self.misses += 1
+                    return False, None
+                self.flight_joins += 1
+                remaining = min(budget, FLIGHT_TTL_S - (now - fl.t0))
+            t0 = time.monotonic()
+            filled = fl.event.wait(remaining)
+            budget -= time.monotonic() - t0
+            if filled:
+                # loop re-probes: the normal outcome is a hit on the
+                # leader's fill (counted below as flight_served); a
+                # refused fill (oversize) falls through to computing
+                with self._lock:
+                    e = self._entries.get(key)
+                    if e is not None and e.gens == gens:
+                        self._entries[key] = self._entries.pop(key)
+                        e.hits += 1
+                        self.hits += 1
+                        self.flight_served += 1
+                        return True, e.value
+                    budget = 0  # resolved without a usable fill
+            # timed out (or unusable fill): compute ourselves on the
+            # next pass — budget is spent, so the re-entry can't wait
 
     def put(self, key, gens, value, nbytes: int) -> bool:
         """Insert one result stamped with the generations captured
         BEFORE its inputs were read.  Returns False when the entry was
-        refused (disabled / oversize / bigger than the whole budget)."""
+        refused (disabled / oversize / bigger than the whole budget).
+        Every outcome resolves an open flight for the key — waiters
+        must never outlive their leader's attempt."""
         if not self.enabled:
             return False
         nbytes = int(nbytes) + ENTRY_OVERHEAD_BYTES
         if nbytes > self.max_entry_bytes or nbytes > self.budget:
             with self._lock:
                 self.skipped_oversize += 1
+                self._resolve_flight_locked(key)
+                self._noflight[key] = None
+                while len(self._noflight) > 256:
+                    self._noflight.pop(next(iter(self._noflight)))
             return False
         with self._lock:
+            self._noflight.pop(key, None)
             old = self._entries.pop(key, None)
             if old is not None:
                 self.bytes -= old.nbytes
             self._entries[key] = _Entry(gens, value, nbytes)
             self.bytes += nbytes
             self.fills += 1
+            self._resolve_flight_locked(key)
             # strict budget: evict LRU until under — the entry just
             # inserted is newest and falls last, and since it fits the
             # budget on its own (checked above) the loop terminates
@@ -192,14 +308,23 @@ class ResultCache:
                 self.evictions += 1
             return True
 
+    def _resolve_flight_locked(self, key) -> None:
+        fl = self._flights.pop(key, None)
+        if fl is not None:
+            fl.event.set()
+
     def invalidate_all(self) -> int:
         """Drop everything (operator escape hatch / tests).  Counted
-        as invalidations."""
+        as invalidations.  Open flights resolve (waiters wake, miss,
+        and compute) rather than linger against cleared entries."""
         with self._lock:
             n = len(self._entries)
             self._entries.clear()
             self.bytes = 0
             self.invalidations += n
+            for fl in self._flights.values():
+                fl.event.set()
+            self._flights.clear()
             return n
 
     # ------------------------------------------------------------- exports
@@ -219,6 +344,9 @@ class ResultCache:
                 "evictions": self.evictions,
                 "invalidations": self.invalidations,
                 "skippedOversize": self.skipped_oversize,
+                "flightJoins": self.flight_joins,
+                "flightServed": self.flight_served,
+                "flightsOpen": len(self._flights),
             }
 
     def debug(self, top_n: int = 32) -> dict:
@@ -253,6 +381,8 @@ class ResultCache:
         stats.gauge("cache.bytes", s["bytes"])
         stats.gauge("cache.entries", s["entries"])
         stats.gauge("cache.budget_bytes", s["budget"])
+        stats.gauge("cache.flight_joins", s["flightJoins"])
+        stats.gauge("cache.flight_served", s["flightServed"])
 
 
 def key_digest(key) -> str:

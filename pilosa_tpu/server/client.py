@@ -278,14 +278,16 @@ class InternalClient:
 
     def query_node(self, uri: str, index: str, pql: str,
                    shards: list[int] | None = None, remote: bool = True,
-                   nocache: bool = False):
+                   nocache: bool = False, nodelta: bool = False):
         """POST /index/{i}/query with Remote semantics over the
         protobuf wire — node-to-node RPC speaks protobuf like the
         reference's InternalClient (http/client.go:268 QueryNode;
         external clients may still POST JSON).  Returns decoded result
         objects.  ``nocache`` rides as the same ?nocache=1 query param
         external clients use, so the peer's handler opts the sub-query
-        out of its result cache."""
+        out of its result cache; ``nodelta`` rides as ?nodelta=1 the
+        same way (the peer compacts its pending ingest deltas and
+        answers from pure base state)."""
         from pilosa_tpu import proto
 
         body = proto.encode(proto.QUERY_REQUEST, {
@@ -294,8 +296,10 @@ class InternalClient:
             "remote": remote,
         })
         path = f"{uri}/index/{index}/query"
-        if nocache:
-            path += "?nocache=1"
+        flags = [f for f, on in (("nocache=1", nocache),
+                                 ("nodelta=1", nodelta)) if on]
+        if flags:
+            path += "?" + "&".join(flags)
         raw = self._request(
             "POST", path, body,
             ctype="application/x-protobuf",
@@ -426,10 +430,10 @@ class HTTPTransport(Transport):
         self.client = client or InternalClient()
 
     def query_node(self, node: Node, index: str, pql: str, shards,
-                   nocache: bool = False):
+                   nocache: bool = False, nodelta: bool = False):
         # the protobuf client already returns decoded result objects
         return self.client.query_node(node.uri, index, pql, shards,
-                                      nocache=nocache)
+                                      nocache=nocache, nodelta=nodelta)
 
     def send_message(self, node: Node, message: dict) -> dict:
         return self.client.send_message(node.uri, message)

@@ -663,6 +663,10 @@ class Handler:
                 # ?nocache=1: opt this request out of the result cache
                 # (symmetric with ?nocoalesce — force a real execution)
                 cache=params.get("nocache") not in ("1", "true"),
+                # ?nodelta=1: compact pending ingest deltas up front
+                # and answer from pure base state (debugging escape;
+                # results are bit-exact either way)
+                delta=params.get("nodelta") not in ("1", "true"),
             )
         except Exception as e:
             if not proto_accept:
@@ -955,11 +959,13 @@ class Handler:
             # (pilosa_tpu.devobs; push backends get the same families
             # from the [observe] device-sample-interval loop)
             from pilosa_tpu import devobs
+            from pilosa_tpu.ingest import compactor
             from pilosa_tpu.runtime import resultcache
 
             try:
                 devobs.observer().publish_gauges(self.stats)
                 resultcache.cache().publish_gauges(self.stats)
+                compactor.compactor().publish_gauges(self.stats)
             except Exception:  # noqa: BLE001 — telemetry never fails a scrape
                 pass
             text = self.stats.prometheus_text(exemplars=exemplars)
@@ -1155,6 +1161,17 @@ class Handler:
 
         self._json(req, resultcache.cache().debug())
 
+    @route("GET", "/debug/ingest")
+    def handle_debug_ingest(self, req, params, path, body):
+        """Streaming-ingest state (pilosa_tpu.ingest): the [ingest]
+        config in force, pending-delta totals (bits / rows / bytes /
+        fragments), compaction counters (background, inline,
+        admission-skipped), and the largest pending per-fragment
+        deltas with their age and delta sequence."""
+        from pilosa_tpu.ingest import compactor
+
+        self._json(req, compactor.compactor().debug())
+
     @route("GET", "/debug/devices")
     def handle_debug_devices(self, req, params, path, body):
         """Device-runtime telemetry (pilosa_tpu.devobs): per-kernel /
@@ -1283,11 +1300,13 @@ class Handler:
         snap = {}
         if self.stats is not None and hasattr(self.stats, "snapshot"):
             from pilosa_tpu import devobs
+            from pilosa_tpu.ingest import compactor
             from pilosa_tpu.runtime import resultcache
 
             try:
                 devobs.observer().publish_gauges(self.stats)
                 resultcache.cache().publish_gauges(self.stats)
+                compactor.compactor().publish_gauges(self.stats)
             except Exception:  # noqa: BLE001
                 pass
             snap = self.stats.snapshot()

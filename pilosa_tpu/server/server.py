@@ -72,6 +72,10 @@ class Server:
         cache_budget_bytes: int | None = None,
         cache_max_entry_bytes: int | None = None,
         cache_ttl: float | None = None,
+        ingest_delta_enabled: bool = True,
+        ingest_delta_budget_bytes: int | None = None,
+        ingest_compact_threshold_bits: int | None = None,
+        ingest_compact_interval: float | None = None,
     ):
         from pilosa_tpu import logger as _logger
         from pilosa_tpu import stats as _stats
@@ -149,6 +153,38 @@ class Server:
             ttl_s=cache_ttl,
             enabled=cache_enabled,
         )
+        # streaming ingest ([ingest] config): delta planes + background
+        # compaction are process-wide like the result cache — configure
+        # in place; the compactor thread starts in open() and stops in
+        # close().  Remember whether the package default (disabled, so
+        # bare library embedders keep pre-delta semantics) was already
+        # overridden: close() only restores what THIS server flipped.
+        from pilosa_tpu import ingest as _ingest
+
+        # the FIRST in-process server snapshots the pre-server config;
+        # the LAST one to close restores it (ingest.restore_baseline —
+        # per-server snapshots compose wrongly when servers close in
+        # creation order, re-installing an earlier sibling's override)
+        _ingest.capture_baseline()
+        _ingest.configure(
+            delta_enabled=ingest_delta_enabled,
+            delta_budget_bytes=ingest_delta_budget_bytes,
+            compact_threshold_bits=ingest_compact_threshold_bits,
+            compact_interval=ingest_compact_interval,
+        )
+        self._ingest_enabled = bool(ingest_delta_enabled)
+        self._ingest_retained = False
+        self._closed = False
+        if self._ingest_enabled:
+            # reference taken at CONSTRUCTION, where the configure
+            # above landed — not at open() — so a sibling's close
+            # cannot restore the baseline out from under a
+            # constructed-but-not-yet-opened server (the scan thread
+            # idling over an empty registry until open is harmless)
+            from pilosa_tpu.ingest import compactor as _compactor
+
+            _compactor.retain()
+            self._ingest_retained = True
         # device-runtime telemetry (pilosa_tpu.devobs): wire the stats
         # backend in (compile.ms histograms publish live) and start the
         # optional background gauge sampler
@@ -179,6 +215,13 @@ class Server:
             enabled=admission_enabled,
             stats=self.stats,
         )
+        # background delta compactor (pilosa_tpu.ingest.compactor):
+        # process-wide; runs each scan under admission's internal class
+        # so compaction yields to query pressure like anti-entropy does
+        from pilosa_tpu.ingest import compactor as _compactor
+
+        _c = _compactor.compactor()
+        _c.admission = self.admission
         self.handler = Handler(self.api, host=host, port=port,
                                stats=self.stats, tracer=tracer,
                                tls_cert=tls_cert, tls_key=tls_key,
@@ -204,6 +247,15 @@ class Server:
         """Serve, then join via seeds or become a standalone NORMAL
         cluster (server.go:417 Open; gossip join with retry,
         gossip/gossip.go:65-123)."""
+        self._closed = False  # an instance reopened after close()
+        if self._ingest_enabled and not self._ingest_retained:
+            # reopened after close(): take the reference back (the
+            # normal first open already holds the construction-time
+            # one)
+            from pilosa_tpu.ingest import compactor as _compactor
+
+            _compactor.retain()
+            self._ingest_retained = True
         self.handler.serve_background()
         self.cluster.save_topology()
         if self.seeds:
@@ -224,7 +276,6 @@ class Server:
             t.start()
         self.runtime_monitor.start()
         self.device_sampler.start()
-
     def _join_via_seeds(self) -> None:
         client = self._client
         me = self.cluster.local_node.to_dict()
@@ -275,9 +326,36 @@ class Server:
                 pass
 
     def close(self) -> None:
+        # idempotent: a double-close (belt-and-braces test teardown)
+        # must not release the shared compactor reference twice and
+        # tear it down under a still-open sibling server
+        if self._closed:
+            return
+        self._closed = True
         self._stop.set()
         self.runtime_monitor.stop()
         self.device_sampler.stop()
+        # the scan thread and [ingest] config are shared across every
+        # in-process server: drop our reference, and only when we were
+        # the LAST ingest-enabled server stop the thread and restore
+        # the pre-server baseline config (a closed server group must
+        # not leave streaming semantics — or an aggressive budget/
+        # threshold/interval — in force for unrelated library users,
+        # nor yank them out from under a still-open sibling).  Pending
+        # bits are WAL-durable — fragment close drops the planes,
+        # reopen replays them.
+        from pilosa_tpu import ingest as _ingest
+        from pilosa_tpu.ingest import compactor as _compactor
+
+        if self._ingest_retained:
+            self._ingest_retained = False
+            last = _compactor.release()
+        else:
+            # ingest-disabled server: only restore when no
+            # ingest-enabled sibling still holds a reference
+            last = _compactor.refs() == 0
+        if last:
+            _ingest.restore_baseline()
         self.handler.close()
         self._client.close()  # drop pooled keep-alive sockets
         self.holder.close()

@@ -73,3 +73,12 @@ def _hermetic_residency_accounting():
     from pilosa_tpu.runtime import resultcache
 
     resultcache.reset()
+    # streaming-ingest state is process-wide as well: a test that
+    # enables delta planes (any in-process Server does) must not leak
+    # delta semantics — or a running compactor thread — into the next
+    # test's bare fragments
+    from pilosa_tpu import ingest
+    from pilosa_tpu.ingest import compactor
+
+    ingest.reset()
+    compactor.reset()

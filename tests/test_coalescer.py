@@ -218,6 +218,35 @@ class TestCoalescer:
         snap = stats.snapshot()
         assert snap["coalescer.batch_occupancy"]["max"] == 1
 
+    def test_batch_pads_to_power_of_two(self, ex):
+        """Free-running batch occupancies would each compile their own
+        XLA variant (the jitted program re-lowers per [B, S, W] input
+        shape), so under sustained ingest the serving path would pay a
+        fresh multi-hundred-ms compile at every new batch size — the
+        flush pads device batches to the next power of two instead.
+        3 concurrent queries -> one launch whose stacks carry 4 batch
+        rows; the 3 real results stay bit-exact."""
+        _attach(ex, window_s=2.0, max_batch=8)
+        qs = [f"Count(Intersect(Row(f0={a}), Row(f1=0)))"
+              for a in range(3)]
+        expected = [_unbatched(ex, q) for q in qs]
+        seen = []
+        orig = expr.evaluate
+
+        def spy(shape, leaves, counts=False):
+            seen.append(tuple(getattr(lv, "shape", ()) for lv in leaves))
+            return orig(shape, leaves, counts=counts)
+
+        expr.evaluate = spy
+        try:
+            got = _run_concurrent(ex, qs)
+        finally:
+            expr.evaluate = orig
+        assert got == expected
+        batched = [s for s in seen if s and len(s[0]) == 3]
+        assert batched, seen
+        assert all(s[0][0] == 4 for s in batched), seen
+
     def test_different_shapes_do_not_merge(self, ex):
         """Structurally different trees dispatch separately but still
         answer correctly."""

@@ -241,7 +241,13 @@ class TestDebugDevices:
         _post(uri, "/index/dv")
         _post(uri, "/index/dv/field/f")
         _post(uri, "/index/dv/query", {"query": "Set(1, f=9)"})
-        _post(uri, "/index/dv/query", {"query": "Count(Row(f=9))"})
+        # ?nodelta=1: the Set lands in the streaming delta plane, and a
+        # plain single-shard read would answer from the host overlay
+        # without ever touching the device — this test needs the
+        # up-front compaction + device-matrix read so a transfer is
+        # actually metered
+        _post(uri, "/index/dv/query?nodelta=1",
+              {"query": "Count(Row(f=9))"})
 
     def test_debug_devices_document(self, srv):
         devobs.reset()

@@ -77,9 +77,9 @@ class TestFusedEquivalence:
         calls = {"n": 0}
         orig = ex._fused_expr
 
-        def spy(idx, call, shards):
+        def spy(idx, call, shards, *a, **k):
             calls["n"] += 1
-            return orig(idx, call, shards)
+            return orig(idx, call, shards, *a, **k)
 
         ex._fused_expr = spy
         ex.execute("i", "Count(Intersect(Row(f0=1), Row(f1=2)))")
@@ -267,9 +267,10 @@ class TestFusedEquivalence:
         for nd in nodes:
             orig = nd.executor._fused_expr
 
-            def spy(idx, call, shards, _o=orig, _id=nd.cluster.local_id):
+            def spy(idx, call, shards, *a, _o=orig,
+                    _id=nd.cluster.local_id, **k):
                 hits[_id] += 1
-                return _o(idx, call, shards)
+                return _o(idx, call, shards, *a, **k)
 
             nd.executor._fused_expr = spy
         got = nodes[0].executor.execute("i", "Count(Row(f=1))")[0]
@@ -288,8 +289,8 @@ class TestFusedEquivalence:
         sum_hits = {"n": 0}
         orig_sum = nodes[0].executor._fused_sum
         nodes[0].executor._fused_sum = (
-            lambda *a: (sum_hits.__setitem__("n", sum_hits["n"] + 1),
-                        orig_sum(*a))[1])
+            lambda *a, **k: (sum_hits.__setitem__("n", sum_hits["n"] + 1),
+                             orig_sum(*a, **k))[1])
         out = nodes[0].executor.execute("i", "Sum(field=v)")[0]
         assert (out.val, out.count) == (5 * len(cols), len(cols))
         assert sum_hits["n"] > 0

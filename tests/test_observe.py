@@ -464,17 +464,23 @@ class TestCoalescerObservability:
         errs: list = []
         barrier = threading.Barrier(n_threads)
 
-        def worker():
+        # DISTINCT same-shape queries: identical concurrent queries
+        # now single-flight at the result cache (only the leader
+        # reaches the coalescer; followers record as cache hits), so
+        # observing per-member batch context needs distinct keys —
+        # same canonical tree shape, different row ids, one batch.
+        def worker(a, b):
             try:
                 barrier.wait()
                 got = e.execute(
-                    "i", "Count(Intersect(Row(f=1), Row(f=2)))")[0]
+                    "i", f"Count(Intersect(Row(f={a}), Row(f={b})))")[0]
                 assert got == 0
             except BaseException as exc:  # noqa: BLE001
                 errs.append(exc)
 
-        threads = [threading.Thread(target=worker)
-                   for _ in range(n_threads)]
+        threads = [threading.Thread(target=worker,
+                                    args=(1 + 2 * i, 2 + 2 * i))
+                   for i in range(n_threads)]
         for t in threads:
             t.start()
         for t in threads:

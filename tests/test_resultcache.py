@@ -14,7 +14,9 @@ from __future__ import annotations
 import json
 import random
 import threading
+import time
 import urllib.request
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 import pytest
@@ -260,6 +262,105 @@ class TestResultCacheUnit:
                       + (48 + 40) + 32 + (48 + 0) + 32   # FieldRow 1
                       + (48 + 40) + 32 + (48 + 30) + 32  # FieldRow 2
                       + 32)         # count
+
+
+class TestSingleFlight:
+    """Stampede control: concurrent same-stamp missers wait for the
+    first misser's fill instead of re-executing (the streaming-ingest
+    round — every delta write invalidates its key, so the convoy of
+    readers behind each invalidation used to multiply device work by
+    its own depth)."""
+
+    def test_follower_serves_leader_fill(self):
+        rc = resultcache.ResultCache()
+        hit, _ = rc.get("k", (1,))   # this thread is now the leader
+        assert not hit
+        got = []
+
+        def follower():
+            got.append(rc.get("k", (1,), wait_s=5.0))
+
+        t = threading.Thread(target=follower)
+        t.start()
+        # wait until the follower has actually joined the flight, then
+        # land the leader's fill
+        for _ in range(500):
+            if rc.stats_dict()["flightJoins"] == 1:
+                break
+            time.sleep(0.002)
+        rc.put("k", (1,), "v", 10)
+        t.join(timeout=5)
+        assert got == [(True, "v")]
+        s = rc.stats_dict()
+        assert s["flightJoins"] == 1 and s["flightServed"] == 1
+        assert s["flightsOpen"] == 0
+
+    def test_leader_reprobe_never_waits_on_itself(self):
+        rc = resultcache.ResultCache()
+        assert not rc.get("k", (1,))[0]
+        t0 = time.monotonic()
+        assert not rc.get("k", (1,))[0]  # same thread: no self-wait
+        assert time.monotonic() - t0 < 0.5
+
+    def test_zero_wait_probe_never_blocks(self):
+        rc = resultcache.ResultCache()
+        assert not rc.get("k", (1,))[0]
+
+        def probe():
+            t0 = time.monotonic()
+            hit, _ = rc.get("k", (1,), wait_s=0)
+            return (hit, time.monotonic() - t0)
+
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            hit, took = pool.submit(probe).result(timeout=5)
+        assert not hit and took < 0.5
+
+    def test_mismatched_stamp_never_joins(self):
+        """A reader whose stamp moved past the open flight's must
+        compute, not wait — the flight's fill could never match."""
+        rc = resultcache.ResultCache()
+        assert not rc.get("k", (1,))[0]  # open flight stamped (1,)
+
+        def probe_newer():
+            t0 = time.monotonic()
+            hit, _ = rc.get("k", (2,), wait_s=5.0)
+            return (hit, time.monotonic() - t0)
+
+        with ThreadPoolExecutor(max_workers=1) as pool:
+            hit, took = pool.submit(probe_newer).result(timeout=5)
+        assert not hit and took < 0.5
+        assert rc.stats_dict()["flightJoins"] == 0
+
+    def test_refused_fill_releases_waiters(self):
+        """An oversize put must still resolve the flight: the waiter
+        wakes, misses, and computes itself rather than hanging."""
+        rc = resultcache.ResultCache(max_entry_bytes=1000)
+        assert not rc.get("k", (1,))[0]
+        got = []
+
+        def follower():
+            got.append(rc.get("k", (1,), wait_s=5.0)[0])
+
+        t = threading.Thread(target=follower)
+        t.start()
+        for _ in range(500):
+            if rc.stats_dict()["flightJoins"] == 1:
+                break
+            time.sleep(0.002)
+        assert not rc.put("k", (1,), "v", 10_000)  # oversize: refused
+        t.join(timeout=5)
+        assert got == [False]
+        # the refusal marks the key no-flight: an uncacheable key can
+        # never serve waiters, so later missers compute immediately —
+        # no new flight opens and nobody queues behind a doomed fill
+        assert rc.stats_dict()["flightsOpen"] == 0
+        t0 = time.monotonic()
+        assert not rc.get("k", (1,))[0]
+        assert time.monotonic() - t0 < 0.5
+        assert rc.stats_dict()["flightsOpen"] == 0
+        # a fill that actually fits readmits the key
+        rc.put("k", (1,), "small", 10)
+        assert rc.get("k", (1,)) == (True, "small")
 
 
 # ---------------------------------------------------------------------------

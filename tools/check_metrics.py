@@ -241,8 +241,14 @@ DEVICE_FAMILIES = ("device_", "compile_", "residency_")
 #: bytes,...} rendered as cache_*.
 CACHE_FAMILIES = ("cache_",)
 
+#: Streaming-ingest families (ingest.compactor publish_gauges):
+#: ingest.{delta_writes,delta_bits,delta_rows,delta_bytes,
+#: fragments_pending,compactions,compacted_bits,inline_flushes,
+#: compact_skipped} rendered as ingest_*.
+INGEST_FAMILIES = ("ingest_",)
+
 #: Everything the ``--families`` CLI mode requires of a live server.
-ALL_FAMILIES = DEVICE_FAMILIES + CACHE_FAMILIES
+ALL_FAMILIES = DEVICE_FAMILIES + CACHE_FAMILIES + INGEST_FAMILIES
 
 
 def check_families(text: str, prefixes=DEVICE_FAMILIES) -> dict[str, int]:

@@ -18,10 +18,18 @@ CLI::
 
     python -m tools.loadgen --host http://127.0.0.1:10101 -i myindex \
         --qps 200 --seconds 5 --query 'Count(Row(f=1))' \
-        --mix query=0.9,ingest=0.1 --deadline-ms 50,500
+        --mix query=0.9,ingest=0.1 --ingest-bits 1000 --ingest-field f \
+        --deadline-ms 50,500
+
+Mixed read/write mode: ingest-class requests POST real import payloads
+(``--ingest-bits`` random positions over ``--ingest-rows`` rows and
+``--ingest-cols`` columns into ``--ingest-field``), and the report adds
+read-only p50/p99, ingested bits/s, and the server's result-cache hit
+rate over the run window — the streaming-ingest acceptance numbers.
 
 Importable: ``run_load(...)`` returns the report dict (used by
-tests/test_admission.py to drive a server at 2x capacity).
+tests/test_admission.py to drive a server at 2x capacity and
+tests/test_ingest.py for the mixed-workload acceptance run).
 """
 
 from __future__ import annotations
@@ -35,8 +43,10 @@ import urllib.error
 import urllib.request
 
 #: class -> request builder is fixed: queries POST PQL, ingest POSTs a
-#: tiny import.  ``internal`` posts a cluster control message (a cheap
-#: attr-blocks probe) — enough to occupy an internal slot.
+#: real import payload (``ingest_bits`` random positions — sized so a
+#: modest request rate sustains >=100k bits/s, the streaming-ingest
+#: acceptance floor).  ``internal`` posts a cluster control message (a
+#: cheap attr-blocks probe) — enough to occupy an internal slot.
 DEFAULT_MIX = {"query": 1.0}
 
 
@@ -46,15 +56,23 @@ class _Stats:
     def __init__(self):
         self.lock = threading.Lock()
         self.ok_latencies: list[float] = []
+        #: READ (query-class) completions only — the latencies the
+        #: mixed-workload pins are about (read p50/p99 under ingest,
+        #: not the blended number that an import's larger body and
+        #: server-side bulk apply would skew)
+        self.read_latencies: list[float] = []
         self.sent = 0
         self.ok = 0
         self.shed = 0
         self.expired = 0
         self.errors = 0
         self.retry_after_seen = 0
+        self.ingest_ok = 0
+        self.ingest_bits = 0
 
     def note(self, outcome: str, latency_s: float,
-             retry_after: bool) -> None:
+             retry_after: bool, klass: str = "query",
+             bits: int = 0) -> None:
         with self.lock:
             self.sent += 1
             if retry_after:
@@ -62,6 +80,11 @@ class _Stats:
             if outcome == "ok":
                 self.ok += 1
                 self.ok_latencies.append(latency_s)
+                if klass == "query":
+                    self.read_latencies.append(latency_s)
+                elif klass == "ingest":
+                    self.ingest_ok += 1
+                    self.ingest_bits += bits
             elif outcome == "shed":
                 self.shed += 1
             elif outcome == "expired":
@@ -78,11 +101,22 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
 
 
 def _build_request(host: str, index: str, klass: str, query: str,
-                   deadline_s: float | None):
+                   deadline_s: float | None,
+                   ingest_field: str = "loadgen",
+                   ingest_bits: int = 1, ingest_rows: int = 8,
+                   ingest_cols: int = 1 << 20):
+    bits = 0
     if klass == "ingest":
-        url = f"{host}/index/{index}/field/loadgen/import"
-        col = random.randrange(1 << 20)
-        body = json.dumps({"rowIDs": [1], "columnIDs": [col]}).encode()
+        url = f"{host}/index/{index}/field/{ingest_field}/import"
+        # a REAL import payload: ingest_bits random positions across a
+        # small row set — the shape a bulk loader ships, and (with
+        # [ingest] deltas on) exactly what lands in the delta plane
+        rows = [random.randrange(ingest_rows)
+                for _ in range(ingest_bits)]
+        cols = [random.randrange(ingest_cols)
+                for _ in range(ingest_bits)]
+        body = json.dumps({"rowIDs": rows, "columnIDs": cols}).encode()
+        bits = ingest_bits
     elif klass == "internal":
         url = f"{host}/internal/cluster/message"
         body = json.dumps({"type": "attr-blocks", "index": index,
@@ -94,15 +128,16 @@ def _build_request(host: str, index: str, klass: str, query: str,
     req.add_header("Content-Type", "application/json")
     if deadline_s is not None:
         req.add_header("X-Pilosa-Deadline", f"{deadline_s:.3f}")
-    return req
+    return req, klass, bits
 
 
-def _fire(req, timeout: float, stats: _Stats) -> None:
+def _fire(req, timeout: float, stats: _Stats, klass: str = "query",
+          bits: int = 0) -> None:
     t0 = time.perf_counter()
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             resp.read()
-        stats.note("ok", time.perf_counter() - t0, False)
+        stats.note("ok", time.perf_counter() - t0, False, klass, bits)
     except urllib.error.HTTPError as e:
         body = b""
         try:
@@ -114,16 +149,32 @@ def _fire(req, timeout: float, stats: _Stats) -> None:
             outcome = "expired" if b"expired" in body else "shed"
         else:
             outcome = "error"
-        stats.note(outcome, time.perf_counter() - t0, retry_after)
+        stats.note(outcome, time.perf_counter() - t0, retry_after, klass)
     except Exception:
-        stats.note("error", time.perf_counter() - t0, False)
+        stats.note("error", time.perf_counter() - t0, False, klass)
+
+
+def _cache_counters(host: str) -> tuple[int, int] | None:
+    """(hits, misses) from the server's result cache, or None when the
+    debug surface is unreachable — the report's hit rate is the DELTA
+    over the run window, so concurrent warmup traffic outside the run
+    doesn't pollute the number."""
+    try:
+        with urllib.request.urlopen(f"{host}/debug/resultcache",
+                                    timeout=5) as resp:
+            d = json.loads(resp.read())
+        return int(d.get("hits", 0)), int(d.get("misses", 0))
+    except Exception:
+        return None
 
 
 def run_load(host: str, index: str, qps: float, seconds: float,
              query: str = "Count(Row(f=1))",
              mix: dict[str, float] | None = None,
              deadline_s: tuple[float, float] | None = None,
-             timeout: float = 10.0, pool: int = 32) -> dict:
+             timeout: float = 10.0, pool: int = 32,
+             ingest_field: str = "loadgen", ingest_bits: int = 1,
+             ingest_rows: int = 8, ingest_cols: int = 1 << 20) -> dict:
     """Drive ``host`` open-loop at ``qps`` for ``seconds``; returns the
     report dict.  ``mix`` maps class -> weight; ``deadline_s`` is a
     (lo, hi) uniform range for the per-request deadline header (None =
@@ -142,9 +193,24 @@ def run_load(host: str, index: str, qps: float, seconds: float,
 
     mix = mix or DEFAULT_MIX
     classes = list(mix)
-    weights = [mix[c] for c in classes]
     stats = _Stats()
     n = int(qps * seconds)
+    # EXACT-proportion, evenly interleaved class schedule (largest-
+    # remainder pacing).  A binomial draw would make the delivered
+    # ingest bits/s wobble +/-30% run to run at small n, and a random
+    # shuffle can cluster several heavy imports back to back — the
+    # schedule itself manufacturing tail latency the server didn't
+    # cause.  Deterministic interleave keeps the mix exact and the
+    # inter-class spacing as even as the proportions allow.
+    total_w = sum(mix.values()) or 1.0
+    err = dict.fromkeys(classes, 0.0)
+    sched = []
+    for _ in range(n):
+        for c in classes:
+            err[c] += mix[c] / total_w
+        pick = max(classes, key=lambda c: err[c])
+        err[pick] -= 1.0
+        sched.append(pick)
     jobs: _queue.Queue = _queue.Queue()
     late = [0]
     late_lock = threading.Lock()
@@ -154,15 +220,16 @@ def run_load(host: str, index: str, qps: float, seconds: float,
             item = jobs.get()
             if item is None:
                 return
-            due, req = item
+            due, req, klass, bits = item
             delay = due - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
             elif delay < -0.05:
                 with late_lock:
                     late[0] += 1
-            _fire(req, timeout, stats)
+            _fire(req, timeout, stats, klass, bits)
 
+    cache0 = _cache_counters(host)
     workers = [threading.Thread(target=worker, daemon=True)
                for _ in range(pool)]
     for w in workers:
@@ -170,16 +237,27 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     start = time.perf_counter()
     for i in range(n):
         due = start + i / qps
-        klass = random.choices(classes, weights)[0]
+        klass = sched[i]
         dl = (random.uniform(*deadline_s)
               if deadline_s is not None else None)
-        jobs.put((due, _build_request(host, index, klass, query, dl)))
+        req, kl, bits = _build_request(host, index, klass, query, dl,
+                                       ingest_field, ingest_bits,
+                                       ingest_rows, ingest_cols)
+        jobs.put((due, req, kl, bits))
     for _ in workers:
         jobs.put(None)
     for w in workers:
         w.join(seconds + n * timeout)
     elapsed = time.perf_counter() - start
+    cache1 = _cache_counters(host)
+    hit_rate = None
+    if cache0 is not None and cache1 is not None:
+        dh = cache1[0] - cache0[0]
+        dm = cache1[1] - cache0[1]
+        if dh + dm > 0:
+            hit_rate = round(dh / (dh + dm), 4)
     lat = sorted(stats.ok_latencies)
+    rlat = sorted(stats.read_latencies)
     return {
         "target_qps": qps,
         "seconds": round(elapsed, 3),
@@ -195,6 +273,19 @@ def run_load(host: str, index: str, qps: float, seconds: float,
         "retry_after_seen": stats.retry_after_seen,
         "p50_ms": round(_percentile(lat, 0.50) * 1e3, 2),
         "p99_ms": round(_percentile(lat, 0.99) * 1e3, 2),
+        # mixed read/write view: READ latencies alone (query-class
+        # completions), the ingest goodput in bits, and the server's
+        # result-cache hit rate over the run window — the numbers the
+        # streaming-ingest acceptance pins (read p99 within 2x of the
+        # read-only baseline at >=100k bits/s with hit rate >50%)
+        "read_ok": len(rlat),
+        "read_p50_ms": round(_percentile(rlat, 0.50) * 1e3, 2),
+        "read_p99_ms": round(_percentile(rlat, 0.99) * 1e3, 2),
+        "ingest_ok": stats.ingest_ok,
+        "ingest_bits": stats.ingest_bits,
+        "ingest_bits_per_s": round(stats.ingest_bits / elapsed, 1)
+        if elapsed else 0.0,
+        "cache_hit_rate": hit_rate,
     }
 
 
@@ -212,6 +303,20 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--deadline-ms", default=None,
                    help="lo,hi uniform per-request deadline in ms "
                         "(default: none sent)")
+    p.add_argument("--ingest-field", default="loadgen",
+                   help="field ingest-class imports land in (point it "
+                        "at the queried field to measure cache warmth "
+                        "under same-field ingest)")
+    p.add_argument("--ingest-bits", type=int, default=1,
+                   help="bit positions per ingest import payload "
+                        "(sized so the mix sustains the target "
+                        "bits/s)")
+    p.add_argument("--ingest-rows", type=int, default=8,
+                   help="row-id range ingest positions draw from")
+    p.add_argument("--ingest-cols", type=int, default=1 << 20,
+                   help="column range ingest positions draw from "
+                        "(span multiple shard widths to fan the write "
+                        "load out)")
     p.add_argument("--timeout", type=float, default=10.0)
     args = p.parse_args(argv)
     mix = {}
@@ -224,7 +329,11 @@ def main(argv: list[str] | None = None) -> int:
         deadline_s = (float(lo) / 1e3, float(hi or lo) / 1e3)
     report = run_load(args.host.rstrip("/"), args.index, args.qps,
                       args.seconds, query=args.query, mix=mix,
-                      deadline_s=deadline_s, timeout=args.timeout)
+                      deadline_s=deadline_s, timeout=args.timeout,
+                      ingest_field=args.ingest_field,
+                      ingest_bits=args.ingest_bits,
+                      ingest_rows=args.ingest_rows,
+                      ingest_cols=args.ingest_cols)
     print(json.dumps(report, indent=2))
     return 0
 
