@@ -634,6 +634,168 @@ def bench_coalescer(a_np: np.ndarray,
     return out, obs, dv
 
 
+def bench_ragged(a_np: np.ndarray, b_np: np.ndarray) -> dict | None:
+    """Homogeneous-vs-heterogeneous A/B on the coalesced serving path
+    (the ragged-megabatch round): closed-loop concurrent Count
+    traffic through the executor, first 8 same-shape variants (the
+    pre-ragged best case — every query merges into one fused-program
+    launch), then 16 structurally DISTINCT shapes (realistic mixed
+    dashboard traffic — pre-ragged this coalesced almost never and
+    paid per-query dispatch; with the op-tape interpreter the whole
+    mix shares size-class buckets).
+
+    Every completed query is verified against a host-computed expected
+    count, and each phase reports p50 latency plus
+    ``dispatches_per_query`` (coalescer launches over completed
+    queries — the number the engine exists to push toward the batch
+    dispatch floor).  Artifact pins: ``pin_2x_ok`` — the mixed-shape
+    open-loop p50 stays within 2x of the homogeneous p50 — and
+    ``pin_dpq_ok`` — mixed dispatches/query <= 0.25 (>= 4 queries per
+    launch on heterogeneous traffic)."""
+    import statistics
+    import tempfile
+    import threading
+
+    from pilosa_tpu import stats as _stats
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.ops import bitmap as bm
+    from pilosa_tpu.parallel.coalescer import Coalescer
+    from pilosa_tpu.parallel.executor import Executor
+    from pilosa_tpu.runtime import resultcache as _resultcache
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from tools.loadgen import shape_mix_queries
+
+    if bm.n_words(SHARD_WIDTH) != WORDS:
+        return None
+
+    SH = 64  # shards: real fan-out, bounded host A/B time
+    N_VAR = 8
+    salts = (np.arange(1, N_VAR + 1, dtype=np.uint64)
+             * np.uint64(0x9E3779B9)).astype(np.uint32)
+    holder = Holder(tempfile.mkdtemp() + "/bench-rg")
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    view = f.create_view_if_not_exists("standard")
+    for s in range(SH):
+        frag = view.create_fragment_if_not_exists(s)
+        with frag._lock:
+            # rows 0..5 feed the shape-mix trees; row 2 doubles as the
+            # homogeneous filter; 100+v are the same-shape variants
+            for r in range(6):
+                frag._rows[r] = (
+                    a_np[s] ^ np.uint32((r * 0x85EBCA6B) & 0xFFFFFFFF)
+                    if r != 2 else b_np[s].copy())
+            for v in range(N_VAR):
+                frag._rows[100 + v] = a_np[s] ^ salts[v]
+            frag._gen += 1
+        f._note_shard(s)
+
+    ex = Executor(holder)
+    stats = _stats.MemStatsClient()
+    # 10ms window (vs the 2ms serving default): the host A/B runs
+    # closed-loop with ~100ms flushes, and a 2ms window lets the
+    # post-flush re-convergence straggle into under-filled buckets —
+    # the wider window costs ~10% of one flush and makes the measured
+    # dispatches/query describe batching, not thread wake-up jitter
+    ex.coalescer = Coalescer(window_s=0.010, max_batch=32,
+                             enabled=True, stats=stats)
+    _resultcache.cache().enabled = False
+
+    homo_qs = [f"Count(Intersect(Row(f={100 + v}), Row(f=2)))"
+               for v in range(N_VAR)]
+    mixed_qs = shape_mix_queries(16, field="f", rows=6)
+
+    def ground_truth(qs):
+        ex.fuse_shards = False
+        try:
+            return [int(ex.execute("i", q)[0]) for q in qs]
+        finally:
+            ex.fuse_shards = True
+
+    homo_expect = ground_truth(homo_qs)
+    mixed_expect = ground_truth(mixed_qs)
+    for qs, expects in ((homo_qs, homo_expect),
+                        (mixed_qs, mixed_expect)):
+        for q, want in zip(qs, expects):  # warm stacks + programs
+            got = int(ex.execute("i", q)[0])
+            if got != want:
+                raise AssertionError(
+                    f"ragged bench warm-up mismatch: {q} -> {got}, "
+                    f"expected {want}")
+
+    THREADS = 16
+
+    def phase(qs, expects, seconds: float) -> dict:
+        lats: list[list[int]] = [[] for _ in range(THREADS)]
+        errs: list = []
+        d0 = stats.snapshot().get("coalescer.dispatches", 0)
+        t0 = time.perf_counter()
+        stop = t0 + seconds
+
+        def worker(t: int) -> None:
+            i = t
+            try:
+                while time.perf_counter() < stop:
+                    v = i % len(qs)
+                    tq = time.perf_counter_ns()
+                    got = int(ex.execute("i", qs[v])[0])
+                    lats[t].append(time.perf_counter_ns() - tq)
+                    if got != expects[v]:
+                        raise AssertionError(
+                            f"ragged bench returned {got}, expected "
+                            f"{expects[v]} for {qs[v]}")
+                    i += THREADS
+            except BaseException as e:  # noqa: BLE001 — fail loudly
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(THREADS)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        if errs:
+            raise errs[0]
+        flat = [x for per in lats for x in per]
+        done = len(flat)
+        dn = stats.snapshot().get("coalescer.dispatches", 0) - d0
+        return {
+            "p50_us": round(statistics.median(flat) / 1e3, 1),
+            "queries": done,
+            "qps": round(done / seconds, 1),
+            "dispatches_per_query": round(dn / max(1, done), 4),
+        }
+
+    try:
+        homo = phase(homo_qs, homo_expect, 1.5)
+        mixed = phase(mixed_qs, mixed_expect, 1.5)
+    finally:
+        _resultcache.cache().enabled = True
+        holder.close()
+    from pilosa_tpu.ops import tape as _tape
+
+    out = {
+        "homogeneous_batch32": homo,
+        "mixed_16_shapes": mixed,
+        "shape_mix": 16,
+        "mixed_vs_homogeneous_p50": round(
+            mixed["p50_us"] / homo["p50_us"], 2),
+        "tape_counters": {k: v for k, v in _tape.counters().items()
+                          if v},
+        "pin_2x_ok": mixed["p50_us"] <= 2.0 * homo["p50_us"],
+        "pin_dpq_ok": mixed["dispatches_per_query"] <= 0.25,
+    }
+    if not out["pin_2x_ok"]:
+        print(f"bench: ragged mixed-shape p50 {mixed['p50_us']:.0f}us "
+              f"is NOT within 2x of the homogeneous p50 "
+              f"{homo['p50_us']:.0f}us", file=sys.stderr)
+    if not out["pin_dpq_ok"]:
+        print(f"bench: ragged mixed dispatches/query "
+              f"{mixed['dispatches_per_query']} exceeds the 0.25 "
+              f"acceptance bound", file=sys.stderr)
+    return out
+
+
 def bench_resultcache(a_np: np.ndarray,
                       b_np: np.ndarray) -> dict | None:
     """Cold/warm A/B of the generation-stamped result cache on the
@@ -1036,6 +1198,9 @@ def main():
         extras["observe"] = obs
         extras["devobs"] = dv
     extras["admission"] = bench_admission(co)
+    rg = bench_ragged(a, b)
+    if rg is not None:
+        extras["ragged"] = rg
     rc = bench_resultcache(a, b)
     if rc is not None:
         extras["resultcache"] = rc

@@ -69,6 +69,18 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--cache-ttl", type=float,
                     help="seconds before a cached result ages out even "
                          "unmutated ([cache] ttl; 0 = generations only)")
+    ps.add_argument("--no-ragged", action="store_true",
+                    help="disable ragged megabatch execution "
+                         "([ragged] enabled=false): the coalescer "
+                         "merges only identical-shape queries through "
+                         "the fused path (pre-ragged behavior)")
+    ps.add_argument("--ragged-max-tape", type=int,
+                    help="longest op-tape a query may compile to "
+                         "before falling back to the per-shape fused "
+                         "path ([ragged] max-tape)")
+    ps.add_argument("--ragged-max-leaves", type=int,
+                    help="most leaf operand stacks a query may stage "
+                         "into a ragged bucket ([ragged] max-leaves)")
     ps.add_argument("--no-ingest-delta", action="store_true",
                     help="disable streaming-ingest delta planes "
                          "([ingest] delta-enabled=false): every write "
@@ -178,6 +190,12 @@ def cmd_server(args) -> int:
         v = getattr(args, f"cache_{key}", None)
         if v is not None:
             setattr(cfg.cache, key, v)
+    if args.no_ragged:
+        cfg.ragged.enabled = False
+    for key in ("max_tape", "max_leaves"):
+        v = getattr(args, f"ragged_{key}", None)
+        if v is not None:
+            setattr(cfg.ragged, key, v)
     if args.no_ingest_delta:
         cfg.ingest.delta_enabled = False
     for key in ("delta_budget_bytes", "compact_threshold_bits",
@@ -252,6 +270,10 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         coalescer_enabled=cfg.coalescer.enabled,
         coalescer_window_ms=cfg.coalescer.window_ms,
         coalescer_max_batch=cfg.coalescer.max_batch,
+        ragged_enabled=cfg.ragged.enabled,
+        ragged_max_tape=cfg.ragged.max_tape,
+        ragged_max_leaves=cfg.ragged.max_leaves,
+        ragged_prewarm=cfg.ragged.prewarm,
         observe_enabled=cfg.observe.enabled,
         observe_recent=cfg.observe.recent,
         observe_long_query_time=cfg.observe.long_query_time,

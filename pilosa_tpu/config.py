@@ -89,6 +89,28 @@ class CoalescerConfig:
 
 
 @dataclass
+class RaggedConfig:
+    """[ragged] — heterogeneous-shape megabatch execution
+    (ops/tape.py + parallel/coalescer.py; no reference analog — the
+    Ragged-Paged-Attention-style batching lever for structurally
+    diverse query traffic).  With ``enabled`` on, the coalescer keys
+    its batching window on tape SIZE CLASS instead of exact expression
+    shape, so distinct Count trees share one device launch through the
+    op-tape interpreter.  ``max-tape``/``max-leaves`` cap the
+    per-query tape; a query over either cap falls back to the
+    per-shape fused path for that query alone (behavior unchanged).
+    ``prewarm`` lowers the bucket interpreter programs on a background
+    thread at server open so the first heterogeneous window pays a
+    dispatch, not an XLA compile.  Only meaningful where the coalescer
+    itself is on (accelerator attached, or [coalescer] forced true)."""
+
+    enabled: bool = True
+    max_tape: int = 32
+    max_leaves: int = 16
+    prewarm: bool = True
+
+
+@dataclass
 class ObserveConfig:
     """[observe] — the query flight recorder (pilosa_tpu.observe; no
     reference analog beyond ``cluster.long-query-time``).  ``enabled``
@@ -208,6 +230,7 @@ class Config:
     profile: ProfileConfig = field(default_factory=ProfileConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
     coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
+    ragged: RaggedConfig = field(default_factory=RaggedConfig)
     observe: ObserveConfig = field(default_factory=ObserveConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     cache: CacheConfig = field(default_factory=CacheConfig)
@@ -247,8 +270,9 @@ class Config:
         for k, v in d.items():
             key = k.replace("-", "_")
             if key in ("cluster", "anti_entropy", "metric", "tracing",
-                       "profile", "tls", "coalescer", "observe",
-                       "admission", "cache", "ingest") and isinstance(v, dict):
+                       "profile", "tls", "coalescer", "ragged",
+                       "observe", "admission", "cache",
+                       "ingest") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -262,6 +286,7 @@ class Config:
                                                         ProfileConfig,
                                                         TLSConfig,
                                                         CoalescerConfig,
+                                                        RaggedConfig,
                                                         ObserveConfig,
                                                         AdmissionConfig,
                                                         CacheConfig,
@@ -273,8 +298,8 @@ class Config:
         (the reference's PILOSA_* envs, cmd/root.go:94)."""
         for f in fields(self):
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
-                          "profile", "tls", "coalescer", "observe",
-                          "admission", "cache", "ingest"):
+                          "profile", "tls", "coalescer", "ragged",
+                          "observe", "admission", "cache", "ingest"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -330,6 +355,12 @@ class Config:
             f'enabled = "{self.coalescer.enabled}"',
             f"window-ms = {self.coalescer.window_ms}",
             f"max-batch = {self.coalescer.max_batch}",
+            "",
+            "[ragged]",
+            f"enabled = {str(self.ragged.enabled).lower()}",
+            f"max-tape = {self.ragged.max_tape}",
+            f"max-leaves = {self.ragged.max_leaves}",
+            f"prewarm = {str(self.ragged.prewarm).lower()}",
             "",
             "[observe]",
             f"enabled = {str(self.observe.enabled).lower()}",

@@ -292,6 +292,13 @@ class QueryRecord:
             c = self.coalesce
             d["coalescer"] = {
                 "batch": c["batch"],
+                # ragged-megabatch evidence (parallel/coalescer.py +
+                # ops/tape.py): how many DISTINCT tree shapes shared
+                # this query's flushed batch, and whether the
+                # tape-interpreter engine ran the launch (false =
+                # same-shape fast path / single-query passthrough)
+                "shapes": c.get("shapes", 1),
+                "tape": c.get("tape", False),
                 "queueWaitMs": round(c["queue_wait_ns"] / ms, 3),
                 "launchMs": round(c["launch_ns"] / ms, 3),
                 "leader": c.get("leader", True),

@@ -1,0 +1,443 @@
+"""Op-tape interpreter: one device launch for a batch of
+heterogeneous-shape expression trees.
+
+The fused compiler (ops/expr.py) erases leaf VALUES from a tree, so
+concurrent queries with the same STRUCTURE share one compiled program
+and one launch (parallel/coalescer.py).  Real mixed dashboard traffic
+is structurally diverse, though — many users, many distinct
+Count/Row trees — and BENCH_r05 shows the read path is
+dispatch-bound (1801 qps XLA against a ~20 us trivial-dispatch floor,
+bw_util 0.148), so each distinct shape paying its own launch is the
+single biggest qps-per-chip loss on diverse traffic (ROADMAP item 1).
+
+This module erases the STRUCTURE too.  Each tree compiles to a flat
+op-tape — an opcode stream over a register file, leaves pre-loaded
+into the low registers — and a *batch* of tapes pads to a small set of
+pow2 size buckets (tape length x leaf-slot count, mirroring the
+coalescer's pow2 batch padding).  One jitted program per bucket then
+executes the whole batch: ``lax.scan`` over tape steps, ``lax.switch``
+on the per-query opcode (under ``vmap`` the switch lowers to a select
+over the five bitwise ops — all cheap next to the register-file
+reads), each step writing its result register with
+``dynamic_update_slice``.  A Count root folds its popcount+reduce into
+the same program, exactly like the fused path.  This is the
+ragged-rows-in-one-kernel design of Ragged Paged Attention and
+DrJAX's batched map primitives (PAPERS.md), applied to expression
+trees instead of attention rows: each query's variable-depth tree is
+one ragged row of a single batched launch.
+
+Tape grammar (compiled from the ops/expr shape grammar):
+
+    opcodes   AND OR XOR ANDNOT COPY
+    operands  i >= 0  -> leaf slot i
+              i <  0  -> instruction ~i's output register
+    ``not``   -> ANDNOT(exist, child)
+    ``dfuse`` -> OR(ANDNOT(child, clear), set)   (two instructions)
+    ``shift`` is NOT tape-eligible (its distance is baked into the
+    compiled program, not an operand) — shift-carrying shapes fall
+    back to the per-shape fused path.
+
+Instruction ``t`` writes register ``n_slots + t``; buckets pad short
+tapes with COPYs of the final real register, so the LAST register
+always holds the result after the scan.  Pad leaf slots are zero
+stacks and pad batch rows are all-COPY tapes over them — never read
+by a real query's operands, never scattered back.
+
+Host stacks (single-CPU-device mode) interpret the tapes eagerly in
+numpy — dispatch is free there — and still tick ONE ``note_dispatch``
+for the whole batch, keeping launch accounting meaningful across
+engines.  Bit-exactness against ``ops/expr._host_tree`` /
+``_host_counts`` is pinned by tests/test_tape.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import namedtuple
+
+import numpy as np
+
+from pilosa_tpu.ops import bitmap as bm
+
+OP_AND, OP_OR, OP_XOR, OP_ANDNOT, OP_COPY = range(5)
+
+_FOLD_OPS = {"and": OP_AND, "or": OP_OR, "xor": OP_XOR,
+             "andnot": OP_ANDNOT}
+
+#: Smallest bucket edge for both axes: rounding tiny tapes up to 4
+#: wastes a few no-op COPY steps but collapses the (1, 2, 4) size
+#: classes into one — fewer lowered program variants AND better batch
+#: occupancy for shallow-tree traffic (a Count(Row) and a
+#: Count(Intersect(Row, Row)) share a launch).
+MIN_BUCKET = 4
+
+#: Default per-query caps (the ``[ragged]`` config): a tape longer
+#: than ``max-tape`` — or a tree with more leaves than ``max-leaves``
+#: — falls back to the per-shape fused path for that query alone.
+DEFAULT_MAX_TAPE = 32
+DEFAULT_MAX_LEAVES = 16
+
+
+class TapeError(ValueError):
+    """The shape cannot compile to a tape (unsupported node, bad leaf
+    ref, or over the configured length cap)."""
+
+
+#: One compiled tape: ``instrs`` is a tuple of (opcode, a, b) with the
+#: symbolic operand encoding above; ``n_leaves`` the number of leaf
+#: slots the operands reference.
+Tape = namedtuple("Tape", ("instrs", "n_leaves"))
+
+
+# ------------------------------------------------------------- compiler
+
+
+def compile_shape(shape, n_leaves: int, max_len: int | None = None) -> Tape:
+    """Compile one ops/expr shape into a Tape (post-order emission).
+    Raises TapeError on shift nodes (structurally ineligible), unknown
+    nodes, out-of-range leaf slots, or a tape longer than ``max_len``.
+    """
+    instrs: list[tuple[int, int, int]] = []
+
+    def emit(op: int, a: int, b: int) -> int:
+        instrs.append((op, a, b))
+        return ~(len(instrs) - 1)
+
+    def go(node) -> int:
+        kind = node[0]
+        if kind == "leaf":
+            slot = node[1]
+            if not 0 <= slot < n_leaves:
+                raise TapeError(f"leaf slot {slot} out of range")
+            return slot
+        if kind in _FOLD_OPS:
+            if len(node) < 2:
+                raise TapeError(f"{kind} needs at least one child")
+            op = _FOLD_OPS[kind]
+            ref = go(node[1])
+            for child in node[2:]:
+                ref = emit(op, ref, go(child))
+            return ref
+        if kind == "not":
+            # exist & ~child — one ANDNOT, same algebra as the fused
+            # engine (expr._build_jnp)
+            return emit(OP_ANDNOT, go(node[1]), go(node[2]))
+        if kind == "dfuse":
+            # (child & ~clear) | set — the streaming-ingest overlay
+            if len(node) != 4:
+                raise TapeError("dfuse needs (child, set, clear)")
+            child = go(node[1])
+            dset = go(node[2])
+            dclear = go(node[3])
+            return emit(OP_OR, emit(OP_ANDNOT, child, dclear), dset)
+        if kind == "shift":
+            raise TapeError("shift is not tape-eligible")
+        raise TapeError(f"unknown expression node: {kind!r}")
+
+    root = go(shape)
+    if root >= 0:
+        # pure-leaf (or single-child fold) root: materialize it into a
+        # register so the result always lives in the last one
+        root = emit(OP_COPY, root, 0)
+    if max_len is not None and len(instrs) > max_len:
+        raise TapeError(
+            f"tape length {len(instrs)} exceeds cap {max_len}")
+    return Tape(tuple(instrs), n_leaves)
+
+
+def try_compile(shape, n_leaves: int,
+                max_len: int | None = None) -> Tape | None:
+    """``compile_shape`` that reports ineligibility via counters
+    instead of raising — the coalescer's per-query fallback gate."""
+    try:
+        return compile_shape(shape, n_leaves, max_len)
+    except TapeError as e:
+        bump("tape.oversize_fallbacks" if "exceeds cap" in str(e)
+             else "tape.unsupported")
+        return None
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def size_class(n_instrs: int, n_leaves: int) -> tuple[int, int]:
+    """The (tape_len, leaf_slots) bucket a tape pads into: pow2 on
+    both axes with a MIN_BUCKET floor.  Lowered-variant count stays
+    O(log(max_tape) * log(max_leaves)) while heterogeneous shapes of
+    similar size share one launch."""
+    return (max(MIN_BUCKET, _pow2(max(1, n_instrs))),
+            max(MIN_BUCKET, _pow2(max(1, n_leaves))))
+
+
+# ------------------------------------------------------------- counters
+
+_lock = threading.Lock()
+_counters = {
+    "tape.executions": 0,         # interpreter launches (device or host)
+    "tape.queries": 0,            # queries served through those launches
+    "tape.oversize_fallbacks": 0,  # per-query cap fallbacks to fused path
+    "tape.unsupported": 0,        # structurally ineligible (shift) shapes
+    "tape.prewarmed": 0,          # bucket programs lowered at server start
+    "coalescer.shape_misses": 0,  # eligible queries with no same-shape
+                                  # partner in their flushed batch
+    "coalescer.shape_flushes": 0,  # flushes carrying >1 distinct shape
+}
+#: (counts, B, tape_len, slots, *stack_shape) combos the interpreter
+#: has lowered — the /debug/ragged program inventory.
+_lowered: set[tuple] = set()
+
+
+def bump(name: str, value: int = 1) -> None:
+    with _lock:
+        _counters[name] += value
+
+
+def counters() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    """Zero the module counters and the lowered-program inventory
+    (tests)."""
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+        _lowered.clear()
+
+
+def publish_gauges(stats) -> None:
+    """Push the tape.* / coalescer.shape_* families into a stats
+    registry at scrape time — cumulative values as gauges, same rule
+    as resultcache/devobs publish_gauges (re-publishing a cumulative
+    total through a counter would double-count)."""
+    for name, value in counters().items():
+        stats.gauge(name, value)
+
+
+def debug() -> dict:
+    """The /debug/ragged document body: counters plus the interpreter
+    program inventory (which bucket variants this process has
+    lowered)."""
+    with _lock:
+        progs = [{"counts": c, "batch": b, "tapeLen": t, "slots": s,
+                  "stack": list(shape)}
+                 for (c, b, t, s, *shape) in sorted(_lowered)]
+        return {"counters": dict(_counters), "programs": progs}
+
+
+# ------------------------------------------------------------ interpreter
+
+
+def _abs_operand(ref: int, n_slots: int) -> int:
+    """Symbolic operand -> absolute register index in a bucket with
+    ``n_slots`` leaf registers."""
+    return ref if ref >= 0 else n_slots + ~ref
+
+
+_programs: dict[bool, object] = {}
+
+
+def _program(counts: bool):
+    """The ONE vmapped scan/switch interpreter per root kind, jitted —
+    jax re-lowers it per (batch, tape_len, slots, stack) input shape,
+    which is exactly the bucket structure; the Python closure is
+    shared.  devobs-instrumented so first lowerings surface on
+    /debug/devices and ride the paying query's flight record."""
+    prog = _programs.get(counts)
+    if prog is not None:
+        return prog
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def one(tape_q, leaves_q):
+        n_slots = leaves_q.shape[0]
+        tape_len = tape_q.shape[0]
+        regs0 = jnp.concatenate(
+            [leaves_q,
+             jnp.zeros((tape_len,) + leaves_q.shape[1:],
+                       leaves_q.dtype)])
+
+        def step(regs, xs):
+            instr, t = xs
+            xa = regs[instr[1]]
+            xb = regs[instr[2]]
+            out = lax.switch(instr[0], (
+                lambda a, b: jnp.bitwise_and(a, b),
+                lambda a, b: jnp.bitwise_or(a, b),
+                lambda a, b: jnp.bitwise_xor(a, b),
+                lambda a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
+                lambda a, b: a,
+            ), xa, xb)
+            regs = lax.dynamic_update_slice(
+                regs, out[None], (n_slots + t,) + (0,) * out.ndim)
+            return regs, None
+
+        regs, _ = lax.scan(step, regs0,
+                           (tape_q, jnp.arange(tape_len)))
+        res = regs[-1]
+        if counts:
+            return jnp.sum(lax.population_count(res), axis=-1,
+                           dtype=jnp.int32)
+        return res
+
+    from pilosa_tpu import devobs
+
+    name = "tape.interpret_counts" if counts else "tape.interpret"
+    prog = devobs.instrument(name, jax.jit(jax.vmap(one)))
+    _programs[counts] = prog
+    return prog
+
+
+def _host_exec(tp: Tape, leaves: tuple, counts: bool):
+    """Eager numpy interpretation of one tape (host-mode engine)."""
+    outs: list[np.ndarray] = []
+
+    def operand(ref: int) -> np.ndarray:
+        return leaves[ref] if ref >= 0 else outs[~ref]
+
+    for op, a, b in tp.instrs:
+        xa = operand(a)
+        if op == OP_COPY:
+            outs.append(xa)
+            continue
+        xb = operand(b)
+        if op == OP_AND:
+            outs.append(np.bitwise_and(xa, xb))
+        elif op == OP_OR:
+            outs.append(np.bitwise_or(xa, xb))
+        elif op == OP_XOR:
+            outs.append(np.bitwise_xor(xa, xb))
+        else:
+            outs.append(np.bitwise_and(xa, np.bitwise_not(xb)))
+    res = outs[-1]
+    if counts:
+        from pilosa_tpu.ops import hostkernels as hk
+
+        lead = res.shape[:-1]
+        return hk.row_counts(
+            res.reshape(-1, res.shape[-1])).reshape(lead)
+    return res
+
+
+def execute(batch, counts: bool = False,
+            tape_len: int | None = None,
+            slots: int | None = None) -> list:
+    """Execute a batch of (Tape, leaves) pairs in ONE launch.
+
+    Every query's leaf stacks must share one array shape (the
+    coalescer's bucket key guarantees it).  ``tape_len``/``slots`` pin
+    the bucket the batch pads into (defaults: the batch's own pow2
+    size class).  Returns one result per query, in order — the bitmap
+    stack, or int32 per-row popcounts with ``counts=True``.  Pad rows
+    (batch pow2, slot and tape padding) are never returned.
+    """
+    if not batch:
+        return []
+    tb, lb = size_class(max(len(t.instrs) for t, _ in batch),
+                        max(t.n_leaves for t, _ in batch))
+    tape_len = tape_len or tb
+    slots = slots or lb
+    for tp, ls in batch:
+        if len(tp.instrs) > tape_len or len(ls) > slots:
+            raise TapeError("tape exceeds its bucket")
+    n = len(batch)
+    bm.note_dispatch("tape")
+    bump("tape.executions")
+    bump("tape.queries", n)
+    if all(isinstance(lv, np.ndarray) for _, ls in batch for lv in ls):
+        return [_host_exec(tp, ls, counts) for tp, ls in batch]
+
+    import jax.numpy as jnp
+
+    first = batch[0][1][0]
+    stack_shape = tuple(first.shape)
+    zero = jnp.zeros(stack_shape, first.dtype)
+    # batch pads to the next power of two, like the coalescer's device
+    # batches: the jitted interpreter re-lowers per input shape, and
+    # free-running occupancies would each pay a fresh XLA compile in
+    # the serving path
+    b_pad = _pow2(n)
+    tape_rows = np.zeros((b_pad, tape_len, 3), dtype=np.int32)
+    tape_rows[:, :, 0] = OP_COPY  # pad rows: COPY of leaf slot 0
+    leaf_rows = []
+    pad_leaves = None
+    for qi in range(b_pad):
+        if qi >= n:
+            if pad_leaves is None:
+                pad_leaves = jnp.stack([zero] * slots)
+            leaf_rows.append(pad_leaves)
+            continue
+        tp, ls = batch[qi]
+        for ti, (op, a, b) in enumerate(tp.instrs):
+            tape_rows[qi, ti] = (op, _abs_operand(a, slots),
+                                 _abs_operand(b, slots))
+        final = slots + len(tp.instrs) - 1
+        # short tapes chain COPYs of the final real register forward,
+        # so the LAST register holds the result after the full scan
+        tape_rows[qi, len(tp.instrs):, 1] = final
+        leaf_rows.append(jnp.stack(
+            list(ls) + [zero] * (slots - len(ls))))
+    leaves_arr = jnp.stack(leaf_rows)
+    with _lock:
+        _lowered.add((counts, b_pad, tape_len, slots) + stack_shape)
+    out = _program(counts)(jnp.asarray(tape_rows), leaves_arr)
+    return [out[i] for i in range(n)]
+
+
+# --------------------------------------------------------------- prewarm
+
+
+def prewarm(stack_shape: tuple[int, ...], max_batch: int,
+            max_tape: int, max_leaves: int,
+            counts: bool = True) -> int:
+    """Lower the bucket programs a serving process will hit first.
+    Flushes pad the BATCH axis to pow2(occupancy), so a window
+    sealing at 5 queries dispatches a b=8 program — warming only the
+    full batch width would leave every partially-filled first window
+    paying a serving-path XLA compile (the convoy the pow2 padding
+    exists to kill).  So: the smallest size class (where shallow-tree
+    traffic lands) warms across the whole pow2 batch ladder
+    2..pow2(max_batch), and the largest class (the configured caps,
+    the worst single compile) warms at full width.  Called from
+    server open on a background thread; best-effort, and a no-op on
+    CPU backends — host mode runs the numpy engine (nothing to
+    lower), and a multi-CPU-device process lowers cheaply on first
+    use while the warm-up's register file (batch x (slots + tape) x
+    stack words) would transiently cost real host memory.  Returns
+    the number of programs warmed."""
+    import jax
+
+    if bm.host_mode() or jax.devices()[0].platform == "cpu":
+        return 0
+    import jax.numpy as jnp
+
+    b_full = max(2, _pow2(max_batch))
+    small = size_class(1, 1)
+    large = size_class(max_tape, max_leaves)
+    jobs: list[tuple[int, int, int]] = []
+    b = 2
+    while b <= b_full:
+        jobs.append((b,) + small)
+        b <<= 1
+    if large != small:
+        jobs.append((b_full,) + large)
+    warmed = 0
+    for b, tape_len, slots in jobs:
+        tape_rows = np.zeros((b, tape_len, 3), dtype=np.int32)
+        tape_rows[:, :, 0] = OP_COPY
+        leaves = jnp.zeros((b, slots) + tuple(stack_shape),
+                           dtype=jnp.uint32)
+        out = _program(counts)(jnp.asarray(tape_rows), leaves)
+        jax.block_until_ready(out)
+        with _lock:
+            _lowered.add((counts, b, tape_len, slots)
+                         + tuple(stack_shape))
+        warmed += 1
+    bump("tape.prewarmed", warmed)
+    return warmed

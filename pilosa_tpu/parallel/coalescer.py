@@ -14,25 +14,39 @@ Mechanics
 ---------
 Fused-eligible `Count(tree)` queries stage their operands on the calling
 thread (`Executor._fused_expr`: canonical tree SHAPE + leaf stacks),
-then meet in a bucket keyed by ``(index, shape, shards)``.  The first
-arrival becomes the bucket's LEADER and waits up to ``window_s`` for
-followers; hitting ``max_batch`` seals the bucket early.  The leader
-stacks each leaf slot across the batch ([B, S, W]), runs ops.expr's
-compiled program ONCE (the count root reduces inside the same program),
-and scatters the per-query count rows back to every waiter's future.
-Same ops, same integer arithmetic — results are bit-exact against the
-unbatched path; a batch of one takes the identical single-query program
-(passthrough).
+then meet in a bucket.  The first arrival becomes the bucket's LEADER
+and waits up to ``window_s`` for followers; hitting ``max_batch`` seals
+the bucket early.  The leader runs ONE launch for the sealed bucket and
+scatters the per-query count rows back to every waiter's future.
 
-Keyed on shape, not query text: ``Count(Intersect(Row(f=3), Row(f=9)))``
-and ``Count(Intersect(Row(f=7), Row(f=2)))`` coalesce (distinct leaf
-VALUES, one compiled program); only structurally different trees (or
-different shard sets) dispatch separately.
+Bucketing is two-tier:
+
+- **Ragged (default)**: the query's tree compiles to an op-tape
+  (ops/tape.py) and the bucket keys on the tape's SIZE CLASS (pow2
+  tape length x pow2 leaf slots) plus the leaf stack shape — so
+  STRUCTURALLY DIFFERENT trees share a window and a launch, the fix
+  for mixed dashboard traffic that mostly missed the same-shape
+  window and paid per-query dispatch.  At flush, a bucket whose live
+  members all share one exact shape takes the same-shape fast path
+  below (the specialized fused program, zero interpreter overhead);
+  a heterogeneous bucket executes as one tape-interpreter launch.
+- **Per-shape fallback**: with ``[ragged]`` disabled — or for a query
+  whose tape exceeds the configured caps (``max-tape``/``max-leaves``)
+  or carries a structurally ineligible node (Shift) — the bucket keys
+  on ``(index, shape, shards)`` exactly as before, merging only
+  identical-shape queries through the fused program.  The ragged
+  engine can therefore be disabled in production with no behavior
+  change (regression-pinned in tests/test_tape.py).
+
+Same ops, same integer arithmetic on both paths — results are
+bit-exact against the unbatched path; a batch of one takes the
+identical single-query program (passthrough).
 
 Enablement: OFF in host mode (single CPU device — dispatch is a Python
 call there, batching buys nothing and the window would only add
 latency); ON by default when an accelerator is attached.  The server
-knobs live under ``[coalescer]`` (docs/configuration.md).
+knobs live under ``[coalescer]`` and ``[ragged]``
+(docs/configuration.md).
 """
 
 from __future__ import annotations
@@ -46,6 +60,7 @@ import numpy as np
 from pilosa_tpu import observe as _observe
 from pilosa_tpu import stats as _stats
 from pilosa_tpu import tracing
+from pilosa_tpu.ops import tape as _tape
 from pilosa_tpu.serve.deadline import DeadlineExceededError
 
 
@@ -71,20 +86,38 @@ def resolve_enabled(mode) -> bool:
 
 class _Bucket:
     __slots__ = ("items", "full", "sealed",
-                 "n_final", "flush_t0", "launch_ns")
+                 "n_final", "shapes_final", "tape_final",
+                 "flush_t0", "launch_ns")
 
     def __init__(self):
-        # (leaves, future, deadline-or-None) per enqueued query
-        self.items: list[tuple] = []
+        # _Entry per enqueued query
+        self.items: list[_Entry] = []
         self.full = threading.Event()
         self.sealed = False
         # flight-recorder breakdown, written by the leader BEFORE the
         # futures resolve (so every waiter may read them after
-        # fut.result() without a lock): final batch occupancy, flush
-        # start (perf_counter_ns), and device-launch duration
+        # fut.result() without a lock): final batch occupancy, distinct
+        # shape count, whether the tape interpreter ran, flush start
+        # (perf_counter_ns), and device-launch duration
         self.n_final = 0
+        self.shapes_final = 0
+        self.tape_final = False
         self.flush_t0 = 0
         self.launch_ns = 0
+
+
+class _Entry:
+    """One staged query waiting in a bucket.  ``tape`` is None on the
+    per-shape fallback path (ragged off / oversize / Shift)."""
+
+    __slots__ = ("shape", "leaves", "tape", "fut", "deadline")
+
+    def __init__(self, shape, leaves, tape, fut, deadline):
+        self.shape = shape
+        self.leaves = leaves
+        self.tape = tape
+        self.fut = fut
+        self.deadline = deadline
 
 
 class Coalescer:
@@ -92,13 +125,25 @@ class Coalescer:
     ``window_s`` beyond their own execution time."""
 
     def __init__(self, window_s: float = 0.002, max_batch: int = 32,
-                 enabled="auto", stats=None):
+                 enabled="auto", stats=None, ragged: bool = True,
+                 max_tape: int = _tape.DEFAULT_MAX_TAPE,
+                 max_leaves: int = _tape.DEFAULT_MAX_LEAVES):
         self.window_s = window_s
         self.max_batch = max_batch
         self.enabled = resolve_enabled(enabled)
+        self.ragged = bool(ragged)
+        self.max_tape = max_tape
+        self.max_leaves = max_leaves
         self.stats = stats if stats is not None else _stats.NOP
         self._lock = threading.Lock()
         self._pending: dict[tuple, _Bucket] = {}
+        # (shape, n_leaves) -> (Tape|None, fallback-counter-name|None):
+        # shapes are canonical/hashable and few, so compile each once
+        # instead of re-walking the tree (and re-raising TapeError for
+        # Shift shapes) on every staged query of the serving hot path.
+        # Unlocked by design: a racing duplicate compile is wasted
+        # work, never a wrong entry; cleared wholesale on overflow.
+        self._tape_memo: dict[tuple, tuple] = {}
 
     # ------------------------------------------------------------- entry
 
@@ -112,6 +157,46 @@ class Coalescer:
             return False
         dl = None if opt is None else getattr(opt, "deadline", None)
         return dl is None or dl.remaining() > 2 * self.window_s
+
+    def _tape_for(self, shape, n_leaves):
+        """Memoized compile: Tape within the caps, or None (with the
+        per-QUERY fallback counter bumped — the memo dedupes the tree
+        walk, never the accounting)."""
+        mkey = (shape, n_leaves)
+        hit = self._tape_memo.get(mkey)
+        if hit is None:
+            try:
+                tp = _tape.compile_shape(shape, n_leaves,
+                                         self.max_tape)
+                reason = None
+                if n_leaves > self.max_leaves:
+                    tp, reason = None, "tape.oversize_fallbacks"
+            except _tape.TapeError as e:
+                tp = None
+                reason = ("tape.oversize_fallbacks"
+                          if "exceeds cap" in str(e)
+                          else "tape.unsupported")
+            if len(self._tape_memo) >= 4096:
+                self._tape_memo.clear()
+            self._tape_memo[mkey] = hit = (tp, reason)
+        tp, reason = hit
+        if reason is not None:
+            _tape.bump(reason)
+        return tp
+
+    def _bucket_key(self, idx, shape, shards, leaves):
+        """(key, tape) for one staged query.  Ragged: tape compiles
+        within the caps -> key on the size class + leaf stack shape,
+        so heterogeneous trees of similar size meet in one bucket
+        (distinct indexes included — the launch is index-agnostic;
+        each waiter folds its own result).  Fallback: the exact
+        per-shape key, the pre-ragged behavior."""
+        if self.ragged:
+            tp = self._tape_for(shape, len(leaves))
+            if tp is not None:
+                tb, lb = _tape.size_class(len(tp.instrs), len(leaves))
+                return ("ragged", tuple(leaves[0].shape), tb, lb), tp
+        return (idx.name, shape, shards), None
 
     def count(self, executor, idx, child, shards: tuple[int, ...],
               deadline=None, cache_fill=None,
@@ -130,16 +215,17 @@ class Coalescer:
         and never fill.
 
         ``use_delta=False`` is the ?nodelta=1 escape, forwarded to
-        staging.  The bucket key stays delta-aware for free: a pending
-        ingest delta puts ``dfuse`` nodes in the canonical SHAPE, so a
-        delta-carrying query can only batch with queries fusing the
-        same overlay structure — and a ?nodelta=1 query (which compacts
-        up front and stages plain leaves) with a delta-reading one only
-        when no delta is pending, where the programs are identical."""
+        staging.  Bucket keys stay delta-aware for free: a pending
+        ingest delta puts ``dfuse`` nodes in the canonical SHAPE —
+        which the tape compiler lowers to two extra instructions, so a
+        delta-carrying query lands in the size class its overlay
+        actually costs — and a ?nodelta=1 query (which compacts up
+        front and stages plain leaves) batches with a delta-reading
+        one only when the programs are identical anyway."""
         shape, leaves = executor._fused_expr(idx, child, shards,
                                              use_delta=use_delta)
-        key = (idx.name, shape, shards)
-        fut: Future = Future()
+        key, tp = self._bucket_key(idx, shape, shards, leaves)
+        entry = _Entry(shape, leaves, tp, Future(), deadline)
         t0 = time.perf_counter_ns()
         with self._lock:
             bucket = self._pending.get(key)
@@ -147,7 +233,7 @@ class Coalescer:
             if leader:
                 bucket = _Bucket()
                 self._pending[key] = bucket
-            bucket.items.append((leaves, fut, deadline))
+            bucket.items.append(entry)
             if len(bucket.items) >= self.max_batch:
                 bucket.sealed = True
                 del self._pending[key]
@@ -158,8 +244,8 @@ class Coalescer:
                 if not bucket.sealed:
                     bucket.sealed = True
                     del self._pending[key]
-            self._flush(shape, bucket)
-        counts = fut.result()
+            self._flush(bucket)
+        counts = entry.fut.result()
         self.stats.timing("coalescer.query_ns",
                           time.perf_counter_ns() - t0)
         rec = _observe.current()
@@ -174,6 +260,8 @@ class Coalescer:
             rec.note_path("coalesced")
             rec.coalesce = {
                 "batch": bucket.n_final,
+                "shapes": bucket.shapes_final,
+                "tape": bucket.tape_final,
                 "queue_wait_ns": max(0, bucket.flush_t0 - t0),
                 "launch_ns": bucket.launch_ns,
                 "leader": leader,
@@ -188,7 +276,7 @@ class Coalescer:
 
     # ------------------------------------------------------------- flush
 
-    def _flush(self, shape, bucket: _Bucket) -> None:
+    def _flush(self, bucket: _Bucket) -> None:
         """Leader-side: ONE launch for the sealed bucket, results
         scattered to every waiter.  Appends are impossible once sealed
         (sealing happens under the same lock that guards appends).
@@ -200,17 +288,21 @@ class Coalescer:
         # their futures resolve to DeadlineExceededError, and their
         # batchmates' results are unaffected (the stack simply omits
         # the expired rows)
-        live: list[tuple] = []
-        expired: list = []
+        live: list[_Entry] = []
+        expired: list[_Entry] = []
         for it in bucket.items:
-            dl = it[2]
+            dl = it.deadline
             (expired if dl is not None and dl.expired()
              else live).append(it)
         for it in expired:
-            it[1].set_exception(DeadlineExceededError(
+            it.fut.set_exception(DeadlineExceededError(
                 "deadline expired in the coalescer window"))
         n = len(live)
         bucket.n_final = n
+        shape_groups: dict = {}
+        for it in live:
+            shape_groups[it.shape] = shape_groups.get(it.shape, 0) + 1
+        bucket.shapes_final = len(shape_groups)
         bucket.flush_t0 = time.perf_counter_ns()
         if expired:
             try:
@@ -223,20 +315,46 @@ class Coalescer:
         try:
             from pilosa_tpu.ops import expr
 
+            # heterogeneity accounting (the before/after evidence for
+            # the ragged engine): a query whose flushed batch held no
+            # same-shape partner is a shape MISS — with ragged off it
+            # flushed alone; with ragged on it still shared the launch,
+            # and the counter measures how much structural diversity
+            # the traffic carries either way
+            misses = sum(1 for c in shape_groups.values() if c == 1)
+            if misses:
+                # cumulative module counter, exposed as a gauge at
+                # scrape time (tape.publish_gauges) — never ALSO
+                # pushed as a count, which would double-count (the
+                # ingest.*/cache.* family rule)
+                _tape.bump("coalescer.shape_misses", misses)
+            if bucket.shapes_final > 1:
+                _tape.bump("coalescer.shape_flushes")
             self.stats.count("coalescer.dispatches", 1)
             self.stats.histogram("coalescer.batch_occupancy", n)
+            self.stats.histogram("coalescer.shape_distinct",
+                                 bucket.shapes_final)
             with tracing.start_span("coalescer.flush") as span:
                 span.set_tag("batch", n)
+                span.set_tag("shapes", bucket.shapes_final)
                 t_launch = time.perf_counter_ns()
                 if n == 1:
                     # single-query passthrough: the identical program
                     # the un-coalesced path would run
-                    results = [expr.evaluate(shape, live[0][0],
+                    results = [expr.evaluate(live[0].shape,
+                                             live[0].leaves,
                                              counts=True)]
-                else:
+                elif bucket.shapes_final == 1:
+                    # same-shape fast path: the specialized fused
+                    # program over stacked operands, exactly the
+                    # pre-ragged engine (and what a ragged bucket that
+                    # happened to fill homogeneously should run — the
+                    # interpreter buys nothing over a specialized
+                    # program)
+                    shape = live[0].shape
                     stacked = tuple(
-                        _stack([it[0][j] for it in live])
-                        for j in range(len(live[0][0])))
+                        _stack([it.leaves[j] for it in live])
+                        for j in range(len(live[0].leaves)))
                     # device batches pad to the next power of two: the
                     # jitted program re-lowers per INPUT shape, so
                     # free-running occupancies (2, 3, 5, ...) each pay
@@ -255,15 +373,29 @@ class Coalescer:
                         expr.evaluate(shape, stacked, counts=True),
                         dtype=np.int64)
                     results = [counts[b] for b in range(n)]
+                else:
+                    # heterogeneous bucket: the whole ragged batch as
+                    # ONE tape-interpreter launch (ops/tape.py); the
+                    # bucket key guarantees every member's tape fits
+                    # the (tape_len, slots) size class and every leaf
+                    # stack shares one shape
+                    bucket.tape_final = True
+                    span.set_tag("tape", True)
+                    tb, lb = _tape.size_class(
+                        max(len(it.tape.instrs) for it in live),
+                        max(it.tape.n_leaves for it in live))
+                    results = _tape.execute(
+                        [(it.tape, it.leaves) for it in live],
+                        counts=True, tape_len=tb, slots=lb)
                 bucket.launch_ns = time.perf_counter_ns() - t_launch
                 self.stats.timing("coalescer.launch_ns",
                                   bucket.launch_ns)
         except BaseException as e:  # noqa: BLE001 — every waiter fails
             for it in live:
-                it[1].set_exception(e)
+                it.fut.set_exception(e)
             return
         for it, row in zip(live, results):
-            it[1].set_result(row)
+            it.fut.set_result(row)
 
 
 def _stack(arrs: list):

@@ -960,12 +960,14 @@ class Handler:
             # from the [observe] device-sample-interval loop)
             from pilosa_tpu import devobs
             from pilosa_tpu.ingest import compactor
+            from pilosa_tpu.ops import tape
             from pilosa_tpu.runtime import resultcache
 
             try:
                 devobs.observer().publish_gauges(self.stats)
                 resultcache.cache().publish_gauges(self.stats)
                 compactor.compactor().publish_gauges(self.stats)
+                tape.publish_gauges(self.stats)
             except Exception:  # noqa: BLE001 — telemetry never fails a scrape
                 pass
             text = self.stats.prometheus_text(exemplars=exemplars)
@@ -1172,6 +1174,31 @@ class Handler:
 
         self._json(req, compactor.compactor().debug())
 
+    @route("GET", "/debug/ragged")
+    def handle_debug_ragged(self, req, params, path, body):
+        """Ragged megabatch state (ops/tape.py +
+        parallel/coalescer.py): the [ragged] config in force on this
+        node's coalescer, the tape.* / coalescer.shape_* counters
+        (executions, queries served, per-query fallbacks, shape
+        misses), and the interpreter program inventory — which
+        (batch, tape-length, leaf-slot, stack-shape) bucket variants
+        this process has lowered."""
+        from pilosa_tpu.ops import tape
+
+        out = tape.debug()
+        co = getattr(self.api.executor, "coalescer", None)
+        out["coalescer"] = {"attached": co is not None}
+        if co is not None:
+            out["coalescer"].update({
+                "enabled": co.enabled,
+                "ragged": co.ragged,
+                "maxTape": co.max_tape,
+                "maxLeaves": co.max_leaves,
+                "windowMs": co.window_s * 1e3,
+                "maxBatch": co.max_batch,
+            })
+        self._json(req, out)
+
     @route("GET", "/debug/devices")
     def handle_debug_devices(self, req, params, path, body):
         """Device-runtime telemetry (pilosa_tpu.devobs): per-kernel /
@@ -1301,12 +1328,14 @@ class Handler:
         if self.stats is not None and hasattr(self.stats, "snapshot"):
             from pilosa_tpu import devobs
             from pilosa_tpu.ingest import compactor
+            from pilosa_tpu.ops import tape
             from pilosa_tpu.runtime import resultcache
 
             try:
                 devobs.observer().publish_gauges(self.stats)
                 resultcache.cache().publish_gauges(self.stats)
                 compactor.compactor().publish_gauges(self.stats)
+                tape.publish_gauges(self.stats)
             except Exception:  # noqa: BLE001
                 pass
             snap = self.stats.snapshot()

@@ -55,6 +55,10 @@ class Server:
         coalescer_enabled="auto",
         coalescer_window_ms: float = 2.0,
         coalescer_max_batch: int = 32,
+        ragged_enabled: bool = True,
+        ragged_max_tape: int = 32,
+        ragged_max_leaves: int = 16,
+        ragged_prewarm: bool = True,
         observe_enabled: bool = True,
         observe_recent: int = 256,
         observe_long_query_time: float = 0.0,
@@ -129,7 +133,11 @@ class Server:
             max_batch=coalescer_max_batch,
             enabled=coalescer_enabled,
             stats=self.stats,
+            ragged=ragged_enabled,
+            max_tape=ragged_max_tape,
+            max_leaves=ragged_max_leaves,
         )
+        self._ragged_prewarm = ragged_prewarm
         # query flight recorder ([observe] config): /debug/queries,
         # ?profile=1, slow-query log, pilosa_query_latency histogram
         from pilosa_tpu import observe as _observe
@@ -276,6 +284,40 @@ class Server:
             t.start()
         self.runtime_monitor.start()
         self.device_sampler.start()
+        if self._ragged_prewarm:
+            # lower the ragged bucket interpreter programs off the
+            # serving path ([ragged] prewarm): best-effort, background,
+            # a no-op in host mode or with the coalescer/ragged off
+            t = threading.Thread(target=self._prewarm_ragged,
+                                 daemon=True, name="ragged-prewarm")
+            t.start()
+
+    def _prewarm_ragged(self) -> None:
+        from pilosa_tpu.ops import bitmap as bm
+        from pilosa_tpu.ops import tape as _tape
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        co = self.node.executor.coalescer
+        if co is None or not (co.enabled and co.ragged) or bm.host_mode():
+            return
+        try:
+            from pilosa_tpu.models.field import _padded_rows
+
+            # the leaf stack shape every fused read stages: the widest
+            # index's shard fan-out (device-padded), SHARD_WIDTH words.
+            # An empty holder warms a nominal 1-shard stack — the
+            # program structure still lowers; a different shard count
+            # later re-specializes only the cheap outer shapes.
+            n_shards = max(
+                [len(idx.available_shards())
+                 for idx in self.holder.indexes.values()] or [1])
+            stack = (_padded_rows(max(1, n_shards)),
+                     bm.n_words(SHARD_WIDTH))
+            _tape.prewarm(stack, co.max_batch, co.max_tape,
+                          co.max_leaves)
+        except Exception as e:  # noqa: BLE001 — prewarm must never
+            # break serving; the first ragged window pays the compile
+            self.logger.printf("ragged prewarm skipped: %r", e)
     def _join_via_seeds(self) -> None:
         client = self._client
         me = self.cluster.local_node.to_dict()

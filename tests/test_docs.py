@@ -62,8 +62,8 @@ def test_every_config_key_documented():
     text = open(os.path.join(DOCS, "configuration.md")).read()
     missing = []
     sections = ("cluster", "anti_entropy", "metric", "tracing",
-                "profile", "tls", "coalescer", "observe", "admission",
-                "cache")
+                "profile", "tls", "coalescer", "ragged", "observe",
+                "admission", "cache", "ingest")
     for f in fields(cfgmod.Config):
         if f.name in sections:
             section = f.name

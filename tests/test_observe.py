@@ -493,7 +493,8 @@ class TestCoalescerObservability:
         assert all(b >= 1 for b in batches)
         d = recs[-1].to_dict()
         assert set(d["coalescer"]) == {
-            "batch", "queueWaitMs", "launchMs", "leader"}
+            "batch", "shapes", "tape", "queueWaitMs", "launchMs",
+            "leader"}
         assert d["coalescer"]["queueWaitMs"] >= 0
         # exactly one record per flush owns the shared launch
         assert sum(1 for r in recs if r.coalesce["leader"]) >= 1

@@ -247,8 +247,15 @@ CACHE_FAMILIES = ("cache_",)
 #: compact_skipped} rendered as ingest_*.
 INGEST_FAMILIES = ("ingest_",)
 
+#: Ragged-megabatch families (ops/tape.publish_gauges):
+#: tape.{executions,queries,oversize_fallbacks,unsupported,prewarmed}
+#: rendered as tape_*, and the coalescer heterogeneity accounting
+#: coalescer.shape_{misses,flushes} rendered as coalescer_shape_*.
+TAPE_FAMILIES = ("tape_", "coalescer_shape_")
+
 #: Everything the ``--families`` CLI mode requires of a live server.
-ALL_FAMILIES = DEVICE_FAMILIES + CACHE_FAMILIES + INGEST_FAMILIES
+ALL_FAMILIES = (DEVICE_FAMILIES + CACHE_FAMILIES + INGEST_FAMILIES
+                + TAPE_FAMILIES)
 
 
 def check_families(text: str, prefixes=DEVICE_FAMILIES) -> dict[str, int]:

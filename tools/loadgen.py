@@ -168,17 +168,82 @@ def _cache_counters(host: str) -> tuple[int, int] | None:
         return None
 
 
+def _vars_counter(host: str, name: str) -> float | None:
+    """One counter from the server's /debug/vars snapshot, or None —
+    like the cache counters, consumers report the DELTA over the run
+    window."""
+    try:
+        with urllib.request.urlopen(f"{host}/debug/vars",
+                                    timeout=5) as resp:
+            d = json.loads(resp.read())
+        # absent means "this server never ticked the counter" (e.g.
+        # coalescer off) — report None like the other unavailable
+        # metrics, NOT 0.0, which would read as perfect batching
+        v = d.get(name)
+        return float(v) if isinstance(v, (int, float)) else None
+    except Exception:
+        return None
+
+
+def shape_mix_queries(n: int, field: str = "f", rows: int = 6,
+                      seed: int = 7) -> list[str]:
+    """``n`` structurally DISTINCT fused-eligible Count trees over
+    ``field`` — the mixed-dashboard-traffic analog the ragged
+    megabatch engine exists for.  Structures enumerate in increasing
+    size (single row, binary ops, 3-wide folds, nested pairs, nested
+    triples) so a realistic mix spans several tree depths; leaf row
+    ids draw from ``rows`` deterministically per ``seed`` so repeat
+    runs issue identical traffic."""
+    rng = random.Random(seed)
+    ops = ["Intersect", "Union", "Difference", "Xor"]
+
+    def leaf() -> str:
+        return f"Row({field}={rng.randrange(rows)})"
+
+    structures: list = [("leaf",)]
+    structures += [("op", o) for o in ops]            # op(l, l)
+    structures += [("flat3", o) for o in ops]         # op(l, l, l)
+    structures += [("nest", o, i) for o in ops for i in ops]
+    structures += [("nest3", o, i) for o in ops for i in ops]
+    out = []
+    for kind in structures[:n]:
+        if kind[0] == "leaf":
+            tree = leaf()
+        elif kind[0] == "op":
+            tree = f"{kind[1]}({leaf()}, {leaf()})"
+        elif kind[0] == "flat3":
+            tree = f"{kind[1]}({leaf()}, {leaf()}, {leaf()})"
+        elif kind[0] == "nest":
+            tree = f"{kind[1]}({kind[2]}({leaf()}, {leaf()}), {leaf()})"
+        else:
+            tree = (f"{kind[1]}({kind[2]}({leaf()}, {leaf()}), "
+                    f"{leaf()}, {leaf()})")
+        out.append(f"Count({tree})")
+    if len(out) < n:
+        raise ValueError(
+            f"shape-mix supports at most {len(structures)} distinct "
+            f"shapes, asked for {n}")
+    return out
+
+
 def run_load(host: str, index: str, qps: float, seconds: float,
              query: str = "Count(Row(f=1))",
              mix: dict[str, float] | None = None,
              deadline_s: tuple[float, float] | None = None,
              timeout: float = 10.0, pool: int = 32,
              ingest_field: str = "loadgen", ingest_bits: int = 1,
-             ingest_rows: int = 8, ingest_cols: int = 1 << 20) -> dict:
+             ingest_rows: int = 8, ingest_cols: int = 1 << 20,
+             shape_mix: int = 0, shape_field: str | None = None,
+             shape_rows: int = 6) -> dict:
     """Drive ``host`` open-loop at ``qps`` for ``seconds``; returns the
     report dict.  ``mix`` maps class -> weight; ``deadline_s`` is a
     (lo, hi) uniform range for the per-request deadline header (None =
-    no deadline sent).
+    no deadline sent).  ``shape_mix=N`` rotates query-class requests
+    through N structurally distinct Count shapes (``shape_mix_queries``
+    over ``shape_field``, default field ``f``) and the
+    report adds ``dispatches_per_query`` — the server-side coalescer
+    launch count per completed read, the number the ragged megabatch
+    engine drives toward the batch dispatch floor.
 
     A fixed pool of ``pool`` workers fires the scheduled arrivals —
     NOT a thread per request: hundreds of short-lived Python threads
@@ -194,6 +259,11 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     mix = mix or DEFAULT_MIX
     classes = list(mix)
     stats = _Stats()
+    qlist = None
+    if shape_mix:
+        qlist = shape_mix_queries(shape_mix,
+                                  field=shape_field or "f",
+                                  rows=shape_rows)
     n = int(qps * seconds)
     # EXACT-proportion, evenly interleaved class schedule (largest-
     # remainder pacing).  A binomial draw would make the delivered
@@ -230,6 +300,7 @@ def run_load(host: str, index: str, qps: float, seconds: float,
             _fire(req, timeout, stats, klass, bits)
 
     cache0 = _cache_counters(host)
+    disp0 = _vars_counter(host, "coalescer.dispatches")
     workers = [threading.Thread(target=worker, daemon=True)
                for _ in range(pool)]
     for w in workers:
@@ -240,7 +311,8 @@ def run_load(host: str, index: str, qps: float, seconds: float,
         klass = sched[i]
         dl = (random.uniform(*deadline_s)
               if deadline_s is not None else None)
-        req, kl, bits = _build_request(host, index, klass, query, dl,
+        q = qlist[i % len(qlist)] if qlist else query
+        req, kl, bits = _build_request(host, index, klass, q, dl,
                                        ingest_field, ingest_bits,
                                        ingest_rows, ingest_cols)
         jobs.put((due, req, kl, bits))
@@ -250,6 +322,7 @@ def run_load(host: str, index: str, qps: float, seconds: float,
         w.join(seconds + n * timeout)
     elapsed = time.perf_counter() - start
     cache1 = _cache_counters(host)
+    disp1 = _vars_counter(host, "coalescer.dispatches")
     hit_rate = None
     if cache0 is not None and cache1 is not None:
         dh = cache1[0] - cache0[0]
@@ -286,6 +359,18 @@ def run_load(host: str, index: str, qps: float, seconds: float,
         "ingest_bits_per_s": round(stats.ingest_bits / elapsed, 1)
         if elapsed else 0.0,
         "cache_hit_rate": hit_rate,
+        # shape-mix view: distinct shapes in rotation and the server's
+        # coalescer launches per completed read over the run window —
+        # near 1.0 means per-query dispatch (the pre-ragged behavior
+        # for mixed traffic); the ragged engine drives it toward
+        # 1/batch (the homogeneous dispatch floor)
+        "shape_mix": shape_mix or None,
+        "dispatches_per_query": (
+            # a missing baseline on a fresh server means zero prior
+            # dispatches; a missing END sample means the coalescer
+            # never dispatched at all -> None, not fake-perfect 0.0
+            round((disp1 - (disp0 or 0.0)) / len(rlat), 4)
+            if disp1 is not None and rlat else None),
     }
 
 
@@ -317,6 +402,16 @@ def main(argv: list[str] | None = None) -> int:
                    help="column range ingest positions draw from "
                         "(span multiple shard widths to fan the write "
                         "load out)")
+    p.add_argument("--shape-mix", type=int, default=0,
+                   help="rotate query-class requests through N "
+                        "structurally distinct Count shapes (0 = the "
+                        "single --query); report adds "
+                        "dispatches/query")
+    p.add_argument("--shape-field", default=None,
+                   help="field the shape-mix trees read (default: "
+                        "'f')")
+    p.add_argument("--shape-rows", type=int, default=6,
+                   help="row-id range shape-mix leaves draw from")
     p.add_argument("--timeout", type=float, default=10.0)
     args = p.parse_args(argv)
     mix = {}
@@ -333,7 +428,10 @@ def main(argv: list[str] | None = None) -> int:
                       ingest_field=args.ingest_field,
                       ingest_bits=args.ingest_bits,
                       ingest_rows=args.ingest_rows,
-                      ingest_cols=args.ingest_cols)
+                      ingest_cols=args.ingest_cols,
+                      shape_mix=args.shape_mix,
+                      shape_field=args.shape_field,
+                      shape_rows=args.shape_rows)
     print(json.dumps(report, indent=2))
     return 0
 
