@@ -6,10 +6,21 @@
 
 PY ?= python
 
-.PHONY: test test-paranoia test-shard22 test-matrix bench measure measure-resize measure-spmd validate-tpu soak soak-spmd check doccheck doccheck-fill native clean
+.PHONY: test test-paranoia test-shard22 test-matrix analyze typecheck bench measure measure-resize measure-spmd validate-tpu soak soak-spmd check doccheck doccheck-fill native clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
+
+# pilosa-lint: the six project-invariant analysis passes over the
+# package (tools/analyze/) — exit 1 on any unsuppressed finding.
+# tests/test_analyze.py pins the committed tree at zero.
+analyze:
+	$(PY) -m tools.analyze pilosa_tpu
+
+# mypy over the strict scope (mypy.ini; ops/tape.py, ops/expr.py,
+# runtime/resultcache.py).  Gates gracefully when mypy is absent.
+typecheck:
+	$(PY) tools/typecheck.py
 
 native:  # pre-build all four C++ fast paths (they also self-build lazily)
 	$(PY) -c "from pilosa_tpu.ops import hostkernels as hk; \
@@ -29,7 +40,7 @@ test-paranoia:
 test-shard22:
 	PILOSA_TPU_SHARD_WIDTH_EXP=22 $(PY) -m pytest tests/ -x -q
 
-test-matrix: test test-paranoia test-shard22
+test-matrix: analyze typecheck test test-paranoia test-shard22
 
 # executable documentation: verify every doc example against a live
 # server; doccheck-fill rewrites the response blocks from actual

@@ -1,0 +1,228 @@
+"""Dynamic lock-order checker: the runtime companion to the static
+``tools/analyze`` suite (the ``-race``-flavored half of the reference's
+CI matrix, adapted to a GIL runtime where torn reads hide but lock
+ORDER inversions still deadlock).
+
+The codebase's documented order is **fragment -> compactor** (delta
+writes under the fragment lock call ``note_delta``/``note_flushed``
+which take the registry lock inside; the scan thread snapshots the
+registry, RELEASES, then takes fragment locks — see
+ingest/compactor.py's module docstring) and fragment/resultcache/
+coalescer locks never nest into each other.  Those invariants were
+re-verified by reviewer eyeballs in PR 6 rounds 1-5; this module
+checks them mechanically in test runs.
+
+With ``PILOSA_TPU_LOCKCHECK=1`` (or ``enable()`` before the guarded
+objects are constructed) the fragment, compactor, result-cache, and
+coalescer locks are created as :class:`CheckedLock` wrappers.  Every
+acquisition records held -> acquiring edges in a process-wide order
+graph, keyed by lock *class name* (``fragment``, ``compactor``,
+``resultcache``, ``coalescer``) — and an acquisition that closes a
+cycle (lock-order inversion: some thread has taken the same pair in
+the opposite order) raises :class:`LockOrderError` immediately, at the
+acquisition site, instead of deadlocking two racing threads some day
+in production.
+
+Scope notes:
+
+- Same-name edges (fragment -> fragment across *instances*) are
+  deliberately ignored: no code path nests two fragment locks, and a
+  per-instance graph would make test fixtures quadratic.  The static
+  P1/P3 passes own intra-class discipline.
+- Disabled (the default), ``rlock()``/``lock()`` return the plain
+  ``threading`` primitives — zero overhead on the hot path.
+- ``CheckedLock`` implements the private Condition protocol
+  (``_is_owned``/``_release_save``/``_acquire_restore``) so
+  ``threading.Condition(fragment._lock)`` (the snapshot-done condvar)
+  keeps working under instrumentation.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["enabled", "enable", "rlock", "lock", "reset",
+           "CheckedLock", "LockOrderError", "order_graph"]
+
+_enabled = os.environ.get("PILOSA_TPU_LOCKCHECK", "") == "1"
+
+#: name -> {successor-name: first-recording thread name} — the
+#: process-wide acquisition-order graph.
+_graph: dict[str, dict[str, str]] = {}
+_graph_lock = threading.Lock()
+_tls = threading.local()
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition closed a cycle in the order graph."""
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    """Turn checking on/off for locks created AFTER this call (tests;
+    the env var covers whole-process runs).  Existing plain locks are
+    not retrofitted — reconstruct the guarded objects (e.g.
+    ``compactor.reset()``) after enabling."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def reset() -> None:
+    """Clear the recorded order graph (tests)."""
+    with _graph_lock:
+        _graph.clear()
+
+
+def order_graph() -> dict[str, dict[str, str]]:
+    """Copy of the recorded order graph: {held: {acquired: thread}}."""
+    with _graph_lock:
+        return {a: dict(bs) for a, bs in _graph.items()}
+
+
+def rlock(name: str):
+    """A named re-entrant lock — checked when the checker is enabled,
+    a plain ``threading.RLock`` otherwise."""
+    inner = threading.RLock()
+    return CheckedLock(name, inner) if _enabled else inner
+
+
+def lock(name: str):
+    """A named non-reentrant lock — checked when enabled."""
+    inner = threading.Lock()
+    return CheckedLock(name, inner) if _enabled else inner
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _path_exists(src: str, dst: str) -> list[str] | None:
+    """DFS under _graph_lock: a recorded order path src -> ... -> dst,
+    or None."""
+    seen = {src}
+    todo = [(src, [src])]
+    while todo:
+        node, path = todo.pop()
+        for nxt in _graph.get(node, ()):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                todo.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquire(name: str) -> None:
+    st = _stack()
+    held = [h for h in st if h != name]
+    if held:
+        me = threading.current_thread().name
+        with _graph_lock:
+            for h in dict.fromkeys(held):  # unique, order-preserving
+                # the reverse path existing FIRST is the inversion:
+                # some earlier acquisition recorded name -> ... -> h,
+                # and this thread now holds h while taking name
+                rev = _path_exists(name, h)
+                if rev is not None:
+                    raise LockOrderError(
+                        f"lock-order inversion: thread {me!r} "
+                        f"acquires {name!r} while holding {h!r}, but "
+                        f"the order {' -> '.join(rev)} was already "
+                        f"recorded (first by thread "
+                        f"{_graph[rev[0]][rev[1]]!r}); one of the "
+                        "two nestings must flip or drop the outer "
+                        "lock")
+                _graph.setdefault(h, {}).setdefault(name, me)
+    st.append(name)
+
+
+def _note_release(name: str) -> None:
+    st = _stack()
+    # remove the innermost matching entry (re-entrant acquires push
+    # one entry per acquire, releases pop symmetrically)
+    for i in range(len(st) - 1, -1, -1):
+        if st[i] == name:
+            del st[i]
+            return
+
+
+class CheckedLock:
+    """Order-checking wrapper over a ``threading`` lock primitive."""
+
+    __slots__ = ("name", "_inner")
+
+    def __init__(self, name: str, inner):
+        self.name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        # record BEFORE blocking: an inversion should raise at the
+        # acquisition site, not deadlock first and raise never
+        _note_acquire(self.name)
+        try:
+            ok = self._inner.acquire(blocking, timeout)
+        except BaseException:
+            _note_release(self.name)
+            raise
+        if not ok:
+            _note_release(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._inner.release()
+        _note_release(self.name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        inner_locked = getattr(self._inner, "locked", None)
+        return inner_locked() if inner_locked is not None else False
+
+    # ------------------------- threading.Condition private protocol
+
+    def _is_owned(self) -> bool:
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        """Fully release (Condition.wait): pop every held-stack entry
+        for this name and remember how many, so the restore can
+        repush them."""
+        st = _stack()
+        k = 0
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == self.name:
+                del st[i]
+                k += 1
+        if hasattr(self._inner, "_release_save"):
+            return (self._inner._release_save(), k)
+        self._inner.release()
+        return (None, k)
+
+    def _acquire_restore(self, saved) -> None:
+        token, k = saved
+        if hasattr(self._inner, "_acquire_restore"):
+            self._inner._acquire_restore(token)
+        else:
+            self._inner.acquire()
+        _stack().extend([self.name] * max(1, k))
+
+    def __repr__(self) -> str:
+        return f"<CheckedLock {self.name} {self._inner!r}>"

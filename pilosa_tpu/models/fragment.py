@@ -100,7 +100,9 @@ class Fragment:
         self._snapshotting = False
         self._stack_cache: tuple[int, np.ndarray, np.ndarray] | None = None
         self._device_cache: dict = {}
-        self._lock = threading.RLock()
+        from pilosa_tpu import lockcheck
+
+        self._lock = lockcheck.rlock("fragment")
         self._snap_done = threading.Condition(self._lock)
 
         from pilosa_tpu.models.cache import TopNCache
@@ -1206,6 +1208,7 @@ class Fragment:
             from pilosa_tpu.ops import bitmap as bm
 
             dev = (np.ascontiguousarray(matrix) if bm.host_mode()
+                   # pilosa-lint: allow(blocking-under-lock) -- upload under the fragment lock is the residency design: it serializes per-fragment uploads so one generation uploads once; nothing re-enters
                    else bm.chunked_device_put(matrix,
                                               label="fragment.matrix"))
             self._device_cache[key] = (self._gen, ids, dev)
@@ -1247,6 +1250,7 @@ class Fragment:
             from pilosa_tpu.ops import bitmap as bm
 
             dev = (P if bm.host_mode()
+                   # pilosa-lint: allow(blocking-under-lock) -- same residency design as device_matrix: per-fragment upload serialization under the owning lock
                    else bm.chunked_device_put(P, label="fragment.planes"))
             self._device_cache[key] = (self._gen, dev)
             residency.manager().admit(self._device_cache, key, P.nbytes)

@@ -44,6 +44,8 @@ from __future__ import annotations
 
 import threading
 from collections import namedtuple
+from collections.abc import Callable
+from typing import Any
 
 import numpy as np
 
@@ -52,7 +54,7 @@ from pilosa_tpu.ops import bitmap as bm
 _FOLD_NAMES = ("and", "or", "xor", "andnot")
 
 
-def _validate(shape, n_leaves: int) -> None:
+def _validate(shape: tuple, n_leaves: int) -> None:
     kind = shape[0]
     if kind == "leaf":
         if not 0 <= shape[1] < n_leaves:
@@ -85,7 +87,7 @@ def _validate(shape, n_leaves: int) -> None:
 # ------------------------------------------------------------ jit engine
 
 
-def _build_jnp(shape):
+def _build_jnp(shape: tuple) -> Callable[[tuple], Any]:
     """shape -> closure(leaves_tuple) -> jnp array, traced under jit."""
     import jax.numpy as jnp
 
@@ -102,7 +104,7 @@ def _build_jnp(shape):
             "andnot": lambda a, b: jnp.bitwise_and(a, jnp.bitwise_not(b)),
         }[kind]
 
-        def ev(leaves):
+        def ev(leaves: tuple) -> Any:
             out = kids[0](leaves)
             for k in kids[1:]:
                 out = fold(out, k(leaves))
@@ -142,7 +144,7 @@ _CacheInfo = namedtuple("_CacheInfo",
                         ("hits", "misses", "maxsize", "currsize"))
 
 
-def _build_program(shape, counts: bool):
+def _build_program(shape: tuple, counts: bool) -> Callable[..., Any]:
     """One jitted program per (canonical shape, root kind).  The
     cache is what makes tree fusion pay: distinct row ids (distinct
     leaf VALUES) reuse the program; only a new tree SHAPE traces."""
@@ -152,11 +154,11 @@ def _build_program(shape, counts: bool):
 
     ev = _build_jnp(shape)
     if counts:
-        def run(*leaves):
+        def run(*leaves: Any) -> Any:
             return jnp.sum(lax.population_count(ev(leaves)),
                            axis=-1, dtype=jnp.int32)
     else:
-        def run(*leaves):
+        def run(*leaves: Any) -> Any:
             return ev(leaves)
     # compile telemetry (pilosa_tpu.devobs): fused-program first
     # lowerings are the ones a fresh tree SHAPE pays — exactly the
@@ -168,7 +170,7 @@ def _build_program(shape, counts: bool):
     return _devobs.instrument(name, jax.jit(run))
 
 
-def _make_compiled(maxsize: int):
+def _make_compiled(maxsize: int) -> Any:
     """An explicit LRU over compiled programs with an EXACT eviction
     count.  ``functools.lru_cache`` was abandoned here because its
     counters can't express evictions: ``misses - currsize`` over-counts
@@ -178,10 +180,11 @@ def _make_compiled(maxsize: int):
     spuriously.  Here an eviction increments exactly when a resident
     program is popped for capacity, nothing else."""
     lock = threading.Lock()
-    cache: dict = {}  # insertion order == LRU order (move-to-end on hit)
+    # insertion order == LRU order (move-to-end on hit)
+    cache: dict[tuple, Callable[..., Any]] = {}
     counters = {"hits": 0, "misses": 0, "evictions": 0}
 
-    def _compiled(shape, counts: bool):
+    def _compiled(shape: tuple, counts: bool) -> Callable[..., Any]:
         key = (shape, counts)
         with lock:
             prog = cache.get(key)
@@ -225,7 +228,7 @@ def _make_compiled(maxsize: int):
 
 
 _compiled = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE)
-_eviction_warned = False
+_eviction_warned: bool = False
 
 
 def program_evictions() -> int:
@@ -266,7 +269,7 @@ def _note_program_cache_pressure() -> None:
 # ----------------------------------------------------------- host engine
 
 
-def _host_tree(shape, leaves) -> np.ndarray:
+def _host_tree(shape: tuple, leaves: tuple) -> np.ndarray:
     kind = shape[0]
     if kind == "leaf":
         return leaves[shape[1]]
@@ -293,7 +296,7 @@ def _host_tree(shape, leaves) -> np.ndarray:
     return bm.shift_words(np, _host_tree(shape[2], leaves), shape[1])
 
 
-def _host_counts(shape, leaves) -> np.ndarray:
+def _host_counts(shape: tuple, leaves: tuple) -> np.ndarray:
     from pilosa_tpu.ops import hostkernels as hk
 
     if (shape[0] == "and" and len(shape) == 3
@@ -311,7 +314,7 @@ def _host_counts(shape, leaves) -> np.ndarray:
 # -------------------------------------------------------------- frontend
 
 
-def evaluate(shape, leaves: tuple, counts: bool = False):
+def evaluate(shape: tuple, leaves: tuple, counts: bool = False) -> Any:
     """Evaluate one compiled tree over its leaf stacks in ONE launch.
 
     ``leaves`` — tuple of uint32 stacks, all the same shape ([S, W], or

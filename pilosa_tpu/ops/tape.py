@@ -54,6 +54,8 @@ from __future__ import annotations
 
 import threading
 from collections import namedtuple
+from collections.abc import Callable, Sequence
+from typing import Any
 
 import numpy as np
 
@@ -103,7 +105,7 @@ def compile_shape(shape, n_leaves: int, max_len: int | None = None) -> Tape:
         instrs.append((op, a, b))
         return ~(len(instrs) - 1)
 
-    def go(node) -> int:
+    def go(node: tuple) -> int:
         kind = node[0]
         if kind == "leaf":
             slot = node[1]
@@ -196,7 +198,7 @@ def bump(name: str, value: int = 1) -> None:
         _counters[name] += value
 
 
-def counters() -> dict:
+def counters() -> dict[str, int]:
     with _lock:
         return dict(_counters)
 
@@ -210,7 +212,7 @@ def reset_counters() -> None:
         _lowered.clear()
 
 
-def publish_gauges(stats) -> None:
+def publish_gauges(stats: Any) -> None:
     """Push the tape.* / coalescer.shape_* families into a stats
     registry at scrape time — cumulative values as gauges, same rule
     as resultcache/devobs publish_gauges (re-publishing a cumulative
@@ -219,7 +221,7 @@ def publish_gauges(stats) -> None:
         stats.gauge(name, value)
 
 
-def debug() -> dict:
+def debug() -> dict[str, Any]:
     """The /debug/ragged document body: counters plus the interpreter
     program inventory (which bucket variants this process has
     lowered)."""
@@ -239,10 +241,10 @@ def _abs_operand(ref: int, n_slots: int) -> int:
     return ref if ref >= 0 else n_slots + ~ref
 
 
-_programs: dict[bool, object] = {}
+_programs: dict[bool, Callable[..., Any]] = {}
 
 
-def _program(counts: bool):
+def _program(counts: bool) -> Callable[..., Any]:
     """The ONE vmapped scan/switch interpreter per root kind, jitted —
     jax re-lowers it per (batch, tape_len, slots, stack) input shape,
     which is exactly the bucket structure; the Python closure is
@@ -255,7 +257,7 @@ def _program(counts: bool):
     import jax.numpy as jnp
     from jax import lax
 
-    def one(tape_q, leaves_q):
+    def one(tape_q: Any, leaves_q: Any) -> Any:
         n_slots = leaves_q.shape[0]
         tape_len = tape_q.shape[0]
         regs0 = jnp.concatenate(
@@ -263,7 +265,7 @@ def _program(counts: bool):
              jnp.zeros((tape_len,) + leaves_q.shape[1:],
                        leaves_q.dtype)])
 
-        def step(regs, xs):
+        def step(regs: Any, xs: Any) -> tuple[Any, None]:
             instr, t = xs
             xa = regs[instr[1]]
             xb = regs[instr[2]]
@@ -294,7 +296,7 @@ def _program(counts: bool):
     return prog
 
 
-def _host_exec(tp: Tape, leaves: tuple, counts: bool):
+def _host_exec(tp: Tape, leaves: tuple, counts: bool) -> np.ndarray:
     """Eager numpy interpretation of one tape (host-mode engine)."""
     outs: list[np.ndarray] = []
 
@@ -325,9 +327,9 @@ def _host_exec(tp: Tape, leaves: tuple, counts: bool):
     return res
 
 
-def execute(batch, counts: bool = False,
+def execute(batch: Sequence[tuple[Tape, tuple]], counts: bool = False,
             tape_len: int | None = None,
-            slots: int | None = None) -> list:
+            slots: int | None = None) -> list[Any]:
     """Execute a batch of (Tape, leaves) pairs in ONE launch.
 
     Every query's leaf stacks must share one array shape (the

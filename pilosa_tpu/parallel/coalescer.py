@@ -135,7 +135,9 @@ class Coalescer:
         self.max_tape = max_tape
         self.max_leaves = max_leaves
         self.stats = stats if stats is not None else _stats.NOP
-        self._lock = threading.Lock()
+        from pilosa_tpu import lockcheck
+
+        self._lock = lockcheck.lock("coalescer")
         self._pending: dict[tuple, _Bucket] = {}
         # (shape, n_leaves) -> (Tape|None, fallback-counter-name|None):
         # shapes are canonical/hashable and few, so compile each once

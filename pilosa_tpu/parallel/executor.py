@@ -1044,6 +1044,7 @@ class Executor:
         frag = view.fragment(shard)
         if frag is None:
             return None
+        # pilosa-lint: allow(lock-discipline) -- unlocked ref-read gate keeps the no-delta fast path lock-free; a detached plane is immutable, so the post-lock row_touched reads a consistent (worst case: stale flight-record note) snapshot
         d = frag._delta
         if d is not None and not d.empty() and use_delta:
             # pending streaming delta: answer from the effective host

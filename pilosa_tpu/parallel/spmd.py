@@ -1055,9 +1055,11 @@ def try_collective(node, index_name: str, pql: str,
                 "scatter-gather (peers unpark via the collective "
                 "runtime's own timeout)", e)
             for t in threads:
+                # pilosa-lint: allow(blocking-under-lock) -- the collective plane is single-flight process-wide BY DESIGN: _collective_lock serializes entire executions including peer fan-out, and no other path takes it
                 t.join(timeout=60)
             return None
         for t in threads:
+            # pilosa-lint: allow(blocking-under-lock) -- same single-flight collective-plane design as the fallback join above
             t.join(timeout=60)
         # ids -> keys in the result, at the origin only (the reference's
         # translateResults, executor.go:2781), plus row-attr attachment

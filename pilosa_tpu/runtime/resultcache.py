@@ -71,6 +71,7 @@ import dataclasses
 import hashlib
 import threading
 import time
+from typing import Any
 
 
 #: Defaults; the server assembly reconfigures from [cache] config.
@@ -102,14 +103,14 @@ class Key:
 
     __slots__ = ("k", "h")
 
-    def __init__(self, k):
+    def __init__(self, k: Any) -> None:
         self.k = k
         self.h = hash(k)
 
     def __hash__(self) -> int:
         return self.h
 
-    def __eq__(self, other):
+    def __eq__(self, other: object) -> bool:
         if self is other:
             return True
         if isinstance(other, Key):
@@ -123,7 +124,7 @@ class Key:
 class _Entry:
     __slots__ = ("gens", "value", "nbytes", "t", "hits")
 
-    def __init__(self, gens, value, nbytes: int):
+    def __init__(self, gens: Any, value: object, nbytes: int) -> None:
         self.gens = gens
         self.value = value
         self.nbytes = nbytes
@@ -140,7 +141,7 @@ class _Flight:
 
     __slots__ = ("gens", "t0", "event", "tid")
 
-    def __init__(self, gens):
+    def __init__(self, gens: Any) -> None:
         self.gens = gens
         self.t0 = time.monotonic()
         self.event = threading.Event()
@@ -161,21 +162,23 @@ class ResultCache:
 
     def __init__(self, budget_bytes: int = DEFAULT_BUDGET_BYTES,
                  max_entry_bytes: int = DEFAULT_MAX_ENTRY_BYTES,
-                 ttl_s: float = 0.0, enabled: bool = True):
+                 ttl_s: float = 0.0, enabled: bool = True) -> None:
         self.budget = int(budget_bytes)
         self.max_entry_bytes = int(max_entry_bytes)
         self.ttl_s = float(ttl_s)
         self.enabled = bool(enabled)
-        self._lock = threading.Lock()
+        from pilosa_tpu import lockcheck
+
+        self._lock = lockcheck.lock("resultcache")
         # insertion order == LRU order (move-to-end on hit)
-        self._entries: dict = {}
+        self._entries: dict[Any, _Entry] = {}
         #: key -> _Flight: fills in progress (single-flight gate)
-        self._flights: dict = {}
+        self._flights: dict[Any, _Flight] = {}
         #: keys whose last fill was refused as oversize — such a key
         #: can never serve a flight's waiters, so followers must not
         #: queue behind a leader whose put is doomed (bounded FIFO;
         #: a later successful fill readmits the key)
-        self._noflight: dict = {}
+        self._noflight: dict[Any, None] = {}
         self.bytes = 0
         self.hits = 0
         self.misses = 0
@@ -188,7 +191,7 @@ class ResultCache:
 
     # -------------------------------------------------------------- access
 
-    def get(self, key, gens,
+    def get(self, key: Any, gens: Any,
             wait_s: float = FLIGHT_WAIT_S) -> tuple[bool, object]:
         """(hit, value).  ``gens`` is the CURRENT generation tuple the
         caller just computed from the live fragments; a stored stamp
@@ -271,7 +274,8 @@ class ResultCache:
             # timed out (or unusable fill): compute ourselves on the
             # next pass — budget is spent, so the re-entry can't wait
 
-    def put(self, key, gens, value, nbytes: int) -> bool:
+    def put(self, key: Any, gens: Any, value: object,
+            nbytes: int) -> bool:
         """Insert one result stamped with the generations captured
         BEFORE its inputs were read.  Returns False when the entry was
         refused (disabled / oversize / bigger than the whole budget).
@@ -308,7 +312,7 @@ class ResultCache:
                 self.evictions += 1
             return True
 
-    def _resolve_flight_locked(self, key) -> None:
+    def _resolve_flight_locked(self, key: Any) -> None:
         fl = self._flights.pop(key, None)
         if fl is not None:
             fl.event.set()
@@ -329,7 +333,7 @@ class ResultCache:
 
     # ------------------------------------------------------------- exports
 
-    def stats_dict(self) -> dict:
+    def stats_dict(self) -> dict[str, Any]:
         with self._lock:
             return {
                 "enabled": self.enabled,
@@ -349,7 +353,7 @@ class ResultCache:
                 "flightsOpen": len(self._flights),
             }
 
-    def debug(self, top_n: int = 32) -> dict:
+    def debug(self, top_n: int = 32) -> dict[str, Any]:
         """The /debug/resultcache document: totals plus the largest
         entries (key digest + human-readable key, bytes, age, hits)."""
         out = self.stats_dict()
@@ -366,7 +370,7 @@ class ResultCache:
             } for k, e in entries]
         return out
 
-    def publish_gauges(self, stats) -> None:
+    def publish_gauges(self, stats: Any) -> None:
         """Push the cache.* families into a stats registry at scrape
         time (/metrics, /debug/vars).  Cumulative totals render as
         gauges, not counters — re-publishing a cumulative value
@@ -385,14 +389,14 @@ class ResultCache:
         stats.gauge("cache.flight_served", s["flightServed"])
 
 
-def key_digest(key) -> str:
+def key_digest(key: Any) -> str:
     """Stable short digest of a cache key for flight records and the
     debug surface (the full tuple is structured but verbose)."""
     return hashlib.blake2b(repr(key).encode(),
                            digest_size=8).hexdigest()
 
 
-def result_nbytes(value) -> int:
+def result_nbytes(value: Any) -> int:
     """Byte estimate for one cached result: numpy buffers by .nbytes,
     containers and result dataclasses (GroupCount rows of FieldRow,
     Pair, ValCount...) recursively, scalars a machine word.  An

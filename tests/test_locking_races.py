@@ -1,5 +1,6 @@
-"""Data-directory locking, concurrent read/write races, and corrupt-file
-detection (parity: fragment.go:311 flock; CI -race suite; ctl/check.go)."""
+"""Data-directory locking, concurrent read/write races, corrupt-file
+detection (parity: fragment.go:311 flock; CI -race suite; ctl/check.go),
+and the PILOSA_TPU_LOCKCHECK=1 dynamic lock-order checker."""
 
 from __future__ import annotations
 
@@ -10,6 +11,7 @@ import urllib.request
 
 import pytest
 
+from pilosa_tpu import lockcheck
 from pilosa_tpu.models.holder import Holder
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
@@ -152,3 +154,149 @@ class TestConcurrentAccess:
             t.join(timeout=120)
             assert not t.is_alive(), "worker thread hung (deadlock?)"
         assert not errors, errors[:3]
+
+
+@pytest.fixture()
+def lockcheck_on():
+    """Enable the dynamic checker for locks created inside the test,
+    with a fresh order graph; restore the plain-lock world after.
+    The process-wide compactor/resultcache singletons are re-reset
+    AFTER disabling — a test's own reset() runs while the checker is
+    still on, so the replacement singletons carry CheckedLocks, and
+    enable(False) does not deactivate existing instances."""
+    lockcheck.enable(True)
+    lockcheck.reset()
+    yield
+    lockcheck.enable(False)
+    lockcheck.reset()
+    from pilosa_tpu.ingest import compactor as _compmod
+    from pilosa_tpu.runtime import resultcache as _rcmod
+
+    _compmod.reset()
+    _rcmod.reset()
+
+
+class TestLockOrderChecker:
+    """PILOSA_TPU_LOCKCHECK=1: acquisition order across the fragment /
+    compactor / resultcache / coalescer locks is recorded and a cycle
+    (lock-order inversion) fails AT THE ACQUISITION SITE instead of
+    deadlocking two racing threads later (ISSUE 8 companion dynamic
+    layer to the static P1/P3 passes)."""
+
+    def test_deliberate_inversion_detected(self, lockcheck_on):
+        """The acceptance pin: record a -> b, then acquire b -> a and
+        the checker raises."""
+        a = lockcheck.rlock("fragment")
+        b = lockcheck.lock("compactor")
+        with a:
+            with b:
+                pass
+        with pytest.raises(lockcheck.LockOrderError,
+                           match="inversion"):
+            with b:
+                with a:
+                    pass
+
+    def test_transitive_cycle_detected(self, lockcheck_on):
+        """a -> b and b -> c recorded; c -> a closes the 3-cycle."""
+        a = lockcheck.lock("resultcache")
+        b = lockcheck.lock("coalescer")
+        c = lockcheck.lock("compactor")
+        with a, b:
+            pass
+        with b, c:
+            pass
+        with pytest.raises(lockcheck.LockOrderError):
+            with c, a:
+                pass
+
+    def test_real_components_fragment_then_compactor(self, lockcheck_on):
+        """The documented production order (delta write under the
+        fragment lock registers with the compactor inside) is
+        recorded cleanly — and then a deliberate compactor->fragment
+        nesting, the inversion the compactor's snapshot-release-flush
+        dance exists to avoid, is caught."""
+        from pilosa_tpu import ingest
+        from pilosa_tpu.ingest import compactor as compmod
+        from pilosa_tpu.models.fragment import Fragment
+
+        compmod.reset()  # fresh instance -> CheckedLock
+        ingest.configure(delta_enabled=True)
+        try:
+            frag = Fragment(None, "i", "f", "standard", 0)
+            frag.set_bit(1, 7)  # delta write: fragment -> compactor
+            graph = lockcheck.order_graph()
+            assert "compactor" in graph.get("fragment", {}), graph
+            with pytest.raises(lockcheck.LockOrderError):
+                with compmod.compactor()._lock:
+                    frag.row_ids()  # takes the fragment lock inside
+        finally:
+            ingest.reset()
+            compmod.reset()
+
+    def test_clean_workload_records_no_violation(self, lockcheck_on):
+        """A realistic write/flush/read mix over instrumented
+        fragment + compactor + resultcache raises nothing (the
+        committed tree's order is consistent) and snapshot's condvar
+        still works through the CheckedLock wrapper."""
+        from pilosa_tpu import ingest
+        from pilosa_tpu.ingest import compactor as compmod
+        from pilosa_tpu.models.fragment import Fragment
+        from pilosa_tpu.runtime import resultcache
+
+        compmod.reset()
+        ingest.configure(delta_enabled=True)
+        rc = resultcache.reset()
+        try:
+            frag = Fragment(None, "i", "f", "standard", 0)
+            for c in range(64):
+                frag.set_bit(c % 4, c)
+            compmod.compactor().run_once(force=True)
+            assert frag.row_count(1) > 0
+            hit, _ = rc.get(("k",), (1,))
+            assert not hit
+            rc.put(("k",), (1,), 42, 32)
+            hit, got = rc.get(("k",), (1,))
+            assert hit and got == 42
+            assert sorted(frag.row_ids()) == [0, 1, 2, 3]
+        finally:
+            ingest.reset()
+            compmod.reset()
+            resultcache.reset()
+
+    def test_disabled_returns_plain_primitives(self):
+        lockcheck.enable(False)
+        assert not isinstance(lockcheck.rlock("x"),
+                              lockcheck.CheckedLock)
+        assert not isinstance(lockcheck.lock("x"),
+                              lockcheck.CheckedLock)
+
+    def test_env_var_enables_whole_process(self):
+        """PILOSA_TPU_LOCKCHECK=1 in the environment instruments a
+        fresh process end to end: the deliberate inversion raises."""
+        import os
+        import subprocess
+        import sys
+
+        script = (
+            "from pilosa_tpu import lockcheck\n"
+            "assert lockcheck.enabled()\n"
+            "a = lockcheck.rlock('fragment')\n"
+            "b = lockcheck.lock('compactor')\n"
+            "with a:\n"
+            "    with b:\n"
+            "        pass\n"
+            "try:\n"
+            "    with b:\n"
+            "        with a:\n"
+            "            pass\n"
+            "except lockcheck.LockOrderError:\n"
+            "    print('INVERSION-DETECTED')\n"
+        )
+        env = dict(os.environ, PILOSA_TPU_LOCKCHECK="1",
+                   JAX_PLATFORMS="cpu")
+        proc = subprocess.run([sys.executable, "-c", script],
+                              capture_output=True, text=True,
+                              env=env, timeout=120)
+        assert proc.returncode == 0, proc.stderr
+        assert "INVERSION-DETECTED" in proc.stdout

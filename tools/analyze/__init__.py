@@ -1,0 +1,49 @@
+"""pilosa-lint: project-invariant static analysis for pilosa-tpu.
+
+Generic linters check style; this suite checks the invariants THIS
+codebase has been burned by — each pass encodes one recurring
+review-round bug class (see ``tools/analyze/registry.py`` for the
+declarative project model and ``docs/development.md`` for the
+incident each pass descends from):
+
+- **P1 lock-discipline** — every touch of a registered lock-guarded
+  attribute (fragment ``_gen``/``_delta_seq``/``_rows``/``_delta``,
+  the compactor registry, the result-cache LRU/flight tables, ...)
+  sits inside the owning ``with <owner>._lock`` region.
+- **P2 generation-audit** — every ``fragment.py``/``field.py`` method
+  that mutates base words or rows bumps ``_gen`` or ``_delta_seq``
+  (directly or via a helper it calls).
+- **P3 blocking-under-lock** — sleeps, joins, future waits, RPC and
+  device-dispatch calls flagged inside held-lock regions.
+- **P4 recompile-hazard** — free-running batch shapes reaching jitted
+  entry points without the pow2/size-class helpers, and ``jnp.`` work
+  at module import time.
+- **P5 config-baseline** — process-wide config mutations outside a
+  ``capture_baseline``/``restore_baseline`` (or refcounted
+  retain/release) pairing.
+- **P6 metric-family-drift** — every metric-name literal fed to the
+  stats registry belongs to a family declared in
+  ``pilosa_tpu/metricfamilies.py``, every declared family still has
+  an emitter, and documented families still appear in their docs.
+
+Suppressions: ``# pilosa-lint: allow(<rule>) -- <reason>`` on the
+flagged line or alone on the line above.  The reason is mandatory, an
+unknown rule is an error, and a suppression that no longer suppresses
+anything is reported as removable (``stale-suppression``).
+
+Usage: ``python -m tools.analyze [--json] [PATH ...]`` (default
+``pilosa_tpu``), or ``make analyze``.  Exit 1 on any unsuppressed
+finding.  ``tests/test_analyze.py`` pins the committed tree at zero.
+"""
+
+from __future__ import annotations
+
+from tools.analyze.core import (  # noqa: F401 — public API
+    ALL_RULES,
+    Finding,
+    SourceFile,
+    analyze_paths,
+    analyze_sources,
+    render_json,
+    render_text,
+)

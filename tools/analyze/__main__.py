@@ -1,0 +1,11 @@
+"""CLI entry: ``python -m tools.analyze [--json] [--show-suppressed]
+[PATH ...]`` — exit 1 on any unsuppressed finding (``make analyze``)."""
+
+from __future__ import annotations
+
+import sys
+
+from tools.analyze.core import main
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

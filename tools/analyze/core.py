@@ -1,0 +1,289 @@
+"""pilosa-lint core: findings, suppression handling, the driver, and
+the text/JSON reporters.
+
+The analysis model is deliberately simple — pure-AST, intra-procedural,
+no imports of the analyzed code — so the suite runs in milliseconds on
+every test run (tier-1) and can never be broken by an import-time side
+effect in the code under analysis.  Each pass trades soundness for
+reviewability: the registry (``tools/analyze/registry.py``) and the
+mandatory suppression reasons ARE the documentation of every place the
+approximation meets reality.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+#: Rule ids of the six analysis passes, in pass order.
+PASS_RULES = (
+    "lock-discipline",
+    "generation-audit",
+    "blocking-under-lock",
+    "recompile-hazard",
+    "config-baseline",
+    "metric-family-drift",
+)
+
+#: Meta rules: defects in the suppression mechanism itself.  Not
+#: suppressible — a broken suppression cannot vouch for itself.
+META_RULES = ("suppression", "stale-suppression")
+
+ALL_RULES = PASS_RULES + META_RULES
+
+
+@dataclass
+class Finding:
+    """One analysis finding, anchored to ``path:line``."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    reason: str | None = None
+
+    def render(self) -> str:
+        tail = f"  [suppressed: {self.reason}]" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.rule}: {self.message}{tail}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "suppressed": self.suppressed,
+            "reason": self.reason,
+        }
+
+
+# ------------------------------------------------------------ suppression
+
+#: ``# pilosa-lint: allow(rule[, rule]) -- reason``
+_DIRECTIVE_RE = re.compile(r"#\s*pilosa-lint:\s*(?P<body>.*)$")
+_ALLOW_RE = re.compile(
+    r"^allow\(\s*(?P<rules>[A-Za-z0-9_\-,\s]*)\s*\)"
+    r"\s*(?:--\s*(?P<reason>\S.*?))?\s*$")
+
+
+@dataclass
+class Suppression:
+    rules: tuple
+    reason: str
+    line: int          # line the directive sits on
+    standalone: bool   # whole line is the comment -> applies to line+1
+    used: bool = False
+
+    def covers(self, line: int) -> bool:
+        if line == self.line:
+            return True
+        return self.standalone and line == self.line + 1
+
+
+def parse_suppressions(src: str, path: str
+                       ) -> tuple[list[Suppression], list[Finding]]:
+    """Scan one file's source for suppression directives.  Returns
+    (suppressions, meta findings) — malformed directives, unknown rule
+    names, and missing reasons are ``suppression`` findings (errors),
+    never silently honored."""
+    sups: list[Suppression] = []
+    bad: list[Finding] = []
+    for lineno, line in enumerate(src.splitlines(), 1):
+        m = _DIRECTIVE_RE.search(line)
+        if m is None:
+            continue
+        body = m.group("body").strip()
+        am = _ALLOW_RE.match(body)
+        if am is None:
+            bad.append(Finding(
+                "suppression", path, lineno,
+                f"malformed pilosa-lint directive {body!r}: expected "
+                "allow(<rule>) -- <reason>"))
+            continue
+        rules = tuple(r.strip() for r in am.group("rules").split(",")
+                      if r.strip())
+        reason = am.group("reason")
+        if not rules:
+            bad.append(Finding(
+                "suppression", path, lineno,
+                "allow() names no rule"))
+            continue
+        unknown = [r for r in rules if r not in PASS_RULES]
+        if unknown:
+            bad.append(Finding(
+                "suppression", path, lineno,
+                f"allow() names unknown rule(s) {unknown}; known rules: "
+                f"{', '.join(PASS_RULES)}"))
+            continue
+        if not reason:
+            bad.append(Finding(
+                "suppression", path, lineno,
+                f"allow({', '.join(rules)}) carries no reason — a "
+                "suppression without a why is a bug with a license"))
+            continue
+        standalone = line.strip().startswith("#")
+        sups.append(Suppression(rules, reason, lineno, standalone))
+    return sups, bad
+
+
+def apply_suppressions(findings: list[Finding],
+                       sups: list[Suppression],
+                       path: str) -> list[Finding]:
+    """Mark suppressed findings in place; return stale-suppression
+    findings for directives that suppressed nothing."""
+    for f in findings:
+        if f.rule not in PASS_RULES:
+            continue  # meta findings are not suppressible
+        for s in sups:
+            if f.rule in s.rules and s.covers(f.line):
+                f.suppressed = True
+                f.reason = s.reason
+                s.used = True
+                break
+    return [
+        Finding("stale-suppression", path, s.line,
+                f"allow({', '.join(s.rules)}) no longer suppresses "
+                "anything here — remove it (the invariant holds "
+                "without it)")
+        for s in sups if not s.used
+    ]
+
+
+# ----------------------------------------------------------------- driver
+
+
+@dataclass
+class SourceFile:
+    """One file under analysis: path (as reported), source, AST."""
+
+    path: str
+    src: str
+    tree: ast.Module = field(repr=False, default=None)
+
+    @classmethod
+    def parse(cls, path: str, src: str) -> "SourceFile":
+        return cls(path, src, ast.parse(src, filename=path))
+
+    def suffix_is(self, suffix: str) -> bool:
+        """Registry matching: does this file's normalized path end
+        with ``suffix`` (posix separators)?"""
+        norm = self.path.replace(os.sep, "/")
+        return norm == suffix or norm.endswith("/" + suffix)
+
+
+def _default_passes():
+    # local import: the pass modules import core for Finding
+    from tools.analyze import passes_config, passes_device, \
+        passes_locks, passes_metrics, passes_mutation
+
+    return (
+        passes_locks.LockDisciplinePass(),
+        passes_mutation.GenerationAuditPass(),
+        passes_locks.BlockingUnderLockPass(),
+        passes_device.RecompileHazardPass(),
+        passes_config.ConfigBaselinePass(),
+        passes_metrics.MetricFamilyDriftPass(),
+    )
+
+
+def analyze_sources(files: list[SourceFile],
+                    passes=None) -> list[Finding]:
+    """Run every pass over the given sources and fold in suppression
+    semantics.  Returns ALL findings — suppressed ones carry their
+    reason, plus ``suppression``/``stale-suppression`` meta findings."""
+    if passes is None:
+        passes = _default_passes()
+    per_file: dict[str, list[Finding]] = {f.path: [] for f in files}
+    sups: dict[str, list[Suppression]] = {}
+    out: list[Finding] = []
+    for sf in files:
+        s, bad = parse_suppressions(sf.src, sf.path)
+        sups[sf.path] = s
+        out.extend(bad)
+    for p in passes:
+        if hasattr(p, "run_package"):
+            found = p.run_package(files)
+        else:
+            found = []
+            for sf in files:
+                found.extend(p.run(sf))
+        for f in found:
+            per_file.setdefault(f.path, []).append(f)
+    analyzed = {sf.path for sf in files}
+    for sf in files:
+        findings = per_file.get(sf.path, [])
+        stale = apply_suppressions(findings, sups[sf.path], sf.path)
+        out.extend(findings)
+        out.extend(stale)
+    # findings anchored outside the analyzed set (e.g. a package pass
+    # pointing at a registry declaration under a different path
+    # spelling) must still be REPORTED — dropping them would let the
+    # gate false-pass; they just can't be suppressed in-file.
+    for path, findings in per_file.items():
+        if path not in analyzed:
+            out.extend(findings)
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out
+
+
+def collect_files(paths: list[str]) -> list[SourceFile]:
+    """Expand files/directories into parsed SourceFiles (sorted,
+    ``__pycache__`` skipped)."""
+    found: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = [d for d in dirs if d != "__pycache__"]
+                found.extend(os.path.join(root, n)
+                             for n in names if n.endswith(".py"))
+        else:
+            found.append(p)
+    out = []
+    for path in sorted(found):
+        with open(path, encoding="utf-8") as fh:
+            out.append(SourceFile.parse(path, fh.read()))
+    return out
+
+
+def analyze_paths(paths: list[str], passes=None) -> list[Finding]:
+    return analyze_sources(collect_files(paths), passes)
+
+
+# -------------------------------------------------------------- reporters
+
+
+def render_text(findings: list[Finding],
+                show_suppressed: bool = False) -> str:
+    lines = [f.render() for f in findings
+             if show_suppressed or not f.suppressed]
+    active = sum(1 for f in findings if not f.suppressed)
+    quiet = sum(1 for f in findings if f.suppressed)
+    lines.append(f"pilosa-lint: {active} finding(s), "
+                 f"{quiet} suppressed")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding]) -> str:
+    return json.dumps({
+        "findings": [f.to_dict() for f in findings],
+        "unsuppressed": sum(1 for f in findings if not f.suppressed),
+    }, indent=2)
+
+
+def main(argv: list[str]) -> int:
+    as_json = "--json" in argv
+    show_suppressed = "--show-suppressed" in argv
+    paths = [a for a in argv
+             if a not in ("--json", "--show-suppressed")]
+    if not paths:
+        paths = ["pilosa_tpu"]
+    findings = analyze_paths(paths)
+    if as_json:
+        print(render_json(findings))
+    else:
+        print(render_text(findings, show_suppressed))
+    return 1 if any(not f.suppressed for f in findings) else 0

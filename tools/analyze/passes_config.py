@@ -1,0 +1,118 @@
+"""P5 config-baseline: process-wide config mutations must carry their
+restore protocol.
+
+The incident: PR 6 spent review rounds 4-5 on the ``[ingest]`` config
+— servers configure the process-wide knobs in place, and per-server
+restore snapshots composed wrongly under create-A-create-B-close-A-
+close-B (the last closer re-installed a sibling's override).  The fix
+is the ``capture_baseline``/``restore_baseline`` protocol (first
+configurer snapshots, LAST closer restores) plus the refcounted
+``compactor.retain``/``release`` pair for the shared scan thread.
+
+The pass holds every future call site to the protocol at module
+granularity: a module (outside the owning definition module) that
+calls a registered config mutator — ``ingest.configure(...)``, an
+attribute write through an ``ingest.config()`` alias, or
+``compactor.retain()`` — must also reference every name in the
+mutator's registered pair.  Module granularity is deliberate: capture
+happens in ``Server.open`` and restore in ``Server.close``, so
+function-level pairing would be all noise; what the pass catches is
+the realistic failure — a NEW call site (a tool, a test harness
+promoted to product code, a second assembly) that flips process-wide
+config and never restores it for library users sharing the process.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze import registry as reg
+from tools.analyze.core import Finding, SourceFile
+
+
+def _matches(txt: str, suffixes) -> bool:
+    return any(txt == s or txt.endswith("." + s) for s in suffixes)
+
+
+class ConfigBaselinePass:
+    rule = "config-baseline"
+
+    def run(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        # names referenced anywhere in the module (pairing evidence)
+        referenced: set[str] = set()
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Attribute):
+                referenced.add(node.attr)
+            elif isinstance(node, ast.Name):
+                referenced.add(node.id)
+
+        # config() accessor aliases: per-function `x = ....config()`
+        alias_writes = self._alias_writes(sf)
+
+        for grule in reg.CONFIG_GUARDS:
+            if any(sf.suffix_is(s) for s in grule.owner_suffixes):
+                continue
+            sites: list[tuple[int, str]] = []
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    txt = ast.unparse(node.func)
+                    if _matches(txt, grule.mutator_suffixes):
+                        sites.append((node.lineno, txt))
+            # accessor-alias attribute writes count against the FIRST
+            # guard whose mutators share the accessor's module prefix
+            if grule is reg.CONFIG_GUARDS[0]:
+                sites.extend(alias_writes)
+            missing = [p for p in grule.pair if p not in referenced]
+            if sites and missing:
+                for lineno, txt in sites:
+                    out.append(Finding(
+                        self.rule, sf.path, lineno,
+                        f"{txt} mutates {grule.what} but this module "
+                        f"never references {missing} — the mutation "
+                        "outlives the mutator for every other user "
+                        "of the process (see registry CONFIG_GUARDS)"))
+        return out
+
+    def _alias_writes(self, sf) -> list[tuple[int, str]]:
+        """Attribute writes through ``cfg = <x>.config()`` aliases and
+        direct ``<x>.config().attr = ...`` writes."""
+        out: list[tuple[int, str]] = []
+        for fn in ast.walk(sf.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.Module)):
+                continue
+            aliases: set[str] = set()
+            body_nodes = list(ast.walk(fn)) if isinstance(
+                fn, ast.FunctionDef) else [
+                n for st in fn.body
+                if not isinstance(st, (ast.FunctionDef, ast.ClassDef))
+                for n in ast.walk(st)]
+            for node in body_nodes:
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        _matches(ast.unparse(node.value.func),
+                                 reg.CONFIG_ACCESSOR_SUFFIXES):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            aliases.add(t.id)
+            for node in body_nodes:
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for t in targets:
+                        if not isinstance(t, ast.Attribute):
+                            continue
+                        v = t.value
+                        if isinstance(v, ast.Name) and v.id in aliases:
+                            out.append((
+                                t.lineno,
+                                f"{v.id}.{t.attr} (via "
+                                f"{v.id} = ingest.config())"))
+                        elif isinstance(v, ast.Call) and _matches(
+                                ast.unparse(v.func),
+                                reg.CONFIG_ACCESSOR_SUFFIXES):
+                            out.append((
+                                t.lineno,
+                                f"{ast.unparse(v)}.{t.attr}"))
+        return out

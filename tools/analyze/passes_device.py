@@ -1,0 +1,125 @@
+"""P4 recompile-hazard: free-running shapes must not reach jitted
+entry points, and no jax array work at import time.
+
+The incident this encodes: PR 6's mixed-workload acceptance run found
+read p99 collapsed ~10x under sustained ingest because the coalescer
+dispatched device batches at free-running occupancies (2, 3, 5, ...)
+— the jitted program re-lowers per input shape, so every novel batch
+size paid a fresh multi-hundred-ms XLA compile IN THE SERVING PATH,
+convoying every query in the process.  The fix (pow2 batch padding +
+size classes) only helps if every future call site keeps the
+discipline; this pass holds them to it.
+
+Two checks:
+
+- **free-running batch shape**: a function that (a) calls a jitted
+  entry point (``expr.evaluate`` / ``tape.execute``), AND (b) builds a
+  variable-length batch stack (``jnp.stack``/``jnp.concatenate``/
+  ``np.stack`` over a comprehension, starred arg, or non-literal), AND
+  (c) never references a pow2/size-class helper
+  (``_pow2``/``size_class``/``_pad_batch``/``_padded_rows``/...), is
+  flagged at the jitted call site.  Referencing the helper is the
+  evidence the batch axis was quantized; the registry lists the
+  blessed helper names.
+- **import-time jax**: any ``jnp.*``/``jax.*`` CALL in module-level
+  statements (outside def/class bodies).  Importing a module must
+  never initialize a backend or trace a program — serving processes
+  import lazily and on the worker path.  ``jax.jit``/``jax.vmap``
+  wrapping (decorators included) is lazy and allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze import registry as reg
+from tools.analyze.core import Finding, SourceFile
+
+
+def _is_variable_batch(call: ast.Call) -> bool:
+    """Does this stack/concatenate call take a variable-length
+    sequence?  A fixed literal list of exprs is a static shape; a
+    comprehension, starred element, or plain name is not."""
+    if not call.args:
+        return False
+    a = call.args[0]
+    if isinstance(a, (ast.List, ast.Tuple)):
+        return any(isinstance(el, ast.Starred) for el in a.elts)
+    return True
+
+
+class RecompileHazardPass:
+    rule = "recompile-hazard"
+
+    def run(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        out.extend(self._import_time(sf))
+        for node in sf.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                out.extend(self._function(sf, node))
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        out.extend(self._function(sf, item))
+        return out
+
+    # ------------------------------------------------ free-running shapes
+
+    def _function(self, sf, fn) -> list[Finding]:
+        jit_calls: list[ast.Call] = []
+        variable_stack: list[ast.Call] = []
+        has_helper = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Name) and \
+                    node.id in reg.SHAPE_HELPER_NAMES:
+                has_helper = True
+            elif isinstance(node, ast.Attribute) and \
+                    node.attr in reg.SHAPE_HELPER_NAMES:
+                has_helper = True
+            elif isinstance(node, ast.Call):
+                txt = ast.unparse(node.func)
+                if any(txt == s or txt.endswith("." + s)
+                       for s in reg.JIT_ENTRY_SUFFIXES):
+                    jit_calls.append(node)
+                elif any(txt == s or txt.endswith("." + s)
+                         for s in reg.STACK_BUILDER_SUFFIXES):
+                    if _is_variable_batch(node):
+                        variable_stack.append(node)
+        if jit_calls and variable_stack and not has_helper:
+            return [Finding(
+                self.rule, sf.path, c.lineno,
+                f"{ast.unparse(c.func)}() reached with a "
+                "variable-length batch stack (built at line "
+                f"{variable_stack[0].lineno}) and no pow2/size-class "
+                "helper in scope — every novel occupancy re-lowers "
+                "the jitted program in the serving path (the PR-6 "
+                "convoy); route the batch axis through "
+                f"{sorted(reg.SHAPE_HELPER_NAMES)[0]}/size_class "
+                "style padding") for c in jit_calls]
+        return []
+
+    # -------------------------------------------------- import-time work
+
+    def _import_time(self, sf) -> list[Finding]:
+        out = []
+        for st in sf.tree.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef, ast.Import,
+                               ast.ImportFrom)):
+                continue
+            for node in ast.walk(st):
+                if not isinstance(node, ast.Call):
+                    continue
+                txt = ast.unparse(node.func)
+                root = txt.split(".", 1)[0]
+                if root not in reg.IMPORT_TIME_JAX_ROOTS:
+                    continue
+                if any(txt == a or txt.startswith(a + ".")
+                       for a in reg.IMPORT_TIME_ALLOWED):
+                    continue
+                out.append(Finding(
+                    self.rule, sf.path, node.lineno,
+                    f"{txt}() runs at module import time — backend "
+                    "init / tracing on import stalls every importer "
+                    "(move it into the function that needs it)"))
+        return out

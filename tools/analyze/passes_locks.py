@@ -1,0 +1,261 @@
+"""P1 lock-discipline and P3 blocking-under-lock.
+
+Both passes reason about *held-lock regions*: the statements inside a
+``with <recv>._lock:`` (or bare ``with <module_lock>:``) block, tracked
+intra-procedurally.  P1 requires every touch of a registered guarded
+attribute/global to sit inside its owner's region (the PR-6 class of
+bug: ``row_ids()`` iterating ``_rows`` while the background compactor
+flushed a delta — "dictionary changed size during iteration" on the
+MinRow/MaxRow map path).  P3 inverts the check: calls that can block
+(sleeps, joins, future results, RPC, device dispatch) are flagged
+INSIDE any region — holding the fragment or registry lock across a
+join is how the PR-6 compactor-shutdown review rounds were spent.
+
+Approximations (by design, documented here and in the registry):
+
+- Intra-procedural only.  A helper with a caller-holds-the-lock
+  contract is declared in the registry (or carries the ``*_locked``
+  suffix) and its body is not re-checked; a caller that invokes it
+  without the lock is not caught by P1 — the dynamic lock-order
+  checker (pilosa_tpu/lockcheck.py) and the race tests own that half.
+- Nested function definitions reset the region state (a closure runs
+  later, under whatever locks its caller holds).  Comprehensions
+  execute inline and keep the current region.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze import registry as reg
+from tools.analyze.core import Finding, SourceFile
+
+
+def _lock_tokens(ctx_expr) -> list[str]:
+    """Lock tokens a ``with`` item establishes: ``recv:<unparse>`` for
+    attribute locks, ``mod:<name>`` for bare module locks."""
+    out = []
+    if isinstance(ctx_expr, ast.Attribute) and \
+            ctx_expr.attr in reg.LOCK_ATTR_NAMES:
+        out.append("recv:" + ast.unparse(ctx_expr.value))
+    elif isinstance(ctx_expr, ast.Name) and \
+            ctx_expr.id.endswith("_lock"):
+        out.append("mod:" + ctx_expr.id)
+    return out
+
+
+class _RegionWalker:
+    """Walks one function body, invoking ``visit(node, active)`` for
+    every expression-level node with the set of active lock tokens."""
+
+    def __init__(self, visit):
+        self._visit = visit
+
+    def walk_function(self, fn) -> None:
+        self._stmts(fn.body, frozenset())
+
+    def _stmts(self, stmts, active) -> None:
+        for st in stmts:
+            self._stmt(st, active)
+
+    def _stmt(self, st, active) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a nested def runs later: fresh region state
+            for deco in st.decorator_list:
+                self._expr(deco, active)
+            self._stmts(st.body, frozenset())
+            return
+        if isinstance(st, ast.ClassDef):
+            self._stmts(st.body, frozenset())
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            inner = set(active)
+            for item in st.items:
+                self._expr(item.context_expr, active)
+                inner.update(_lock_tokens(item.context_expr))
+            self._stmts(st.body, frozenset(inner))
+            return
+        # generic: visit child expressions with current region, then
+        # child statement blocks
+        for fname, value in ast.iter_fields(st):
+            if isinstance(value, list):
+                if value and isinstance(value[0], ast.stmt):
+                    self._stmts(value, active)
+                else:
+                    for v in value:
+                        if isinstance(v, ast.expr):
+                            self._expr(v, active)
+                        elif isinstance(v, ast.excepthandler):
+                            self._stmts(v.body, active)
+            elif isinstance(value, ast.expr):
+                self._expr(value, active)
+
+    def _expr(self, e, active) -> None:
+        if e is None:
+            return
+        for node in ast.walk(e):
+            if isinstance(node, ast.Lambda):
+                continue  # runs later; body nodes still walked —
+                # acceptable: lambdas in this codebase close over
+                # locals, not guarded attributes
+            self._visit(node, active)
+
+
+def _is_locked_helper(name: str, rule) -> bool:
+    if name == "__init__" or name.endswith("_locked"):
+        return True
+    return rule is not None and name in rule.helpers
+
+
+def _store_ctx(node) -> bool:
+    return isinstance(node.ctx, (ast.Store, ast.Del))
+
+
+class LockDisciplinePass:
+    """P1: every registered guarded attribute/global touch inside its
+    owning held-lock region."""
+
+    rule = "lock-discipline"
+
+    def run(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        class_rules = {cls: r for (suffix, cls), r in
+                       reg.CLASS_LOCKS.items() if sf.suffix_is(suffix)}
+        mod_rules = []
+        for suffix, rules in reg.MODULE_LOCKS.items():
+            if sf.suffix_is(suffix):
+                mod_rules.extend(rules)
+        mod_by_name = {r.name: r for r in mod_rules}
+
+        def check_function(fn, cls_rule, out=out):
+            def visit(node, active):
+                if isinstance(node, ast.Attribute):
+                    recv = node.value
+                    recv_txt = (ast.unparse(recv)
+                                if isinstance(recv, ast.Name) else None)
+                    if recv_txt == "self" and cls_rule is not None:
+                        if node.attr in cls_rule.attrs and \
+                                "recv:self" not in active:
+                            out.append(Finding(
+                                self.rule, sf.path, node.lineno,
+                                f"self.{node.attr} touched outside "
+                                f"'with self.{cls_rule.lock}' (guarded "
+                                "attribute; see tools/analyze/"
+                                "registry.py CLASS_LOCKS)"))
+                    elif recv_txt is not None and recv_txt != "self":
+                        mode = reg.CROSS_OBJECT_ATTRS.get(node.attr)
+                        grule = mod_by_name.get(recv_txt)
+                        if grule is not None and grule.attrs and \
+                                _store_ctx(node):
+                            if "mod:" + grule.lock not in active:
+                                out.append(Finding(
+                                    self.rule, sf.path, node.lineno,
+                                    f"write to {recv_txt}.{node.attr} "
+                                    f"outside 'with {grule.lock}' "
+                                    "(guarded module config)"))
+                        elif mode is not None:
+                            if mode == "w" and not _store_ctx(node):
+                                return
+                            if "recv:" + recv_txt not in active:
+                                out.append(Finding(
+                                    self.rule, sf.path, node.lineno,
+                                    f"{recv_txt}.{node.attr} touched "
+                                    f"outside 'with {recv_txt}._lock' "
+                                    "(guarded attribute; see registry "
+                                    "CROSS_OBJECT_ATTRS)"))
+                elif isinstance(node, ast.Name):
+                    grule = mod_by_name.get(node.id)
+                    if grule is None:
+                        return
+                    # attrs=True ADDITIONALLY guards attribute writes
+                    # (handled above); the name itself — in particular
+                    # a rebind like `_cfg = IngestRuntimeConfig()` —
+                    # still goes through the mode check here
+                    if grule.mode == "w" and not _store_ctx(node):
+                        return
+                    if "mod:" + grule.lock not in active:
+                        out.append(Finding(
+                            self.rule, sf.path, node.lineno,
+                            f"module global {node.id!r} touched "
+                            f"outside 'with {grule.lock}' (guarded "
+                            "global; see registry MODULE_LOCKS)"))
+
+            _RegionWalker(visit).walk_function(fn)
+
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls_rule = class_rules.get(node.name)
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        if cls_rule is not None and \
+                                _is_locked_helper(item.name, cls_rule):
+                            continue
+                        check_function(item, cls_rule)
+            elif isinstance(node, ast.FunctionDef):
+                check_function(node, None)
+        return out
+
+
+def _call_suffix(func) -> str:
+    """Dotted text of a call target (best effort)."""
+    try:
+        return ast.unparse(func)
+    except Exception:  # pragma: no cover - unparse is total on exprs
+        return ""
+
+
+class BlockingUnderLockPass:
+    """P3: blocking/device-dispatch calls inside held-lock regions."""
+
+    rule = "blocking-under-lock"
+
+    def run(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+
+        def visit(node, active):
+            if not active or not isinstance(node, ast.Call):
+                return
+            func = node.func
+            label = None
+            txt = _call_suffix(func)
+            if isinstance(func, ast.Attribute):
+                attr = func.attr
+                if any(txt.endswith(s)
+                       for s in reg.BLOCKING_CALL_SUFFIXES):
+                    label = txt
+                elif attr in reg.DEVICE_DISPATCH_NAMES:
+                    label = f"device dispatch .{attr}()"
+                elif attr in reg.BLOCKING_ATTRS:
+                    if attr == "join" and (
+                            isinstance(func.value, ast.Constant)
+                            or txt.startswith("os.path.")):
+                        return  # str.join / os.path.join
+                    if attr == "wait":
+                        recv = func.value
+                        if isinstance(recv, ast.Attribute) and \
+                                recv.attr in reg.CONDITION_ATTRS:
+                            return  # Condition.wait releases the lock
+                    label = f".{attr}()"
+            elif isinstance(func, ast.Name):
+                if func.id in reg.DEVICE_DISPATCH_NAMES:
+                    label = f"device dispatch {func.id}()"
+            if label is not None:
+                locks = ", ".join(sorted(
+                    a.split(":", 1)[1] for a in active))
+                out.append(Finding(
+                    self.rule, sf.path, node.lineno,
+                    f"{label} called while holding lock(s) [{locks}]"
+                    " — blocking under a lock convoys every waiter"))
+
+        walker = _RegionWalker(visit)
+        # top-level and method defs only: _RegionWalker recurses into
+        # nested defs itself (with fresh region state), so walking
+        # every FunctionDef in ast.walk would double-visit them
+        for node in sf.tree.body:
+            if isinstance(node, ast.FunctionDef):
+                walker.walk_function(node)
+            elif isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef):
+                        walker.walk_function(item)
+        return out

@@ -1,0 +1,162 @@
+"""P6 metric-family drift: the static twin of
+``tools/check_metrics.check_families``.
+
+The live checker can only see families a running server happens to
+emit; this pass sees every emission SITE.  It harvests each metric
+name fed to the stats registry across the package — string-literal
+first arguments of ``.count``/``.gauge``/``.histogram``/``.timing``/
+``.count_with_tags`` calls, ``bump("...")`` module-counter feeds, and
+the string keys of module-level ``_counters`` dict literals — and
+diffs the result against the one declarative registry
+(``pilosa_tpu/metricfamilies.py``):
+
+- a harvested dotted name whose family is not declared -> finding at
+  the emission site (a new family must be declared once, where the
+  live checker and the docs checks will see it);
+- a declared ``static=True`` family with no harvested emitter ->
+  finding at the family's declaration line (a refactor silently
+  dropped a whole telemetry family — exactly what
+  ``check_families`` exists to catch, but at analysis time instead
+  of against a live server);
+- a family naming a ``doc`` file whose rendered prefix no longer
+  appears there -> finding at the declaration line (operator docs
+  rot).
+
+Dotted names only: bare names (``threads``, ``pilosa_query_latency``)
+are inventoried in ``metricfamilies.BARE_METRICS`` and skipped here.
+Dynamic names (f-strings, variables) are invisible to the harvest by
+design — families must keep at least one literal emitter, which every
+family today has.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from tools.analyze import registry as reg
+from tools.analyze.core import Finding, SourceFile
+
+_DOTTED_RE = re.compile(r"^[a-z][a-z0-9_]*(\.[a-z0-9_]+)+$")
+
+
+def _harvest_file(sf: SourceFile) -> list[tuple[str, int]]:
+    """(metric name, line) literals fed to the stats registry."""
+    out: list[tuple[str, int]] = []
+
+    def literal_name(node) -> str | None:
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                _DOTTED_RE.match(node.value):
+            return node.value
+        return None
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Call):
+            func = node.func
+            is_stats = (isinstance(func, ast.Attribute)
+                        and func.attr in reg.STATS_CALL_ATTRS)
+            is_feed = ((isinstance(func, ast.Name)
+                        and func.id in reg.STATS_CALL_FUNCS)
+                       or (isinstance(func, ast.Attribute)
+                           and func.attr in reg.STATS_CALL_FUNCS))
+            if (is_stats or is_feed) and node.args:
+                name = literal_name(node.args[0])
+                if name is not None:
+                    out.append((name, node.lineno))
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Dict):
+            names = [t.id for t in node.targets
+                     if isinstance(t, ast.Name)]
+            if any(n in reg.STATS_DICT_NAMES for n in names):
+                for key in node.value.keys:
+                    name = literal_name(key)
+                    if name is not None:
+                        out.append((name, key.lineno))
+    return out
+
+
+def _registry_module():
+    from pilosa_tpu import metricfamilies
+
+    return metricfamilies
+
+
+def _declaration_line(family_name: str,
+                      files: list[SourceFile]) -> tuple[str, int]:
+    """(path, line) of one family's declaration in
+    pilosa_tpu/metricfamilies.py, for file:line-quality findings.
+    Anchors at the ANALYZED file's own path spelling when the registry
+    is in the sweep (absolute vs relative invocation must not detach
+    the finding from its file — suppression matching is per-path)."""
+    for sf in files:
+        if sf.suffix_is("pilosa_tpu/metricfamilies.py"):
+            for lineno, line in enumerate(sf.src.splitlines(), 1):
+                if f'Family("{family_name}"' in line:
+                    return sf.path, lineno
+            return sf.path, 1
+    mod = _registry_module()
+    path = mod.__file__
+    rel = os.path.relpath(path)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, 1):
+                if f'Family("{family_name}"' in line:
+                    return rel, lineno
+    except OSError:
+        pass
+    return rel, 1
+
+
+class MetricFamilyDriftPass:
+    rule = "metric-family-drift"
+
+    def run_package(self, files: list[SourceFile]) -> list[Finding]:
+        mod = _registry_module()
+        declared = mod.by_name()
+        out: list[Finding] = []
+        seen_families: set[str] = set()
+        for sf in files:
+            if sf.suffix_is("metricfamilies.py"):
+                continue  # the registry's own docstrings/examples
+            for name, lineno in _harvest_file(sf):
+                family = name.split(".", 1)[0]
+                seen_families.add(family)
+                if family not in declared:
+                    out.append(Finding(
+                        self.rule, sf.path, lineno,
+                        f"metric {name!r} belongs to undeclared "
+                        f"family {family!r} — declare it in "
+                        "pilosa_tpu/metricfamilies.py (one "
+                        "declaration feeds the live check, this "
+                        "pass, and the docs check)"))
+        analyzed_any = bool(files)
+        for fam in mod.static_families():
+            path, line = _declaration_line(fam.name, files)
+            if analyzed_any and fam.name not in seen_families:
+                out.append(Finding(
+                    self.rule, path, line,
+                    f"family {fam.name!r} is declared static but no "
+                    "emitter was harvested in the analyzed tree — "
+                    "the telemetry family was dropped (or its last "
+                    "emitter went dynamic)"))
+            if fam.doc is not None:
+                doc_path = os.path.join(
+                    os.path.dirname(os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__)))),
+                    "docs", fam.doc)
+                try:
+                    with open(doc_path, encoding="utf-8") as fh:
+                        text = fh.read()
+                except OSError:
+                    text = ""
+                if fam.rendered not in text and \
+                        fam.name + "." not in text:
+                    out.append(Finding(
+                        self.rule, path, line,
+                        f"family {fam.name!r} declares doc "
+                        f"{fam.doc!r} but neither {fam.rendered!r} "
+                        f"nor {fam.name + '.'!r} appears there — "
+                        "operator docs drifted"))
+        return out

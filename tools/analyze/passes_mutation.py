@@ -1,0 +1,137 @@
+"""P2 generation-audit: mutation implies a generation bump.
+
+The result cache's entire correctness argument (runtime/resultcache.py
+"stamp-before-read") rests on one discipline: EVERY path that changes
+a fragment's effective content moves ``_gen`` (base mutations,
+compaction) or ``_delta_seq`` (delta-landing writes).  PR 5 verified
+this with a parametrized hand-audit over every mutation path and PR 6
+re-verified it for the delta write path — this pass is the
+machine-checked form.
+
+Model (per registered class): a method *mutates* when it writes a
+target attribute (``self._rows[...] = ...``, ``.pop``/``.clear``/
+``.setdefault``/``.update`` on it), calls a registered mutation
+primitive, or calls a delta-plane writer (``add_bit``/
+``add_positions``).  A method *bumps* when it assigns or augments a
+bump attribute.  Both facts close transitively over same-class
+``self.<method>()`` calls — so ``import_roaring`` inherits its bump
+from nothing (it bumps inline) while ``_stacked`` inherits BOTH facts
+from ``_flush_delta_locked`` and passes.  A method that (transitively)
+mutates but never (transitively) bumps is the finding, anchored at its
+``def`` line.  Primitives themselves and registry-exempt methods are
+skipped: their callers own the bump, and the exemption reason is
+recorded in the registry.
+
+This is containment, not path-sensitivity: a method that bumps on one
+branch and returns mutated-without-bump on another is out of scope
+(the paranoia gate and the audit tests own runtime verification).
+What this catches is the realistic review-round failure — a new
+mutation path that never bumps at all.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analyze import registry as reg
+from tools.analyze.core import Finding, SourceFile
+
+_MUTATING_CONTAINER_METHODS = ("pop", "clear", "setdefault", "update",
+                               "__setitem__")
+
+
+def _method_facts(fn: ast.FunctionDef, rule) -> dict:
+    """(mutates, bumps, calls) facts for one method body."""
+    mutates = False
+    bumps = False
+    calls: set[str] = set()
+    for node in ast.walk(fn):
+        # self.<bump> += 1 / self.<bump> = ...
+        if isinstance(node, (ast.AugAssign, ast.Assign)):
+            targets = ([node.target] if isinstance(node, ast.AugAssign)
+                       else node.targets)
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name):
+                    if t.attr in rule.bump_attrs and \
+                            t.value.id == "self":
+                        bumps = True
+                    if t.attr in rule.targets:
+                        mutates = True  # <recv>._rows = ... (any recv)
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Attribute) and \
+                        t.value.attr in rule.targets:
+                    mutates = True  # <recv>._rows[...] = ...
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Attribute) and \
+                        t.value.attr in rule.targets:
+                    mutates = True
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            # self.<primitive>(...) / self.<helper>(...)
+            if isinstance(func.value, ast.Name) and \
+                    func.value.id == "self":
+                if func.attr in rule.primitives:
+                    mutates = True
+                else:
+                    calls.add(func.attr)
+            # <anything>.add_bit(...) — delta-plane write
+            if func.attr in rule.delta_mutators:
+                mutates = True
+            # <recv>._rows.pop(...) and friends
+            if func.attr in _MUTATING_CONTAINER_METHODS and \
+                    isinstance(func.value, ast.Attribute) and \
+                    func.value.attr in rule.targets:
+                mutates = True
+    return {"mutates": mutates, "bumps": bumps, "calls": calls}
+
+
+class GenerationAuditPass:
+    rule = "generation-audit"
+
+    def run(self, sf: SourceFile) -> list[Finding]:
+        out: list[Finding] = []
+        for (suffix, cls), rule in reg.GEN_AUDIT.items():
+            if not sf.suffix_is(suffix):
+                continue
+            for node in sf.tree.body:
+                if isinstance(node, ast.ClassDef) and node.name == cls:
+                    out.extend(self._check_class(sf, node, rule))
+        return out
+
+    def _check_class(self, sf, cls_node, rule) -> list[Finding]:
+        methods = {m.name: m for m in cls_node.body
+                   if isinstance(m, ast.FunctionDef)}
+        facts = {name: _method_facts(fn, rule)
+                 for name, fn in methods.items()}
+        # transitive closure over same-class calls (fixpoint; the call
+        # graph is tiny)
+        changed = True
+        while changed:
+            changed = False
+            for name, f in facts.items():
+                for callee in f["calls"]:
+                    cf = facts.get(callee)
+                    if cf is None:
+                        continue
+                    for key in ("mutates", "bumps"):
+                        if cf[key] and not f[key]:
+                            f[key] = True
+                            changed = True
+        out = []
+        for name, f in facts.items():
+            if name in rule.primitives or name in rule.exempt:
+                continue
+            if f["mutates"] and not f["bumps"]:
+                out.append(Finding(
+                    self.rule, sf.path, methods[name].lineno,
+                    f"{cls_node.name}.{name} mutates base words/rows "
+                    "but never bumps "
+                    f"{' or '.join(sorted(rule.bump_attrs))} — stale "
+                    "result-cache entries would keep serving (see "
+                    "registry GEN_AUDIT)"))
+        return out

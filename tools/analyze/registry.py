@@ -1,0 +1,272 @@
+"""The declarative project model pilosa-lint checks against.
+
+Everything here is an INVARIANT REGISTRY, not analyzer configuration:
+each entry names a concurrency/caching/device contract the codebase
+relies on, in one place, machine-checked by the passes.  Growing the
+system means growing this file — a new lock-guarded structure, metric
+family, or process-wide config knob is declared here and the analyzer
+holds every touch to the declared discipline from then on.
+
+Paths are repo-relative suffixes (``models/fragment.py``) so the suite
+works from any checkout root and on synthetic fixture paths in tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# --------------------------------------------------------- P1: lock model
+
+#: Attribute names that hold locks; ``with <recv>.<one of these>:``
+#: marks a held-lock region for receiver ``<recv>``, and a bare
+#: ``with <name>:`` where <name> ends in ``_lock`` marks a module-level
+#: region.
+LOCK_ATTR_NAMES = ("_lock", "_global_lock", "_cfg_lock", "_graph_lock")
+
+
+@dataclass(frozen=True)
+class ClassLockRule:
+    """One class whose listed attributes are guarded by ``self.<lock>``.
+
+    ``helpers`` are methods with a documented caller-holds-the-lock
+    contract (the ``*_locked`` suffix is honored automatically, as is
+    ``__init__`` — construction is single-threaded).  Listing a method
+    here IS the declaration of that contract; the reason strings keep
+    the registry reviewable.
+    """
+
+    lock: str
+    attrs: frozenset
+    helpers: dict = field(default_factory=dict)  # name -> contract note
+
+
+CLASS_LOCKS: dict[tuple, ClassLockRule] = {
+    ("models/fragment.py", "Fragment"): ClassLockRule(
+        lock="_lock",
+        attrs=frozenset({
+            "_rows", "_gen", "_delta_seq", "_delta", "_op_n", "_wal",
+            "_stack_cache", "_device_cache", "_snapshotting",
+            "_closed",
+        }),
+        helpers={
+            "_load": "construction-time replay, single-threaded",
+            "_replay_wal": "construction-time replay, single-threaded",
+            "_replay_wal_file": "construction-time replay",
+            "_wal_append": "every caller is a mutator holding _lock",
+            "_apply_set": "mutation primitive; callers hold _lock",
+            "_apply_clear": "mutation primitive; callers hold _lock",
+            "_apply_bulk": "mutation primitive; callers hold _lock",
+            "_merge_roaring": "callers hold _lock (or _load replay)",
+            "_merge_positions": "callers hold _lock (or _load replay)",
+            "_row_array": "mutation primitive; callers hold _lock",
+            "_maybe_snapshot": "called at the tail of locked mutators",
+            "_delta_or_new": "delta write path; callers hold _lock",
+            "_delta_set_bit": "delta write path; callers hold _lock",
+            "_delta_row_seq": "token read under the caller's _lock",
+        },
+    ),
+    ("ingest/compactor.py", "Compactor"): ClassLockRule(
+        lock="_lock",
+        attrs=frozenset({
+            "_frags", "_pending_bytes", "_paused", "_thread",
+            "compactions", "compacted_bits", "inline_flushes",
+            "compact_skipped", "delta_writes",
+        }),
+    ),
+    ("runtime/resultcache.py", "ResultCache"): ClassLockRule(
+        lock="_lock",
+        attrs=frozenset({
+            "_entries", "_flights", "_noflight", "bytes", "hits",
+            "misses", "fills", "evictions", "invalidations",
+            "skipped_oversize", "flight_joins", "flight_served",
+        }),
+    ),
+    ("parallel/coalescer.py", "Coalescer"): ClassLockRule(
+        lock="_lock",
+        attrs=frozenset({"_pending"}),
+        # _tape_memo is deliberately UNREGISTERED: racy-by-design
+        # (a duplicate compile is wasted work, never a wrong entry —
+        # see the inline comment at its definition)
+    ),
+}
+
+#: Guarded attributes checked on NON-self receivers anywhere in the
+#: sweep: ``frag._rows`` needs an active ``with frag._lock`` region.
+#: mode "rw" checks loads and stores; "w" checks stores only — the
+#: monotone token ints (_gen/_delta_seq) are read lock-free by design
+#: (GIL-atomic int loads; the stamp-before-read discipline tolerates
+#: any interleaving, see runtime/resultcache.py's module docstring).
+CROSS_OBJECT_ATTRS: dict[str, str] = {
+    "_rows": "rw",
+    "_delta": "rw",
+    "_frags": "rw",
+    "_flights": "rw",
+    "_noflight": "rw",
+    "_gen": "w",
+    "_delta_seq": "w",
+}
+
+
+@dataclass(frozen=True)
+class ModuleGlobalRule:
+    """One module-level global guarded by a module-level lock.  mode
+    as above; ``attrs=True`` additionally guards attribute WRITES
+    through the name (``_cfg.delta_enabled = ...``)."""
+
+    name: str
+    lock: str
+    mode: str = "rw"
+    attrs: bool = False
+
+
+MODULE_LOCKS: dict[str, tuple] = {
+    "ops/tape.py": (
+        ModuleGlobalRule("_counters", "_lock", "rw"),
+        ModuleGlobalRule("_lowered", "_lock", "rw"),
+    ),
+    "runtime/resultcache.py": (
+        # reads are the lock-free fast path (documented); rebinds only
+        # under the construction lock
+        ModuleGlobalRule("_global", "_global_lock", "w"),
+    ),
+    "ingest/compactor.py": (
+        ModuleGlobalRule("_global", "_global_lock", "w"),
+        ModuleGlobalRule("_refs", "_global_lock", "w"),
+    ),
+    "ingest/__init__.py": (
+        ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
+        ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+    ),
+}
+
+# ------------------------------------------------------ P2: mutation model
+
+
+@dataclass(frozen=True)
+class GenAuditRule:
+    """Generation-audit model for one class: methods that (directly or
+    via same-class helper calls) hit a mutation primitive or write a
+    mutation target must also (transitively) bump a generation
+    attribute.  ``primitives`` are the leaf write helpers themselves —
+    their CALLERS own the bump.  ``exempt`` maps method -> reason."""
+
+    bump_attrs: frozenset
+    primitives: frozenset
+    targets: frozenset          # attrs whose writes count as mutation
+    delta_mutators: frozenset   # method calls that write a delta plane
+    exempt: dict = field(default_factory=dict)
+
+
+GEN_AUDIT: dict[tuple, GenAuditRule] = {
+    ("models/fragment.py", "Fragment"): GenAuditRule(
+        bump_attrs=frozenset({"_gen", "_delta_seq"}),
+        primitives=frozenset({
+            "_apply_set", "_apply_clear", "_apply_bulk",
+            "_merge_roaring", "_merge_positions", "_row_array",
+        }),
+        targets=frozenset({"_rows"}),
+        delta_mutators=frozenset({"add_bit", "add_positions"}),
+        exempt={
+            "_replay_wal_file": "WAL replay applies records one file "
+                                "at a time; _replay_wal bumps _gen "
+                                "once after both files",
+        },
+    ),
+    ("models/field.py", "Field"): GenAuditRule(
+        bump_attrs=frozenset({"_gen", "_delta_seq"}),
+        primitives=frozenset(),
+        targets=frozenset({"_rows"}),
+        delta_mutators=frozenset({"add_bit", "add_positions"}),
+    ),
+}
+
+# ------------------------------------------------------ P3: blocking model
+
+#: (dotted-call suffixes, attr-call names) treated as blocking or
+#: device-dispatching.  ``.join``/``.result``/``.wait`` match by attr;
+#: string-constant receivers are excluded for ``join`` (str.join) and
+#: receivers named in CONDITION_ATTRS for ``wait`` (Condition.wait
+#: releases the lock while waiting — the one legitimate wait-under-
+#: lock).
+BLOCKING_CALL_SUFFIXES = (
+    "time.sleep",
+    "urllib.request.urlopen",
+    "socket.create_connection",
+    "jax.block_until_ready",
+)
+BLOCKING_ATTRS = ("join", "result", "wait", "block_until_ready",
+                  "urlopen")
+DEVICE_DISPATCH_NAMES = ("chunked_device_put", "device_put")
+CONDITION_ATTRS = ("_snap_done",)
+
+# ----------------------------------------------------- P4: recompile model
+
+#: Call suffixes that reach a jitted program whose lowering
+#: specializes on input shape.
+JIT_ENTRY_SUFFIXES = ("expr.evaluate", "tape.execute", "_tape.execute")
+#: Batch-stack builders whose output shape tracks their (variable)
+#: input length.
+STACK_BUILDER_SUFFIXES = ("jnp.stack", "jnp.concatenate", "np.stack",
+                          "numpy.stack")
+#: Referencing any of these names in the same function is the evidence
+#: the batch axis was routed through a pow2/size-class discipline.
+SHAPE_HELPER_NAMES = frozenset({
+    "_pow2", "pow2", "size_class", "_pad_batch", "_padded_rows",
+    "MIN_BUCKET", "prewarm",
+})
+#: jax attribute roots whose module-import-time CALLS are flagged
+#: (device init / tracing at import).  jax.jit/vmap wrapping is lazy
+#: and allowed.
+IMPORT_TIME_JAX_ROOTS = ("jnp", "jax")
+IMPORT_TIME_ALLOWED = ("jax.jit", "jax.vmap", "functools.partial",
+                       "jax.tree_util")
+
+# -------------------------------------------------------- P5: config model
+
+
+@dataclass(frozen=True)
+class ConfigGuardRule:
+    """One process-wide config surface: calling a mutator in a module
+    requires that module to also reference every name in ``pair`` —
+    the capture/restore (or retain/release) protocol that makes the
+    mutation reversible.  ``owner`` modules (the definition site) and
+    accessor-alias writes (``cfg = <x>.config(); cfg.attr = ...``)
+    are handled by the pass."""
+
+    mutator_suffixes: tuple
+    pair: tuple
+    owner_suffixes: tuple
+    what: str
+
+
+CONFIG_GUARDS = (
+    ConfigGuardRule(
+        mutator_suffixes=("ingest.configure", "_ingest.configure"),
+        pair=("capture_baseline", "restore_baseline"),
+        owner_suffixes=("ingest/__init__.py",),
+        what="the process-wide [ingest] runtime config",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("compactor.retain", "_compactor.retain"),
+        pair=("release",),
+        owner_suffixes=("ingest/compactor.py",),
+        what="the refcounted shared compactor scan thread",
+    ),
+)
+
+#: ``<x>.config()`` accessors whose result's attribute WRITES count as
+#: mutating the guarded config (same pairing requirement).
+CONFIG_ACCESSOR_SUFFIXES = ("ingest.config", "_ingest.config")
+
+# ------------------------------------------------------- P6: metric model
+
+#: Stats-registry method names whose first string-literal argument is
+#: a metric name.
+STATS_CALL_ATTRS = ("count", "count_with_tags", "gauge", "histogram",
+                    "timing")
+#: Free functions that feed the module counter registries (published
+#: as gauges at scrape time).
+STATS_CALL_FUNCS = ("bump",)
+#: Module-level dict literals whose string keys are metric names
+#: (ops/tape.py's counter registry).
+STATS_DICT_NAMES = ("_counters",)

@@ -231,31 +231,40 @@ def check_text(text: str) -> dict:
     return {"samples": n_samples, "metrics": len(sampled)}
 
 
-#: Metric-family prefixes (registry dot-names rendered with ``_``) the
-#: device-runtime telemetry must keep on /metrics — the live-server
-#: family check (``check_families``) pins these in tests/test_http.py.
-DEVICE_FAMILIES = ("device_", "compile_", "residency_")
+# Family lists come from the one declarative registry
+# (pilosa_tpu/metricfamilies.py) — a new family is declared exactly
+# once there and both this live checker and the tools/analyze P6
+# static drift pass consume it.  The per-subsystem constants below are
+# the long-standing public names tests import.  The tool must stay
+# runnable standalone (`python tools/check_metrics.py URL` from a
+# scraper box, any cwd), so bootstrap the repo root when the package
+# is not already importable.
+try:
+    from pilosa_tpu import metricfamilies as _mf
+except ImportError:  # direct-script invocation from outside the repo
+    import os as _os
+
+    sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))))
+    from pilosa_tpu import metricfamilies as _mf
+
+#: Device-runtime telemetry prefixes (devobs/residency/expr-compile).
+DEVICE_FAMILIES = _mf.live_prefixes("device")
 
 #: The query result cache's families (runtime/resultcache
-#: publish_gauges): cache.{hits,misses,fills,evictions,invalidations,
-#: bytes,...} rendered as cache_*.
-CACHE_FAMILIES = ("cache_",)
+#: publish_gauges), rendered as cache_*.
+CACHE_FAMILIES = _mf.live_prefixes("cache")
 
-#: Streaming-ingest families (ingest.compactor publish_gauges):
-#: ingest.{delta_writes,delta_bits,delta_rows,delta_bytes,
-#: fragments_pending,compactions,compacted_bits,inline_flushes,
-#: compact_skipped} rendered as ingest_*.
-INGEST_FAMILIES = ("ingest_",)
+#: Streaming-ingest families (ingest.compactor publish_gauges),
+#: rendered as ingest_*.
+INGEST_FAMILIES = _mf.live_prefixes("ingest")
 
-#: Ragged-megabatch families (ops/tape.publish_gauges):
-#: tape.{executions,queries,oversize_fallbacks,unsupported,prewarmed}
-#: rendered as tape_*, and the coalescer heterogeneity accounting
-#: coalescer.shape_{misses,flushes} rendered as coalescer_shape_*.
-TAPE_FAMILIES = ("tape_", "coalescer_shape_")
+#: Ragged-megabatch families (ops/tape.publish_gauges): tape_* plus
+#: the coalescer heterogeneity accounting coalescer_shape_*.
+TAPE_FAMILIES = _mf.live_prefixes("tape")
 
 #: Everything the ``--families`` CLI mode requires of a live server.
-ALL_FAMILIES = (DEVICE_FAMILIES + CACHE_FAMILIES + INGEST_FAMILIES
-                + TAPE_FAMILIES)
+ALL_FAMILIES = _mf.live_prefixes()
 
 
 def check_families(text: str, prefixes=DEVICE_FAMILIES) -> dict[str, int]:
