@@ -530,14 +530,21 @@ def bench_coalescer(a_np: np.ndarray,
     # off-then-on pair confounds the delta with load drift on a busy
     # host (observed swings of tens of percent between identical runs,
     # far above any real recorder cost), while medians of alternating
-    # short windows see the same ambient load on both sides.
+    # short windows see the same ambient load on both sides.  Both
+    # phases are PRE-WARMED by a throwaway window first and the side
+    # that goes first alternates per iteration — before this, the
+    # off-phase always ran first and ate the serving-path warmup
+    # (thread ramp, allocator), which made recorder-ON measure FASTER
+    # than off (overhead_pct -26.2 in BENCH_r06, a nonsense number).
     ex.recorder.stats = stats
+    run_load(0.6)  # warmup window: not recorded on either side
     offs, ons = [], []
-    for _ in range(3):
-        ex.recorder.enabled = False
-        offs.append(run_load(0.6))
-        ex.recorder.enabled = True
-        ons.append(run_load(0.6))
+    for i in range(3):
+        order = ((False, True) if i % 2 == 0 else (True, False))
+        for rec_on in order:
+            ex.recorder.enabled = rec_on
+            (ons if rec_on else offs).append(run_load(0.6))
+    ex.recorder.enabled = True
     qps_off = sorted(offs)[1]
     qps_on = sorted(ons)[1]
     # The noise-free overhead figure: the recorder's own begin+publish
@@ -605,8 +612,12 @@ def bench_coalescer(a_np: np.ndarray,
     obs = {
         "qps_recorder_on": round(qps_on, 2),
         "qps_recorder_off": round(qps_off, 2),
-        # medians of interleaved windows; negative = within noise
-        "overhead_pct": round((qps_off - qps_on) / qps_off * 100.0, 2),
+        # medians of warmed, order-alternated windows, floored at 0:
+        # a negative delta is measurement noise, not a speedup, and
+        # the artifact's overhead figure must stay meaningful (the raw
+        # qps pair above carries the unclamped evidence)
+        "overhead_pct": round(
+            max(0.0, (qps_off - qps_on) / qps_off * 100.0), 2),
         # per-query recorder cost as a share of the measured per-query
         # service time — the number the <1% budget is judged on
         "record_cost_us": round(record_cost_us, 2),
@@ -1052,6 +1063,134 @@ def bench_ingest(a_np: np.ndarray, b_np: np.ndarray) -> dict | None:
     return out
 
 
+def bench_containers() -> dict | None:
+    """Sparse/dense A/B of the compressed container-directory engine
+    (ops/containers.py — the roaring-on-TPU representation change):
+
+    - builds a ≤1%-fill CLUSTERED synthetic index (each row's bits
+      confined to 2 of the 16 containers per shard — the shape real
+      sparse bitmap rows take, and exactly what roaring's container
+      specialization exists for) plus a dense ~50%-fill control,
+    - measures the same Count(Intersect(...)) workload with the
+      engine enabled vs disabled (``[containers] enabled`` — disabled
+      IS the pre-container dense fused path, byte-identical),
+    - reports resident device bytes both ways (dense stacks vs pooled
+      container blocks, from the residency manager's kind split) and
+      the achieved streaming rates, every sample verified against a
+      host-computed expected count.
+
+    Returns None under a non-default shard width (the container
+    geometry assumes 2^20-column shards here).  CPU-fallback numbers
+    are acceptable for the artifact; the chip capture slot rides the
+    main JSON line like every other extras phase."""
+    import tempfile
+
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.ops import bitmap as bm
+    from pilosa_tpu.ops import containers as ct
+    from pilosa_tpu.parallel.executor import Executor
+    from pilosa_tpu.runtime import residency
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    if bm.n_words(SHARD_WIDTH) != WORDS:
+        return None
+    CT_SHARDS = 32
+    FILL = 0.01
+    bits_per_row = int(FILL * SHARD_WIDTH)      # ~10.5k bits/shard-row
+    rng = np.random.default_rng(12348)
+    holder = Holder(tempfile.mkdtemp() + "/bench-ct")
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    view = f.create_view_if_not_exists("standard")
+    truth: dict[int, set] = {10: set(), 11: set()}
+    for s in range(CT_SHARDS):
+        frag = view.create_fragment_if_not_exists(s)
+        # clustered: all bits inside containers 0-1 (128Ki bits); the
+        # shared half is drawn ONCE per shard so rows 10 and 11 really
+        # intersect in ~bits_per_row/2 positions (drawing it inside
+        # the row loop made the sets independent and the measured
+        # intersection mostly random overlap)
+        shared = rng.choice(1 << 17, size=bits_per_row // 2,
+                            replace=False)
+        for r in (10, 11):
+            own = rng.choice(1 << 17, size=bits_per_row // 2,
+                             replace=False)
+            pos = np.unique(np.concatenate([shared, own]))
+            frag.import_positions((r * SHARD_WIDTH + pos)
+                                  .astype(np.uint64))
+            truth[r].update((s * SHARD_WIDTH + pos).tolist())
+        f._note_shard(s)
+    ex = Executor(holder)
+    from pilosa_tpu.runtime import resultcache as _resultcache
+
+    rc_was = _resultcache.cache().enabled
+    ct.retain()  # baseline-snapshot the [containers] config we flip
+    _resultcache.cache().enabled = False  # measure the dispatch path
+    q = "Count(Intersect(Row(f=10), Row(f=11)))"
+    expect = len(truth[10] & truth[11])
+
+    def timed(seconds: float) -> float:
+        got = int(ex.execute("i", q)[0])  # warm + verify
+        if got != expect:
+            raise AssertionError(f"containers bench: {got} != {expect}")
+        n = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            if int(ex.execute("i", q)[0]) != expect:
+                raise AssertionError("containers bench: drift mid-run")
+            n += 1
+        return n / (time.perf_counter() - t0)
+
+    try:
+        ct.configure(enabled=True)
+        ct.reset_counters()
+        qps_compressed = timed(1.0)
+        gathered = ct.counters()["container.containers_gathered"]
+        queries = max(1, ct.counters()["container.queries"])
+        # THIS workload's pooled leaves, not the process-wide kind
+        # split (earlier bench phases leave their own residency
+        # behind); the /debug/devices residency.kinds gauge carries
+        # the live total
+        compressed_bytes = sum(
+            f.device_container_leaf(r, tuple(range(CT_SHARDS))).nbytes
+            for r in (10, 11))
+        assert (residency.manager().stats().get("kinds") or {}).get(
+            "compressed", 0) >= compressed_bytes
+        ct.configure(enabled=False)
+        qps_dense = timed(1.0)
+    finally:
+        # restore the pre-bench [containers] baseline and the result
+        # cache, and close the holder, no matter which phase raised
+        ct.release()
+        _resultcache.cache().enabled = rc_was
+        holder.close()
+    # dense layout residency for the same two leaves: 2 row stacks of
+    # [shards, words] uint32
+    dense_bytes = 2 * CT_SHARDS * WORDS * 4
+    per_query_compressed = gathered / queries * ct.CWORDS * 4
+    out = {
+        "fill": FILL,
+        "shards": CT_SHARDS,
+        "qps_compressed": round(qps_compressed, 2),
+        "qps_dense": round(qps_dense, 2),
+        "speedup": round(qps_compressed / qps_dense, 2),
+        "resident_bytes_dense": dense_bytes,
+        "resident_bytes_compressed": compressed_bytes,
+        "bytes_ratio": round(dense_bytes / max(1, compressed_bytes), 1),
+        # bytes the compressed launch actually streams per query vs
+        # the dense layout's full-stack read
+        "achieved_gbps_compressed": round(
+            qps_compressed * per_query_compressed / 1e9, 2),
+        "achieved_gbps_dense": round(
+            qps_dense * dense_bytes / 1e9, 2),
+        # acceptance pins: >=4x lower resident bytes at <=1% fill, and
+        # the sparse workload at least matching the dense path
+        "pin_bytes_ok": dense_bytes >= 4 * max(1, compressed_bytes),
+        "pin_qps_ok": qps_compressed >= 0.95 * qps_dense,
+    }
+    return out
+
+
 def bench_admission(coalescer_extras: dict | None) -> dict:
     """Admission-layer overhead on the uncontended serving path: the
     gate's acquire+release pair is what every admitted request pays on
@@ -1207,6 +1346,9 @@ def main():
     ing = bench_ingest(a, b)
     if ing is not None:
         extras["ingest"] = ing
+    ctn = bench_containers()
+    if ctn is not None:
+        extras["containers"] = ctn
     bytes_per_query = a.nbytes + b.nbytes  # streamed once per query
     achieved_gbps = dev_qps * bytes_per_query / 1e9
     peak = _peak_gbps(platform)

@@ -94,7 +94,8 @@ class API:
     def query(self, index: str, pql, shards=None, remote: bool = False,
               column_attrs: bool = False, exclude_row_attrs: bool = False,
               exclude_columns: bool = False, coalesce: bool = True,
-              cache: bool = True, delta: bool = True):
+              cache: bool = True, delta: bool = True,
+              containers: bool = True):
         """Execute PQL -> list of results (api.go:135 API.Query)."""
         from pilosa_tpu.parallel.executor import ExecOptions
         from pilosa_tpu.serve import deadline as _deadline
@@ -169,6 +170,7 @@ class API:
             coalesce=coalesce,
             cache=cache,
             delta=delta,
+            containers=containers,
             deadline=dl,
         )
         return self.executor.execute(index, pql, opt=opt)

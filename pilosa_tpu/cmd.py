@@ -81,6 +81,16 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--ragged-max-leaves", type=int,
                     help="most leaf operand stacks a query may stage "
                          "into a ragged bucket ([ragged] max-leaves)")
+    ps.add_argument("--no-containers", action="store_true",
+                    help="disable the compressed container-directory "
+                         "device layout ([containers] enabled=false): "
+                         "every fused read routes the dense "
+                         "pre-container path")
+    ps.add_argument("--containers-threshold", type=float,
+                    help="per-fragment fill-ratio ceiling for "
+                         "compressed execution ([containers] "
+                         "threshold); rows denser than this stay on "
+                         "the dense path")
     ps.add_argument("--no-ingest-delta", action="store_true",
                     help="disable streaming-ingest delta planes "
                          "([ingest] delta-enabled=false): every write "
@@ -196,6 +206,10 @@ def cmd_server(args) -> int:
         v = getattr(args, f"ragged_{key}", None)
         if v is not None:
             setattr(cfg.ragged, key, v)
+    if args.no_containers:
+        cfg.containers.enabled = False
+    if args.containers_threshold is not None:
+        cfg.containers.threshold = args.containers_threshold
     if args.no_ingest_delta:
         cfg.ingest.delta_enabled = False
     for key in ("delta_budget_bytes", "compact_threshold_bits",
@@ -292,6 +306,8 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         cache_max_entry_bytes=cfg.cache.max_entry_bytes,
         cache_ttl=cfg.cache.ttl,
         ingest_delta_enabled=cfg.ingest.delta_enabled,
+        containers_enabled=cfg.containers.enabled,
+        containers_threshold=cfg.containers.threshold,
         ingest_delta_budget_bytes=cfg.ingest.delta_budget_bytes,
         ingest_compact_threshold_bits=cfg.ingest.compact_threshold_bits,
         ingest_compact_interval=cfg.ingest.compact_interval,

@@ -181,6 +181,26 @@ class IngestConfig:
 
 
 @dataclass
+class ContainersConfig:
+    """[containers] — the compressed container-directory device layout
+    (ops/containers.py; the reference's entire performance story:
+    Chambi et al. / Lemire et al. roaring container specialization,
+    ported to device).  With ``enabled`` on, fused Row/Count reads
+    whose leaf rows are sparse execute over pooled non-empty 2^16-bit
+    container blocks — resident device bytes track real data instead
+    of shards x shard-width, and absent containers are skipped
+    entirely.  ``threshold`` is the per-fragment fill-ratio ceiling
+    (set bits / shard width) above which a row is considered hot and
+    the query keeps the dense fused path (the dense layout is the
+    right engine for hot rows).  Per-request escape:
+    ``?nocontainers=1`` on the query route — results are bit-identical
+    either way."""
+
+    enabled: bool = True
+    threshold: float = 0.25
+
+
+@dataclass
 class AdmissionConfig:
     """[admission] — priority-classed admission control + load
     shedding on the serving path (serve/admission.py; no reference
@@ -235,6 +255,8 @@ class Config:
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     cache: CacheConfig = field(default_factory=CacheConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
+    containers: ContainersConfig = field(
+        default_factory=ContainersConfig)
 
     # ------------------------------------------------------------- access
 
@@ -271,8 +293,8 @@ class Config:
             key = k.replace("-", "_")
             if key in ("cluster", "anti_entropy", "metric", "tracing",
                        "profile", "tls", "coalescer", "ragged",
-                       "observe", "admission", "cache",
-                       "ingest") and isinstance(v, dict):
+                       "observe", "admission", "cache", "ingest",
+                       "containers") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -290,7 +312,8 @@ class Config:
                                                         ObserveConfig,
                                                         AdmissionConfig,
                                                         CacheConfig,
-                                                        IngestConfig)):
+                                                        IngestConfig,
+                                                        ContainersConfig)):
                 setattr(self, key, v)
 
     def _apply_env(self, env: dict) -> None:
@@ -299,7 +322,8 @@ class Config:
         for f in fields(self):
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
                           "profile", "tls", "coalescer", "ragged",
-                          "observe", "admission", "cache", "ingest"):
+                          "observe", "admission", "cache", "ingest",
+                          "containers"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -392,6 +416,10 @@ class Config:
             f"compact-threshold-bits = "
             f"{self.ingest.compact_threshold_bits}",
             f"compact-interval = {self.ingest.compact_interval}",
+            "",
+            "[containers]",
+            f"enabled = {str(self.containers.enabled).lower()}",
+            f"threshold = {self.containers.threshold}",
             "",
             "[tls]",
             f'certificate-path = "{self.tls.certificate_path}"',

@@ -230,6 +230,12 @@ class DeviceObserver:
         stats.gauge("residency.admits", r.get("admits", 0))
         stats.gauge("residency.high_water_bytes",
                     r.get("high_water", r["total"]))
+        kinds = r.get("kinds") or {}
+        # the compressed-vs-dense residency split (roaring-on-TPU
+        # container pools vs dense plane tensors, ops/containers.py)
+        stats.gauge("residency.dense_bytes", kinds.get("dense", 0))
+        stats.gauge("residency.compressed_bytes",
+                    kinds.get("compressed", 0))
         for d in self.device_memory():
             if d.get("bytesInUse") is None:
                 continue

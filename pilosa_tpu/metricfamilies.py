@@ -88,6 +88,11 @@ FAMILIES: tuple[Family, ...] = (
            "ragged op-tape interpreter (ops/tape.py)",
            live_prefixes=("tape_",), group="tape",
            doc="architecture.md"),
+    Family("container", "container_",
+           "compressed container-directory execution engine "
+           "(ops/containers.py)",
+           live_prefixes=("container_",), group="container",
+           doc="architecture.md"),
     Family("coalescer", "coalescer_",
            "cross-query batching window (parallel/coalescer.py); the "
            "shape_* heterogeneity counters are pinned on live "

@@ -606,6 +606,97 @@ class Field:
                                    max_entries=64)
         return pair
 
+    def device_container_leaf(self, row_id: int, shards: tuple[int, ...]):
+        """One standard-view row across the shard set in POOLED
+        compressed form (ops/containers.ContainerLeaf): each shard's
+        non-empty 2^16-bit containers (Fragment.row_containers)
+        concatenate into one device word pool, driven by the host-side
+        per-shard directory — the compressed analog of
+        device_row_stack, cached alongside it under the same BASE
+        generation tokens (delta writes leave it warm; the engine
+        routes delta-touched rows dense).  The residency manager
+        accounts the REAL compressed bytes under kind="compressed", so
+        a sparse row costs HBM proportional to its containers, not to
+        shards x shard-width — the capacity multiplier of the roaring
+        layout."""
+        from pilosa_tpu.ops import containers as ct
+
+        view = self.view(VIEW_STANDARD)
+        frags = [None if view is None else view.fragment(s)
+                 for s in shards]
+        # the fill-ratio threshold joins the token: a cached leaf
+        # froze each fragment's sparse-vs-hot verdict, so a runtime
+        # [containers] threshold change must miss and re-evaluate —
+        # not wait for the next base mutation
+        gens = (ct.config().threshold,
+                *(_frag_base_gen(fr) for fr in frags))
+        key = ("cont", row_id, shards)
+        with self._lock:
+            hit = self._row_stack_cache.get(key)
+            if (hit is not None and hit[0] == gens
+                    and _live(hit[1].pool)):
+                self._touch(self._row_stack_cache, key)
+                return hit[1]
+        entries: list = []
+        starts: list[int] = []
+        kinds: list = []
+        blocks_list: list[np.ndarray] = []
+        n = 0
+        for fr in frags:
+            starts.append(n)
+            if fr is None:
+                entries.append(np.empty(0, dtype=np.int64))
+                kinds.append(np.empty(0, dtype=np.uint8))
+                continue
+            rc = fr.row_containers(row_id)
+            if rc is None:
+                # hot row in this fragment: dense-fallback evidence
+                entries.append(None)
+                kinds.append(None)
+                continue
+            keys, blocks, _bits = rc
+            entries.append(keys)
+            # kind 1 = dense bitmap block (array/run kinds reserved)
+            kinds.append(np.ones(len(keys), dtype=np.uint8))
+            if len(keys):
+                blocks_list.append(blocks)
+                n += len(keys)
+        # >= 1 zero tail row: gather index n is the canonical
+        # absent-container block.  On device the row count pads to
+        # pow2 so the gather programs lower O(log) distinct shapes; in
+        # host mode there is no jit specialization to bound, and the
+        # tight pool keeps resident bytes equal to real data
+        from pilosa_tpu.ops import bitmap as bm
+
+        rows = n + 1 if bm.host_mode() else ct._pow2(n + 1)
+        pool = np.zeros((rows, ct.CWORDS), dtype=np.uint32)
+        if blocks_list:
+            pool[:n] = np.concatenate(blocks_list, axis=0)
+        leaf = ct.ContainerLeaf(shards, entries, starts, kinds,
+                                self._place_pool(pool), n, pool.nbytes)
+        if pool.nbytes <= self._entry_cap(self.ROW_STACK_CACHE_BYTES):
+            self._evict_and_insert(self._row_stack_cache, key,
+                                   (gens, leaf), pool.nbytes,
+                                   max_entries=64, kind="compressed")
+        return leaf
+
+    @staticmethod
+    def _place_pool(pool: np.ndarray):
+        """Place a container word pool: host numpy in host mode, one
+        local-device upload otherwise.  Deliberately NOT mesh-sharded
+        like the dense stacks — pools are gather operands whose row
+        count tracks data, not the shard axis."""
+        import jax
+
+        from pilosa_tpu.ops import bitmap as bm
+
+        if bm.host_mode():
+            return np.ascontiguousarray(pool)
+        if jax.process_count() > 1:
+            return bm.chunked_device_put(pool, jax.local_devices()[0],
+                                         label="field.containers")
+        return bm.chunked_device_put(pool, label="field.containers")
+
     def flush_deltas(self, shards=None) -> int:
         """Merge every pending delta of this field's fragments into
         base state (the ``?nodelta=1`` escape and test barrier).
@@ -620,7 +711,7 @@ class Field:
         return merged
 
     def _evict_and_insert(self, cache: dict, key, entry, entry_bytes: int,
-                          max_entries: int) -> None:
+                          max_entries: int, kind: str = "dense") -> None:
         """Insert under the entry cap; BYTE budgeting is global — the
         process-wide residency manager sees every owner's device caches
         and LRU-evicts across all of them, so the true device total is
@@ -644,7 +735,7 @@ class Field:
                 cache.pop(k, None)
                 mgr.forget(cache, k)
             cache[key] = entry
-            mgr.admit(cache, key, entry_bytes)
+            mgr.admit(cache, key, entry_bytes, kind=kind)
 
     #: device-memory budget for concatenated matrix stacks (bytes)
     MATRIX_STACK_CACHE_BYTES = 512 << 20

@@ -100,6 +100,12 @@ class Fragment:
         self._snapshotting = False
         self._stack_cache: tuple[int, np.ndarray, np.ndarray] | None = None
         self._device_cache: dict = {}
+        # compressed container directories (ops/containers.py): row ->
+        # (gen, keys, blocks, bits); gen-stamped like _stack_cache, so
+        # every mutation path invalidates by bumping _gen — no new
+        # invalidation machinery, and delta-landing writes (which bump
+        # _delta_seq only) leave the BASE directory warm by design
+        self._container_cache: dict = {}
         from pilosa_tpu import lockcheck
 
         self._lock = lockcheck.rlock("fragment")
@@ -1227,6 +1233,54 @@ class Fragment:
 
             return jnp.zeros(self.n_words, dtype=jnp.uint32)
         return dev[int(slot)]
+
+    def row_containers(self, row: int):
+        """One BASE row in compressed container-directory form:
+        ``(keys int64[n], blocks uint32[n, 2048], bits)`` holding only
+        the row's non-empty 2^16-bit containers — the host half of the
+        roaring-on-TPU layout (ops/containers.py), the exact
+        ``(keys, 1024x64-bit blocks)`` shape storage/roaring.py decodes
+        — or ``None`` when the row is too dense to benefit (fill ratio
+        ``bits/width`` above the [containers] threshold: the dense
+        fused path stays the right engine for hot rows).  Cached per
+        base generation; a pending delta plane does NOT invalidate
+        (the engine routes delta-touched rows dense instead)."""
+        from pilosa_tpu.ops import containers as ct
+
+        with self._lock:
+            hit = self._container_cache.get(row)
+            if hit is not None and hit[0] == self._gen:
+                _g, keys, blocks, bits = hit
+            else:
+                while len(self._container_cache) >= 1024:
+                    self._container_cache.pop(
+                        next(iter(self._container_cache)))
+                arr = self._rows.get(row)
+                bits = (0 if arr is None
+                        else int(np.bitwise_count(arr)
+                                 .sum(dtype=np.uint64)))
+                # hot rows cache ONLY the bit count (keys=None): the
+                # block build would copy the whole dense row per
+                # queried row, for a path that falls back anyway
+                keys = blocks = None
+                self._container_cache[row] = (self._gen, keys, blocks,
+                                              bits)
+            if bits > ct.config().threshold * self.width:
+                return None
+            if keys is None:
+                # sparse (under the CURRENT threshold) but not yet
+                # built — materialize the directory now
+                arr = self._rows.get(row)
+                if arr is None or bits == 0:
+                    keys = np.empty(0, dtype=np.int64)
+                    blocks = np.empty((0, ct.CWORDS), dtype=np.uint32)
+                else:
+                    grid = arr.reshape(-1, ct.CWORDS)
+                    keys = np.flatnonzero(grid.any(axis=1))
+                    blocks = grid[keys].copy()
+                self._container_cache[row] = (self._gen, keys, blocks,
+                                              bits)
+            return keys, blocks, bits
 
     def device_planes(self, depth: int):
         """BSI plane stack uint32[2 + depth, words] resident on device;

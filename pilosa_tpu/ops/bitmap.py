@@ -472,6 +472,32 @@ def _jit_row_counts_gathered(mat, filt_stack, shard_pos):
     )
 
 
+def gathered_pair_counts(a_pool, ai, b_pool, bi):
+    """Per-pair |a_pool[ai[p]] & b_pool[bi[p]]| -> int32[P] — the
+    compressed-container IntersectionCount core (ops/containers.py):
+    both gathers, the AND, the popcount and the per-container reduce
+    fuse into one kernel, and only directory-matched container blocks
+    are ever read (the dense layout's zero words are never streamed).
+    Pool rows past the directory's count are zeros, so an absent-
+    container index contributes 0 — the roaring co-present-container
+    walk (roaring/roaring.go:570) as a gather."""
+    note_dispatch("gathered_pair_counts")
+    if _host(a_pool, b_pool):
+        from pilosa_tpu.ops import hostkernels as hk
+
+        return hk.row_counts_and(a_pool[np.asarray(ai)],
+                                 b_pool[np.asarray(bi)])
+    return _jit_gathered_pair_counts(a_pool, ai, b_pool, bi)
+
+
+@jax.jit
+def _jit_gathered_pair_counts(a_pool, ai, b_pool, bi):
+    a = jnp.take(a_pool, ai, axis=0, mode="clip")
+    b = jnp.take(b_pool, bi, axis=0, mode="clip")
+    return jnp.sum(lax.population_count(jnp.bitwise_and(a, b)),
+                   axis=-1, dtype=jnp.int32)
+
+
 def masked_matrix_counts(mat, masks):
     """counts[g, r] = |mat[r] & masks[g]| -> int32[G, rows]; see
     _jit_masked_matrix_counts for the device story."""
@@ -613,6 +639,7 @@ for _n in ("_jit_and", "_jit_or", "_jit_xor", "_jit_andnot", "_jit_not",
            "_jit_row_counts", "_jit_row_counts_and",
            "_jit_row_counts_masked", "_jit_row_counts_gathered",
            "_jit_masked_matrix_counts", "_jit_and_pairs",
+           "_jit_gathered_pair_counts",
            "_jit_set_bits", "_jit_clear_bits", "_jit_get_bits",
            "_jit_reduce_or_rows", "_jit_reduce_and_rows"):
     globals()[_n] = _devobs.instrument(f"bitmap.{_n[5:]}", globals()[_n])

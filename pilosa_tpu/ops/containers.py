@@ -1,0 +1,538 @@
+"""Roaring-on-TPU: compressed container-directory execution engine.
+
+Fragments have lived on device as fully dense bit planes, so a sparse
+row spends ~all of its HBM traffic reading zero words and HBM capacity
+caps the column count per chip (BENCH_r05: bw_util 0.148).  The
+reference's entire performance story is container specialization
+(Chambi et al., "Better bitmap performance with Roaring bitmaps";
+Lemire et al., "Consistently faster and smaller compressed bitmaps
+with Roaring"): a row decomposes into 2^16-bit containers and only the
+non-empty ones exist.  This module ports that idea to the device:
+
+- **Layout** — per fragment row, the non-empty 1024x64-bit (= 2048
+  uint32-word) containers are materialized into a contiguous device
+  WORD POOL, driven by a small host-side DIRECTORY (per row: container
+  keys, pool offsets, kind).  ``storage/roaring.py`` already decodes
+  official roaring into exactly this ``(keys, 1024-word blocks)``
+  shape, so the host side is a re-plumb, not a rewrite
+  (``Fragment.row_containers`` builds it straight off the row words;
+  ``Field.device_container_leaf`` pools a row's containers across the
+  query's shard set and uploads once, cached under the same base
+  generation tokens as the dense row stacks).
+- **Execution** — a fused-supported expression tree evaluates over
+  compressed leaves by (1) walking the leaf directories on host and
+  computing the ROOT's container-key domain per shard with roaring's
+  set rules (Intersect intersects key sets, Union/Xor unions,
+  Difference keeps the left side, Not keeps the existence row's keys
+  — containers absent from the domain are never touched, and two
+  disjoint sparse rows intersect in ZERO device work), then (2)
+  launching ONE jitted gather-program over the pooled operands
+  (``ops/expr.evaluate_gathered``: per-leaf ``take`` from its pool +
+  the same fused tree body + the optional popcount Count root, all
+  inside one launch).  Domains and pools pad to powers of two so the
+  lowered-program count stays O(log), never one per query shape (the
+  PR-6 recompile-convoy lesson, enforced by pilosa-lint P4).
+- **Fallback** — hot/full rows stay dense: a fragment row whose fill
+  ratio (set bits / shard width) exceeds the ``[containers]``
+  threshold marks its query dense, and the query routes through the
+  exact pre-existing dense fused path (also the ``?nocontainers=1``
+  escape, the ``[containers] enabled=false`` switch, pending ingest
+  deltas on a queried row, and trees with non-row leaves — BSI
+  ranges, time ranges, Shift).  The fallback is query-level by design
+  so a fused read always costs exactly ONE launch either way (the
+  dispatch-count pins across the suite stay valid).
+
+Process-wide configuration mirrors ``pilosa_tpu.ingest``: ``configure``
+applies explicit values in place, the FIRST server to retain() captures
+the pre-server baseline and the LAST to release() restores it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+import numpy as np
+
+#: Container geometry: 2^16 bits = 1024 uint64 = 2048 uint32 words —
+#: the reference's container size and storage/roaring.py's block shape.
+CONTAINER_BITS = 1 << 16
+CWORDS = CONTAINER_BITS // 32
+
+DEFAULT_THRESHOLD = 0.25
+
+
+def _pow2(n: int) -> int:
+    """Smallest power of two >= n (domain/pool padding so the gather
+    programs lower O(log) distinct shapes, not one per query)."""
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+# ------------------------------------------------------------ runtime config
+
+
+class ContainersRuntimeConfig:
+    """The process-wide [containers] knobs (one per process, like the
+    residency budget and the [ingest] runtime config)."""
+
+    __slots__ = ("enabled", "threshold")
+
+    def __init__(self) -> None:
+        self.enabled = True
+        self.threshold = DEFAULT_THRESHOLD
+
+
+_cfg = ContainersRuntimeConfig()
+_cfg_lock = threading.Lock()
+_baseline: tuple | None = None
+_refs = 0
+
+
+def config() -> ContainersRuntimeConfig:
+    return _cfg
+
+
+def configure(enabled: bool | None = None,
+              threshold: float | None = None) -> ContainersRuntimeConfig:
+    """Apply [containers] config in place — only explicit values land,
+    so a second in-process server cannot wipe the first's settings
+    with defaults (same contract as ingest.configure)."""
+    with _cfg_lock:
+        if enabled is not None:
+            _cfg.enabled = bool(enabled)
+        if threshold is not None:
+            _cfg.threshold = float(threshold)
+    return _cfg
+
+
+def retain() -> None:
+    """Take a server reference; the FIRST holder snapshots the
+    pre-server baseline config (restore composes correctly under any
+    close order — the PR-6 [ingest] lesson, pilosa-lint P5)."""
+    global _refs, _baseline
+    with _cfg_lock:
+        if _refs == 0 and _baseline is None:
+            _baseline = (_cfg.enabled, _cfg.threshold)
+        _refs += 1
+
+
+def release() -> None:
+    """Drop a server reference; the LAST holder restores the captured
+    baseline for every other user of the process."""
+    global _refs, _baseline
+    with _cfg_lock:
+        if _refs > 0:
+            _refs -= 1
+        if _refs == 0 and _baseline is not None:
+            _cfg.enabled, _cfg.threshold = _baseline
+            _baseline = None
+
+
+def reset() -> ContainersRuntimeConfig:
+    """Restore defaults and drop any held baseline (tests)."""
+    global _cfg, _baseline, _refs
+    with _cfg_lock:
+        _cfg = ContainersRuntimeConfig()
+        _baseline = None
+        _refs = 0
+    return _cfg
+
+
+# ---------------------------------------------------------------- counters
+
+_lock = threading.Lock()
+_counters = {
+    "container.queries": 0,             # fused reads served compressed
+    "container.fallbacks": 0,           # eligible trees routed dense
+                                        # (hot rows / pending deltas)
+    "container.containers_gathered": 0,  # domain containers launched
+    "container.containers_skipped": 0,   # dense-layout containers the
+                                         # directory walk never touched
+    "container.empty_domains": 0,       # whole-query zero-work answers
+}
+
+
+def bump(name: str, value: int = 1) -> None:
+    with _lock:
+        _counters[name] += value
+
+
+def counters() -> dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+
+
+def publish_gauges(stats: Any) -> None:
+    """Push the container.* family into a stats registry at scrape
+    time — cumulative values as gauges, same rule as tape/devobs
+    publish_gauges (re-publishing a cumulative total through a counter
+    would double-count)."""
+    for name, value in counters().items():
+        stats.gauge(name, value)
+
+
+def debug() -> dict[str, Any]:
+    """The container section of the debug surface: config in force,
+    counters, and the residency split (compressed vs dense bytes are
+    on /debug/devices via residency.kinds)."""
+    return {
+        "enabled": _cfg.enabled,
+        "threshold": _cfg.threshold,
+        "counters": counters(),
+    }
+
+
+# -------------------------------------------------------------- leaf pooling
+
+
+import itertools as _itertools
+
+_LEAF_UID = _itertools.count(1)
+
+
+class ContainerLeaf:
+    """One expression leaf (a standard-view row across the query's
+    shard set) in pooled compressed form.
+
+    ``entries[i]`` describes shard ``shards[i]``: ``None`` for a
+    hot/ineligible fragment row (dense fallback evidence), else a
+    sorted int64 key array of the row's non-empty container slots
+    (possibly empty).  ``starts[i]`` is the shard's base offset into
+    the pool; ``pool`` is the uint32[P, CWORDS] block pool (host numpy
+    in host mode, device array otherwise) whose rows [n:] are zeros —
+    gather index ``n`` is the canonical absent-container row.  ``kinds``
+    mirrors the directory's per-container kind byte (1 = dense bitmap
+    block; array/run specializations are future kinds — the directory
+    carries the slot from day one so the layout doesn't change when
+    they land).
+    """
+
+    __slots__ = ("shards", "entries", "starts", "kinds", "pool", "n",
+                 "nbytes", "uid")
+
+    def __init__(self, shards: tuple, entries: list, starts: list,
+                 kinds: list, pool: Any, n: int, nbytes: int) -> None:
+        self.shards = shards
+        self.entries = entries
+        self.starts = starts
+        self.kinds = kinds
+        self.pool = pool
+        self.n = n
+        self.nbytes = nbytes
+        # identity for the staging memo: a rebuilt leaf (any base
+        # mutation) is a NEW object with a fresh uid, so stale staged
+        # gathers can never be addressed
+        self.uid = next(_LEAF_UID)
+
+    def dense_slots(self) -> list[int]:
+        """Shard positions whose fragment row is too hot to compress."""
+        return [i for i, e in enumerate(self.entries) if e is None]
+
+
+# ------------------------------------------------------------ domain algebra
+
+
+def _domain(shape: tuple, keysets: list) -> np.ndarray:
+    """The ROOT's container-key domain for one shard: the minimal set
+    of container keys that can hold a set bit of the result, from the
+    leaves' key sets by roaring's per-op rules.  Containers outside
+    the domain are skipped entirely — for Intersect that is exactly
+    the reference's co-present-container walk
+    (roaring.Intersect, roaring/roaring.go:595)."""
+    kind = shape[0]
+    if kind == "leaf":
+        return keysets[shape[1]]
+    if kind == "and":
+        out = _domain(shape[1], keysets)
+        for c in shape[2:]:
+            out = np.intersect1d(out, _domain(c, keysets),
+                                 assume_unique=True)
+        return out
+    if kind in ("or", "xor"):
+        out = _domain(shape[1], keysets)
+        for c in shape[2:]:
+            out = np.union1d(out, _domain(c, keysets))
+        return out
+    if kind == "andnot":
+        # a \ b can only be non-empty where a is
+        return _domain(shape[1], keysets)
+    if kind == "not":
+        # exist & ~child lives inside the existence row's containers
+        return _domain(shape[1], keysets)
+    raise ValueError(f"container-ineligible node: {kind!r}")
+
+
+def _leaf_indices(leaf: ContainerLeaf, domains: list[np.ndarray],
+                  pad_to: int) -> np.ndarray:
+    """Gather indices into ``leaf.pool`` for the concatenated per-shard
+    domains; absent containers (and the pow2 tail padding) point at the
+    pool's canonical zero row."""
+    zero = leaf.n
+    parts: list[np.ndarray] = []
+    for i, dom in enumerate(domains):
+        if len(dom) == 0:
+            continue
+        keys = leaf.entries[i]
+        if keys is None or len(keys) == 0:
+            parts.append(np.full(len(dom), zero, dtype=np.int32))
+            continue
+        pos = np.searchsorted(keys, dom)
+        pos_c = np.minimum(pos, len(keys) - 1)
+        hit = keys[pos_c] == dom
+        idx = np.where(hit, leaf.starts[i] + pos_c, zero)
+        parts.append(idx.astype(np.int32))
+    total = sum(len(p) for p in parts)
+    out = np.full(pad_to, zero, dtype=np.int32)
+    if parts:
+        np.concatenate(parts, out=out[:total])
+    return out
+
+
+# Staged-gather memo: (shape, leaf uids) -> (domains, bounds, total,
+# idxs).  The domain algebra and searchsorted index builds are pure
+# functions of the leaf directories, which are themselves cached per
+# base generation — recomputing them per query would put ~0.5 ms of
+# host numpy on a hot path whose whole launch costs less.  Leaf uids
+# change on every rebuild, so stale entries simply stop being
+# addressed; the LRU cap bounds memory.
+_stage_lock = threading.Lock()
+_stage_memo: dict = {}
+_STAGE_MEMO_CAP = 256
+
+
+# ------------------------------------------------------------------ planning
+
+
+class Plan:
+    """A fused read staged for compressed execution over ALL its
+    shards.  ``counts()`` / ``row_words()`` perform the one-launch
+    evaluation; both tick exactly one dispatch, like the dense fused
+    path, so launch-count pins hold on either route."""
+
+    def __init__(self, shape: tuple, leaves: list[ContainerLeaf],
+                 shards: tuple, cpr: int, n_words: int) -> None:
+        self.shape = shape
+        self.leaves = leaves
+        self.shards = shards
+        self.cpr = cpr
+        self.n_words = n_words
+        self._staged: tuple | None = None
+
+    # ------------------------------------------------------------- staging
+
+    def _stage(self) -> tuple:
+        """(domains, bounds, total, idxs) — the per-shard root domains,
+        their concatenation boundaries, and the per-leaf gather
+        indices.  Memoized across queries on (shape, leaf uids): the
+        whole stage is a pure function of the cached directories."""
+        if self._staged is not None:
+            return self._staged
+        mkey = (self.shape, tuple(leaf.uid for leaf in self.leaves))
+        with _stage_lock:
+            hit = _stage_memo.get(mkey)
+            if hit is not None:
+                _stage_memo[mkey] = _stage_memo.pop(mkey)  # LRU touch
+        if hit is None:
+            domains: list[np.ndarray] = []
+            for i in range(len(self.shards)):
+                keysets = [leaf.entries[i] for leaf in self.leaves]
+                domains.append(_domain(self.shape, keysets))
+            bounds = np.cumsum([0] + [len(d) for d in domains])
+            total = int(bounds[-1])
+            pad = _pow2(total) if total else 0
+            idxs = [_leaf_indices(leaf, domains, pad)
+                    for leaf in self.leaves]
+            hit = (domains, bounds, total, idxs)
+            with _stage_lock:
+                _stage_memo[mkey] = hit
+                while len(_stage_memo) > _STAGE_MEMO_CAP:
+                    _stage_memo.pop(next(iter(_stage_memo)))
+        domains, bounds, total, idxs = hit
+        n_leaves = len(self.leaves)
+        bump("container.containers_gathered", total * n_leaves)
+        # what the dense layout would have streamed vs what the
+        # directory walk actually touches — the bandwidth story
+        bump("container.containers_skipped",
+             n_leaves * (len(self.shards) * self.cpr - total))
+        self._staged = hit
+        return self._staged
+
+    def _gathered(self, counts: bool) -> Any:
+        """ONE launch over the pooled operands; None when the root
+        domain is empty everywhere (zero device work)."""
+        from pilosa_tpu.ops import expr
+        from pilosa_tpu.ops import pallas_kernels as pk
+
+        _domains, _bounds, total, idxs = self._stage()
+        if total == 0:
+            bump("container.empty_domains")
+            # the dense path would still have launched once; tick the
+            # dispatch hook so launch accounting is route-invariant
+            from pilosa_tpu.ops import bitmap as bm
+
+            bm.note_dispatch("fused_gather")
+            return None
+        pools = [leaf.pool for leaf in self.leaves]
+        if (counts and self.shape == ("and", ("leaf", 0), ("leaf", 1))
+                and pk.on_tpu() and not isinstance(pools[0], np.ndarray)):
+            # the north-star pair: the Pallas directory-walk kernel
+            # intersects+counts co-present containers in one pass
+            return pk.gathered_count_and(pools[0], idxs[0],
+                                         pools[1], idxs[1])
+        return expr.evaluate_gathered(self.shape, tuple(pools),
+                                      tuple(idxs), counts=counts)
+
+    # ----------------------------------------------------------- execution
+
+    def counts(self) -> list[int]:
+        """Per-shard popcounts of the tree, aligned with ``shards`` —
+        the Count root folded into the same launch."""
+        bump("container.queries")
+        out = self._gathered(counts=True)
+        _domains, bounds, total, _idxs = self._staged  # set by _gathered
+        if out is None:
+            return [0] * len(self.shards)
+        cts = np.asarray(out, dtype=np.int64)[:total]
+        return [int(cts[bounds[i]:bounds[i + 1]].sum())
+                for i in range(len(self.shards))]
+
+    def row_words(self) -> list[tuple[int, np.ndarray]]:
+        """Non-empty per-shard result words, scattered back to the
+        dense row layout the Row reduce consumes."""
+        bump("container.queries")
+        out = self._gathered(counts=False)
+        if out is None:
+            return []
+        domains, bounds, total, _idxs = self._staged
+        res = np.asarray(out)[:total]
+        partials: list[tuple[int, np.ndarray]] = []
+        for i, s in enumerate(self.shards):
+            dom = domains[i]
+            if len(dom) == 0:
+                continue
+            blocks = res[int(bounds[i]):int(bounds[i + 1])]
+            if not blocks.any():
+                continue
+            words = np.zeros(self.n_words, dtype=np.uint32)
+            words.reshape(self.cpr, CWORDS)[dom] = blocks
+            partials.append((s, words))
+        return partials
+
+
+def _walk(idx: Any, call: Any, leaves: list) -> tuple | None:
+    """Shape + (field, row) leaf descriptors for a tree whose every
+    leaf is a plain standard-view row — the container-eligible grammar.
+    Returns None for BSI condition rows, time ranges, Shift (bits cross
+    container boundaries), and anything unknown."""
+    name = call.name
+    if name == "Row":
+        if call.condition_arg() is not None:
+            return None
+        if "from" in call.args or "to" in call.args:
+            return None
+        try:
+            fname = call.field_arg()
+        except ValueError:
+            return None
+        row_id = call.args.get(fname)
+        if not isinstance(row_id, int) or isinstance(row_id, bool):
+            return None
+        f = idx.field(fname)
+        if f is None:
+            return None
+        o = f.options
+        if o.type == "int" or (o.type == "time" and o.no_standard_view):
+            return None
+        leaves.append((f, row_id))
+        return ("leaf", len(leaves) - 1)
+    if name in ("Union", "Intersect", "Difference", "Xor"):
+        op = {"Union": "or", "Intersect": "and",
+              "Difference": "andnot", "Xor": "xor"}[name]
+        kids = []
+        for c in call.children:
+            k = _walk(idx, c, leaves)
+            if k is None:
+                return None
+            kids.append(k)
+        if not kids:
+            return None
+        return (op, *kids)
+    if name == "Not":
+        if len(call.children) != 1:
+            return None
+        ef = idx.existence_field()
+        if ef is None:
+            return None
+        leaves.append((ef, 0))
+        exist = ("leaf", len(leaves) - 1)
+        child = _walk(idx, call.children[0], leaves)
+        if child is None:
+            return None
+        return ("not", exist, child)
+    return None
+
+
+def plan_fused(executor: Any, idx: Any, call: Any, shards: tuple,
+               opt: Any, counts: bool = True) -> Plan | None:
+    """Stage a fused read for compressed execution, or None to route
+    the exact pre-existing dense path.  All-or-nothing per query: every
+    leaf row must be compression-eligible (under the fill-ratio
+    threshold, no pending delta overlay) in EVERY shard — so the read
+    costs one launch on either route and partial results never mix.
+
+    ``counts`` is the root kind: a bare-leaf Row tree is declined when
+    ``counts=False`` because the dense path answers it as a ZERO-launch
+    passthrough of the resident stack (expr.evaluate's leaf case) —
+    gathering would both tick a launch the dense route doesn't (the
+    route-invariant accounting would break) and redo work the stack
+    cache already holds."""
+    from pilosa_tpu.models.view import VIEW_STANDARD
+    from pilosa_tpu.ops import bitmap as bm
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    if not _cfg.enabled or not shards:
+        return None
+    if opt is not None and not getattr(opt, "containers", True):
+        return None
+    leaf_descs: list = []
+    shape = _walk(idx, call, leaf_descs)
+    if shape is None or not leaf_descs:
+        return None
+    if not counts and shape[0] == "leaf":
+        return None
+    use_delta = opt is None or opt.delta
+    for f, row_id in leaf_descs:
+        view = f.view(VIEW_STANDARD)
+        if view is None:
+            continue
+        if not use_delta:
+            # the ?nodelta=1 contract: compact up front, then a real
+            # pure-base read — which the compressed path is
+            f.flush_deltas(shards)
+            continue
+        for s in shards:
+            fr = view.fragment(s)
+            if fr is not None and fr._delta_row_seq(row_id):
+                # pending overlay on a queried row: the dense path
+                # fuses it (expr "dfuse"); compressed pools hold base
+                # content only
+                bump("container.fallbacks")
+                return None
+    leaves = []
+    for f, row_id in leaf_descs:
+        leaf = f.device_container_leaf(row_id, shards)
+        if leaf.dense_slots():
+            bump("container.fallbacks")
+            return None
+        leaves.append(leaf)
+    return Plan(shape, leaves, shards, SHARD_WIDTH // CONTAINER_BITS,
+                bm.n_words(SHARD_WIDTH))

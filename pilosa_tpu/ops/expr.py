@@ -170,7 +170,40 @@ def _build_program(shape: tuple, counts: bool) -> Callable[..., Any]:
     return _devobs.instrument(name, jax.jit(run))
 
 
-def _make_compiled(maxsize: int) -> Any:
+def _build_gather_program(shape: tuple, counts: bool) -> Callable[..., Any]:
+    """The container-engine variant of ``_build_program``: leaves are
+    (pool, gather-index) pairs and each leaf materializes as
+    ``take(pool, idx, axis=0)`` INSIDE the jitted program, so the
+    directory-driven gather, the fused tree body, and the optional
+    popcount Count root all cost one launch (ops/containers.py stages
+    the pools and pow2-padded indices; see its module docstring for
+    the layout).  Argument convention: ``run(*pools, *idxs)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ev = _build_jnp(shape)
+
+    def run(*args: Any) -> Any:
+        n = len(args) // 2
+        pools, idxs = args[:n], args[n:]
+        leaves = tuple(jnp.take(p, ix, axis=0, mode="clip")
+                       for p, ix in zip(pools, idxs))
+        out = ev(leaves)
+        if counts:
+            return jnp.sum(lax.population_count(out),
+                           axis=-1, dtype=jnp.int32)
+        return out
+
+    from pilosa_tpu import devobs as _devobs
+
+    name = "expr.fused_gather_counts" if counts else "expr.fused_gather"
+    return _devobs.instrument(name, jax.jit(run))
+
+
+def _make_compiled(maxsize: int,
+                   build: Callable[[tuple, bool],
+                                   Callable[..., Any]] | None = None) -> Any:
     """An explicit LRU over compiled programs with an EXACT eviction
     count.  ``functools.lru_cache`` was abandoned here because its
     counters can't express evictions: ``misses - currsize`` over-counts
@@ -180,6 +213,7 @@ def _make_compiled(maxsize: int) -> Any:
     spuriously.  Here an eviction increments exactly when a resident
     program is popped for capacity, nothing else."""
     lock = threading.Lock()
+    builder = build if build is not None else _build_program
     # insertion order == LRU order (move-to-end on hit)
     cache: dict[tuple, Callable[..., Any]] = {}
     counters = {"hits": 0, "misses": 0, "evictions": 0}
@@ -196,7 +230,7 @@ def _make_compiled(maxsize: int) -> Any:
         # trace/lower outside the lock — tens of ms for a fresh shape;
         # a concurrent duplicate build is wasted work, never a wrong
         # count: only the first insert lands and no eviction is charged
-        prog = _build_program(shape, counts)
+        prog = builder(shape, counts)
         with lock:
             if key in cache:
                 return cache[key]
@@ -228,23 +262,31 @@ def _make_compiled(maxsize: int) -> Any:
 
 
 _compiled = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE)
+#: gather-program cache (the container engine's fused programs): its
+#: keys are the same canonical tree shapes, so the dense and gathered
+#: variants of one shape are two entries — sized accordingly
+_compiled_gather = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE,
+                                  build=_build_gather_program)
 _eviction_warned: bool = False
 
 
 def program_evictions() -> int:
-    """Capacity evictions from the compiled-program cache so far —
+    """Capacity evictions from the compiled-program caches so far —
     counted exactly at the point a resident program is popped (see
     ``_make_compiled``), so concurrent same-shape builds and failed
     builds never inflate it."""
-    return _compiled.cache_evictions()
+    return (_compiled.cache_evictions()
+            + _compiled_gather.cache_evictions())
 
 
 def set_program_cache_size(maxsize: int) -> None:
     """Swap in a fresh program cache of the given capacity (tests —
     forcing 512 distinct shapes to exercise eviction would dominate a
     test run with tracing)."""
-    global _compiled, _eviction_warned
+    global _compiled, _compiled_gather, _eviction_warned
     _compiled = _make_compiled(maxsize)
+    _compiled_gather = _make_compiled(maxsize,
+                                      build=_build_gather_program)
     _eviction_warned = False
 
 
@@ -332,3 +374,30 @@ def evaluate(shape: tuple, leaves: tuple, counts: bool = False) -> Any:
     fn = _compiled(shape, counts)
     _note_program_cache_pressure()
     return fn(*leaves)
+
+
+def evaluate_gathered(shape: tuple, pools: tuple, idxs: tuple,
+                      counts: bool = False) -> Any:
+    """Evaluate one compiled tree over POOLED container operands in
+    ONE launch (the compressed-fragment read path, ops/containers.py).
+
+    ``pools[i]`` — leaf i's uint32[P_i, CWORDS] container block pool
+    (host numpy or device array), rows past the directory's count all
+    zeros; ``idxs[i]`` — int32[D] gather indices mapping the query's
+    container domain into that pool (absent containers point at a zero
+    row).  The caller pads D and each P_i to powers of two
+    (``containers._pow2``) so the jit re-specializations stay O(log).
+    Returns the uint32[D, CWORDS] result blocks, or int32[D]
+    per-container popcounts with ``counts=True``."""
+    _validate(shape, len(pools))
+    bm.note_dispatch("fused_gather")
+    if bm._host(*pools):
+        leaves = tuple(p[np.asarray(ix)] for p, ix in zip(pools, idxs))
+        if counts:
+            return _host_counts(shape, leaves)
+        return _host_tree(shape, leaves)
+    import jax.numpy as jnp
+
+    fn = _compiled_gather(shape, counts)
+    _note_program_cache_pressure()
+    return fn(*pools, *(jnp.asarray(ix) for ix in idxs))

@@ -222,6 +222,69 @@ def count_and(a, b, interpret: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# compressed-container intersection count: the directory walk on TPU.
+# Scalar-prefetched gather indices drive the BlockSpec index maps, so the
+# DMA engine fetches exactly the directory-matched container blocks from
+# the two word pools — absent containers (index = the pool's zero row)
+# cost one zero block, and the dense layout's zero words never stream
+# (ops/containers.py; roaring.IntersectionCount's co-present-container
+# walk, roaring/roaring.go:570, as hardware-prefetched gathers).
+# ---------------------------------------------------------------------------
+
+CONTAINER_WORDS = 2048  # uint32 words per 2^16-bit container
+
+
+def _gathered_count_and_kernel(ai_ref, bi_ref, a_ref, b_ref, out_ref):
+    del ai_ref, bi_ref  # consumed by the BlockSpec index maps
+    out_ref[0, 0] = jnp.sum(
+        lax.population_count(a_ref[:] & b_ref[:]), dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _gathered_count_and_pallas(a_pool, ai, b_pool, bi,
+                               interpret: bool = False):
+    P = ai.shape[0]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(P,),
+        in_specs=[
+            pl.BlockSpec((1, CONTAINER_WORDS),
+                         lambda p, ai, bi: (ai[p], 0)),
+            pl.BlockSpec((1, CONTAINER_WORDS),
+                         lambda p, ai, bi: (bi[p], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda p, ai, bi: (p, 0),
+                               memory_space=pltpu.SMEM),
+    )
+    out = pl.pallas_call(
+        _gathered_count_and_kernel,
+        out_shape=jax.ShapeDtypeStruct((P, 1), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(ai, bi, a_pool, b_pool)
+    return out[:, 0]
+
+
+def gathered_count_and(a_pool, ai, b_pool, bi, interpret: bool = False):
+    """Per-pair |a_pool[ai[p]] & b_pool[bi[p]]| -> int32[P]: Pallas
+    directory-walk on TPU, the fused jnp gather kernel elsewhere
+    (bm.gathered_pair_counts) — identical counts.  Exactly one
+    dispatch tick on either route, like every bm op."""
+    from pilosa_tpu.ops import bitmap as bm
+
+    ai = jnp.asarray(ai, dtype=jnp.int32)
+    bi = jnp.asarray(bi, dtype=jnp.int32)
+    if (a_pool.shape[-1] == CONTAINER_WORDS
+            and _use_pallas(interpret, ai.shape[0] * CONTAINER_WORDS,
+                            kernel="gathered_count_and")):
+        bm.note_dispatch("gathered_count_and")
+        return _gathered_count_and_pallas(jnp.asarray(a_pool), ai,
+                                          jnp.asarray(b_pool), bi,
+                                          interpret=interpret)
+    return bm.gathered_pair_counts(a_pool, ai, b_pool, bi)
+
+
+# ---------------------------------------------------------------------------
 # GroupBy cartesian counts: out[g, r] = |mat[r] & masks[g]| — one pass
 # over the row matrix per mask block, [GB, RB, WB] intermediate in VMEM
 # (SURVEY §7's third Pallas target; groupByIterator, executor.go:3058)
@@ -399,7 +462,8 @@ def _bsi_compare_jnp(planes, filt, upred: int, depth: int):
 from pilosa_tpu import devobs as _devobs  # noqa: E402
 
 for _n in ("_row_counts_masked_pallas", "_count_and_pallas",
-           "_mmc_pallas", "_bsi_compare_pallas"):
+           "_gathered_count_and_pallas", "_mmc_pallas",
+           "_bsi_compare_pallas"):
     globals()[_n] = _devobs.instrument(f"pallas.{_n.strip('_')}",
                                        globals()[_n])
 del _n

@@ -226,14 +226,17 @@ class Transport:
     http/client.go:37)."""
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
-                   nocache: bool = False, nodelta: bool = False):
+                   nocache: bool = False, nodelta: bool = False,
+                   nocontainers: bool = False):
         """Execute pql on the remote node restricted to `shards` with
         remote semantics (no re-translation).  Returns the result list.
         Raises TransportError if the node is unreachable.  ``nocache``
         forwards the origin request's ?nocache=1 so an opted-out query
         forces a real execution on every node, not just the origin;
         ``nodelta`` forwards ?nodelta=1 the same way (peers compact
-        their pending ingest deltas and answer from pure base)."""
+        their pending ingest deltas and answer from pure base);
+        ``nocontainers`` forwards ?nocontainers=1 (peers route their
+        fused reads through the dense pre-container path)."""
         raise NotImplementedError
 
     def send_message(self, node: Node, message: dict) -> dict:
@@ -298,7 +301,8 @@ class LocalTransport(Transport):
             raise TransportError(f"partitioned: {src} <-/-> {dst}")
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
-                   nocache: bool = False, nodelta: bool = False):
+                   nocache: bool = False, nodelta: bool = False,
+                   nocontainers: bool = False):
         from pilosa_tpu.parallel.executor import ExecOptions
 
         if node.id in self.down or node.id not in self.handles:
@@ -310,6 +314,7 @@ class LocalTransport(Transport):
             opt=ExecOptions(
                 remote=True, shards=None if shards is None else list(shards),
                 cache=not nocache, delta=not nodelta,
+                containers=not nocontainers,
             ),
         )
 
@@ -337,13 +342,16 @@ class BoundTransport(Transport):
         return getattr(self.parent, name)
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
-                   nocache: bool = False, nodelta: bool = False):
+                   nocache: bool = False, nodelta: bool = False,
+                   nocontainers: bool = False):
         self.parent._check_partition(self.src, node.id)
         extra = {}
         if nocache:
             extra["nocache"] = True
         if nodelta:
             extra["nodelta"] = True
+        if nocontainers:
+            extra["nocontainers"] = True
         if extra:
             return self.parent.query_node(node, index, pql, shards,
                                           **extra)

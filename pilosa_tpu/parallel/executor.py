@@ -88,6 +88,11 @@ class ExecOptions:
     # query runs against pure base state (a debugging escape; results
     # are bit-exact either way)
     delta: bool = True
+    # per-request opt-out of the compressed container-directory
+    # engine (the HTTP layer's ?nocontainers=1 — symmetric with
+    # ?nocoalesce/?nocache/?nodelta): fused reads route the exact
+    # dense pre-container path; results are bit-identical either way
+    containers: bool = True
     # end-to-end deadline (serve/deadline.Deadline), propagated from
     # the X-Pilosa-Deadline header; checked at translate, before each
     # per-shard map, and before reduce so expired work never reaches
@@ -420,6 +425,10 @@ class Executor:
                     # forward ?nodelta=1: peers compact their own
                     # pending deltas and run against pure base too
                     extra["nodelta"] = True
+                if opt is not None and not opt.containers:
+                    # forward ?nocontainers=1: peers route their own
+                    # fused reads through the dense pre-container path
+                    extra["nocontainers"] = True
                 if extra:
                     fut = self._submit_io(
                         lambda n, i, p, s, _e=extra:
@@ -901,12 +910,24 @@ class Executor:
                     # copies both ways (fill and hit): cached words
                     # must never alias a Row a caller may mutate
                     return [(s, w.copy()) for s, w in val]
-            # copies: a view would pin the whole stack in memory for as
-            # long as one sparse segment lives
-            stack = np.asarray(self._fused_eval(idx, call, g,
-                                                use_delta=opt.delta))
-            partials = [(s, stack[i].copy())
-                        for i, s in enumerate(group) if stack[i].any()]
+            # sparse trees route the compressed container engine
+            # (ops/containers.py): one launch over the pooled
+            # directory-matched containers, scattered back to dense
+            # per-shard words here
+            from pilosa_tpu.ops import containers as _containers
+
+            cplan = _containers.plan_fused(self, idx, call, g, opt,
+                                           counts=False)
+            if cplan is not None:
+                partials = cplan.row_words()
+            else:
+                # copies: a view would pin the whole stack in memory
+                # for as long as one sparse segment lives
+                stack = np.asarray(self._fused_eval(idx, call, g,
+                                                    use_delta=opt.delta))
+                partials = [(s, stack[i].copy())
+                            for i, s in enumerate(group)
+                            if stack[i].any()]
             if probe is not None:
                 value = [(s, w.copy()) for s, w in partials]
                 rc.put(key, gens, value,
@@ -1135,9 +1156,17 @@ class Executor:
             # materializes (the host engine keeps the native pairwise
             # kernel for the same reason); per-shard int32 counts summed
             # in Python ints — a single int32 reduce over the stack
-            # could wrap past 2^31 set bits
+            # could wrap past 2^31 set bits.  Sparse trees route the
+            # compressed container engine first (ops/containers.py):
+            # same single launch, but only the directory-matched
+            # container blocks are ever read
+            from pilosa_tpu.ops import containers as _containers
             from pilosa_tpu.ops import expr
 
+            cplan = _containers.plan_fused(self, idx, child,
+                                           tuple(group), opt)
+            if cplan is not None:
+                return cplan.counts()
             shape, leaves = self._fused_expr(idx, child, tuple(group),
                                              use_delta=opt.delta)
             counts = expr.evaluate(shape, leaves, counts=True)

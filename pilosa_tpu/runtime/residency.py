@@ -78,10 +78,16 @@ class ResidencyManager:
         # caps only relax for deliberately-sized deployments
         self.operator_sized = budget_bytes is not None or _operator_sized()
         self._lock = threading.Lock()
-        # (owner dict id, key) -> (owner dict, key, nbytes); dict
+        # (owner dict id, key) -> (owner dict, key, nbytes, kind); dict
         # preserves insertion order = LRU order (move-to-end on touch)
-        self._entries: dict[tuple, tuple[dict, object, int]] = {}
+        self._entries: dict[tuple, tuple[dict, object, int, str]] = {}
         self.total = 0
+        # bytes by representation kind ("dense" tensors vs the
+        # roaring-on-TPU "compressed" container pools) — the
+        # /debug/devices compressed-vs-dense split, and the number
+        # that shows one chip admitting several times more index when
+        # sparse fragments ride the compressed layout
+        self._by_kind: dict[str, int] = {}
         self.evictions = 0
         self.admits = 0
         # max SETTLED bytes (post-eviction; the mid-admit transient
@@ -92,21 +98,27 @@ class ResidencyManager:
     def _id(cache: dict, key) -> tuple:
         return (id(cache), key)
 
-    def admit(self, cache: dict, key, nbytes: int) -> None:
+    def admit(self, cache: dict, key, nbytes: int,
+              kind: str = "dense") -> None:
         """Track an entry just inserted into ``cache`` under ``key``;
         evict least-recently-used entries (from any owner) until the
         total fits the budget.  The entry being admitted is never its
         own victim, so the total is bounded by max(budget, largest
         single entry) even when individual entries exceed the whole
         budget — an unconditional reclaim, like the reference's global
-        syswrap caps (syswrap/os.go:41)."""
+        syswrap caps (syswrap/os.go:41).  ``kind`` tags the bytes as
+        "dense" tensors or roaring "compressed" container pools, so
+        the stats() split reports REAL compressed residency."""
         eid = self._id(cache, key)
         with self._lock:
             old = self._entries.pop(eid, None)
             if old is not None:
                 self.total -= old[2]
-            self._entries[eid] = (cache, key, nbytes)
+                self._by_kind[old[3]] = \
+                    self._by_kind.get(old[3], 0) - old[2]
+            self._entries[eid] = (cache, key, nbytes, kind)
             self.total += nbytes
+            self._by_kind[kind] = self._by_kind.get(kind, 0) + nbytes
             self.admits += 1
             while self.total > self.budget and len(self._entries) > 1:
                 victim_id = next(iter(self._entries))
@@ -114,8 +126,10 @@ class ResidencyManager:
                     # never evict the entry being admitted
                     self._entries[eid] = self._entries.pop(eid)
                     continue
-                vcache, vkey, vbytes = self._entries.pop(victim_id)
+                vcache, vkey, vbytes, vkind = self._entries.pop(victim_id)
                 self.total -= vbytes
+                self._by_kind[vkind] = \
+                    self._by_kind.get(vkind, 0) - vbytes
                 self.evictions += 1
                 vcache.pop(vkey, None)
             # high-water marks the SETTLED residency level (the number
@@ -141,6 +155,7 @@ class ResidencyManager:
             e = self._entries.pop(eid, None)
             if e is not None:
                 self.total -= e[2]
+                self._by_kind[e[3]] = self._by_kind.get(e[3], 0) - e[2]
 
     def stats(self) -> dict:
         with self._lock:
@@ -148,7 +163,11 @@ class ResidencyManager:
                     "entries": len(self._entries),
                     "evictions": self.evictions,
                     "admits": self.admits,
-                    "high_water": self.high_water}
+                    "high_water": self.high_water,
+                    # compressed-vs-dense residency split (the
+                    # roaring-on-TPU capacity story; /debug/devices)
+                    "kinds": {k: v for k, v in self._by_kind.items()
+                              if v}}
 
     def top_entries(self, n: int = 20) -> list[dict]:
         """Largest tracked device/host cache entries, for the heap
@@ -157,8 +176,9 @@ class ResidencyManager:
         10B-scale operator asks."""
         with self._lock:
             entries = sorted(self._entries.values(), key=lambda e: -e[2])[:n]
-        return [{"key": repr(key)[:160], "bytes": nbytes}
-                for _, key, nbytes in entries]
+        return [{"key": repr(key)[:160], "bytes": nbytes,
+                 "kind": kind}
+                for _, key, nbytes, kind in entries]
 
 
 _global: ResidencyManager | None = None

@@ -278,7 +278,8 @@ class InternalClient:
 
     def query_node(self, uri: str, index: str, pql: str,
                    shards: list[int] | None = None, remote: bool = True,
-                   nocache: bool = False, nodelta: bool = False):
+                   nocache: bool = False, nodelta: bool = False,
+                   nocontainers: bool = False):
         """POST /index/{i}/query with Remote semantics over the
         protobuf wire — node-to-node RPC speaks protobuf like the
         reference's InternalClient (http/client.go:268 QueryNode;
@@ -287,7 +288,9 @@ class InternalClient:
         external clients use, so the peer's handler opts the sub-query
         out of its result cache; ``nodelta`` rides as ?nodelta=1 the
         same way (the peer compacts its pending ingest deltas and
-        answers from pure base state)."""
+        answers from pure base state); ``nocontainers`` rides as
+        ?nocontainers=1 (the peer routes its fused reads through the
+        dense pre-container path)."""
         from pilosa_tpu import proto
 
         body = proto.encode(proto.QUERY_REQUEST, {
@@ -297,7 +300,8 @@ class InternalClient:
         })
         path = f"{uri}/index/{index}/query"
         flags = [f for f, on in (("nocache=1", nocache),
-                                 ("nodelta=1", nodelta)) if on]
+                                 ("nodelta=1", nodelta),
+                                 ("nocontainers=1", nocontainers)) if on]
         if flags:
             path += "?" + "&".join(flags)
         raw = self._request(
@@ -430,10 +434,12 @@ class HTTPTransport(Transport):
         self.client = client or InternalClient()
 
     def query_node(self, node: Node, index: str, pql: str, shards,
-                   nocache: bool = False, nodelta: bool = False):
+                   nocache: bool = False, nodelta: bool = False,
+                   nocontainers: bool = False):
         # the protobuf client already returns decoded result objects
         return self.client.query_node(node.uri, index, pql, shards,
-                                      nocache=nocache, nodelta=nodelta)
+                                      nocache=nocache, nodelta=nodelta,
+                                      nocontainers=nocontainers)
 
     def send_message(self, node: Node, message: dict) -> dict:
         return self.client.send_message(node.uri, message)

@@ -667,6 +667,11 @@ class Handler:
                 # and answer from pure base state (debugging escape;
                 # results are bit-exact either way)
                 delta=params.get("nodelta") not in ("1", "true"),
+                # ?nocontainers=1: route fused reads through the dense
+                # pre-container path (debugging escape; results are
+                # bit-identical either way)
+                containers=params.get("nocontainers")
+                not in ("1", "true"),
             )
         except Exception as e:
             if not proto_accept:
@@ -960,6 +965,7 @@ class Handler:
             # from the [observe] device-sample-interval loop)
             from pilosa_tpu import devobs
             from pilosa_tpu.ingest import compactor
+            from pilosa_tpu.ops import containers as _containers
             from pilosa_tpu.ops import tape
             from pilosa_tpu.runtime import resultcache
 
@@ -968,6 +974,7 @@ class Handler:
                 resultcache.cache().publish_gauges(self.stats)
                 compactor.compactor().publish_gauges(self.stats)
                 tape.publish_gauges(self.stats)
+                _containers.publish_gauges(self.stats)
             except Exception:  # noqa: BLE001 — telemetry never fails a scrape
                 pass
             text = self.stats.prometheus_text(exemplars=exemplars)
@@ -1174,6 +1181,19 @@ class Handler:
 
         self._json(req, compactor.compactor().debug())
 
+    @route("GET", "/debug/containers")
+    def handle_debug_containers(self, req, params, path, body):
+        """Compressed container-directory engine state
+        (ops/containers.py): the [containers] config in force
+        (enabled/threshold) and the container.* counters (queries
+        served compressed, dense fallbacks, containers gathered vs
+        skipped, empty-domain zero-work answers).  The
+        compressed-vs-dense resident-byte split is on /debug/devices
+        (residency.kinds)."""
+        from pilosa_tpu.ops import containers
+
+        self._json(req, containers.debug())
+
     @route("GET", "/debug/ragged")
     def handle_debug_ragged(self, req, params, path, body):
         """Ragged megabatch state (ops/tape.py +
@@ -1328,6 +1348,7 @@ class Handler:
         if self.stats is not None and hasattr(self.stats, "snapshot"):
             from pilosa_tpu import devobs
             from pilosa_tpu.ingest import compactor
+            from pilosa_tpu.ops import containers as _containers
             from pilosa_tpu.ops import tape
             from pilosa_tpu.runtime import resultcache
 
@@ -1336,6 +1357,7 @@ class Handler:
                 resultcache.cache().publish_gauges(self.stats)
                 compactor.compactor().publish_gauges(self.stats)
                 tape.publish_gauges(self.stats)
+                _containers.publish_gauges(self.stats)
             except Exception:  # noqa: BLE001
                 pass
             snap = self.stats.snapshot()

@@ -80,6 +80,8 @@ class Server:
         ingest_delta_budget_bytes: int | None = None,
         ingest_compact_threshold_bits: int | None = None,
         ingest_compact_interval: float | None = None,
+        containers_enabled: bool | None = None,
+        containers_threshold: float | None = None,
     ):
         from pilosa_tpu import logger as _logger
         from pilosa_tpu import stats as _stats
@@ -183,6 +185,16 @@ class Server:
         self._ingest_enabled = bool(ingest_delta_enabled)
         self._ingest_retained = False
         self._closed = False
+        # compressed container-directory engine ([containers] config):
+        # process-wide like [ingest] — the first server's retain()
+        # captures the pre-server baseline, the LAST release() (in
+        # close) restores it for library users sharing the process
+        from pilosa_tpu.ops import containers as _containers
+
+        _containers.retain()
+        self._containers_retained = True
+        _containers.configure(enabled=containers_enabled,
+                              threshold=containers_threshold)
         if self._ingest_enabled:
             # reference taken at CONSTRUCTION, where the configure
             # above landed — not at open() — so a sibling's close
@@ -256,6 +268,13 @@ class Server:
         cluster (server.go:417 Open; gossip join with retry,
         gossip/gossip.go:65-123)."""
         self._closed = False  # an instance reopened after close()
+        if not self._containers_retained:
+            # reopened after close(): take the [containers] reference
+            # back (the first open holds the construction-time one)
+            from pilosa_tpu.ops import containers as _containers
+
+            _containers.retain()
+            self._containers_retained = True
         if self._ingest_enabled and not self._ingest_retained:
             # reopened after close(): take the reference back (the
             # normal first open already holds the construction-time
@@ -398,6 +417,11 @@ class Server:
             last = _compactor.refs() == 0
         if last:
             _ingest.restore_baseline()
+        from pilosa_tpu.ops import containers as _containers
+
+        if self._containers_retained:
+            self._containers_retained = False
+            _containers.release()
         self.handler.close()
         self._client.close()  # drop pooled keep-alive sockets
         self.holder.close()

@@ -597,10 +597,22 @@ class TestHTTPAdmission:
         srv.handler.max_threads = base + 3
         socks = []
         try:
-            for _ in range(3):  # idle connections each hold a thread
+            # saturate the cap DETERMINISTICALLY: a handler thread
+            # lingering from an earlier request can be counted in
+            # ``base`` and exit before the probe, leaving spare
+            # capacity — keep opening idle connections (each holds a
+            # thread; refused extras cost nothing) until the active
+            # count actually reaches the cap, instead of assuming
+            # exactly 3 + a fixed sleep suffices (flaked under
+            # full-suite load)
+            deadline = time.time() + 5.0
+            while (srv.handler._threads_active < srv.handler.max_threads
+                   and time.time() < deadline and len(socks) < 12):
                 socks.append(socket.create_connection(
                     (srv.handler.host, srv.handler.port), timeout=5))
-            time.sleep(0.3)
+                time.sleep(0.1)
+            assert (srv.handler._threads_active
+                    >= srv.handler.max_threads), "cap never saturated"
             t0 = time.perf_counter()
             with pytest.raises(urllib.error.HTTPError) as e:
                 _get(srv.uri, "/status", timeout=5)

@@ -97,10 +97,15 @@ class TestFusedDispatchCount:
         """Distinct row ids share one compiled program (the shape key
         erases leaf values) — no per-query retrace."""
         expr._compiled.cache_clear()
+        expr._compiled_gather.cache_clear()
         for a in range(3):
             ex.execute("i", f"Count(Intersect(Row(f0={a}), Row(f1={a})))")
-        info = expr._compiled.cache_info()
-        assert info.misses == 1, info
+        # the query routes ONE of the two fused engines (dense program
+        # or the compressed-container gather program) — whichever ran,
+        # the three row-id variants must share a single compiled shape
+        dense = expr._compiled.cache_info()
+        gather = expr._compiled_gather.cache_info()
+        assert dense.misses + gather.misses == 1, (dense, gather)
 
     def test_expr_matches_bm_ops(self):
         """Direct engine check: compiled program == op-by-op chain."""

@@ -72,8 +72,13 @@ class TestFusedEquivalence:
                 assert fused == general, q
 
     def test_fused_path_engages(self, ex):
-        # _fused_expr is the shared staging point of every fused path
-        # (Count stages directly; Row/TopN/GroupBy go via _fused_eval)
+        # _fused_expr is the dense staging point of every fused path
+        # (Count stages directly; Row/TopN/GroupBy go via _fused_eval);
+        # sparse trees may stage through the compressed container
+        # engine instead (ops/containers.plan_fused) — either one is
+        # the fused path, and exactly one launch results either way
+        from pilosa_tpu.ops import bitmap as bm
+
         calls = {"n": 0}
         orig = ex._fused_expr
 
@@ -82,8 +87,12 @@ class TestFusedEquivalence:
             return orig(idx, call, shards, *a, **k)
 
         ex._fused_expr = spy
-        ex.execute("i", "Count(Intersect(Row(f0=1), Row(f1=2)))")
-        assert calls["n"] > 0
+        with bm.dispatch_counter() as dc:
+            ex.execute("i", "Count(Intersect(Row(f0=1), Row(f1=2)))")
+        engaged_dense = calls["n"] > 0
+        engaged_compressed = "fused_gather" in dc.launches
+        assert engaged_dense or engaged_compressed
+        assert dc.n == 1, dc.launches
 
     def test_fused_support_surface(self, ex):
         # BSI conditions, time ranges, and Shift all fuse now
@@ -253,9 +262,23 @@ class TestFusedEquivalence:
 
     def test_clustered_local_group_fuses(self, tmp_path):
         """In a cluster, the originating node's local shard group
-        evaluates fused (remote nodes fuse on their own side)."""
+        evaluates fused (remote nodes fuse on their own side).  The
+        compressed container engine is disabled so the spied
+        ``_fused_expr`` staging point is the one that must engage —
+        the clustered batch_fn wiring under test is engine-agnostic."""
         from pilosa_tpu.api import API
+        from pilosa_tpu.ops import containers as ct
         from tests.test_cluster import make_cluster
+
+        was = ct.config().enabled
+        ct.configure(enabled=False)
+        try:
+            self._clustered_local_group_fuses(tmp_path, API,
+                                              make_cluster)
+        finally:
+            ct.configure(enabled=was)
+
+    def _clustered_local_group_fuses(self, tmp_path, API, make_cluster):
 
         _, nodes = make_cluster(tmp_path, n=3, replica_n=1)
         nodes[0].create_index("i")

@@ -45,8 +45,8 @@ CLASS_LOCKS: dict[tuple, ClassLockRule] = {
         lock="_lock",
         attrs=frozenset({
             "_rows", "_gen", "_delta_seq", "_delta", "_op_n", "_wal",
-            "_stack_cache", "_device_cache", "_snapshotting",
-            "_closed",
+            "_stack_cache", "_device_cache", "_container_cache",
+            "_snapshotting", "_closed",
         }),
         helpers={
             "_load": "construction-time replay, single-threaded",
@@ -123,6 +123,13 @@ MODULE_LOCKS: dict[str, tuple] = {
     "ops/tape.py": (
         ModuleGlobalRule("_counters", "_lock", "rw"),
         ModuleGlobalRule("_lowered", "_lock", "rw"),
+    ),
+    "ops/containers.py": (
+        ModuleGlobalRule("_counters", "_lock", "rw"),
+        ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
+        ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_stage_memo", "_stage_lock", "w"),
     ),
     "runtime/resultcache.py": (
         # reads are the lock-free fast path (documented); rebinds only
@@ -203,7 +210,8 @@ CONDITION_ATTRS = ("_snap_done",)
 
 #: Call suffixes that reach a jitted program whose lowering
 #: specializes on input shape.
-JIT_ENTRY_SUFFIXES = ("expr.evaluate", "tape.execute", "_tape.execute")
+JIT_ENTRY_SUFFIXES = ("expr.evaluate", "tape.execute", "_tape.execute",
+                      "expr.evaluate_gathered")
 #: Batch-stack builders whose output shape tracks their (variable)
 #: input length.
 STACK_BUILDER_SUFFIXES = ("jnp.stack", "jnp.concatenate", "np.stack",
@@ -251,6 +259,19 @@ CONFIG_GUARDS = (
         pair=("release",),
         owner_suffixes=("ingest/compactor.py",),
         what="the refcounted shared compactor scan thread",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("containers.configure",
+                          "_containers.configure"),
+        pair=("retain", "release"),
+        owner_suffixes=("ops/containers.py",),
+        what="the process-wide [containers] runtime config",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("containers.retain", "_containers.retain"),
+        pair=("release",),
+        owner_suffixes=("ops/containers.py",),
+        what="the refcounted [containers] baseline",
     ),
 )
 

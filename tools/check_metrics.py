@@ -263,6 +263,10 @@ INGEST_FAMILIES = _mf.live_prefixes("ingest")
 #: the coalescer heterogeneity accounting coalescer_shape_*.
 TAPE_FAMILIES = _mf.live_prefixes("tape")
 
+#: Compressed container-directory engine families
+#: (ops/containers.publish_gauges), rendered as container_*.
+CONTAINER_FAMILIES = _mf.live_prefixes("container")
+
 #: Everything the ``--families`` CLI mode requires of a live server.
 ALL_FAMILIES = _mf.live_prefixes()
 

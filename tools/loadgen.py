@@ -27,9 +27,16 @@ Mixed read/write mode: ingest-class requests POST real import payloads
 read-only p50/p99, ingested bits/s, and the server's result-cache hit
 rate over the run window — the streaming-ingest acceptance numbers.
 
+Sparsity-mix mode (``--sparsity-mix dense=1,pct10=2,pct01=3``):
+query-class requests rotate across rows whose fill ratios the operator
+controlled at load time, and the report adds per-bucket read p50/p99 —
+how compressed-container sparse-path wins (ops/containers.py) are
+measured under serving traffic rather than in microbench.
+
 Importable: ``run_load(...)`` returns the report dict (used by
-tests/test_admission.py to drive a server at 2x capacity and
-tests/test_ingest.py for the mixed-workload acceptance run).
+tests/test_admission.py to drive a server at 2x capacity,
+tests/test_ingest.py for the mixed-workload acceptance run, and
+tests/test_containers.py for the sparsity-mix serving check).
 """
 
 from __future__ import annotations
@@ -69,10 +76,12 @@ class _Stats:
         self.retry_after_seen = 0
         self.ingest_ok = 0
         self.ingest_bits = 0
+        #: sparsity-mix view: bucket name -> completed-read latencies
+        self.bucket_latencies: dict[str, list[float]] = {}
 
     def note(self, outcome: str, latency_s: float,
              retry_after: bool, klass: str = "query",
-             bits: int = 0) -> None:
+             bits: int = 0, bucket: str | None = None) -> None:
         with self.lock:
             self.sent += 1
             if retry_after:
@@ -82,6 +91,9 @@ class _Stats:
                 self.ok_latencies.append(latency_s)
                 if klass == "query":
                     self.read_latencies.append(latency_s)
+                    if bucket is not None:
+                        self.bucket_latencies.setdefault(
+                            bucket, []).append(latency_s)
                 elif klass == "ingest":
                     self.ingest_ok += 1
                     self.ingest_bits += bits
@@ -132,12 +144,13 @@ def _build_request(host: str, index: str, klass: str, query: str,
 
 
 def _fire(req, timeout: float, stats: _Stats, klass: str = "query",
-          bits: int = 0) -> None:
+          bits: int = 0, bucket: str | None = None) -> None:
     t0 = time.perf_counter()
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             resp.read()
-        stats.note("ok", time.perf_counter() - t0, False, klass, bits)
+        stats.note("ok", time.perf_counter() - t0, False, klass, bits,
+                   bucket)
     except urllib.error.HTTPError as e:
         body = b""
         try:
@@ -226,6 +239,22 @@ def shape_mix_queries(n: int, field: str = "f", rows: int = 6,
     return out
 
 
+def parse_sparsity_mix(spec: str) -> dict[str, int]:
+    """``"dense=1,pct10=2,pct01=3"`` -> {bucket: row id}.  Bucket
+    names are free-form labels for the report; the rows must already
+    hold data at the intended fill ratios (loadgen generates traffic,
+    not data — tests/benches load the controlled-fill rows first)."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        k, _, v = part.partition("=")
+        if not k.strip() or not v:
+            raise ValueError(f"bad --sparsity-mix entry: {part!r}")
+        out[k.strip()] = int(v)
+    if not out:
+        raise ValueError("--sparsity-mix needs at least one bucket")
+    return out
+
+
 def run_load(host: str, index: str, qps: float, seconds: float,
              query: str = "Count(Row(f=1))",
              mix: dict[str, float] | None = None,
@@ -234,7 +263,9 @@ def run_load(host: str, index: str, qps: float, seconds: float,
              ingest_field: str = "loadgen", ingest_bits: int = 1,
              ingest_rows: int = 8, ingest_cols: int = 1 << 20,
              shape_mix: int = 0, shape_field: str | None = None,
-             shape_rows: int = 6) -> dict:
+             shape_rows: int = 6,
+             sparsity_mix: dict[str, int] | None = None,
+             sparsity_field: str = "f") -> dict:
     """Drive ``host`` open-loop at ``qps`` for ``seconds``; returns the
     report dict.  ``mix`` maps class -> weight; ``deadline_s`` is a
     (lo, hi) uniform range for the per-request deadline header (None =
@@ -264,6 +295,16 @@ def run_load(host: str, index: str, qps: float, seconds: float,
         qlist = shape_mix_queries(shape_mix,
                                   field=shape_field or "f",
                                   rows=shape_rows)
+    # sparsity-mix mode: rotate query-class requests across rows with
+    # operator-controlled fill ratios (dense / 10% / 0.1% — whatever
+    # the loaded buckets hold) and report per-bucket p50/p99, so
+    # sparse-path wins (the compressed container engine,
+    # ops/containers.py) are measurable under serving traffic, not
+    # just in microbench
+    buckets = None
+    if sparsity_mix:
+        buckets = [(name, f"Count(Row({sparsity_field}={row}))")
+                   for name, row in sparsity_mix.items()]
     n = int(qps * seconds)
     # EXACT-proportion, evenly interleaved class schedule (largest-
     # remainder pacing).  A binomial draw would make the delivered
@@ -290,14 +331,14 @@ def run_load(host: str, index: str, qps: float, seconds: float,
             item = jobs.get()
             if item is None:
                 return
-            due, req, klass, bits = item
+            due, req, klass, bits, bucket = item
             delay = due - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
             elif delay < -0.05:
                 with late_lock:
                     late[0] += 1
-            _fire(req, timeout, stats, klass, bits)
+            _fire(req, timeout, stats, klass, bits, bucket)
 
     cache0 = _cache_counters(host)
     disp0 = _vars_counter(host, "coalescer.dispatches")
@@ -311,11 +352,15 @@ def run_load(host: str, index: str, qps: float, seconds: float,
         klass = sched[i]
         dl = (random.uniform(*deadline_s)
               if deadline_s is not None else None)
-        q = qlist[i % len(qlist)] if qlist else query
+        bucket = None
+        if buckets is not None and klass == "query":
+            bucket, q = buckets[i % len(buckets)]
+        else:
+            q = qlist[i % len(qlist)] if qlist else query
         req, kl, bits = _build_request(host, index, klass, q, dl,
                                        ingest_field, ingest_bits,
                                        ingest_rows, ingest_cols)
-        jobs.put((due, req, kl, bits))
+        jobs.put((due, req, kl, bits, bucket))
     for _ in workers:
         jobs.put(None)
     for w in workers:
@@ -371,6 +416,18 @@ def run_load(host: str, index: str, qps: float, seconds: float,
             # never dispatched at all -> None, not fake-perfect 0.0
             round((disp1 - (disp0 or 0.0)) / len(rlat), 4)
             if disp1 is not None and rlat else None),
+        # sparsity-mix view: per-bucket read latency percentiles
+        "sparsity": (None if buckets is None else {
+            name: {
+                "ok": len(lats),
+                "p50_ms": round(_percentile(sorted(lats), 0.50) * 1e3,
+                                2),
+                "p99_ms": round(_percentile(sorted(lats), 0.99) * 1e3,
+                                2),
+            }
+            for name, lats in sorted(
+                stats.bucket_latencies.items())
+        }),
     }
 
 
@@ -412,6 +469,14 @@ def main(argv: list[str] | None = None) -> int:
                         "'f')")
     p.add_argument("--shape-rows", type=int, default=6,
                    help="row-id range shape-mix leaves draw from")
+    p.add_argument("--sparsity-mix", default=None,
+                   help="bucket=row[,bucket=row...] — rotate "
+                        "query-class requests across rows with "
+                        "controlled fill ratios (e.g. "
+                        "dense=1,pct10=2,pct01=3) and report "
+                        "per-bucket p50/p99")
+    p.add_argument("--sparsity-field", default="f",
+                   help="field the sparsity-mix rows live in")
     p.add_argument("--timeout", type=float, default=10.0)
     args = p.parse_args(argv)
     mix = {}
@@ -431,7 +496,10 @@ def main(argv: list[str] | None = None) -> int:
                       ingest_cols=args.ingest_cols,
                       shape_mix=args.shape_mix,
                       shape_field=args.shape_field,
-                      shape_rows=args.shape_rows)
+                      shape_rows=args.shape_rows,
+                      sparsity_mix=(parse_sparsity_mix(args.sparsity_mix)
+                                    if args.sparsity_mix else None),
+                      sparsity_field=args.sparsity_field)
     print(json.dumps(report, indent=2))
     return 0
 
