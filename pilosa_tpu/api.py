@@ -95,8 +95,17 @@ class API:
               column_attrs: bool = False, exclude_row_attrs: bool = False,
               exclude_columns: bool = False, coalesce: bool = True,
               cache: bool = True, delta: bool = True,
-              containers: bool = True):
-        """Execute PQL -> list of results (api.go:135 API.Query)."""
+              containers: bool = True, partial: bool = False,
+              partial_meta: dict | None = None):
+        """Execute PQL -> list of results (api.go:135 API.Query).
+
+        ``partial=True`` (the HTTP layer's ?partial=1 /
+        X-Pilosa-Partial) degrades instead of erroring when shards
+        exhaust every replica: results come back with the reachable
+        shards only, and ``partial_meta`` (when given) is filled with
+        ``missingShards`` (the exact unavailable set) and
+        ``missingFraction``.  The default keeps all-or-error
+        semantics on an identical code path."""
         from pilosa_tpu.parallel.executor import ExecOptions
         from pilosa_tpu.serve import deadline as _deadline
 
@@ -106,7 +115,8 @@ class API:
         # here, before translate/collective work touches anything
         dl = _deadline.current()
         _deadline.check(dl, "query execution")
-        if (not remote and shards is None and isinstance(pql, str)):
+        if (not remote and shards is None and not partial
+                and isinstance(pql, str)):
             # multi-process runtime: the coordinator upgrades supported
             # reads to one collective SPMD program over the global mesh
             # (parallel/spmd.py); None falls through to scatter-gather.
@@ -172,8 +182,17 @@ class API:
             delta=delta,
             containers=containers,
             deadline=dl,
+            partial=partial,
+            missing=set() if partial else None,
         )
-        return self.executor.execute(index, pql, opt=opt)
+        results = self.executor.execute(index, pql, opt=opt)
+        if partial_meta is not None:
+            miss = sorted(opt.missing or ())
+            partial_meta["missingShards"] = miss
+            partial_meta["missingFraction"] = (
+                round(len(miss) / opt.targeted, 4) if opt.targeted
+                else 0.0)
+        return results
 
     # ------------------------------------------------------------- schema
 

@@ -108,6 +108,21 @@ def main(argv: list[str] | None = None) -> int:
                     help="compactor scan period in seconds, and the "
                          "age bound for small deltas ([ingest] "
                          "compact-interval)")
+    ps.add_argument("--breaker-threshold", type=int,
+                    help="consecutive transport failures that open a "
+                         "peer's circuit breaker ([cluster] "
+                         "breaker-threshold)")
+    ps.add_argument("--breaker-cooldown", type=float,
+                    help="seconds a breaker stays open before the "
+                         "half-open trial ([cluster] breaker-cooldown)")
+    ps.add_argument("--hedge-max-fraction", type=float,
+                    help="bound on hedged replica reads as a fraction "
+                         "of RPC volume ([cluster] hedge-max-fraction; "
+                         "0 disables hedging)")
+    ps.add_argument("--faultinject-armed",
+                    help="failpoint spec armed at open ([faultinject] "
+                         "armed; e.g. "
+                         "'client.request.send=error(transport)*3')")
     ps.add_argument("--verbose", action="store_true")
 
     pi = sub.add_parser("import", help="bulk-import CSV bits")
@@ -210,6 +225,13 @@ def cmd_server(args) -> int:
         cfg.containers.enabled = False
     if args.containers_threshold is not None:
         cfg.containers.threshold = args.containers_threshold
+    for key in ("breaker_threshold", "breaker_cooldown",
+                "hedge_max_fraction"):
+        v = getattr(args, key, None)
+        if v is not None:
+            setattr(cfg.cluster, key, v)
+    if args.faultinject_armed is not None:
+        cfg.faultinject.armed = args.faultinject_armed
     if args.no_ingest_delta:
         cfg.ingest.delta_enabled = False
     for key in ("delta_budget_bytes", "compact_threshold_bits",
@@ -311,6 +333,13 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         ingest_delta_budget_bytes=cfg.ingest.delta_budget_bytes,
         ingest_compact_threshold_bits=cfg.ingest.compact_threshold_bits,
         ingest_compact_interval=cfg.ingest.compact_interval,
+        breaker_threshold=cfg.cluster.breaker_threshold,
+        breaker_cooldown=cfg.cluster.breaker_cooldown,
+        hedge_min_samples=cfg.cluster.hedge_min_samples,
+        hedge_deviations=cfg.cluster.hedge_deviations,
+        hedge_min_ms=cfg.cluster.hedge_min_ms,
+        hedge_max_fraction=cfg.cluster.hedge_max_fraction,
+        faultinject_armed=cfg.faultinject.armed,
         logger=log,
         stats=stats,
     )

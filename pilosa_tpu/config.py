@@ -23,13 +23,45 @@ ENV_PREFIX = "PILOSA_TPU_"
 
 @dataclass
 class ClusterConfig:
-    """[cluster] section (server/config.go:100-117)."""
+    """[cluster] section (server/config.go:100-117), plus the
+    failure-handling knobs of the chaos round (parallel/cluster.py
+    circuit breakers, parallel/executor.py hedged replica reads — no
+    reference analog; Pilosa pays the full RPC timeout per query to a
+    slow-but-alive peer).  ``breaker-threshold`` consecutive transport
+    failures open a peer's breaker (queries fast-fail to the next
+    replica instead of paying the timeout); after
+    ``breaker-cooldown`` seconds the breaker half-opens and one trial
+    request (or a successful membership heartbeat probe) closes it.
+    Hedging: once ``hedge-min-samples`` latency samples exist for a
+    peer, a remote shard map still in flight past ``EWMA +
+    hedge-deviations x EWMA-deviation`` (floored at ``hedge-min-ms``)
+    is re-issued to the next replica and the first full result wins;
+    hedges are bounded to ``hedge-max-fraction`` of RPC volume (0
+    disables hedging)."""
 
     replicas: int = 1
     partitions: int = 256
     seeds: list[str] = field(default_factory=list)
     coordinator: bool = False
     long_query_time: float = 0.0  # seconds; 0 disables slow-query log
+    breaker_threshold: int = 5
+    breaker_cooldown: float = 5.0  # seconds open before half-open
+    hedge_min_samples: int = 8
+    hedge_deviations: float = 4.0
+    hedge_min_ms: float = 20.0
+    hedge_max_fraction: float = 0.1  # of RPC volume; 0 disables
+
+
+@dataclass
+class FaultinjectConfig:
+    """[faultinject] — the failpoint registry (pilosa_tpu.faultinject).
+    ``armed`` is a failpoint spec (``name=action;...`` — see the
+    module docstring for the grammar) applied at server open; empty
+    (the default) arms nothing and every compiled-in site stays on its
+    zero-cost disarmed path.  Also armable live via
+    ``POST /debug/failpoints``."""
+
+    armed: str = ""
 
 
 @dataclass
@@ -257,6 +289,8 @@ class Config:
     ingest: IngestConfig = field(default_factory=IngestConfig)
     containers: ContainersConfig = field(
         default_factory=ContainersConfig)
+    faultinject: FaultinjectConfig = field(
+        default_factory=FaultinjectConfig)
 
     # ------------------------------------------------------------- access
 
@@ -294,7 +328,7 @@ class Config:
             if key in ("cluster", "anti_entropy", "metric", "tracing",
                        "profile", "tls", "coalescer", "ragged",
                        "observe", "admission", "cache", "ingest",
-                       "containers") and isinstance(v, dict):
+                       "containers", "faultinject") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -313,7 +347,8 @@ class Config:
                                                         AdmissionConfig,
                                                         CacheConfig,
                                                         IngestConfig,
-                                                        ContainersConfig)):
+                                                        ContainersConfig,
+                                                        FaultinjectConfig)):
                 setattr(self, key, v)
 
     def _apply_env(self, env: dict) -> None:
@@ -323,7 +358,7 @@ class Config:
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
                           "profile", "tls", "coalescer", "ragged",
                           "observe", "admission", "cache", "ingest",
-                          "containers"):
+                          "containers", "faultinject"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -357,6 +392,12 @@ class Config:
             f"seeds = [{', '.join(repr(s) for s in self.cluster.seeds)}]",
             f"coordinator = {str(self.cluster.coordinator).lower()}",
             f"long-query-time = {self.cluster.long_query_time}",
+            f"breaker-threshold = {self.cluster.breaker_threshold}",
+            f"breaker-cooldown = {self.cluster.breaker_cooldown}",
+            f"hedge-min-samples = {self.cluster.hedge_min_samples}",
+            f"hedge-deviations = {self.cluster.hedge_deviations}",
+            f"hedge-min-ms = {self.cluster.hedge_min_ms}",
+            f"hedge-max-fraction = {self.cluster.hedge_max_fraction}",
             "",
             "[anti-entropy]",
             f"interval = {self.anti_entropy.interval}",
@@ -420,6 +461,9 @@ class Config:
             "[containers]",
             f"enabled = {str(self.containers.enabled).lower()}",
             f"threshold = {self.containers.threshold}",
+            "",
+            "[faultinject]",
+            f'armed = "{self.faultinject.armed}"',
             "",
             "[tls]",
             f'certificate-path = "{self.tls.certificate_path}"',

@@ -80,6 +80,9 @@ class DeviceObserver:
         self.transfer_bytes = 0
         self.transfer_chunks = 0
         self.transfer_puts = 0
+        # device-OOM recoveries: RESOURCE_EXHAUSTED launches that
+        # evicted residency and retried (executor fused Count path)
+        self.oom_retries = 0
 
     # -------------------------------------------------------------- events
 
@@ -114,6 +117,12 @@ class DeviceObserver:
                     "compile.ms", ns / 1e6)
             except Exception:  # noqa: BLE001 — telemetry never raises
                 pass
+
+    def note_oom_retry(self) -> None:
+        """One RESOURCE_EXHAUSTED launch recovered by evict-and-retry
+        (device.oom_retries)."""
+        with self._lock:
+            self.oom_retries += 1
 
     def note_transfer(self, nbytes: int, chunks: int,
                       label: str = "other") -> None:
@@ -195,6 +204,7 @@ class DeviceObserver:
                     "puts": self.transfer_puts,
                     "byLabel": transfers,
                 },
+                "oomRetries": self.oom_retries,
             }
         out["residency"] = residency.manager().stats()
         out["devices"] = self.device_memory()
@@ -222,6 +232,7 @@ class DeviceObserver:
             stats.gauge("device.transfer_bytes", self.transfer_bytes)
             stats.gauge("device.transfer_chunks", self.transfer_chunks)
             stats.gauge("device.transfer_puts", self.transfer_puts)
+            stats.gauge("device.oom_retries", self.oom_retries)
         r = residency.manager().stats()
         stats.gauge("residency.usage_bytes", r["total"])
         stats.gauge("residency.budget_bytes", r["budget"])

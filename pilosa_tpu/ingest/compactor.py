@@ -139,6 +139,13 @@ class Compactor:
                         self._pending_bytes -= prev[1]
                 continue
             if force or self._due(frag, cfg):
+                from pilosa_tpu import faultinject as _fi
+
+                if _fi.armed:
+                    # failpoint: the production delta-merge path (an
+                    # injected error aborts this scan; pending deltas
+                    # stay WAL-durable and merge on the next one)
+                    _fi.hit("compactor.merge")
                 # flush_delta takes fragment -> registry (note_flushed);
                 # no compactor lock is held here
                 if frag.flush_delta() == 0:

@@ -102,6 +102,26 @@ FAMILIES: tuple[Family, ...] = (
     Family("admission", "admission_",
            "priority-class admission control (serve/admission.py)",
            doc="administration.md"),
+    Family("breaker", "breaker_",
+           "per-peer circuit breakers on the cluster fan-out "
+           "(parallel/cluster.py)",
+           live_prefixes=("breaker_",), group="chaos",
+           doc="administration.md"),
+    Family("hedge", "hedge_",
+           "hedged replica reads on the remote shard map "
+           "(parallel/executor.py)",
+           live_prefixes=("hedge_",), group="chaos",
+           doc="administration.md"),
+    Family("failpoint", "failpoint_",
+           "failpoint registry arming/trigger accounting "
+           "(pilosa_tpu.faultinject)",
+           live_prefixes=("failpoint_",), group="chaos",
+           doc="administration.md"),
+    Family("partial", "partial_",
+           "degraded-read (?partial=1) request accounting "
+           "(parallel/executor.py)",
+           live_prefixes=("partial_",), group="chaos",
+           doc="administration.md"),
     Family("http", "http_",
            "per-route request counters (server/handler.py)"),
     Family("gc", "gc_",

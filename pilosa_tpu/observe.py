@@ -133,7 +133,8 @@ class QueryRecord:
         "elapsed_ns", "shards_n", "stages", "shard_ns", "node_ns",
         "launches", "path", "coalesce", "result_sizes", "error", "slow",
         "admission", "outcome", "compiles", "cached", "cache_key",
-        "delta_notes", "compacted",
+        "delta_notes", "compacted", "hedged", "hedge_wins",
+        "missing_shards",
     )
 
     def __init__(self, qid: int, index: str, pql: str,
@@ -185,6 +186,16 @@ class QueryRecord:
         # a single idempotent True store, race-free
         self.delta_notes: list[int] = []
         self.compacted = False
+        # failure-handling annotations (the chaos round): ``hedged``
+        # counts remote flights this query re-issued to a replica
+        # past the peer's latency threshold, ``hedge_wins`` how many
+        # of those races the hedge side won; ``missing_shards`` are
+        # the shards a ?partial=1 request accounted as unavailable
+        # (or, on a ShardsUnavailableError, the shards that failed
+        # it).  All touched only by the origin map thread.
+        self.hedged = 0
+        self.hedge_wins = 0
+        self.missing_shards: list[int] = []
 
     # ------------------------------------------------------------ notes
 
@@ -224,6 +235,12 @@ class QueryRecord:
 
     def note_path(self, path: str) -> None:
         self.path = path
+
+    def note_missing(self, shard: int) -> None:
+        """One shard accounted unavailable (partial degradation or a
+        structured exhaustion error)."""
+        if len(self.missing_shards) < MAX_SHARD_TIMINGS:
+            self.missing_shards.append(shard)
 
     # ----------------------------------------------------------- export
 
@@ -275,6 +292,13 @@ class QueryRecord:
             d["deltaDepth"] = sum(self.delta_notes)
         if self.compacted:
             d["compacted"] = True
+        # chaos-round annotations: present only when the query hedged
+        # or degraded (the common healthy record stays small)
+        if self.hedged:
+            d["hedged"] = self.hedged
+            d["hedgeWins"] = self.hedge_wins
+        if self.missing_shards:
+            d["missingShards"] = sorted(self.missing_shards)
         if self.admission is not None:
             d["admission"] = {
                 "class": self.admission.get("class"),

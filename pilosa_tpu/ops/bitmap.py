@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from pilosa_tpu import faultinject as _fi
 from pilosa_tpu import observe as _observe
 
 WORD_BITS = 32
@@ -57,6 +58,13 @@ def note_dispatch(name: str) -> None:
     is active on it).  The flight recorder reuses THIS hook so a
     query's profiled device-launch count is the dispatch-count the
     regression tests pin, by construction."""
+    if _fi.armed:
+        # failpoint: every device kernel launch funnels through here —
+        # error(oom) exercises the executor's RESOURCE_EXHAUSTED
+        # evict-and-retry without a real allocation failure.  Gated on
+        # the module bool so the disarmed hot path pays one attribute
+        # read (bench.py extras.faultinject).
+        _fi.hit("device.dispatch")
     log = getattr(_dispatch, "log", None)
     if log is not None:
         log.append(name)

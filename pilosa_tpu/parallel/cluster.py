@@ -23,6 +23,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time as _time
 from dataclasses import dataclass
 
 # Cluster states (cluster.go:46-50)
@@ -227,7 +228,7 @@ class Transport:
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
-                   nocontainers: bool = False):
+                   nocontainers: bool = False, partial: bool = False):
         """Execute pql on the remote node restricted to `shards` with
         remote semantics (no re-translation).  Returns the result list.
         Raises TransportError if the node is unreachable.  ``nocache``
@@ -236,7 +237,9 @@ class Transport:
         ``nodelta`` forwards ?nodelta=1 the same way (peers compact
         their pending ingest deltas and answer from pure base);
         ``nocontainers`` forwards ?nocontainers=1 (peers route their
-        fused reads through the dense pre-container path)."""
+        fused reads through the dense pre-container path); ``partial``
+        forwards ?partial=1 (degraded-read semantics ride sub-queries
+        like the other per-request escapes)."""
         raise NotImplementedError
 
     def send_message(self, node: Node, message: dict) -> dict:
@@ -302,7 +305,7 @@ class LocalTransport(Transport):
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
-                   nocontainers: bool = False):
+                   nocontainers: bool = False, partial: bool = False):
         from pilosa_tpu.parallel.executor import ExecOptions
 
         if node.id in self.down or node.id not in self.handles:
@@ -315,6 +318,7 @@ class LocalTransport(Transport):
                 remote=True, shards=None if shards is None else list(shards),
                 cache=not nocache, delta=not nodelta,
                 containers=not nocontainers,
+                partial=partial, missing=set() if partial else None,
             ),
         )
 
@@ -343,7 +347,7 @@ class BoundTransport(Transport):
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
-                   nocontainers: bool = False):
+                   nocontainers: bool = False, partial: bool = False):
         self.parent._check_partition(self.src, node.id)
         extra = {}
         if nocache:
@@ -352,6 +356,8 @@ class BoundTransport(Transport):
             extra["nodelta"] = True
         if nocontainers:
             extra["nocontainers"] = True
+        if partial:
+            extra["partial"] = True
         if extra:
             return self.parent.query_node(node, index, pql, shards,
                                           **extra)
@@ -362,6 +368,147 @@ class BoundTransport(Transport):
     def send_message(self, node: Node, message: dict) -> dict:
         self.parent._check_partition(self.src, node.id)
         return self.parent.send_message(node, message)
+
+
+#: circuit-breaker states (the classic closed/open/half-open machine;
+#: no reference analog — Pilosa pays the full RPC timeout per query to
+#: a dead-but-routable peer until SWIM marks it DOWN)
+BREAKER_CLOSED = "CLOSED"
+BREAKER_OPEN = "OPEN"
+BREAKER_HALF_OPEN = "HALF_OPEN"
+
+
+class CircuitBreaker:
+    """Per-peer circuit breaker.
+
+    CLOSED counts consecutive transport failures; at ``threshold`` it
+    OPENs and ``allow()`` fast-fails every call until ``cooldown_s``
+    elapses, when the next ``allow()`` transitions to HALF_OPEN and
+    admits exactly ONE trial — success closes (and resets the failure
+    count), failure re-opens for another cooldown.  Shed responses
+    (429/503 from a live peer's admission gate) must never feed
+    ``note_failure``: a shed is proof of life (see ShedByPeerError).
+
+    Half-open trials also ride the membership heartbeat: a successful
+    SWIM probe calls ``note_success`` through ``Cluster.note_probe``,
+    so an idle peer's breaker heals without waiting for query traffic
+    to gamble on it.
+
+    ``clock`` is injectable for deterministic state-machine tests."""
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 5.0,
+                 clock=_time.monotonic):
+        from pilosa_tpu import lockcheck as _lockcheck
+
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock
+        self._lock = _lockcheck.lock("breaker")
+        self._state = BREAKER_CLOSED
+        self._failures = 0      # consecutive failures while CLOSED
+        self._opened_t = 0.0    # clock() at the last OPEN transition
+        self._probing = False   # a HALF_OPEN trial is outstanding
+        self._probe_t = 0.0     # clock() when that trial was admitted
+        # cumulative transition + refusal counters (breaker.* metrics)
+        self.opened = 0
+        self.closed = 0
+        self.half_opens = 0
+        self.fast_fails = 0
+
+    def allow(self) -> bool:
+        """True when a request may be sent to this peer.  While OPEN,
+        the first call past the cooldown flips to HALF_OPEN and is
+        admitted as the trial; concurrent calls during the trial keep
+        fast-failing."""
+        with self._lock:
+            if self._state == BREAKER_CLOSED:
+                return True
+            if self._state == BREAKER_OPEN:
+                if self.clock() - self._opened_t >= self.cooldown_s:
+                    self._state = BREAKER_HALF_OPEN
+                    self._probing = True
+                    self._probe_t = self.clock()
+                    self.half_opens += 1
+                    return True
+                self.fast_fails += 1
+                return False
+            # HALF_OPEN: one trial at a time — but a trial whose
+            # outcome never arrived (caller crashed before noting)
+            # must not wedge the breaker refusing forever: after one
+            # more cooldown, admit a fresh trial
+            if (not self._probing
+                    or self.clock() - self._probe_t >= self.cooldown_s):
+                self._probing = True
+                self._probe_t = self.clock()
+                self.half_opens += 1
+                return True
+            self.fast_fails += 1
+            return False
+
+    def note_success(self) -> None:
+        with self._lock:
+            if self._state != BREAKER_CLOSED:
+                self.closed += 1
+            self._state = BREAKER_CLOSED
+            self._failures = 0
+            self._probing = False
+
+    def note_failure(self) -> None:
+        with self._lock:
+            if self._state == BREAKER_HALF_OPEN:
+                # the trial failed: straight back to OPEN
+                self._state = BREAKER_OPEN
+                self._opened_t = self.clock()
+                self._probing = False
+                self.opened += 1
+                return
+            if self._state == BREAKER_OPEN:
+                return
+            self._failures += 1
+            if self._failures >= self.threshold:
+                self._state = BREAKER_OPEN
+                self._opened_t = self.clock()
+                self.opened += 1
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutiveFailures": self._failures,
+                "opened": self.opened,
+                "closed": self.closed,
+                "halfOpens": self.half_opens,
+                "fastFails": self.fast_fails,
+            }
+
+
+class _PeerLatency:
+    """EWMA mean + EWMA absolute deviation of one peer's successful
+    RPC latencies — the signal hedged reads trigger on.  Touched only
+    under the owning Cluster's ``_peer_lock``."""
+
+    __slots__ = ("ewma_s", "dev_s", "n")
+    ALPHA = 0.2
+
+    def __init__(self):
+        self.ewma_s = 0.0
+        self.dev_s = 0.0
+        self.n = 0
+
+    def update(self, latency_s: float) -> None:
+        if self.n == 0:
+            self.ewma_s = latency_s
+            self.dev_s = 0.0
+        else:
+            d = abs(latency_s - self.ewma_s)
+            self.ewma_s += self.ALPHA * (latency_s - self.ewma_s)
+            self.dev_s += self.ALPHA * (d - self.dev_s)
+        self.n += 1
 
 
 class Cluster:
@@ -378,6 +525,8 @@ class Cluster:
         transport: Transport | None = None,
         topology_path: str | None = None,
         coordinator_id: str | None = None,
+        breaker_threshold: int = 5,
+        breaker_cooldown_s: float = 5.0,
     ):
         self.local_id = local_id
         self.replica_n = max(1, replica_n)
@@ -394,6 +543,16 @@ class Cluster:
             self._nodes[local_id] = Node(id=local_id)
         self.coordinator_id = coordinator_id or sorted(self._nodes)[0]
         self._listeners: list = []
+        # per-peer failure handling (the chaos round): circuit
+        # breakers + latency EWMAs, both keyed by node id and guarded
+        # by their own lock (never taken with self._lock held)
+        from pilosa_tpu import lockcheck as _lockcheck
+
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self._peer_lock = _lockcheck.lock("peers")
+        self._breakers: dict[str, CircuitBreaker] = {}
+        self._peer_lat: dict[str, _PeerLatency] = {}
         if topology_path and os.path.exists(topology_path):
             self._load_topology()
         self.save_topology()
@@ -500,6 +659,119 @@ class Cluster:
             self.state = STATE_DEGRADED  # still degraded; queries hitting
             # lost shards fail with exhausted-replica errors
 
+    # ------------------------------------------------- per-peer breakers
+
+    def breaker(self, node_id: str) -> CircuitBreaker:
+        """The peer's breaker, created on first use."""
+        with self._peer_lock:
+            b = self._breakers.get(node_id)
+            if b is None:
+                b = self._breakers[node_id] = CircuitBreaker(
+                    self.breaker_threshold, self.breaker_cooldown_s)
+            return b
+
+    def peer_allows(self, node_id: str) -> bool:
+        """False when the peer's breaker refuses traffic right now
+        (counts a fast-fail).  The local node always allows."""
+        if node_id == self.local_id:
+            return True
+        return self.breaker(node_id).allow()
+
+    def breaker_open(self, node_id: str) -> bool:
+        """True when the peer's breaker is OPEN and still cooling down
+        — a pure routing read (no state transition, no fast-fail
+        count), used by shards_by_node to steer primaries away from
+        known-bad peers exactly like DOWN markings."""
+        with self._peer_lock:
+            b = self._breakers.get(node_id)
+        if b is None:
+            return False
+        with b._lock:
+            return (b._state == BREAKER_OPEN
+                    and b.clock() - b._opened_t < b.cooldown_s)
+
+    def note_peer_success(self, node_id: str,
+                          latency_s: float | None = None) -> None:
+        """A peer answered (any HTTP answer counts — shed included).
+        Feeds the breaker; a real latency sample also feeds the hedge
+        EWMA (shed/probe successes pass None: their turnaround is not
+        a service-time sample)."""
+        self.breaker(node_id).note_success()
+        if latency_s is not None:
+            with self._peer_lock:
+                lat = self._peer_lat.get(node_id)
+                if lat is None:
+                    lat = self._peer_lat[node_id] = _PeerLatency()
+                lat.update(latency_s)
+
+    def note_peer_failure(self, node_id: str) -> None:
+        self.breaker(node_id).note_failure()
+
+    def peer_latency(self, node_id: str) -> tuple[float, float, int]:
+        """(ewma_s, deviation_s, n_samples) for the peer — (0,0,0)
+        until the first sample."""
+        with self._peer_lock:
+            lat = self._peer_lat.get(node_id)
+            if lat is None:
+                return (0.0, 0.0, 0)
+            return (lat.ewma_s, lat.dev_s, lat.n)
+
+    def note_probe(self, node_id: str, alive: bool) -> None:
+        """Membership heartbeat hand-off (parallel/membership.py): a
+        successful SWIM probe is the half-open trial riding the
+        heartbeat — it closes an open breaker without waiting for
+        query traffic; a failed probe re-opens a half-open one.  A
+        failed probe of a CLOSED breaker is left to real traffic (and
+        the DOWN marking) so a single lost ping cannot open
+        breakers."""
+        with self._peer_lock:
+            b = self._breakers.get(node_id)
+        if b is None:
+            return
+        if alive:
+            b.note_success()
+        elif b.state != BREAKER_CLOSED:
+            b.note_failure()
+
+    def debug_peers(self) -> dict:
+        """The /debug/peers document: per-peer breaker state, latency
+        EWMA, and membership state."""
+        out = {}
+        for n in self.sorted_nodes():
+            if n.id == self.local_id:
+                continue
+            with self._peer_lock:
+                b = self._breakers.get(n.id)
+            ewma, dev, samples = self.peer_latency(n.id)
+            out[n.id] = {
+                "uri": n.uri,
+                "nodeState": n.state,
+                "breaker": (b.snapshot() if b is not None
+                            else {"state": BREAKER_CLOSED}),
+                "latencyEwmaMs": round(ewma * 1e3, 3),
+                "latencyDevMs": round(dev * 1e3, 3),
+                "latencySamples": samples,
+            }
+        return out
+
+    def publish_breaker_gauges(self, stats) -> None:
+        """breaker.* gauge family for /metrics and /debug/vars.
+        Cumulative transition counts publish as gauges (they are
+        already totals — the devobs discipline)."""
+        with self._peer_lock:
+            breakers = list(self._breakers.values())
+        n_open = sum(1 for b in breakers if b.state != BREAKER_CLOSED)
+        stats.gauge("breaker.tracked", len(breakers))
+        stats.gauge("breaker.open", n_open)
+        stats.gauge("breaker.opened_total",
+                    sum(b.opened for b in breakers))
+        stats.gauge("breaker.closed_total",
+                    sum(b.closed for b in breakers))
+        stats.gauge("breaker.half_opens_total",
+                    sum(b.half_opens for b in breakers))
+        stats.gauge("breaker.fast_fails_total",
+                    sum(b.fast_fails for b in breakers))
+
     # ----------------------------------------------------------- placement
 
     def partition_nodes(self, p: int) -> list[Node]:
@@ -536,10 +808,14 @@ class Cluster:
             owners = self.shard_nodes(index, s)
             ids = [n.id for n in owners]
             target = self.local_id if self.local_id in ids else ids[0]
-            # skip DOWN primaries up front; failover handles mid-query loss
+            # skip DOWN primaries and open-breaker peers up front;
+            # failover handles mid-query loss (a fully-excluded shard
+            # keeps its first owner so the breaker's half-open trial
+            # still has a route)
             if target != self.local_id:
                 for nid in ids:
-                    if self._nodes[nid].state != NODE_DOWN:
+                    if (self._nodes[nid].state != NODE_DOWN
+                            and not self.breaker_open(nid)):
                         target = nid
                         break
             out.setdefault(target, []).append(s)

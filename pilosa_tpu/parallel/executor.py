@@ -55,6 +55,7 @@ from pilosa_tpu.runtime import resultcache
 from pilosa_tpu.serve import deadline as _deadline
 from pilosa_tpu.serve.deadline import DeadlineExceededError
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu import faultinject as _fi
 from pilosa_tpu import observe as _observe
 from pilosa_tpu import stats as _stats
 from pilosa_tpu import tracing
@@ -98,10 +99,103 @@ class ExecOptions:
     # per-shard map, and before reduce so expired work never reaches
     # device dispatch
     deadline: object | None = None
+    # degraded-read mode (the HTTP layer's ?partial=1 / the
+    # X-Pilosa-Partial header, forwarded on sub-queries like
+    # ?nocache): shards whose replicas are ALL unavailable are
+    # ACCOUNTED in ``missing`` instead of failing the whole query —
+    # the caller surfaces missingShards/missingFraction.  The default
+    # (partial=False, missing=None) keeps today's all-or-error
+    # semantics on exactly the same code path.
+    partial: bool = False
+    missing: set | None = None
+    # widest shard fan-out this request targeted (stamped by
+    # _target_shards) — the denominator of missingFraction
+    targeted: int = 0
 
 
 class ExecutionError(ValueError):
     pass
+
+
+class ShardsUnavailableError(ExecutionError):
+    """Read fan-out exhausted every replica of one or more shards.
+
+    Structured (chaos round): ``shards`` is the sorted unavailable
+    shard list and ``causes`` maps shard -> {node_id: cause} with
+    cause one of ``transport`` / ``timeout`` / ``shed`` / ``breaker``
+    — surfaced in the HTTP error body (503 with
+    ``unavailableShards``/``causes``) and on the flight record,
+    replacing the old flat "all replicas exhausted" string."""
+
+    def __init__(self, shards, causes: dict | None = None):
+        self.shards = sorted(shards)
+        causes = causes or {}
+        self.causes = {s: dict(causes.get(s, {})) for s in self.shards}
+        head = self.shards[:8]
+        detail = "; ".join(
+            f"shard {s}: " + (", ".join(
+                f"{n}={c}" for n, c in sorted(self.causes[s].items()))
+                or "no live replica")
+            for s in head)
+        more = ("" if len(self.shards) <= 8
+                else f" (+{len(self.shards) - 8} more)")
+        super().__init__(
+            f"shards {self.shards} unavailable: all replicas "
+            f"exhausted{more}: {detail}")
+
+
+def _failure_cause(e: BaseException) -> str:
+    """Classify one replica failure for ShardsUnavailableError /
+    /debug surfaces: shed (peer alive but refusing), timeout (the
+    transport gave up waiting), transport (unreachable/mid-request
+    death)."""
+    if isinstance(e, ShedByPeerError):
+        return "shed"
+    s = str(e).lower()
+    if "timed out" in s or "timeout" in s:
+        return "timeout"
+    return "transport"
+
+
+class _Flight:
+    """One in-flight remote shard map (original or hedge)."""
+
+    __slots__ = ("node_id", "shards", "t0", "race", "is_hedge",
+                 "hedge_attempted")
+
+    def __init__(self, node_id: str, shards: list[int], t0: int,
+                 race: "_HedgeRace | None" = None,
+                 is_hedge: bool = False):
+        self.node_id = node_id
+        self.shards = shards
+        self.t0 = t0
+        self.race = race
+        self.is_hedge = is_hedge
+        self.hedge_attempted = False
+
+
+class _HedgeRace:
+    """One original flight racing its hedge re-issues.  Remote results
+    are not separable per shard (a Count sub-query returns one total
+    over its shard group), so the race commits a whole SIDE: the
+    original, or the full set of hedge flights covering the same
+    shards — first side to completely succeed wins, the loser is
+    abandoned (ignored, never awaited).  Touched only by the one
+    thread running the owning map loop — no lock."""
+
+    __slots__ = ("node_id", "shards", "orig_failed", "orig_error",
+                 "hedge_pending", "hedge_failed", "hedge_results",
+                 "committed")
+
+    def __init__(self, node_id: str, shards: list[int]):
+        self.node_id = node_id
+        self.shards = shards
+        self.orig_failed = False
+        self.orig_error: BaseException | None = None
+        self.hedge_pending = 0
+        self.hedge_failed = False
+        self.hedge_results: list = []
+        self.committed: str | None = None
 
 
 class UnownedShardError(ExecutionError):
@@ -149,6 +243,24 @@ class Executor:
 
         self.pool = ThreadPoolExecutor(
             max_workers=worker_pool_size or _os.cpu_count() or 8)
+        # hedged replica reads ([cluster] hedge-* config; the server
+        # assembly overwrites these): a remote shard map still in
+        # flight past the peer's EWMA + k*dev latency threshold is
+        # re-issued to the next replicas and the first full result
+        # wins.  The fraction bound is global across queries, so the
+        # counters live here under their own lock.
+        self.hedge_min_samples = 8
+        self.hedge_deviations = 4.0
+        self.hedge_min_s = 0.02
+        self.hedge_max_fraction = 0.1  # of RPC volume; <=0 disables
+        self._hedge_lock = threading.Lock()
+        self._hedge_rpcs = 0
+        self._hedge_issued = 0
+        self._hedge_wins = 0
+        # partial-result accounting (?partial=1 requests / requests
+        # that actually degraded) — the partial.* gauge family
+        self._partial_requests = 0
+        self._partial_degraded = 0
 
     # ------------------------------------------------------------- public
 
@@ -167,6 +279,12 @@ class Executor:
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
+        if opt.partial:
+            if opt.missing is None:
+                # a partial request always carries its accounting set
+                opt.missing = set()
+            with self._hedge_lock:
+                self._partial_requests += 1
         rec = None
         if self.recorder is not None and self.recorder.enabled:
             # str() on a parsed Query re-serializes the AST — only pay
@@ -229,9 +347,17 @@ class Executor:
             if rec is not None:
                 if isinstance(e, DeadlineExceededError):
                     rec.outcome = "expired"
+                if isinstance(e, ShardsUnavailableError):
+                    # the structured unavailability surfaces on the
+                    # flight record too, not just the HTTP body
+                    for s in e.shards:
+                        rec.note_missing(s)
                 self.recorder.publish(rec,
                                       error=f"{type(e).__name__}: {e}")
             raise
+        if opt.missing:
+            with self._hedge_lock:
+                self._partial_degraded += 1
         if rec is not None:
             rec.result_sizes = [_observe.result_size(r) for r in results]
             self.recorder.publish(rec)
@@ -297,6 +423,9 @@ class Executor:
             # the chokepoint every op's shard resolution passes through:
             # record the query's fan-out (max across calls)
             rec.note_shards(len(out))
+        if opt is not None and len(out) > opt.targeted:
+            # missingFraction's denominator for partial results
+            opt.targeted = len(out)
         return out
 
     def _cluster_active(self, opt: ExecOptions | None) -> bool:
@@ -340,7 +469,7 @@ class Executor:
 
     def _local_map(self, fn, shards, deadline=None):
         rec = _observe.current()
-        if rec is not None or deadline is not None:
+        if rec is not None or deadline is not None or _fi.armed:
             # re-attach the flight record on the pool workers so their
             # kernel launches tick it, time each shard's evaluation,
             # and bail before a shard whose deadline already expired —
@@ -348,6 +477,9 @@ class Executor:
             inner = fn
 
             def fn(shard, _inner=inner, _rec=rec, _dl=deadline):
+                if _fi.armed:
+                    # failpoint: the production per-shard map
+                    _fi.hit("executor.map_shard")
                 if _dl is not None and _dl.expired():
                     raise DeadlineExceededError(
                         f"deadline expired before map of shard {shard}")
@@ -406,8 +538,143 @@ class Executor:
         pql = str(call if remote_call is None else remote_call)
         partials = []
         tried: dict[int, set] = {s: set() for s in shards}
+        causes: dict[int, dict] = {}  # shard -> {node_id: cause}
         pending = cluster.shards_by_node(idx.name, shards)
-        inflight: dict = {}  # future -> (node_id, node_shards, t_submit)
+        inflight: dict = {}  # future -> _Flight
+
+        def submit(node_id, node_shards, race=None, is_hedge=False):
+            extra = {}
+            if opt is not None and not opt.cache:
+                # forward the origin's ?nocache=1: peers must do a
+                # real execution too, not answer from their
+                # per-shard result caches
+                extra["nocache"] = True
+            if opt is not None and not opt.delta:
+                # forward ?nodelta=1: peers compact their own
+                # pending deltas and run against pure base too
+                extra["nodelta"] = True
+            if opt is not None and not opt.containers:
+                # forward ?nocontainers=1: peers route their own
+                # fused reads through the dense pre-container path
+                extra["nocontainers"] = True
+            if opt is not None and opt.partial:
+                # forward ?partial=1: degraded-read semantics ride
+                # sub-queries like the other per-request escapes
+                extra["partial"] = True
+            if extra:
+                fut = self._submit_io(
+                    lambda n, i, p, s, _e=extra:
+                    cluster.transport.query_node(n, i, p, s, **_e),
+                    cluster.node(node_id), idx.name, pql,
+                    node_shards,
+                )
+            else:
+                fut = self._submit_io(
+                    cluster.transport.query_node,
+                    cluster.node(node_id), idx.name, pql, node_shards,
+                )
+            fl = _Flight(node_id, node_shards,
+                         _time.perf_counter_ns(),
+                         race=race, is_hedge=is_hedge)
+            inflight[fut] = fl
+
+            def _settle(f, _fl=fl):
+                # Runs on the flight's IO thread the moment it
+                # resolves — whether the map loop processes it, a
+                # settled race purged it, or an exhaustion error
+                # unwound with it still in the air — so breakers and
+                # the latency EWMA ALWAYS learn the outcome.  Without
+                # this, a hedged-over HALF_OPEN trial would never
+                # resolve its probe and the breaker would wedge
+                # refusing until a heartbeat probe happened by.
+                try:
+                    f.result()
+                except ShedByPeerError:
+                    # a shed is proof of life: never a breaker failure
+                    cluster.note_peer_success(_fl.node_id)
+                except TransportError:
+                    cluster.note_peer_failure(_fl.node_id)
+                except BaseException:  # noqa: BLE001 — deadline &c.:
+                    pass  # says nothing about the PEER either way
+                else:
+                    cluster.note_peer_success(
+                        _fl.node_id,
+                        (_time.perf_counter_ns() - _fl.t0) / 1e9)
+
+            fut.add_done_callback(_settle)
+            with self._hedge_lock:
+                self._hedge_rpcs += 1
+
+        def fail_shards(node_shards, node_id, err, cause):
+            """Fail ``node_shards`` over from ``node_id`` onto their
+            next replicas; shards with no replica left are ACCOUNTED
+            (?partial=1) or raised as a structured
+            ShardsUnavailableError carrying the shard list and the
+            per-replica causes collected along the way."""
+            exhausted = []
+            for s in node_shards:
+                tried[s].add(node_id)
+                causes.setdefault(s, {})[node_id] = cause
+                nxt = cluster.next_replica(idx.name, s, tried[s])
+                if nxt is None:
+                    exhausted.append(s)
+                else:
+                    pending.setdefault(nxt.id, []).append(s)
+            if not exhausted:
+                return
+            if (opt is not None and opt.partial
+                    and opt.missing is not None):
+                for s in exhausted:
+                    opt.missing.add(s)
+                    if rec is not None:
+                        rec.note_missing(s)
+                return
+            if isinstance(err, ShedByPeerError):
+                # every replica SHED (admission gates saturated
+                # cluster-wide): transient overload, not missing data
+                # — let it surface as 503 + Retry-After, never the 400
+                # an ExecutionError maps to
+                raise err
+            raise ShardsUnavailableError(exhausted, causes)
+
+        def purge_race(race):
+            """Abandon (cancel-or-ignore) every still-inflight flight
+            of a settled race: the loser's IO thread finishes on its
+            own; its result is dropped.  Never await a loser — waiting
+            out a slow peer is exactly what hedging exists to avoid."""
+            for f2 in [f2 for f2, fl2 in inflight.items()
+                       if fl2.race is race]:
+                inflight.pop(f2)
+
+        def try_hedge(fl):
+            """Race ``fl``'s shards on their next replicas.  A remote
+            result is one value for the whole shard group, so the
+            hedge must cover EVERY shard of the flight (each on a live
+            next replica) or not issue at all; the global fraction
+            bound keeps hedges from ever exceeding hedge-max-fraction
+            of RPC volume."""
+            fl.hedge_attempted = True
+            with self._hedge_lock:
+                if (self._hedge_issued + 1
+                        > self.hedge_max_fraction * self._hedge_rpcs):
+                    return
+            groups: dict[str, list[int]] = {}
+            for s in fl.shards:
+                nxt = cluster.next_replica(idx.name, s,
+                                           tried[s] | {fl.node_id})
+                if nxt is None or cluster.breaker_open(nxt.id):
+                    return
+                groups.setdefault(nxt.id, []).append(s)
+            race = _HedgeRace(fl.node_id, fl.shards)
+            race.hedge_pending = len(groups)
+            fl.race = race
+            for hnode_id, hshards in groups.items():
+                submit(hnode_id, hshards, race=race, is_hedge=True)
+            with self._hedge_lock:
+                self._hedge_issued += 1
+            if rec is not None:
+                rec.hedged += 1
+
         while pending or inflight:
             # fan out every remote group concurrently, then run local
             # shards inline while the remotes are in flight — distributed
@@ -415,34 +682,16 @@ class Executor:
             # goroutines)
             for node_id in [k for k in list(pending) if k != cluster.local_id]:
                 node_shards = pending.pop(node_id)
-                extra = {}
-                if opt is not None and not opt.cache:
-                    # forward the origin's ?nocache=1: peers must do a
-                    # real execution too, not answer from their
-                    # per-shard result caches
-                    extra["nocache"] = True
-                if opt is not None and not opt.delta:
-                    # forward ?nodelta=1: peers compact their own
-                    # pending deltas and run against pure base too
-                    extra["nodelta"] = True
-                if opt is not None and not opt.containers:
-                    # forward ?nocontainers=1: peers route their own
-                    # fused reads through the dense pre-container path
-                    extra["nocontainers"] = True
-                if extra:
-                    fut = self._submit_io(
-                        lambda n, i, p, s, _e=extra:
-                        cluster.transport.query_node(n, i, p, s, **_e),
-                        cluster.node(node_id), idx.name, pql,
-                        node_shards,
-                    )
-                else:
-                    fut = self._submit_io(
-                        cluster.transport.query_node,
-                        cluster.node(node_id), idx.name, pql, node_shards,
-                    )
-                inflight[fut] = (node_id, node_shards,
-                                 _time.perf_counter_ns())
+                if not cluster.peer_allows(node_id):
+                    # breaker open: fast-fail onto the next replica
+                    # without paying the transport timeout
+                    fail_shards(node_shards, node_id,
+                                TransportError(
+                                    f"circuit breaker open for peer "
+                                    f"{node_id}"),
+                                "breaker")
+                    continue
+                submit(node_id, node_shards)
             if cluster.local_id in pending:
                 local_shards = pending.pop(cluster.local_id)
                 t_loc = _time.perf_counter_ns()
@@ -458,34 +707,135 @@ class Executor:
                                   len(local_shards))
             if not inflight:
                 continue
-            done, _ = futures_wait(list(inflight), return_when=FIRST_COMPLETED)
+            # hedge pass: an original flight past its per-peer latency
+            # threshold (EWMA + k*dev, floored) races its shards on
+            # the next replicas; flights below threshold bound the
+            # wait so the check re-runs when the soonest one crosses
+            timeout = None
+            if self.hedge_max_fraction > 0:
+                now = _time.perf_counter_ns()
+                soonest = None
+                for fl in list(inflight.values()):
+                    if (fl.race is not None or fl.is_hedge
+                            or fl.hedge_attempted):
+                        continue
+                    thr = self._hedge_threshold_s(fl.node_id)
+                    if thr is None:
+                        continue
+                    due = fl.t0 + int(thr * 1e9)
+                    if now >= due:
+                        try_hedge(fl)
+                    elif soonest is None or due < soonest:
+                        soonest = due
+                if soonest is not None:
+                    timeout = max(0.001, (soonest - now) / 1e9)
+            done, _ = futures_wait(list(inflight), timeout=timeout,
+                                   return_when=FIRST_COMPLETED)
             for fut in done:
-                node_id, node_shards, t_sub = inflight.pop(fut)
+                fl = inflight.pop(fut, None)
+                if fl is None:
+                    continue  # purged loser of a settled race
                 try:
                     res = fut.result()
                 except TransportError as te:
-                    for s in node_shards:
-                        tried[s].add(node_id)
-                        nxt = cluster.next_replica(idx.name, s, tried[s])
-                        if nxt is None:
-                            if isinstance(te, ShedByPeerError):
-                                # every replica SHED (admission gates
-                                # saturated cluster-wide): transient
-                                # overload, not missing data — let it
-                                # surface as 503 + Retry-After, never
-                                # the 400 an ExecutionError maps to
-                                raise
-                            raise ExecutionError(
-                                f"shard {s} unavailable: all replicas exhausted"
-                            )
-                        pending.setdefault(nxt.id, []).append(s)
+                    # breaker/EWMA feedback already ran in the
+                    # flight's _settle callback
+                    cause = _failure_cause(te)
+                    race = fl.race
+                    if race is None:
+                        fail_shards(fl.shards, fl.node_id, te, cause)
+                        continue
+                    if fl.is_hedge:
+                        race.hedge_pending -= 1
+                        race.hedge_failed = True
+                        for s in fl.shards:
+                            tried[s].add(fl.node_id)
+                            causes.setdefault(s, {})[fl.node_id] = cause
+                        if (race.committed is None and race.orig_failed
+                                and race.hedge_pending == 0):
+                            # both sides dead: normal failover for the
+                            # original shard set
+                            race.committed = "failed"
+                            fail_shards(race.shards, race.node_id,
+                                        race.orig_error,
+                                        _failure_cause(race.orig_error))
+                    else:
+                        race.orig_failed = True
+                        race.orig_error = te
+                        if (race.committed is None and race.hedge_failed
+                                and race.hedge_pending == 0):
+                            race.committed = "failed"
+                            fail_shards(fl.shards, fl.node_id, te,
+                                        cause)
+                        # hedge side still pending: wait for it
                     continue
-                if rec is not None:
-                    rec.note_node(node_id,
-                                  _time.perf_counter_ns() - t_sub,
-                                  len(node_shards))
-                partials.extend(adapt(res[0]))
+                lat_ns = _time.perf_counter_ns() - fl.t0
+                race = fl.race
+                if race is None:
+                    if rec is not None:
+                        rec.note_node(fl.node_id, lat_ns,
+                                      len(fl.shards))
+                    partials.extend(adapt(res[0]))
+                    continue
+                if fl.is_hedge:
+                    race.hedge_pending -= 1
+                    race.hedge_results.append((fl, res))
+                    if (race.committed is None and not race.hedge_failed
+                            and race.hedge_pending == 0):
+                        # the hedge side produced the full shard set
+                        # first: commit it, abandon the original
+                        race.committed = "hedge"
+                        for hfl, hres in race.hedge_results:
+                            if rec is not None:
+                                rec.note_node(
+                                    hfl.node_id,
+                                    _time.perf_counter_ns() - hfl.t0,
+                                    len(hfl.shards))
+                            partials.extend(adapt(hres[0]))
+                        with self._hedge_lock:
+                            self._hedge_wins += 1
+                        if rec is not None:
+                            rec.hedge_wins += 1
+                        purge_race(race)
+                else:
+                    if race.committed is None:
+                        race.committed = "orig"
+                        if rec is not None:
+                            rec.note_node(fl.node_id, lat_ns,
+                                          len(fl.shards))
+                        partials.extend(adapt(res[0]))
+                        purge_race(race)
         return partials
+
+    def _hedge_threshold_s(self, node_id: str) -> float | None:
+        """The elapsed time past which a flight to ``node_id`` should
+        hedge, or None while the peer has too few latency samples for
+        the EWMA to mean anything."""
+        ewma, dev, n = self.cluster.peer_latency(node_id)
+        if n < self.hedge_min_samples:
+            return None
+        return max(self.hedge_min_s,
+                   ewma + self.hedge_deviations * dev)
+
+    @staticmethod
+    def _rc_fill_ok(opt: ExecOptions | None) -> bool:
+        """Partial results never enter the result cache: once this
+        request has accounted a missing shard, every fill it would
+        perform is suppressed (probes/hits stay — serving a COMPLETE
+        cached value to a degraded request is strictly better than
+        recomputing a partial one)."""
+        return opt is None or not opt.missing
+
+    def publish_chaos_gauges(self, stats) -> None:
+        """hedge.* / partial.* gauge families for /metrics and
+        /debug/vars — published unconditionally (zeros on a clean
+        server) so the families are scrape-visible before any fault."""
+        with self._hedge_lock:
+            stats.gauge("hedge.rpcs", self._hedge_rpcs)
+            stats.gauge("hedge.issued", self._hedge_issued)
+            stats.gauge("hedge.wins", self._hedge_wins)
+            stats.gauge("partial.requests", self._partial_requests)
+            stats.gauge("partial.degraded", self._partial_degraded)
 
     def _field(self, idx, name: str):
         f = idx.field(name)
@@ -928,7 +1278,7 @@ class Executor:
                 partials = [(s, stack[i].copy())
                             for i, s in enumerate(group)
                             if stack[i].any()]
-            if probe is not None:
+            if probe is not None and self._rc_fill_ok(opt):
                 value = [(s, w.copy()) for s, w in partials]
                 rc.put(key, gens, value,
                        sum(w.nbytes for _, w in value) + 32 * len(value))
@@ -1149,7 +1499,7 @@ class Executor:
         child = call.children[0]
         fused_ok = self._fuse_eligible(idx, shards, child)
 
-        def compute_counts(group):
+        def compute_counts_once(group):
             # the whole tree INCLUDING the popcount root as one compiled
             # program (ops.expr) — a single dispatch for the group, with
             # XLA fusing AND+popcount so no intersection stack
@@ -1173,6 +1523,25 @@ class Executor:
             return [int(c) for c in
                     np.asarray(counts, dtype=np.int64)[:len(group)]]
 
+        def compute_counts(group):
+            # device-dispatch resilience (chaos round, narrow to this
+            # fused Count path): a backend RESOURCE_EXHAUSTED evicts
+            # every residency-tracked device cache entry and retries
+            # ONCE — cached stacks rebuild from host state, so the
+            # retry runs against a drained HBM instead of failing the
+            # query on transient allocation pressure
+            try:
+                return compute_counts_once(group)
+            except Exception as e:  # noqa: BLE001 — classify below
+                if "RESOURCE_EXHAUSTED" not in str(e):
+                    raise
+                from pilosa_tpu import devobs as _devobs
+                from pilosa_tpu.runtime import residency as _residency
+
+                _devobs.observer().note_oom_retry()
+                _residency.manager().evict_all()
+                return compute_counts_once(group)
+
         def batch_fn(group):
             # the clustered local-group path: per-shard counts for the
             # shards THIS node owns, cached under their own key so
@@ -1189,7 +1558,7 @@ class Executor:
                     self._rc_mark_hit()
                     return list(val)
             vals = compute_counts(group)
-            if probe is not None:
+            if probe is not None and self._rc_fill_ok(opt):
                 rc.put(key, gens, tuple(vals), 16 * len(vals))
             return vals
 
@@ -1224,7 +1593,7 @@ class Executor:
             total = sum(compute_counts(shards))
             if rec is not None:
                 rec.note_stage("map.fused", _time.perf_counter_ns() - t_f)
-            if probe is not None:
+            if probe is not None and self._rc_fill_ok(opt):
                 rc.put(ckey, cgens, total, 32)
             return total
 
@@ -1413,7 +1782,7 @@ class Executor:
                 return dict(val)
         totals = self._fused_topn_counts_uncached(idx, f, filter_call,
                                                   shards, opt=opt)
-        if probe is not None:
+        if probe is not None and self._rc_fill_ok(opt):
             rc.put(key, gens, dict(totals),
                    resultcache.result_nbytes(totals))
         return totals
@@ -1790,7 +2159,7 @@ class Executor:
             out = out[offset:] if offset < len(out) else out
         if limit is not None:
             out = out[:limit]
-        if probe is not None:
+        if probe is not None and self._rc_fill_ok(opt):
             rc.put(ckey, cgens, self._copy_group_counts(out),
                    resultcache.result_nbytes(out) * 2)
         return out
@@ -2093,6 +2462,11 @@ class Executor:
                     applied.add(n.id)
                     continue
                 try:
+                    if _fi.armed:
+                        # failpoint: the production replica write
+                        # delivery (errors here fail the write like a
+                        # dead owner)
+                        _fi.hit("replica.write")
                     res = self.cluster.transport.query_node(
                         n, idx.name, str(call), [shard]
                     )

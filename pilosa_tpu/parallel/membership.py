@@ -249,6 +249,14 @@ def heartbeat_round(node, k: int = PROBE_FANOUT,
         if not alive:
             alive = indirect_probe(node, target, peers, rng,
                                    timeout=per_dial)
+        # circuit-breaker half-open trials ride the heartbeat: a
+        # successful probe closes the peer's open breaker without
+        # waiting for query traffic to gamble on it (a failed probe of
+        # a CLOSED breaker is deliberately NOT fed — one lost ping
+        # must not open breakers; see Cluster.note_probe)
+        note_probe = getattr(cluster, "note_probe", None)
+        if note_probe is not None:
+            note_probe(target.id, alive)
         change = None
         if not alive and target.state != NODE_DOWN:
             if confirm_down(node, target, timeout=per_dial):

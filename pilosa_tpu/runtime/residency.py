@@ -157,6 +157,27 @@ class ResidencyManager:
                 self.total -= e[2]
                 self._by_kind[e[3]] = self._by_kind.get(e[3], 0) - e[2]
 
+    def evict_all(self) -> int:
+        """Drop EVERY tracked cache entry (device-OOM recovery: the
+        executor's RESOURCE_EXHAUSTED retry path drains all cached
+        device tensors before re-launching).  Owners rebuild from host
+        state on the next touch — eviction loses warmth, never data.
+        Returns the number of entries evicted."""
+        with self._lock:
+            victims = list(self._entries.values())
+            self._entries.clear()
+            self.total = 0
+            self._by_kind.clear()
+            self.evictions += len(victims)
+            # owner-dict pops stay under the lock (the admit() victim
+            # discipline): released, a concurrent admit could insert a
+            # fresh entry for the same key between our snapshot and
+            # pop — we would drop ITS tensor while _entries still
+            # tracks it, permanently skewing the byte accounting
+            for vcache, vkey, _vbytes, _vkind in victims:
+                vcache.pop(vkey, None)
+        return len(victims)
+
     def stats(self) -> dict:
         with self._lock:
             return {"budget": self.budget, "total": self.total,

@@ -283,6 +283,13 @@ class ResultCache:
         must never outlive their leader's attempt."""
         if not self.enabled:
             return False
+        from pilosa_tpu import faultinject as _fi
+
+        if _fi.armed:
+            # failpoint: the production cache-fill path (an injected
+            # error here surfaces to the filling query; waiters'
+            # bounded flight wait covers the unresolved flight)
+            _fi.hit("resultcache.fill")
         nbytes = int(nbytes) + ENTRY_OVERHEAD_BYTES
         if nbytes > self.max_entry_bytes or nbytes > self.budget:
             with self._lock:

@@ -13,6 +13,7 @@ import random
 import threading
 import time
 
+from pilosa_tpu import faultinject as _fi
 from pilosa_tpu.parallel.cluster import (
     Node,
     ShedByPeerError,
@@ -173,6 +174,10 @@ class InternalClient:
                   _hc.CannotSendRequest, BrokenPipeError,
                   ConnectionResetError, ConnectionAbortedError)
         while True:
+            if _fi.armed:
+                # failpoint: the production RPC send path (errors here
+                # surface as TransportError, exactly like a dead wire)
+                _fi.hit("client.request.send")
             remaining = budget_end - time.monotonic()
             if remaining <= 0:
                 # the caller's deadline is spent: stop, never silently
@@ -249,6 +254,11 @@ class InternalClient:
                         resp.status)
                 raise ClientError(resp.status,
                                   detail or f"http {resp.status}")
+            if _fi.armed:
+                # failpoint: the response was read off the wire but is
+                # "lost" before the caller sees it (a mid-response
+                # drop; the request DID execute on the peer)
+                _fi.hit("client.request.recv")
             return raw
 
     @classmethod
@@ -279,7 +289,7 @@ class InternalClient:
     def query_node(self, uri: str, index: str, pql: str,
                    shards: list[int] | None = None, remote: bool = True,
                    nocache: bool = False, nodelta: bool = False,
-                   nocontainers: bool = False):
+                   nocontainers: bool = False, partial: bool = False):
         """POST /index/{i}/query with Remote semantics over the
         protobuf wire — node-to-node RPC speaks protobuf like the
         reference's InternalClient (http/client.go:268 QueryNode;
@@ -301,7 +311,8 @@ class InternalClient:
         path = f"{uri}/index/{index}/query"
         flags = [f for f, on in (("nocache=1", nocache),
                                  ("nodelta=1", nodelta),
-                                 ("nocontainers=1", nocontainers)) if on]
+                                 ("nocontainers=1", nocontainers),
+                                 ("partial=1", partial)) if on]
         if flags:
             path += "?" + "&".join(flags)
         raw = self._request(
@@ -435,11 +446,12 @@ class HTTPTransport(Transport):
 
     def query_node(self, node: Node, index: str, pql: str, shards,
                    nocache: bool = False, nodelta: bool = False,
-                   nocontainers: bool = False):
+                   nocontainers: bool = False, partial: bool = False):
         # the protobuf client already returns decoded result objects
         return self.client.query_node(node.uri, index, pql, shards,
                                       nocache=nocache, nodelta=nodelta,
-                                      nocontainers=nocontainers)
+                                      nocontainers=nocontainers,
+                                      partial=partial)
 
     def send_message(self, node: Node, message: dict) -> dict:
         return self.client.send_message(node.uri, message)

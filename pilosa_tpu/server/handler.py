@@ -35,6 +35,7 @@ from pilosa_tpu.models.field import FieldOptions
 from pilosa_tpu.models.index import IndexOptions
 from pilosa_tpu.models.row import Row
 from pilosa_tpu.parallel.cluster import ShedByPeerError
+from pilosa_tpu.parallel.executor import ShardsUnavailableError
 from pilosa_tpu.parallel.results import GroupCount, Pair, PairField, ValCount
 from pilosa_tpu.serve import admission as _admission
 from pilosa_tpu.serve import deadline as _deadline
@@ -467,6 +468,18 @@ class Handler:
                 if self.admission is not None and ticket is not None:
                     self.admission.count_expired(ticket.klass)
                 self._error(req, 503, str(e))
+            except ShardsUnavailableError as e:
+                # structured replica exhaustion (chaos round): an
+                # availability condition, not a client error — 503
+                # with the shard list and per-replica causes so
+                # operators (and retrying clients) see WHAT is gone
+                # and WHY, not a flat string
+                self._json(req, {
+                    "error": str(e),
+                    "unavailableShards": e.shards,
+                    "causes": {str(s): e.causes.get(s, {})
+                               for s in e.shards},
+                }, 503, headers={"Retry-After": "1"})
             except (ApiError, ValueError, KeyError, TypeError) as e:
                 self._error(req, 400, str(e))
             except ShedByPeerError as e:
@@ -651,6 +664,22 @@ class Handler:
             from pilosa_tpu import observe
 
             observe.take_last()
+        # ?partial=1 (or the X-Pilosa-Partial header): degraded reads —
+        # unavailable shards are accounted in the response
+        # (missingShards/missingFraction) instead of failing the query.
+        # JSON responses only; the protobuf wire has no meta slot, so
+        # protobuf clients keep all-or-error semantics.
+        partial = (params.get("partial") in ("1", "true")
+                   or req.headers.get("X-Pilosa-Partial")
+                   in ("1", "true"))
+        partial_meta: dict | None = \
+            {} if partial and not proto_accept else None
+        # degraded execution is only honored where the response can
+        # CARRY the accounting: JSON responses (partial_meta) and
+        # remote sub-queries (the origin accounts its own failures).
+        # A protobuf origin request keeps all-or-error semantics — an
+        # unannotated undercount would be silently wrong data.
+        partial = partial and (partial_meta is not None or remote)
         try:
             results = self.api.query(
                 path["index"], pql, shards=shards, remote=remote,
@@ -672,6 +701,8 @@ class Handler:
                 # bit-identical either way)
                 containers=params.get("nocontainers")
                 not in ("1", "true"),
+                partial=partial,
+                partial_meta=partial_meta,
             )
         except Exception as e:
             if not proto_accept:
@@ -708,6 +739,14 @@ class Handler:
         resp = {"results": [serialize_result(r) for r in results]}
         if attr_sets is not None:
             resp["columnAttrs"] = attr_sets
+        if partial_meta is not None:
+            # always present on partial requests — [] / 0.0 when the
+            # whole shard set was reachable, so clients can read the
+            # keys unconditionally
+            resp["missingShards"] = partial_meta.get("missingShards",
+                                                     [])
+            resp["missingFraction"] = partial_meta.get(
+                "missingFraction", 0.0)
         if profile:
             from pilosa_tpu import observe
 
@@ -959,24 +998,10 @@ class Handler:
         and this exposition is 0.0.4-shaped, not fully OpenMetrics.)"""
         exemplars = params.get("exemplars") == "1"
         if self.stats is not None and hasattr(self.stats, "prometheus_text"):
-            # refresh the device.*/compile.*/residency.* gauge families
-            # at scrape time so the exposition is never stale
-            # (pilosa_tpu.devobs; push backends get the same families
-            # from the [observe] device-sample-interval loop)
-            from pilosa_tpu import devobs
-            from pilosa_tpu.ingest import compactor
-            from pilosa_tpu.ops import containers as _containers
-            from pilosa_tpu.ops import tape
-            from pilosa_tpu.runtime import resultcache
-
-            try:
-                devobs.observer().publish_gauges(self.stats)
-                resultcache.cache().publish_gauges(self.stats)
-                compactor.compactor().publish_gauges(self.stats)
-                tape.publish_gauges(self.stats)
-                _containers.publish_gauges(self.stats)
-            except Exception:  # noqa: BLE001 — telemetry never fails a scrape
-                pass
+            # refresh every module gauge family at scrape time so the
+            # exposition is never stale (push backends get the same
+            # families from the [observe] device-sample-interval loop)
+            self._publish_all_gauges()
             text = self.stats.prometheus_text(exemplars=exemplars)
         else:
             text = ""
@@ -1327,6 +1352,52 @@ class Handler:
             "totals": totals,
         })
 
+    @route("GET", "/debug/peers")
+    def handle_debug_peers(self, req, params, path, body):
+        """Per-peer failure-handling state (parallel/cluster.py): each
+        peer's circuit-breaker state machine (state, consecutive
+        failures, transition + fast-fail counters), latency EWMA /
+        deviation / sample count (the hedged-read trigger signal), and
+        membership state; plus this node's hedge counters."""
+        ex = self.api.executor
+        with ex._hedge_lock:
+            hedge = {"rpcs": ex._hedge_rpcs, "issued": ex._hedge_issued,
+                     "wins": ex._hedge_wins}
+        self._json(req, {
+            "local": self.api.cluster.local_id,
+            "peers": self.api.cluster.debug_peers(),
+            "hedge": hedge,
+        })
+
+    @route("GET", "/debug/failpoints")
+    def handle_debug_failpoints(self, req, params, path, body):
+        """Failpoint registry state (pilosa_tpu.faultinject): armed
+        points with their specs and call/trigger counters, plus the
+        full compiled-in site inventory."""
+        from pilosa_tpu import faultinject
+
+        self._json(req, faultinject.snapshot())
+
+    @route("POST", "/debug/failpoints")
+    def handle_post_failpoints(self, req, params, path, body):
+        """Arm/disarm failpoints live: ``{"arm": "<spec>"}`` arms
+        (grammar in the faultinject module docstring), ``{"disarm":
+        "<name>"}`` disarms one point, ``{"disarm": true}`` disarms
+        everything.  Returns the post-change registry snapshot — the
+        ops surface ``tools/loadgen.py --chaos`` toggles on a
+        schedule."""
+        from pilosa_tpu import faultinject
+
+        d = json.loads(body or b"{}")
+        if d.get("arm"):
+            faultinject.arm(str(d["arm"]))
+        dis = d.get("disarm")
+        if dis is True or dis == "all":
+            faultinject.disarm()
+        elif isinstance(dis, str) and dis:
+            faultinject.disarm(dis)
+        self._json(req, faultinject.snapshot())
+
     @route("GET", "/debug/admission")
     def handle_debug_admission(self, req, params, path, body):
         """Admission-gate state: per-class caps, in-flight counts,
@@ -1346,22 +1417,36 @@ class Handler:
     def handle_debug_vars(self, req, params, path, body):
         snap = {}
         if self.stats is not None and hasattr(self.stats, "snapshot"):
-            from pilosa_tpu import devobs
-            from pilosa_tpu.ingest import compactor
-            from pilosa_tpu.ops import containers as _containers
-            from pilosa_tpu.ops import tape
-            from pilosa_tpu.runtime import resultcache
-
-            try:
-                devobs.observer().publish_gauges(self.stats)
-                resultcache.cache().publish_gauges(self.stats)
-                compactor.compactor().publish_gauges(self.stats)
-                tape.publish_gauges(self.stats)
-                _containers.publish_gauges(self.stats)
-            except Exception:  # noqa: BLE001
-                pass
+            self._publish_all_gauges()
             snap = self.stats.snapshot()
         self._json(req, snap)
+
+    def _publish_all_gauges(self) -> None:
+        """Push every module gauge family into the stats registry —
+        the ONE list both scrape surfaces (/metrics and /debug/vars)
+        share, so a new family cannot render on one and drift off the
+        other.  Telemetry never fails a scrape."""
+        from pilosa_tpu import devobs
+        from pilosa_tpu import faultinject as _faultinject
+        from pilosa_tpu.ingest import compactor
+        from pilosa_tpu.ops import containers as _containers
+        from pilosa_tpu.ops import tape
+        from pilosa_tpu.runtime import resultcache
+
+        try:
+            devobs.observer().publish_gauges(self.stats)
+            resultcache.cache().publish_gauges(self.stats)
+            compactor.compactor().publish_gauges(self.stats)
+            tape.publish_gauges(self.stats)
+            _containers.publish_gauges(self.stats)
+            # chaos-round families: breakers, hedged reads, failpoints,
+            # partial degradation — zeros on a clean server so the
+            # families are alert-able before the first fault
+            self.api.cluster.publish_breaker_gauges(self.stats)
+            self.api.executor.publish_chaos_gauges(self.stats)
+            _faultinject.publish_gauges(self.stats)
+        except Exception:  # noqa: BLE001
+            pass
 
 
 def _parse_ts(t):

@@ -82,6 +82,13 @@ class Server:
         ingest_compact_interval: float | None = None,
         containers_enabled: bool | None = None,
         containers_threshold: float | None = None,
+        breaker_threshold: int = 5,
+        breaker_cooldown: float = 5.0,
+        hedge_min_samples: int = 8,
+        hedge_deviations: float = 4.0,
+        hedge_min_ms: float = 20.0,
+        hedge_max_fraction: float = 0.1,
+        faultinject_armed: str = "",
     ):
         from pilosa_tpu import logger as _logger
         from pilosa_tpu import stats as _stats
@@ -121,11 +128,27 @@ class Server:
             partition_n=partition_n,
             transport=HTTPTransport(self._client),
             topology_path=os.path.join(data_dir, ".topology"),
+            breaker_threshold=breaker_threshold,
+            breaker_cooldown_s=breaker_cooldown,
         )
         self.node = ClusterNode(self.holder, self.cluster)
         self.node.executor.stats = self.stats
         self.node.executor.logger = self.logger
         self.node.executor.long_query_time = long_query_time
+        # hedged replica reads ([cluster] hedge-* config)
+        self.node.executor.hedge_min_samples = hedge_min_samples
+        self.node.executor.hedge_deviations = hedge_deviations
+        self.node.executor.hedge_min_s = hedge_min_ms / 1e3
+        self.node.executor.hedge_max_fraction = hedge_max_fraction
+        # failpoint registry ([faultinject] armed): armed at
+        # construction, disarmed (process-wide) by close() — the
+        # registry is process-global like the result cache, so only a
+        # server that armed something clears it
+        from pilosa_tpu import faultinject as _faultinject
+
+        self._faultinject_armed = bool(faultinject_armed)
+        if faultinject_armed:
+            _faultinject.arm(faultinject_armed)
         # cross-query micro-batched dispatch ([coalescer] config);
         # "auto" resolves to on-accelerator-only
         from pilosa_tpu.parallel.coalescer import Coalescer
@@ -422,6 +445,14 @@ class Server:
         if self._containers_retained:
             self._containers_retained = False
             _containers.release()
+        if self._faultinject_armed:
+            # config-armed failpoints are process-wide: the arming
+            # server disarms everything on close so library users
+            # sharing the process never inherit injected faults
+            from pilosa_tpu import faultinject as _faultinject
+
+            _faultinject.disarm()
+            self._faultinject_armed = False
         self.handler.close()
         self._client.close()  # drop pooled keep-alive sockets
         self.holder.close()

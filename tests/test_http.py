@@ -261,6 +261,93 @@ class TestHTTPCluster:
         assert st["state"] == "NORMAL"
 
 
+class TestChaosRoutes:
+    """The failure-handling surfaces of the chaos round:
+    /debug/failpoints (arm/disarm live), /debug/peers (breaker +
+    latency state), ?partial=1 / X-Pilosa-Partial on the query route,
+    and the client.request.send failpoint against the REAL
+    InternalClient."""
+
+    def test_failpoints_arm_disarm_roundtrip(self, srv):
+        from pilosa_tpu import faultinject
+
+        snap = _get(srv.uri, "/debug/failpoints")
+        assert not snap["armed"]
+        assert "device.dispatch" in snap["sites"]
+        snap = _post(srv.uri, "/debug/failpoints",
+                     {"arm": "executor.map_shard=delay(1)@2"})
+        assert snap["armed"]
+        assert snap["points"]["executor.map_shard"]["spec"] == \
+            "delay(1)@2"
+        try:
+            snap = _post(srv.uri, "/debug/failpoints", {"disarm": True})
+            assert not snap["armed"]
+        finally:
+            faultinject.disarm()
+
+    def test_failpoint_bad_spec_is_400(self, srv):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(srv.uri, "/debug/failpoints", {"arm": "nope=error"})
+        assert e.value.code == 400
+
+    def test_debug_peers_shape(self, srv):
+        d = _get(srv.uri, "/debug/peers")
+        assert d["local"] == srv.cluster.local_id
+        assert d["peers"] == {}  # single node: no peers
+        assert set(d["hedge"]) == {"rpcs", "issued", "wins"}
+
+    def test_partial_param_and_header_healthy(self, srv):
+        _post(srv.uri, "/index/p")
+        _post(srv.uri, "/index/p/field/f")
+        _post(srv.uri, "/index/p/query", {"query": "Set(1, f=3)"})
+        # default responses carry NO partial keys (byte-compat)
+        r = _post(srv.uri, "/index/p/query",
+                  {"query": "Count(Row(f=3))"})
+        assert "missingShards" not in r and "missingFraction" not in r
+        r = _post(srv.uri, "/index/p/query?partial=1",
+                  {"query": "Count(Row(f=3))"})
+        assert r["results"] == [1]
+        assert r["missingShards"] == [] and r["missingFraction"] == 0.0
+        req = urllib.request.Request(
+            srv.uri + "/index/p/query",
+            data=json.dumps({"query": "Count(Row(f=3))"}).encode(),
+            method="POST")
+        req.add_header("Content-Type", "application/json")
+        req.add_header("X-Pilosa-Partial", "1")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            r = json.loads(resp.read())
+        assert r["missingShards"] == []
+
+    def test_client_send_failpoint_hits_real_transport(self, srv):
+        """The production InternalClient path carries the
+        client.request.send failpoint: armed, a real HTTP RPC raises
+        TransportError without touching the wire."""
+        from pilosa_tpu import faultinject
+        from pilosa_tpu.parallel.cluster import TransportError
+
+        c = InternalClient()
+        assert c.status(srv.uri)["state"] == "NORMAL"
+        faultinject.arm("client.request.send=error(transport)*1")
+        try:
+            with pytest.raises(TransportError, match="injected"):
+                c.status(srv.uri)
+            assert c.status(srv.uri)["state"] == "NORMAL"  # *1 spent
+        finally:
+            faultinject.disarm()
+
+    def test_chaos_metric_families_render(self, srv):
+        """breaker_/hedge_/failpoint_/partial_ render on a clean
+        server's /metrics (zeros) and survive the strict parser —
+        covered generically by test_metrics_device_families_present,
+        pinned here by name so a publisher regression is explicit."""
+        text = _get(srv.uri, "/metrics", expect_json=False).decode()
+        for name in ("breaker_tracked", "breaker_open", "hedge_rpcs",
+                     "hedge_issued", "hedge_wins", "failpoint_armed",
+                     "failpoint_triggers", "partial_requests",
+                     "partial_degraded"):
+            assert f"\n{name}" in text or text.startswith(name), name
+
+
 class TestRouteParityAdditions:
     """Routes mirroring the reference's remaining public surface:
     /internal/nodes, /recalculate-caches, /internal/translate/keys,

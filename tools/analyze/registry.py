@@ -88,6 +88,16 @@ CLASS_LOCKS: dict[tuple, ClassLockRule] = {
         # (a duplicate compile is wasted work, never a wrong entry —
         # see the inline comment at its definition)
     ),
+    ("parallel/cluster.py", "CircuitBreaker"): ClassLockRule(
+        lock="_lock",
+        attrs=frozenset({"_state", "_failures", "_opened_t",
+                         "_probing", "_probe_t"}),
+        # the cumulative transition counters (opened/closed/
+        # half_opens/fast_fails) are deliberately UNREGISTERED:
+        # monotone ints read lock-free by the gauge publisher (the
+        # _gen discipline — a stale read is a stale gauge, never a
+        # wrong transition)
+    ),
 }
 
 #: Guarded attributes checked on NON-self receivers anywhere in the
@@ -143,6 +153,17 @@ MODULE_LOCKS: dict[str, tuple] = {
     "ingest/__init__.py": (
         ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
         ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+    ),
+    "faultinject.py": (
+        # the failpoint registry: every read AND write of the armed
+        # point table goes through the module lock (hit() is only
+        # reached when something is armed, so the lock is off the
+        # disarmed hot path by construction — the `armed` bool gate)
+        ModuleGlobalRule("_points", "_lock", "rw"),
+        # the fast gate itself: rebinds only under the lock; sites
+        # read it lock-free by design (a stale read skips or probes
+        # one injection window, never corrupts the registry)
+        ModuleGlobalRule("armed", "_lock", "w"),
     ),
 }
 
@@ -272,6 +293,12 @@ CONFIG_GUARDS = (
         pair=("release",),
         owner_suffixes=("ops/containers.py",),
         what="the refcounted [containers] baseline",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("faultinject.arm", "_faultinject.arm"),
+        pair=("disarm",),
+        owner_suffixes=("faultinject.py",),
+        what="the process-wide failpoint registry",
     ),
 )
 

@@ -78,6 +78,8 @@ class _Stats:
         self.ingest_bits = 0
         #: sparsity-mix view: bucket name -> completed-read latencies
         self.bucket_latencies: dict[str, list[float]] = {}
+        #: per-bucket outcome counts (the --chaos fault/clear split)
+        self.bucket_outcomes: dict[str, dict[str, int]] = {}
 
     def note(self, outcome: str, latency_s: float,
              retry_after: bool, klass: str = "query",
@@ -86,6 +88,13 @@ class _Stats:
             self.sent += 1
             if retry_after:
                 self.retry_after_seen += 1
+            if bucket is not None:
+                oc = self.bucket_outcomes.setdefault(
+                    bucket, {"ok": 0, "shed": 0, "expired": 0,
+                             "error": 0})
+                oc["ok" if outcome == "ok"
+                   else outcome if outcome in ("shed", "expired")
+                   else "error"] += 1
             if outcome == "ok":
                 self.ok += 1
                 self.ok_latencies.append(latency_s)
@@ -162,9 +171,11 @@ def _fire(req, timeout: float, stats: _Stats, klass: str = "query",
             outcome = "expired" if b"expired" in body else "shed"
         else:
             outcome = "error"
-        stats.note(outcome, time.perf_counter() - t0, retry_after, klass)
+        stats.note(outcome, time.perf_counter() - t0, retry_after, klass,
+                   bucket=bucket)
     except Exception:
-        stats.note("error", time.perf_counter() - t0, False, klass)
+        stats.note("error", time.perf_counter() - t0, False, klass,
+                   bucket=bucket)
 
 
 def _cache_counters(host: str) -> tuple[int, int] | None:
@@ -255,6 +266,69 @@ def parse_sparsity_mix(spec: str) -> dict[str, int]:
     return out
 
 
+class _ChaosDriver:
+    """Arms/disarms failpoints on a schedule mid-run (the ``--chaos``
+    mode): a background thread POSTs the spec to every target host's
+    ``/debug/failpoints`` for ``duty * period`` seconds of each
+    ``period``, then disarms for the remainder.  Requests are labeled
+    ``fault``/``clear`` by their FIRE time, so the report separates
+    goodput/error-rate/p99 during fault windows from the windows
+    between them — the number that shows degradation is graceful, not
+    just survivable."""
+
+    def __init__(self, hosts: list[str], spec: str,
+                 period_s: float = 2.0, duty: float = 0.5):
+        self.hosts = hosts
+        self.spec = spec
+        self.period_s = max(0.2, period_s)
+        self.duty = min(max(duty, 0.05), 0.95)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._fault_now = False
+        self.windows = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _post(self, body: dict) -> None:
+        for host in self.hosts:
+            try:
+                req = urllib.request.Request(
+                    f"{host}/debug/failpoints",
+                    data=json.dumps(body).encode(), method="POST")
+                req.add_header("Content-Type", "application/json")
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    resp.read()
+            except Exception:
+                pass  # a dead host IS the chaos; keep driving
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._post({"arm": self.spec})
+            with self._lock:
+                self._fault_now = True
+                self.windows += 1
+            if self._stop.wait(self.period_s * self.duty):
+                break
+            self._post({"disarm": True})
+            with self._lock:
+                self._fault_now = False
+            if self._stop.wait(self.period_s * (1.0 - self.duty)):
+                break
+        self._post({"disarm": True})
+        with self._lock:
+            self._fault_now = False
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def label(self) -> str:
+        with self._lock:
+            return "fault" if self._fault_now else "clear"
+
+
 def run_load(host: str, index: str, qps: float, seconds: float,
              query: str = "Count(Row(f=1))",
              mix: dict[str, float] | None = None,
@@ -265,7 +339,8 @@ def run_load(host: str, index: str, qps: float, seconds: float,
              shape_mix: int = 0, shape_field: str | None = None,
              shape_rows: int = 6,
              sparsity_mix: dict[str, int] | None = None,
-             sparsity_field: str = "f") -> dict:
+             sparsity_field: str = "f",
+             chaos: "_ChaosDriver | None" = None) -> dict:
     """Drive ``host`` open-loop at ``qps`` for ``seconds``; returns the
     report dict.  ``mix`` maps class -> weight; ``deadline_s`` is a
     (lo, hi) uniform range for the per-request deadline header (None =
@@ -338,10 +413,17 @@ def run_load(host: str, index: str, qps: float, seconds: float,
             elif delay < -0.05:
                 with late_lock:
                     late[0] += 1
+            if chaos is not None and bucket is None:
+                # label by FIRE time: is a fault window armed right now
+                bucket = chaos.label()
             _fire(req, timeout, stats, klass, bits, bucket)
 
     cache0 = _cache_counters(host)
     disp0 = _vars_counter(host, "coalescer.dispatches")
+    hedge0 = _vars_counter(host, "hedge.issued")
+    hrpcs0 = _vars_counter(host, "hedge.rpcs")
+    if chaos is not None:
+        chaos.start()
     workers = [threading.Thread(target=worker, daemon=True)
                for _ in range(pool)]
     for w in workers:
@@ -366,8 +448,12 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     for w in workers:
         w.join(seconds + n * timeout)
     elapsed = time.perf_counter() - start
+    if chaos is not None:
+        chaos.stop()
     cache1 = _cache_counters(host)
     disp1 = _vars_counter(host, "coalescer.dispatches")
+    hedge1 = _vars_counter(host, "hedge.issued")
+    hrpcs1 = _vars_counter(host, "hedge.rpcs")
     hit_rate = None
     if cache0 is not None and cache1 is not None:
         dh = cache1[0] - cache0[0]
@@ -416,6 +502,35 @@ def run_load(host: str, index: str, qps: float, seconds: float,
             # never dispatched at all -> None, not fake-perfect 0.0
             round((disp1 - (disp0 or 0.0)) / len(rlat), 4)
             if disp1 is not None and rlat else None),
+        # chaos view (--chaos): goodput / error rate / p99 during
+        # fault windows vs between them, the fault-window count, and
+        # the server's hedge rate over the run — graceful degradation
+        # as numbers, not vibes
+        "chaos": (None if chaos is None else {
+            "spec": chaos.spec,
+            "windows": chaos.windows,
+            "hedge_issued": (None if hedge1 is None
+                             else hedge1 - (hedge0 or 0.0)),
+            "hedge_rate": (
+                round((hedge1 - (hedge0 or 0.0))
+                      / max(1.0, hrpcs1 - (hrpcs0 or 0.0)), 4)
+                if hedge1 is not None and hrpcs1 is not None
+                else None),
+            **{
+                label: {
+                    **stats.bucket_outcomes.get(
+                        label, {"ok": 0, "shed": 0, "expired": 0,
+                                "error": 0}),
+                    "p50_ms": round(_percentile(sorted(
+                        stats.bucket_latencies.get(label, [])),
+                        0.50) * 1e3, 2),
+                    "p99_ms": round(_percentile(sorted(
+                        stats.bucket_latencies.get(label, [])),
+                        0.99) * 1e3, 2),
+                }
+                for label in ("fault", "clear")
+            },
+        }),
         # sparsity-mix view: per-bucket read latency percentiles
         "sparsity": (None if buckets is None else {
             name: {
@@ -477,6 +592,20 @@ def main(argv: list[str] | None = None) -> int:
                         "per-bucket p50/p99")
     p.add_argument("--sparsity-field", default="f",
                    help="field the sparsity-mix rows live in")
+    p.add_argument("--chaos", default=None,
+                   help="failpoint spec armed/disarmed on a schedule "
+                        "mid-run via POST /debug/failpoints (e.g. "
+                        "'client.request.send=error(transport)@3'); "
+                        "the report splits goodput/error-rate/p99 "
+                        "into fault vs clear windows and adds the "
+                        "server's hedge rate")
+    p.add_argument("--chaos-period", type=float, default=2.0,
+                   help="seconds per arm+disarm cycle")
+    p.add_argument("--chaos-duty", type=float, default=0.5,
+                   help="fraction of each cycle the spec stays armed")
+    p.add_argument("--chaos-hosts", default=None,
+                   help="comma-separated extra hosts to arm (default: "
+                        "--host only)")
     p.add_argument("--timeout", type=float, default=10.0)
     args = p.parse_args(argv)
     mix = {}
@@ -487,8 +616,18 @@ def main(argv: list[str] | None = None) -> int:
     if args.deadline_ms:
         lo, _, hi = args.deadline_ms.partition(",")
         deadline_s = (float(lo) / 1e3, float(hi or lo) / 1e3)
+    chaos = None
+    if args.chaos:
+        hosts = [args.host.rstrip("/")]
+        if args.chaos_hosts:
+            hosts += [h.rstrip("/")
+                      for h in args.chaos_hosts.split(",") if h]
+        chaos = _ChaosDriver(hosts, args.chaos,
+                             period_s=args.chaos_period,
+                             duty=args.chaos_duty)
     report = run_load(args.host.rstrip("/"), args.index, args.qps,
                       args.seconds, query=args.query, mix=mix,
+                      chaos=chaos,
                       deadline_s=deadline_s, timeout=args.timeout,
                       ingest_field=args.ingest_field,
                       ingest_bits=args.ingest_bits,
