@@ -1322,6 +1322,47 @@ def _last_chip_capture():
     return None
 
 
+def bench_mesh() -> dict | None:
+    """Mesh-native execution A/B (parallel/meshexec.py): the batch32
+    coalesced-path workload on a 4-device CPU mesh, shard_map program
+    vs the identical single-device program, every sampled batch
+    host-verified.  Runs in a SUBPROCESS with its own virtual 4-device
+    CPU backend — the device count is fixed at backend init, and this
+    process's backend is whatever the chip probe chose — via
+    tools/multichip.py, so the bench capture and the MULTICHIP_r*
+    capture share one measurement path.  The pin: mesh qps >= the
+    single-device path on the same workload (no-regression floor)."""
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # the child sets its own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.multichip", "--devices", "4",
+             "--skip-dryrun", "--seconds", "2.0"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+    except Exception as e:  # noqa: BLE001 — bench keeps going
+        print(f"bench: mesh A/B skipped: {e}", file=sys.stderr)
+        return None
+    if out.returncode != 0:
+        print(f"bench: mesh A/B failed rc={out.returncode}: "
+              f"{out.stderr[-400:]!r}", file=sys.stderr)
+        return None
+    try:
+        body = json.loads(out.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        print(f"bench: mesh A/B unparseable: {e}", file=sys.stderr)
+        return None
+    m = body["mesh"]
+    m["pin_no_regression_ok"] = (
+        m["scaling_vs_single"] is not None
+        and m["scaling_vs_single"] >= 1.0)
+    return m
+
+
 def bench_faultinject() -> dict:
     """Disarmed-failpoint A/B (the chaos round's <1% budget, same
     discipline as extras.observe/devobs): the per-site disarmed cost
@@ -1394,6 +1435,9 @@ def main():
     if ctn is not None:
         extras["containers"] = ctn
     extras["faultinject"] = bench_faultinject()
+    msh = bench_mesh()
+    if msh is not None:
+        extras["mesh"] = msh
     bytes_per_query = a.nbytes + b.nbytes  # streamed once per query
     achieved_gbps = dev_qps * bytes_per_query / 1e9
     peak = _peak_gbps(platform)

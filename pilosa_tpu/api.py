@@ -95,7 +95,8 @@ class API:
               column_attrs: bool = False, exclude_row_attrs: bool = False,
               exclude_columns: bool = False, coalesce: bool = True,
               cache: bool = True, delta: bool = True,
-              containers: bool = True, partial: bool = False,
+              containers: bool = True, mesh: bool = True,
+              partial: bool = False,
               partial_meta: dict | None = None):
         """Execute PQL -> list of results (api.go:135 API.Query).
 
@@ -181,6 +182,7 @@ class API:
             cache=cache,
             delta=delta,
             containers=containers,
+            mesh=mesh,
             deadline=dl,
             partial=partial,
             missing=set() if partial else None,

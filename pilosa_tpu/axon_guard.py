@@ -214,9 +214,16 @@ def guard_dead_relay(wait_s: float = 0.0) -> bool:
         # process") — a failed check (None) must never demote a live
         # accelerator to CPU.
         if _axon_registered() and _relay_alive() is False:
-            print("axon_guard: axon plugin registered but relay process "
-                  "is dead; deregistering it so backend init cannot hang",
-                  file=sys.stderr)
+            # ROUTINE housekeeping on this box, not an anomaly: logged
+            # at INFO (silent unless logging is configured) instead of
+            # printed, so harness stderr tails — the multichip
+            # capture's `tail` field — carry real signal only (the
+            # notice polluted MULTICHIP_r05.json's tail)
+            import logging
+
+            logging.getLogger("pilosa_tpu.axon_guard").info(
+                "axon plugin registered but relay process is dead; "
+                "deregistering it so backend init cannot hang")
             scrub_axon_backend()
             # The site hook's register() also PINS jax_platforms config
             # to "axon,cpu" (config beats the env var), so honor the

@@ -91,6 +91,14 @@ def main(argv: list[str] | None = None) -> int:
                          "compressed execution ([containers] "
                          "threshold); rows denser than this stay on "
                          "the dense path")
+    ps.add_argument("--no-mesh", action="store_true",
+                    help="disable mesh-native SPMD execution ([mesh] "
+                         "enabled=false): fused dispatches run the "
+                         "pre-mesh single-device programs and operand "
+                         "stacks place on one device")
+    ps.add_argument("--mesh-axis-size", type=int,
+                    help="local devices joined to the mesh shard axis "
+                         "([mesh] axis-size); 0 = all local devices")
     ps.add_argument("--no-ingest-delta", action="store_true",
                     help="disable streaming-ingest delta planes "
                          "([ingest] delta-enabled=false): every write "
@@ -225,6 +233,10 @@ def cmd_server(args) -> int:
         cfg.containers.enabled = False
     if args.containers_threshold is not None:
         cfg.containers.threshold = args.containers_threshold
+    if args.no_mesh:
+        cfg.mesh.enabled = "false"
+    if args.mesh_axis_size is not None:
+        cfg.mesh.axis_size = args.mesh_axis_size
     for key in ("breaker_threshold", "breaker_cooldown",
                 "hedge_max_fraction"):
         v = getattr(args, key, None)
@@ -330,6 +342,8 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         ingest_delta_enabled=cfg.ingest.delta_enabled,
         containers_enabled=cfg.containers.enabled,
         containers_threshold=cfg.containers.threshold,
+        mesh_enabled=cfg.mesh.enabled,
+        mesh_axis_size=cfg.mesh.axis_size,
         ingest_delta_budget_bytes=cfg.ingest.delta_budget_bytes,
         ingest_compact_threshold_bits=cfg.ingest.compact_threshold_bits,
         ingest_compact_interval=cfg.ingest.compact_interval,

@@ -233,6 +233,27 @@ class ContainersConfig:
 
 
 @dataclass
+class MeshConfig:
+    """[mesh] — mesh-native SPMD execution of the fused serving path
+    (parallel/meshexec.py; no reference analog — Pilosa's only
+    scale-out is host map-reduce over shards, executor.go:2455).
+    With ``enabled`` on, fused-operand stacks lay out across a named
+    device mesh via NamedSharding and the fused / ragged-tape /
+    container-gather programs run under shard_map with collective
+    reductions on the shard axis, so ONE launch evaluates a query (or
+    a coalesced megabatch) across every local chip.  ``enabled`` is
+    tri-state like the coalescer's: ``"auto"`` activates exactly when
+    it can help (more than one local device, single process, not host
+    mode).  ``axis-size`` bounds how many local devices join the
+    shard axis (0 = all of them).  Per-request escape: ``?nomesh=1``
+    on the query route — the pre-mesh single-device programs, results
+    byte-identical."""
+
+    enabled: str = "auto"  # auto | true | false
+    axis_size: int = 0  # local devices on the shard axis; 0 = all
+
+
+@dataclass
 class AdmissionConfig:
     """[admission] — priority-classed admission control + load
     shedding on the serving path (serve/admission.py; no reference
@@ -289,6 +310,7 @@ class Config:
     ingest: IngestConfig = field(default_factory=IngestConfig)
     containers: ContainersConfig = field(
         default_factory=ContainersConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
     faultinject: FaultinjectConfig = field(
         default_factory=FaultinjectConfig)
 
@@ -328,7 +350,8 @@ class Config:
             if key in ("cluster", "anti_entropy", "metric", "tracing",
                        "profile", "tls", "coalescer", "ragged",
                        "observe", "admission", "cache", "ingest",
-                       "containers", "faultinject") and isinstance(v, dict):
+                       "containers", "mesh",
+                       "faultinject") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -348,6 +371,7 @@ class Config:
                                                         CacheConfig,
                                                         IngestConfig,
                                                         ContainersConfig,
+                                                        MeshConfig,
                                                         FaultinjectConfig)):
                 setattr(self, key, v)
 
@@ -358,7 +382,7 @@ class Config:
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
                           "profile", "tls", "coalescer", "ragged",
                           "observe", "admission", "cache", "ingest",
-                          "containers", "faultinject"):
+                          "containers", "mesh", "faultinject"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -461,6 +485,10 @@ class Config:
             "[containers]",
             f"enabled = {str(self.containers.enabled).lower()}",
             f"threshold = {self.containers.threshold}",
+            "",
+            "[mesh]",
+            f'enabled = "{self.mesh.enabled}"',
+            f"axis-size = {self.mesh.axis_size}",
             "",
             "[faultinject]",
             f'armed = "{self.faultinject.armed}"',

@@ -93,6 +93,11 @@ FAMILIES: tuple[Family, ...] = (
            "(ops/containers.py)",
            live_prefixes=("container_",), group="container",
            doc="architecture.md"),
+    Family("mesh", "mesh_",
+           "mesh-native SPMD execution of the fused serving path "
+           "(parallel/meshexec.py)",
+           live_prefixes=("mesh_",), group="mesh",
+           doc="architecture.md"),
     Family("coalescer", "coalescer_",
            "cross-query batching window (parallel/coalescer.py); the "
            "shape_* heterogeneity counters are pinned on live "

@@ -67,19 +67,50 @@ def _frag_base_gen(fr):
 
 
 def _padded_rows(n: int) -> int:
-    """Pad the shard axis to the device count so stacks shard evenly
-    over the mesh; padding rows are zero (no bits)."""
+    """Pad the shard axis so stacks shard evenly over the mesh in
+    force; padding rows are zero (no bits).  Single-process placement
+    follows the [mesh] config (parallel/meshexec.py: the axis size,
+    which is every local device by default and 1 — no padding — when
+    the mesh is disabled); multi-process placement pads to the
+    node-local device count for parallel/spmd.py's per-node stacks."""
     import jax
 
-    n_dev = len(jax.devices())
-    if n_dev <= 1:
+    if jax.process_count() > 1:
+        n_dev = len(jax.local_devices())
+        if n_dev <= 1:
+            return n
+        return ((n + n_dev - 1) // n_dev) * n_dev
+    from pilosa_tpu.parallel import meshexec
+
+    a = meshexec.pad_axis()
+    if a <= 1:
         return n
-    return ((n + n_dev - 1) // n_dev) * n_dev
+    return ((n + a - 1) // a) * a
 
 def _live(dev) -> bool:
     from pilosa_tpu.runtime import residency
 
     return residency.live(dev)
+
+
+def _placement_token():
+    """The [mesh] placement flavor in force (parallel/meshexec.py),
+    joined into every device-stack cache's invalidation tuple: a mesh
+    toggle or axis resize must MISS and re-place — a stack laid out
+    for the previous shard plan would otherwise keep serving under
+    fresh config."""
+    from pilosa_tpu.parallel import meshexec
+
+    return meshexec.placement_token()
+
+
+def _placement_devices() -> int:
+    """How many devices the active placement spreads a stack over —
+    the residency manager's per-device accounting (devobs/residency
+    follow the shard plan)."""
+    from pilosa_tpu.parallel import meshexec
+
+    return meshexec.axis_size()
 
 
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
@@ -394,7 +425,8 @@ class Field:
         # pending delta must NOT invalidate this stack — the executor
         # fuses it on top (device_delta_stacks + expr "dfuse")
         frags = [None if view is None else view.fragment(s) for s in shards]
-        gens = tuple(_frag_base_gen(fr) for fr in frags)
+        gens = (_placement_token(),) + tuple(
+            _frag_base_gen(fr) for fr in frags)
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if hit is not None and hit[0] == gens and _live(hit[1]):
@@ -427,14 +459,16 @@ class Field:
 
     @staticmethod
     def _place_on_devices(stack: np.ndarray):
-        """Place a host array on device — sharded along axis 0 over the
-        mesh when more than one chip is visible, so XLA partitions the
-        set algebra + reductions across chips with ICI collectives
-        (SURVEY.md §7 step 4: the executor's shard batch IS the mesh's
-        data axis).  On a single CPU device the stack stays a host
-        numpy array: every bm op dispatches host arrays to numpy + the
-        native popcount kernels (ops/hostkernels.py), which beat
-        XLA:CPU codegen ~8x at query shapes."""
+        """Place a host array on device — sharded along axis 0 over
+        the [mesh] shard plan (parallel/meshexec.py) when the mesh is
+        active, so device assignment follows the same contiguous-block
+        split the shard_map programs execute; a plain (uncommitted)
+        single-device put when the mesh is disabled or only one chip
+        is visible — the exact pre-mesh placement.  On a single CPU
+        device the stack stays a host numpy array: every bm op
+        dispatches host arrays to numpy + the native popcount kernels
+        (ops/hostkernels.py), which beat XLA:CPU codegen ~8x at query
+        shapes."""
         import jax
 
         from pilosa_tpu.ops import bitmap as bm
@@ -459,14 +493,9 @@ class Field:
                 return pmesh.shard_stack(pmesh.local_device_mesh(), stack)
             return bm.chunked_device_put(stack, local[0],
                                          label="field.stack")
-        if len(jax.devices()) > 1:
-            from pilosa_tpu import devobs
-            from pilosa_tpu.parallel import mesh as pmesh
+        from pilosa_tpu.parallel import meshexec
 
-            devobs.note_transfer(stack.nbytes, len(jax.devices()),
-                                 "field.shard_stack")
-            return pmesh.shard_stack(pmesh.device_mesh(), stack)
-        return bm.chunked_device_put(stack, label="field.stack")
+        return meshexec.place_stack(stack, label="field.stack")
 
     def device_time_row_stack(self, row_id: int, shards: tuple[int, ...],
                               view_names: tuple[str, ...]):
@@ -481,7 +510,7 @@ class Field:
 
         key = ("time", row_id, shards, view_names)
         frag_grid = []
-        gens = []
+        gens = [_placement_token()]
         views = [self.view(vn) for vn in view_names]
         for s in shards:
             frags = [None if v is None else v.fragment(s) for v in views]
@@ -544,7 +573,7 @@ class Field:
             return dev  # uncacheable; never evict the warm cache for it
         self._evict_and_insert(
             self._row_stack_cache, key, (gens, dev), entry_bytes,
-            max_entries=64)
+            max_entries=64, devices=_placement_devices())
         return dev
 
     def device_delta_stacks(self, row_id: int, shards: tuple[int, ...]):
@@ -568,10 +597,11 @@ class Field:
         view = self.view(VIEW_STANDARD)
         frags = [None if view is None else view.fragment(s)
                  for s in shards]
-        toks = tuple(0 if fr is None
-                     else (fr._uid, fr._delta_row_seq(row_id))
-                     for fr in frags)
-        if not any(t and t[1] for t in toks):
+        toks = (_placement_token(),) + tuple(
+            0 if fr is None
+            else (fr._uid, fr._delta_row_seq(row_id))
+            for fr in frags)
+        if not any(t and t[1] for t in toks[1:]):
             return None
         key = ("delta", row_id, shards)
         with self._lock:
@@ -603,7 +633,8 @@ class Field:
         if entry_bytes <= self._entry_cap(self.ROW_STACK_CACHE_BYTES):
             self._evict_and_insert(self._row_stack_cache, key,
                                    (toks, pair), entry_bytes,
-                                   max_entries=64)
+                                   max_entries=64,
+                                   devices=_placement_devices())
         return pair
 
     def device_container_leaf(self, row_id: int, shards: tuple[int, ...]):
@@ -628,7 +659,7 @@ class Field:
         # froze each fragment's sparse-vs-hot verdict, so a runtime
         # [containers] threshold change must miss and re-evaluate —
         # not wait for the next base mutation
-        gens = (ct.config().threshold,
+        gens = (ct.config().threshold, _placement_token(),
                 *(_frag_base_gen(fr) for fr in frags))
         key = ("cont", row_id, shards)
         with self._lock:
@@ -683,9 +714,12 @@ class Field:
     @staticmethod
     def _place_pool(pool: np.ndarray):
         """Place a container word pool: host numpy in host mode, one
-        local-device upload otherwise.  Deliberately NOT mesh-sharded
-        like the dense stacks — pools are gather operands whose row
-        count tracks data, not the shard axis."""
+        local-device upload otherwise.  Deliberately NOT sharded on
+        the pool's row axis — pools are gather operands whose rows are
+        addressed by indices that cross shard boundaries, so under an
+        active mesh the pool REPLICATES onto every mesh device and the
+        gather DOMAIN axis shards instead (ops/expr
+        _build_mesh_gather_program)."""
         import jax
 
         from pilosa_tpu.ops import bitmap as bm
@@ -695,6 +729,11 @@ class Field:
         if jax.process_count() > 1:
             return bm.chunked_device_put(pool, jax.local_devices()[0],
                                          label="field.containers")
+        from pilosa_tpu.parallel import meshexec
+
+        if meshexec.active():
+            return meshexec.place_replicated(pool,
+                                             label="field.containers")
         return bm.chunked_device_put(pool, label="field.containers")
 
     def flush_deltas(self, shards=None) -> int:
@@ -711,7 +750,8 @@ class Field:
         return merged
 
     def _evict_and_insert(self, cache: dict, key, entry, entry_bytes: int,
-                          max_entries: int, kind: str = "dense") -> None:
+                          max_entries: int, kind: str = "dense",
+                          devices: int = 1) -> None:
         """Insert under the entry cap; BYTE budgeting is global — the
         process-wide residency manager sees every owner's device caches
         and LRU-evicts across all of them, so the true device total is
@@ -735,7 +775,8 @@ class Field:
                 cache.pop(k, None)
                 mgr.forget(cache, k)
             cache[key] = entry
-            mgr.admit(cache, key, entry_bytes, kind=kind)
+            mgr.admit(cache, key, entry_bytes, kind=kind,
+                      devices=devices)
 
     #: device-memory budget for concatenated matrix stacks (bytes)
     MATRIX_STACK_CACHE_BYTES = 512 << 20
@@ -774,6 +815,10 @@ class Field:
                 gens.append(_frag_gen(frag))
             if len(ids):
                 parts.append((i, ids, mat))
+        # placement token APPENDED (not prepended): consumers index
+        # gens positionally by shard slot (_fused_topn_counts_uncached
+        # reads gens[pos] to validate per-fragment cache warms)
+        gens.append(_placement_token())
         gens = tuple(gens)
         with self._lock:
             hit = self._matrix_stack_cache.get(key)
@@ -799,7 +844,7 @@ class Field:
             return entry  # uncacheable; don't evict the warm cache for it
         self._evict_and_insert(
             self._matrix_stack_cache, key, entry, entry_bytes,
-            max_entries=8)
+            max_entries=8, devices=_placement_devices())
         return entry
 
     def time_view_times(self) -> list:
@@ -852,7 +897,8 @@ class Field:
         view = self.view(self.bsi_view_name)
         key = ("planes", shards, depth)
         frags = [None if view is None else view.fragment(s) for s in shards]
-        gens = tuple(_frag_gen(fr) for fr in frags)
+        gens = (_placement_token(),) + tuple(
+            _frag_gen(fr) for fr in frags)
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if hit is not None and hit[0] == gens and _live(hit[1]):

@@ -348,7 +348,12 @@ class Plan:
                 domains.append(_domain(self.shape, keysets))
             bounds = np.cumsum([0] + [len(d) for d in domains])
             total = int(bounds[-1])
-            pad = _pow2(total) if total else 0
+            # pow2 padding rounded to a mesh-axis multiple so the
+            # domain shards evenly under the mesh gather program
+            # (parallel/meshexec.py; identical pow2 when no mesh)
+            from pilosa_tpu.parallel import meshexec
+
+            pad = meshexec.pad_domain(total) if total else 0
             idxs = [_leaf_indices(leaf, domains, pad)
                     for leaf in self.leaves]
             hit = (domains, bounds, total, idxs)
@@ -366,9 +371,11 @@ class Plan:
         self._staged = hit
         return self._staged
 
-    def _gathered(self, counts: bool) -> Any:
+    def _gathered(self, counts: bool, mesh=None) -> Any:
         """ONE launch over the pooled operands; None when the root
-        domain is empty everywhere (zero device work)."""
+        domain is empty everywhere (zero device work).  ``mesh``
+        routes the shard_map gather program (domain axis sharded,
+        pools replicated — parallel/meshexec.py)."""
         from pilosa_tpu.ops import expr
         from pilosa_tpu.ops import pallas_kernels as pk
 
@@ -382,22 +389,26 @@ class Plan:
             bm.note_dispatch("fused_gather")
             return None
         pools = [leaf.pool for leaf in self.leaves]
-        if (counts and self.shape == ("and", ("leaf", 0), ("leaf", 1))
+        if (counts and mesh is None
+                and self.shape == ("and", ("leaf", 0), ("leaf", 1))
                 and pk.on_tpu() and not isinstance(pools[0], np.ndarray)):
             # the north-star pair: the Pallas directory-walk kernel
             # intersects+counts co-present containers in one pass
+            # (single-device; the mesh route splits the domain walk
+            # across chips through the shard_map gather instead)
             return pk.gathered_count_and(pools[0], idxs[0],
                                          pools[1], idxs[1])
         return expr.evaluate_gathered(self.shape, tuple(pools),
-                                      tuple(idxs), counts=counts)
+                                      tuple(idxs), counts=counts,
+                                      mesh=mesh)
 
     # ----------------------------------------------------------- execution
 
-    def counts(self) -> list[int]:
+    def counts(self, mesh=None) -> list[int]:
         """Per-shard popcounts of the tree, aligned with ``shards`` —
         the Count root folded into the same launch."""
         bump("container.queries")
-        out = self._gathered(counts=True)
+        out = self._gathered(counts=True, mesh=mesh)
         _domains, bounds, total, _idxs = self._staged  # set by _gathered
         if out is None:
             return [0] * len(self.shards)
@@ -405,11 +416,11 @@ class Plan:
         return [int(cts[bounds[i]:bounds[i + 1]].sum())
                 for i in range(len(self.shards))]
 
-    def row_words(self) -> list[tuple[int, np.ndarray]]:
+    def row_words(self, mesh=None) -> list[tuple[int, np.ndarray]]:
         """Non-empty per-shard result words, scattered back to the
         dense row layout the Row reduce consumes."""
         bump("container.queries")
-        out = self._gathered(counts=False)
+        out = self._gathered(counts=False, mesh=mesh)
         if out is None:
             return []
         domains, bounds, total, _idxs = self._staged

@@ -201,6 +201,113 @@ def _build_gather_program(shape: tuple, counts: bool) -> Callable[..., Any]:
     return _devobs.instrument(name, jax.jit(run))
 
 
+def _build_mesh_program(meshkey: tuple, counts: bool) -> Callable[..., Any]:
+    """The mesh-native variant of ``_build_program``: the same tree
+    body runs per-device on shard-axis blocks under ``shard_map``
+    (parallel/meshexec.py), so ONE launch evaluates the query across
+    every mesh device.  A Count root popcounts its local shards and
+    returns the full per-shard vector through a tiled
+    ``lax.all_gather`` on the shard axis — the collective replacement
+    for the host-side per-shard gather, keeping the output
+    bit-identical to the single-device program (int32 per-shard
+    counts; callers still sum in Python ints).  A bitmap root stays
+    sharded in place (out_specs on the shard axis) — set algebra is
+    embarrassingly shard-parallel and the host assembles segments
+    from the sharded result.  ``meshkey`` is ``(shape, n_leaves,
+    ndim, mesh)``: the in_specs tuple length and the shard-axis
+    position are static per program."""
+    shape, n_leaves, ndim, mesh = meshkey
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pilosa_tpu.parallel import meshexec
+    from pilosa_tpu.parallel.mesh import shard_map
+
+    ev = _build_jnp(shape)
+    leaf_spec = meshexec.shard_spec(ndim, ndim - 2)
+    if counts:
+        from jax.sharding import PartitionSpec as P
+
+        out_spec = P()  # replicated full per-shard counts (all_gather)
+    else:
+        out_spec = leaf_spec
+
+    def body(*blks: Any) -> Any:
+        out = ev(blks)
+        if counts:
+            local = jnp.sum(lax.population_count(out),
+                            axis=-1, dtype=jnp.int32)
+            return lax.all_gather(local, meshexec.SHARD_AXIS,
+                                  axis=ndim - 2, tiled=True)
+        return out
+
+    sm = shard_map(body, mesh=mesh, in_specs=(leaf_spec,) * n_leaves,
+                   out_specs=out_spec, check_rep=False)
+
+    def run(*leaves: Any) -> Any:
+        return sm(*leaves)
+
+    from pilosa_tpu import devobs as _devobs
+
+    name = "expr.mesh_counts" if counts else "expr.mesh"
+    return _devobs.instrument(name, jax.jit(run))
+
+
+def _build_mesh_gather_program(meshkey: tuple,
+                               counts: bool) -> Callable[..., Any]:
+    """Mesh variant of ``_build_gather_program``: container word POOLS
+    replicate across the mesh (gather indices address arbitrary pool
+    rows — ops/containers.py's domain algebra crosses shard
+    boundaries by construction) while the gather DOMAIN axis shards,
+    so each device gathers and evaluates its block of the query's
+    container domain.  Count roots all_gather the per-container
+    popcounts back (replicated, same int32 vector as the
+    single-device program); bitmap roots stay domain-sharded.
+    Argument convention matches ``_build_gather_program``:
+    ``run(*pools, *idxs)``."""
+    shape, n_leaves, mesh = meshkey
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from pilosa_tpu.parallel import meshexec
+    from pilosa_tpu.parallel.mesh import shard_map
+
+    ev = _build_jnp(shape)
+    pool_spec = P(None, None)
+    idx_spec = P(meshexec.SHARD_AXIS)
+    out_spec = P() if counts else P(meshexec.SHARD_AXIS, None)
+
+    def body(*args: Any) -> Any:
+        n = len(args) // 2
+        pools, idxs = args[:n], args[n:]
+        leaves = tuple(jnp.take(p, ix, axis=0, mode="clip")
+                       for p, ix in zip(pools, idxs))
+        out = ev(leaves)
+        if counts:
+            local = jnp.sum(lax.population_count(out),
+                            axis=-1, dtype=jnp.int32)
+            return lax.all_gather(local, meshexec.SHARD_AXIS,
+                                  axis=0, tiled=True)
+        return out
+
+    sm = shard_map(body, mesh=mesh,
+                   in_specs=(pool_spec,) * n_leaves
+                   + (idx_spec,) * n_leaves,
+                   out_specs=out_spec, check_rep=False)
+
+    def run(*args: Any) -> Any:
+        return sm(*args)
+
+    from pilosa_tpu import devobs as _devobs
+
+    name = ("expr.mesh_gather_counts" if counts
+            else "expr.mesh_gather")
+    return _devobs.instrument(name, jax.jit(run))
+
+
 def _make_compiled(maxsize: int,
                    build: Callable[[tuple, bool],
                                    Callable[..., Any]] | None = None) -> Any:
@@ -267,6 +374,14 @@ _compiled = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE)
 #: variants of one shape are two entries — sized accordingly
 _compiled_gather = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE,
                                   build=_build_gather_program)
+#: mesh-program caches (parallel/meshexec.py): keyed on the composite
+#: (shape, n_leaves, ndim, mesh) — the Mesh is a cached singleton, so
+#: one config's programs stay warm across queries and an axis resize
+#: simply addresses fresh entries
+_compiled_mesh = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE,
+                                build=_build_mesh_program)
+_compiled_mesh_gather = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE,
+                                       build=_build_mesh_gather_program)
 _eviction_warned: bool = False
 
 
@@ -276,7 +391,9 @@ def program_evictions() -> int:
     ``_make_compiled``), so concurrent same-shape builds and failed
     builds never inflate it."""
     return (_compiled.cache_evictions()
-            + _compiled_gather.cache_evictions())
+            + _compiled_gather.cache_evictions()
+            + _compiled_mesh.cache_evictions()
+            + _compiled_mesh_gather.cache_evictions())
 
 
 def set_program_cache_size(maxsize: int) -> None:
@@ -284,9 +401,14 @@ def set_program_cache_size(maxsize: int) -> None:
     forcing 512 distinct shapes to exercise eviction would dominate a
     test run with tracing)."""
     global _compiled, _compiled_gather, _eviction_warned
+    global _compiled_mesh, _compiled_mesh_gather
     _compiled = _make_compiled(maxsize)
     _compiled_gather = _make_compiled(maxsize,
                                       build=_build_gather_program)
+    _compiled_mesh = _make_compiled(maxsize,
+                                    build=_build_mesh_program)
+    _compiled_mesh_gather = _make_compiled(
+        maxsize, build=_build_mesh_gather_program)
     _eviction_warned = False
 
 
@@ -356,12 +478,22 @@ def _host_counts(shape: tuple, leaves: tuple) -> np.ndarray:
 # -------------------------------------------------------------- frontend
 
 
-def evaluate(shape: tuple, leaves: tuple, counts: bool = False) -> Any:
+def evaluate(shape: tuple, leaves: tuple, counts: bool = False,
+             mesh: Any = None, mesh_queries: int | None = None) -> Any:
     """Evaluate one compiled tree over its leaf stacks in ONE launch.
 
     ``leaves`` — tuple of uint32 stacks, all the same shape ([S, W], or
     [B, S, W] for a coalesced cross-query batch).  Returns the result
     bitmap stack, or int32 per-row counts with ``counts=True``.
+
+    ``mesh`` — an active device mesh (meshexec.query_mesh) routes the
+    shard_map program: the same tree body per device over shard-axis
+    blocks, one launch across every mesh chip, results bit-identical.
+    None (the default, and the ?nomesh=1 escape) runs the exact
+    single-device program.  ``mesh_queries`` — how many LIVE queries
+    this launch serves for the mesh.queries counter (the coalescer
+    passes its live occupancy; a [B, S, W] batch otherwise counts its
+    batch rows, which include pow2 padding).
     """
     _validate(shape, len(leaves))
     if shape[0] == "leaf" and not counts:
@@ -371,13 +503,36 @@ def evaluate(shape: tuple, leaves: tuple, counts: bool = False) -> Any:
         if counts:
             return _host_counts(shape, leaves)
         return _host_tree(shape, leaves)
+    ndim = leaves[0].ndim
+    if mesh is not None:
+        from pilosa_tpu.parallel import meshexec
+
+        if meshexec.shardable(mesh, leaves[0].shape[ndim - 2]):
+            # jit refuses committed inputs on foreign device sets, so
+            # every leaf commits to the program's sharding here — a
+            # no-op when placement already matches (the warm path)
+            placed = tuple(meshexec.ensure_placed(lv, mesh, ndim - 2)
+                           for lv in leaves)
+            fn = _compiled_mesh((shape, len(leaves), ndim, mesh),
+                                counts)
+            _note_program_cache_pressure()
+            meshexec.note_launch(
+                mesh_queries if mesh_queries is not None
+                else (leaves[0].shape[0] if ndim == 3 else 1))
+            # dispatch under the process-wide mesh launch lock:
+            # concurrent collective dispatches from different threads
+            # can interleave per-device enqueues and deadlock the
+            # backend (meshexec.launch_lock); execution pipelines —
+            # the lock covers the enqueue, not the compute
+            with meshexec.launch_lock():
+                return fn(*placed)
     fn = _compiled(shape, counts)
     _note_program_cache_pressure()
     return fn(*leaves)
 
 
 def evaluate_gathered(shape: tuple, pools: tuple, idxs: tuple,
-                      counts: bool = False) -> Any:
+                      counts: bool = False, mesh: Any = None) -> Any:
     """Evaluate one compiled tree over POOLED container operands in
     ONE launch (the compressed-fragment read path, ops/containers.py).
 
@@ -388,7 +543,12 @@ def evaluate_gathered(shape: tuple, pools: tuple, idxs: tuple,
     row).  The caller pads D and each P_i to powers of two
     (``containers._pow2``) so the jit re-specializations stay O(log).
     Returns the uint32[D, CWORDS] result blocks, or int32[D]
-    per-container popcounts with ``counts=True``."""
+    per-container popcounts with ``counts=True``.
+
+    ``mesh`` (meshexec.query_mesh) shards the DOMAIN axis across the
+    mesh and replicates the pools — one launch gathers and evaluates
+    every device's domain block; None keeps the single-device gather
+    program."""
     _validate(shape, len(pools))
     bm.note_dispatch("fused_gather")
     if bm._host(*pools):
@@ -398,6 +558,20 @@ def evaluate_gathered(shape: tuple, pools: tuple, idxs: tuple,
         return _host_tree(shape, leaves)
     import jax.numpy as jnp
 
+    if mesh is not None:
+        from pilosa_tpu.parallel import meshexec
+
+        if meshexec.shardable(mesh, len(idxs[0])):
+            placed_pools = tuple(meshexec.ensure_replicated(p, mesh)
+                                 for p in pools)
+            placed_idxs = tuple(meshexec.ensure_placed(
+                jnp.asarray(ix), mesh, 0) for ix in idxs)
+            fn = _compiled_mesh_gather((shape, len(pools), mesh),
+                                       counts)
+            _note_program_cache_pressure()
+            meshexec.note_launch()
+            with meshexec.launch_lock():  # see evaluate's mesh route
+                return fn(*placed_pools, *placed_idxs)
     fn = _compiled_gather(shape, counts)
     _note_program_cache_pressure()
     return fn(*pools, *(jnp.asarray(ix) for ix in idxs))

@@ -241,19 +241,13 @@ def _abs_operand(ref: int, n_slots: int) -> int:
     return ref if ref >= 0 else n_slots + ~ref
 
 
-_programs: dict[bool, Callable[..., Any]] = {}
+_programs: dict = {}
 
 
-def _program(counts: bool) -> Callable[..., Any]:
-    """The ONE vmapped scan/switch interpreter per root kind, jitted —
-    jax re-lowers it per (batch, tape_len, slots, stack) input shape,
-    which is exactly the bucket structure; the Python closure is
-    shared.  devobs-instrumented so first lowerings surface on
-    /debug/devices and ride the paying query's flight record."""
-    prog = _programs.get(counts)
-    if prog is not None:
-        return prog
-    import jax
+def _one_query(counts: bool) -> Callable[..., Any]:
+    """The per-query scan/switch interpreter body, shared verbatim by
+    the single-device program and the shard_map mesh variant — the
+    two routes cannot drift because they trace the same closure."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -288,11 +282,74 @@ def _program(counts: bool) -> Callable[..., Any]:
                            dtype=jnp.int32)
         return res
 
+    return one
+
+
+def _program(counts: bool) -> Callable[..., Any]:
+    """The ONE vmapped scan/switch interpreter per root kind, jitted —
+    jax re-lowers it per (batch, tape_len, slots, stack) input shape,
+    which is exactly the bucket structure; the Python closure is
+    shared.  devobs-instrumented so first lowerings surface on
+    /debug/devices and ride the paying query's flight record."""
+    prog = _programs.get(counts)
+    if prog is not None:
+        return prog
+    import jax
+
     from pilosa_tpu import devobs
 
+    one = _one_query(counts)
     name = "tape.interpret_counts" if counts else "tape.interpret"
     prog = devobs.instrument(name, jax.jit(jax.vmap(one)))
     _programs[counts] = prog
+    return prog
+
+
+def _mesh_program(counts: bool, mesh: Any) -> Callable[..., Any]:
+    """The mesh-native interpreter (parallel/meshexec.py): the SAME
+    vmapped scan/switch body runs per device on shard-axis blocks of
+    the batched register file under ``shard_map`` — tapes replicate
+    (they are tiny int32 control words), leaf stacks shard on the
+    shard axis (dim 2 of the [B, slots, S, W] batch), and a Count
+    root all_gathers the per-shard popcounts back so the output is
+    bit-identical to the single-device interpreter.  One launch then
+    executes the whole heterogeneous megabatch across every mesh
+    chip.  Cached per (root kind, mesh) — the Mesh is a meshexec
+    singleton."""
+    key = (counts, mesh)
+    prog = _programs.get(key)
+    if prog is not None:
+        return prog
+    import jax
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from pilosa_tpu import devobs
+    from pilosa_tpu.parallel import meshexec
+    from pilosa_tpu.parallel.mesh import shard_map
+
+    one = _one_query(counts)
+    leaf_spec = P(None, None, meshexec.SHARD_AXIS, None)
+
+    def body(tapes_blk: Any, leaves_blk: Any) -> Any:
+        out = jax.vmap(one)(tapes_blk, leaves_blk)
+        if counts:
+            return lax.all_gather(out, meshexec.SHARD_AXIS,
+                                  axis=1, tiled=True)
+        return out
+
+    sm = shard_map(body, mesh=mesh, in_specs=(P(), leaf_spec),
+                   out_specs=(P() if counts
+                              else P(None, meshexec.SHARD_AXIS, None)),
+                   check_rep=False)
+
+    def run(tapes: Any, leaves: Any) -> Any:
+        return sm(tapes, leaves)
+
+    name = ("tape.mesh_interpret_counts" if counts
+            else "tape.mesh_interpret")
+    prog = devobs.instrument(name, jax.jit(run))
+    _programs[key] = prog
     return prog
 
 
@@ -329,7 +386,7 @@ def _host_exec(tp: Tape, leaves: tuple, counts: bool) -> np.ndarray:
 
 def execute(batch: Sequence[tuple[Tape, tuple]], counts: bool = False,
             tape_len: int | None = None,
-            slots: int | None = None) -> list[Any]:
+            slots: int | None = None, mesh: Any = None) -> list[Any]:
     """Execute a batch of (Tape, leaves) pairs in ONE launch.
 
     Every query's leaf stacks must share one array shape (the
@@ -338,6 +395,11 @@ def execute(batch: Sequence[tuple[Tape, tuple]], counts: bool = False,
     size class).  Returns one result per query, in order — the bitmap
     stack, or int32 per-row popcounts with ``counts=True``.  Pad rows
     (batch pow2, slot and tape padding) are never returned.
+
+    ``mesh`` (meshexec.query_mesh) routes the shard_map interpreter:
+    the batch's register file shards on the stack's shard axis and
+    the one launch spans every mesh device, bit-identically.  None
+    (and host mode) keeps the existing engines.
     """
     if not batch:
         return []
@@ -388,6 +450,22 @@ def execute(batch: Sequence[tuple[Tape, tuple]], counts: bool = False,
     leaves_arr = jnp.stack(leaf_rows)
     with _lock:
         _lowered.add((counts, b_pad, tape_len, slots) + stack_shape)
+    if mesh is not None:
+        from pilosa_tpu.parallel import meshexec
+
+        if len(stack_shape) >= 2 and meshexec.shardable(
+                mesh, stack_shape[0]):
+            meshexec.note_launch(n)
+            tapes_dev = meshexec.ensure_replicated(
+                jnp.asarray(tape_rows), mesh)
+            leaves_dev = meshexec.ensure_placed(leaves_arr, mesh, 2)
+            # dispatch under the process-wide mesh launch lock (see
+            # meshexec.launch_lock: concurrent collective dispatches
+            # can deadlock the backend)
+            with meshexec.launch_lock():
+                out = _mesh_program(counts, mesh)(tapes_dev,
+                                                  leaves_dev)
+            return [out[i] for i in range(n)]
     out = _program(counts)(jnp.asarray(tape_rows), leaves_arr)
     return [out[i] for i in range(n)]
 
@@ -395,9 +473,25 @@ def execute(batch: Sequence[tuple[Tape, tuple]], counts: bool = False,
 # --------------------------------------------------------------- prewarm
 
 
+def _prewarm_worthwhile() -> bool:
+    """Whether lowering interpreter programs ahead of traffic pays on
+    THIS process's devices.  Host mode runs the numpy engine (nothing
+    to lower); CPU backends — one device or a virtual multi-device
+    test mesh alike — lower these programs cheaply on first use while
+    the warm-up's register file (batch x (slots + tape) x stack
+    words) would transiently cost real host memory.  Accelerator
+    backends pay multi-hundred-ms serving-path compiles, which is
+    what prewarm exists to move off the first window."""
+    import jax
+
+    if bm.host_mode():
+        return False
+    return jax.devices()[0].platform != "cpu"
+
+
 def prewarm(stack_shape: tuple[int, ...], max_batch: int,
             max_tape: int, max_leaves: int,
-            counts: bool = True) -> int:
+            counts: bool = True, mesh: Any = None) -> int:
     """Lower the bucket programs a serving process will hit first.
     Flushes pad the BATCH axis to pow2(occupancy), so a window
     sealing at 5 queries dispatches a b=8 program — warming only the
@@ -406,18 +500,31 @@ def prewarm(stack_shape: tuple[int, ...], max_batch: int,
     exists to kill).  So: the smallest size class (where shallow-tree
     traffic lands) warms across the whole pow2 batch ladder
     2..pow2(max_batch), and the largest class (the configured caps,
-    the worst single compile) warms at full width.  Called from
-    server open on a background thread; best-effort, and a no-op on
-    CPU backends — host mode runs the numpy engine (nothing to
-    lower), and a multi-CPU-device process lowers cheaply on first
-    use while the warm-up's register file (batch x (slots + tape) x
-    stack words) would transiently cost real host memory.  Returns
-    the number of programs warmed."""
+    the worst single compile) warms at full width.
+
+    The programs warmed are keyed on the ACTUAL device layout:
+    ``mesh`` (the caller's meshexec.active_mesh(), threaded from
+    server open) selects the shard_map interpreter variants, and its
+    absence the single-device ones — so a 1-device process never
+    lowers mesh-shaped programs and an N-device mesh never wastes its
+    warm-up on programs serving traffic won't run.  ``stack_shape``
+    must carry the same device-count-derived padding serving stacks
+    get (models/field._padded_rows).  Called from server open on a
+    background thread; best-effort, and a no-op where lowering is
+    cheap (``_prewarm_worthwhile``).  Returns the number of programs
+    warmed."""
     import jax
 
-    if bm.host_mode() or jax.devices()[0].platform == "cpu":
+    if not _prewarm_worthwhile():
         return 0
     import jax.numpy as jnp
+
+    use_mesh = mesh is not None and len(stack_shape) >= 2
+    if use_mesh:
+        from pilosa_tpu.parallel import meshexec
+
+        if not meshexec.shardable(mesh, stack_shape[0]):
+            use_mesh = False
 
     b_full = max(2, _pow2(max_batch))
     small = size_class(1, 1)
@@ -435,7 +542,20 @@ def prewarm(stack_shape: tuple[int, ...], max_batch: int,
         tape_rows[:, :, 0] = OP_COPY
         leaves = jnp.zeros((b, slots) + tuple(stack_shape),
                            dtype=jnp.uint32)
-        out = _program(counts)(jnp.asarray(tape_rows), leaves)
+        if use_mesh:
+            from pilosa_tpu.parallel import meshexec
+
+            tapes_dev = meshexec.ensure_replicated(
+                jnp.asarray(tape_rows), mesh)
+            leaves_dev = meshexec.ensure_placed(leaves, mesh, 2)
+            # the every-mesh-dispatch rule applies to warm-up too: a
+            # prewarm thread racing a serving thread's collective
+            # launch is the same enqueue-interleave deadlock
+            with meshexec.launch_lock():
+                out = _mesh_program(counts, mesh)(tapes_dev,
+                                                  leaves_dev)
+        else:
+            out = _program(counts)(jnp.asarray(tape_rows), leaves)
         jax.block_until_ready(out)
         with _lock:
             _lowered.add((counts, b, tape_len, slots)

@@ -228,7 +228,8 @@ class Transport:
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
-                   nocontainers: bool = False, partial: bool = False):
+                   nocontainers: bool = False, nomesh: bool = False,
+                   partial: bool = False):
         """Execute pql on the remote node restricted to `shards` with
         remote semantics (no re-translation).  Returns the result list.
         Raises TransportError if the node is unreachable.  ``nocache``
@@ -237,7 +238,9 @@ class Transport:
         ``nodelta`` forwards ?nodelta=1 the same way (peers compact
         their pending ingest deltas and answer from pure base);
         ``nocontainers`` forwards ?nocontainers=1 (peers route their
-        fused reads through the dense pre-container path); ``partial``
+        fused reads through the dense pre-container path); ``nomesh``
+        forwards ?nomesh=1 (peers run their fused dispatches on the
+        pre-mesh single-device programs); ``partial``
         forwards ?partial=1 (degraded-read semantics ride sub-queries
         like the other per-request escapes)."""
         raise NotImplementedError
@@ -305,7 +308,8 @@ class LocalTransport(Transport):
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
-                   nocontainers: bool = False, partial: bool = False):
+                   nocontainers: bool = False, nomesh: bool = False,
+                   partial: bool = False):
         from pilosa_tpu.parallel.executor import ExecOptions
 
         if node.id in self.down or node.id not in self.handles:
@@ -317,7 +321,7 @@ class LocalTransport(Transport):
             opt=ExecOptions(
                 remote=True, shards=None if shards is None else list(shards),
                 cache=not nocache, delta=not nodelta,
-                containers=not nocontainers,
+                containers=not nocontainers, mesh=not nomesh,
                 partial=partial, missing=set() if partial else None,
             ),
         )
@@ -347,7 +351,8 @@ class BoundTransport(Transport):
 
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
-                   nocontainers: bool = False, partial: bool = False):
+                   nocontainers: bool = False, nomesh: bool = False,
+                   partial: bool = False):
         self.parent._check_partition(self.src, node.id)
         extra = {}
         if nocache:
@@ -356,6 +361,8 @@ class BoundTransport(Transport):
             extra["nodelta"] = True
         if nocontainers:
             extra["nocontainers"] = True
+        if nomesh:
+            extra["nomesh"] = True
         if partial:
             extra["partial"] = True
         if extra:

@@ -108,16 +108,21 @@ class _Bucket:
 
 class _Entry:
     """One staged query waiting in a bucket.  ``tape`` is None on the
-    per-shape fallback path (ragged off / oversize / Shift)."""
+    per-shape fallback path (ragged off / oversize / Shift); ``mesh``
+    is the device mesh this query's launch must run under (None = the
+    pre-mesh single-device programs — ?nomesh=1 / [mesh] off).  The
+    bucket key carries the mesh identity, so queries on different
+    placement flavors never share a launch."""
 
-    __slots__ = ("shape", "leaves", "tape", "fut", "deadline")
+    __slots__ = ("shape", "leaves", "tape", "fut", "deadline", "mesh")
 
-    def __init__(self, shape, leaves, tape, fut, deadline):
+    def __init__(self, shape, leaves, tape, fut, deadline, mesh=None):
         self.shape = shape
         self.leaves = leaves
         self.tape = tape
         self.fut = fut
         self.deadline = deadline
+        self.mesh = mesh
 
 
 class Coalescer:
@@ -186,23 +191,26 @@ class Coalescer:
             _tape.bump(reason)
         return tp
 
-    def _bucket_key(self, idx, shape, shards, leaves):
+    def _bucket_key(self, idx, shape, shards, leaves, mesh=None):
         """(key, tape) for one staged query.  Ragged: tape compiles
         within the caps -> key on the size class + leaf stack shape,
         so heterogeneous trees of similar size meet in one bucket
         (distinct indexes included — the launch is index-agnostic;
         each waiter folds its own result).  Fallback: the exact
-        per-shape key, the pre-ragged behavior."""
+        per-shape key, the pre-ragged behavior.  The mesh identity
+        joins both keys: a ?nomesh=1 query must not share a launch
+        with mesh-routed batchmates (different compiled programs)."""
         if self.ragged:
             tp = self._tape_for(shape, len(leaves))
             if tp is not None:
                 tb, lb = _tape.size_class(len(tp.instrs), len(leaves))
-                return ("ragged", tuple(leaves[0].shape), tb, lb), tp
-        return (idx.name, shape, shards), None
+                return (("ragged", tuple(leaves[0].shape), tb, lb,
+                         mesh), tp)
+        return (idx.name, shape, shards, mesh), None
 
     def count(self, executor, idx, child, shards: tuple[int, ...],
               deadline=None, cache_fill=None,
-              use_delta: bool = True) -> int:
+              use_delta: bool = True, mesh=None) -> int:
         """One Count(tree) query through the batching window -> total.
         Staging runs on the CALLER's thread (fragment locks, and a
         staging error belongs to this query alone).
@@ -226,8 +234,10 @@ class Coalescer:
         one only when the programs are identical anyway."""
         shape, leaves = executor._fused_expr(idx, child, shards,
                                              use_delta=use_delta)
-        key, tp = self._bucket_key(idx, shape, shards, leaves)
-        entry = _Entry(shape, leaves, tp, Future(), deadline)
+        key, tp = self._bucket_key(idx, shape, shards, leaves,
+                                   mesh=mesh)
+        entry = _Entry(shape, leaves, tp, Future(), deadline,
+                       mesh=mesh)
         t0 = time.perf_counter_ns()
         with self._lock:
             bucket = self._pending.get(key)
@@ -345,7 +355,8 @@ class Coalescer:
                     # the un-coalesced path would run
                     results = [expr.evaluate(live[0].shape,
                                              live[0].leaves,
-                                             counts=True)]
+                                             counts=True,
+                                             mesh=live[0].mesh)]
                 elif bucket.shapes_final == 1:
                     # same-shape fast path: the specialized fused
                     # program over stacked operands, exactly the
@@ -372,7 +383,12 @@ class Coalescer:
                         stacked = tuple(_pad_batch(s, pad)
                                         for s in stacked)
                     counts = np.asarray(
-                        expr.evaluate(shape, stacked, counts=True),
+                        expr.evaluate(shape, stacked, counts=True,
+                                      mesh=live[0].mesh,
+                                      # live occupancy, not the pow2-
+                                      # padded batch rows, feeds the
+                                      # mesh.queries counter
+                                      mesh_queries=n),
                         dtype=np.int64)
                     results = [counts[b] for b in range(n)]
                 else:
@@ -388,7 +404,8 @@ class Coalescer:
                         max(it.tape.n_leaves for it in live))
                     results = _tape.execute(
                         [(it.tape, it.leaves) for it in live],
-                        counts=True, tape_len=tb, slots=lb)
+                        counts=True, tape_len=tb, slots=lb,
+                        mesh=live[0].mesh)
                 bucket.launch_ns = time.perf_counter_ns() - t_launch
                 self.stats.timing("coalescer.launch_ns",
                                   bucket.launch_ns)

@@ -94,6 +94,12 @@ class ExecOptions:
     # ?nocoalesce/?nocache/?nodelta): fused reads route the exact
     # dense pre-container path; results are bit-identical either way
     containers: bool = True
+    # per-request opt-out of mesh-native SPMD execution (the HTTP
+    # layer's ?nomesh=1 — symmetric with the other escapes): fused
+    # dispatches run the exact pre-mesh single-device programs
+    # (parallel/meshexec.py stays out of the launch); results are
+    # byte-identical either way
+    mesh: bool = True
     # end-to-end deadline (serve/deadline.Deadline), propagated from
     # the X-Pilosa-Deadline header; checked at translate, before each
     # per-shard map, and before reduce so expired work never reaches
@@ -285,6 +291,14 @@ class Executor:
                 opt.missing = set()
             with self._hedge_lock:
                 self._partial_requests += 1
+        if not opt.mesh:
+            # ONE fallback tick per executed ?nomesh=1 request — the
+            # fused paths consult _query_mesh at several call sites
+            # (staging + per-group batch fns), which must not each
+            # count
+            from pilosa_tpu.parallel import meshexec as _meshexec
+
+            _meshexec.note_fallback()
         rec = None
         if self.recorder is not None and self.recorder.enabled:
             # str() on a parsed Query re-serializes the AST — only pay
@@ -557,6 +571,10 @@ class Executor:
                 # forward ?nocontainers=1: peers route their own
                 # fused reads through the dense pre-container path
                 extra["nocontainers"] = True
+            if opt is not None and not opt.mesh:
+                # forward ?nomesh=1: peers run their own fused
+                # dispatches on the pre-mesh single-device programs
+                extra["nomesh"] = True
             if opt is not None and opt.partial:
                 # forward ?partial=1: degraded-read semantics ride
                 # sub-queries like the other per-request escapes
@@ -1047,16 +1065,29 @@ class Executor:
         raise ExecutionError(f"unsupported fused call: {name}")
 
     def _fused_eval(self, idx, call: Call, shards: tuple[int, ...],
-                    use_delta: bool = True):
+                    use_delta: bool = True, mesh=None):
         """Evaluate a supported tree -> uint32 [n_shards, words] device
         stack, as ONE compiled program over the leaf stacks (ops.expr) —
         tree depth no longer multiplies the launch count, the dominant
         win when device dispatch has real latency (TPU behind an RPC
-        boundary; the 20 us dispatch floor of VERDICT round 5)."""
+        boundary; the 20 us dispatch floor of VERDICT round 5).
+
+        ``mesh`` (``_query_mesh``) routes the shard_map program so the
+        one launch spans every mesh device; None is the pre-mesh
+        single-device program (?nomesh=1 / [mesh] disabled)."""
         from pilosa_tpu.ops import expr
 
         shape, leaves = self._fused_expr(idx, call, shards, use_delta)
-        return expr.evaluate(shape, leaves)
+        return expr.evaluate(shape, leaves, mesh=mesh)
+
+    @staticmethod
+    def _query_mesh(opt: ExecOptions | None):
+        """The device mesh this request's fused dispatches run under:
+        the active [mesh] layout, or None for ?nomesh=1 (counted as a
+        mesh fallback) and whenever the mesh cannot activate."""
+        from pilosa_tpu.parallel import meshexec
+
+        return meshexec.query_mesh(opt is None or opt.mesh)
 
     # ------------------------------------------- result cache (read paths)
 
@@ -1266,15 +1297,17 @@ class Executor:
             # per-shard words here
             from pilosa_tpu.ops import containers as _containers
 
+            m = self._query_mesh(opt)
             cplan = _containers.plan_fused(self, idx, call, g, opt,
                                            counts=False)
             if cplan is not None:
-                partials = cplan.row_words()
+                partials = cplan.row_words(mesh=m)
             else:
                 # copies: a view would pin the whole stack in memory
                 # for as long as one sparse segment lives
                 stack = np.asarray(self._fused_eval(idx, call, g,
-                                                    use_delta=opt.delta))
+                                                    use_delta=opt.delta,
+                                                    mesh=m))
                 partials = [(s, stack[i].copy())
                             for i, s in enumerate(group)
                             if stack[i].any()]
@@ -1513,13 +1546,14 @@ class Executor:
             from pilosa_tpu.ops import containers as _containers
             from pilosa_tpu.ops import expr
 
+            m = self._query_mesh(opt)
             cplan = _containers.plan_fused(self, idx, child,
                                            tuple(group), opt)
             if cplan is not None:
-                return cplan.counts()
+                return cplan.counts(mesh=m)
             shape, leaves = self._fused_expr(idx, child, tuple(group),
                                              use_delta=opt.delta)
-            counts = expr.evaluate(shape, leaves, counts=True)
+            counts = expr.evaluate(shape, leaves, counts=True, mesh=m)
             return [int(c) for c in
                     np.asarray(counts, dtype=np.int64)[:len(group)]]
 
@@ -1588,7 +1622,8 @@ class Executor:
                                             tuple(shards),
                                             deadline=opt.deadline,
                                             cache_fill=probe,
-                                            use_delta=opt.delta)
+                                            use_delta=opt.delta,
+                                            mesh=self._query_mesh(opt))
             t_f = _time.perf_counter_ns()
             total = sum(compute_counts(shards))
             if rec is not None:
@@ -1824,7 +1859,8 @@ class Executor:
         if filter_call is not None:
             filt = self._fused_eval(
                 idx, filter_call, shards,
-                use_delta=opt is None or opt.delta)
+                use_delta=opt is None or opt.delta,
+                mesh=self._query_mesh(opt))
             counts = bm.row_counts_gathered(mat_dev, filt, pos_dev)
         else:
             counts = bm.row_counts(mat_dev)
@@ -2024,7 +2060,8 @@ class Executor:
                 shard_pos = {s: i for i, s in enumerate(group)}
                 filt_stack = self._fused_eval(idx, filter_call,
                                               tuple(group),
-                                              use_delta=opt.delta)
+                                              use_delta=opt.delta,
+                                              mesh=self._query_mesh(opt))
 
         def map_fn(shard):
             import jax.numpy as jnp
@@ -2234,11 +2271,13 @@ class Executor:
         if call.name == "Sum":
             def batch_fn(group):
                 return [self._fused_sum(idx, f, call, tuple(group),
-                                        use_delta=opt.delta)]
+                                        use_delta=opt.delta,
+                                        mesh=self._query_mesh(opt))]
         else:
             def batch_fn(group):
                 return [self._fused_extreme(idx, f, call, tuple(group),
-                                            use_delta=opt.delta)]
+                                            use_delta=opt.delta,
+                                            mesh=self._query_mesh(opt))]
 
         if fused_ok and not self._cluster_active(opt):
             _deadline.check(opt.deadline, "map")
@@ -2279,7 +2318,7 @@ class Executor:
         return out
 
     def _fused_sum(self, idx, f, call: Call, shards: tuple[int, ...],
-                   use_delta: bool = True) -> ValCount:
+                   use_delta: bool = True, mesh=None) -> ValCount:
         """Sum over all shards in one stacked dispatch: plane counts from
         the [S, planes, W] BSI stack, exact assembly in Python ints
         (reference fragment.sum per shard, fragment.go:1111; here the
@@ -2290,7 +2329,7 @@ class Executor:
         consider = P[:, bsi_ops.EXISTS_PLANE]
         if call.children:
             filt = self._fused_eval(idx, call.children[0], shards,
-                                    use_delta=use_delta)
+                                    use_delta=use_delta, mesh=mesh)
             # the filter stack is padded to the same device multiple
             consider = consider & filt
         pos, neg, count = bsi_ops.plane_counts_stacked(P, consider)
@@ -2303,7 +2342,7 @@ class Executor:
 
     def _fused_extreme(self, idx, f, call: Call,
                        shards: tuple[int, ...],
-                       use_delta: bool = True) -> ValCount:
+                       use_delta: bool = True, mesh=None) -> ValCount:
         """Min/Max over all shards from one stacked dispatch: the
         vmapped extreme scans produce every per-shard candidate; the
         host applies the sign-branching of fragment.min/max
@@ -2314,7 +2353,8 @@ class Executor:
         consider = P[:, bsi_ops.EXISTS_PLANE]
         if call.children:
             consider = consider & self._fused_eval(
-                idx, call.children[0], shards, use_delta=use_delta)
+                idx, call.children[0], shards, use_delta=use_delta,
+                mesh=mesh)
         is_min = call.name == "Min"
         want = "min" if is_min else "max"
         (signed_cnt, all_cnt, primary_taken, fallback_taken,

@@ -78,10 +78,14 @@ class ResidencyManager:
         # caps only relax for deliberately-sized deployments
         self.operator_sized = budget_bytes is not None or _operator_sized()
         self._lock = threading.Lock()
-        # (owner dict id, key) -> (owner dict, key, nbytes, kind); dict
-        # preserves insertion order = LRU order (move-to-end on touch)
-        self._entries: dict[tuple, tuple[dict, object, int, str]] = {}
+        # (owner dict id, key) -> (owner dict, key, nbytes, kind,
+        # devices); dict preserves insertion order = LRU order
+        # (move-to-end on touch)
+        self._entries: dict[tuple, tuple] = {}
         self.total = 0
+        # sum of per-entry ceil(nbytes / devices): what the most-loaded
+        # single device holds when entries shard over the [mesh] plan
+        self._per_device = 0
         # bytes by representation kind ("dense" tensors vs the
         # roaring-on-TPU "compressed" container pools) — the
         # /debug/devices compressed-vs-dense split, and the number
@@ -99,7 +103,7 @@ class ResidencyManager:
         return (id(cache), key)
 
     def admit(self, cache: dict, key, nbytes: int,
-              kind: str = "dense") -> None:
+              kind: str = "dense", devices: int = 1) -> None:
         """Track an entry just inserted into ``cache`` under ``key``;
         evict least-recently-used entries (from any owner) until the
         total fits the budget.  The entry being admitted is never its
@@ -108,7 +112,11 @@ class ResidencyManager:
         budget — an unconditional reclaim, like the reference's global
         syswrap caps (syswrap/os.go:41).  ``kind`` tags the bytes as
         "dense" tensors or roaring "compressed" container pools, so
-        the stats() split reports REAL compressed residency."""
+        the stats() split reports REAL compressed residency.
+        ``devices`` is how many mesh devices the entry's bytes spread
+        over under the [mesh] shard plan (parallel/meshexec.py) —
+        stats() reports the resulting worst-per-device residency so
+        an operator sizes HBM against what ONE chip actually holds."""
         eid = self._id(cache, key)
         with self._lock:
             old = self._entries.pop(eid, None)
@@ -116,8 +124,11 @@ class ResidencyManager:
                 self.total -= old[2]
                 self._by_kind[old[3]] = \
                     self._by_kind.get(old[3], 0) - old[2]
-            self._entries[eid] = (cache, key, nbytes, kind)
+                self._per_device -= -(-old[2] // old[4])
+            self._entries[eid] = (cache, key, nbytes, kind,
+                                  max(1, devices))
             self.total += nbytes
+            self._per_device += -(-nbytes // max(1, devices))
             self._by_kind[kind] = self._by_kind.get(kind, 0) + nbytes
             self.admits += 1
             while self.total > self.budget and len(self._entries) > 1:
@@ -126,8 +137,10 @@ class ResidencyManager:
                     # never evict the entry being admitted
                     self._entries[eid] = self._entries.pop(eid)
                     continue
-                vcache, vkey, vbytes, vkind = self._entries.pop(victim_id)
+                (vcache, vkey, vbytes, vkind,
+                 vdev) = self._entries.pop(victim_id)
                 self.total -= vbytes
+                self._per_device -= -(-vbytes // vdev)
                 self._by_kind[vkind] = \
                     self._by_kind.get(vkind, 0) - vbytes
                 self.evictions += 1
@@ -155,6 +168,7 @@ class ResidencyManager:
             e = self._entries.pop(eid, None)
             if e is not None:
                 self.total -= e[2]
+                self._per_device -= -(-e[2] // e[4])
                 self._by_kind[e[3]] = self._by_kind.get(e[3], 0) - e[2]
 
     def evict_all(self) -> int:
@@ -167,6 +181,7 @@ class ResidencyManager:
             victims = list(self._entries.values())
             self._entries.clear()
             self.total = 0
+            self._per_device = 0
             self._by_kind.clear()
             self.evictions += len(victims)
             # owner-dict pops stay under the lock (the admit() victim
@@ -174,7 +189,7 @@ class ResidencyManager:
             # fresh entry for the same key between our snapshot and
             # pop — we would drop ITS tensor while _entries still
             # tracks it, permanently skewing the byte accounting
-            for vcache, vkey, _vbytes, _vkind in victims:
+            for vcache, vkey, _vbytes, _vkind, _vdev in victims:
                 vcache.pop(vkey, None)
         return len(victims)
 
@@ -185,6 +200,12 @@ class ResidencyManager:
                     "evictions": self.evictions,
                     "admits": self.admits,
                     "high_water": self.high_water,
+                    # what one chip holds when stacks shard over the
+                    # [mesh] plan: sum of ceil(bytes / devices) — equal
+                    # to total with the mesh off, total/axis when every
+                    # entry shards (the /debug/devices + /debug/mesh
+                    # per-device residency line)
+                    "per_device": self._per_device,
                     # compressed-vs-dense residency split (the
                     # roaring-on-TPU capacity story; /debug/devices)
                     "kinds": {k: v for k, v in self._by_kind.items()
@@ -198,8 +219,8 @@ class ResidencyManager:
         with self._lock:
             entries = sorted(self._entries.values(), key=lambda e: -e[2])[:n]
         return [{"key": repr(key)[:160], "bytes": nbytes,
-                 "kind": kind}
-                for _, key, nbytes, kind in entries]
+                 "kind": kind, "devices": devices}
+                for _, key, nbytes, kind, devices in entries]
 
 
 _global: ResidencyManager | None = None

@@ -289,7 +289,8 @@ class InternalClient:
     def query_node(self, uri: str, index: str, pql: str,
                    shards: list[int] | None = None, remote: bool = True,
                    nocache: bool = False, nodelta: bool = False,
-                   nocontainers: bool = False, partial: bool = False):
+                   nocontainers: bool = False, nomesh: bool = False,
+                   partial: bool = False):
         """POST /index/{i}/query with Remote semantics over the
         protobuf wire — node-to-node RPC speaks protobuf like the
         reference's InternalClient (http/client.go:268 QueryNode;
@@ -300,7 +301,9 @@ class InternalClient:
         same way (the peer compacts its pending ingest deltas and
         answers from pure base state); ``nocontainers`` rides as
         ?nocontainers=1 (the peer routes its fused reads through the
-        dense pre-container path)."""
+        dense pre-container path); ``nomesh`` rides as ?nomesh=1 (the
+        peer runs its fused dispatches on the pre-mesh single-device
+        programs)."""
         from pilosa_tpu import proto
 
         body = proto.encode(proto.QUERY_REQUEST, {
@@ -312,6 +315,7 @@ class InternalClient:
         flags = [f for f, on in (("nocache=1", nocache),
                                  ("nodelta=1", nodelta),
                                  ("nocontainers=1", nocontainers),
+                                 ("nomesh=1", nomesh),
                                  ("partial=1", partial)) if on]
         if flags:
             path += "?" + "&".join(flags)
@@ -446,11 +450,13 @@ class HTTPTransport(Transport):
 
     def query_node(self, node: Node, index: str, pql: str, shards,
                    nocache: bool = False, nodelta: bool = False,
-                   nocontainers: bool = False, partial: bool = False):
+                   nocontainers: bool = False, nomesh: bool = False,
+                   partial: bool = False):
         # the protobuf client already returns decoded result objects
         return self.client.query_node(node.uri, index, pql, shards,
                                       nocache=nocache, nodelta=nodelta,
                                       nocontainers=nocontainers,
+                                      nomesh=nomesh,
                                       partial=partial)
 
     def send_message(self, node: Node, message: dict) -> dict:

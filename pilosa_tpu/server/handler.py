@@ -701,6 +701,10 @@ class Handler:
                 # bit-identical either way)
                 containers=params.get("nocontainers")
                 not in ("1", "true"),
+                # ?nomesh=1: run fused dispatches on the pre-mesh
+                # single-device programs (debugging escape; results
+                # are byte-identical either way)
+                mesh=params.get("nomesh") not in ("1", "true"),
                 partial=partial,
                 partial_meta=partial_meta,
             )
@@ -1244,6 +1248,26 @@ class Handler:
             })
         self._json(req, out)
 
+    @route("GET", "/debug/mesh")
+    def handle_debug_mesh(self, req, params, path, body):
+        """Mesh-native execution state (parallel/meshexec.py): the
+        [mesh] config in force, whether the mesh is active, the axis
+        layout (which local devices join the shard axis), the
+        per-device shard plan for the widest index's shard fan-out,
+        the mesh.* counters (launches, queries, ?nomesh fallbacks,
+        placements/bytes), and the residency per-device split."""
+        from pilosa_tpu.parallel import meshexec
+        from pilosa_tpu.runtime import residency
+
+        widest = max(
+            [len(idx.available_shards())
+             for idx in self.api.holder.indexes.values()] or [0])
+        out = meshexec.debug(n_shards=widest or None)
+        rs = residency.manager().stats()
+        out["residency"] = {"total": rs["total"],
+                            "perDevice": rs["per_device"]}
+        self._json(req, out)
+
     @route("GET", "/debug/devices")
     def handle_debug_devices(self, req, params, path, body):
         """Device-runtime telemetry (pilosa_tpu.devobs): per-kernel /
@@ -1431,6 +1455,7 @@ class Handler:
         from pilosa_tpu.ingest import compactor
         from pilosa_tpu.ops import containers as _containers
         from pilosa_tpu.ops import tape
+        from pilosa_tpu.parallel import meshexec as _meshexec
         from pilosa_tpu.runtime import resultcache
 
         try:
@@ -1439,6 +1464,7 @@ class Handler:
             compactor.compactor().publish_gauges(self.stats)
             tape.publish_gauges(self.stats)
             _containers.publish_gauges(self.stats)
+            _meshexec.publish_gauges(self.stats)
             # chaos-round families: breakers, hedged reads, failpoints,
             # partial degradation — zeros on a clean server so the
             # families are alert-able before the first fault

@@ -82,6 +82,8 @@ class Server:
         ingest_compact_interval: float | None = None,
         containers_enabled: bool | None = None,
         containers_threshold: float | None = None,
+        mesh_enabled=None,
+        mesh_axis_size: int | None = None,
         breaker_threshold: int = 5,
         breaker_cooldown: float = 5.0,
         hedge_min_samples: int = 8,
@@ -218,6 +220,16 @@ class Server:
         self._containers_retained = True
         _containers.configure(enabled=containers_enabled,
                               threshold=containers_threshold)
+        # mesh-native SPMD execution ([mesh] config): process-wide
+        # like [containers] — the first server's retain() captures the
+        # pre-server baseline, the LAST release() (in close) restores
+        # it for library users sharing the process
+        from pilosa_tpu.parallel import meshexec as _meshexec
+
+        _meshexec.retain()
+        self._mesh_retained = True
+        _meshexec.configure(enabled=mesh_enabled,
+                            axis_size=mesh_axis_size)
         if self._ingest_enabled:
             # reference taken at CONSTRUCTION, where the configure
             # above landed — not at open() — so a sibling's close
@@ -298,6 +310,12 @@ class Server:
 
             _containers.retain()
             self._containers_retained = True
+        if not self._mesh_retained:
+            # reopened after close(): take the [mesh] reference back
+            from pilosa_tpu.parallel import meshexec as _meshexec
+
+            _meshexec.retain()
+            self._mesh_retained = True
         if self._ingest_enabled and not self._ingest_retained:
             # reopened after close(): take the reference back (the
             # normal first open already holds the construction-time
@@ -344,19 +362,28 @@ class Server:
             return
         try:
             from pilosa_tpu.models.field import _padded_rows
+            from pilosa_tpu.parallel import meshexec
 
             # the leaf stack shape every fused read stages: the widest
-            # index's shard fan-out (device-padded), SHARD_WIDTH words.
-            # An empty holder warms a nominal 1-shard stack — the
-            # program structure still lowers; a different shard count
-            # later re-specializes only the cheap outer shapes.
+            # index's shard fan-out, padded exactly as serving stacks
+            # pad (_padded_rows keys on the [mesh] axis in force — the
+            # actual device layout), SHARD_WIDTH words.  The mesh is
+            # threaded through so the programs LOWERED are the ones
+            # serving traffic will run: shard_map variants on an
+            # active mesh, single-device ones otherwise — a 1-device
+            # process never lowers mesh-shaped programs and an
+            # N-device mesh never wastes the warm-up on single-device
+            # ones.  An empty holder warms a nominal 1-shard stack —
+            # the program structure still lowers; a different shard
+            # count later re-specializes only the cheap outer shapes.
             n_shards = max(
                 [len(idx.available_shards())
                  for idx in self.holder.indexes.values()] or [1])
             stack = (_padded_rows(max(1, n_shards)),
                      bm.n_words(SHARD_WIDTH))
             _tape.prewarm(stack, co.max_batch, co.max_tape,
-                          co.max_leaves)
+                          co.max_leaves,
+                          mesh=meshexec.active_mesh())
         except Exception as e:  # noqa: BLE001 — prewarm must never
             # break serving; the first ragged window pays the compile
             self.logger.printf("ragged prewarm skipped: %r", e)
@@ -445,6 +472,11 @@ class Server:
         if self._containers_retained:
             self._containers_retained = False
             _containers.release()
+        from pilosa_tpu.parallel import meshexec as _meshexec
+
+        if self._mesh_retained:
+            self._mesh_retained = False
+            _meshexec.release()
         if self._faultinject_armed:
             # config-armed failpoints are process-wide: the arming
             # server disarms everything on close so library users

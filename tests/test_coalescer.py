@@ -98,6 +98,8 @@ class TestFusedDispatchCount:
         erases leaf values) — no per-query retrace."""
         expr._compiled.cache_clear()
         expr._compiled_gather.cache_clear()
+        expr._compiled_mesh.cache_clear()
+        expr._compiled_mesh_gather.cache_clear()
         for a in range(3):
             ex.execute("i", f"Count(Intersect(Row(f0={a}), Row(f1={a})))")
         # the query routes ONE of the two fused engines (dense program
@@ -105,7 +107,11 @@ class TestFusedDispatchCount:
         # the three row-id variants must share a single compiled shape
         dense = expr._compiled.cache_info()
         gather = expr._compiled_gather.cache_info()
-        assert dense.misses + gather.misses == 1, (dense, gather)
+        mesh = expr._compiled_mesh.cache_info()
+        mgather = expr._compiled_mesh_gather.cache_info()
+        assert (dense.misses + gather.misses
+                + mesh.misses + mgather.misses) == 1, (
+            dense, gather, mesh, mgather)
 
     def test_expr_matches_bm_ops(self):
         """Direct engine check: compiled program == op-by-op chain."""
@@ -172,9 +178,9 @@ class TestCoalescer:
         launches = []
         orig = expr.evaluate
 
-        def spy(shape, leaves, counts=False):
+        def spy(shape, leaves, **kw):
             launches.append(shape)
-            return orig(shape, leaves, counts=counts)
+            return orig(shape, leaves, **kw)
 
         expr_evaluate = expr.evaluate
         expr.evaluate = spy
@@ -238,9 +244,9 @@ class TestCoalescer:
         seen = []
         orig = expr.evaluate
 
-        def spy(shape, leaves, counts=False):
+        def spy(shape, leaves, **kw):
             seen.append(tuple(getattr(lv, "shape", ()) for lv in leaves))
-            return orig(shape, leaves, counts=counts)
+            return orig(shape, leaves, **kw)
 
         expr.evaluate = spy
         try:
@@ -303,7 +309,7 @@ class TestCoalescer:
         _attach(ex, window_s=1.0, max_batch=2)
         orig = expr.evaluate
 
-        def boom(shape, leaves, counts=False):
+        def boom(shape, leaves, **kw):
             raise RuntimeError("flush exploded")
 
         expr.evaluate = boom

@@ -63,7 +63,7 @@ def test_every_config_key_documented():
     missing = []
     sections = ("cluster", "anti_entropy", "metric", "tracing",
                 "profile", "tls", "coalescer", "ragged", "observe",
-                "admission", "cache", "ingest", "containers",
+                "admission", "cache", "ingest", "containers", "mesh",
                 "faultinject")
     for f in fields(cfgmod.Config):
         if f.name in sections:

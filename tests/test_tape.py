@@ -359,9 +359,9 @@ class TestRaggedCoalescer:
             tape_calls.append(len(batch))
             return orig_t(batch, **kw)
 
-        def spy_e(shape, leaves, counts=False):
+        def spy_e(shape, leaves, **kw):
             expr_calls.append(shape)
-            return orig_e(shape, leaves, counts=counts)
+            return orig_e(shape, leaves, **kw)
 
         tape.execute, expr.evaluate = spy_t, spy_e
         try:

@@ -154,6 +154,13 @@ MODULE_LOCKS: dict[str, tuple] = {
         ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
         ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
     ),
+    "parallel/meshexec.py": (
+        ModuleGlobalRule("_counters", "_lock", "rw"),
+        ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
+        ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_mesh_cache", "_cfg_lock", "w"),
+    ),
     "faultinject.py": (
         # the failpoint registry: every read AND write of the armed
         # point table goes through the module lock (hit() is only
@@ -299,6 +306,18 @@ CONFIG_GUARDS = (
         pair=("disarm",),
         owner_suffixes=("faultinject.py",),
         what="the process-wide failpoint registry",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("meshexec.configure", "_meshexec.configure"),
+        pair=("retain", "release"),
+        owner_suffixes=("parallel/meshexec.py",),
+        what="the process-wide [mesh] runtime config",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("meshexec.retain", "_meshexec.retain"),
+        pair=("release",),
+        owner_suffixes=("parallel/meshexec.py",),
+        what="the refcounted [mesh] baseline",
     ),
 )
 

@@ -267,6 +267,10 @@ TAPE_FAMILIES = _mf.live_prefixes("tape")
 #: (ops/containers.publish_gauges), rendered as container_*.
 CONTAINER_FAMILIES = _mf.live_prefixes("container")
 
+#: Mesh-native execution families (parallel/meshexec.publish_gauges),
+#: rendered as mesh_*.
+MESH_FAMILIES = _mf.live_prefixes("mesh")
+
 #: Everything the ``--families`` CLI mode requires of a live server.
 ALL_FAMILIES = _mf.live_prefixes()
 
