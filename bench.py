@@ -1191,6 +1191,120 @@ def bench_containers() -> dict | None:
     return out
 
 
+def bench_residency() -> dict | None:
+    """Tiered-residency A/B (runtime/residency.py): the same zipfian
+    Count mix measured (a) fully resident — HBM budget far above the
+    working set, (b) at a 4x-over-budget working set with the
+    predictive prefetcher OFF, and (c) 4x over budget with it ON.
+
+    The pinned number is the STALL RATE: the fraction of queries whose
+    flight record shows any non-HBM stack access (an async-promotion
+    wait, a host-compute fallback, or a cold rebuild).  Fully resident
+    it is ~0 after warmup by construction; at 4x the tier machinery
+    absorbs the overflow, and the prefetcher must strictly reduce it
+    on the zipfian mix (the hot head gets promoted ahead of demand) —
+    ``pin_prefetch_ok``.  Every sample is verified against the
+    imported truth (one bit per shard per row -> count == shards)."""
+    from pilosa_tpu import observe
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.ops import bitmap as bm
+    from pilosa_tpu.parallel.executor import ExecOptions, Executor
+    from pilosa_tpu.runtime import residency
+    from pilosa_tpu.runtime.prefetch import Prefetcher
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    SHARDS = 8
+    stack_bytes = SHARDS * bm.n_words(SHARD_WIDTH) * 4
+    budget = 8 * stack_bytes + (64 << 10)   # ~8 resident row stacks
+    n_rows = 32                              # 4x the budget
+    rng = np.random.default_rng(12349)
+    holder = Holder(None)
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    for row in range(n_rows):
+        cols = np.arange(SHARDS, dtype=np.int64) * SHARD_WIDTH + row
+        f.import_bits(np.full(SHARDS, row), cols)
+    ex = Executor(holder)
+    # zipfian row schedule, fixed across all three legs
+    weights = [1.0 / (r + 1) ** 1.2 for r in range(n_rows)]
+    zrng = np.random.default_rng(4242)
+    schedule = zrng.choice(n_rows, size=4096,
+                           p=np.array(weights) / sum(weights))
+
+    def leg(seconds: float) -> dict:
+        n = 0
+        stalled = 0
+        stall_ms = 0.0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < seconds:
+            row = int(schedule[n % len(schedule)])
+            got = ex.execute(
+                "i", f"Count(Row(f={row}))",
+                opt=ExecOptions(cache=False, containers=False))[0]
+            if got != SHARDS:
+                raise AssertionError(
+                    f"residency bench: row {row}: {got} != {SHARDS}")
+            rec = observe.take_last()
+            tier = (rec.to_dict().get("tier") or {}) if rec else {}
+            if (tier.get("promoted", 0) or tier.get("fallback", 0)
+                    or tier.get("cold", 0)):
+                stalled += 1
+                stall_ms += tier.get("stallMs", 0.0)
+            n += 1
+        dt = time.perf_counter() - t0
+        return {"qps": round(n / dt, 2), "queries": n,
+                "stall_rate": round(stalled / max(1, n), 4),
+                "stall_ms_total": round(stall_ms, 1)}
+
+    def fresh_manager(hbm: int) -> None:
+        # a reset ORPHANS entries still sitting in the field's stack
+        # cache (they keep hitting, untracked — hiding the budget);
+        # clear the owner dicts so every leg restages under its own
+        # budget from a cold start
+        residency.reset(hbm)
+        residency.configure(host_budget_bytes=1 << 30, prefetch=False)
+        with f._lock:
+            f._row_stack_cache.clear()
+            f._matrix_stack_cache.clear()
+
+    try:
+        # (a) fully resident
+        fresh_manager(64 * stack_bytes)
+        leg(0.5)  # warm
+        resident = leg(1.0)
+        # (b) 4x working set, prefetch off
+        fresh_manager(budget)
+        leg(1.0)  # populate + demote into steady churn
+        off = leg(2.0)
+        # (c) 4x working set, prefetch on (same demoted steady state)
+        residency.configure(prefetch=True, prefetch_interval=0.005)
+        pf = Prefetcher()
+        pf.start()
+        try:
+            leg(1.0)
+            on = leg(2.0)
+        finally:
+            pf.stop()
+    finally:
+        residency.reset()
+        holder.close()
+    return {
+        "shards": SHARDS,
+        "rows": n_rows,
+        "budget_bytes": budget,
+        "working_set_bytes": n_rows * stack_bytes,
+        "working_set_factor": round(n_rows * stack_bytes / budget, 2),
+        "resident": resident,
+        "overbudget_prefetch_off": off,
+        "overbudget_prefetch_on": on,
+        # acceptance pins: the prefetcher strictly reduces the stall
+        # rate on the zipfian mix, and the fully-resident control is
+        # (near-)stall-free after warmup
+        "pin_prefetch_ok": on["stall_rate"] < off["stall_rate"],
+        "pin_resident_ok": resident["stall_rate"] <= 0.01,
+    }
+
+
 def bench_admission(coalescer_extras: dict | None) -> dict:
     """Admission-layer overhead on the uncontended serving path: the
     gate's acquire+release pair is what every admitted request pays on
@@ -1438,6 +1552,9 @@ def main():
     msh = bench_mesh()
     if msh is not None:
         extras["mesh"] = msh
+    rsd = bench_residency()
+    if rsd is not None:
+        extras["residency"] = rsd
     bytes_per_query = a.nbytes + b.nbytes  # streamed once per query
     achieved_gbps = dev_qps * bytes_per_query / 1e9
     peak = _peak_gbps(platform)

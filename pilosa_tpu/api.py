@@ -96,7 +96,7 @@ class API:
               exclude_columns: bool = False, coalesce: bool = True,
               cache: bool = True, delta: bool = True,
               containers: bool = True, mesh: bool = True,
-              partial: bool = False,
+              tiers: bool = True, partial: bool = False,
               partial_meta: dict | None = None):
         """Execute PQL -> list of results (api.go:135 API.Query).
 
@@ -183,6 +183,7 @@ class API:
             delta=delta,
             containers=containers,
             mesh=mesh,
+            tiers=tiers,
             deadline=dl,
             partial=partial,
             missing=set() if partial else None,

@@ -99,6 +99,24 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--mesh-axis-size", type=int,
                     help="local devices joined to the mesh shard axis "
                          "([mesh] axis-size); 0 = all local devices")
+    ps.add_argument("--residency-host-budget-bytes", type=int,
+                    help="host-RAM tier budget behind HBM ([residency] "
+                         "host-budget-bytes); 0 disables tiering "
+                         "(misses rebuild inline, evictions drop)")
+    ps.add_argument("--residency-disk-path",
+                    help="directory for the optional disk spill tier "
+                         "behind host RAM ([residency] disk-path); "
+                         "empty disables it")
+    ps.add_argument("--residency-promote-workers", type=int,
+                    help="async promotion worker threads ([residency] "
+                         "promote-workers)")
+    ps.add_argument("--residency-promote-wait-ms", type=float,
+                    help="bound on a demand miss's promotion wait "
+                         "before the host-compute fallback "
+                         "([residency] promote-wait-ms)")
+    ps.add_argument("--no-prefetch", action="store_true",
+                    help="disable the predictive host-tier prefetcher "
+                         "([residency] prefetch=false)")
     ps.add_argument("--no-ingest-delta", action="store_true",
                     help="disable streaming-ingest delta planes "
                          "([ingest] delta-enabled=false): every write "
@@ -237,6 +255,17 @@ def cmd_server(args) -> int:
         cfg.mesh.enabled = "false"
     if args.mesh_axis_size is not None:
         cfg.mesh.axis_size = args.mesh_axis_size
+    if args.residency_host_budget_bytes is not None:
+        cfg.residency.host_budget_bytes = \
+            args.residency_host_budget_bytes
+    if args.residency_disk_path is not None:
+        cfg.residency.disk_path = args.residency_disk_path
+    if args.residency_promote_workers is not None:
+        cfg.residency.promote_workers = args.residency_promote_workers
+    if args.residency_promote_wait_ms is not None:
+        cfg.residency.promote_wait_ms = args.residency_promote_wait_ms
+    if args.no_prefetch:
+        cfg.residency.prefetch = False
     for key in ("breaker_threshold", "breaker_cooldown",
                 "hedge_max_fraction"):
         v = getattr(args, key, None)
@@ -344,6 +373,14 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         containers_threshold=cfg.containers.threshold,
         mesh_enabled=cfg.mesh.enabled,
         mesh_axis_size=cfg.mesh.axis_size,
+        residency_host_budget_bytes=cfg.residency.host_budget_bytes,
+        residency_disk_path=cfg.residency.disk_path,
+        residency_disk_budget_bytes=cfg.residency.disk_budget_bytes,
+        residency_promote_workers=cfg.residency.promote_workers,
+        residency_promote_queue=cfg.residency.promote_queue,
+        residency_promote_wait_ms=cfg.residency.promote_wait_ms,
+        residency_prefetch=cfg.residency.prefetch,
+        residency_prefetch_interval=cfg.residency.prefetch_interval,
         ingest_delta_budget_bytes=cfg.ingest.delta_budget_bytes,
         ingest_compact_threshold_bits=cfg.ingest.compact_threshold_bits,
         ingest_compact_interval=cfg.ingest.compact_interval,

@@ -233,6 +233,35 @@ class ContainersConfig:
 
 
 @dataclass
+class ResidencyConfig:
+    """[residency] — tiered device-memory residency
+    (runtime/residency.py; reference analog: the syswrap-capped mmap
+    plus file-handle/map LRU that lets Pilosa serve fragments far
+    beyond RAM).  ``host-budget-bytes`` caps the host-RAM tier behind
+    HBM (0 disables tiering: misses rebuild inline, evictions drop —
+    the pre-tier behavior); ``disk-path``/``disk-budget-bytes``
+    optionally put a spill tier behind host RAM.
+    ``promote-workers``/``promote-queue`` size the async promotion
+    pool (each job runs under admission's ``internal`` class; a full
+    queue sheds prefetch work first); ``promote-wait-ms`` bounds how
+    long a demand miss parks on its promotion before taking the
+    host-compute fallback (further capped by the request deadline).
+    ``prefetch``/``prefetch-interval`` drive the predictive
+    prefetcher (runtime/prefetch.py).  Per-request escape:
+    ``?notiers=1`` on the query route — results are byte-identical
+    either way."""
+
+    host_budget_bytes: int = 1 << 30
+    disk_path: str = ""
+    disk_budget_bytes: int = 4 << 30
+    promote_workers: int = 2
+    promote_queue: int = 64
+    promote_wait_ms: float = 50.0
+    prefetch: bool = True
+    prefetch_interval: float = 0.25
+
+
+@dataclass
 class MeshConfig:
     """[mesh] — mesh-native SPMD execution of the fused serving path
     (parallel/meshexec.py; no reference analog — Pilosa's only
@@ -311,6 +340,7 @@ class Config:
     containers: ContainersConfig = field(
         default_factory=ContainersConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    residency: ResidencyConfig = field(default_factory=ResidencyConfig)
     faultinject: FaultinjectConfig = field(
         default_factory=FaultinjectConfig)
 
@@ -350,7 +380,7 @@ class Config:
             if key in ("cluster", "anti_entropy", "metric", "tracing",
                        "profile", "tls", "coalescer", "ragged",
                        "observe", "admission", "cache", "ingest",
-                       "containers", "mesh",
+                       "containers", "mesh", "residency",
                        "faultinject") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
@@ -372,6 +402,7 @@ class Config:
                                                         IngestConfig,
                                                         ContainersConfig,
                                                         MeshConfig,
+                                                        ResidencyConfig,
                                                         FaultinjectConfig)):
                 setattr(self, key, v)
 
@@ -382,7 +413,8 @@ class Config:
             if f.name in ("cluster", "anti_entropy", "metric", "tracing",
                           "profile", "tls", "coalescer", "ragged",
                           "observe", "admission", "cache", "ingest",
-                          "containers", "mesh", "faultinject"):
+                          "containers", "mesh", "residency",
+                          "faultinject"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -489,6 +521,16 @@ class Config:
             "[mesh]",
             f'enabled = "{self.mesh.enabled}"',
             f"axis-size = {self.mesh.axis_size}",
+            "",
+            "[residency]",
+            f"host-budget-bytes = {self.residency.host_budget_bytes}",
+            f'disk-path = "{self.residency.disk_path}"',
+            f"disk-budget-bytes = {self.residency.disk_budget_bytes}",
+            f"promote-workers = {self.residency.promote_workers}",
+            f"promote-queue = {self.residency.promote_queue}",
+            f"promote-wait-ms = {self.residency.promote_wait_ms}",
+            f"prefetch = {str(self.residency.prefetch).lower()}",
+            f"prefetch-interval = {self.residency.prefetch_interval}",
             "",
             "[faultinject]",
             f'armed = "{self.faultinject.armed}"',

@@ -207,6 +207,10 @@ class DeviceObserver:
                 "oomRetries": self.oom_retries,
             }
         out["residency"] = residency.manager().stats()
+        # tiered residency: the promotion pool's live state joins the
+        # manager's tier split (/debug/devices answers "is the working
+        # set over HBM, and is promotion keeping up" in one read)
+        out["residency"]["promoter"] = residency.promoter().stats()
         out["devices"] = self.device_memory()
         return out
 
@@ -247,6 +251,43 @@ class DeviceObserver:
         stats.gauge("residency.dense_bytes", kinds.get("dense", 0))
         stats.gauge("residency.compressed_bytes",
                     kinds.get("compressed", 0))
+        # tiered residency (runtime/residency.py): the host/disk tier
+        # occupancy, demotion/promotion flow, and degradation counters
+        # — residency.tier.* + prefetch.* families, published
+        # unconditionally (zeros pre-pressure) so the surfaces are
+        # scrape-visible before the first over-HBM working set
+        t = r.get("tiers") or {}
+        host = t.get("host") or {}
+        disk = t.get("disk") or {}
+        stats.gauge("residency.tier.host_bytes", host.get("bytes", 0))
+        stats.gauge("residency.tier.host_budget_bytes",
+                    host.get("budget", 0))
+        stats.gauge("residency.tier.host_entries",
+                    host.get("entries", 0))
+        stats.gauge("residency.tier.disk_bytes", disk.get("bytes", 0))
+        stats.gauge("residency.tier.disk_entries",
+                    disk.get("entries", 0))
+        stats.gauge("residency.tier.demotions", t.get("demotions", 0))
+        stats.gauge("residency.tier.hits", t.get("hits", 0))
+        stats.gauge("residency.tier.misses", t.get("misses", 0))
+        stats.gauge("residency.tier.spills", t.get("spills", 0))
+        stats.gauge("residency.tier.disk_hits", t.get("diskHits", 0))
+        stats.gauge("residency.tier.fallbacks", t.get("fallbacks", 0))
+        stats.gauge("residency.tier.oom_budget_shrinks",
+                    t.get("oomBudgetShrinks", 0))
+        p = residency.promoter().stats()
+        stats.gauge("residency.tier.promotions", p.get("promotions", 0))
+        stats.gauge("residency.tier.promotion_failures",
+                    p.get("failures", 0))
+        stats.gauge("residency.tier.promotion_sheds", p.get("sheds", 0))
+        stats.gauge("residency.tier.promote_queue", p.get("queue", 0))
+        stats.gauge("prefetch.issued", p.get("prefetchIssued", 0))
+        stats.gauge("prefetch.completed",
+                    p.get("prefetchCompleted", 0))
+        stats.gauge("prefetch.shed", p.get("prefetchShed", 0))
+        stats.gauge("prefetch.useful", t.get("prefetchUseful", 0))
+        stats.gauge("prefetch.enabled",
+                    1 if residency.config().prefetch else 0)
         for d in self.device_memory():
             if d.get("bytesInUse") is None:
                 continue

@@ -67,6 +67,11 @@ SITES: dict[str, str] = {
         "ops.bitmap.note_dispatch — every device kernel launch",
     "resultcache.fill":
         "runtime.ResultCache.put, before a computed result is cached",
+    "residency.promote":
+        "runtime.residency promotion worker, before a host-tier entry "
+        "is placed back on device (error = promotion failure -> the "
+        "waiting query takes the host-compute fallback; delay(ms) = a "
+        "tier stall)",
 }
 
 

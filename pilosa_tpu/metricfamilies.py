@@ -71,9 +71,15 @@ FAMILIES: tuple[Family, ...] = (
            live_prefixes=("compile_",), group="device",
            doc="administration.md"),
     Family("residency", "residency_",
-           "device-cache budget/evict/admit accounting "
-           "(runtime/residency.py)",
-           live_prefixes=("residency_",), group="device",
+           "device-cache budget/evict/admit accounting plus the "
+           "residency.tier.* host/disk-tier, demotion, promotion and "
+           "fallback counters (runtime/residency.py)",
+           live_prefixes=("residency_", "residency_tier_"),
+           group="device", doc="administration.md"),
+    Family("prefetch", "prefetch_",
+           "predictive host-tier->HBM prefetcher "
+           "(runtime/prefetch.py)",
+           live_prefixes=("prefetch_",), group="tier",
            doc="administration.md"),
     Family("cache", "cache_",
            "generation-stamped result cache (runtime/resultcache.py)",

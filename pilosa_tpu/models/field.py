@@ -15,6 +15,7 @@ import json
 import os
 import re
 import threading
+import time as _time
 from dataclasses import dataclass
 
 import numpy as np
@@ -427,11 +428,21 @@ class Field:
         frags = [None if view is None else view.fragment(s) for s in shards]
         gens = (_placement_token(),) + tuple(
             _frag_base_gen(fr) for fr in frags)
+        self._note_access(self._row_stack_cache, key)
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if hit is not None and hit[0] == gens and _live(hit[1]):
                 self._touch(self._row_stack_cache, key)
+                self._note_tier("hbm")
                 return hit[1]
+        # demoted-but-warm: the host tier holds the assembled stack —
+        # promote asynchronously (bounded wait) or serve host bytes
+        tiered = self._tier_consult(
+            self._row_stack_cache, key, gens,
+            lambda h: h[0] == gens and _live(h[1]))
+        if tiered is not None:
+            return tiered[1][1] if tiered[0] == "dev" else tiered[1]
+        t_build = _time.perf_counter_ns()
         n_words = bm.n_words(SHARD_WIDTH)
         # np.empty, zeroing only rows no fragment fills: at north-star
         # scale the stack is ~1.25 GB and a full memset is a whole
@@ -449,13 +460,69 @@ class Field:
             if not copied:
                 stack[i] = 0
         stack[len(shards):] = 0  # device-count padding rows
-        return self._place_and_cache_stack(key, gens, stack)
+        return self._place_and_cache_stack(key, gens, stack,
+                                           t0_ns=t_build)
 
     @staticmethod
     def _touch(cache: dict, key) -> None:
         from pilosa_tpu.runtime import residency
 
         residency.manager().touch(cache, key)
+
+    @staticmethod
+    def _note_tier(outcome: str, ns: int = 0) -> None:
+        """Stamp one tiered stack access (hbm | promoted | fallback |
+        cold) onto the active flight record — the stall-vs-hit split
+        ?profile=1 and /debug/queries carry.  Silent under ?notiers
+        (the escape's profile must look pre-tier too)."""
+        from pilosa_tpu import observe as _observe
+        from pilosa_tpu.runtime import residency
+
+        if not residency.tiers_enabled():
+            return
+        rec = _observe.current()
+        if rec is not None:
+            rec.note_tier(outcome, ns)
+
+    @staticmethod
+    def _note_access(cache: dict, key) -> None:
+        """Tick the prefetcher's access-statistics table
+        (observe.access_stats) for one stack entry."""
+        from pilosa_tpu import observe as _observe
+
+        _observe.note_access((id(cache), key))
+
+    def _tier_consult(self, cache: dict, key, gens, valid):
+        """Host-tier consult after an owner-cache miss: enqueue the
+        async promotion (single-flight per key), wait a bounded slice
+        of the request's deadline, and return ``("dev", entry)`` when
+        the promoted owner-cache entry landed in time (``valid``
+        re-checks it) — else ``("host", value)``, the host-compute
+        fallback (bit-exact; the promotion keeps running for the next
+        query).  None on a true cold miss: the caller assembles from
+        fragment state, exactly the pre-tier path."""
+        from pilosa_tpu.runtime import residency
+        from pilosa_tpu.serve import deadline as _deadline
+
+        mgr = residency.manager()
+        ent = mgr.host_lookup(cache, key, gens)
+        if ent is None:
+            return None
+        t0 = _time.perf_counter_ns()
+        fl = residency.promoter().submit(ent)
+        if fl is not None:
+            fl.event.wait(
+                residency.promote_wait_s(_deadline.current()))
+        with self._lock:
+            hit = cache.get(key)
+            if hit is not None and valid(hit):
+                self._touch(cache, key)
+                self._note_tier("promoted",
+                                _time.perf_counter_ns() - t0)
+                return ("dev", hit)
+        mgr.note_fallback()
+        self._note_tier("fallback", _time.perf_counter_ns() - t0)
+        return ("host", ent.host_value())
 
     @staticmethod
     def _place_on_devices(stack: np.ndarray):
@@ -517,11 +584,19 @@ class Field:
             frag_grid.append(frags)
             gens.append(tuple(_frag_gen(fr) for fr in frags))
         gens = tuple(gens)
+        self._note_access(self._row_stack_cache, key)
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if hit is not None and hit[0] == gens and _live(hit[1]):
                 self._touch(self._row_stack_cache, key)
+                self._note_tier("hbm")
                 return hit[1]
+        tiered = self._tier_consult(
+            self._row_stack_cache, key, gens,
+            lambda h: h[0] == gens and _live(h[1]))
+        if tiered is not None:
+            return tiered[1][1] if tiered[0] == "dev" else tiered[1]
+        t_build = _time.perf_counter_ns()
         n_words = bm.n_words(SHARD_WIDTH)
         # np.empty + first-contributor copy: no whole-stack memset (see
         # device_row_stack); later contributors OR-accumulate
@@ -548,7 +623,8 @@ class Field:
             if not wrote:
                 stack[i] = 0
         stack[len(shards):] = 0
-        return self._place_and_cache_stack(key, gens, stack)
+        return self._place_and_cache_stack(key, gens, stack,
+                                           t0_ns=t_build)
 
     @staticmethod
     def _entry_cap(fixed_cap: int) -> int:
@@ -566,14 +642,28 @@ class Field:
             return fixed_cap
         return max(fixed_cap, mgr.budget // 4)
 
-    def _place_and_cache_stack(self, key, gens, stack: np.ndarray):
+    def _place_and_cache_stack(self, key, gens, stack: np.ndarray,
+                               t0_ns: int | None = None):
         dev = self._place_on_devices(stack)
+        if t0_ns is not None:
+            # cold-build attribution: this query paid the fragment
+            # re-assembly + placement (nothing in HBM or the host tier)
+            self._note_tier("cold", _time.perf_counter_ns() - t0_ns)
         entry_bytes = stack.nbytes
         if entry_bytes > self._entry_cap(self.ROW_STACK_CACHE_BYTES):
             return dev  # uncacheable; never evict the warm cache for it
+        place = self._place_on_devices
+
+        def _promote(arr, _g=gens):
+            # async re-promotion: re-place the demoted host stack under
+            # whatever [mesh] layout is then in force; a placement-
+            # token drift simply misses at the consumer and rebuilds
+            return (_g, place(arr))
+
         self._evict_and_insert(
             self._row_stack_cache, key, (gens, dev), entry_bytes,
-            max_entries=64, devices=_placement_devices())
+            max_entries=64, devices=_placement_devices(),
+            token=gens, host=stack, promote=_promote)
         return dev
 
     def device_delta_stacks(self, row_id: int, shards: tuple[int, ...]):
@@ -662,12 +752,20 @@ class Field:
         gens = (ct.config().threshold, _placement_token(),
                 *(_frag_base_gen(fr) for fr in frags))
         key = ("cont", row_id, shards)
+        self._note_access(self._row_stack_cache, key)
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if (hit is not None and hit[0] == gens
                     and _live(hit[1].pool)):
                 self._touch(self._row_stack_cache, key)
+                self._note_tier("hbm")
                 return hit[1]
+        tiered = self._tier_consult(
+            self._row_stack_cache, key, gens,
+            lambda h: h[0] == gens and _live(h[1].pool))
+        if tiered is not None:
+            return tiered[1][1] if tiered[0] == "dev" else tiered[1]
+        t_build = _time.perf_counter_ns()
         entries: list = []
         starts: list[int] = []
         kinds: list = []
@@ -705,10 +803,28 @@ class Field:
             pool[:n] = np.concatenate(blocks_list, axis=0)
         leaf = ct.ContainerLeaf(shards, entries, starts, kinds,
                                 self._place_pool(pool), n, pool.nbytes)
+        self._note_tier("cold", _time.perf_counter_ns() - t_build)
         if pool.nbytes <= self._entry_cap(self.ROW_STACK_CACHE_BYTES):
+            place_pool = self._place_pool
+
+            def _promote_leaf(p, _g=gens, _e=entries, _s=starts,
+                              _k=kinds, _n=n, _sh=shards):
+                return (_g, ct.ContainerLeaf(_sh, _e, _s, _k,
+                                             place_pool(p), _n,
+                                             p.nbytes))
+
+            def _leaf_host(p, _e=entries, _s=starts, _k=kinds,
+                           _n=n, _sh=shards):
+                return ct.ContainerLeaf(
+                    _sh, _e, _s, _k, np.ascontiguousarray(p), _n,
+                    p.nbytes)
+
             self._evict_and_insert(self._row_stack_cache, key,
                                    (gens, leaf), pool.nbytes,
-                                   max_entries=64, kind="compressed")
+                                   max_entries=64, kind="compressed",
+                                   token=gens, host=pool,
+                                   promote=_promote_leaf,
+                                   fallback=_leaf_host)
         return leaf
 
     @staticmethod
@@ -751,7 +867,8 @@ class Field:
 
     def _evict_and_insert(self, cache: dict, key, entry, entry_bytes: int,
                           max_entries: int, kind: str = "dense",
-                          devices: int = 1) -> None:
+                          devices: int = 1, token=None, host=None,
+                          promote=None, fallback=None) -> None:
         """Insert under the entry cap; BYTE budgeting is global — the
         process-wide residency manager sees every owner's device caches
         and LRU-evicts across all of them, so the true device total is
@@ -760,7 +877,10 @@ class Field:
         entries from this dict under its own lock, so every removal
         here tolerates a vanished key, and admit happens inside
         self._lock so the inserted entry can't be popped before it is
-        tracked."""
+        tracked.  ``token``+``host``+``promote`` opt the entry into the
+        host tier (eviction demotes instead of dropping); cap
+        evictions DEMOTE too — the FIFO-displaced entry is still valid,
+        merely cold."""
         from pilosa_tpu.runtime import residency
 
         mgr = residency.manager()
@@ -773,10 +893,11 @@ class Field:
                 except StopIteration:
                     break
                 cache.pop(k, None)
-                mgr.forget(cache, k)
+                mgr.demote(cache, k)
             cache[key] = entry
             mgr.admit(cache, key, entry_bytes, kind=kind,
-                      devices=devices)
+                      devices=devices, token=token, host=host,
+                      promote=promote, fallback=fallback)
 
     #: device-memory budget for concatenated matrix stacks (bytes)
     MATRIX_STACK_CACHE_BYTES = 512 << 20
@@ -820,14 +941,22 @@ class Field:
         # reads gens[pos] to validate per-fragment cache warms)
         gens.append(_placement_token())
         gens = tuple(gens)
+        self._note_access(self._matrix_stack_cache, key)
         with self._lock:
             hit = self._matrix_stack_cache.get(key)
             if (hit is not None and hit[0] == gens
                     and (hit[4] is None or _live(hit[4]))):
                 self._touch(self._matrix_stack_cache, key)
+                self._note_tier("hbm")
                 return hit
+        tiered = self._tier_consult(
+            self._matrix_stack_cache, key, gens,
+            lambda h: h[0] == gens and (h[4] is None or _live(h[4])))
+        if tiered is not None:
+            return tiered[1]
         if not parts:
             return (gens, np.empty(0, dtype=np.int64), None, None, None)
+        t_build = _time.perf_counter_ns()
         row_ids = np.concatenate([ids for _, ids, _ in parts])
         shard_pos = np.concatenate(
             [np.full(len(ids), pos, dtype=np.int32) for pos, ids, _ in parts])
@@ -838,13 +967,30 @@ class Field:
             shard_pos = np.pad(shard_pos, (0, pad))
         mat_dev = self._place_on_devices(big)
         pos_dev = self._place_on_devices(shard_pos)
+        self._note_tier("cold", _time.perf_counter_ns() - t_build)
         entry = (gens, row_ids, shard_pos, pos_dev, mat_dev)
         entry_bytes = big.nbytes
         if entry_bytes > self._entry_cap(self.MATRIX_STACK_CACHE_BYTES):
             return entry  # uncacheable; don't evict the warm cache for it
+        place = self._place_on_devices
+
+        def _promote_matrix(payload, _g=gens):
+            ids_, pos_, big_ = payload
+            return (_g, ids_, pos_, place(pos_), place(big_))
+
+        def _matrix_host(payload, _g=gens):
+            # host-compute fallback: the numpy halves stand in for the
+            # device ones (bm dispatches numpy operands to the host
+            # kernels; on a device backend they transfer implicitly —
+            # still bounded by this query, never by a promotion queue)
+            ids_, pos_, big_ = payload
+            return (_g, ids_, pos_, pos_, big_)
+
         self._evict_and_insert(
             self._matrix_stack_cache, key, entry, entry_bytes,
-            max_entries=8, devices=_placement_devices())
+            max_entries=8, devices=_placement_devices(),
+            token=gens, host=(row_ids, shard_pos, big),
+            promote=_promote_matrix, fallback=_matrix_host)
         return entry
 
     def time_view_times(self) -> list:
@@ -899,11 +1045,19 @@ class Field:
         frags = [None if view is None else view.fragment(s) for s in shards]
         gens = (_placement_token(),) + tuple(
             _frag_gen(fr) for fr in frags)
+        self._note_access(self._row_stack_cache, key)
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if hit is not None and hit[0] == gens and _live(hit[1]):
                 self._touch(self._row_stack_cache, key)
+                self._note_tier("hbm")
                 return hit[1]
+        tiered = self._tier_consult(
+            self._row_stack_cache, key, gens,
+            lambda h: h[0] == gens and _live(h[1]))
+        if tiered is not None:
+            return tiered[1][1] if tiered[0] == "dev" else tiered[1]
+        t_build = _time.perf_counter_ns()
         n_words = bm.n_words(SHARD_WIDTH)
         n_planes = bsi_ops.OFFSET_PLANE + depth
         # np.empty + per-plane copy-or-zero: no whole-stack memset (see
@@ -922,7 +1076,8 @@ class Field:
                     else:
                         stack[i, p] = 0
         stack[len(shards):] = 0
-        return self._place_and_cache_stack(key, gens, stack)
+        return self._place_and_cache_stack(key, gens, stack,
+                                           t0_ns=t_build)
 
     # ------------------------------------------------------------ BSI ops
 

@@ -51,6 +51,19 @@ _WAL_BULK_HDR = struct.Struct("<BQQ")  # op, n_set, n_clear
 _WAL_ROARING_HDR = struct.Struct("<BQQ")  # op, blob_len, clear-flag
 
 
+def _plane_promote(gen: int):
+    """Tier-promotion closure for one generation of a fragment's BSI
+    plane stack: host planes -> placed owner-cache entry (the
+    runtime/residency host-tier contract)."""
+
+    def promote(P: np.ndarray):
+        dev = (P if bm.host_mode()
+               else bm.chunked_device_put(P, label="fragment.planes"))
+        return (gen, dev)
+
+    return promote
+
+
 class Fragment:
     """One shard of one view of one field."""
 
@@ -1284,18 +1297,41 @@ class Fragment:
 
     def device_planes(self, depth: int):
         """BSI plane stack uint32[2 + depth, words] resident on device;
-        accounted by the process-wide residency manager."""
+        accounted by the process-wide residency manager.  Tiered: the
+        assembled host planes register as the entry's host twin, so an
+        HBM eviction demotes and a re-miss pays ONE placement instead
+        of the per-plane re-assembly — inline rather than async (this
+        runs under the fragment lock; the field-level stacks own the
+        async promotion path, and ``device_matrix``'s host half is the
+        existing generation-stamped ``_stack_cache``)."""
         import jax
 
+        from pilosa_tpu import observe as _observe
         from pilosa_tpu.runtime import residency
 
         with self._lock:
             key = ("planes", depth)
+            # tick the prefetcher's access table: plane-stack entries
+            # are demote-eligible, so without a score a hot one would
+            # be the permanent demote_coldest victim
+            _observe.note_access((id(self._device_cache), key))
             hit = self._device_cache.get(key)
             if (hit is not None and hit[0] == self._gen
                     and residency.live(hit[1])):
                 residency.manager().touch(self._device_cache, key)
                 return hit[1]
+            mgr = residency.manager()
+            ent = mgr.host_lookup(self._device_cache, key, self._gen)
+            if ent is not None:
+                # demoted-but-warm: one placement (ent.promote — the
+                # same upload-under-the-fragment-lock design as the
+                # cold path below), no plane re-assembly
+                value = ent.promote(ent.payload)
+                self._device_cache[key] = value
+                mgr.admit(self._device_cache, key, ent.nbytes,
+                          token=self._gen, host=ent.payload,
+                          promote=ent.promote)
+                return value[1]
             P = np.zeros((bsi_ops.OFFSET_PLANE + depth, self.n_words), dtype=np.uint32)
             for i in range(P.shape[0]):
                 arr = self._rows.get(i)
@@ -1307,7 +1343,9 @@ class Fragment:
                    # pilosa-lint: allow(blocking-under-lock) -- same residency design as device_matrix: per-fragment upload serialization under the owning lock
                    else bm.chunked_device_put(P, label="fragment.planes"))
             self._device_cache[key] = (self._gen, dev)
-            residency.manager().admit(self._device_cache, key, P.nbytes)
+            residency.manager().admit(
+                self._device_cache, key, P.nbytes, token=self._gen,
+                host=P, promote=_plane_promote(self._gen))
             return dev
 
     # ------------------------------------------------------------ BSI ops

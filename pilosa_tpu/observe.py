@@ -106,6 +106,61 @@ def take_last() -> "QueryRecord | None":
     return rec
 
 
+class AccessStats:
+    """Per-cache-entry access statistics for the predictive
+    prefetcher (runtime/prefetch.py): every tiered stack access —
+    HBM hit, host-tier promotion, or cold build — ticks a decayed
+    score per entry id, so 'which demoted entries is traffic about to
+    want' is answerable by rank.  Scores decay by half every
+    ``HALF_LIFE_S`` so yesterday's hot rows don't pin today's
+    prefetch bandwidth; the table is LRU-capped (a per-row cache key
+    churn must not grow it without bound).
+
+    Lock discipline: one short lock per note — the note sits on the
+    stack-accessor path (~µs against a rebuild measured in ms), not
+    on the per-dispatch hot path."""
+
+    HALF_LIFE_S = 30.0
+    MAX_ENTRIES = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # eid -> [score, last_monotonic]; insertion order = LRU
+        self._scores: dict = {}
+
+    def note(self, eid) -> None:
+        now = time.monotonic()
+        with self._lock:
+            rec = self._scores.pop(eid, None)
+            if rec is None:
+                rec = [0.0, now]
+                if len(self._scores) >= self.MAX_ENTRIES:
+                    self._scores.pop(next(iter(self._scores)))
+            score, last = rec
+            score *= 0.5 ** ((now - last) / self.HALF_LIFE_S)
+            self._scores[eid] = [score + 1.0, now]
+
+    def score(self, eid) -> float:
+        now = time.monotonic()
+        with self._lock:
+            rec = self._scores.get(eid)
+            if rec is None:
+                return 0.0
+            return rec[0] * 0.5 ** ((now - rec[1]) / self.HALF_LIFE_S)
+
+_access = AccessStats()
+
+
+def access_stats() -> AccessStats:
+    """The process-wide access-statistics table (process-wide like the
+    residency budget the prefetcher feeds)."""
+    return _access
+
+
+def note_access(eid) -> None:
+    _access.note(eid)
+
+
 def result_size(res) -> int:
     """Cheap size proxy for one query result: list length, populated
     shard-segment count for Row-shaped results (duck-typed on
@@ -134,7 +189,7 @@ class QueryRecord:
         "launches", "path", "coalesce", "result_sizes", "error", "slow",
         "admission", "outcome", "compiles", "cached", "cache_key",
         "delta_notes", "compacted", "hedged", "hedge_wins",
-        "missing_shards",
+        "missing_shards", "tier_notes",
     )
 
     def __init__(self, qid: int, index: str, pql: str,
@@ -196,6 +251,15 @@ class QueryRecord:
         self.hedged = 0
         self.hedge_wins = 0
         self.missing_shards: list[int] = []
+        # tiered-residency attribution (runtime/residency.py):
+        # (outcome, ns) per tiered stack access — outcome one of
+        # ``hbm`` (resident hit), ``promoted`` (waited for an async
+        # host->HBM promotion), ``fallback`` (served host-compute
+        # past the promotion wait), ``cold`` (assembled from fragment
+        # state).  List appends, GIL-atomic across map workers (the
+        # launches discipline); rendered as the ``tier`` dict — the
+        # stall-vs-hit split ?profile=1 and /debug/queries carry.
+        self.tier_notes: list[tuple[str, int]] = []
 
     # ------------------------------------------------------------ notes
 
@@ -235,6 +299,14 @@ class QueryRecord:
 
     def note_path(self, path: str) -> None:
         self.path = path
+
+    def note_tier(self, outcome: str, ns: int = 0) -> None:
+        """One tiered stack access: ``hbm`` | ``promoted`` |
+        ``fallback`` | ``cold``, with the wall time the access cost
+        this query (the promotion wait / rebuild — the stall side of
+        stall-vs-hit).  List append, GIL-atomic."""
+        if len(self.tier_notes) < MAX_SHARD_TIMINGS:
+            self.tier_notes.append((outcome, ns))
 
     def note_missing(self, shard: int) -> None:
         """One shard accounted unavailable (partial degradation or a
@@ -299,6 +371,22 @@ class QueryRecord:
             d["hedgeWins"] = self.hedge_wins
         if self.missing_shards:
             d["missingShards"] = sorted(self.missing_shards)
+        # tiered-residency attribution: present only when the query
+        # crossed the tier machinery (the common fully-resident record
+        # stays small).  ``stallMs`` is the time THIS query spent
+        # waiting on promotions / host fallbacks / cold assembly —
+        # the "slow because the working set exceeded HBM" answer.
+        if self.tier_notes:
+            by = Counter(o for o, _ in self.tier_notes)
+            d["tier"] = {
+                "hbm": by.get("hbm", 0),
+                "promoted": by.get("promoted", 0),
+                "fallback": by.get("fallback", 0),
+                "cold": by.get("cold", 0),
+                "stallMs": round(
+                    sum(ns for o, ns in self.tier_notes
+                        if o != "hbm") / ms, 3),
+            }
         if self.admission is not None:
             d["admission"] = {
                 "class": self.admission.get("class"),

@@ -229,7 +229,7 @@ class Transport:
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   partial: bool = False):
+                   notiers: bool = False, partial: bool = False):
         """Execute pql on the remote node restricted to `shards` with
         remote semantics (no re-translation).  Returns the result list.
         Raises TransportError if the node is unreachable.  ``nocache``
@@ -240,7 +240,9 @@ class Transport:
         ``nocontainers`` forwards ?nocontainers=1 (peers route their
         fused reads through the dense pre-container path); ``nomesh``
         forwards ?nomesh=1 (peers run their fused dispatches on the
-        pre-mesh single-device programs); ``partial``
+        pre-mesh single-device programs); ``notiers``
+        forwards ?notiers=1 (peers bypass their tiered residency:
+        inline rebuilds, drop-not-demote); ``partial``
         forwards ?partial=1 (degraded-read semantics ride sub-queries
         like the other per-request escapes)."""
         raise NotImplementedError
@@ -309,7 +311,7 @@ class LocalTransport(Transport):
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   partial: bool = False):
+                   notiers: bool = False, partial: bool = False):
         from pilosa_tpu.parallel.executor import ExecOptions
 
         if node.id in self.down or node.id not in self.handles:
@@ -322,6 +324,7 @@ class LocalTransport(Transport):
                 remote=True, shards=None if shards is None else list(shards),
                 cache=not nocache, delta=not nodelta,
                 containers=not nocontainers, mesh=not nomesh,
+                tiers=not notiers,
                 partial=partial, missing=set() if partial else None,
             ),
         )
@@ -352,7 +355,7 @@ class BoundTransport(Transport):
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   partial: bool = False):
+                   notiers: bool = False, partial: bool = False):
         self.parent._check_partition(self.src, node.id)
         extra = {}
         if nocache:
@@ -363,6 +366,8 @@ class BoundTransport(Transport):
             extra["nocontainers"] = True
         if nomesh:
             extra["nomesh"] = True
+        if notiers:
+            extra["notiers"] = True
         if partial:
             extra["partial"] = True
         if extra:

@@ -350,13 +350,16 @@ class Coalescer:
                 span.set_tag("batch", n)
                 span.set_tag("shapes", bucket.shapes_final)
                 t_launch = time.perf_counter_ns()
+                from pilosa_tpu.runtime import residency as _residency
+
                 if n == 1:
                     # single-query passthrough: the identical program
                     # the un-coalesced path would run
-                    results = [expr.evaluate(live[0].shape,
-                                             live[0].leaves,
-                                             counts=True,
-                                             mesh=live[0].mesh)]
+                    results = _residency.run_with_oom_retry(
+                        lambda: [expr.evaluate(live[0].shape,
+                                               live[0].leaves,
+                                               counts=True,
+                                               mesh=live[0].mesh)])
                 elif bucket.shapes_final == 1:
                     # same-shape fast path: the specialized fused
                     # program over stacked operands, exactly the
@@ -383,12 +386,14 @@ class Coalescer:
                         stacked = tuple(_pad_batch(s, pad)
                                         for s in stacked)
                     counts = np.asarray(
-                        expr.evaluate(shape, stacked, counts=True,
-                                      mesh=live[0].mesh,
-                                      # live occupancy, not the pow2-
-                                      # padded batch rows, feeds the
-                                      # mesh.queries counter
-                                      mesh_queries=n),
+                        _residency.run_with_oom_retry(
+                            lambda: expr.evaluate(
+                                shape, stacked, counts=True,
+                                mesh=live[0].mesh,
+                                # live occupancy, not the pow2-
+                                # padded batch rows, feeds the
+                                # mesh.queries counter
+                                mesh_queries=n)),
                         dtype=np.int64)
                     results = [counts[b] for b in range(n)]
                 else:
@@ -402,10 +407,11 @@ class Coalescer:
                     tb, lb = _tape.size_class(
                         max(len(it.tape.instrs) for it in live),
                         max(it.tape.n_leaves for it in live))
-                    results = _tape.execute(
-                        [(it.tape, it.leaves) for it in live],
-                        counts=True, tape_len=tb, slots=lb,
-                        mesh=live[0].mesh)
+                    results = _residency.run_with_oom_retry(
+                        lambda: _tape.execute(
+                            [(it.tape, it.leaves) for it in live],
+                            counts=True, tape_len=tb, slots=lb,
+                            mesh=live[0].mesh))
                 bucket.launch_ns = time.perf_counter_ns() - t_launch
                 self.stats.timing("coalescer.launch_ns",
                                   bucket.launch_ns)

@@ -51,6 +51,7 @@ from pilosa_tpu.parallel.results import (
     sort_pairs,
 )
 from pilosa_tpu.pql import Call, Query, parse
+from pilosa_tpu.runtime import residency as _residency
 from pilosa_tpu.runtime import resultcache
 from pilosa_tpu.serve import deadline as _deadline
 from pilosa_tpu.serve.deadline import DeadlineExceededError
@@ -100,6 +101,12 @@ class ExecOptions:
     # (parallel/meshexec.py stays out of the launch); results are
     # byte-identical either way
     mesh: bool = True
+    # per-request opt-out of tiered residency (the HTTP layer's
+    # ?notiers=1 — symmetric with the other escapes): host-tier
+    # lookups miss, evictions drop instead of demoting, and misses
+    # rebuild inline (runtime/residency.py pre-tier behavior); results
+    # are byte-identical either way
+    tiers: bool = True
     # end-to-end deadline (serve/deadline.Deadline), propagated from
     # the X-Pilosa-Deadline header; checked at translate, before each
     # per-shard map, and before reduce so expired work never reaches
@@ -310,6 +317,7 @@ class Executor:
         t0 = _time.perf_counter()
         try:
             with _observe.attach(rec), \
+                    _residency.no_tiers(not opt.tiers), \
                     tracing.start_span("executor.Execute") as span:
                 span.set_tag("index", index_name)
                 if rec is not None:
@@ -483,27 +491,33 @@ class Executor:
 
     def _local_map(self, fn, shards, deadline=None):
         rec = _observe.current()
-        if rec is not None or deadline is not None or _fi.armed:
+        notiers = _residency.tiers_off_scope()
+        if rec is not None or deadline is not None or _fi.armed \
+                or notiers:
             # re-attach the flight record on the pool workers so their
             # kernel launches tick it, time each shard's evaluation,
             # and bail before a shard whose deadline already expired —
-            # expired work must never reach device dispatch
+            # expired work must never reach device dispatch.  The
+            # ?notiers scope re-installs the same way the record does:
+            # worker threads must honor the caller's escape.
             inner = fn
 
-            def fn(shard, _inner=inner, _rec=rec, _dl=deadline):
+            def fn(shard, _inner=inner, _rec=rec, _dl=deadline,
+                   _nt=notiers):
                 if _fi.armed:
                     # failpoint: the production per-shard map
                     _fi.hit("executor.map_shard")
                 if _dl is not None and _dl.expired():
                     raise DeadlineExceededError(
                         f"deadline expired before map of shard {shard}")
-                if _rec is None:
-                    return _inner(shard)
-                t0 = _time.perf_counter_ns()
-                with _observe.attach(_rec):
-                    out = _inner(shard)
-                _rec.note_shard(shard, _time.perf_counter_ns() - t0)
-                return out
+                with _residency.no_tiers(_nt):
+                    if _rec is None:
+                        return _inner(shard)
+                    t0 = _time.perf_counter_ns()
+                    with _observe.attach(_rec):
+                        out = _inner(shard)
+                    _rec.note_shard(shard, _time.perf_counter_ns() - t0)
+                    return out
 
         if len(shards) <= 1:
             return [fn(s) for s in shards]
@@ -575,6 +589,10 @@ class Executor:
                 # forward ?nomesh=1: peers run their own fused
                 # dispatches on the pre-mesh single-device programs
                 extra["nomesh"] = True
+            if opt is not None and not opt.tiers:
+                # forward ?notiers=1: peers bypass their own tiered
+                # residency too (inline rebuilds, drop-not-demote)
+                extra["notiers"] = True
             if opt is not None and opt.partial:
                 # forward ?partial=1: degraded-read semantics ride
                 # sub-queries like the other per-request escapes
@@ -1300,17 +1318,22 @@ class Executor:
             m = self._query_mesh(opt)
             cplan = _containers.plan_fused(self, idx, call, g, opt,
                                            counts=False)
-            if cplan is not None:
-                partials = cplan.row_words(mesh=m)
-            else:
+
+            def _dispatch():
+                # the fused Row launch (dense or container-gather),
+                # under the shared RESOURCE_EXHAUSTED evict-and-retry
+                if cplan is not None:
+                    return cplan.row_words(mesh=m)
                 # copies: a view would pin the whole stack in memory
                 # for as long as one sparse segment lives
                 stack = np.asarray(self._fused_eval(idx, call, g,
                                                     use_delta=opt.delta,
                                                     mesh=m))
-                partials = [(s, stack[i].copy())
-                            for i, s in enumerate(group)
-                            if stack[i].any()]
+                return [(s, stack[i].copy())
+                        for i, s in enumerate(group)
+                        if stack[i].any()]
+
+            partials = _residency.run_with_oom_retry(_dispatch)
             if probe is not None and self._rc_fill_ok(opt):
                 value = [(s, w.copy()) for s, w in partials]
                 rc.put(key, gens, value,
@@ -1558,23 +1581,14 @@ class Executor:
                     np.asarray(counts, dtype=np.int64)[:len(group)]]
 
         def compute_counts(group):
-            # device-dispatch resilience (chaos round, narrow to this
-            # fused Count path): a backend RESOURCE_EXHAUSTED evicts
-            # every residency-tracked device cache entry and retries
-            # ONCE — cached stacks rebuild from host state, so the
-            # retry runs against a drained HBM instead of failing the
-            # query on transient allocation pressure
-            try:
-                return compute_counts_once(group)
-            except Exception as e:  # noqa: BLE001 — classify below
-                if "RESOURCE_EXHAUSTED" not in str(e):
-                    raise
-                from pilosa_tpu import devobs as _devobs
-                from pilosa_tpu.runtime import residency as _residency
-
-                _devobs.observer().note_oom_retry()
-                _residency.manager().evict_all()
-                return compute_counts_once(group)
+            # device-dispatch resilience: a backend RESOURCE_EXHAUSTED
+            # evicts every residency-tracked device cache entry
+            # (demoting — host twins survive), shrinks the HBM budget
+            # so the tier demotes harder, and retries ONCE — the
+            # shared run_with_oom_retry wrapper, applied to every
+            # fused dispatch site (Count/Row/TopN/coalescer/mesh)
+            return _residency.run_with_oom_retry(
+                lambda: compute_counts_once(group))
 
         def batch_fn(group):
             # the clustered local-group path: per-shard counts for the
@@ -1852,18 +1866,30 @@ class Executor:
                         totals[r] = totals.get(r, 0) + c
                 return totals
 
-        gens, row_ids, shard_pos, pos_dev, mat_dev = \
-            f.device_matrix_stack(shards)
-        if mat_dev is None:
+        def _scan():
+            # the fused TopN matrix scan, under the shared
+            # RESOURCE_EXHAUSTED evict-and-retry.  The matrix stack is
+            # fetched INSIDE the retry scope: on an OOM, evict_all()
+            # drops its cache entry, so the retry restages the query's
+            # own largest operand post-eviction instead of
+            # re-dispatching against the pinned pre-OOM buffers.
+            stack = f.device_matrix_stack(shards)
+            mat_dev, pos_dev = stack[4], stack[3]
+            if mat_dev is None:
+                return stack, None
+            if filter_call is not None:
+                filt = self._fused_eval(
+                    idx, filter_call, shards,
+                    use_delta=opt is None or opt.delta,
+                    mesh=self._query_mesh(opt))
+                return stack, bm.row_counts_gathered(mat_dev, filt,
+                                                     pos_dev)
+            return stack, bm.row_counts(mat_dev)
+
+        (gens, row_ids, shard_pos, _pos_dev, _mat_dev), counts = \
+            _residency.run_with_oom_retry(_scan)
+        if counts is None:
             return totals
-        if filter_call is not None:
-            filt = self._fused_eval(
-                idx, filter_call, shards,
-                use_delta=opt is None or opt.delta,
-                mesh=self._query_mesh(opt))
-            counts = bm.row_counts_gathered(mat_dev, filt, pos_dev)
-        else:
-            counts = bm.row_counts(mat_dev)
         n_rows = len(row_ids)
         counts = np.asarray(counts, dtype=np.int64)[:n_rows]
         if filter_call is not None:

@@ -317,6 +317,33 @@ class AdmissionController:
         raise ShedError(klass, reason, 503, self._retry_after(g),
                         wait_ns=wait_ns)
 
+    def try_acquire(self, klass: str) -> Ticket:
+        """Non-blocking admit: a free slot (with no queued waiters
+        ahead) or an immediate ShedError — never a queue wait.  The
+        gate for opportunistic background work (tiered-residency
+        promotions, prefetch): under saturation such work must SHED,
+        not line up behind user traffic it exists to serve."""
+        g = self._gates.get(klass)
+        if g is None:
+            raise ValueError(f"unknown admission class: {klass!r}")
+        if not self.enabled:
+            return Ticket(None, klass, 0)
+        with self._lock:
+            if (klass == "internal" and self._query_pressure_locked()) \
+                    or g.in_flight >= g.cap or g.waiters:
+                g.shed += 1
+                err = ShedError(klass, "yield-to-query", 503,
+                                self._retry_after(g))
+            else:
+                g.in_flight += 1
+                g.admitted += 1
+                err = None
+        if err is not None:
+            self._emit_shed(klass, err.reason)
+            raise err
+        self._emit_admitted(klass, 0)
+        return Ticket(self, klass, 0)
+
     def _release(self, klass: str, t_admit: float) -> None:
         with self._lock:
             g = self._gates[klass]

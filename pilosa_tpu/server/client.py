@@ -290,7 +290,7 @@ class InternalClient:
                    shards: list[int] | None = None, remote: bool = True,
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   partial: bool = False):
+                   notiers: bool = False, partial: bool = False):
         """POST /index/{i}/query with Remote semantics over the
         protobuf wire — node-to-node RPC speaks protobuf like the
         reference's InternalClient (http/client.go:268 QueryNode;
@@ -303,7 +303,8 @@ class InternalClient:
         ?nocontainers=1 (the peer routes its fused reads through the
         dense pre-container path); ``nomesh`` rides as ?nomesh=1 (the
         peer runs its fused dispatches on the pre-mesh single-device
-        programs)."""
+        programs); ``notiers`` rides as ?notiers=1 (the peer bypasses
+        its tiered residency: inline rebuilds, drop-not-demote)."""
         from pilosa_tpu import proto
 
         body = proto.encode(proto.QUERY_REQUEST, {
@@ -316,6 +317,7 @@ class InternalClient:
                                  ("nodelta=1", nodelta),
                                  ("nocontainers=1", nocontainers),
                                  ("nomesh=1", nomesh),
+                                 ("notiers=1", notiers),
                                  ("partial=1", partial)) if on]
         if flags:
             path += "?" + "&".join(flags)
@@ -451,12 +453,12 @@ class HTTPTransport(Transport):
     def query_node(self, node: Node, index: str, pql: str, shards,
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   partial: bool = False):
+                   notiers: bool = False, partial: bool = False):
         # the protobuf client already returns decoded result objects
         return self.client.query_node(node.uri, index, pql, shards,
                                       nocache=nocache, nodelta=nodelta,
                                       nocontainers=nocontainers,
-                                      nomesh=nomesh,
+                                      nomesh=nomesh, notiers=notiers,
                                       partial=partial)
 
     def send_message(self, node: Node, message: dict) -> dict:

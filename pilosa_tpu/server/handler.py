@@ -705,6 +705,11 @@ class Handler:
                 # single-device programs (debugging escape; results
                 # are byte-identical either way)
                 mesh=params.get("nomesh") not in ("1", "true"),
+                # ?notiers=1: bypass tiered residency (host-tier
+                # lookups miss, evictions drop, misses rebuild
+                # inline — the pre-tier behavior; results are
+                # byte-identical either way)
+                tiers=params.get("notiers") not in ("1", "true"),
                 partial=partial,
                 partial_meta=partial_meta,
             )
@@ -1264,8 +1269,16 @@ class Handler:
              for idx in self.api.holder.indexes.values()] or [0])
         out = meshexec.debug(n_shards=widest or None)
         rs = residency.manager().stats()
+        tiers = rs.get("tiers") or {}
+        host = tiers.get("host") or {}
         out["residency"] = {"total": rs["total"],
-                            "perDevice": rs["per_device"]}
+                            "perDevice": rs["per_device"],
+                            # per-device HBM is what one chip holds;
+                            # the host tier backs ALL of them (demoted
+                            # entries re-place under the shard plan in
+                            # force at promotion time)
+                            "hostTierBytes": host.get("bytes", 0),
+                            "demotions": tiers.get("demotions", 0)}
         self._json(req, out)
 
     @route("GET", "/debug/devices")

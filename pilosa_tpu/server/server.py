@@ -84,6 +84,14 @@ class Server:
         containers_threshold: float | None = None,
         mesh_enabled=None,
         mesh_axis_size: int | None = None,
+        residency_host_budget_bytes: int | None = None,
+        residency_disk_path: str | None = None,
+        residency_disk_budget_bytes: int | None = None,
+        residency_promote_workers: int | None = None,
+        residency_promote_queue: int | None = None,
+        residency_promote_wait_ms: float | None = None,
+        residency_prefetch: bool | None = None,
+        residency_prefetch_interval: float | None = None,
         breaker_threshold: int = 5,
         breaker_cooldown: float = 5.0,
         hedge_min_samples: int = 8,
@@ -230,6 +238,23 @@ class Server:
         self._mesh_retained = True
         _meshexec.configure(enabled=mesh_enabled,
                             axis_size=mesh_axis_size)
+        # tiered residency ([residency] config): process-wide like
+        # [mesh] — the first server's retain() captures the pre-server
+        # baseline, the LAST release() (in close) restores it and
+        # stops the shared promotion workers
+        from pilosa_tpu.runtime import residency as _residency
+
+        _residency.retain()
+        self._residency_retained = True
+        _residency.configure(
+            host_budget_bytes=residency_host_budget_bytes,
+            disk_path=residency_disk_path,
+            disk_budget_bytes=residency_disk_budget_bytes,
+            promote_workers=residency_promote_workers,
+            promote_queue=residency_promote_queue,
+            promote_wait_ms=residency_promote_wait_ms,
+            prefetch=residency_prefetch,
+            prefetch_interval=residency_prefetch_interval)
         if self._ingest_enabled:
             # reference taken at CONSTRUCTION, where the configure
             # above landed — not at open() — so a sibling's close
@@ -277,6 +302,14 @@ class Server:
 
         _c = _compactor.compactor()
         _c.admission = self.admission
+        # tiered-residency promotion pool: each promotion admits under
+        # the internal class, so query saturation sheds promotions
+        # (the waiting query takes the host-compute fallback) exactly
+        # like it pauses compaction
+        _residency.promoter().admission = self.admission
+        from pilosa_tpu.runtime.prefetch import Prefetcher
+
+        self.prefetcher = Prefetcher()
         self.handler = Handler(self.api, host=host, port=port,
                                stats=self.stats, tracer=tracer,
                                tls_cert=tls_cert, tls_key=tls_key,
@@ -316,6 +349,14 @@ class Server:
 
             _meshexec.retain()
             self._mesh_retained = True
+        if not self._residency_retained:
+            # reopened after close(): take the [residency] reference
+            # back and re-wire the promotion pool's admission gate
+            from pilosa_tpu.runtime import residency as _residency
+
+            _residency.retain()
+            self._residency_retained = True
+            _residency.promoter().admission = self.admission
         if self._ingest_enabled and not self._ingest_retained:
             # reopened after close(): take the reference back (the
             # normal first open already holds the construction-time
@@ -344,6 +385,7 @@ class Server:
             t.start()
         self.runtime_monitor.start()
         self.device_sampler.start()
+        self.prefetcher.start()
         if self._ragged_prewarm:
             # lower the ragged bucket interpreter programs off the
             # serving path ([ragged] prewarm): best-effort, background,
@@ -446,6 +488,7 @@ class Server:
         self._stop.set()
         self.runtime_monitor.stop()
         self.device_sampler.stop()
+        self.prefetcher.stop()
         # the scan thread and [ingest] config are shared across every
         # in-process server: drop our reference, and only when we were
         # the LAST ingest-enabled server stop the thread and restore
@@ -477,6 +520,11 @@ class Server:
         if self._mesh_retained:
             self._mesh_retained = False
             _meshexec.release()
+        from pilosa_tpu.runtime import residency as _residency2
+
+        if self._residency_retained:
+            self._residency_retained = False
+            _residency2.release()
         if self._faultinject_armed:
             # config-armed failpoints are process-wide: the arming
             # server disarms everything on close so library users

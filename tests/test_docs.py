@@ -64,7 +64,7 @@ def test_every_config_key_documented():
     sections = ("cluster", "anti_entropy", "metric", "tracing",
                 "profile", "tls", "coalescer", "ragged", "observe",
                 "admission", "cache", "ingest", "containers", "mesh",
-                "faultinject")
+                "residency", "faultinject")
     for f in fields(cfgmod.Config):
         if f.name in sections:
             section = f.name

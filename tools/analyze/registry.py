@@ -88,6 +88,30 @@ CLASS_LOCKS: dict[tuple, ClassLockRule] = {
         # (a duplicate compile is wasted work, never a wrong entry —
         # see the inline comment at its definition)
     ),
+    ("runtime/residency.py", "ResidencyManager"): ClassLockRule(
+        lock="_lock",
+        attrs=frozenset({
+            "_entries", "total", "_per_device", "_by_kind",
+            "evictions", "admits", "high_water",
+            "_host", "_host_bytes", "_disk", "_disk_bytes",
+            "_spill_seq", "demotions", "tier_hits", "tier_misses",
+            "tier_spills", "tier_spill_drops", "disk_hits",
+            "fallbacks", "oom_budget_shrinks", "_prefetched",
+            "prefetch_useful",
+        }),
+        # ``budget`` is deliberately UNREGISTERED: written only under
+        # the lock (note_oom_feedback), read lock-free by the entry
+        # caps and stats — the monotone-ish operator-knob discipline
+        # (a stale read admits one borderline entry, never corrupts)
+    ),
+    ("runtime/residency.py", "Promoter"): ClassLockRule(
+        lock="_lock",
+        attrs=frozenset({
+            "_queue", "_flights", "_workers", "_epoch", "promotions",
+            "failures", "sheds", "prefetch_issued",
+            "prefetch_completed", "prefetch_shed",
+        }),
+    ),
     ("parallel/cluster.py", "CircuitBreaker"): ClassLockRule(
         lock="_lock",
         attrs=frozenset({"_state", "_failures", "_opened_t",
@@ -160,6 +184,12 @@ MODULE_LOCKS: dict[str, tuple] = {
         ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
         ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
         ModuleGlobalRule("_mesh_cache", "_cfg_lock", "w"),
+    ),
+    "runtime/residency.py": (
+        ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
+        ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_global", "_global_lock", "w"),
     ),
     "faultinject.py": (
         # the failpoint registry: every read AND write of the armed
@@ -306,6 +336,19 @@ CONFIG_GUARDS = (
         pair=("disarm",),
         owner_suffixes=("faultinject.py",),
         what="the process-wide failpoint registry",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("residency.configure",
+                          "_residency.configure"),
+        pair=("retain", "release"),
+        owner_suffixes=("runtime/residency.py",),
+        what="the process-wide [residency] runtime config",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("residency.retain", "_residency.retain"),
+        pair=("release",),
+        owner_suffixes=("runtime/residency.py",),
+        what="the refcounted [residency] baseline",
     ),
     ConfigGuardRule(
         mutator_suffixes=("meshexec.configure", "_meshexec.configure"),

@@ -271,6 +271,12 @@ CONTAINER_FAMILIES = _mf.live_prefixes("container")
 #: rendered as mesh_*.
 MESH_FAMILIES = _mf.live_prefixes("mesh")
 
+#: Tiered-residency prefetch families (runtime/prefetch.py via
+#: devobs.publish_gauges), rendered as prefetch_*; the
+#: residency_tier_* prefixes ride the "device" group with the rest of
+#: the residency family.
+TIER_FAMILIES = _mf.live_prefixes("tier")
+
 #: Everything the ``--families`` CLI mode requires of a live server.
 ALL_FAMILIES = _mf.live_prefixes()
 

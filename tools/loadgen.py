@@ -546,6 +546,218 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     }
 
 
+def zipf_rows(n_rows: int, count: int, alpha: float = 1.1,
+              seed: int = 11) -> list[int]:
+    """``count`` row ids drawn zipfian (exponent ``alpha``) over
+    ``[0, n_rows)`` — the skewed access pattern the tiered-residency
+    prefetcher exists for: a hot head that should stay HBM-resident
+    and a long tail that lives in the host tier.  Deterministic per
+    seed so repeat runs issue identical traffic."""
+    rng = random.Random(seed)
+    weights = [1.0 / (r + 1) ** alpha for r in range(n_rows)]
+    return rng.choices(range(n_rows), weights=weights, k=count)
+
+
+def _residency_budget(host: str) -> int | None:
+    """The server's HBM residency budget (bytes) off /debug/devices."""
+    try:
+        with urllib.request.urlopen(f"{host}/debug/devices",
+                                    timeout=10) as resp:
+            d = json.loads(resp.read())
+        return int(d["residency"]["budget"])
+    except Exception:
+        return None
+
+
+def _residency_usage(host: str) -> int | None:
+    try:
+        with urllib.request.urlopen(f"{host}/debug/devices",
+                                    timeout=10) as resp:
+            d = json.loads(resp.read())
+        return int(d["residency"]["total"])
+    except Exception:
+        return None
+
+
+#: /debug/vars counters the working-set report deltas over the run.
+_TIER_VARS = ("residency.tier.hits", "residency.tier.misses",
+              "residency.tier.demotions", "residency.tier.promotions",
+              "residency.tier.fallbacks", "residency.evictions",
+              "prefetch.issued", "prefetch.completed",
+              "prefetch.useful")
+
+
+def run_working_set(host: str, index: str, factor: float,
+                    qps: float = 50.0, seconds: float = 5.0,
+                    field: str = "ws", shards: int = 4,
+                    alpha: float = 1.1, pool: int = 16,
+                    timeout: float = 10.0,
+                    deadline_s: float | None = None) -> dict:
+    """The working-set-over-HBM scenario (``--working-set-factor N``):
+    size a row population at N× the server's residency budget, drive a
+    zipfian read mix over it, and report the tier hit/stall split with
+    per-tier read latencies.
+
+    Setup is self-contained: one probe row is imported and queried
+    (``nocache=1&nocontainers=1`` — the dense fused path, whose
+    per-row device stack is the tier's unit) to measure the per-row
+    resident bytes off /debug/devices, then enough rows are imported
+    (one bit per shard each — row COUNT, not fill, is what multiplies
+    resident stacks) that ``rows x row_bytes >= factor x budget``.
+    Every measured request carries ``profile=1`` and buckets by the
+    flight record's tier outcome: ``warm`` (every stack access hit
+    HBM), ``promoted``, ``fallback``, ``cold``.  The report adds the
+    server's ``residency_tier_*``/``prefetch_*`` counter deltas over
+    the run window."""
+    budget = _residency_budget(host)
+    if budget is None:
+        raise RuntimeError(f"no /debug/devices at {host}")
+    # the SERVER's shard width, not an assumed one: against a
+    # PILOSA_TPU_SHARD_WIDTH_EXP build the hardcoded 2^20 would land
+    # every "shard" of a row inside shard 0 and the probe would size
+    # the working set against the wrong stack footprint
+    try:
+        with urllib.request.urlopen(f"{host}/info", timeout=10) as r:
+            shard_width = int(json.loads(r.read())["shardWidth"])
+    except Exception:
+        shard_width = 1 << 20
+
+    def _import_rows(lo: int, hi: int) -> None:
+        # one bit per shard per row, batched — enough to materialize
+        # the row in every shard so its dense stack spans all of them
+        rows_l, cols_l = [], []
+        for r in range(lo, hi):
+            for s in range(shards):
+                rows_l.append(r)
+                cols_l.append(s * shard_width + (r % 1024))
+        body = json.dumps({"rowIDs": rows_l,
+                           "columnIDs": cols_l}).encode()
+        req = urllib.request.Request(
+            f"{host}/index/{index}/field/{field}/import", data=body,
+            method="POST")
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read()
+
+    def _count(row: int, profile: bool = False) -> dict:
+        params = "nocache=1&nocontainers=1"
+        if profile:
+            params += "&profile=1"
+        req = urllib.request.Request(
+            f"{host}/index/{index}/query?{params}",
+            data=json.dumps(
+                {"query": f"Count(Row({field}={row}))"}).encode(),
+            method="POST")
+        req.add_header("Content-Type", "application/json")
+        if deadline_s is not None:
+            req.add_header("X-Pilosa-Deadline", f"{deadline_s:.3f}")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+
+    # probe: one row's resident stack bytes (usage delta of its first
+    # cold staging)
+    _import_rows(0, 1)
+    u0 = _residency_usage(host)
+    _count(0)
+    u1 = _residency_usage(host)
+    if u0 is None or u1 is None or u1 - u0 < 1024:
+        # probe measured nothing (debug surface unreachable mid-probe,
+        # or the stack was refused as uncacheable): abort loudly — a
+        # row_bytes floor of 1 would size n_rows at ~factor x budget
+        # ROWS and hang the client building import payloads
+        raise RuntimeError(
+            f"working-set probe measured no resident stack bytes "
+            f"(usage {u0} -> {u1}); cannot size the working set")
+    row_bytes = u1 - u0
+    n_rows = min(1 << 20, max(8, int(factor * budget / row_bytes) + 1))
+    _import_rows(1, n_rows)
+
+    rows = zipf_rows(n_rows, int(qps * seconds), alpha=alpha)
+    vars0 = {n: _vars_counter(host, n) for n in _TIER_VARS}
+    stats = _Stats()
+    import queue as _queue
+
+    jobs: _queue.Queue = _queue.Queue()
+
+    def worker():
+        while True:
+            item = jobs.get()
+            if item is None:
+                return
+            due, row = item
+            delay = due - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            t0 = time.perf_counter()
+            try:
+                resp = _count(row, profile=True)
+            except urllib.error.HTTPError as e:
+                outcome = ("shed" if e.code in (429, 503) else "error")
+                stats.note(outcome, time.perf_counter() - t0, False)
+                continue
+            except Exception:
+                stats.note("error", time.perf_counter() - t0, False)
+                continue
+            tier = (resp.get("profile") or {}).get("tier") or {}
+            if tier.get("fallback"):
+                bucket = "fallback"
+            elif tier.get("cold"):
+                bucket = "cold"
+            elif tier.get("promoted"):
+                bucket = "promoted"
+            else:
+                bucket = "warm"
+            stats.note("ok", time.perf_counter() - t0, False,
+                       bucket=bucket)
+
+    workers = [threading.Thread(target=worker, daemon=True)
+               for _ in range(pool)]
+    for w in workers:
+        w.start()
+    start = time.perf_counter()
+    for i, row in enumerate(rows):
+        jobs.put((start + i / qps, row))
+    for _ in workers:
+        jobs.put(None)
+    for w in workers:
+        w.join(seconds + len(rows) * timeout)
+    elapsed = time.perf_counter() - start
+    vars1 = {n: _vars_counter(host, n) for n in _TIER_VARS}
+    ok_total = stats.ok
+    stall = sum(stats.bucket_outcomes.get(b, {}).get("ok", 0)
+                for b in ("promoted", "fallback", "cold"))
+    return {
+        "factor": factor,
+        "budget_bytes": budget,
+        "row_bytes": row_bytes,
+        "rows": n_rows,
+        "working_set_bytes": n_rows * row_bytes,
+        "sent": stats.sent,
+        "ok": ok_total,
+        "shed": stats.shed,
+        "errors": stats.errors,
+        "seconds": round(elapsed, 3),
+        # the headline: what fraction of completed reads paid ANY
+        # non-HBM stack access (promotion wait / fallback / rebuild)
+        "stall_rate": round(stall / ok_total, 4) if ok_total else None,
+        "tiers": {
+            b: {
+                "ok": len(lats),
+                "p50_ms": round(_percentile(sorted(lats), 0.50) * 1e3,
+                                2),
+                "p99_ms": round(_percentile(sorted(lats), 0.99) * 1e3,
+                                2),
+            }
+            for b, lats in sorted(stats.bucket_latencies.items())
+        },
+        "server": {
+            n: (None if vars1.get(n) is None
+                else round(vars1[n] - (vars0.get(n) or 0.0), 1))
+            for n in _TIER_VARS
+        },
+    }
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         description="open-loop load generator (admission control)")
@@ -592,6 +804,18 @@ def main(argv: list[str] | None = None) -> int:
                         "per-bucket p50/p99")
     p.add_argument("--sparsity-field", default="f",
                    help="field the sparsity-mix rows live in")
+    p.add_argument("--working-set-factor", type=float, default=None,
+                   help="drive a zipfian row mix over an index sized "
+                        "N x the server's HBM residency budget "
+                        "(self-importing; see run_working_set) and "
+                        "report the tier hit/stall split with "
+                        "per-tier read p50/p99")
+    p.add_argument("--working-set-field", default="ws",
+                   help="field the working-set rows are imported into")
+    p.add_argument("--working-set-shards", type=int, default=4,
+                   help="shards each working-set row spans")
+    p.add_argument("--working-set-alpha", type=float, default=1.1,
+                   help="zipf exponent of the working-set row mix")
     p.add_argument("--chaos", default=None,
                    help="failpoint spec armed/disarmed on a schedule "
                         "mid-run via POST /debug/failpoints (e.g. "
@@ -616,6 +840,19 @@ def main(argv: list[str] | None = None) -> int:
     if args.deadline_ms:
         lo, _, hi = args.deadline_ms.partition(",")
         deadline_s = (float(lo) / 1e3, float(hi or lo) / 1e3)
+    if args.working_set_factor is not None:
+        dl = None
+        if deadline_s is not None:
+            dl = deadline_s[1]
+        report = run_working_set(
+            args.host.rstrip("/"), args.index,
+            args.working_set_factor, qps=args.qps,
+            seconds=args.seconds, field=args.working_set_field,
+            shards=args.working_set_shards,
+            alpha=args.working_set_alpha, timeout=args.timeout,
+            deadline_s=dl)
+        print(json.dumps(report, indent=2))
+        return 0
     chaos = None
     if args.chaos:
         hosts = [args.host.rstrip("/")]
