@@ -149,6 +149,20 @@ def main(argv: list[str] | None = None) -> int:
                     help="failpoint spec armed at open ([faultinject] "
                          "armed; e.g. "
                          "'client.request.send=error(transport)*3')")
+    ps.add_argument("--write-policy", choices=("all", "available"),
+                    help="replica write policy ([replication] "
+                         "write-policy): 'all' fails the write when "
+                         "any owner is unreachable (default); "
+                         "'available' commits on the reachable owners "
+                         "and hints the rest for replay")
+    ps.add_argument("--hint-max-bytes", type=int,
+                    help="total bytes of queued hinted-handoff writes "
+                         "([replication] hint-max-bytes; 0 disables "
+                         "the hint queue)")
+    ps.add_argument("--anti-entropy-round-budget", type=float,
+                    help="seconds per anti-entropy slice before the "
+                         "walk parks its cursor ([anti-entropy] "
+                         "round-budget; 0 = whole holder per round)")
     ps.add_argument("--verbose", action="store_true")
 
     pi = sub.add_parser("import", help="bulk-import CSV bits")
@@ -273,6 +287,12 @@ def cmd_server(args) -> int:
             setattr(cfg.cluster, key, v)
     if args.faultinject_armed is not None:
         cfg.faultinject.armed = args.faultinject_armed
+    if args.write_policy is not None:
+        cfg.replication.write_policy = args.write_policy
+    if args.hint_max_bytes is not None:
+        cfg.replication.hint_max_bytes = args.hint_max_bytes
+    if args.anti_entropy_round_budget is not None:
+        cfg.anti_entropy.round_budget = args.anti_entropy_round_budget
     if args.no_ingest_delta:
         cfg.ingest.delta_enabled = False
     for key in ("delta_budget_bytes", "compact_threshold_bits",
@@ -391,6 +411,13 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         hedge_min_ms=cfg.cluster.hedge_min_ms,
         hedge_max_fraction=cfg.cluster.hedge_max_fraction,
         faultinject_armed=cfg.faultinject.armed,
+        write_policy=cfg.replication.write_policy,
+        hint_max_bytes=cfg.replication.hint_max_bytes,
+        hint_max_age=cfg.replication.hint_max_age,
+        hint_replay_interval=cfg.replication.replay_interval,
+        anti_entropy_jitter=cfg.anti_entropy.jitter,
+        anti_entropy_round_budget=cfg.anti_entropy.round_budget,
+        anti_entropy_peer_timeout=cfg.anti_entropy.peer_timeout,
         logger=log,
         stats=stats,
     )

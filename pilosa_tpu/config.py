@@ -66,9 +66,42 @@ class FaultinjectConfig:
 
 @dataclass
 class AntiEntropyConfig:
-    """[anti-entropy] (server/config.go:118)."""
+    """[anti-entropy] (server/config.go:118), grown into the
+    self-healing round's knobs (parallel/syncer.py).  ``jitter`` is
+    the fraction of ``interval`` each wait is randomized by (so a
+    fleet restarted together does not run every AE sweep in lockstep);
+    ``round-budget`` (seconds, 0 = unbounded) time-slices each sweep —
+    a slice stops at the budget and the next one resumes from the
+    persisted (index, field, view, shard) cursor, so a huge holder
+    never monopolizes the internal admission class;
+    ``peer-timeout`` bounds every peer exchange (block checksums,
+    block data, diff pushes, attribute blocks) so one hung peer costs
+    at most that, never a stalled round."""
 
     interval: float = 600.0  # seconds (reference default 10m)
+    jitter: float = 0.1  # fraction of interval; 0 disables
+    round_budget: float = 0.0  # seconds per slice; 0 = whole holder
+    peer_timeout: float = 2.0  # seconds per peer exchange
+
+
+@dataclass
+class ReplicationConfig:
+    """[replication] — degraded-write semantics + hinted handoff
+    (parallel/hints.py; no reference analog — Pilosa fails the write
+    when any owner replica is unreachable).  ``write-policy = "all"``
+    (the default) keeps that all-owners guarantee byte-identical;
+    ``"available"`` commits the write on the reachable owners and
+    queues a HINT per missed delivery, replayed by a background worker
+    once the peer's breaker closes or a heartbeat proves it alive —
+    anti-entropy remains the backstop.  ``hint-max-bytes`` bounds the
+    node's total queued hints (0 disables the queue);
+    ``hint-max-age`` (seconds) drops hints too old to be the honest
+    repair; ``replay-interval`` (seconds) is the drain scan period."""
+
+    write_policy: str = "all"  # all | available
+    hint_max_bytes: int = 16 << 20
+    hint_max_age: float = 3600.0
+    replay_interval: float = 0.5
 
 
 @dataclass
@@ -327,6 +360,8 @@ class Config:
     heartbeat_interval: float = 0.0  # seconds; 0 disables the detector
     cluster: ClusterConfig = field(default_factory=ClusterConfig)
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
+    replication: ReplicationConfig = field(
+        default_factory=ReplicationConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
@@ -377,7 +412,8 @@ class Config:
     def _apply_dict(self, d: dict) -> None:
         for k, v in d.items():
             key = k.replace("-", "_")
-            if key in ("cluster", "anti_entropy", "metric", "tracing",
+            if key in ("cluster", "anti_entropy", "replication",
+                       "metric", "tracing",
                        "profile", "tls", "coalescer", "ragged",
                        "observe", "admission", "cache", "ingest",
                        "containers", "mesh", "residency",
@@ -390,6 +426,7 @@ class Config:
             elif hasattr(self, key) and not isinstance(getattr(self, key),
                                                        (ClusterConfig,
                                                         AntiEntropyConfig,
+                                                        ReplicationConfig,
                                                         MetricConfig,
                                                         TracingConfig,
                                                         ProfileConfig,
@@ -410,7 +447,8 @@ class Config:
         """PILOSA_TPU_BIND=..., PILOSA_TPU_CLUSTER_REPLICAS=2, etc.
         (the reference's PILOSA_* envs, cmd/root.go:94)."""
         for f in fields(self):
-            if f.name in ("cluster", "anti_entropy", "metric", "tracing",
+            if f.name in ("cluster", "anti_entropy", "replication",
+                          "metric", "tracing",
                           "profile", "tls", "coalescer", "ragged",
                           "observe", "admission", "cache", "ingest",
                           "containers", "mesh", "residency",
@@ -457,6 +495,15 @@ class Config:
             "",
             "[anti-entropy]",
             f"interval = {self.anti_entropy.interval}",
+            f"jitter = {self.anti_entropy.jitter}",
+            f"round-budget = {self.anti_entropy.round_budget}",
+            f"peer-timeout = {self.anti_entropy.peer_timeout}",
+            "",
+            "[replication]",
+            f'write-policy = "{self.replication.write_policy}"',
+            f"hint-max-bytes = {self.replication.hint_max_bytes}",
+            f"hint-max-age = {self.replication.hint_max_age}",
+            f"replay-interval = {self.replication.replay_interval}",
             "",
             "[metric]",
             f'service = "{self.metric.service}"',

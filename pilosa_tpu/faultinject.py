@@ -72,6 +72,10 @@ SITES: dict[str, str] = {
         "is placed back on device (error = promotion failure -> the "
         "waiting query takes the host-compute fallback; delay(ms) = a "
         "tier stall)",
+    "hint.replay":
+        "parallel.hints replay worker, before each queued hint is "
+        "delivered to its healed peer (errors leave the hint queued "
+        "for the next backoff scan; delay(ms) = a slow drain)",
 }
 
 

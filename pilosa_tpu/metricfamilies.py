@@ -133,6 +133,23 @@ FAMILIES: tuple[Family, ...] = (
            "(parallel/executor.py)",
            live_prefixes=("partial_",), group="chaos",
            doc="administration.md"),
+    Family("ae", "ae_",
+           "anti-entropy rounds: fragments walked, dirty/reconciled/"
+           "pushed blocks, classified peer failures, digest-cache "
+           "hits (parallel/syncer.py)",
+           live_prefixes=("ae_",), group="repl",
+           doc="administration.md"),
+    Family("hint", "hint_",
+           "hinted handoff for degraded writes: queued/replayed/"
+           "dropped hints plus live per-node queue depth "
+           "(parallel/hints.py)",
+           live_prefixes=("hint_",), group="repl",
+           doc="administration.md"),
+    Family("wal", "wal_",
+           "fragment WAL replay health — torn/corrupt tail records "
+           "ignored at reload (models/fragment.py)",
+           live_prefixes=("wal_",), group="repl",
+           doc="administration.md"),
     Family("http", "http_",
            "per-route request counters (server/handler.py)"),
     Family("gc", "gc_",

@@ -39,6 +39,43 @@ from pilosa_tpu.shardwidth import SHARD_WIDTH
 DEFAULT_MAX_OP_N = 10000
 HASH_BLOCK_SIZE = 100  # rows per anti-entropy block (fragment.go:80)
 
+# ---------------------------------------------------------------- wal.*
+# Module-level WAL health counters (published as gauges at scrape
+# time).  A torn/corrupt WAL tail is EXPECTED after a crash window —
+# replay stops at the tear by design — but it must be visible:
+# operators deciding whether a crash lost acknowledged records need
+# the count and the log line, not a silent `break`.
+
+from pilosa_tpu import lockcheck as _lockcheck  # noqa: E402
+
+_wal_counter_lock = _lockcheck.lock("wal-counters")
+_counters = {
+    "wal.torn_records": 0,  # torn/corrupt tails ignored at replay
+}
+
+
+def _note_torn_wal(path: str, offset: int, trailing: int) -> None:
+    import logging
+
+    with _wal_counter_lock:
+        _counters["wal.torn_records"] += 1
+    logging.getLogger("pilosa_tpu.fragment").warning(
+        "torn WAL tail in %s at byte %d (%d trailing bytes ignored; "
+        "a crash window may have lost acknowledged tail records)",
+        path, offset, trailing)
+
+
+def wal_counters() -> dict:
+    with _wal_counter_lock:
+        return dict(_counters)
+
+
+def publish_wal_gauges(stats) -> None:
+    """wal.* gauge family for /metrics and /debug/vars — published
+    unconditionally (zeros on a healthy server)."""
+    for name, v in wal_counters().items():
+        stats.gauge(name, v)
+
 _SNAP_MAGIC = b"PTSF"
 _SNAP_VERSION = 1
 _SNAP_HEADER = struct.Struct("<4sIIQ")  # magic, version, width_exp, n_rows
@@ -119,6 +156,11 @@ class Fragment:
         # invalidation machinery, and delta-landing writes (which bump
         # _delta_seq only) leave the BASE directory warm by design
         self._container_cache: dict = {}
+        # anti-entropy digest cache (parallel/syncer.py): (gen, blocks)
+        # — gen-stamped like the caches above, so an unchanged fragment
+        # costs ZERO checksum work per AE round and any mutation
+        # invalidates by bumping _gen
+        self._blocks_cache: tuple[int, list] | None = None
         from pilosa_tpu import lockcheck
 
         self._lock = lockcheck.rlock("fragment")
@@ -216,7 +258,9 @@ class Fragment:
         with open(path, "rb") as f:
             buf = f.read()
         off, n = 0, len(buf)
+        torn_at = None  # byte offset of the first torn/corrupt record
         while off + _WAL_REC.size <= n:
+            rec_start = off
             op, a, b = _WAL_REC.unpack_from(buf, off)
             off += _WAL_REC.size
             if op == _WAL_SET:
@@ -229,7 +273,9 @@ class Fragment:
                 n_set, n_clear = a, b
                 need = 8 * (n_set + n_clear)
                 if off + need > n:
-                    break  # torn bulk record: crash mid-append; ignore tail
+                    # torn bulk record: crash mid-append; ignore tail
+                    torn_at = rec_start
+                    break
                 sets = np.frombuffer(buf, dtype=np.uint64, count=n_set, offset=off)
                 off += 8 * n_set
                 clears = np.frombuffer(buf, dtype=np.uint64, count=n_clear, offset=off)
@@ -239,7 +285,9 @@ class Fragment:
             elif op == _WAL_ROARING:
                 blob_len, clear_flag = a, b
                 if off + blob_len > n:
-                    break  # torn roaring record: crash mid-append
+                    # torn roaring record: crash mid-append
+                    torn_at = rec_start
+                    break
                 blob = bytes(buf[off:off + blob_len])
                 off += blob_len
                 try:
@@ -250,9 +298,17 @@ class Fragment:
                     self._op_n += self._merge_roaring(
                         blob, clear=bool(clear_flag))
                 except Exception:  # noqa: BLE001 — corrupt blob: stop
-                    break  # like any torn/corrupt tail
+                    torn_at = rec_start  # like any torn/corrupt tail
+                    break
             else:
-                break  # corrupt/torn record; ignore tail (same as op-log replay stop)
+                # corrupt/torn record; ignore tail (same as op-log
+                # replay stop)
+                torn_at = rec_start
+                break
+        if torn_at is None and off != n:
+            torn_at = off  # partial header at the tail
+        if torn_at is not None:
+            _note_torn_wal(path, torn_at, n - torn_at)
 
     def _wal_append(self, data: bytes) -> None:
         if self._wal is not None:
@@ -1097,13 +1153,26 @@ class Fragment:
         fragment.go:80 HashBlockSize, :1762 Checksum/Blocks).  The hash is
         blake2b-64 rather than the reference's xxhash — only cross-node
         consistency matters, not format compatibility."""
+        return self.blocks_with_flag()[0]
+
+    def blocks_with_flag(self) -> tuple[list[dict], bool]:
+        """``(blocks, cache_hit)`` — the generation-keyed digest cache
+        behind :meth:`blocks`: an unchanged fragment (same ``_gen``, no
+        pending delta) serves the cached checksum list with zero hash
+        work, so a quiescent anti-entropy round re-checksums nothing.
+        Callers treat the returned list as READ-ONLY (it may be the
+        cached object)."""
         import hashlib
 
-        out = []
         with self._lock:
             # replica reconciliation hashes base rows: merge the
             # pending overlay so checksums reflect effective content
+            # (an empty overlay leaves _gen alone, keeping the cache)
             self._flush_delta_locked()
+            cached = self._blocks_cache
+            if cached is not None and cached[0] == self._gen:
+                return cached[1], True
+            out: list[dict] = []
             by_block: dict[int, list[int]] = {}
             for r in self.row_ids():
                 by_block.setdefault(r // HASH_BLOCK_SIZE, []).append(r)
@@ -1113,7 +1182,8 @@ class Fragment:
                     h.update(r.to_bytes(8, "little"))
                     h.update(self._rows[r].tobytes())
                 out.append({"id": block, "checksum": h.hexdigest()})
-        return out
+            self._blocks_cache = (self._gen, out)
+        return out, False
 
     def block_data(self, block: int) -> tuple[list[int], list[int]]:
         """(rowIDs, column offsets) parallel arrays for one block

@@ -31,6 +31,8 @@ class View:
         cache_type: str = "ranked",
         cache_size: int = 50000,
     ):
+        from pilosa_tpu import lockcheck as _lockcheck
+
         self.path = path
         self.index = index
         self.field = field
@@ -39,6 +41,9 @@ class View:
         self.cache_type = cache_type
         self.cache_size = cache_size
         self.fragments: dict[int, Fragment] = {}
+        # guards fragment CREATION/DELETION only; reads stay lock-free
+        # (GIL-atomic dict gets, the double-checked pattern)
+        self._lock = _lockcheck.lock("view")
         if path is not None:
             os.makedirs(self._frag_dir, exist_ok=True)
             self._open_fragments()
@@ -67,21 +72,37 @@ class View:
         return self.fragments.get(shard)
 
     def create_fragment_if_not_exists(self, shard: int) -> Fragment:
+        """Create-on-first-write, double-checked under the view lock:
+        two concurrent first-writers to a fresh shard must get the
+        SAME Fragment object — the unlocked check-then-act let each
+        construct its own, one won the dict, and the loser's
+        acknowledged write landed in an orphaned object (found by the
+        self-healing convergence soak: one bit silently missing on a
+        replica after concurrent degraded-write ingest; with a path,
+        both objects also held append handles to the same WAL file)."""
         frag = self.fragments.get(shard)
-        if frag is None:
-            path = None if self.path is None else self._frag_path(shard)
-            frag = Fragment(
-                path, self.index, self.field, self.name, shard, mutex=self.mutex,
-                cache_type=self.cache_type, cache_size=self.cache_size,
-            )
-            self.fragments[shard] = frag
+        if frag is not None:
+            return frag
+        with self._lock:
+            frag = self.fragments.get(shard)
+            if frag is None:
+                path = (None if self.path is None
+                        else self._frag_path(shard))
+                frag = Fragment(
+                    path, self.index, self.field, self.name, shard,
+                    mutex=self.mutex,
+                    cache_type=self.cache_type,
+                    cache_size=self.cache_size,
+                )
+                self.fragments[shard] = frag
         return frag
 
     def delete_fragment(self, shard: int) -> bool:
         """Close and delete one shard's fragment and its files — the
         post-resize cleaner path (reference holderCleaner,
         holder.go:1126 cleanHolder; view.deleteFragment)."""
-        frag = self.fragments.pop(shard, None)
+        with self._lock:
+            frag = self.fragments.pop(shard, None)
         if frag is None:
             return False
         frag.close()

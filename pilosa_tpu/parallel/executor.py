@@ -2503,35 +2503,61 @@ class Executor:
 
     def _replicate_to_shard_owners(self, idx, call: Call, shard: int, local_fn) -> bool:
         """Run a single-shard write on every owner replica synchronously
-        (reference executeSetBitField, executor.go:2137-2168).  A replica
-        that cannot be reached fails the write — the reference offers the
-        same all-owners guarantee, with anti-entropy as the backstop.
+        (reference executeSetBitField, executor.go:2137-2168).
+
+        Under the default ``[replication] write-policy = "all"`` a
+        replica that cannot be reached fails the write — the reference
+        offers the same all-owners guarantee, with anti-entropy as the
+        backstop (this path is byte-identical to the pre-hint behavior,
+        regression-pinned).  Under ``write-policy = "available"`` the
+        write commits on the reachable owners and each missed delivery
+        (breaker-open peer skipped without an RPC, transport error,
+        shed-exhausted peer) lands in the per-peer hint queue
+        (parallel/hints.py) for replay when the peer heals — at least
+        one owner must still apply, or the write fails (no durable
+        copy would exist anywhere).
 
         An owner REFUSING as non-owner means a resize just re-homed the
         shard and its view is fresher than ours: wait for the status
         broadcast, re-resolve the owner set, and retry the refused
         deliveries within the PILOSA_TPU_WRITE_RETRY_S budget."""
+        from pilosa_tpu.parallel import hints as _hints
         from pilosa_tpu.parallel.cluster import (
             converge_owner_deliveries, refusal_is_unowned)
 
+        available = (_hints.config().write_policy
+                     == _hints.WRITE_POLICY_AVAILABLE)
         applied: set[str] = set()
+        hinted: set[str] = set()
         changed = False
+
+        def hint_for(n) -> None:
+            # marked now, FLUSHED to the store only once the write has
+            # committed on some owner — a write that fails outright
+            # must not leave hints that would later replay it
+            hinted.add(n.id)
 
         def delivery_pass() -> bool:
             nonlocal changed
             refused = False
             for n in self.cluster.shard_nodes(idx.name, shard):
-                if n.id in applied:
+                if n.id in applied or n.id in hinted:
                     continue
                 if n.id == self.cluster.local_id:
                     changed |= local_fn()
                     applied.add(n.id)
                     continue
+                if available and self.cluster.breaker_open(n.id):
+                    # known-dead peer: hint without paying the RPC
+                    # timeout (the breaker's half-open trial re-admits
+                    # it; the replay worker drains the backlog)
+                    hint_for(n)
+                    continue
                 try:
                     if _fi.armed:
                         # failpoint: the production replica write
                         # delivery (errors here fail the write like a
-                        # dead owner)
+                        # dead owner — or hint it, under "available")
                         _fi.hit("replica.write")
                     res = self.cluster.transport.query_node(
                         n, idx.name, str(call), [shard]
@@ -2542,11 +2568,23 @@ class Executor:
                     if refusal_is_unowned(e):
                         refused = True
                         continue
+                    if available and isinstance(e, ShedByPeerError):
+                        # shed-exhausted: proof of life (never feeds
+                        # the breaker), but the delivery did not land
+                        self.cluster.note_peer_success(n.id)
+                        hint_for(n)
+                        continue
                     if isinstance(e, TransportError):
+                        if available:
+                            self.cluster.note_peer_failure(n.id)
+                            hint_for(n)
+                            continue
                         raise ExecutionError(
                             f"write replication to node {n.id} "
                             f"failed: {e}")
                     raise
+                if available:
+                    self.cluster.note_peer_success(n.id)
                 changed |= bool(res[0])
                 applied.add(n.id)
             return refused
@@ -2558,6 +2596,20 @@ class Executor:
                 "converge; retry")
 
         converge_owner_deliveries(delivery_pass, on_timeout)
+        if available and not applied:
+            raise ExecutionError(
+                f"no owner of shard {shard} was reachable; the write "
+                "has no durable copy (write-policy=available still "
+                "requires one live owner)")
+        if hinted:
+            store = (getattr(self.node, "hints", None)
+                     if self.node is not None else None)
+            pql = str(call)
+            for nid in sorted(hinted):
+                if store is not None:
+                    store.append(nid, idx.name, pql, shard)
+                else:
+                    _hints.bump("hint.dropped")
         return changed
 
     def _check_remote_write_owned(self, idx, shard: int,

@@ -1,0 +1,627 @@
+"""Hinted handoff: disk-backed per-peer queues of missed replica writes.
+
+Before this module a single unreachable replica failed the whole write
+(executor._replicate_to_shard_owners — the reference's all-owners
+guarantee, executor.go:2137) and the only healing was the next
+anti-entropy sweep.  With ``[replication] write-policy = "available"``
+the write commits on the reachable owners and each missed delivery is
+recorded as a HINT for the dead peer (the Dynamo/Cassandra hinted
+handoff shape): a WAL-style append record in a per-peer file that
+survives restart, bounded in bytes and age, replayed by a background
+worker once the peer's circuit breaker closes or a heartbeat proves it
+alive.  Anti-entropy (parallel/syncer.py) stays the backstop — a
+dropped or expired hint only costs the cheaper repair path, never
+correctness.
+
+Record framing reuses the fragment WAL's roaring-record shape
+(``models/fragment.py`` ``_WAL_ROARING_HDR``: one ``<BQQ`` header in
+front of a length-prefixed blob), so replay tolerates a torn tail the
+same way fragment replay does, and the append handle rides the same
+``runtime/filebudget`` budgeted-fd machinery (flush-per-write like the
+fragment WAL).
+
+Process-wide configuration mirrors ``[mesh]``/``[containers]``:
+``configure`` applies explicit values in place, the FIRST server to
+``retain()`` captures the pre-server baseline and the LAST
+``release()`` restores it (pilosa-lint P5).  The default policy is
+``"all"`` — bare library embedders keep the reference's all-owners
+write semantics byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import struct
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from pilosa_tpu import faultinject as _fi
+from pilosa_tpu import lockcheck as _lockcheck
+from pilosa_tpu.runtime import filebudget
+
+#: hint record framing — the fragment WAL's blob-record shape
+#: (op byte, blob length, timestamp ms since epoch)
+_HINT_HDR = struct.Struct("<BQQ")
+_HINT_OP = 1
+
+WRITE_POLICY_ALL = "all"
+WRITE_POLICY_AVAILABLE = "available"
+
+
+# --------------------------------------------------------------------
+# process-wide [replication] runtime config
+# --------------------------------------------------------------------
+
+
+@dataclass
+class ReplicationRuntimeConfig:
+    """The [replication] knobs in force process-wide."""
+
+    #: "all" fails the write when any owner delivery fails (the
+    #: reference semantics, regression-pinned default); "available"
+    #: commits on the reachable owners and hints the rest.
+    write_policy: str = WRITE_POLICY_ALL
+    #: total bytes of queued hints across all peers; 0 disables the
+    #: hint queue entirely (missed deliveries count hint.dropped and
+    #: anti-entropy alone heals them).
+    hint_max_bytes: int = 16 << 20
+    #: hints older than this are dropped at replay time (the peer was
+    #: gone long enough that a full AE reconcile is the honest repair).
+    hint_max_age: float = 3600.0
+    #: replay worker scan period (seconds).
+    replay_interval: float = 0.5
+
+
+_cfg = ReplicationRuntimeConfig()
+_cfg_lock = threading.Lock()
+_baseline: tuple | None = None
+_refs = 0
+
+
+def config() -> ReplicationRuntimeConfig:
+    return _cfg
+
+
+def configure(write_policy: str | None = None,
+              hint_max_bytes: int | None = None,
+              hint_max_age: float | None = None,
+              replay_interval: float | None = None) -> ReplicationRuntimeConfig:
+    """Apply explicit values in place (None leaves a knob alone)."""
+    if write_policy is not None and write_policy not in (
+            WRITE_POLICY_ALL, WRITE_POLICY_AVAILABLE):
+        raise ValueError(
+            f"unknown write-policy {write_policy!r} (all|available)")
+    with _cfg_lock:
+        if write_policy is not None:
+            _cfg.write_policy = write_policy
+        if hint_max_bytes is not None:
+            _cfg.hint_max_bytes = int(hint_max_bytes)
+        if hint_max_age is not None:
+            _cfg.hint_max_age = float(hint_max_age)
+        if replay_interval is not None:
+            _cfg.replay_interval = float(replay_interval)
+    return _cfg
+
+
+def retain() -> None:
+    """First retain captures the pre-server baseline config."""
+    global _refs, _baseline
+    with _cfg_lock:
+        if _refs == 0 and _baseline is None:
+            _baseline = (_cfg.write_policy, _cfg.hint_max_bytes,
+                         _cfg.hint_max_age, _cfg.replay_interval)
+        _refs += 1
+
+
+def release() -> None:
+    """Last release restores the baseline for library users."""
+    global _refs, _baseline
+    with _cfg_lock:
+        if _refs > 0:
+            _refs -= 1
+        if _refs == 0 and _baseline is not None:
+            (_cfg.write_policy, _cfg.hint_max_bytes,
+             _cfg.hint_max_age, _cfg.replay_interval) = _baseline
+            _baseline = None
+
+
+def reset() -> ReplicationRuntimeConfig:
+    """Test hook: defaults, no baseline, zero refs."""
+    global _cfg, _baseline, _refs
+    with _cfg_lock:
+        _cfg = ReplicationRuntimeConfig()
+        _baseline = None
+        _refs = 0
+    return _cfg
+
+
+# --------------------------------------------------------------------
+# hint.* counters (published as gauges at scrape time, like tape.*)
+# --------------------------------------------------------------------
+
+_lock = _lockcheck.lock("hints-counters")
+_counters = {
+    "hint.queued": 0,          # hints appended to a peer queue
+    "hint.replayed": 0,        # hints delivered to their peer
+    "hint.dropped": 0,         # refused at append (disabled/overflow)
+    "hint.expired": 0,         # aged out before delivery
+    "hint.discarded": 0,       # dropped at replay (unowned refusal)
+    "hint.replay_failures": 0, # replay attempts stopped by a dead peer
+    "hint.torn_records": 0,    # torn tail records ignored at reload
+}
+
+
+def bump(name: str, value: int = 1) -> None:
+    with _lock:
+        _counters[name] += value
+
+
+def counters() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
+def publish_gauges(stats, store: "HintStore | None" = None) -> None:
+    """hint.* gauge family for /metrics and /debug/vars — published
+    unconditionally (zeros on a clean server) so the family is
+    alert-able before the first degraded write."""
+    for name, v in counters().items():
+        stats.gauge(name, v)
+    depth = total_bytes = 0
+    if store is not None:
+        d = store.debug()
+        depth = d["depth"]
+        total_bytes = d["bytes"]
+    stats.gauge("hint.depth", depth)
+    stats.gauge("hint.bytes", total_bytes)
+
+
+# --------------------------------------------------------------------
+# store
+# --------------------------------------------------------------------
+
+
+class HintRecord:
+    """One missed replica delivery: the single-shard PQL write that
+    failed, replayable verbatim via transport.query_node.  The record
+    blob carries the REAL peer id — filenames are sanitized, so the
+    file name alone cannot round-trip arbitrary node names."""
+
+    __slots__ = ("ts_ms", "peer", "index", "pql", "shard", "raw")
+
+    def __init__(self, ts_ms: int, peer: str, index: str, pql: str,
+                 shard: int, raw: bytes):
+        self.ts_ms = ts_ms
+        self.peer = peer
+        self.index = index
+        self.pql = pql
+        self.shard = shard
+        self.raw = raw  # the exact appended bytes, for file rewrites
+
+    @property
+    def nbytes(self) -> int:
+        return len(self.raw)
+
+    @classmethod
+    def make(cls, peer: str, index: str, pql: str, shard: int,
+             ts_ms: int | None = None) -> "HintRecord":
+        ts = int(time.time() * 1e3) if ts_ms is None else ts_ms
+        blob = json.dumps({"p": peer, "i": index, "q": pql, "s": shard},
+                          separators=(",", ":")).encode()
+        raw = _HINT_HDR.pack(_HINT_OP, len(blob), ts) + blob
+        return cls(ts, peer, index, pql, shard, raw)
+
+
+class _PeerQueue:
+    __slots__ = ("records", "bytes", "wal", "draining")
+
+    def __init__(self):
+        self.records: deque[HintRecord] = deque()
+        self.bytes = 0
+        self.wal = None  # filebudget.BudgetedAppendFile | None
+        self.draining = False
+
+
+def _safe_name(peer_id: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", peer_id) or "_"
+
+
+class HintStore:
+    """Per-peer hint queues for ONE node, persisted under
+    ``<data_dir>/hints/<peer>.hints`` (``dir_path=None`` = memory-only,
+    for bare in-process test nodes without durability needs)."""
+
+    def __init__(self, dir_path: str | None):
+        self.dir = dir_path
+        self._lock = _lockcheck.lock("hints")
+        self._queues: dict[str, _PeerQueue] = {}
+        self._total_bytes = 0
+        if dir_path is not None:
+            os.makedirs(dir_path, exist_ok=True)
+            self._load()
+
+    # ------------------------------------------------------------ load
+
+    def _path(self, peer_id: str) -> str:
+        # sanitized stem + short digest of the REAL id: two peers whose
+        # names sanitize identically still get distinct files, and the
+        # record blob (not the filename) is the identity of record
+        import hashlib
+
+        digest = hashlib.sha1(peer_id.encode()).hexdigest()[:8]
+        return os.path.join(self.dir,
+                            f"{_safe_name(peer_id)}-{digest}.hints")
+
+    def _load(self) -> None:
+        """Reload persisted queues.  Peer identity comes from the
+        record blobs (filenames are sanitized and cannot round-trip
+        arbitrary node names); every surviving queue is rewritten to
+        its canonical file immediately, which also heals torn tails —
+        appends through a plain append handle would otherwise land
+        BEHIND torn bytes and vanish on the next reload (a dead peer
+        never drains, so the drain-time rewrite cannot be the
+        healer)."""
+        torn = 0
+        with self._lock:
+            loaded: dict[str, list[HintRecord]] = {}
+            seen: set[bytes] = set()
+            sources: list[str] = []
+            for name in sorted(os.listdir(self.dir)):
+                if not name.endswith(".hints"):
+                    continue
+                path = os.path.join(self.dir, name)
+                sources.append(path)
+                recs, t = self._parse_file_locked(path)
+                torn += t
+                for rec in recs:
+                    # dedup by exact record bytes: a crash between the
+                    # canonical rewrite and the original's removal
+                    # legitimately leaves both files on disk
+                    if rec.raw in seen:
+                        continue
+                    seen.add(rec.raw)
+                    loaded.setdefault(rec.peer, []).append(rec)
+            # canonical rewrite FIRST (atomic via temp + replace),
+            # originals removed only after every rewrite landed — a
+            # crash anywhere in this window loses nothing
+            canonical = set()
+            for pid, rec_list in loaded.items():
+                cpath = self._path(pid)
+                tmp = cpath + ".tmp"
+                with open(tmp, "wb") as f:
+                    for rec in rec_list:
+                        f.write(rec.raw)
+                os.replace(tmp, cpath)
+                canonical.add(cpath)
+            for path in sources:
+                if path not in canonical:
+                    os.remove(path)
+            for pid, rec_list in loaded.items():
+                q = _PeerQueue()
+                q.records.extend(rec_list)
+                q.bytes = sum(r.nbytes for r in rec_list)
+                q.wal = filebudget.open_append(self._path(pid))
+                self._queues[pid] = q
+                self._total_bytes += q.bytes
+        if torn:
+            bump("hint.torn_records", torn)
+
+    def _parse_file_locked(
+            self, path: str) -> tuple[list[HintRecord], int]:
+        """Parse one persisted file; returns (records, torn) — torn is
+        0 or 1 (parsing stops at the first tear, exactly like fragment
+        WAL replay)."""
+        with open(path, "rb") as f:
+            buf = f.read()
+        out: list[HintRecord] = []
+        off, n = 0, len(buf)
+        while off + _HINT_HDR.size <= n:
+            op, blob_len, ts_ms = _HINT_HDR.unpack_from(buf, off)
+            if op != _HINT_OP or off + _HINT_HDR.size + blob_len > n:
+                return out, 1  # torn/corrupt tail: ignore, WAL-style
+            start = off
+            off += _HINT_HDR.size
+            blob = buf[off:off + blob_len]
+            off += blob_len
+            try:
+                d = json.loads(blob)
+                rec = HintRecord(ts_ms, str(d["p"]), d["i"], d["q"],
+                                 int(d["s"]), bytes(buf[start:off]))
+            except Exception:  # noqa: BLE001 — corrupt blob: stop
+                return out, 1
+            out.append(rec)
+        return out, 1 if off != n else 0
+
+    # ---------------------------------------------------------- append
+
+    def append(self, peer_id: str, index: str, pql: str,
+               shard: int) -> bool:
+        """Queue one missed delivery for ``peer_id``.  Returns False
+        (and counts ``hint.dropped``) when the queue is disabled or the
+        byte bound would be exceeded — the caller's write still
+        commits; anti-entropy repairs the peer."""
+        cfg = config()
+        if cfg.hint_max_bytes <= 0:
+            bump("hint.dropped")
+            return False
+        rec = HintRecord.make(peer_id, index, pql, shard)
+        with self._lock:
+            if self._total_bytes + rec.nbytes > cfg.hint_max_bytes:
+                over = True
+            else:
+                over = False
+                q = self._queue_locked(peer_id)
+                q.records.append(rec)
+                q.bytes += rec.nbytes
+                self._total_bytes += rec.nbytes
+                if q.wal is not None:
+                    q.wal.write(rec.raw)
+        bump("hint.dropped" if over else "hint.queued")
+        return not over
+
+    def _queue_locked(self, peer_id: str) -> _PeerQueue:
+        q = self._queues.get(peer_id)
+        if q is None:
+            q = self._queues[peer_id] = _PeerQueue()
+            if self.dir is not None:
+                q.wal = filebudget.open_append(self._path(peer_id))
+        return q
+
+    # ---------------------------------------------------------- replay
+
+    def replay_peer(self, peer_id: str, deliver) -> dict:
+        """Drain ``peer_id``'s queue in order through ``deliver(rec)``.
+        Delivery raising an unowned-shard refusal discards the hint
+        (ownership moved; anti-entropy owns the repair); any other
+        exception stops the drain (the peer is still unhealthy) and the
+        remaining hints wait for the next attempt.  Returns
+        ``{"replayed", "expired", "discarded", "failed", "error"}``.
+
+        The store lock is NEVER held across a delivery RPC: the head
+        of the queue is snapshotted, delivered outside the lock, and
+        the consumed prefix removed afterward (concurrent appends land
+        behind the snapshot and survive untouched)."""
+        from pilosa_tpu.parallel.cluster import refusal_is_unowned
+
+        out = {"replayed": 0, "expired": 0, "discarded": 0,
+               "failed": False, "error": None}
+        with self._lock:
+            q = self._queues.get(peer_id)
+            if q is None or q.draining or not q.records:
+                return out
+            q.draining = True
+            batch = list(q.records)
+        max_age = config().hint_max_age
+        now_ms = time.time() * 1e3
+        consumed = 0
+        try:
+            for rec in batch:
+                if max_age > 0 and now_ms - rec.ts_ms > max_age * 1e3:
+                    out["expired"] += 1
+                    consumed += 1
+                    continue
+                try:
+                    deliver(rec)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    if refusal_is_unowned(e):
+                        out["discarded"] += 1
+                        consumed += 1
+                        continue
+                    out["failed"] = True
+                    out["error"] = e
+                    break
+                out["replayed"] += 1
+                consumed += 1
+        finally:
+            with self._lock:
+                for _ in range(consumed):
+                    r = q.records.popleft()
+                    q.bytes -= r.nbytes
+                    self._total_bytes -= r.nbytes
+                if consumed:
+                    self._rewrite_locked(peer_id, q)
+                q.draining = False
+        if out["replayed"]:
+            bump("hint.replayed", out["replayed"])
+        if out["expired"]:
+            bump("hint.expired", out["expired"])
+        if out["discarded"]:
+            bump("hint.discarded", out["discarded"])
+        if out["failed"]:
+            bump("hint.replay_failures")
+        return out
+
+    def _rewrite_locked(self, peer_id: str, q: _PeerQueue) -> None:
+        """Persist the post-drain remainder atomically (temp +
+        os.replace, the same hardening _load has): a truncate-in-place
+        rewrite killed mid-way would lose every undrained hint.  The
+        file is small by construction (hint_max_bytes bound)."""
+        if q.wal is None:
+            return
+        q.wal.close()
+        cpath = self._path(peer_id)
+        tmp = cpath + ".tmp"
+        with open(tmp, "wb") as f:
+            for rec in q.records:
+                f.write(rec.raw)
+            f.flush()
+        os.replace(tmp, cpath)
+        q.wal = filebudget.open_append(cpath)
+
+    # ----------------------------------------------------------- views
+
+    def peers(self) -> list[str]:
+        with self._lock:
+            return sorted(p for p, q in self._queues.items()
+                          if q.records)
+
+    def depth(self, peer_id: str) -> int:
+        with self._lock:
+            q = self._queues.get(peer_id)
+            return 0 if q is None else len(q.records)
+
+    def total_depth(self) -> int:
+        with self._lock:
+            return sum(len(q.records) for q in self._queues.values())
+
+    def debug(self) -> dict:
+        """The per-peer section of /debug/antientropy."""
+        now_ms = time.time() * 1e3
+        with self._lock:
+            peers = {}
+            depth = 0
+            for pid, q in sorted(self._queues.items()):
+                if not q.records:
+                    continue
+                depth += len(q.records)
+                oldest = q.records[0].ts_ms
+                peers[pid] = {
+                    "depth": len(q.records),
+                    "bytes": q.bytes,
+                    "oldestAgeS": round(max(0.0,
+                                            (now_ms - oldest) / 1e3), 3),
+                }
+            return {"depth": depth, "bytes": self._total_bytes,
+                    "peers": peers}
+
+    def close(self) -> None:
+        with self._lock:
+            for q in self._queues.values():
+                if q.wal is not None:
+                    q.wal.close()
+                    q.wal = None
+
+
+# --------------------------------------------------------------------
+# replay worker
+# --------------------------------------------------------------------
+
+
+class HintReplayer:
+    """Background drain loop for one node's hint store.
+
+    Every ``[replication] replay-interval`` seconds each peer with
+    queued hints is considered: a peer whose circuit breaker is open
+    (still cooling down) is skipped without an RPC — the breaker
+    closing (via real traffic or a successful SWIM heartbeat probe,
+    Cluster.note_probe) is exactly the "peer came back" signal that
+    lets the next scan drain it.  A failed drain attempt backs the
+    peer off exponentially (capped) so a flapping peer is not hammered
+    with its whole backlog every scan."""
+
+    BACKOFF_CAP_S = 30.0
+
+    def __init__(self, node, interval_s: float | None = None):
+        self.node = node
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # peer -> (monotonic not-before, current delay); only touched
+        # by the replay thread / run_once callers (externally
+        # serialized — the store's per-peer draining flag makes a
+        # concurrent run_once a no-op for in-flight peers anyway)
+        self._backoff: dict[str, tuple[float, float]] = {}
+
+    def _interval(self) -> float:
+        if self.interval_s is not None:
+            return self.interval_s
+        return max(0.05, config().replay_interval)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        t = threading.Thread(target=self._loop, daemon=True,
+                             name="hint-replay")
+        self._thread = t
+        t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval()):
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — the drain loop must
+                # survive any single peer's weirdness; the next scan
+                # retries
+                pass
+
+    def run_once(self, force: bool = False) -> dict:
+        """One scan over every peer with queued hints.  ``force``
+        ignores breaker state and backoff (tests / operator kicks).
+        Returns aggregate counts."""
+        from pilosa_tpu.serve.admission import rpc_class
+
+        store = getattr(self.node, "hints", None)
+        cluster = self.node.cluster
+        totals = {"replayed": 0, "expired": 0, "discarded": 0,
+                  "failed_peers": 0, "skipped_peers": 0}
+        if store is None or cluster.transport is None:
+            return totals
+        now = time.monotonic()
+        for pid in store.peers():
+            peer = cluster.node(pid)
+            if peer is None:
+                # the peer left the cluster: its hints can never land
+                store.replay_peer(pid, self._drop_all)
+                continue
+            if not force:
+                nb, _ = self._backoff.get(pid, (0.0, 0.0))
+                if now < nb or cluster.breaker_open(pid):
+                    totals["skipped_peers"] += 1
+                    continue
+            with rpc_class("internal"):
+                res = store.replay_peer(pid, self._deliver_fn(peer))
+            for k in ("replayed", "expired", "discarded"):
+                totals[k] += res[k]
+            if res["failed"]:
+                totals["failed_peers"] += 1
+                self._note_failure(pid, res["error"])
+            else:
+                self._backoff.pop(pid, None)
+                if res["replayed"]:
+                    cluster.note_peer_success(pid)
+        return totals
+
+    @staticmethod
+    def _drop_all(rec) -> None:
+        from pilosa_tpu.parallel.cluster import UNOWNED_MARKER
+
+        raise RuntimeError(f"{UNOWNED_MARKER}: peer removed")
+
+    def _deliver_fn(self, peer):
+        transport = self.node.cluster.transport
+
+        def deliver(rec: HintRecord) -> None:
+            if _fi.armed:
+                # failpoint: the production hint replay delivery
+                # (errors here leave the hint queued for the next scan)
+                _fi.hit("hint.replay")
+            transport.query_node(peer, rec.index, rec.pql, [rec.shard])
+
+        return deliver
+
+    def _note_failure(self, pid: str, error) -> None:
+        from pilosa_tpu.parallel.cluster import ShedByPeerError
+
+        cluster = self.node.cluster
+        if isinstance(error, ShedByPeerError):
+            # proof of life: the peer is up but loaded — back off
+            # without feeding its breaker
+            cluster.note_peer_success(pid)
+        else:
+            cluster.note_peer_failure(pid)
+        _, prev = self._backoff.get(pid, (0.0, 0.0))
+        delay = min(self.BACKOFF_CAP_S,
+                    max(self._interval(), 0.1) if prev <= 0 else prev * 2)
+        self._backoff[pid] = (time.monotonic() + delay, delay)

@@ -26,7 +26,10 @@ class ClusterNode:
     """A holder + executor bound to a cluster and its transport."""
 
     def __init__(self, holder, cluster: Cluster, worker_pool_size: int | None = None):
+        import os as _os
+
         from pilosa_tpu.parallel.executor import Executor
+        from pilosa_tpu.parallel.hints import HintStore
 
         self.holder = holder
         self.cluster = cluster
@@ -36,6 +39,16 @@ class ClusterNode:
         self._cleanup_lock = threading.Lock()
         self._cleanup_timer: threading.Timer | None = None
         self._cleanup_deadline = 0.0
+        # hinted handoff (parallel/hints.py): per-peer queues of missed
+        # replica writes, disk-backed under the data dir (memory-only
+        # for pathless holders); drained by the server's HintReplayer
+        self.hints = HintStore(
+            _os.path.join(holder.path, "hints")
+            if getattr(holder, "path", None) else None)
+        # anti-entropy round state (parallel/syncer.py): the resumable
+        # walk cursor and the last round's outcome (/debug/antientropy)
+        self.ae_cursor: tuple | None = None
+        self.ae_last_round: dict = {}
         if cluster.transport is not None and hasattr(cluster.transport, "register"):
             cluster.transport.register(cluster.local_id, self)
 
@@ -191,8 +204,16 @@ class ClusterNode:
             idx.import_existence(msg["cols"])
         elif t == "fragment-blocks":
             frag = self._fragment(msg, create=False)
-            return {"ok": True,
-                    "blocks": [] if frag is None else frag.blocks()}
+            if frag is None:
+                return {"ok": True, "blocks": []}
+            blocks, hit = frag.blocks_with_flag()
+            from pilosa_tpu.parallel import syncer as _syncer
+
+            # digest-cache accounting for the SERVING side of the
+            # exchange too: a quiescent AE round must re-checksum
+            # nothing on either end
+            _syncer.note_digest(hit)
+            return {"ok": True, "blocks": blocks}
         elif t == "fragment-block-data":
             frag = self._fragment(msg, create=False)
             if frag is None:

@@ -1,4 +1,4 @@
-"""Anti-entropy: periodic replica reconciliation.
+"""Anti-entropy: incremental, failure-classified replica reconciliation.
 
 Parity target: the reference's holderSyncer (holder.go:880-1101) and
 fragmentSyncer (fragment.go:2840-3032): walk the schema; for every
@@ -13,34 +13,202 @@ fragment.go:1875-1995 — a cleared bit that some replica still holds is
 resurrected there too, absent tombstones).  Deltas this node is missing
 are applied locally; deltas a peer is missing are pushed as an import
 message to that peer alone.
+
+The self-healing round (PR 14) turned the bare synchronous walk into a
+subsystem:
+
+- **Digest caching** — fragment block checksums are generation-keyed
+  (``Fragment.blocks_with_flag``): an unchanged fragment costs zero
+  checksum work on either side of the exchange, so a quiescent round
+  is pure cheap RPC (and zero block-data RPCs, since nothing differs).
+- **Time-sliced rounds** — ``sync_holder(budget_s)`` walks from a
+  resumable (index, field, view, shard) cursor persisted on the node
+  and stops when the slice budget is spent; the next round resumes,
+  so a huge holder never monopolizes the internal admission class.
+- **Breaker-aware peer skip** — a peer whose circuit breaker is open
+  is skipped without an RPC (``ae.peer_skipped``) instead of paying a
+  full transport timeout per fragment; transport failures feed the
+  breaker, and a shed reply is proof of life exactly as on the read
+  path (ShedByPeerError never opens a breaker).
+- **Failure classification** — peer failures that the old walk
+  swallowed (``except TransportError: pass``) are classified
+  (transport / shed / refused), counted under the ``ae.*`` family, and
+  carried in the round result instead of reporting a clean round.
+- **Deadline-bounded exchanges** — every peer RPC (fragment blocks,
+  block data, pushes, attribute exchanges) runs under a per-exchange
+  deadline scope (``[anti-entropy] peer-timeout``), the internal-class
+  deadline pattern, so one hung peer cannot stall the whole round.
+
+Round outcomes land on ``node.ae_last_round`` (the /debug/antientropy
+document), the ``ae.*`` gauges, and — when a flight recorder is
+attached — an internal-class record on /debug/queries.
 """
 
 from __future__ import annotations
 
-from pilosa_tpu.parallel.cluster import TransportError
+import time
+
+from pilosa_tpu import lockcheck as _lockcheck
+from pilosa_tpu.parallel.cluster import ShedByPeerError, TransportError
+from pilosa_tpu.serve import deadline as _deadline
 from pilosa_tpu.serve.admission import tagged
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+#: per-peer-exchange deadline (seconds) when none is configured
+DEFAULT_PEER_TIMEOUT_S = 2.0
+
+# --------------------------------------------------------------------
+# ae.* counters (published as gauges at scrape time, like tape.*)
+# --------------------------------------------------------------------
+
+_lock = _lockcheck.lock("syncer-counters")
+_counters = {
+    "ae.rounds": 0,            # completed full-holder walks
+    "ae.slices": 0,            # sync_holder calls (incl. partial)
+    "ae.fragments": 0,         # fragment syncs performed
+    "ae.dirty_blocks": 0,      # blocks that differed somewhere
+    "ae.reconciled": 0,        # blocks merged to the union
+    "ae.pushed": 0,            # per-peer diff pushes delivered
+    "ae.pulled": 0,            # peer block-data pulls applied
+    "ae.peer_skipped": 0,      # peers skipped on an open breaker
+    "ae.failures_transport": 0,
+    "ae.failures_shed": 0,
+    "ae.failures_refused": 0,
+    "ae.digest_cache_hits": 0,
+    "ae.digest_cache_misses": 0,
+}
+
+
+def bump(name: str, value: int = 1) -> None:
+    with _lock:
+        _counters[name] += value
+
+
+def counters() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
+def note_digest(hit: bool) -> None:
+    """One fragment checksum request served (either side of the
+    exchange): from the generation-keyed cache, or recomputed."""
+    bump("ae.digest_cache_hits" if hit else "ae.digest_cache_misses")
+
+
+def publish_gauges(stats) -> None:
+    """ae.* gauge family for /metrics and /debug/vars — published
+    unconditionally (zeros on a clean server)."""
+    for name, v in counters().items():
+        stats.gauge(name, v)
+
+
+class SyncStats:
+    """One round's accounting, carried in the round result instead of
+    the old walk's silent ``pass``."""
+
+    __slots__ = ("fragments", "dirty", "reconciled", "pushed", "pulled",
+                 "peer_skipped", "digest_hits", "digest_misses",
+                 "failures", "attr_failures", "block_data_rpcs")
+
+    def __init__(self):
+        self.fragments = 0
+        self.dirty = 0
+        self.reconciled = 0
+        self.pushed = 0
+        self.pulled = 0
+        self.peer_skipped = 0
+        self.digest_hits = 0
+        self.digest_misses = 0
+        self.failures = {"transport": 0, "shed": 0, "refused": 0}
+        self.attr_failures = {"transport": 0, "shed": 0, "refused": 0}
+        self.block_data_rpcs = 0
+
+    def note_failure(self, kind: str, attrs: bool = False) -> None:
+        (self.attr_failures if attrs else self.failures)[kind] += 1
+        bump(f"ae.failures_{kind}")
+
+    def to_dict(self) -> dict:
+        return {
+            "fragments": self.fragments,
+            "dirtyBlocks": self.dirty,
+            "reconciled": self.reconciled,
+            "pushed": self.pushed,
+            "pulled": self.pulled,
+            "peerSkipped": self.peer_skipped,
+            "digestCacheHits": self.digest_hits,
+            "digestCacheMisses": self.digest_misses,
+            "blockDataRpcs": self.block_data_rpcs,
+            "failures": dict(self.failures),
+            "attrFailures": dict(self.attr_failures),
+        }
+
+
+def classify_failure(exc: BaseException) -> str:
+    """transport / shed / refused — the three ways a peer exchange
+    fails (a refusal is a structured non-ok reply, e.g. unowned)."""
+    if isinstance(exc, ShedByPeerError):
+        return "shed"
+    if isinstance(exc, (TransportError, _deadline.DeadlineExceededError,
+                        TimeoutError, OSError)):
+        return "transport"
+    return "refused"
 
 
 class FragmentSyncer:
     """Reconcile one (index, field, view, shard) across its owner
     replicas (fragment.go:2840 fragmentSyncer)."""
 
-    def __init__(self, node, index: str, field: str, view: str, shard: int):
+    def __init__(self, node, index: str, field: str, view: str,
+                 shard: int, stats: SyncStats | None = None,
+                 peer_timeout: float | None = None):
         self.node = node
         self.cluster = node.cluster
         self.index = index
         self.field = field
         self.view = view
         self.shard = shard
+        self.stats = stats if stats is not None else SyncStats()
+        self.peer_timeout = (DEFAULT_PEER_TIMEOUT_S
+                             if peer_timeout is None else peer_timeout)
 
     def _peers(self):
         return [n for n in self.cluster.shard_nodes(self.index, self.shard)
                 if n.id != self.cluster.local_id]
 
+    def _available_peers(self):
+        """Owner peers whose breaker is not open: a known-dead peer
+        must not cost a transport timeout per fragment — the breaker's
+        half-open trial (or a heartbeat probe) re-admits it."""
+        out = []
+        for n in self._peers():
+            if self.cluster.breaker_open(n.id):
+                self.stats.peer_skipped += 1
+                bump("ae.peer_skipped")
+                continue
+            out.append(n)
+        return out
+
     def _local_fragment(self, create: bool = False):
         return self.node.local_fragment(self.index, self.field, self.view,
                                         self.shard, create)
+
+    def _exchange(self, n, message: dict) -> dict:
+        """One deadline-bounded peer RPC with breaker feedback: a shed
+        reply is proof of life (note_peer_success), a transport error
+        feeds the peer's breaker.  Raises the original exception —
+        callers classify and account it."""
+        try:
+            with _deadline.scope(_deadline.Deadline(self.peer_timeout)):
+                resp = self.cluster.transport.send_message(n, message)
+        except ShedByPeerError:
+            self.cluster.note_peer_success(n.id)
+            raise
+        except (TransportError, _deadline.DeadlineExceededError,
+                TimeoutError, OSError):
+            self.cluster.note_peer_failure(n.id)
+            raise
+        self.cluster.note_peer_success(n.id)
+        return resp
 
     @tagged("internal")
     def sync(self) -> int:
@@ -48,19 +216,28 @@ class FragmentSyncer:
         agree).  Anti-entropy RPC rides the internal class: it can
         shed under query pressure (the next AE round reconverges) but
         can never occupy a query slot on the peer."""
+        self.stats.fragments += 1
+        bump("ae.fragments")
         frag = self._local_fragment()
-        local_blocks = {} if frag is None else {
-            b["id"]: b["checksum"] for b in frag.blocks()
-        }
+        local_blocks = {}
+        if frag is not None:
+            blocks, hit = frag.blocks_with_flag()
+            note_digest(hit)
+            if hit:
+                self.stats.digest_hits += 1
+            else:
+                self.stats.digest_misses += 1
+            local_blocks = {b["id"]: b["checksum"] for b in blocks}
         peer_blocks: dict[str, dict[int, str]] = {}
-        for n in self._peers():
+        for n in self._available_peers():
             try:
-                resp = self.cluster.transport.send_message(n, {
+                resp = self._exchange(n, {
                     "type": "fragment-blocks",
                     "index": self.index, "field": self.field,
                     "view": self.view, "shard": self.shard,
                 })
-            except TransportError:
+            except Exception as e:  # noqa: BLE001 — classified, counted
+                self.stats.note_failure(classify_failure(e))
                 continue
             peer_blocks[n.id] = {
                 b["id"]: b["checksum"] for b in resp.get("blocks", [])
@@ -76,30 +253,47 @@ class FragmentSyncer:
                 sums.add(blocks.get(bid))
             if len(sums) > 1:
                 dirty.add(bid)
+        self.stats.dirty += len(dirty)
+        bump("ae.dirty_blocks", len(dirty))
+        reconciled = 0
         for bid in sorted(dirty):
-            self._sync_block(bid, list(peer_blocks))
+            if self._sync_block(bid, list(peer_blocks)):
+                reconciled += 1
+        # only blocks whose merge saw NO peer failure count as
+        # reconciled — a round that pulled/pushed nothing must not
+        # read as repaired (dirtyBlocks vs reconciled is the gap)
+        self.stats.reconciled += reconciled
+        bump("ae.reconciled", reconciled)
         return len(dirty)
 
-    def _sync_block(self, block: int, peer_ids: list[str]) -> None:
+    def _sync_block(self, block: int, peer_ids: list[str]) -> bool:
         """Pull every replica's block data, compute the union, apply the
         local diff, and push each peer its own missing bits
-        (fragment.go:2941 syncBlock + :1875 mergeBlock)."""
+        (fragment.go:2941 syncBlock + :1875 mergeBlock).  Peer failures
+        are classified and counted — never silently swallowed.  Returns
+        True only when every exchange in the merge succeeded."""
         frag = self._local_fragment(create=True)
         local_pairs = set(zip(*frag.block_data(block)))
         per_peer: dict[str, set] = {}
+        ok = True
         for n in self._peers():
             if n.id not in peer_ids:
                 continue
             try:
-                resp = self.cluster.transport.send_message(n, {
+                self.stats.block_data_rpcs += 1
+                resp = self._exchange(n, {
                     "type": "fragment-block-data",
                     "index": self.index, "field": self.field,
                     "view": self.view, "shard": self.shard, "block": block,
                 })
-            except TransportError:
+            except Exception as e:  # noqa: BLE001 — classified, counted
+                self.stats.note_failure(classify_failure(e))
+                ok = False
                 continue
             per_peer[n.id] = set(zip(resp.get("rowIDs", []),
                                      resp.get("columnIDs", [])))
+            self.stats.pulled += 1
+            bump("ae.pulled")
         union = set(local_pairs)
         for pairs in per_peer.values():
             union |= pairs
@@ -118,88 +312,230 @@ class FragmentSyncer:
             if not peer_missing:
                 continue
             try:
-                self.cluster.transport.send_message(n, {
+                resp = self._exchange(n, {
                     "type": "fragment-import",
                     "index": self.index, "field": self.field,
                     "view": self.view, "shard": self.shard,
                     "positions": [r * SHARD_WIDTH + c
                                   for r, c in peer_missing],
                 })
-            except TransportError:
-                pass
+            except Exception as e:  # noqa: BLE001 — classified, counted
+                self.stats.note_failure(classify_failure(e))
+                ok = False
+                continue
+            if resp.get("ok", True):
+                self.stats.pushed += 1
+                bump("ae.pushed")
+            else:
+                self.stats.note_failure("refused")
+                ok = False
+        return ok
 
 
 class HolderSyncer:
     """Walk the whole schema and reconcile every locally-owned fragment
-    and attribute store (holder.go:880 holderSyncer.SyncHolder)."""
+    and attribute store (holder.go:880 holderSyncer.SyncHolder), in
+    resumable time slices."""
 
-    def __init__(self, node):
+    def __init__(self, node, peer_timeout: float | None = None):
         self.node = node
         self.cluster = node.cluster
+        self.peer_timeout = (DEFAULT_PEER_TIMEOUT_S
+                             if peer_timeout is None else peer_timeout)
+
+    # --------------------------------------------------------- the walk
+
+    def _work_items(self) -> list[tuple]:
+        """The full ordered reconcile walk.  Each item carries a
+        monotonically increasing sort key so the resumable cursor is a
+        plain tuple comparison — schema churn between slices degrades
+        to skipping/revisiting a few items, never corruption:
+
+        - ``(iname, "",    0, "", -1)`` — index attribute store
+        - ``(iname, fname, 0, "", -1)`` — field attribute store
+        - ``(iname, fname, 1, vname, shard)`` — one fragment
+        """
+        items: list[tuple] = []
+        for idx_info in sorted(self.node.holder.schema(),
+                               key=lambda d: d["name"]):
+            iname = idx_info["name"]
+            idx = self.node.holder.index(iname)
+            if idx is None:
+                continue
+            items.append(((iname, "", 0, "", -1), "attrs", iname, None))
+            for f in sorted(idx.all_fields(), key=lambda f: f.name):
+                items.append(((iname, f.name, 0, "", -1),
+                              "attrs", iname, f.name))
+                for vname in sorted(f.views):
+                    for shard in sorted(f.available_shards()):
+                        if not self.cluster.owns_shard(
+                                self.cluster.local_id, iname, shard):
+                            continue
+                        items.append(((iname, f.name, 1, vname, shard),
+                                      "frag", iname, f.name, vname,
+                                      shard))
+        return items
 
     @tagged("internal")
-    def sync_holder(self) -> int:
+    def sync_holder(self, budget_s: float | None = None) -> int:
+        """One reconcile slice.  With no budget (the default, and the
+        historical call shape) the whole holder is walked; with a
+        budget the walk stops when the slice is spent and persists its
+        cursor on the node — the next call resumes there.  Returns the
+        number of blocks reconciled in THIS slice."""
         if self.cluster.replica_n < 2:
             return 0
         from pilosa_tpu.parallel.cluster import STATE_RESIZING
 
         if self.cluster.state == STATE_RESIZING:
             return 0  # skipped mid-resize (server.go:514)
-        # announce local shard availability first so peers (owners or
-        # not) fan queries out over everything this node holds
-        # (reference NodeStatus exchange, server.go:569)
-        self.node.broadcast_node_status()
+        t0 = time.monotonic()
+        stats = SyncStats()
+        bump("ae.slices")
+        cursor = getattr(self.node, "ae_cursor", None)
+        fresh = cursor is None
+        if fresh:
+            # announce local shard availability first so peers (owners
+            # or not) fan queries out over everything this node holds
+            # (reference NodeStatus exchange, server.go:569)
+            self.node.broadcast_node_status()
+        items = self._work_items()
+        if cursor is not None:
+            resumed = [it for it in items if it[0] > tuple(cursor)]
+            if not resumed:
+                # the cursor outlived its schema position: restart
+                fresh = True
+                self.node.broadcast_node_status()
+            else:
+                items = resumed
+        deadline = (None if not budget_s or budget_s <= 0
+                    else t0 + budget_s)
         total = 0
-        for idx_info in self.node.holder.schema():
-            iname = idx_info["name"]
-            idx = self.node.holder.index(iname)
-            if idx is None:
-                continue
-            self._sync_attrs(iname, None)
-            for f in idx.all_fields():
-                self._sync_attrs(iname, f.name)
-                for vname, view in list(f.views.items()):
-                    for shard in sorted(f.available_shards()):
-                        if not self.cluster.owns_shard(
-                                self.cluster.local_id, iname, shard):
-                            continue
-                        total += FragmentSyncer(
-                            self.node, iname, f.name, vname, shard).sync()
-        # periodic unowned-fragment cleanup rides the AE cadence, so a
-        # node that missed the one-shot post-resize holder-cleanup
+        completed = True
+        last_key = cursor
+        processed = 0
+        for it in items:
+            # minimum-progress guarantee: at least one item per slice,
+            # or a budget smaller than the walk's setup cost would park
+            # the cursor in place forever and AE would silently stop
+            # converging
+            if (processed and deadline is not None
+                    and time.monotonic() >= deadline):
+                completed = False
+                break
+            key = it[0]
+            if it[1] == "attrs":
+                self._sync_attrs(it[2], it[3], stats)
+            else:
+                _, _, iname, fname, vname, shard = it
+                total += FragmentSyncer(
+                    self.node, iname, fname, vname, shard,
+                    stats=stats, peer_timeout=self.peer_timeout).sync()
+            processed += 1
+            last_key = key
+        if completed:
+            self.node.ae_cursor = None
+            bump("ae.rounds")
+        else:
+            self.node.ae_cursor = last_key
+        # cleanup + translate tailing run on EVERY slice, not just a
+        # completed round: neither is part of the reconcile walk being
+        # sliced, and deferring them to round completion would
+        # multiply their cadence by the slice count under a small
+        # round-budget.  Unowned-fragment cleanup rides the AE cadence
+        # so a node that missed the one-shot post-resize cleanup
         # broadcast still converges (reference holderCleaner loop,
-        # holder.go:1103) — grace-deferred like every cleanup path,
-        # or a short AE interval re-opens the read-vs-cleanup race
-        # the grace exists to close
-        self.node.request_cleanup()
+        # holder.go:1103) — grace-deferred like every cleanup path;
         # replicas tail the primary's key-translation entry stream
         # (reference holder.go:690-878)
+        self.node.request_cleanup()
         self.node.tail_translate_entries()
+        self._publish_round(stats, t0, completed, fresh)
         return total
 
-    def _sync_attrs(self, index: str, field: str | None) -> None:
+    def _publish_round(self, stats: SyncStats, t0: float,
+                       completed: bool, fresh: bool) -> None:
+        """Round outcome -> node state (/debug/antientropy) and, when
+        a flight recorder is attached, an internal-class record on
+        /debug/queries."""
+        out = stats.to_dict()
+        out.update({
+            "durationMs": round((time.monotonic() - t0) * 1e3, 3),
+            "completed": completed,
+            "resumed": not fresh,
+            "cursor": (None if completed
+                       else list(getattr(self.node, "ae_cursor", None)
+                                 or [])),
+            "at": time.time(),
+        })
+        self.node.ae_last_round = out
+        recorder = getattr(self.node.executor, "recorder", None)
+        if recorder is None or not recorder.enabled:
+            return
+        summary = (f"AntiEntropy(fragments={stats.fragments}, "
+                   f"dirty={stats.dirty}, pushed={stats.pushed}, "
+                   f"failures={sum(stats.failures.values())}, "
+                   f"completed={str(completed).lower()})")
+        rec = recorder.begin("", summary)
+        rec.admission = {"class": "internal", "queue_wait_ns": 0}
+        rec.note_path("anti-entropy")
+        failed = (sum(stats.failures.values())
+                  + sum(stats.attr_failures.values()))
+        recorder.publish(
+            rec, error=(f"{failed} peer exchanges failed"
+                        if failed else None))
+
+    def _sync_attrs(self, index: str, field: str | None,
+                    stats: SyncStats) -> None:
         """Pull attribute blocks that differ and merge them locally
         (holder.go:975 syncIndex / :1021 syncField; attrBlocks.Diff
-        attr.go:90)."""
+        attr.go:90).  Each peer exchange is deadline-bounded (the
+        internal-class deadline pattern the fragment walk rides) so a
+        hung peer costs at most peer-timeout, never a stalled round;
+        failures are classified and counted, never swallowed."""
         store = self.node.attr_store(index, field)
         if store is None:
             return
         for n in self.cluster.sorted_nodes():
             if n.id == self.cluster.local_id:
                 continue
+            if self.cluster.breaker_open(n.id):
+                stats.peer_skipped += 1
+                bump("ae.peer_skipped")
+                continue
             try:
-                resp = self.cluster.transport.send_message(n, {
-                    "type": "attr-blocks", "index": index, "field": field,
-                })
-                peer_blocks = [(b["id"], bytes.fromhex(b["checksum"]))
+                # one FRESH deadline per RPC (matching _exchange on
+                # the fragment walk) — a single budget spanning the
+                # attr-blocks exchange plus every block-data pull
+                # would charge a healthy peer with many differing
+                # blocks a cumulative timeout and feed its breaker
+                with _deadline.scope(
+                        _deadline.Deadline(self.peer_timeout)):
+                    resp = self.cluster.transport.send_message(n, {
+                        "type": "attr-blocks", "index": index,
+                        "field": field,
+                    })
+                peer_blocks = [(b["id"],
+                                bytes.fromhex(b["checksum"]))
                                for b in resp.get("blocks", [])]
                 need = store.blocks_diff(peer_blocks)
                 for bid in need:
-                    data = self.cluster.transport.send_message(n, {
-                        "type": "attr-block-data", "index": index,
-                        "field": field, "block": bid,
-                    }).get("attrs", {})
+                    with _deadline.scope(
+                            _deadline.Deadline(self.peer_timeout)):
+                        data = self.cluster.transport.send_message(n, {
+                            "type": "attr-block-data", "index": index,
+                            "field": field, "block": bid,
+                        }).get("attrs", {})
                     store.set_bulk_attrs(
                         {int(k): v for k, v in data.items()})
-            except TransportError:
-                continue
+            except Exception as e:  # noqa: BLE001 — classified, counted
+                # EVERY failure is classified (matching the fragment
+                # walk): an uncaught malformed-reply or remote error
+                # would abort the whole round mid-walk and park every
+                # later item unreconciled, forever
+                kind = classify_failure(e)
+                if isinstance(e, ShedByPeerError):
+                    self.cluster.note_peer_success(n.id)
+                elif kind == "transport":
+                    self.cluster.note_peer_failure(n.id)
+                stats.note_failure(kind, attrs=True)

@@ -1406,6 +1406,43 @@ class Handler:
             "hedge": hedge,
         })
 
+    @route("GET", "/debug/antientropy")
+    def handle_debug_antientropy(self, req, params, path, body):
+        """Self-healing replication state (parallel/syncer.py +
+        parallel/hints.py): the resumable anti-entropy cursor, the
+        last round's outcome (fragments walked, dirty / reconciled /
+        pushed block counts, classified peer failures, duration), the
+        cumulative ae.* counters with the digest-cache hit rate, the
+        [replication] write policy in force, and each peer's hint
+        queue depth / bytes / oldest-hint age."""
+        from pilosa_tpu.parallel import hints as _hints
+        from pilosa_tpu.parallel import syncer as _syncer
+
+        node = self.api.node
+        ctrs = _syncer.counters()
+        hits = ctrs["ae.digest_cache_hits"]
+        misses = ctrs["ae.digest_cache_misses"]
+        cfg = _hints.config()
+        # one snapshot read: the AE thread clears ae_cursor on slice
+        # completion, and a two-read None-check would race it
+        cur = node.ae_cursor
+        self._json(req, {
+            "cursor": None if cur is None else list(cur),
+            "lastRound": node.ae_last_round or None,
+            "counters": ctrs,
+            "digestCacheHitRate": (
+                round(hits / (hits + misses), 4)
+                if hits + misses else None),
+            "replication": {
+                "writePolicy": cfg.write_policy,
+                "hintMaxBytes": cfg.hint_max_bytes,
+                "hintMaxAge": cfg.hint_max_age,
+                "replayInterval": cfg.replay_interval,
+            },
+            "hints": node.hints.debug(),
+            "hintCounters": _hints.counters(),
+        })
+
     @route("GET", "/debug/failpoints")
     def handle_debug_failpoints(self, req, params, path, body):
         """Failpoint registry state (pilosa_tpu.faultinject): armed
@@ -1466,9 +1503,12 @@ class Handler:
         from pilosa_tpu import devobs
         from pilosa_tpu import faultinject as _faultinject
         from pilosa_tpu.ingest import compactor
+        from pilosa_tpu.models import fragment as _fragment
         from pilosa_tpu.ops import containers as _containers
         from pilosa_tpu.ops import tape
+        from pilosa_tpu.parallel import hints as _hints
         from pilosa_tpu.parallel import meshexec as _meshexec
+        from pilosa_tpu.parallel import syncer as _syncer
         from pilosa_tpu.runtime import resultcache
 
         try:
@@ -1484,6 +1524,12 @@ class Handler:
             self.api.cluster.publish_breaker_gauges(self.stats)
             self.api.executor.publish_chaos_gauges(self.stats)
             _faultinject.publish_gauges(self.stats)
+            # self-healing replication families: anti-entropy rounds,
+            # hinted handoff (with this node's live queue depth), and
+            # WAL replay health — zeros on a clean server
+            _syncer.publish_gauges(self.stats)
+            _hints.publish_gauges(self.stats, self.api.node.hints)
+            _fragment.publish_wal_gauges(self.stats)
         except Exception:  # noqa: BLE001
             pass
 

@@ -99,6 +99,13 @@ class Server:
         hedge_min_ms: float = 20.0,
         hedge_max_fraction: float = 0.1,
         faultinject_armed: str = "",
+        write_policy: str = "all",
+        hint_max_bytes: int | None = None,
+        hint_max_age: float | None = None,
+        hint_replay_interval: float | None = None,
+        anti_entropy_jitter: float = 0.1,
+        anti_entropy_round_budget: float = 0.0,
+        anti_entropy_peer_timeout: float = 2.0,
     ):
         from pilosa_tpu import logger as _logger
         from pilosa_tpu import stats as _stats
@@ -126,6 +133,9 @@ class Server:
                 tracemalloc.start(heap_profile_frames)
         self.seeds = seeds or []
         self.anti_entropy_interval = anti_entropy_interval
+        self.anti_entropy_jitter = anti_entropy_jitter
+        self.anti_entropy_round_budget = anti_entropy_round_budget
+        self.anti_entropy_peer_timeout = anti_entropy_peer_timeout
         self.heartbeat_interval = heartbeat_interval
 
         self.holder = Holder(data_dir)
@@ -142,6 +152,25 @@ class Server:
             breaker_cooldown_s=breaker_cooldown,
         )
         self.node = ClusterNode(self.holder, self.cluster)
+        # self-healing replication ([replication] config): process-wide
+        # like [mesh] — the first server's retain() captures the
+        # pre-server baseline, the LAST release() (in close) restores
+        # it; the hint REPLAYER is per-node and starts in open()
+        from pilosa_tpu.parallel import hints as _hints
+        from pilosa_tpu.parallel.hints import HintReplayer
+
+        _hints.retain()
+        self._hints_retained = True
+        # kept for the reopen path: close() releases the baseline, so
+        # a reopened server must RE-APPLY its configured policy, not
+        # silently revert to the restored default
+        self._replication_cfg = dict(
+            write_policy=write_policy,
+            hint_max_bytes=hint_max_bytes,
+            hint_max_age=hint_max_age,
+            replay_interval=hint_replay_interval)
+        _hints.configure(**self._replication_cfg)
+        self.hint_replayer = HintReplayer(self.node)
         self.node.executor.stats = self.stats
         self.node.executor.logger = self.logger
         self.node.executor.long_query_time = long_query_time
@@ -365,6 +394,23 @@ class Server:
 
             _compactor.retain()
             self._ingest_retained = True
+        if not self._hints_retained:
+            # reopened after close(): take the [replication] reference
+            # back, RE-APPLY this server's configured policy (close()
+            # restored the process baseline), and rebuild the hint
+            # store (close() released its append handles; queued hints
+            # reload from disk)
+            import os as _os
+
+            from pilosa_tpu.parallel import hints as _hints
+            from pilosa_tpu.parallel.hints import HintStore
+
+            _hints.retain()
+            self._hints_retained = True
+            _hints.configure(**self._replication_cfg)
+            self.node.hints = HintStore(
+                _os.path.join(self.holder.path, "hints")
+                if getattr(self.holder, "path", None) else None)
         self.handler.serve_background()
         self.cluster.save_topology()
         if self.seeds:
@@ -386,6 +432,9 @@ class Server:
         self.runtime_monitor.start()
         self.device_sampler.start()
         self.prefetcher.start()
+        # hinted-handoff replay worker: drains per-peer hint queues
+        # with backoff once a peer's breaker closes / heartbeat returns
+        self.hint_replayer.start()
         if self._ragged_prewarm:
             # lower the ragged bucket interpreter programs off the
             # serving path ([ragged] prewarm): best-effort, background,
@@ -461,11 +510,26 @@ class Server:
         raise RuntimeError(f"could not join cluster via seeds: {last_err}")
 
     def _anti_entropy_loop(self) -> None:
+        import random
+
         from pilosa_tpu.parallel.syncer import HolderSyncer
 
-        while not self._stop.wait(self.anti_entropy_interval):
+        syncer = HolderSyncer(
+            self.node, peer_timeout=self.anti_entropy_peer_timeout)
+        budget = self.anti_entropy_round_budget
+        while True:
+            wait = self.anti_entropy_interval
+            if self.anti_entropy_jitter > 0:
+                # jittered cadence: a fleet restarted together must
+                # not run every AE sweep (and its RPC fan-out) in
+                # lockstep
+                wait *= 1.0 + random.uniform(-self.anti_entropy_jitter,
+                                             self.anti_entropy_jitter)
+            if self._stop.wait(max(0.01, wait)):
+                return
             try:
-                HolderSyncer(self.node).sync_holder()
+                syncer.sync_holder(
+                    budget_s=budget if budget and budget > 0 else None)
             except Exception:
                 pass
 
@@ -489,6 +553,13 @@ class Server:
         self.runtime_monitor.stop()
         self.device_sampler.stop()
         self.prefetcher.stop()
+        self.hint_replayer.stop()
+        from pilosa_tpu.parallel import hints as _hints0
+
+        if self._hints_retained:
+            self._hints_retained = False
+            _hints0.release()
+        self.node.hints.close()
         # the scan thread and [ingest] config are shared across every
         # in-process server: drop our reference, and only when we were
         # the LAST ingest-enabled server stop the thread and restore

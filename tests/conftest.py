@@ -82,3 +82,9 @@ def _hermetic_residency_accounting():
 
     ingest.reset()
     compactor.reset()
+    # the [replication] write-policy / hint-queue config is
+    # process-wide too: a test that flips write_policy="available"
+    # must not leak degraded-write semantics into the next test
+    from pilosa_tpu.parallel import hints
+
+    hints.reset()

@@ -46,7 +46,7 @@ CLASS_LOCKS: dict[tuple, ClassLockRule] = {
         attrs=frozenset({
             "_rows", "_gen", "_delta_seq", "_delta", "_op_n", "_wal",
             "_stack_cache", "_device_cache", "_container_cache",
-            "_snapshotting", "_closed",
+            "_blocks_cache", "_snapshotting", "_closed",
         }),
         helpers={
             "_load": "construction-time replay, single-threaded",
@@ -111,6 +111,15 @@ CLASS_LOCKS: dict[tuple, ClassLockRule] = {
             "failures", "sheds", "prefetch_issued",
             "prefetch_completed", "prefetch_shed",
         }),
+    ),
+    ("parallel/hints.py", "HintStore"): ClassLockRule(
+        lock="_lock",
+        attrs=frozenset({"_queues", "_total_bytes"}),
+        helpers={
+            "_parse_file_locked": "called from _load under self._lock",
+            "_queue_locked": "callers hold self._lock",
+            "_rewrite_locked": "callers hold self._lock",
+        },
     ),
     ("parallel/cluster.py", "CircuitBreaker"): ClassLockRule(
         lock="_lock",
@@ -190,6 +199,20 @@ MODULE_LOCKS: dict[str, tuple] = {
         ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
         ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
         ModuleGlobalRule("_global", "_global_lock", "w"),
+    ),
+    "parallel/hints.py": (
+        ModuleGlobalRule("_counters", "_lock", "rw"),
+        ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
+        ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
+    ),
+    "parallel/syncer.py": (
+        ModuleGlobalRule("_counters", "_lock", "rw"),
+    ),
+    "models/fragment.py": (
+        # the wal.* replay-health counters (module-level; every
+        # fragment's construction-time replay can note a torn tail)
+        ModuleGlobalRule("_counters", "_wal_counter_lock", "rw"),
     ),
     "faultinject.py": (
         # the failpoint registry: every read AND write of the armed
@@ -349,6 +372,18 @@ CONFIG_GUARDS = (
         pair=("release",),
         owner_suffixes=("runtime/residency.py",),
         what="the refcounted [residency] baseline",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("hints.configure", "_hints.configure"),
+        pair=("retain", "release"),
+        owner_suffixes=("parallel/hints.py",),
+        what="the process-wide [replication] runtime config",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("hints.retain", "_hints.retain"),
+        pair=("release",),
+        owner_suffixes=("parallel/hints.py",),
+        what="the refcounted [replication] baseline",
     ),
     ConfigGuardRule(
         mutator_suffixes=("meshexec.configure", "_meshexec.configure"),

@@ -277,6 +277,11 @@ MESH_FAMILIES = _mf.live_prefixes("mesh")
 #: the residency family.
 TIER_FAMILIES = _mf.live_prefixes("tier")
 
+#: Self-healing replication families (parallel/syncer.py anti-entropy
+#: rounds, parallel/hints.py hinted handoff, models/fragment.py WAL
+#: replay health), rendered as ae_* / hint_* / wal_*.
+REPL_FAMILIES = _mf.live_prefixes("repl")
+
 #: Everything the ``--families`` CLI mode requires of a live server.
 ALL_FAMILIES = _mf.live_prefixes()
 

@@ -422,6 +422,12 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     disp0 = _vars_counter(host, "coalescer.dispatches")
     hedge0 = _vars_counter(host, "hedge.issued")
     hrpcs0 = _vars_counter(host, "hedge.rpcs")
+    # self-healing replication counters (hinted handoff + AE repair):
+    # the chaos report carries their deltas so a degraded-write run
+    # shows how many writes were hinted and whether they drained
+    hint0 = {n_: _vars_counter(host, n_)
+             for n_ in ("hint.queued", "hint.replayed", "hint.dropped",
+                        "ae.reconciled")}
     if chaos is not None:
         chaos.start()
     workers = [threading.Thread(target=worker, daemon=True)
@@ -454,6 +460,8 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     disp1 = _vars_counter(host, "coalescer.dispatches")
     hedge1 = _vars_counter(host, "hedge.issued")
     hrpcs1 = _vars_counter(host, "hedge.rpcs")
+    hint1 = {n_: _vars_counter(host, n_) for n_ in hint0}
+    hint_depth = _vars_counter(host, "hint.depth")
     hit_rate = None
     if cache0 is not None and cache1 is not None:
         dh = cache1[0] - cache0[0]
@@ -509,6 +517,17 @@ def run_load(host: str, index: str, qps: float, seconds: float,
         "chaos": (None if chaos is None else {
             "spec": chaos.spec,
             "windows": chaos.windows,
+            # hinted-handoff / anti-entropy view over the run window:
+            # writes that missed a replica and were queued, hints that
+            # drained back, and the residual queue depth at run end
+            # (nonzero = the replay worker is still catching up)
+            "hints": {
+                n_.replace(".", "_"): (
+                    None if hint1[n_] is None
+                    else hint1[n_] - (hint0[n_] or 0.0))
+                for n_ in hint0
+            },
+            "hint_depth_end": hint_depth,
             "hedge_issued": (None if hedge1 is None
                              else hedge1 - (hedge0 or 0.0)),
             "hedge_rate": (
