@@ -1331,6 +1331,116 @@ def bench_admission(coalescer_extras: dict | None) -> dict:
     return out
 
 
+def bench_tenants(coalescer_extras: dict | None) -> dict:
+    """[tenants] isolation cost + effect.
+
+    Two measurements: (1) the UNCONTENDED acquire+release pair with
+    isolation off vs on — the per-request tax every admitted request
+    pays, held to the same <1% budget as the admission/observe gates;
+    (2) an abusive-mix A/B at the controller — one tenant flooding
+    from 12 threads against a 2-thread victim on a 4-slot class, with
+    isolation off vs on — reporting the victim's queue-wait p99 both
+    ways (the isolation contract: the victim's wait must not degrade
+    with isolation ON vs OFF while the abuser floods)."""
+    import threading
+
+    from pilosa_tpu import stats as _stats
+    from pilosa_tpu.serve import tenant as _tenant
+    from pilosa_tpu.serve.admission import AdmissionController
+
+    out: dict = {"budget_pct": 1.0}
+    try:
+        n = 20000
+        _tenant.reset()
+        ctrl = AdmissionController(stats=_stats.MemStatsClient())
+        ctrl.acquire("query", tenant="t0").release()  # warm
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ctrl.acquire("query", tenant="t0").release()
+        off_us = (time.perf_counter() - t0) / n * 1e6
+        _tenant.configure(enabled=True,
+                          quotas={"t0": {"share": 8, "queue": 32}})
+        ctrl.acquire("query", tenant="t0").release()  # warm tenant path
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ctrl.acquire("query", tenant="t0").release()
+        on_us = (time.perf_counter() - t0) / n * 1e6
+        out["acquire_release_us_off"] = round(off_us, 3)
+        out["acquire_release_us_on"] = round(on_us, 3)
+        out["added_us"] = round(on_us - off_us, 3)
+        if coalescer_extras and coalescer_extras.get("qps"):
+            per_query_us = (coalescer_extras.get("threads", 16)
+                            / coalescer_extras["qps"] * 1e6)
+            out["pct_of_query"] = round(
+                max(0.0, on_us - off_us) / per_query_us * 100.0, 3)
+
+        def abusive(iso: bool) -> dict:
+            _tenant.reset()
+            if iso:
+                _tenant.configure(
+                    enabled=True, default_share=1, default_queue=8,
+                    quotas={"victim": {"share": 3, "queue": 32},
+                            "abuser": {"share": 1, "queue": 64}})
+            c = AdmissionController(query_cap=4, query_queue=128,
+                                    stats=_stats.MemStatsClient())
+            waits: dict = {"victim": [], "abuser": []}
+            shed = {"victim": 0, "abuser": 0}
+            lock = threading.Lock()
+            stop = time.perf_counter() + 0.75
+
+            def client(name: str):
+                from pilosa_tpu.serve.admission import ShedError
+
+                while time.perf_counter() < stop:
+                    try:
+                        tk = c.acquire("query", tenant=name)
+                    except ShedError:
+                        with lock:
+                            shed[name] += 1
+                        time.sleep(0.001)
+                        continue
+                    with lock:
+                        waits[name].append(tk.queue_wait_ns / 1e6)
+                    time.sleep(0.002)  # simulated service time
+                    tk.release()
+
+            threads = ([threading.Thread(target=client,
+                                         args=("abuser",))
+                        for _ in range(12)]
+                       + [threading.Thread(target=client,
+                                           args=("victim",))
+                          for _ in range(2)])
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            vw = sorted(waits["victim"])
+            return {
+                "victim_ok": len(vw),
+                "victim_wait_p99_ms": round(
+                    vw[int(0.99 * (len(vw) - 1))] if vw else 0.0, 3),
+                "victim_shed": shed["victim"],
+                "abuser_ok": len(waits["abuser"]),
+                "abuser_shed": shed["abuser"],
+            }
+
+        iso_on = abusive(True)
+        iso_off = abusive(False)
+        out["abusive"] = {
+            "isolation_on": iso_on,
+            "isolation_off": iso_off,
+            # the isolation contract (with margin for scheduler noise)
+            "pin_isolation_ok": (
+                iso_on["victim_wait_p99_ms"]
+                <= max(1.0, 1.5 * iso_off["victim_wait_p99_ms"])),
+        }
+    finally:
+        from pilosa_tpu.serve import tenant as _tenant2
+
+        _tenant2.reset()
+    return out
+
+
 def verify_product_path(a_np: np.ndarray, b_np: np.ndarray,
                         expect: int) -> None:
     """Bit-exactness of the REAL path: the PQL string through the
@@ -1549,6 +1659,7 @@ def main():
     if ctn is not None:
         extras["containers"] = ctn
     extras["faultinject"] = bench_faultinject()
+    extras["tenants"] = bench_tenants(co)
     msh = bench_mesh()
     if msh is not None:
         extras["mesh"] = msh

@@ -97,7 +97,8 @@ class API:
               cache: bool = True, delta: bool = True,
               containers: bool = True, mesh: bool = True,
               tiers: bool = True, partial: bool = False,
-              partial_meta: dict | None = None):
+              partial_meta: dict | None = None,
+              tenant: str | None = None):
         """Execute PQL -> list of results (api.go:135 API.Query).
 
         ``partial=True`` (the HTTP layer's ?partial=1 /
@@ -106,7 +107,13 @@ class API:
         shards only, and ``partial_meta`` (when given) is filled with
         ``missingShards`` (the exact unavailable set) and
         ``missingFraction``.  The default keeps all-or-error
-        semantics on an identical code path."""
+        semantics on an identical code path.
+
+        ``tenant`` is the request's tenant id (the HTTP layer's
+        X-Pilosa-Tenant / ?tenant=): it rides ExecOptions into the
+        executor, where admission quotas, result-cache soft budgets
+        and residency tier quotas charge it ([tenants] isolation;
+        inert while disabled)."""
         from pilosa_tpu.parallel.executor import ExecOptions
         from pilosa_tpu.serve import deadline as _deadline
 
@@ -140,8 +147,16 @@ class API:
                     and spmd.collective_available()):
                 rec = recorder.begin(index, pql,
                                      trace_id=_tracing.active_trace_id())
+                rec.tenant = tenant
             try:
-                with _observe.attach(rec):
+                # the collective upgrade bypasses the executor, so the
+                # tenant scope the executor would install goes here —
+                # without it, cache fills and residency admissions on
+                # this path charge the default tier, escaping the
+                # requesting tenant's quotas
+                from pilosa_tpu.serve import tenant as _tenantmod
+
+                with _observe.attach(rec), _tenantmod.scope(tenant):
                     res = spmd.try_collective(
                         self.node, index, pql,
                         exclude_row_attrs=exclude_row_attrs)
@@ -187,6 +202,7 @@ class API:
             deadline=dl,
             partial=partial,
             missing=set() if partial else None,
+            tenant=tenant,
         )
         results = self.executor.execute(index, pql, opt=opt)
         if partial_meta is not None:

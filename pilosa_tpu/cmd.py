@@ -163,6 +163,23 @@ def main(argv: list[str] | None = None) -> int:
                     help="seconds per anti-entropy slice before the "
                          "walk parks its cursor ([anti-entropy] "
                          "round-budget; 0 = whole holder per round)")
+    ps.add_argument("--tenants-enabled", action="store_true",
+                    help="enable per-tenant isolation ([tenants] "
+                         "enabled): weighted-fair admission, "
+                         "result-cache soft budgets and residency "
+                         "tier quotas per X-Pilosa-Tenant")
+    ps.add_argument("--tenant-default-share", type=int,
+                    help="concurrency share (per admission class) of "
+                         "tenants without their own quota ([tenants] "
+                         "default-share)")
+    ps.add_argument("--tenant-default-queue", type=int,
+                    help="per-class queue depth of tenants without "
+                         "their own quota ([tenants] default-queue)")
+    ps.add_argument("--tenant-quota", action="append", default=None,
+                    metavar="NAME:SHARE[:QUEUE[:CACHE[:RES]]]",
+                    help="per-tenant quota entry ([tenants] quotas); "
+                         "repeatable — e.g. --tenant-quota "
+                         "gold:16:64:0.5 --tenant-quota free:2:8")
     ps.add_argument("--verbose", action="store_true")
 
     pi = sub.add_parser("import", help="bulk-import CSV bits")
@@ -300,6 +317,19 @@ def cmd_server(args) -> int:
         v = getattr(args, f"ingest_{key}", None)
         if v is not None:
             setattr(cfg.ingest, key, v)
+    if args.tenants_enabled:
+        cfg.tenants.enabled = True
+    if args.tenant_default_share is not None:
+        cfg.tenants.default_share = args.tenant_default_share
+    if args.tenant_default_queue is not None:
+        cfg.tenants.default_queue = args.tenant_default_queue
+    if args.tenant_quota:
+        from pilosa_tpu.serve.tenant import parse_quota_spec
+
+        quotas = dict(cfg.tenants.quotas)
+        for spec in args.tenant_quota:
+            quotas.update(parse_quota_spec(spec))
+        cfg.tenants.quotas = quotas
     return run_server(cfg)
 
 
@@ -418,6 +448,13 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         anti_entropy_jitter=cfg.anti_entropy.jitter,
         anti_entropy_round_budget=cfg.anti_entropy.round_budget,
         anti_entropy_peer_timeout=cfg.anti_entropy.peer_timeout,
+        tenants_enabled=cfg.tenants.enabled,
+        tenants_default_share=cfg.tenants.default_share,
+        tenants_default_queue=cfg.tenants.default_queue,
+        tenants_default_cache_share=cfg.tenants.default_cache_share,
+        tenants_default_residency_share=(
+            cfg.tenants.default_residency_share),
+        tenants_quotas=cfg.tenants.quotas or None,
         logger=log,
         stats=stats,
     )

@@ -338,6 +338,33 @@ class AdmissionConfig:
 
 
 @dataclass
+class TenantsConfig:
+    """[tenants] — per-tenant isolation (serve/tenant.py; no reference
+    analog — the reference's executor has no notion of who a query
+    belongs to).  Disabled by default: a config with no [tenants]
+    table is byte-identical to pre-tenant behavior.  With ``enabled``
+    on, every request's tenant id (X-Pilosa-Tenant / ?tenant=; absent
+    = the default tier) is scheduled fairly inside each admission
+    class (``share`` concurrency slots + deficit-round-robin dequeue
+    weight, ``queue`` bounded per-class wait depth), charged a soft
+    ``cache-share`` fraction of the result-cache budget (eviction
+    prefers an over-budget tenant's own entries), and held to a
+    ``residency-share`` HBM/host-tier quota (an over-quota working
+    set demotes its own stacks).  ``default-*`` are the quota of every
+    tenant without its own ``[tenants.quotas.<name>]`` table entry;
+    ``quotas`` maps tenant name -> {share, queue, cache-share,
+    residency-share} (env form:
+    ``name:share[:queue[:cache_share[:residency_share]]],...``)."""
+
+    enabled: bool = False
+    default_share: int = 4
+    default_queue: int = 16
+    default_cache_share: float = 0.25
+    default_residency_share: float = 0.5
+    quotas: dict = field(default_factory=dict)
+
+
+@dataclass
 class TLSConfig:
     """[tls] (server/tlsconfig.go; config server/config.go:58-66)."""
 
@@ -378,6 +405,7 @@ class Config:
     residency: ResidencyConfig = field(default_factory=ResidencyConfig)
     faultinject: FaultinjectConfig = field(
         default_factory=FaultinjectConfig)
+    tenants: TenantsConfig = field(default_factory=TenantsConfig)
 
     # ------------------------------------------------------------- access
 
@@ -417,7 +445,7 @@ class Config:
                        "profile", "tls", "coalescer", "ragged",
                        "observe", "admission", "cache", "ingest",
                        "containers", "mesh", "residency",
-                       "faultinject") and isinstance(v, dict):
+                       "faultinject", "tenants") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
                     sname = sk.replace("-", "_")
@@ -440,7 +468,8 @@ class Config:
                                                         ContainersConfig,
                                                         MeshConfig,
                                                         ResidencyConfig,
-                                                        FaultinjectConfig)):
+                                                        FaultinjectConfig,
+                                                        TenantsConfig)):
                 setattr(self, key, v)
 
     def _apply_env(self, env: dict) -> None:
@@ -452,7 +481,7 @@ class Config:
                           "profile", "tls", "coalescer", "ragged",
                           "observe", "admission", "cache", "ingest",
                           "containers", "mesh", "residency",
-                          "faultinject"):
+                          "faultinject", "tenants"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -582,6 +611,17 @@ class Config:
             "[faultinject]",
             f'armed = "{self.faultinject.armed}"',
             "",
+            "[tenants]",
+            f"enabled = {str(self.tenants.enabled).lower()}",
+            f"default-share = {self.tenants.default_share}",
+            f"default-queue = {self.tenants.default_queue}",
+            f"default-cache-share = {self.tenants.default_cache_share}",
+            f"default-residency-share = "
+            f"{self.tenants.default_residency_share}",
+            *[line
+              for name, q in sorted(self.tenants.quotas.items())
+              for line in _tenant_quota_toml(name, q)],
+            "",
             "[tls]",
             f'certificate-path = "{self.tls.certificate_path}"',
             f'key-path = "{self.tls.key_path}"',
@@ -590,7 +630,30 @@ class Config:
         return "\n".join(lines) + "\n"
 
 
+def _tenant_quota_toml(name: str, q) -> list[str]:
+    """Render one [tenants.quotas.<name>] table (dict or TenantQuota)."""
+    get = (q.get if isinstance(q, dict)
+           else lambda k, d=None: getattr(q, k.replace("-", "_"), d))
+    out = [f'[tenants.quotas."{name}"]']
+    for key, default in (("share", 4), ("queue", 16),
+                         ("cache-share", 0.25),
+                         ("residency-share", 0.5)):
+        v = get(key, None)
+        if v is None and isinstance(q, dict):
+            v = q.get(key.replace("-", "_"))
+        out.append(f"{key} = {default if v is None else v}")
+    return out
+
+
 def _coerce(raw: str, current):
+    if isinstance(current, dict):
+        # tenant-quota spec: name:share[:queue[:cache:res]],...
+        from pilosa_tpu.serve.tenant import parse_quota_spec
+
+        return {n: {"share": q.share, "queue": q.queue,
+                    "cache_share": q.cache_share,
+                    "residency_share": q.residency_share}
+                for n, q in parse_quota_spec(raw).items()}
     if isinstance(current, bool):
         return raw.lower() in ("1", "true", "yes", "on")
     if isinstance(current, int):

@@ -58,6 +58,10 @@ SITES: dict[str, str] = {
         "InternalClient._request, after the response body is read",
     "executor.map_shard":
         "Executor local per-shard map, before each shard evaluates",
+    "admission.acquire":
+        "AdmissionController.acquire, before the gate decides — "
+        "error(shed) injects a deterministic refusal, delay(ms) a "
+        "queue-delay stall",
     "replica.write":
         "Executor._replicate_to_shard_owners, before each remote "
         "delivery",

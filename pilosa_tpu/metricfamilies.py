@@ -150,6 +150,12 @@ FAMILIES: tuple[Family, ...] = (
            "ignored at reload (models/fragment.py)",
            live_prefixes=("wal_",), group="repl",
            doc="administration.md"),
+    Family("tenant", "tenant_",
+           "per-tenant isolation totals: admission admitted/shed/"
+           "waiting, result-cache bytes, residency HBM/host bytes "
+           "(serve/tenant.py; zeros while [tenants] is off)",
+           live_prefixes=("tenant_",), group="tenant",
+           doc="administration.md"),
     Family("http", "http_",
            "per-route request counters (server/handler.py)"),
     Family("gc", "gc_",

@@ -195,6 +195,27 @@ class Holder:
         if first_err is not None:
             raise first_err
 
+    def reopen(self) -> None:
+        """Re-open a closed holder from its directory (Server.open
+        after close): re-acquire the flock and reload every index from
+        disk.  close() closed the WAL handles, so the old Index
+        objects are REBUILT from persisted state, not resurrected — a
+        no-op while the holder is still open (first open holds the
+        flock from construction), or for a pathless in-memory holder
+        (nothing persisted to reload)."""
+        if self.path is None or self._lock_file is not None:
+            return
+        self._acquire_dir_lock()
+        try:
+            with self._lock:
+                self.indexes = {}
+                self._load_node_id()
+                self._open_indexes()
+            self._prewarm_all()
+        except BaseException:
+            self._release_dir_lock()
+            raise
+
     def snapshot(self) -> None:
         for idx in self.indexes.values():
             idx.snapshot()

@@ -189,7 +189,7 @@ class QueryRecord:
         "launches", "path", "coalesce", "result_sizes", "error", "slow",
         "admission", "outcome", "compiles", "cached", "cache_key",
         "delta_notes", "compacted", "hedged", "hedge_wins",
-        "missing_shards", "tier_notes",
+        "missing_shards", "tier_notes", "tenant",
     )
 
     def __init__(self, qid: int, index: str, pql: str,
@@ -251,6 +251,12 @@ class QueryRecord:
         self.hedged = 0
         self.hedge_wins = 0
         self.missing_shards: list[int] = []
+        # the request's tenant id ([tenants] isolation; None for
+        # anonymous/default-tier traffic) — stamped by the executor
+        # from ExecOptions.tenant, rendered on /debug/queries and the
+        # slow-query log so abusive-tenant triage reads straight off
+        # the flight recorder
+        self.tenant: str | None = None
         # tiered-residency attribution (runtime/residency.py):
         # (outcome, ns) per tiered stack access — outcome one of
         # ``hbm`` (resident hit), ``promoted`` (waited for an async
@@ -358,6 +364,8 @@ class QueryRecord:
         }
         if self.cache_key is not None:
             d["cacheKey"] = self.cache_key
+        if self.tenant is not None:
+            d["tenant"] = self.tenant
         # streaming-ingest annotations: present only when the query
         # actually met a delta (the common no-ingest record stays small)
         if self.delta_notes:
@@ -461,7 +469,8 @@ class FlightRecorder:
 
     def record_shed(self, index: str, pql: str, klass: str,
                     outcome: str, reason: str,
-                    wait_ns: int = 0) -> None:
+                    wait_ns: int = 0,
+                    tenant: str | None = None) -> None:
         """A request refused at the admission gate never executes, so
         no record is begun for it — synthesize one straight into the
         ring buffer (outcome ``shed``/``expired``) so /debug/queries
@@ -472,6 +481,7 @@ class FlightRecorder:
             return
         rec = QueryRecord(next(self._seq), index, pql)
         rec.admission = {"class": klass, "queue_wait_ns": wait_ns}
+        rec.tenant = tenant
         rec.outcome = outcome
         rec.error = reason
         rec.elapsed_ns = wait_ns
@@ -523,12 +533,13 @@ class FlightRecorder:
             compile_ms = sum(ns for _, ns in rec.compiles) / 1e6
             self.logger.printf(
                 "slow query (%.3fs) trace=%s on %s: %s | stages=%s "
-                "shards=%d launches=%d path=%s compiled=%s%s",
+                "shards=%d launches=%d path=%s compiled=%s%s%s",
                 elapsed_s, rec.trace_id, rec.index, rec.pql,
                 ",".join(f"{n}:{v / 1e6:.1f}ms" for n, v in rec.stages),
                 rec.shards_n, len(rec.launches), rec.path or "-",
                 "true" if rec.compiles else "false",
-                f" compile_ms={compile_ms:.1f}" if rec.compiles else "")
+                f" compile_ms={compile_ms:.1f}" if rec.compiles else "",
+                f" tenant={rec.tenant}" if rec.tenant else "")
 
     # ------------------------------------------------------------- views
 

@@ -229,7 +229,8 @@ class Transport:
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   notiers: bool = False, partial: bool = False):
+                   notiers: bool = False, partial: bool = False,
+                   tenant: str | None = None):
         """Execute pql on the remote node restricted to `shards` with
         remote semantics (no re-translation).  Returns the result list.
         Raises TransportError if the node is unreachable.  ``nocache``
@@ -244,7 +245,9 @@ class Transport:
         forwards ?notiers=1 (peers bypass their tiered residency:
         inline rebuilds, drop-not-demote); ``partial``
         forwards ?partial=1 (degraded-read semantics ride sub-queries
-        like the other per-request escapes)."""
+        like the other per-request escapes); ``tenant`` forwards the
+        origin's tenant id as ?tenant= (the peer's admission gate,
+        result cache and residency tiers charge the same tenant)."""
         raise NotImplementedError
 
     def send_message(self, node: Node, message: dict) -> dict:
@@ -311,7 +314,8 @@ class LocalTransport(Transport):
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   notiers: bool = False, partial: bool = False):
+                   notiers: bool = False, partial: bool = False,
+                   tenant: str | None = None):
         from pilosa_tpu.parallel.executor import ExecOptions
 
         if node.id in self.down or node.id not in self.handles:
@@ -326,6 +330,7 @@ class LocalTransport(Transport):
                 containers=not nocontainers, mesh=not nomesh,
                 tiers=not notiers,
                 partial=partial, missing=set() if partial else None,
+                tenant=tenant,
             ),
         )
 
@@ -355,7 +360,8 @@ class BoundTransport(Transport):
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   notiers: bool = False, partial: bool = False):
+                   notiers: bool = False, partial: bool = False,
+                   tenant: str | None = None):
         self.parent._check_partition(self.src, node.id)
         extra = {}
         if nocache:
@@ -370,6 +376,8 @@ class BoundTransport(Transport):
             extra["notiers"] = True
         if partial:
             extra["partial"] = True
+        if tenant is not None:
+            extra["tenant"] = tenant
         if extra:
             return self.parent.query_node(node, index, pql, shards,
                                           **extra)

@@ -210,7 +210,8 @@ class Coalescer:
 
     def count(self, executor, idx, child, shards: tuple[int, ...],
               deadline=None, cache_fill=None,
-              use_delta: bool = True, mesh=None) -> int:
+              use_delta: bool = True, mesh=None,
+              tenant: str | None = None) -> int:
         """One Count(tree) query through the batching window -> total.
         Staging runs on the CALLER's thread (fragment locks, and a
         staging error belongs to this query alone).
@@ -223,6 +224,11 @@ class Coalescer:
         before its leaves were staged.  Entries dropped from the batch
         (deadline death, flush failure) raise out of ``fut.result()``
         and never fill.
+
+        ``tenant`` is the query's tenant id ([tenants] isolation):
+        tenants SHARE launches by design — batching across tenants is
+        the whole point of the window — but each member's cache fill
+        below charges its own tenant's soft budget.
 
         ``use_delta=False`` is the ?nodelta=1 escape, forwarded to
         staging.  Bucket keys stay delta-aware for free: a pending
@@ -283,7 +289,7 @@ class Coalescer:
         total = int(np.asarray(counts, dtype=np.int64)[:len(shards)].sum())
         if cache_fill is not None:
             rc, key, gens = cache_fill
-            rc.put(key, gens, total, 32)
+            rc.put(key, gens, total, 32, tenant=tenant)
         return total
 
     # ------------------------------------------------------------- flush

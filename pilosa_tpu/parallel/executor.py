@@ -54,6 +54,7 @@ from pilosa_tpu.pql import Call, Query, parse
 from pilosa_tpu.runtime import residency as _residency
 from pilosa_tpu.runtime import resultcache
 from pilosa_tpu.serve import deadline as _deadline
+from pilosa_tpu.serve import tenant as _tenantmod
 from pilosa_tpu.serve.deadline import DeadlineExceededError
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 from pilosa_tpu import faultinject as _fi
@@ -124,6 +125,13 @@ class ExecOptions:
     # widest shard fan-out this request targeted (stamped by
     # _target_shards) — the denominator of missingFraction
     targeted: int = 0
+    # the request's tenant id (the HTTP layer's X-Pilosa-Tenant header
+    # / ?tenant= param, forwarded on node-to-node sub-queries like
+    # ?nocache): installed as the thread-local tenant scope for the
+    # execution, so admission quotas, result-cache soft budgets and
+    # residency tier quotas all charge the right tenant.  None rides
+    # the default tier; with [tenants] off it is inert.
+    tenant: str | None = None
 
 
 class ExecutionError(ValueError):
@@ -318,8 +326,11 @@ class Executor:
         try:
             with _observe.attach(rec), \
                     _residency.no_tiers(not opt.tiers), \
+                    _tenantmod.scope(opt.tenant), \
                     tracing.start_span("executor.Execute") as span:
                 span.set_tag("index", index_name)
+                if rec is not None:
+                    rec.tenant = opt.tenant
                 if rec is not None:
                     # span -> record linkage: the record carries the
                     # exported trace id, the span the record id
@@ -492,25 +503,27 @@ class Executor:
     def _local_map(self, fn, shards, deadline=None):
         rec = _observe.current()
         notiers = _residency.tiers_off_scope()
+        tenant = _tenantmod.current()
         if rec is not None or deadline is not None or _fi.armed \
-                or notiers:
+                or notiers or tenant is not None:
             # re-attach the flight record on the pool workers so their
             # kernel launches tick it, time each shard's evaluation,
             # and bail before a shard whose deadline already expired —
             # expired work must never reach device dispatch.  The
-            # ?notiers scope re-installs the same way the record does:
-            # worker threads must honor the caller's escape.
+            # ?notiers scope and the tenant identity re-install the
+            # same way the record does: worker threads must honor the
+            # caller's escape and charge the caller's tenant.
             inner = fn
 
             def fn(shard, _inner=inner, _rec=rec, _dl=deadline,
-                   _nt=notiers):
+                   _nt=notiers, _ten=tenant):
                 if _fi.armed:
                     # failpoint: the production per-shard map
                     _fi.hit("executor.map_shard")
                 if _dl is not None and _dl.expired():
                     raise DeadlineExceededError(
                         f"deadline expired before map of shard {shard}")
-                with _residency.no_tiers(_nt):
+                with _residency.no_tiers(_nt), _tenantmod.scope(_ten):
                     if _rec is None:
                         return _inner(shard)
                     t0 = _time.perf_counter_ns()
@@ -597,6 +610,11 @@ class Executor:
                 # forward ?partial=1: degraded-read semantics ride
                 # sub-queries like the other per-request escapes
                 extra["partial"] = True
+            if opt is not None and opt.tenant:
+                # forward the tenant id: the peer's admission gate,
+                # result cache and residency tiers must charge the
+                # SAME tenant the origin did (exactly like ?nocache)
+                extra["tenant"] = opt.tenant
             if extra:
                 fut = self._submit_io(
                     lambda n, i, p, s, _e=extra:
@@ -1637,7 +1655,8 @@ class Executor:
                                             deadline=opt.deadline,
                                             cache_fill=probe,
                                             use_delta=opt.delta,
-                                            mesh=self._query_mesh(opt))
+                                            mesh=self._query_mesh(opt),
+                                            tenant=opt.tenant)
             t_f = _time.perf_counter_ns()
             total = sum(compute_counts(shards))
             if rec is not None:

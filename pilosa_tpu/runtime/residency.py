@@ -61,6 +61,7 @@ from collections import deque
 import numpy as np
 
 from pilosa_tpu import lockcheck as _lockcheck
+from pilosa_tpu.serve import tenant as _tenantmod
 from pilosa_tpu.serve.deadline import tls_scope as _tls_scope
 
 
@@ -268,10 +269,12 @@ class HostEntry:
     bytes back into an owner-cache entry (placement included)."""
 
     __slots__ = ("cache", "key", "token", "payload", "promote",
-                 "fallback", "nbytes", "kind", "devices", "spilled")
+                 "fallback", "nbytes", "kind", "devices", "spilled",
+                 "tenant")
 
     def __init__(self, cache: dict, key, token, payload, promote,
-                 nbytes: int, kind: str, devices: int, fallback=None):
+                 nbytes: int, kind: str, devices: int, fallback=None,
+                 tenant: str | None = None):
         self.cache = cache
         self.key = key
         self.token = token
@@ -285,6 +288,9 @@ class HostEntry:
         self.kind = kind
         self.devices = devices
         self.spilled: str | None = None  # .npz path when on disk
+        # the tenant whose query assembled these bytes ([tenants]
+        # isolation; None while off) — host-tier byte attribution
+        self.tenant = tenant
 
     def host_value(self):
         """The host-compute fallback value for this entry."""
@@ -356,10 +362,52 @@ class ResidencyManager:
         # and not yet touched by a query (prefetch.useful accounting)
         self._prefetched: set[tuple] = set()
         self.prefetch_useful = 0
+        # ---------------- per-tenant accounting ([tenants]) --------
+        # tenant -> HBM bytes / host-tier bytes its stacks hold, and
+        # the demotion PRESSURE charged to each tenant (evictions its
+        # over-quota admissions forced onto its own entries).  Only
+        # touched while the admitting thread carries a tenant scope.
+        self._tenant_bytes: dict[str, int] = {}
+        self._tenant_host_bytes: dict[str, int] = {}
+        self._tenant_pressure: dict[str, int] = {}
 
     @staticmethod
     def _id(cache: dict, key) -> tuple:
         return (id(cache), key)
+
+    # --------------------------------------------------- tenant hooks
+
+    @staticmethod
+    def _admitting_tenant(old_tenant: str | None) -> str | None:
+        """The tenant this admission charges: the thread-local scope
+        (the executor installs the request's id), inheriting the
+        entry's previous owner when the admitting thread is anonymous
+        (promotion workers, prefetch) — None while [tenants] is off."""
+        if not _tenantmod.enabled():
+            return None
+        t = _tenantmod.current()
+        if t is not None:
+            # through resolve(): the individuation bound collapses
+            # rotated unconfigured labels into the default tier
+            return _tenantmod.resolve(t)
+        return old_tenant or _tenantmod.DEFAULT_TENANT
+
+    @staticmethod
+    def _tenant_quota_bytes(t: str, budget: int) -> int:
+        """The tenant's share of ``budget`` (0 = unenforced)."""
+        pol = _tenantmod.policy()
+        if pol is None:
+            return 0
+        return int(budget * pol.quota_for(t).residency_share)
+
+    def _tenant_charge_locked(self, t: str | None, n: int) -> None:
+        if t is not None:
+            self._tenant_bytes[t] = self._tenant_bytes.get(t, 0) + n
+
+    def _tenant_host_charge_locked(self, t: str | None, n: int) -> None:
+        if t is not None:
+            self._tenant_host_bytes[t] = \
+                self._tenant_host_bytes.get(t, 0) + n
 
     # ---------------------------------------------------------- admit
 
@@ -387,16 +435,20 @@ class ResidencyManager:
         spill: list[HostEntry] = []
         with self._lock:
             old = self._entries.pop(eid, None)
+            ten = self._admitting_tenant(
+                old[5] if old is not None else None)
             if old is not None:
                 self.total -= old[2]
                 self._by_kind[old[3]] = \
                     self._by_kind.get(old[3], 0) - old[2]
                 self._per_device -= -(-old[2] // old[4])
+                self._tenant_charge_locked(old[5], -old[2])
             self._entries[eid] = (cache, key, nbytes, kind,
-                                  max(1, devices))
+                                  max(1, devices), ten)
             self.total += nbytes
             self._per_device += -(-nbytes // max(1, devices))
             self._by_kind[kind] = self._by_kind.get(kind, 0) + nbytes
+            self._tenant_charge_locked(ten, nbytes)
             self.admits += 1
             if prefetched:
                 self._prefetched.add(eid)
@@ -410,7 +462,22 @@ class ResidencyManager:
                 spill = self._host_put_locked(HostEntry(
                     cache, key, token, host, promote,
                     _payload_nbytes(host), kind, max(1, devices),
-                    fallback=fallback))
+                    fallback=fallback, tenant=ten))
+            if ten is not None:
+                # per-tenant HBM quota ([tenants] residency-share):
+                # an over-quota tenant demotes its OWN coldest stacks,
+                # never the fleet's zipfian head — the demotion
+                # pressure is charged to the tenant that caused it
+                tq = self._tenant_quota_bytes(ten, self.budget)
+                while (tq > 0
+                       and self._tenant_bytes.get(ten, 0) > tq):
+                    vid = next((v for v, e in self._entries.items()
+                                if e[5] == ten and v != eid), None)
+                    if vid is None:
+                        break
+                    self._evict_one_locked(vid)
+                    self._tenant_pressure[ten] = \
+                        self._tenant_pressure.get(ten, 0) + 1
             while self.total > self.budget and len(self._entries) > 1:
                 victim_id = next(iter(self._entries))
                 if victim_id == eid:
@@ -431,10 +498,11 @@ class ResidencyManager:
         """Drop one HBM entry (owner-dict pop included), demoting —
         i.e. leaving its host-tier twin in place — when one exists."""
         (vcache, vkey, vbytes, vkind,
-         vdev) = self._entries.pop(victim_id)
+         vdev, vtenant) = self._entries.pop(victim_id)
         self.total -= vbytes
         self._per_device -= -(-vbytes // vdev)
         self._by_kind[vkind] = self._by_kind.get(vkind, 0) - vbytes
+        self._tenant_charge_locked(vtenant, -vbytes)
         self.evictions += 1
         self._prefetched.discard(victim_id)
         if victim_id in self._host or victim_id in self._disk:
@@ -453,10 +521,29 @@ class ResidencyManager:
         old = self._host.pop(eid, None)
         if old is not None:
             self._host_bytes -= old.nbytes
+            self._tenant_host_charge_locked(old.tenant, -old.nbytes)
         self._drop_disk_locked(eid)
         self._host[eid] = ent
         self._host_bytes += ent.nbytes
+        self._tenant_host_charge_locked(ent.tenant, ent.nbytes)
         victims: list[HostEntry] = []
+        if ent.tenant is not None:
+            # per-tenant host-tier quota (residency-share of the host
+            # budget): an over-quota tenant's own oldest host entries
+            # overflow first — the HBM rule, applied to the tier
+            tq = self._tenant_quota_bytes(ent.tenant,
+                                          _cfg.host_budget_bytes)
+            while (tq > 0
+                   and self._tenant_host_bytes.get(ent.tenant, 0) > tq):
+                vid = next((v for v, e in self._host.items()
+                            if e.tenant == ent.tenant and v != eid),
+                           None)
+                if vid is None:
+                    break
+                v = self._host.pop(vid)
+                self._host_bytes -= v.nbytes
+                self._tenant_host_charge_locked(v.tenant, -v.nbytes)
+                victims.append(v)
         while (self._host_bytes > _cfg.host_budget_bytes
                and len(self._host) > 1):
             vid = next(iter(self._host))
@@ -465,6 +552,7 @@ class ResidencyManager:
                 continue
             v = self._host.pop(vid)
             self._host_bytes -= v.nbytes
+            self._tenant_host_charge_locked(v.tenant, -v.nbytes)
             victims.append(v)
         return victims
 
@@ -493,7 +581,7 @@ class ResidencyManager:
                 continue
             d = HostEntry(v.cache, v.key, v.token, None, v.promote,
                           v.nbytes, v.kind, v.devices,
-                          fallback=v.fallback)
+                          fallback=v.fallback, tenant=v.tenant)
             d.spilled = path
             with self._lock:
                 eid = v.eid
@@ -565,7 +653,8 @@ class ResidencyManager:
                                       loaded.token, payload,
                                       loaded.promote, loaded.nbytes,
                                       loaded.kind, loaded.devices,
-                                      fallback=loaded.fallback)
+                                      fallback=loaded.fallback,
+                                      tenant=loaded.tenant)
                     spill = self._host_put_locked(fresh)
                     self.disk_hits += 1
             if spill:
@@ -578,6 +667,7 @@ class ResidencyManager:
             if e.token != token:
                 self._host.pop(eid, None)
                 self._host_bytes -= e.nbytes
+                self._tenant_host_charge_locked(e.tenant, -e.nbytes)
                 self.tier_misses += 1
                 return None
             self._host[eid] = self._host.pop(eid)  # LRU touch
@@ -626,9 +716,11 @@ class ResidencyManager:
                 self.total -= e[2]
                 self._per_device -= -(-e[2] // e[4])
                 self._by_kind[e[3]] = self._by_kind.get(e[3], 0) - e[2]
+                self._tenant_charge_locked(e[5], -e[2])
             h = self._host.pop(eid, None)
             if h is not None:
                 self._host_bytes -= h.nbytes
+                self._tenant_host_charge_locked(h.tenant, -h.nbytes)
             self._drop_disk_locked(eid)
 
     def demote(self, cache: dict, key) -> None:
@@ -647,6 +739,7 @@ class ResidencyManager:
                 self.total -= e[2]
                 self._per_device -= -(-e[2] // e[4])
                 self._by_kind[e[3]] = self._by_kind.get(e[3], 0) - e[2]
+                self._tenant_charge_locked(e[5], -e[2])
                 if eid in self._host or eid in self._disk:
                     self.demotions += 1
 
@@ -666,6 +759,7 @@ class ResidencyManager:
             self.total = 0
             self._per_device = 0
             self._by_kind.clear()
+            self._tenant_bytes.clear()
             self._prefetched.clear()
             self.evictions += len(victims)
             self.demotions += n_demoted
@@ -674,7 +768,7 @@ class ResidencyManager:
             # fresh entry for the same key between our snapshot and
             # pop — we would drop ITS tensor while _entries still
             # tracks it, permanently skewing the byte accounting
-            for vcache, vkey, _vbytes, _vkind, _vdev in victims:
+            for vcache, vkey, *_rest in victims:
                 vcache.pop(vkey, None)
         return len(victims)
 
@@ -708,7 +802,31 @@ class ResidencyManager:
                     # roaring-on-TPU capacity story; /debug/devices)
                     "kinds": {k: v for k, v in self._by_kind.items()
                               if v},
+                    "tenants": {t: v for t, v
+                                in self._tenant_bytes.items() if v},
                     "tiers": self._tier_stats_locked()}
+
+    def tenant_stats(self) -> dict[str, dict]:
+        """Per-tenant residency accounting — the residency half of
+        GET /debug/tenants: HBM bytes, host-tier bytes, the HBM quota
+        in force, and the demotion pressure charged to each tenant.
+        Empty until a tenant-attributed admission happens."""
+        with self._lock:
+            names = (set(self._tenant_bytes)
+                     | set(self._tenant_host_bytes)
+                     | set(self._tenant_pressure))
+            out = {}
+            for t in sorted(names):
+                d = {
+                    "hbmBytes": self._tenant_bytes.get(t, 0),
+                    "hostBytes": self._tenant_host_bytes.get(t, 0),
+                    "pressure": self._tenant_pressure.get(t, 0),
+                }
+                q = self._tenant_quota_bytes(t, self.budget)
+                if q:
+                    d["hbmQuota"] = q
+                out[t] = d
+            return out
 
     def _tier_stats_locked(self) -> dict:
         return {
@@ -791,8 +909,9 @@ class ResidencyManager:
         with self._lock:
             entries = sorted(self._entries.values(), key=lambda e: -e[2])[:n]
         return [{"key": repr(key)[:160], "bytes": nbytes,
-                 "kind": kind, "devices": devices}
-                for _, key, nbytes, kind, devices in entries]
+                 "kind": kind, "devices": devices,
+                 **({"tenant": tenant} if tenant is not None else {})}
+                for _, key, nbytes, kind, devices, tenant in entries]
 
     def close(self) -> None:
         """Drop spill files (reset/test teardown)."""
@@ -801,6 +920,7 @@ class ResidencyManager:
                 self._drop_disk_locked(eid)
             self._host.clear()
             self._host_bytes = 0
+            self._tenant_host_bytes.clear()
 
 
 _global: ResidencyManager | None = None

@@ -57,11 +57,23 @@ the uncoordinated behavior, never an error.  A stamp moved by a newer
 write never joins an older flight (and vice versa): mismatched stamps
 compute independently, so single-flight can not serve stale data.
 
+Per-tenant soft budgets (the [tenants] round, serve/tenant.py): with
+isolation enabled every entry is charged to the tenant that filled it
+(the executor's thread-local tenant scope), each tenant's soft budget
+is its ``cache_share`` of the global budget, and the eviction loop
+prefers the oldest entry OF AN OVER-BUDGET TENANT before touching the
+global LRU order — so one tenant churning distinct keys evicts its own
+entries, never the fleet's warm head.  Budgets are soft (a tenant may
+transiently exceed its share when the cache has global headroom); the
+global budget stays strict.  With [tenants] off the tenant structures
+are never consulted — byte-identical behavior, regression-pinned.
+
 Surface: ``[cache]`` config (budget bytes, max entry bytes, ttl,
 enabled), ``?nocache=1`` on the query route (symmetric with
 ``?nocoalesce``), ``cached``/``cacheKey`` on every flight record,
 ``cache.{hits,misses,fills,evictions,invalidations,bytes,
-flight_joins,flight_served}`` gauge families on /metrics, and
+flight_joins,flight_served}`` gauge families on /metrics, per-tenant
+bytes/hit-rates on ``GET /debug/tenants``, and
 ``GET /debug/resultcache``.
 """
 
@@ -72,6 +84,8 @@ import hashlib
 import threading
 import time
 from typing import Any
+
+from pilosa_tpu.serve import tenant as _tenant
 
 
 #: Defaults; the server assembly reconfigures from [cache] config.
@@ -122,14 +136,16 @@ class Key:
 
 
 class _Entry:
-    __slots__ = ("gens", "value", "nbytes", "t", "hits")
+    __slots__ = ("gens", "value", "nbytes", "t", "hits", "tenant")
 
-    def __init__(self, gens: Any, value: object, nbytes: int) -> None:
+    def __init__(self, gens: Any, value: object, nbytes: int,
+                 tenant: str | None = None) -> None:
         self.gens = gens
         self.value = value
         self.nbytes = nbytes
         self.t = time.monotonic()
         self.hits = 0
+        self.tenant = tenant
 
 
 class _Flight:
@@ -188,11 +204,87 @@ class ResultCache:
         self.skipped_oversize = 0
         self.flight_joins = 0
         self.flight_served = 0
+        # ---------------- per-tenant accounting ([tenants]) --------
+        # tenant -> live bytes; tenant -> ordered key set (per-tenant
+        # LRU, mirroring the global order's move-to-end); tenant ->
+        # [hits, misses, fills, evictions].  Touched only while a
+        # tenant id is attributable (isolation on) — the anonymous
+        # path never pays the dict ops.
+        self._tenant_bytes: dict[str, int] = {}
+        self._tenant_lru: dict[str, dict] = {}
+        self._tenant_counters: dict[str, list] = {}
+        self.tenant_pref_evictions = 0  # over-budget-tenant victims
 
-    # -------------------------------------------------------------- access
+    # ------------------------------------------------- tenant helpers
+
+    @staticmethod
+    def _caller_tenant(tenant: str | None) -> str | None:
+        """The tenant this access charges against: an explicit id, or
+        the executor's thread-local scope — None (no accounting at
+        all) while [tenants] isolation is off."""
+        if not _tenant.enabled():
+            # no accounting at all while isolation is off — returning
+            # an explicit label here would mint per-label dict keys
+            # from unauthenticated traffic on the DEFAULT config
+            return None
+        # explicit ids (coalescer fills) pass the individuation bound
+        # too, so rotated labels collapse consistently
+        return _tenant.resolve(tenant if tenant is not None
+                               else _tenant.current())
+
+    def _tc_locked(self, t: str) -> list:
+        c = self._tenant_counters.get(t)
+        if c is None:
+            c = self._tenant_counters[t] = [0, 0, 0, 0]
+        return c
+
+    def _tenant_track_locked(self, key: Any, e: _Entry) -> None:
+        if e.tenant is None:
+            return
+        self._tenant_bytes[e.tenant] = \
+            self._tenant_bytes.get(e.tenant, 0) + e.nbytes
+        self._tenant_lru.setdefault(e.tenant, {})[key] = None
+
+    def _tenant_untrack_locked(self, key: Any, e: _Entry) -> None:
+        if e.tenant is None:
+            return
+        self._tenant_bytes[e.tenant] = \
+            self._tenant_bytes.get(e.tenant, 0) - e.nbytes
+        lru = self._tenant_lru.get(e.tenant)
+        if lru is not None:
+            lru.pop(key, None)
+
+    def _tenant_touch_locked(self, key: Any, e: _Entry) -> None:
+        if e.tenant is None:
+            return
+        lru = self._tenant_lru.get(e.tenant)
+        if lru is not None and key in lru:
+            lru[key] = lru.pop(key)
+
+    def _victim_key_locked(self, protect: Any) -> Any:
+        """The next eviction victim: the oldest entry of any tenant
+        over its soft budget (its churn evicts ITS OWN entries first —
+        the isolation contract), else the global LRU head.  Never the
+        entry being inserted (``protect``)."""
+        pol = _tenant.policy()
+        if pol is not None and self._tenant_bytes:
+            for t, b in self._tenant_bytes.items():
+                if b <= int(self.budget * pol.quota_for(t).cache_share):
+                    continue
+                for k in self._tenant_lru.get(t, ()):
+                    if k != protect:
+                        self.tenant_pref_evictions += 1
+                        return k
+        for k in self._entries:
+            if k != protect:
+                return k
+        return None
+
+    # -------------------------------------------------------- access
 
     def get(self, key: Any, gens: Any,
-            wait_s: float = FLIGHT_WAIT_S) -> tuple[bool, object]:
+            wait_s: float = FLIGHT_WAIT_S,
+            tenant: str | None = None) -> tuple[bool, object]:
         """(hit, value).  ``gens`` is the CURRENT generation tuple the
         caller just computed from the live fragments; a stored stamp
         that differs means some participating fragment mutated (or was
@@ -206,6 +298,7 @@ class ResultCache:
         ``wait_s=0`` to never wait (pure probe)."""
         if not self.enabled:
             return False, None
+        t = self._caller_tenant(tenant)
         budget = wait_s
         while True:
             with self._lock:
@@ -215,16 +308,22 @@ class ResultCache:
                             self.ttl_s > 0
                             and time.monotonic() - e.t > self.ttl_s):
                         self._entries[key] = self._entries.pop(key)
+                        self._tenant_touch_locked(key, e)
                         e.hits += 1
                         self.hits += 1
+                        if t is not None:
+                            self._tc_locked(t)[0] += 1
                         return True, e.value
                     del self._entries[key]
                     self.bytes -= e.nbytes
+                    self._tenant_untrack_locked(key, e)
                     self.invalidations += 1
                 if key in self._noflight:
                     # last fill for this key was refused (oversize):
                     # waiting could never turn into a hit
                     self.misses += 1
+                    if t is not None:
+                        self._tc_locked(t)[1] += 1
                     return False, None
                 fl = self._flights.get(key)
                 now = time.monotonic()
@@ -248,10 +347,14 @@ class ResultCache:
                                 self._flights.pop(k).event.set()
                         self._flights[key] = _Flight(gens)
                     self.misses += 1
+                    if t is not None:
+                        self._tc_locked(t)[1] += 1
                     return False, None
                 if budget <= 0:
                     # joinable fill but the caller can't wait
                     self.misses += 1
+                    if t is not None:
+                        self._tc_locked(t)[1] += 1
                     return False, None
                 self.flight_joins += 1
                 remaining = min(budget, FLIGHT_TTL_S - (now - fl.t0))
@@ -266,8 +369,11 @@ class ResultCache:
                     e = self._entries.get(key)
                     if e is not None and e.gens == gens:
                         self._entries[key] = self._entries.pop(key)
+                        self._tenant_touch_locked(key, e)
                         e.hits += 1
                         self.hits += 1
+                        if t is not None:
+                            self._tc_locked(t)[0] += 1
                         self.flight_served += 1
                         return True, e.value
                     budget = 0  # resolved without a usable fill
@@ -275,12 +381,15 @@ class ResultCache:
             # next pass — budget is spent, so the re-entry can't wait
 
     def put(self, key: Any, gens: Any, value: object,
-            nbytes: int) -> bool:
+            nbytes: int, tenant: str | None = None) -> bool:
         """Insert one result stamped with the generations captured
         BEFORE its inputs were read.  Returns False when the entry was
         refused (disabled / oversize / bigger than the whole budget).
         Every outcome resolves an open flight for the key — waiters
-        must never outlive their leader's attempt."""
+        must never outlive their leader's attempt.  With [tenants]
+        isolation on, the fill is charged to ``tenant`` (or the
+        thread-local tenant scope) and eviction prefers over-budget
+        tenants' own entries."""
         if not self.enabled:
             return False
         from pilosa_tpu import faultinject as _fi
@@ -290,6 +399,7 @@ class ResultCache:
             # error here surfaces to the filling query; waiters'
             # bounded flight wait covers the unresolved flight)
             _fi.hit("resultcache.fill")
+        t = self._caller_tenant(tenant)
         nbytes = int(nbytes) + ENTRY_OVERHEAD_BYTES
         if nbytes > self.max_entry_bytes or nbytes > self.budget:
             with self._lock:
@@ -304,19 +414,30 @@ class ResultCache:
             old = self._entries.pop(key, None)
             if old is not None:
                 self.bytes -= old.nbytes
-            self._entries[key] = _Entry(gens, value, nbytes)
+                self._tenant_untrack_locked(key, old)
+            e = _Entry(gens, value, nbytes, tenant=t)
+            self._entries[key] = e
             self.bytes += nbytes
+            self._tenant_track_locked(key, e)
             self.fills += 1
+            if t is not None:
+                self._tc_locked(t)[2] += 1
             self._resolve_flight_locked(key)
-            # strict budget: evict LRU until under — the entry just
-            # inserted is newest and falls last, and since it fits the
-            # budget on its own (checked above) the loop terminates
-            # with it retained
-            while self.bytes > self.budget and self._entries:
-                vk = next(iter(self._entries))
+            # strict budget: evict until under — over-budget tenants'
+            # oldest entries first (their churn displaces themselves),
+            # then global LRU.  The entry just inserted is never a
+            # victim, and since it fits the budget on its own (checked
+            # above) the loop terminates with it retained.
+            while self.bytes > self.budget and len(self._entries) > 1:
+                vk = self._victim_key_locked(key)
+                if vk is None:
+                    break
                 ve = self._entries.pop(vk)
                 self.bytes -= ve.nbytes
+                self._tenant_untrack_locked(vk, ve)
                 self.evictions += 1
+                if ve.tenant is not None:
+                    self._tc_locked(ve.tenant)[3] += 1
             return True
 
     def _resolve_flight_locked(self, key: Any) -> None:
@@ -332,6 +453,8 @@ class ResultCache:
             n = len(self._entries)
             self._entries.clear()
             self.bytes = 0
+            self._tenant_bytes.clear()
+            self._tenant_lru.clear()
             self.invalidations += n
             for fl in self._flights.values():
                 fl.event.set()
@@ -358,12 +481,41 @@ class ResultCache:
                 "flightJoins": self.flight_joins,
                 "flightServed": self.flight_served,
                 "flightsOpen": len(self._flights),
+                "tenantPrefEvictions": self.tenant_pref_evictions,
             }
+
+    def tenant_stats(self) -> dict[str, dict]:
+        """Per-tenant cache accounting — the result-cache half of
+        GET /debug/tenants: live bytes, soft budget, and the
+        hit/miss/fill/eviction counters an abusive-tenant triage
+        reads.  Empty until a tenant-attributed access happens."""
+        pol = _tenant.policy()
+        out: dict[str, dict] = {}
+        with self._lock:
+            names = set(self._tenant_bytes) | set(self._tenant_counters)
+            for t in sorted(names):
+                c = self._tenant_counters.get(t, [0, 0, 0, 0])
+                d = {
+                    "bytes": self._tenant_bytes.get(t, 0),
+                    "entries": len(self._tenant_lru.get(t, ())),
+                    "hits": c[0],
+                    "misses": c[1],
+                    "fills": c[2],
+                    "evictions": c[3],
+                }
+                if pol is not None:
+                    d["softBudget"] = int(
+                        self.budget * pol.quota_for(t).cache_share)
+                out[t] = d
+        return out
 
     def debug(self, top_n: int = 32) -> dict[str, Any]:
         """The /debug/resultcache document: totals plus the largest
         entries (key digest + human-readable key, bytes, age, hits)."""
         out = self.stats_dict()
+        tstats = self.tenant_stats()
+        if tstats:
+            out["tenants"] = tstats
         now = time.monotonic()
         with self._lock:
             entries = sorted(self._entries.items(),

@@ -1,0 +1,345 @@
+"""Per-tenant isolation: the process-wide [tenants] policy and the
+thread-local tenant identity every shared resource charges against.
+
+The reference's serving model has no notion of who a query belongs to
+— one shared executor map-reduces every tenant's PQL over the same
+shard pool (executor.go:2455), so one flooding client degrades
+everyone.  ROADMAP item 5 names the gap ("per-tenant admission quotas
+and result-cache budgets ... one abusive tenant can't evict or starve
+the rest"); this module is the policy half of the fix:
+
+- **Identity** — a tenant id rides the ``X-Pilosa-Tenant`` header (or
+  ``?tenant=`` for tools), handler -> api -> ``ExecOptions.tenant`` ->
+  executor, forwarded on node-to-node sub-queries exactly like
+  ``?nocache``.  Requests with no id resolve to :data:`DEFAULT_TENANT`
+  (the default tier).  The executor installs the id as a thread-local
+  :class:`scope`, re-installed on map workers like the flight record,
+  so the result cache and the residency manager can attribute bytes
+  without threading a parameter through every call site.
+- **Policy** — a :class:`TenantQuota` per configured tenant (plus a
+  default tier for unknown ones): ``share`` is both the tenant's
+  concurrency slots inside each admission class and its deficit-
+  round-robin dequeue weight (serve/admission.py); ``queue`` bounds
+  its per-class wait queue; ``cache_share`` / ``residency_share`` are
+  the tenant's soft fraction of the result-cache byte budget and its
+  HBM/host-tier residency quota (runtime/resultcache.py,
+  runtime/residency.py).
+- **Default-off** — ``[tenants] enabled = false`` (the default) keeps
+  every enforcement site on its exact pre-tenant path
+  (:func:`policy` returns None and the hot paths never touch tenant
+  state), so a config with no ``[tenants]`` table is byte-identical
+  to today's behavior — regression-pinned in tests/test_tenants.py.
+
+Process-wide configuration mirrors ``[mesh]``: ``configure`` applies
+explicit values in place, the FIRST server to ``retain()`` captures
+the pre-server baseline and the LAST ``release()`` restores it
+(pilosa-lint P5).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+from pilosa_tpu.serve.deadline import tls_scope as _tls_scope
+
+#: The tier every request with no tenant id (and every id without its
+#: own ``[tenants.quotas.*]`` entry when ``strict`` naming is not a
+#: thing we do) charges against.
+DEFAULT_TENANT = "default"
+
+#: Tenant ids are operator-facing labels, not payloads: cap the length
+#: so a hostile header cannot grow per-tenant tables without bound.
+MAX_TENANT_LEN = 64
+
+#: Bound on DISTINCT unconfigured labels the policy individuates per
+#: process.  The header is client-asserted, so a client rotating
+#: arbitrary labels (a1, a2, a3, ...) would otherwise mint a fresh
+#: default-tier quota — and a fresh admission/cache/residency state
+#: entry — per label, multiplying its capacity by the rotation width
+#: and growing per-tenant tables without bound.  Past the cap, new
+#: unconfigured labels collapse into the shared default tier: they
+#: still serve, they just share one quota.  Configured tenants are
+#: never collapsed.
+MAX_TRACKED_TENANTS = 256
+
+
+@dataclass(frozen=True)
+class TenantQuota:
+    """One tenant's resource quotas.
+
+    ``share`` — concurrency slots inside EACH admission class, and the
+    tenant's deficit-round-robin weight when queued slots free up.
+    ``queue`` — bounded wait-queue depth inside each class; an arrival
+    past it sheds 429 ``tenant-queue-full`` (the "I am over quota"
+    signal, distinct from the class-wide ``queue-full``).
+    ``cache_share`` — soft fraction of the result-cache byte budget;
+    past it, LRU eviction prefers this tenant's own entries.
+    ``residency_share`` — fraction of the HBM (and host-tier) budget
+    this tenant's stacks may hold before its own coldest stacks
+    demote — an abusive working set demotes itself, not the zipfian
+    head."""
+
+    share: int = 4
+    queue: int = 16
+    cache_share: float = 0.25
+    residency_share: float = 0.5
+
+
+class TenantsRuntimeConfig:
+    """The process-wide [tenants] knobs (one per process, like the
+    [mesh] runtime config)."""
+
+    __slots__ = ("enabled", "default_quota", "quotas", "seen")
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.default_quota = TenantQuota()
+        self.quotas: dict[str, TenantQuota] = {}
+        # distinct UNCONFIGURED labels individuated so far (bounded by
+        # MAX_TRACKED_TENANTS; set.add is atomic under the GIL, and a
+        # lost race merely individuates one extra label)
+        self.seen: set[str] = set()
+
+    def quota_for(self, name: str) -> TenantQuota:
+        return self.quotas.get(name, self.default_quota)
+
+    def account(self, name: str) -> str:
+        """The accounting identity for ``name``: itself while it is
+        configured, already individuated, or within the individuation
+        bound — else the shared :data:`DEFAULT_TENANT` tier."""
+        if name == DEFAULT_TENANT or name in self.quotas \
+                or name in self.seen:
+            return name
+        if len(self.seen) >= MAX_TRACKED_TENANTS:
+            return DEFAULT_TENANT
+        self.seen.add(name)
+        return name
+
+
+_cfg = TenantsRuntimeConfig()
+_cfg_lock = threading.Lock()
+_baseline: tuple | None = None
+_refs = 0
+
+
+def config() -> TenantsRuntimeConfig:
+    return _cfg
+
+
+def policy() -> TenantsRuntimeConfig | None:
+    """The enforcement gate every per-tenant site consults: the config
+    while [tenants] is enabled, else None — one attribute read on the
+    disabled hot path, so default-config behavior stays byte-identical
+    to pre-tenant code."""
+    return _cfg if _cfg.enabled else None
+
+
+def enabled() -> bool:
+    return _cfg.enabled
+
+
+def _coerce_quota(raw) -> TenantQuota:
+    if isinstance(raw, TenantQuota):
+        return raw
+    if not isinstance(raw, dict):
+        raise ValueError(f"tenant quota must be a table, got {raw!r}")
+    d = {k.replace("-", "_"): v for k, v in raw.items()}
+    unknown = set(d) - {"share", "queue", "cache_share",
+                        "residency_share"}
+    if unknown:
+        raise ValueError(
+            f"unknown tenant quota keys: {sorted(unknown)} "
+            "(share, queue, cache-share, residency-share)")
+    base = TenantQuota()
+    q = TenantQuota(
+        share=int(d.get("share", base.share)),
+        queue=int(d.get("queue", base.queue)),
+        cache_share=float(d.get("cache_share", base.cache_share)),
+        residency_share=float(d.get("residency_share",
+                                    base.residency_share)))
+    if q.share < 1 or q.queue < 0:
+        raise ValueError(f"tenant quota out of range: {q}")
+    return q
+
+
+def parse_quota_spec(spec: str) -> dict[str, TenantQuota]:
+    """Compact quota spec for the CLI/env surface:
+    ``name:share[:queue[:cache_share[:residency_share]]]`` entries,
+    comma-separated — ``gold:16:64:0.5,free:2:8``."""
+    out: dict[str, TenantQuota] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) < 2 or not bits[0]:
+            raise ValueError(
+                f"bad tenant quota entry {part!r} "
+                "(name:share[:queue[:cache_share[:residency_share]]])")
+        base = TenantQuota()
+        out[bits[0]] = _coerce_quota({
+            "share": int(bits[1]),
+            "queue": int(bits[2]) if len(bits) > 2 else base.queue,
+            "cache_share": (float(bits[3]) if len(bits) > 3
+                            else base.cache_share),
+            "residency_share": (float(bits[4]) if len(bits) > 4
+                                else base.residency_share)})
+    return out
+
+
+def configure(enabled: bool | None = None,
+              default_share: int | None = None,
+              default_queue: int | None = None,
+              default_cache_share: float | None = None,
+              default_residency_share: float | None = None,
+              quotas: dict | None = None) -> TenantsRuntimeConfig:
+    """Apply [tenants] config in place — only explicit values land
+    (the containers.configure contract).  ``quotas`` maps tenant name
+    to a quota table/:class:`TenantQuota` and REPLACES the configured
+    set (per-tenant quotas are one coherent table, not a merge)."""
+    parsed = (None if quotas is None
+              else {str(n): _coerce_quota(q) for n, q in quotas.items()})
+    with _cfg_lock:
+        if enabled is not None:
+            _cfg.enabled = bool(enabled)
+        d = _cfg.default_quota
+        _cfg.default_quota = TenantQuota(
+            share=int(default_share) if default_share is not None
+            else d.share,
+            queue=int(default_queue) if default_queue is not None
+            else d.queue,
+            cache_share=float(default_cache_share)
+            if default_cache_share is not None else d.cache_share,
+            residency_share=float(default_residency_share)
+            if default_residency_share is not None
+            else d.residency_share)
+        if _cfg.default_quota.share < 1 or _cfg.default_quota.queue < 0:
+            raise ValueError(
+                f"default tenant quota out of range: {_cfg.default_quota}")
+        if parsed is not None:
+            _cfg.quotas = parsed
+    return _cfg
+
+
+def retain() -> None:
+    """Take a server reference; the FIRST holder snapshots the
+    pre-server baseline config (restore composes correctly under any
+    close order — the PR-6 [ingest] lesson, pilosa-lint P5)."""
+    global _refs, _baseline
+    with _cfg_lock:
+        if _refs == 0 and _baseline is None:
+            _baseline = (_cfg.enabled, _cfg.default_quota,
+                         dict(_cfg.quotas))
+        _refs += 1
+
+
+def release() -> None:
+    """Drop a server reference; the LAST holder restores the baseline."""
+    global _refs, _baseline
+    with _cfg_lock:
+        if _refs > 0:
+            _refs -= 1
+        if _refs == 0 and _baseline is not None:
+            _cfg.enabled, _cfg.default_quota = _baseline[0], _baseline[1]
+            _cfg.quotas = dict(_baseline[2])
+            _cfg.seen = set()
+            _baseline = None
+
+
+def reset() -> TenantsRuntimeConfig:
+    """Replace the process-wide config (tests)."""
+    global _cfg, _baseline, _refs
+    with _cfg_lock:
+        _cfg = TenantsRuntimeConfig()
+        _baseline = None
+        _refs = 0
+        return _cfg
+
+
+# --------------------------------------------------------- identity
+
+
+def clean(raw: str | None) -> str | None:
+    """Normalize a wire-supplied tenant id: stripped, length-capped,
+    empty -> None.  Never raises — a malformed label degrades to the
+    default tier, not a 400 (the id is an accounting key, not a
+    credential)."""
+    if raw is None:
+        return None
+    t = str(raw).strip()
+    if not t:
+        return None
+    return t[:MAX_TENANT_LEN]
+
+
+def resolve(tenant: str | None) -> str:
+    """The accounting identity for a request: its tenant id, or the
+    default tier for anonymous ones.  While [tenants] is enabled the
+    id also passes the individuation bound (``account``) so rotated
+    arbitrary labels cannot mint unbounded per-tenant quotas."""
+    name = tenant if tenant else DEFAULT_TENANT
+    pol = policy()
+    return pol.account(name) if pol is not None else name
+
+
+_tls = threading.local()  # .tenant: active tenant id on this thread
+
+
+class scope(_tls_scope):
+    """Install a tenant id as this thread's identity for a scope
+    (executor.execute installs the request's; _local_map re-installs
+    on pool workers).  Re-entrant, like observe.attach."""
+
+    __slots__ = ()
+
+    def __init__(self, tenant: str | None):
+        super().__init__(_tls, "tenant", tenant)
+
+
+def current() -> str | None:
+    """The tenant id active on THIS thread, or None."""
+    return getattr(_tls, "tenant", None)
+
+
+# ------------------------------------------------------------ gauges
+
+
+def publish_gauges(stats, admission=None) -> None:
+    """tenant.* gauge family for /metrics and /debug/vars — published
+    unconditionally (zeros while [tenants] is off) so the family is
+    scrape-visible before the first isolated tenant.  Cumulative
+    totals render as gauges, never ALSO as counts (the cache.* rule)."""
+    from pilosa_tpu.runtime import residency as _residency
+    from pilosa_tpu.runtime import resultcache as _resultcache
+
+    stats.gauge("tenant.enabled", 1 if _cfg.enabled else 0)
+    stats.gauge("tenant.configured", len(_cfg.quotas))
+    admitted = shed = expired = waiting = in_flight = 0
+    known: set[str] = set()
+    if admission is not None:
+        for name, d in admission.tenants_debug().items():
+            known.add(name)
+            admitted += d["admitted"]
+            shed += d["shed"]
+            expired += d["expired"]
+            waiting += d["waiting"]
+            in_flight += d["inFlight"]
+    cache_bytes = 0
+    for name, d in _resultcache.cache().tenant_stats().items():
+        known.add(name)
+        cache_bytes += d["bytes"]
+    res_bytes = host_bytes = 0
+    for name, d in _residency.manager().tenant_stats().items():
+        known.add(name)
+        res_bytes += d["hbmBytes"]
+        host_bytes += d["hostBytes"]
+    stats.gauge("tenant.known", len(known))
+    stats.gauge("tenant.admitted", admitted)
+    stats.gauge("tenant.shed", shed)
+    stats.gauge("tenant.expired", expired)
+    stats.gauge("tenant.waiting", waiting)
+    stats.gauge("tenant.in_flight", in_flight)
+    stats.gauge("tenant.cache_bytes", cache_bytes)
+    stats.gauge("tenant.residency_bytes", res_bytes)
+    stats.gauge("tenant.residency_host_bytes", host_bytes)

@@ -290,7 +290,8 @@ class InternalClient:
                    shards: list[int] | None = None, remote: bool = True,
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   notiers: bool = False, partial: bool = False):
+                   notiers: bool = False, partial: bool = False,
+                   tenant: str | None = None):
         """POST /index/{i}/query with Remote semantics over the
         protobuf wire — node-to-node RPC speaks protobuf like the
         reference's InternalClient (http/client.go:268 QueryNode;
@@ -304,7 +305,9 @@ class InternalClient:
         dense pre-container path); ``nomesh`` rides as ?nomesh=1 (the
         peer runs its fused dispatches on the pre-mesh single-device
         programs); ``notiers`` rides as ?notiers=1 (the peer bypasses
-        its tiered residency: inline rebuilds, drop-not-demote)."""
+        its tiered residency: inline rebuilds, drop-not-demote);
+        ``tenant`` rides as ?tenant= so the peer charges the origin's
+        tenant ([tenants] isolation)."""
         from pilosa_tpu import proto
 
         body = proto.encode(proto.QUERY_REQUEST, {
@@ -319,6 +322,10 @@ class InternalClient:
                                  ("nomesh=1", nomesh),
                                  ("notiers=1", notiers),
                                  ("partial=1", partial)) if on]
+        if tenant:
+            from urllib.parse import quote
+
+            flags.append("tenant=" + quote(tenant, safe=""))
         if flags:
             path += "?" + "&".join(flags)
         raw = self._request(
@@ -453,13 +460,14 @@ class HTTPTransport(Transport):
     def query_node(self, node: Node, index: str, pql: str, shards,
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   notiers: bool = False, partial: bool = False):
+                   notiers: bool = False, partial: bool = False,
+                   tenant: str | None = None):
         # the protobuf client already returns decoded result objects
         return self.client.query_node(node.uri, index, pql, shards,
                                       nocache=nocache, nodelta=nodelta,
                                       nocontainers=nocontainers,
                                       nomesh=nomesh, notiers=notiers,
-                                      partial=partial)
+                                      partial=partial, tenant=tenant)
 
     def send_message(self, node: Node, message: dict) -> dict:
         return self.client.send_message(node.uri, message)

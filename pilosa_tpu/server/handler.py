@@ -39,6 +39,7 @@ from pilosa_tpu.parallel.executor import ShardsUnavailableError
 from pilosa_tpu.parallel.results import GroupCount, Pair, PairField, ValCount
 from pilosa_tpu.serve import admission as _admission
 from pilosa_tpu.serve import deadline as _deadline
+from pilosa_tpu.serve import tenant as _tenant
 from pilosa_tpu.serve.deadline import DeadlineExceededError
 
 
@@ -294,6 +295,11 @@ class Handler:
                 do_handshake_on_connect=False)
         self.port = self.httpd.server_address[1]
         self.host = host
+        # reopen support: close() closes the listening socket, so a
+        # reopened server must REBUILD it (on the same port — s.uri
+        # stays valid) instead of serve_forever-ing a dead fd
+        self._srv_cls, self._req_cls = _Srv, _Req
+        self._tls_cert, self._tls_key = tls_cert, tls_key
         self._thread: threading.Thread | None = None
         # /debug/pprof/profile serialization: a second concurrent
         # sampler would double-count stacks and burn CPU for up to 30 s
@@ -306,6 +312,23 @@ class Handler:
         return f"{scheme}://{self.host}:{self.port}"
 
     def serve_background(self) -> None:
+        if self.httpd.fileno() == -1:
+            # reopened after close(): rebuild the listener on the SAME
+            # port (server_close() closed the old socket; serving the
+            # dead fd raised in the accept thread and the reopened
+            # server silently refused every connection)
+            self.httpd = self._srv_cls((self.host, self.port),
+                                       self._req_cls)
+            self.httpd.block_on_close = False
+            if self._tls_cert:
+                import ssl
+
+                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+                ctx.load_cert_chain(self._tls_cert,
+                                    self._tls_key or self._tls_cert)
+                self.httpd.socket = ctx.wrap_socket(
+                    self.httpd.socket, server_side=True,
+                    do_handshake_on_connect=False)
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -401,6 +424,19 @@ class Handler:
                                 f"invalid {_deadline.HEADER} header: "
                                 f"{dl_hdr!r}")
                     return
+            # tenant identity ([tenants] isolation): the
+            # X-Pilosa-Tenant header (authenticated clients), or
+            # ?tenant= (tools and node-to-node sub-query forwarding —
+            # exactly like ?nocache).  A missing/empty id rides the
+            # default tier; the label is an accounting key, never a
+            # credential, so malformed values degrade instead of 400.
+            tenant = _tenant.clean(req.headers.get("X-Pilosa-Tenant")
+                                   or params.get("tenant"))
+            # stash the cleaned label on the request so handle_query's
+            # ExecOptions reuses THIS value — parsing twice invites the
+            # two sites drifting apart (quota charged to one tenant,
+            # cache/residency to another)
+            req._pilosa_tenant = tenant
             ticket = None
             if self.admission is not None and klass is not None:
                 k = klass
@@ -418,14 +454,32 @@ class Handler:
                     dl = _deadline.Deadline(
                         self.admission.default_deadline)
                 try:
-                    ticket = self.admission.acquire(k, dl)
+                    ticket = self.admission.acquire(k, dl,
+                                                    tenant=tenant)
                 except _admission.ShedError as e:
                     self._record_shed(
                         match.groupdict().get("index", path), k, e)
                     req.close_connection = True
-                    self._error(req, e.status, str(e),
-                                headers={"Retry-After":
-                                         str(e.retry_after)})
+                    # structured shed body: ``reason`` + the tenant id
+                    # let a client tell "I am over quota"
+                    # (tenant-queue-full) from "the server is
+                    # drowning" (queue-full / deadline-unmeetable)
+                    body_obj = {"error": str(e), "reason": e.reason,
+                                "class": e.klass}
+                    if e.tenant is not None:
+                        body_obj["tenant"] = e.tenant
+                    self._json(req, body_obj, e.status,
+                               headers={"Retry-After":
+                                        str(e.retry_after)})
+                    return
+                except ShedByPeerError as e:
+                    # an armed admission.acquire failpoint injects
+                    # error(shed) here — surface it exactly like a
+                    # capacity refusal (503 + Retry-After), never an
+                    # unhandled 500
+                    req.close_connection = True
+                    self._error(req, 503, str(e),
+                                headers={"Retry-After": "1"})
                     return
             try:
                 body = b""
@@ -514,7 +568,7 @@ class Handler:
         recorder = getattr(self.api.executor, "recorder", None)
         if recorder is not None:
             recorder.record_shed(index, "", klass, e.outcome, str(e),
-                                 wait_ns=e.wait_ns)
+                                 wait_ns=e.wait_ns, tenant=e.tenant)
 
     def _json(self, req, obj, status: int = 200,
               headers: dict | None = None) -> None:
@@ -712,6 +766,12 @@ class Handler:
                 tiers=params.get("notiers") not in ("1", "true"),
                 partial=partial,
                 partial_meta=partial_meta,
+                # tenant identity (X-Pilosa-Tenant / ?tenant=): rides
+                # ExecOptions so every shared resource charges the
+                # right tenant, and forwards on sub-queries — the
+                # dispatch loop already parsed and cleaned it (ONE
+                # parse site; a second would invite the two drifting)
+                tenant=getattr(req, "_pilosa_tenant", None),
             )
         except Exception as e:
             if not proto_accept:
@@ -1487,6 +1547,48 @@ class Handler:
         }
         self._json(req, out)
 
+    @route("GET", "/debug/tenants")
+    def handle_debug_tenants(self, req, params, path, body):
+        """Per-tenant isolation state (serve/tenant.py): the [tenants]
+        policy in force (quotas per configured tenant + the default
+        tier), and per tenant the admission picture (admitted / shed /
+        expired / in-flight / waiting / queue-wait EWMA, aggregated
+        across classes), result-cache bytes + hit/miss/fill/eviction
+        counters against the soft budget, and residency HBM/host-tier
+        bytes with the demotion pressure charged — the one surface an
+        abusive-tenant triage needs."""
+        from pilosa_tpu.runtime import residency as _residency
+        from pilosa_tpu.runtime import resultcache as _resultcache
+
+        cfg = _tenant.config()
+        admission = (self.admission.tenants_debug()
+                     if self.admission is not None else {})
+        cache = _resultcache.cache().tenant_stats()
+        res = _residency.manager().tenant_stats()
+        tenants: dict[str, dict] = {}
+        for name in sorted(set(admission) | set(cache) | set(res)):
+            tenants[name] = {
+                "admission": admission.get(name),
+                "cache": cache.get(name),
+                "residency": res.get(name),
+            }
+        self._json(req, {
+            "enabled": cfg.enabled,
+            "default": {
+                "share": cfg.default_quota.share,
+                "queue": cfg.default_quota.queue,
+                "cacheShare": cfg.default_quota.cache_share,
+                "residencyShare": cfg.default_quota.residency_share,
+            },
+            "quotas": {
+                n: {"share": q.share, "queue": q.queue,
+                    "cacheShare": q.cache_share,
+                    "residencyShare": q.residency_share}
+                for n, q in cfg.quotas.items()
+            },
+            "tenants": tenants,
+        })
+
     @route("GET", "/debug/vars")
     def handle_debug_vars(self, req, params, path, body):
         snap = {}
@@ -1530,6 +1632,10 @@ class Handler:
             _syncer.publish_gauges(self.stats)
             _hints.publish_gauges(self.stats, self.api.node.hints)
             _fragment.publish_wal_gauges(self.stats)
+            # per-tenant isolation totals (zeros while [tenants] is
+            # off — the family stays alert-able before the first
+            # isolated tenant)
+            _tenant.publish_gauges(self.stats, self.admission)
         except Exception:  # noqa: BLE001
             pass
 

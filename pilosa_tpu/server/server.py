@@ -106,6 +106,12 @@ class Server:
         anti_entropy_jitter: float = 0.1,
         anti_entropy_round_budget: float = 0.0,
         anti_entropy_peer_timeout: float = 2.0,
+        tenants_enabled: bool = False,
+        tenants_default_share: int | None = None,
+        tenants_default_queue: int | None = None,
+        tenants_default_cache_share: float | None = None,
+        tenants_default_residency_share: float | None = None,
+        tenants_quotas: dict | None = None,
     ):
         from pilosa_tpu import logger as _logger
         from pilosa_tpu import stats as _stats
@@ -267,6 +273,24 @@ class Server:
         self._mesh_retained = True
         _meshexec.configure(enabled=mesh_enabled,
                             axis_size=mesh_axis_size)
+        # per-tenant isolation ([tenants] config): process-wide like
+        # [mesh] — the first server's retain() captures the pre-server
+        # baseline, the LAST release() (in close) restores it.  The
+        # admission gate, result cache and residency manager all
+        # consult serve.tenant.policy() live, so this configure is the
+        # single switch.
+        from pilosa_tpu.serve import tenant as _tenantcfg
+
+        _tenantcfg.retain()
+        self._tenants_retained = True
+        self._tenants_cfg = dict(
+            enabled=tenants_enabled,
+            default_share=tenants_default_share,
+            default_queue=tenants_default_queue,
+            default_cache_share=tenants_default_cache_share,
+            default_residency_share=tenants_default_residency_share,
+            quotas=tenants_quotas)
+        _tenantcfg.configure(**self._tenants_cfg)
         # tiered residency ([residency] config): process-wide like
         # [mesh] — the first server's retain() captures the pre-server
         # baseline, the LAST release() (in close) restores it and
@@ -365,6 +389,10 @@ class Server:
         cluster (server.go:417 Open; gossip join with retry,
         gossip/gossip.go:65-123)."""
         self._closed = False  # an instance reopened after close()
+        # reopened after close(): the holder closed its indexes and
+        # released the directory flock — reload persisted state (no-op
+        # on first open, which holds the flock from construction)
+        self.holder.reopen()
         if not self._containers_retained:
             # reopened after close(): take the [containers] reference
             # back (the first open holds the construction-time one)
@@ -378,6 +406,17 @@ class Server:
 
             _meshexec.retain()
             self._mesh_retained = True
+        if not self._tenants_retained:
+            # reopened after close(): take the [tenants] reference
+            # back and RE-APPLY this server's configured quotas
+            # (close() restored the process baseline — without the
+            # re-apply a reopened server would serve with isolation
+            # silently off, the [replication] reopen bug class)
+            from pilosa_tpu.serve import tenant as _tenantcfg
+
+            _tenantcfg.retain()
+            self._tenants_retained = True
+            _tenantcfg.configure(**self._tenants_cfg)
         if not self._residency_retained:
             # reopened after close(): take the [residency] reference
             # back and re-wire the promotion pool's admission gate
@@ -596,6 +635,11 @@ class Server:
         if self._residency_retained:
             self._residency_retained = False
             _residency2.release()
+        from pilosa_tpu.serve import tenant as _tenantcfg2
+
+        if self._tenants_retained:
+            self._tenants_retained = False
+            _tenantcfg2.release()
         if self._faultinject_armed:
             # config-armed failpoints are process-wide: the arming
             # server disarms everything on close so library users

@@ -88,3 +88,9 @@ def _hermetic_residency_accounting():
     from pilosa_tpu.parallel import hints
 
     hints.reset()
+    # the [tenants] isolation policy is process-wide as well: a test
+    # that enables quotas must not leak weighted-fair scheduling (or
+    # per-tenant cache/residency accounting) into the next test
+    from pilosa_tpu.serve import tenant
+
+    tenant.reset()

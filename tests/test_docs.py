@@ -64,7 +64,8 @@ def test_every_config_key_documented():
     sections = ("cluster", "anti_entropy", "replication", "metric",
                 "tracing", "profile", "tls", "coalescer", "ragged",
                 "observe", "admission", "cache", "ingest",
-                "containers", "mesh", "residency", "faultinject")
+                "containers", "mesh", "residency", "faultinject",
+                "tenants")
     for f in fields(cfgmod.Config):
         if f.name in sections:
             section = f.name

@@ -79,7 +79,16 @@ CLASS_LOCKS: dict[tuple, ClassLockRule] = {
             "_entries", "_flights", "_noflight", "bytes", "hits",
             "misses", "fills", "evictions", "invalidations",
             "skipped_oversize", "flight_joins", "flight_served",
+            "_tenant_bytes", "_tenant_lru", "_tenant_counters",
+            "tenant_pref_evictions",
         }),
+        helpers={
+            "_tc_locked": "callers hold self._lock",
+            "_tenant_track_locked": "callers hold self._lock",
+            "_tenant_untrack_locked": "callers hold self._lock",
+            "_tenant_touch_locked": "callers hold self._lock",
+            "_victim_key_locked": "callers hold self._lock",
+        },
     ),
     ("parallel/coalescer.py", "Coalescer"): ClassLockRule(
         lock="_lock",
@@ -97,8 +106,13 @@ CLASS_LOCKS: dict[tuple, ClassLockRule] = {
             "_spill_seq", "demotions", "tier_hits", "tier_misses",
             "tier_spills", "tier_spill_drops", "disk_hits",
             "fallbacks", "oom_budget_shrinks", "_prefetched",
-            "prefetch_useful",
+            "prefetch_useful", "_tenant_bytes", "_tenant_host_bytes",
+            "_tenant_pressure",
         }),
+        helpers={
+            "_tenant_charge_locked": "callers hold self._lock",
+            "_tenant_host_charge_locked": "callers hold self._lock",
+        },
         # ``budget`` is deliberately UNREGISTERED: written only under
         # the lock (note_oom_feedback), read lock-free by the entry
         # caps and stats — the monotone-ish operator-knob discipline
@@ -119,6 +133,21 @@ CLASS_LOCKS: dict[tuple, ClassLockRule] = {
             "_parse_file_locked": "called from _load under self._lock",
             "_queue_locked": "callers hold self._lock",
             "_rewrite_locked": "callers hold self._lock",
+        },
+    ),
+    ("serve/admission.py", "AdmissionController"): ClassLockRule(
+        lock="_lock",
+        # ``_gates`` itself is immutable after construction (the dict
+        # is only ever READ to find a gate; all mutable state lives in
+        # gate/tenant fields touched under the lock), so it is
+        # deliberately unregistered — the *_locked helper contracts
+        # below are the checked surface
+        attrs=frozenset(),
+        helpers={
+            "_wake_tenants_locked": "called from _release under "
+                                    "self._lock",
+            "_query_pressure_locked": "callers hold self._lock",
+            "_tenant_dict_locked": "callers hold self._lock",
         },
     ),
     ("parallel/cluster.py", "CircuitBreaker"): ClassLockRule(
@@ -186,6 +215,15 @@ MODULE_LOCKS: dict[str, tuple] = {
     "ingest/__init__.py": (
         ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
         ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+    ),
+    "serve/tenant.py": (
+        # reads (policy()/enabled()/quota_for) are the lock-free hot
+        # path by design — a momentarily stale policy admits one
+        # borderline request, never corrupts; rebinds/attr-writes only
+        # under the config lock
+        ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
+        ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
     ),
     "parallel/meshexec.py": (
         ModuleGlobalRule("_counters", "_lock", "rw"),
@@ -384,6 +422,20 @@ CONFIG_GUARDS = (
         pair=("release",),
         owner_suffixes=("parallel/hints.py",),
         what="the refcounted [replication] baseline",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("tenant.configure", "_tenant.configure",
+                          "_tenantcfg.configure"),
+        pair=("retain", "release"),
+        owner_suffixes=("serve/tenant.py",),
+        what="the process-wide [tenants] runtime config",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("tenant.retain", "_tenant.retain",
+                          "_tenantcfg.retain"),
+        pair=("release",),
+        owner_suffixes=("serve/tenant.py",),
+        what="the refcounted [tenants] baseline",
     ),
     ConfigGuardRule(
         mutator_suffixes=("meshexec.configure", "_meshexec.configure"),

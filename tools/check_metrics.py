@@ -282,6 +282,10 @@ TIER_FAMILIES = _mf.live_prefixes("tier")
 #: replay health), rendered as ae_* / hint_* / wal_*.
 REPL_FAMILIES = _mf.live_prefixes("repl")
 
+#: Per-tenant isolation families (serve/tenant.publish_gauges),
+#: rendered as tenant_* — published (zeros) even with [tenants] off.
+TENANT_FAMILIES = _mf.live_prefixes("tenant")
+
 #: Everything the ``--families`` CLI mode requires of a live server.
 ALL_FAMILIES = _mf.live_prefixes()
 

@@ -80,10 +80,16 @@ class _Stats:
         self.bucket_latencies: dict[str, list[float]] = {}
         #: per-bucket outcome counts (the --chaos fault/clear split)
         self.bucket_outcomes: dict[str, dict[str, int]] = {}
+        #: --tenant-mix view: tenant -> completed-read latencies and
+        #: per-tenant outcome counts (goodput/shed per tenant is the
+        #: isolation evidence the [tenants] acceptance run pins)
+        self.tenant_latencies: dict[str, list[float]] = {}
+        self.tenant_outcomes: dict[str, dict[str, int]] = {}
 
     def note(self, outcome: str, latency_s: float,
              retry_after: bool, klass: str = "query",
-             bits: int = 0, bucket: str | None = None) -> None:
+             bits: int = 0, bucket: str | None = None,
+             tenant: str | None = None) -> None:
         with self.lock:
             self.sent += 1
             if retry_after:
@@ -95,6 +101,16 @@ class _Stats:
                 oc["ok" if outcome == "ok"
                    else outcome if outcome in ("shed", "expired")
                    else "error"] += 1
+            if tenant is not None:
+                toc = self.tenant_outcomes.setdefault(
+                    tenant, {"ok": 0, "shed": 0, "expired": 0,
+                             "error": 0})
+                toc["ok" if outcome == "ok"
+                    else outcome if outcome in ("shed", "expired")
+                    else "error"] += 1
+                if outcome == "ok" and klass == "query":
+                    self.tenant_latencies.setdefault(
+                        tenant, []).append(latency_s)
             if outcome == "ok":
                 self.ok += 1
                 self.ok_latencies.append(latency_s)
@@ -125,7 +141,8 @@ def _build_request(host: str, index: str, klass: str, query: str,
                    deadline_s: float | None,
                    ingest_field: str = "loadgen",
                    ingest_bits: int = 1, ingest_rows: int = 8,
-                   ingest_cols: int = 1 << 20):
+                   ingest_cols: int = 1 << 20,
+                   tenant: str | None = None):
     bits = 0
     if klass == "ingest":
         url = f"{host}/index/{index}/field/{ingest_field}/import"
@@ -149,17 +166,20 @@ def _build_request(host: str, index: str, klass: str, query: str,
     req.add_header("Content-Type", "application/json")
     if deadline_s is not None:
         req.add_header("X-Pilosa-Deadline", f"{deadline_s:.3f}")
+    if tenant is not None:
+        req.add_header("X-Pilosa-Tenant", tenant)
     return req, klass, bits
 
 
 def _fire(req, timeout: float, stats: _Stats, klass: str = "query",
-          bits: int = 0, bucket: str | None = None) -> None:
+          bits: int = 0, bucket: str | None = None,
+          tenant: str | None = None) -> None:
     t0 = time.perf_counter()
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
             resp.read()
         stats.note("ok", time.perf_counter() - t0, False, klass, bits,
-                   bucket)
+                   bucket, tenant=tenant)
     except urllib.error.HTTPError as e:
         body = b""
         try:
@@ -172,10 +192,10 @@ def _fire(req, timeout: float, stats: _Stats, klass: str = "query",
         else:
             outcome = "error"
         stats.note(outcome, time.perf_counter() - t0, retry_after, klass,
-                   bucket=bucket)
+                   bucket=bucket, tenant=tenant)
     except Exception:
         stats.note("error", time.perf_counter() - t0, False, klass,
-                   bucket=bucket)
+                   bucket=bucket, tenant=tenant)
 
 
 def _cache_counters(host: str) -> tuple[int, int] | None:
@@ -247,6 +267,30 @@ def shape_mix_queries(n: int, field: str = "f", rows: int = 6,
         raise ValueError(
             f"shape-mix supports at most {len(structures)} distinct "
             f"shapes, asked for {n}")
+    return out
+
+
+def parse_tenant_mix(spec: str) -> list[tuple[str, float, str]]:
+    """``tenant:weight[:class]`` comma list -> [(tenant, weight,
+    class)] — e.g. ``gold:8:query,free:2:query,abuser:10:ingest``.
+    Weights are the arrival-rate proportions (largest-remainder
+    interleaved like the class mix); class defaults to ``query``."""
+    out: list[tuple[str, float, str]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) < 2 or not bits[0]:
+            raise ValueError(
+                f"bad tenant-mix entry {part!r} "
+                "(tenant:weight[:class])")
+        klass = bits[2] if len(bits) > 2 else "query"
+        if klass not in ("query", "ingest", "internal"):
+            raise ValueError(f"bad tenant-mix class {klass!r}")
+        out.append((bits[0], float(bits[1]), klass))
+    if not out:
+        raise ValueError("empty tenant mix")
     return out
 
 
@@ -340,7 +384,8 @@ def run_load(host: str, index: str, qps: float, seconds: float,
              shape_rows: int = 6,
              sparsity_mix: dict[str, int] | None = None,
              sparsity_field: str = "f",
-             chaos: "_ChaosDriver | None" = None) -> dict:
+             chaos: "_ChaosDriver | None" = None,
+             tenant_mix: list | None = None) -> dict:
     """Drive ``host`` open-loop at ``qps`` for ``seconds``; returns the
     report dict.  ``mix`` maps class -> weight; ``deadline_s`` is a
     (lo, hi) uniform range for the per-request deadline header (None =
@@ -359,10 +404,22 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     open-loop as long as in-flight requests < pool — true under
     admission control, where overflow is refused in milliseconds; when
     the pool ever falls behind an arrival by >50ms the report's
-    ``late`` counter says so instead of silently closing the loop."""
+    ``late`` counter says so instead of silently closing the loop.
+
+    ``tenant_mix`` ([(tenant, weight, class)], from
+    :func:`parse_tenant_mix`) replaces the class mix: each arrival is
+    drawn from the tenant schedule, stamped with its
+    ``X-Pilosa-Tenant`` header, and the report adds a per-tenant
+    goodput/p50/p99/shed section — the isolation evidence the
+    [tenants] acceptance run pins."""
     import queue as _queue
 
-    mix = mix or DEFAULT_MIX
+    if tenant_mix is not None:
+        # the tenant schedule IS the class schedule: weight per
+        # (tenant, class) pair, same largest-remainder interleave
+        mix = {(t, k): w for t, w, k in tenant_mix}
+    else:
+        mix = mix or DEFAULT_MIX
     classes = list(mix)
     stats = _Stats()
     qlist = None
@@ -406,7 +463,7 @@ def run_load(host: str, index: str, qps: float, seconds: float,
             item = jobs.get()
             if item is None:
                 return
-            due, req, klass, bits, bucket = item
+            due, req, klass, bits, bucket, tenant = item
             delay = due - time.perf_counter()
             if delay > 0:
                 time.sleep(delay)
@@ -416,7 +473,8 @@ def run_load(host: str, index: str, qps: float, seconds: float,
             if chaos is not None and bucket is None:
                 # label by FIRE time: is a fault window armed right now
                 bucket = chaos.label()
-            _fire(req, timeout, stats, klass, bits, bucket)
+            _fire(req, timeout, stats, klass, bits, bucket,
+                  tenant=tenant)
 
     cache0 = _cache_counters(host)
     disp0 = _vars_counter(host, "coalescer.dispatches")
@@ -437,7 +495,12 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     start = time.perf_counter()
     for i in range(n):
         due = start + i / qps
-        klass = sched[i]
+        pick_i = sched[i]
+        tenant = None
+        if tenant_mix is not None:
+            tenant, klass = pick_i
+        else:
+            klass = pick_i
         dl = (random.uniform(*deadline_s)
               if deadline_s is not None else None)
         bucket = None
@@ -447,8 +510,9 @@ def run_load(host: str, index: str, qps: float, seconds: float,
             q = qlist[i % len(qlist)] if qlist else query
         req, kl, bits = _build_request(host, index, klass, q, dl,
                                        ingest_field, ingest_bits,
-                                       ingest_rows, ingest_cols)
-        jobs.put((due, req, kl, bits, bucket))
+                                       ingest_rows, ingest_cols,
+                                       tenant=tenant)
+        jobs.put((due, req, kl, bits, bucket, tenant))
     for _ in workers:
         jobs.put(None)
     for w in workers:
@@ -549,6 +613,23 @@ def run_load(host: str, index: str, qps: float, seconds: float,
                 }
                 for label in ("fault", "clear")
             },
+        }),
+        # --tenant-mix view: per-tenant goodput / latency / shed —
+        # with [tenants] isolation on, an abusive tenant's flood shows
+        # up in ITS shed column while the victims' p99 holds
+        "tenants": (None if tenant_mix is None else {
+            t: {
+                **stats.tenant_outcomes.get(
+                    t, {"ok": 0, "shed": 0, "expired": 0, "error": 0}),
+                "goodput_qps": round(
+                    stats.tenant_outcomes.get(t, {}).get("ok", 0)
+                    / elapsed, 2) if elapsed else 0.0,
+                "p50_ms": round(_percentile(sorted(
+                    stats.tenant_latencies.get(t, [])), 0.50) * 1e3, 2),
+                "p99_ms": round(_percentile(sorted(
+                    stats.tenant_latencies.get(t, [])), 0.99) * 1e3, 2),
+            }
+            for t in sorted({t_ for t_, _, _ in tenant_mix})
         }),
         # sparsity-mix view: per-bucket read latency percentiles
         "sparsity": (None if buckets is None else {
@@ -849,6 +930,13 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--chaos-hosts", default=None,
                    help="comma-separated extra hosts to arm (default: "
                         "--host only)")
+    p.add_argument("--tenant-mix", default=None,
+                   help="tenant:weight[:class][,tenant:weight...] — "
+                        "draw each arrival from a weighted tenant "
+                        "schedule, stamp its X-Pilosa-Tenant header, "
+                        "and report per-tenant goodput/p50/p99/shed "
+                        "(e.g. 'gold:8:query,abuser:40:query'); "
+                        "replaces --mix")
     p.add_argument("--timeout", type=float, default=10.0)
     args = p.parse_args(argv)
     mix = {}
@@ -894,7 +982,9 @@ def main(argv: list[str] | None = None) -> int:
                       shape_rows=args.shape_rows,
                       sparsity_mix=(parse_sparsity_mix(args.sparsity_mix)
                                     if args.sparsity_mix else None),
-                      sparsity_field=args.sparsity_field)
+                      sparsity_field=args.sparsity_field,
+                      tenant_mix=(parse_tenant_mix(args.tenant_mix)
+                                  if args.tenant_mix else None))
     print(json.dumps(report, indent=2))
     return 0
 
