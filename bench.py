@@ -1191,6 +1191,159 @@ def bench_containers() -> dict | None:
     return out
 
 
+def bench_vm() -> dict | None:
+    """Bitmap-VM A/B (ops/pallas_kernels.vm_counts + the coalescer's
+    "vm" buckets): the SAME heterogeneous 16-distinct-shape sparse
+    Count mix served closed-loop through the coalescer twice — once
+    with the VM routing eligible buckets through the one
+    scalar-prefetch kernel over compressed container pools, once with
+    ``?novm`` semantics (the pre-VM engines: dense gather + the XLA
+    tape interpreter).  Every completed query is verified against a
+    host-computed expected count.
+
+    The reported pin is the no-regression floor ``pin_vm_qps_ok``
+    (vm qps >= 0.9x the pre-VM path on this host); the chip target —
+    beat the XLA route's committed 1801 qps / 0.148 bw_util capture —
+    rides the chip-capture slot (tools/chipcapture.py)."""
+    import statistics
+    import tempfile
+    import threading
+
+    from pilosa_tpu import stats as _stats
+    from pilosa_tpu.models.holder import Holder
+    from pilosa_tpu.ops import bitmap as bm
+    from pilosa_tpu.ops import containers as ct
+    from pilosa_tpu.ops import tape as _tape
+    from pilosa_tpu.parallel.coalescer import Coalescer
+    from pilosa_tpu.parallel.executor import ExecOptions, Executor
+    from pilosa_tpu.runtime import resultcache as _resultcache
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from tools.loadgen import shape_mix_queries
+
+    if bm.n_words(SHARD_WIDTH) != WORDS:
+        return None
+    VM_SHARDS = 32
+    FILL = 0.01
+    bits_per_row = int(FILL * SHARD_WIDTH)
+    rng = np.random.default_rng(12350)
+    holder = Holder(tempfile.mkdtemp() + "/bench-vm")
+    idx = holder.create_index("i")
+    f = idx.create_field("f")
+    view = f.create_view_if_not_exists("standard")
+    exist: dict[int, set] = {}
+    for s in range(VM_SHARDS):
+        frag = view.create_fragment_if_not_exists(s)
+        # clustered sparsity: each row's bits confined to the first
+        # two containers (the roaring-shaped rows the VM gathers)
+        for r in range(6):
+            pos = np.unique(rng.choice(
+                1 << 17, size=bits_per_row, replace=False))
+            frag.import_positions(
+                (r * SHARD_WIDTH + pos).astype(np.uint64))
+            exist.setdefault(s, set()).update(pos.tolist())
+        f._note_shard(s)
+    for s, cols in exist.items():
+        arr = np.fromiter(cols, dtype=np.int64) + s * SHARD_WIDTH
+        idx.import_existence(arr)
+    ex = Executor(holder)
+    stats = _stats.MemStatsClient()
+    ex.coalescer = Coalescer(window_s=0.010, max_batch=32,
+                             enabled=True, stats=stats)
+    rc_was = _resultcache.cache().enabled
+    _resultcache.cache().enabled = False
+    qs = shape_mix_queries(16, field="f", rows=6)
+    # mesh off in both legs: the VM is a single-device kernel, and the
+    # A/B must differ only in the ?novm bit
+    vm_on = ExecOptions(mesh=False)
+    vm_off = ExecOptions(mesh=False, vm=False)
+
+    def ground_truth(q):
+        ex.fuse_shards = False
+        try:
+            return int(ex.execute("i", q)[0])
+        finally:
+            ex.fuse_shards = True
+
+    expects = [ground_truth(q) for q in qs]
+    THREADS = 16
+
+    def phase(opt, seconds: float) -> dict:
+        for q, want in zip(qs, expects):  # warm + verify
+            got = int(ex.execute("i", q, opt=opt)[0])
+            if got != want:
+                raise AssertionError(
+                    f"vm bench warm-up mismatch: {q} -> {got}, "
+                    f"expected {want}")
+        lats: list[list[int]] = [[] for _ in range(THREADS)]
+        errs: list = []
+        t0 = time.perf_counter()
+        stop = t0 + seconds
+
+        def worker(t: int) -> None:
+            i = t
+            try:
+                while time.perf_counter() < stop:
+                    v = i % len(qs)
+                    tq = time.perf_counter_ns()
+                    got = int(ex.execute("i", qs[v], opt=opt)[0])
+                    lats[t].append(time.perf_counter_ns() - tq)
+                    if got != expects[v]:
+                        raise AssertionError(
+                            f"vm bench returned {got}, expected "
+                            f"{expects[v]} for {qs[v]}")
+                    i += THREADS
+            except BaseException as e:  # noqa: BLE001 — fail loudly
+                errs.append(e)
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(THREADS)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        if errs:
+            raise errs[0]
+        flat = [x for per in lats for x in per]
+        return {
+            "p50_us": round(statistics.median(flat) / 1e3, 1),
+            "queries": len(flat),
+            "qps": round(len(flat) / seconds, 1),
+        }
+
+    try:
+        c0 = dict(_tape.counters())
+        with_vm = phase(vm_on, 1.2)
+        c1 = dict(_tape.counters())
+        without = phase(vm_off, 1.2)
+        c2 = dict(_tape.counters())
+    finally:
+        _resultcache.cache().enabled = rc_was
+        holder.close()
+    vm_q = c1["vm.queries"] - c0["vm.queries"]
+    vm_x = c1["vm.executions"] - c0["vm.executions"]
+    out = {
+        "shape_mix": 16,
+        "fill": FILL,
+        "shards": VM_SHARDS,
+        "vm": with_vm,
+        "novm": without,
+        "speedup": round(with_vm["qps"] / max(1.0, without["qps"]), 2),
+        "vm_queries": vm_q,
+        "vm_executions": vm_x,
+        "vm_queries_per_launch": round(vm_q / max(1, vm_x), 2),
+        # the pre-VM engines must stay off the VM leg's counters and
+        # vice versa: the off leg's executions delta is the evidence
+        "novm_leaked_vm_launches": c2["vm.executions"]
+        - c1["vm.executions"],
+        "pin_vm_qps_ok": with_vm["qps"] >= 0.9 * without["qps"],
+    }
+    if not out["pin_vm_qps_ok"]:
+        print(f"bench: bitmap-VM qps {with_vm['qps']:.0f} fell below "
+              f"0.9x the pre-VM path {without['qps']:.0f}",
+              file=sys.stderr)
+    return out
+
+
 def bench_residency() -> dict | None:
     """Tiered-residency A/B (runtime/residency.py): the same zipfian
     Count mix measured (a) fully resident — HBM budget far above the
@@ -1658,6 +1811,9 @@ def main():
     ctn = bench_containers()
     if ctn is not None:
         extras["containers"] = ctn
+    vmab = bench_vm()
+    if vmab is not None:
+        extras["vm"] = vmab
     extras["faultinject"] = bench_faultinject()
     extras["tenants"] = bench_tenants(co)
     msh = bench_mesh()

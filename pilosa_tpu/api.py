@@ -96,7 +96,8 @@ class API:
               exclude_columns: bool = False, coalesce: bool = True,
               cache: bool = True, delta: bool = True,
               containers: bool = True, mesh: bool = True,
-              tiers: bool = True, partial: bool = False,
+              tiers: bool = True, vm: bool = True,
+              partial: bool = False,
               partial_meta: dict | None = None,
               tenant: str | None = None):
         """Execute PQL -> list of results (api.go:135 API.Query).
@@ -199,6 +200,7 @@ class API:
             containers=containers,
             mesh=mesh,
             tiers=tiers,
+            vm=vm,
             deadline=dl,
             partial=partial,
             missing=set() if partial else None,

@@ -401,6 +401,9 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         ragged_max_tape=cfg.ragged.max_tape,
         ragged_max_leaves=cfg.ragged.max_leaves,
         ragged_prewarm=cfg.ragged.prewarm,
+        vm_enabled=cfg.vm.enabled,
+        vm_min_domain=cfg.vm.min_domain,
+        vm_max_prefetch=cfg.vm.max_prefetch,
         observe_enabled=cfg.observe.enabled,
         observe_recent=cfg.observe.recent,
         observe_long_query_time=cfg.observe.long_query_time,

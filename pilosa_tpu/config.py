@@ -176,6 +176,28 @@ class RaggedConfig:
 
 
 @dataclass
+class VMConfig:
+    """[vm] — the Pallas bitmap VM (ops/pallas_kernels.vm_counts +
+    ops/tape.execute_vm; no reference analog — the one-kernel fusion
+    of the ragged tape interpreter with the compressed container
+    engine).  With ``enabled`` on, a coalesced sparse Count batch
+    whose every leaf stages compressed executes as ONE scalar-prefetch
+    kernel over the pooled containers, never materializing a dense
+    register file.  ``min-domain`` is the floor a staged query's
+    padded container-domain width rounds up to (keeps lowered-variant
+    counts down and gives empty-domain queries a real batch slot);
+    ``max-prefetch`` caps the per-launch scalar-prefetch directory in
+    int32 entries (slots x batch x domain live in SMEM on chip —
+    oversized batches split in two, oversized single queries route
+    the dense engines).  Rides [ragged]: disabling the ragged engine
+    disables the VM too, and ``?novm=1`` is the per-request escape."""
+
+    enabled: bool = True
+    min_domain: int = 8
+    max_prefetch: int = 65536
+
+
+@dataclass
 class ObserveConfig:
     """[observe] — the query flight recorder (pilosa_tpu.observe; no
     reference analog beyond ``cluster.long-query-time``).  ``enabled``
@@ -395,6 +417,7 @@ class Config:
     tls: TLSConfig = field(default_factory=TLSConfig)
     coalescer: CoalescerConfig = field(default_factory=CoalescerConfig)
     ragged: RaggedConfig = field(default_factory=RaggedConfig)
+    vm: VMConfig = field(default_factory=VMConfig)
     observe: ObserveConfig = field(default_factory=ObserveConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     cache: CacheConfig = field(default_factory=CacheConfig)
@@ -442,7 +465,7 @@ class Config:
             key = k.replace("-", "_")
             if key in ("cluster", "anti_entropy", "replication",
                        "metric", "tracing",
-                       "profile", "tls", "coalescer", "ragged",
+                       "profile", "tls", "coalescer", "ragged", "vm",
                        "observe", "admission", "cache", "ingest",
                        "containers", "mesh", "residency",
                        "faultinject", "tenants") and isinstance(v, dict):
@@ -461,6 +484,7 @@ class Config:
                                                         TLSConfig,
                                                         CoalescerConfig,
                                                         RaggedConfig,
+                                                        VMConfig,
                                                         ObserveConfig,
                                                         AdmissionConfig,
                                                         CacheConfig,
@@ -479,8 +503,8 @@ class Config:
             if f.name in ("cluster", "anti_entropy", "replication",
                           "metric", "tracing",
                           "profile", "tls", "coalescer", "ragged",
-                          "observe", "admission", "cache", "ingest",
-                          "containers", "mesh", "residency",
+                          "vm", "observe", "admission", "cache",
+                          "ingest", "containers", "mesh", "residency",
                           "faultinject", "tenants"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
@@ -558,6 +582,11 @@ class Config:
             f"max-tape = {self.ragged.max_tape}",
             f"max-leaves = {self.ragged.max_leaves}",
             f"prewarm = {str(self.ragged.prewarm).lower()}",
+            "",
+            "[vm]",
+            f"enabled = {str(self.vm.enabled).lower()}",
+            f"min-domain = {self.vm.min_domain}",
+            f"max-prefetch = {self.vm.max_prefetch}",
             "",
             "[observe]",
             f"enabled = {str(self.observe.enabled).lower()}",

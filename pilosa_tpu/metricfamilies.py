@@ -94,6 +94,12 @@ FAMILIES: tuple[Family, ...] = (
            "ragged op-tape interpreter (ops/tape.py)",
            live_prefixes=("tape_",), group="tape",
            doc="architecture.md"),
+    Family("vm", "vm_",
+           "Pallas bitmap VM: one scalar-prefetch kernel for ragged "
+           "tapes over compressed containers (ops/pallas_kernels.py "
+           "+ ops/tape.py)",
+           live_prefixes=("vm_",), group="tape",
+           doc="architecture.md"),
     Family("container", "container_",
            "compressed container-directory execution engine "
            "(ops/containers.py)",

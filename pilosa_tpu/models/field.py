@@ -727,6 +727,94 @@ class Field:
                                    devices=_placement_devices())
         return pair
 
+    def device_delta_container_leaves(self, row_id: int,
+                                      shards: tuple[int, ...]):
+        """Pending delta overlays for one standard-view row in POOLED
+        compressed form: a pair of ContainerLeaf ``(set_leaf,
+        clear_leaf)`` — the operands of the bitmap VM's ``dfuse`` node
+        ``(base & ~clear) | set`` (ops/containers.stage_vm), or None
+        when NO fragment has a pending overlay for this row (the
+        common post-compaction case, same gate as
+        device_delta_stacks).  A delta plane per shard is at most
+        SHARD_WIDTH/2^16 containers, and only the non-empty ones pool.
+
+        Cached per (row, shards) keyed on the per-fragment ``(uid,
+        row_seq)`` tokens, like device_delta_stacks — and safe under a
+        concurrent compaction for the same reason: the VM stages these
+        BEFORE the base leaf, and re-applying an already-merged
+        overlay is idempotent ((b&~c|s)&~c|s == b&~c|s)."""
+        from pilosa_tpu.ops import containers as ct
+
+        view = self.view(VIEW_STANDARD)
+        frags = [None if view is None else view.fragment(s)
+                 for s in shards]
+        toks = (_placement_token(),) + tuple(
+            0 if fr is None
+            else (fr._uid, fr._delta_row_seq(row_id))
+            for fr in frags)
+        if not any(t and t[1] for t in toks[1:]):
+            return None
+        key = ("dcont", row_id, shards)
+        with self._lock:
+            hit = self._row_stack_cache.get(key)
+            if (hit is not None and hit[0] == toks
+                    and _live(hit[1][0].pool) and _live(hit[1][1].pool)):
+                self._touch(self._row_stack_cache, key)
+                return hit[1]
+        from pilosa_tpu.ops import bitmap as bm
+
+        cpr = SHARD_WIDTH // ct.CONTAINER_BITS
+        planes: list[list] = [[], []]  # per kind: (set, clear) words
+        for fr in frags:
+            s = c = None
+            if fr is not None:
+                with fr._lock:
+                    d = fr._delta
+                    if d is not None and d.row_touched(row_id):
+                        # copy under the fragment lock: later delta
+                        # writes mutate these word arrays in place
+                        s = d.sets.get(row_id)
+                        s = None if s is None else s.copy()
+                        c = d.clears.get(row_id)
+                        c = None if c is None else c.copy()
+            planes[0].append(s)
+            planes[1].append(c)
+        pair = []
+        for words_per_shard in planes:
+            entries: list = []
+            starts: list[int] = []
+            kinds: list = []
+            blocks_list: list[np.ndarray] = []
+            n = 0
+            for words in words_per_shard:
+                starts.append(n)
+                if words is None:
+                    entries.append(np.empty(0, dtype=np.int64))
+                    kinds.append(np.empty(0, dtype=np.uint8))
+                    continue
+                blocks = words.reshape(cpr, ct.CWORDS)
+                keys = np.flatnonzero(blocks.any(axis=1)).astype(np.int64)
+                entries.append(keys)
+                kinds.append(np.ones(len(keys), dtype=np.uint8))
+                if len(keys):
+                    blocks_list.append(blocks[keys])
+                    n += len(keys)
+            rows = n + 1 if bm.host_mode() else ct._pow2(n + 1)
+            pool = np.zeros((rows, ct.CWORDS), dtype=np.uint32)
+            if blocks_list:
+                pool[:n] = np.concatenate(blocks_list, axis=0)
+            pair.append(ct.ContainerLeaf(shards, entries, starts, kinds,
+                                         self._place_pool(pool), n,
+                                         pool.nbytes))
+        pair = (pair[0], pair[1])
+        entry_bytes = pair[0].nbytes + pair[1].nbytes
+        if entry_bytes <= self._entry_cap(self.ROW_STACK_CACHE_BYTES):
+            self._evict_and_insert(self._row_stack_cache, key,
+                                   (toks, pair), entry_bytes,
+                                   max_entries=64, kind="compressed",
+                                   devices=_placement_devices())
+        return pair
+
     def device_container_leaf(self, row_id: int, shards: tuple[int, ...]):
         """One standard-view row across the shard set in POOLED
         compressed form (ops/containers.ContainerLeaf): each shard's

@@ -268,6 +268,11 @@ def _domain(shape: tuple, keysets: list) -> np.ndarray:
     if kind == "not":
         # exist & ~child lives inside the existence row's containers
         return _domain(shape[1], keysets)
+    if kind == "dfuse":
+        # (child & ~clear) | set — a result bit can only live in the
+        # child's or the set-overlay's containers (clear only removes)
+        return np.union1d(_domain(shape[1], keysets),
+                          _domain(shape[2], keysets))
     raise ValueError(f"container-ineligible node: {kind!r}")
 
 
@@ -437,6 +442,192 @@ class Plan:
             words.reshape(self.cpr, CWORDS)[dom] = blocks
             partials.append((s, words))
         return partials
+
+
+#: Default ``[vm]`` knobs: the minimum padded domain width a staged VM
+#: query rounds up to (keeps the lowered-variant count down for tiny
+#: domains and gives empty-domain queries a real — all-zero-row — batch
+#: slot, so the ONE-launch accounting never special-cases them), and
+#: the per-launch scalar-prefetch budget in int32 directory entries
+#: (slots x batch x domain live in SMEM on chip; oversized batches
+#: split, oversized single queries decline to the dense engines).
+VM_MIN_DOMAIN = 8
+VM_MAX_PREFETCH = 1 << 16
+
+
+class VMStage:
+    """One fused Count read staged for the Pallas bitmap VM: the
+    (possibly delta-substituted) shape, its compiled op-tape, the
+    container leaves in slot order, the per-leaf LOCAL gather rows for
+    the concatenated per-shard root domains (each int32[pad], absent
+    containers and the pow2 tail pointing at the leaf's own zero row),
+    and the live domain total.  parallel/coalescer.py globalizes the
+    rows against the bucket megapool at flush."""
+
+    __slots__ = ("shape", "tape", "leaves", "idxs", "total", "pad")
+
+    def __init__(self, shape: tuple, tape: Any, leaves: list,
+                 idxs: list, total: int, pad: int) -> None:
+        self.shape = shape
+        self.tape = tape
+        self.leaves = leaves
+        self.idxs = idxs
+        self.total = total
+        self.pad = pad
+
+
+def stage_vm(idx: Any, call: Any, shards: tuple,
+             use_delta: bool = True, max_tape: int | None = None,
+             max_leaves: int | None = None,
+             min_domain: int = VM_MIN_DOMAIN,
+             max_prefetch: int | None = VM_MAX_PREFETCH) -> VMStage | None:
+    """Stage one fused Count read for the bitmap VM, or None to route
+    the pre-existing engines (dense fused / plain ragged) — the
+    all-or-nothing per-query contract of ``plan_fused``, with one
+    deliberate difference: a pending ingest delta does NOT decline.
+    The overlay stages as two extra compressed leaves under a
+    ``dfuse`` node ((base & ~clear) | set, two tape instructions), so
+    ingest-warm rows stay on the compressed path instead of falling
+    back dense — the delta leaves stage BEFORE the base leaf, which
+    makes a concurrent compaction safe (idempotent re-apply, the
+    device_delta_stacks discipline)."""
+    from pilosa_tpu.ops import tape as _tp
+
+    if not _cfg.enabled or not shards:
+        return None
+    leaf_descs: list = []
+    shape = _walk(idx, call, leaf_descs)
+    if shape is None or not leaf_descs:
+        return None
+    nodemap: dict = {}
+    leaves: list[ContainerLeaf] = []
+    for i, (f, row_id) in enumerate(leaf_descs):
+        pair = None
+        if not use_delta:
+            # the ?nodelta=1 contract: compact up front, then a real
+            # pure-base read — which the VM is
+            f.flush_deltas(shards)
+        else:
+            pair = f.device_delta_container_leaves(row_id, shards)
+        base = f.device_container_leaf(row_id, shards)
+        if base.dense_slots():
+            bump("container.fallbacks")
+            return None
+        bi = len(leaves)
+        leaves.append(base)
+        if pair is None:
+            nodemap[i] = ("leaf", bi)
+        else:
+            si = len(leaves)
+            leaves.append(pair[0])
+            ci = len(leaves)
+            leaves.append(pair[1])
+            nodemap[i] = ("dfuse", ("leaf", bi), ("leaf", si),
+                          ("leaf", ci))
+
+    def subst(node: tuple) -> tuple:
+        if node[0] == "leaf":
+            return nodemap[node[1]]
+        return (node[0],) + tuple(subst(c) for c in node[1:])
+
+    vshape = subst(shape)
+    if max_leaves is not None and len(leaves) > max_leaves:
+        _tp.bump("tape.oversize_fallbacks")
+        return None
+    tp = _tp.try_compile(vshape, len(leaves), max_tape)
+    if tp is None:
+        return None
+    mkey = ("vm", vshape, tuple(leaf.uid for leaf in leaves),
+            int(min_domain))
+    with _stage_lock:
+        hit = _stage_memo.get(mkey)
+        if hit is not None:
+            _stage_memo[mkey] = _stage_memo.pop(mkey)  # LRU touch
+    if hit is None:
+        domains: list[np.ndarray] = []
+        for i in range(len(shards)):
+            keysets = [leaf.entries[i] for leaf in leaves]
+            domains.append(_domain(vshape, keysets))
+        total = int(sum(len(d) for d in domains))
+        pad = max(int(min_domain), _pow2(max(1, total)))
+        idxs = [_leaf_indices(leaf, domains, pad) for leaf in leaves]
+        hit = (total, pad, idxs)
+        with _stage_lock:
+            _stage_memo[mkey] = hit
+            while len(_stage_memo) > _STAGE_MEMO_CAP:
+                _stage_memo.pop(next(iter(_stage_memo)))
+    total, pad, idxs = hit
+    if max_prefetch is not None and len(leaves) * pad > max_prefetch:
+        # a single query's directory would blow the per-launch scalar
+        # budget even unbatched — the dense engines take it
+        return None
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    cpr = SHARD_WIDTH // CONTAINER_BITS
+    n_leaves = len(leaves)
+    bump("container.containers_gathered", total * n_leaves)
+    bump("container.containers_skipped",
+         n_leaves * (len(shards) * cpr - total))
+    if total == 0:
+        # the query still rides the batch (all-zero-row directory,
+        # count 0) — ONE launch either way, so the empty-domain case
+        # never forks the dispatch accounting like Plan._gathered must
+        bump("container.empty_domains")
+    return VMStage(vshape, tp, leaves, idxs, total, pad)
+
+
+# Megapool memo: a VM bucket's distinct leaves concatenate into ONE
+# device word pool the kernel gathers from; steady traffic re-flushes
+# the same leaf sets, and re-concatenating device pools per flush would
+# put an HBM copy on the hot path.  Keyed on the leaf uid tuple — uids
+# change on every rebuild, so stale megapools stop being addressed and
+# age out of the small LRU.
+_mega_lock = threading.Lock()
+_megapool_memo: dict = {}
+_MEGAPOOL_MEMO_CAP = 8
+
+
+def megapool(leaves: list) -> tuple:
+    """(pool, bases, zero_index) for a set of container leaves: the
+    concatenated word pool a VM bucket gathers from, each leaf's row
+    offset keyed by uid, and a canonical all-zero row (the first
+    leaf's own zero tail).  Device megapools pad their row count to
+    pow2 with zero rows so the gather programs keep lowering O(log)
+    distinct shapes (the P4 rule); host pools stay tight."""
+    order = sorted({leaf.uid: leaf for leaf in leaves}.values(),
+                   key=lambda leaf: leaf.uid)
+    key = tuple(leaf.uid for leaf in order)
+    with _mega_lock:
+        hit = _megapool_memo.get(key)
+        if hit is not None:
+            _megapool_memo[key] = _megapool_memo.pop(key)  # LRU touch
+            return hit
+    bases: dict = {}
+    off = 0
+    for leaf in order:
+        bases[leaf.uid] = off
+        off += int(leaf.pool.shape[0])
+    zero_index = bases[order[0].uid] + order[0].n
+    host = all(isinstance(leaf.pool, np.ndarray) for leaf in order)
+    if len(order) == 1:
+        pool = order[0].pool
+    elif host:
+        pool = np.concatenate([leaf.pool for leaf in order], axis=0)
+    else:
+        import jax.numpy as jnp
+
+        parts = [jnp.asarray(leaf.pool) for leaf in order]
+        rows = _pow2(off)
+        if rows > off:
+            parts.append(jnp.zeros((rows - off, CWORDS),
+                                   dtype=jnp.uint32))
+        pool = jnp.concatenate(parts, axis=0)
+    hit = (pool, bases, zero_index)
+    with _mega_lock:
+        _megapool_memo[key] = hit
+        while len(_megapool_memo) > _MEGAPOOL_MEMO_CAP:
+            _megapool_memo.pop(next(iter(_megapool_memo)))
+    return hit
 
 
 def _walk(idx: Any, call: Any, leaves: list) -> tuple | None:

@@ -285,6 +285,159 @@ def gathered_count_and(a_pool, ai, b_pool, bi, interpret: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# bitmap VM: ONE scalar-prefetch kernel for a megabatch of ragged op-tapes
+# over compressed container pools.  Each grid step (q, d) interprets query
+# q's flat register program (ops/tape.py grammar: AND/OR/XOR/ANDNOT/COPY
+# over leaf slots + instruction outputs) on domain slot d's container
+# blocks, which the BlockSpec index maps gather straight from the pooled
+# word storage via the host-computed directory (ops/containers.py) — the
+# Ragged Paged Attention recipe (heterogeneous work items driven by
+# scalar-prefetched indirection in one kernel) applied to expression
+# trees over roaring containers.  No dense register file and no dense
+# row word ever materializes: absent containers cost one canonical zero
+# block, and the fused popcount root reduces each (q, d) cell to a
+# single int32 in SMEM.
+# ---------------------------------------------------------------------------
+
+
+def _vm_counts_kernel(prog_ref, gidx_ref, *refs, slots: int,
+                      tape_len: int):
+    """One (query, domain-slot) cell: interpret the tape over the
+    gathered leaf blocks.  ``prog_ref`` is the scalar-prefetched
+    int32[B, T, 3] program (absolute register operands — ops/tape.py's
+    ``_abs_operand`` encoding, COPY-chain padded so the LAST register
+    holds the result); ``gidx_ref`` was consumed by the index maps.
+    The register file lives entirely in VMEM: ``slots`` gathered leaf
+    blocks + ``tape_len`` instruction outputs, each one container."""
+    del gidx_ref  # consumed by the BlockSpec index maps
+    out_ref = refs[-1]
+    leaf_refs = refs[:-1]
+    q = pl.program_id(0)
+    regs = jnp.concatenate(
+        [r[:] for r in leaf_refs]
+        + [jnp.zeros((tape_len, CONTAINER_WORDS), jnp.uint32)])
+    for t in range(tape_len):
+        # opcode constants are ops/tape.py's OP_AND..OP_COPY = range(5)
+        # (literal here so the kernel module stays import-light)
+        op = prog_ref[q, t, 0]
+        a = prog_ref[q, t, 1]
+        b = prog_ref[q, t, 2]
+        xa = lax.dynamic_slice(regs, (a, 0), (1, CONTAINER_WORDS))[0]
+        xb = lax.dynamic_slice(regs, (b, 0), (1, CONTAINER_WORDS))[0]
+        out = jnp.where(
+            op == 0, xa & xb,
+            jnp.where(op == 1, xa | xb,
+                      jnp.where(op == 2, xa ^ xb,
+                                jnp.where(op == 3, xa & ~xb, xa))))
+        regs = lax.dynamic_update_slice(regs, out[None],
+                                        (slots + t, 0))
+    out_ref[0, 0] = jnp.sum(
+        lax.population_count(regs[slots + tape_len - 1]),
+        dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _vm_counts_pallas(pool, prog, gidx, interpret: bool = False):
+    """grid (B, D): every query x domain-slot cell is one step whose
+    ``slots`` leaf blocks DMA from the ONE megapool through per-slot
+    index maps over the scalar-prefetched directory — the same buffer
+    is passed once per leaf slot, so no operand copy exists.  Output
+    is per-cell int32 popcounts (each <= 2^16, overflow-free); the
+    host sums them in int64."""
+    B, T, _ = prog.shape
+    L, _, D = gidx.shape
+    kernel = functools.partial(_vm_counts_kernel, slots=L, tape_len=T)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, D),
+        in_specs=[
+            pl.BlockSpec((1, CONTAINER_WORDS),
+                         lambda q, d, prog, gidx, _l=l: (gidx[_l, q, d], 0))
+            for l in range(L)
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda q, d, prog, gidx: (q, d),
+                               memory_space=pltpu.SMEM),
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B, D), jnp.int32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(prog, gidx, *([pool] * L))
+    return out
+
+
+def _vm_counts_host(pool, prog, gidx):
+    """Eager numpy twin of the VM kernel (host-mode engine and the
+    differential oracle for interpret-mode tests) — same register
+    grammar, per-cell int32 counts."""
+    from pilosa_tpu.ops import hostkernels as hk
+
+    B, T, _ = prog.shape
+    L, _, D = gidx.shape
+    pool = np.asarray(pool)
+    out = np.zeros((B, D), dtype=np.int32)
+    for q in range(B):
+        # vectorized over the domain axis: each register is [D, W], so a
+        # query costs T whole-array ops instead of D x T per-cell ops
+        regs = [pool[gidx[l, q]] for l in range(L)]
+        for t in range(T):
+            op, a, b = (int(v) for v in prog[q, t])
+            xa = regs[a]
+            if op == 4:
+                regs.append(xa)
+                continue
+            xb = regs[b]
+            if op == 0:
+                regs.append(xa & xb)
+            elif op == 1:
+                regs.append(xa | xb)
+            elif op == 2:
+                regs.append(xa ^ xb)
+            else:
+                regs.append(xa & ~xb)
+        out[q] = hk.row_counts(regs[-1])
+    return out
+
+
+@jax.jit
+def _vm_counts_jnp(pool, prog, gidx):
+    """Jitted XLA twin: gather every leaf block from the pool, then
+    run the EXACT tape-interpreter closure (ops/tape._one_query) per
+    query over [slots, D, W] leaf stacks — the two engines cannot
+    drift because they trace the same scan/switch body.  Re-lowers
+    per (B, T, L, D) bucket shape, which pow2 bucketing bounds."""
+    from pilosa_tpu.ops import tape as _tape_mod
+
+    leaves = jnp.take(pool, gidx, axis=0)   # [L, B, D, W]
+    leaves = jnp.moveaxis(leaves, 1, 0)     # [B, L, D, W]
+    one = _tape_mod._one_query(True)
+    return jax.vmap(one)(prog, leaves)      # [B, D] int32
+
+
+def vm_counts(pool, prog, gidx, interpret: bool = False):
+    """Per-cell popcounts int32[B, D] of a batch of op-tapes over one
+    pooled compressed operand: the Pallas VM on TPU, the jitted
+    gather+interpret twin elsewhere, eager numpy for host pools —
+    bit-identical counts on every route.  The caller
+    (ops/tape.execute_vm) owns the single dispatch tick."""
+    prog = np.ascontiguousarray(prog, dtype=np.int32)
+    gidx = np.ascontiguousarray(gidx, dtype=np.int32)
+    B, T, _ = prog.shape
+    _L, _, D = gidx.shape
+    if isinstance(pool, np.ndarray):
+        return _vm_counts_host(pool, prog, gidx)
+    progj = jnp.asarray(prog)
+    gidxj = jnp.asarray(gidx)
+    if (pool.shape[-1] == CONTAINER_WORDS
+            and _use_pallas(interpret, B * D * CONTAINER_WORDS,
+                            kernel="vm_counts")):
+        return _vm_counts_pallas(jnp.asarray(pool), progj, gidxj,
+                                 interpret=interpret)
+    return _vm_counts_jnp(jnp.asarray(pool), progj, gidxj)
+
+
+# ---------------------------------------------------------------------------
 # GroupBy cartesian counts: out[g, r] = |mat[r] & masks[g]| — one pass
 # over the row matrix per mask block, [GB, RB, WB] intermediate in VMEM
 # (SURVEY §7's third Pallas target; groupByIterator, executor.go:3058)
@@ -462,7 +615,8 @@ def _bsi_compare_jnp(planes, filt, upred: int, depth: int):
 from pilosa_tpu import devobs as _devobs  # noqa: E402
 
 for _n in ("_row_counts_masked_pallas", "_count_and_pallas",
-           "_gathered_count_and_pallas", "_mmc_pallas",
+           "_gathered_count_and_pallas", "_vm_counts_pallas",
+           "_vm_counts_jnp", "_mmc_pallas",
            "_bsi_compare_pallas"):
     globals()[_n] = _devobs.instrument(f"pallas.{_n.strip('_')}",
                                        globals()[_n])

@@ -187,10 +187,17 @@ _counters = {
     "coalescer.shape_misses": 0,  # eligible queries with no same-shape
                                   # partner in their flushed batch
     "coalescer.shape_flushes": 0,  # flushes carrying >1 distinct shape
+    "vm.executions": 0,           # bitmap-VM launches (pallas/jnp/host)
+    "vm.queries": 0,              # queries served through those launches
+    "vm.fallbacks": 0,            # VM-gated queries routed to the dense
+                                  # ragged/fused engines instead
 }
 #: (counts, B, tape_len, slots, *stack_shape) combos the interpreter
 #: has lowered — the /debug/ragged program inventory.
 _lowered: set[tuple] = set()
+#: (B, tape_len, slots, domain) combos the bitmap VM has lowered —
+#: the /debug/ragged "vm" program inventory.
+_vm_lowered: set[tuple] = set()
 
 
 def bump(name: str, value: int = 1) -> None:
@@ -210,6 +217,7 @@ def reset_counters() -> None:
         for k in _counters:
             _counters[k] = 0
         _lowered.clear()
+        _vm_lowered.clear()
 
 
 def publish_gauges(stats: Any) -> None:
@@ -229,7 +237,10 @@ def debug() -> dict[str, Any]:
         progs = [{"counts": c, "batch": b, "tapeLen": t, "slots": s,
                   "stack": list(shape)}
                  for (c, b, t, s, *shape) in sorted(_lowered)]
-        return {"counters": dict(_counters), "programs": progs}
+        vm_progs = [{"batch": b, "tapeLen": t, "slots": s, "domain": d}
+                    for (b, t, s, d) in sorted(_vm_lowered)]
+        return {"counters": dict(_counters), "programs": progs,
+                "vm": {"programs": vm_progs}}
 
 
 # ------------------------------------------------------------ interpreter
@@ -468,6 +479,74 @@ def execute(batch: Sequence[tuple[Tape, tuple]], counts: bool = False,
             return [out[i] for i in range(n)]
     out = _program(counts)(jnp.asarray(tape_rows), leaves_arr)
     return [out[i] for i in range(n)]
+
+
+def execute_vm(batch: Sequence[tuple[Tape, list]], pool: Any,
+               zero_index: int, tape_len: int | None = None,
+               slots: int | None = None, interpret: bool = False,
+               max_prefetch: int | None = None) -> list[np.ndarray]:
+    """Execute a megabatch of (Tape, gather rows) queries over ONE
+    pooled compressed operand as ONE bitmap-VM launch
+    (ops/pallas_kernels.vm_counts).
+
+    Each query's second element is its per-leaf-slot list of int32[D]
+    GLOBAL pool row indices (the coalescer globalizes the staged
+    per-leaf directories against the bucket megapool —
+    ops/containers.megapool); every query in the batch shares one
+    domain width D.  ``zero_index`` is the megapool's canonical
+    all-zero row: pad slots, pad batch rows and absent containers all
+    gather it and contribute nothing.  Returns one int64[D] per-cell
+    count vector per query, in order — the query's total is the plain
+    sum (there is no shard-row alignment to trim; the domain already
+    concatenated the per-shard walks).
+
+    ``max_prefetch`` bounds the scalar-prefetch directory
+    (slots x batch x D int32 entries live in SMEM on chip): an
+    oversized batch splits in half recursively, each half its own
+    launch — the ≤2-launch degradation the acceptance pin allows."""
+    if not batch:
+        return []
+    tb, lb = size_class(max(len(t.instrs) for t, _ in batch),
+                        max(t.n_leaves for t, _ in batch))
+    tape_len = tape_len or tb
+    slots = slots or lb
+    for tp, idxs in batch:
+        if len(tp.instrs) > tape_len or len(idxs) > slots:
+            raise TapeError("tape exceeds its bucket")
+    n = len(batch)
+    D = len(batch[0][1][0])
+    b_pad = _pow2(n)
+    if (max_prefetch is not None and n > 1
+            and slots * b_pad * D > max_prefetch):
+        mid = (n + 1) // 2
+        return (execute_vm(batch[:mid], pool, zero_index, tape_len,
+                           slots, interpret, max_prefetch)
+                + execute_vm(batch[mid:], pool, zero_index, tape_len,
+                             slots, interpret, max_prefetch))
+    bm.note_dispatch("vm")
+    bump("vm.executions")
+    bump("vm.queries", n)
+    prog = np.zeros((b_pad, tape_len, 3), dtype=np.int32)
+    prog[:, :, 0] = OP_COPY  # pad rows: COPY of leaf slot 0
+    gidx = np.full((slots, b_pad, D), zero_index, dtype=np.int32)
+    for qi, (tp, idxs) in enumerate(batch):
+        for ti, (op, a, b) in enumerate(tp.instrs):
+            prog[qi, ti] = (op, _abs_operand(a, slots),
+                            _abs_operand(b, slots))
+        final = slots + len(tp.instrs) - 1
+        # short tapes chain COPYs of the final real register forward,
+        # exactly like execute() — the LAST register holds the result
+        prog[qi, len(tp.instrs):, 1] = final
+        for li, ix in enumerate(idxs):
+            gidx[li, qi, :len(ix)] = ix
+    with _lock:
+        _vm_lowered.add((b_pad, tape_len, slots, D))
+    from pilosa_tpu.ops import pallas_kernels as pk
+
+    cts = np.asarray(pk.vm_counts(pool, prog, gidx,
+                                  interpret=interpret),
+                     dtype=np.int64)
+    return [cts[i] for i in range(n)]
 
 
 # --------------------------------------------------------------- prewarm

@@ -229,7 +229,8 @@ class Transport:
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   notiers: bool = False, partial: bool = False,
+                   notiers: bool = False, novm: bool = False,
+                   partial: bool = False,
                    tenant: str | None = None):
         """Execute pql on the remote node restricted to `shards` with
         remote semantics (no re-translation).  Returns the result list.
@@ -243,7 +244,9 @@ class Transport:
         forwards ?nomesh=1 (peers run their fused dispatches on the
         pre-mesh single-device programs); ``notiers``
         forwards ?notiers=1 (peers bypass their tiered residency:
-        inline rebuilds, drop-not-demote); ``partial``
+        inline rebuilds, drop-not-demote); ``novm`` forwards ?novm=1
+        (peers route their coalesced sparse reads through the pre-VM
+        engines); ``partial``
         forwards ?partial=1 (degraded-read semantics ride sub-queries
         like the other per-request escapes); ``tenant`` forwards the
         origin's tenant id as ?tenant= (the peer's admission gate,
@@ -314,7 +317,8 @@ class LocalTransport(Transport):
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   notiers: bool = False, partial: bool = False,
+                   notiers: bool = False, novm: bool = False,
+                   partial: bool = False,
                    tenant: str | None = None):
         from pilosa_tpu.parallel.executor import ExecOptions
 
@@ -328,7 +332,7 @@ class LocalTransport(Transport):
                 remote=True, shards=None if shards is None else list(shards),
                 cache=not nocache, delta=not nodelta,
                 containers=not nocontainers, mesh=not nomesh,
-                tiers=not notiers,
+                tiers=not notiers, vm=not novm,
                 partial=partial, missing=set() if partial else None,
                 tenant=tenant,
             ),
@@ -360,7 +364,8 @@ class BoundTransport(Transport):
     def query_node(self, node: Node, index: str, pql: str, shards: list[int],
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   notiers: bool = False, partial: bool = False,
+                   notiers: bool = False, novm: bool = False,
+                   partial: bool = False,
                    tenant: str | None = None):
         self.parent._check_partition(self.src, node.id)
         extra = {}
@@ -374,6 +379,8 @@ class BoundTransport(Transport):
             extra["nomesh"] = True
         if notiers:
             extra["notiers"] = True
+        if novm:
+            extra["novm"] = True
         if partial:
             extra["partial"] = True
         if tenant is not None:

@@ -60,6 +60,7 @@ import numpy as np
 from pilosa_tpu import observe as _observe
 from pilosa_tpu import stats as _stats
 from pilosa_tpu import tracing
+from pilosa_tpu.ops import containers as _containers
 from pilosa_tpu.ops import tape as _tape
 from pilosa_tpu.serve.deadline import DeadlineExceededError
 
@@ -86,7 +87,7 @@ def resolve_enabled(mode) -> bool:
 
 class _Bucket:
     __slots__ = ("items", "full", "sealed",
-                 "n_final", "shapes_final", "tape_final",
+                 "n_final", "shapes_final", "tape_final", "vm_final",
                  "flush_t0", "launch_ns")
 
     def __init__(self):
@@ -97,11 +98,13 @@ class _Bucket:
         # flight-recorder breakdown, written by the leader BEFORE the
         # futures resolve (so every waiter may read them after
         # fut.result() without a lock): final batch occupancy, distinct
-        # shape count, whether the tape interpreter ran, flush start
-        # (perf_counter_ns), and device-launch duration
+        # shape count, whether the tape interpreter ran, whether the
+        # bitmap VM ran, flush start (perf_counter_ns), and
+        # device-launch duration
         self.n_final = 0
         self.shapes_final = 0
         self.tape_final = False
+        self.vm_final = False
         self.flush_t0 = 0
         self.launch_ns = 0
 
@@ -112,17 +115,22 @@ class _Entry:
     is the device mesh this query's launch must run under (None = the
     pre-mesh single-device programs — ?nomesh=1 / [mesh] off).  The
     bucket key carries the mesh identity, so queries on different
-    placement flavors never share a launch."""
+    placement flavors never share a launch.  ``vm`` is the query's
+    compressed VM staging (ops/containers.VMStage) when the bitmap VM
+    routes it — VM entries carry no dense leaf stacks at all."""
 
-    __slots__ = ("shape", "leaves", "tape", "fut", "deadline", "mesh")
+    __slots__ = ("shape", "leaves", "tape", "fut", "deadline", "mesh",
+                 "vm")
 
-    def __init__(self, shape, leaves, tape, fut, deadline, mesh=None):
+    def __init__(self, shape, leaves, tape, fut, deadline, mesh=None,
+                 vm=None):
         self.shape = shape
         self.leaves = leaves
         self.tape = tape
         self.fut = fut
         self.deadline = deadline
         self.mesh = mesh
+        self.vm = vm
 
 
 class Coalescer:
@@ -132,13 +140,23 @@ class Coalescer:
     def __init__(self, window_s: float = 0.002, max_batch: int = 32,
                  enabled="auto", stats=None, ragged: bool = True,
                  max_tape: int = _tape.DEFAULT_MAX_TAPE,
-                 max_leaves: int = _tape.DEFAULT_MAX_LEAVES):
+                 max_leaves: int = _tape.DEFAULT_MAX_LEAVES,
+                 vm: bool = True,
+                 vm_min_domain: int = _containers.VM_MIN_DOMAIN,
+                 vm_max_prefetch: int = _containers.VM_MAX_PREFETCH):
         self.window_s = window_s
         self.max_batch = max_batch
         self.enabled = resolve_enabled(enabled)
         self.ragged = bool(ragged)
         self.max_tape = max_tape
         self.max_leaves = max_leaves
+        # the Pallas bitmap VM ([vm] config): heterogeneous ragged
+        # buckets whose every leaf stages compressed execute as ONE
+        # scalar-prefetch kernel over the pooled containers — rides
+        # the ragged engine, so [ragged] off disables it too
+        self.vm = bool(vm)
+        self.vm_min_domain = int(vm_min_domain)
+        self.vm_max_prefetch = int(vm_max_prefetch)
         self.stats = stats if stats is not None else _stats.NOP
         from pilosa_tpu import lockcheck
 
@@ -211,7 +229,8 @@ class Coalescer:
     def count(self, executor, idx, child, shards: tuple[int, ...],
               deadline=None, cache_fill=None,
               use_delta: bool = True, mesh=None,
-              tenant: str | None = None) -> int:
+              tenant: str | None = None,
+              use_vm: bool = True) -> int:
         """One Count(tree) query through the batching window -> total.
         Staging runs on the CALLER's thread (fragment locks, and a
         staging error belongs to this query alone).
@@ -238,12 +257,37 @@ class Coalescer:
         actually costs — and a ?nodelta=1 query (which compacts up
         front and stages plain leaves) batches with a delta-reading
         one only when the programs are identical anyway."""
-        shape, leaves = executor._fused_expr(idx, child, shards,
-                                             use_delta=use_delta)
-        key, tp = self._bucket_key(idx, shape, shards, leaves,
-                                   mesh=mesh)
-        entry = _Entry(shape, leaves, tp, Future(), deadline,
-                       mesh=mesh)
+        vmstage = None
+        if self.vm and self.ragged and use_vm and mesh is None:
+            # the bitmap VM: stage compressed (directories + local
+            # gather rows, NO dense stacks) and key on the tape size
+            # class alone — domain widths re-pad to the bucket max at
+            # flush, so 16 structurally distinct sparse queries still
+            # meet in ONE bucket and ONE kernel.  mesh is None only:
+            # the VM is a single-device kernel; mesh-routed queries
+            # keep the shard_map interpreter.  Any decline (dense/hot
+            # leaf, ineligible tree, oversize) falls through to the
+            # existing ragged/fused staging below, all-or-nothing.
+            vmstage = _containers.stage_vm(
+                idx, child, shards, use_delta=use_delta,
+                max_tape=self.max_tape, max_leaves=self.max_leaves,
+                min_domain=self.vm_min_domain,
+                max_prefetch=self.vm_max_prefetch)
+            if vmstage is None:
+                _tape.bump("vm.fallbacks")
+        if vmstage is not None:
+            tb, lb = _tape.size_class(len(vmstage.tape.instrs),
+                                      len(vmstage.leaves))
+            key = ("vm", tb, lb)
+            entry = _Entry(vmstage.shape, (), vmstage.tape, Future(),
+                           deadline, mesh=None, vm=vmstage)
+        else:
+            shape, leaves = executor._fused_expr(idx, child, shards,
+                                                 use_delta=use_delta)
+            key, tp = self._bucket_key(idx, shape, shards, leaves,
+                                       mesh=mesh)
+            entry = _Entry(shape, leaves, tp, Future(), deadline,
+                           mesh=mesh)
         t0 = time.perf_counter_ns()
         with self._lock:
             bucket = self._pending.get(key)
@@ -280,13 +324,23 @@ class Coalescer:
                 "batch": bucket.n_final,
                 "shapes": bucket.shapes_final,
                 "tape": bucket.tape_final,
+                "vm": bucket.vm_final,
                 "queue_wait_ns": max(0, bucket.flush_t0 - t0),
                 "launch_ns": bucket.launch_ns,
                 "leader": leader,
             }
-        # leaf stacks are padded to the device multiple — sum only the
-        # live shard rows, in Python ints (int32 could wrap)
-        total = int(np.asarray(counts, dtype=np.int64)[:len(shards)].sum())
+        arr = np.asarray(counts, dtype=np.int64)
+        if entry.vm is not None:
+            # VM results are per-domain-slot counts over the bucket's
+            # padded domain — pad slots gather the megapool zero row
+            # and contribute 0, and the domain already concatenated
+            # the per-shard walks, so the total sums ALL slots (there
+            # is no shard-row alignment to trim)
+            total = int(arr.sum())
+        else:
+            # leaf stacks are padded to the device multiple — sum only
+            # the live shard rows, in Python ints (int32 could wrap)
+            total = int(arr[:len(shards)].sum())
         if cache_fill is not None:
             rc, key, gens = cache_fill
             rc.put(key, gens, total, 32, tenant=tenant)
@@ -358,7 +412,39 @@ class Coalescer:
                 t_launch = time.perf_counter_ns()
                 from pilosa_tpu.runtime import residency as _residency
 
-                if n == 1:
+                if live[0].vm is not None:
+                    # bitmap-VM bucket (every entry staged compressed
+                    # — the key's "vm" leader guarantees it): the
+                    # distinct leaves concatenate into ONE megapool,
+                    # each entry's local gather rows globalize against
+                    # it (re-padded to the bucket-wide domain width
+                    # with the canonical zero row), and the whole
+                    # heterogeneous batch executes as ONE
+                    # scalar-prefetch kernel that never materializes a
+                    # dense register file (ops/tape.execute_vm ->
+                    # ops/pallas_kernels.vm_counts)
+                    bucket.tape_final = True
+                    bucket.vm_final = True
+                    span.set_tag("vm", True)
+                    tb, lb = _tape.size_class(
+                        max(len(it.tape.instrs) for it in live),
+                        max(len(it.vm.leaves) for it in live))
+                    D = max(it.vm.pad for it in live)
+                    pool, bases, zero = _containers.megapool(
+                        [lf for it in live for lf in it.vm.leaves])
+                    vbatch = []
+                    for it in live:
+                        rows = []
+                        for lf, ix in zip(it.vm.leaves, it.vm.idxs):
+                            g = np.full(D, zero, dtype=np.int32)
+                            g[:len(ix)] = bases[lf.uid] + ix
+                            rows.append(g)
+                        vbatch.append((it.tape, rows))
+                    results = _residency.run_with_oom_retry(
+                        lambda: _tape.execute_vm(
+                            vbatch, pool, zero, tape_len=tb, slots=lb,
+                            max_prefetch=self.vm_max_prefetch))
+                elif n == 1:
                     # single-query passthrough: the identical program
                     # the un-coalesced path would run
                     results = _residency.run_with_oom_retry(

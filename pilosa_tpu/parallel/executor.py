@@ -102,6 +102,12 @@ class ExecOptions:
     # (parallel/meshexec.py stays out of the launch); results are
     # byte-identical either way
     mesh: bool = True
+    # per-request opt-out of the Pallas bitmap VM (the HTTP layer's
+    # ?novm=1 — symmetric with ?nocontainers): coalesced sparse Count
+    # batches route the pre-VM ragged/fused engines instead of the
+    # one-kernel compressed megabatch (ops/tape.execute_vm); results
+    # are byte-identical either way
+    vm: bool = True
     # per-request opt-out of tiered residency (the HTTP layer's
     # ?notiers=1 — symmetric with the other escapes): host-tier
     # lookups miss, evictions drop instead of demoting, and misses
@@ -602,6 +608,10 @@ class Executor:
                 # forward ?nomesh=1: peers run their own fused
                 # dispatches on the pre-mesh single-device programs
                 extra["nomesh"] = True
+            if opt is not None and not opt.vm:
+                # forward ?novm=1: peers route their own coalesced
+                # sparse reads through the pre-VM engines too
+                extra["novm"] = True
             if opt is not None and not opt.tiers:
                 # forward ?notiers=1: peers bypass their own tiered
                 # residency too (inline rebuilds, drop-not-demote)
@@ -1279,8 +1289,18 @@ class Executor:
         except (ExecutionError, ValueError, KeyError, TypeError,
                 AttributeError):
             return None
+        # the active placement flavor joins the key (PR 12 follow-up):
+        # a [mesh] toggle or axis resize must not serve fills staged
+        # under the previous device layout — and when the operator
+        # toggles BACK, the old flavor's still-generation-valid
+        # entries become warm again instead of having been overwritten
+        from pilosa_tpu.parallel import meshexec as _meshexec
+
+        placement = _meshexec.placement_token(
+            opt is None or opt.mesh)
         key = resultcache.Key(
-            (self.holder.uid, idx.name, kind, sig, extra, shards))
+            (self.holder.uid, idx.name, kind, sig, extra, shards,
+             placement))
         rec = _observe.current()
         if rec is not None:
             rec.cache_key = resultcache.key_digest(key)
@@ -1656,7 +1676,12 @@ class Executor:
                                             cache_fill=probe,
                                             use_delta=opt.delta,
                                             mesh=self._query_mesh(opt),
-                                            tenant=opt.tenant)
+                                            tenant=opt.tenant,
+                                            # ?nocontainers disables
+                                            # the VM too: it executes
+                                            # over compressed pools
+                                            use_vm=(opt.vm
+                                                    and opt.containers))
             t_f = _time.perf_counter_ns()
             total = sum(compute_counts(shards))
             if rec is not None:

@@ -53,6 +53,7 @@ bytes live and WHEN they transfer, never what they contain.
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 import time
@@ -479,7 +480,20 @@ class ResidencyManager:
                     self._tenant_pressure[ten] = \
                         self._tenant_pressure.get(ten, 0) + 1
             while self.total > self.budget and len(self._entries) > 1:
-                victim_id = next(iter(self._entries))
+                # prefer demoting a dense twin over a compressed
+                # container pool: the dense stack re-promotes from its
+                # host twin (or rebuilds from fragments), while the
+                # pool is what the bitmap VM gathers from — losing it
+                # forces the whole bucket back to the dense path.  The
+                # scan is bounded so admit() stays O(1)-ish; past the
+                # window the plain LRU head goes
+                victim_id = next(
+                    (vid for vid, e in itertools.islice(
+                        self._entries.items(), 32)
+                     if vid != eid and e[3] == "dense"),
+                    None)
+                if victim_id is None:
+                    victim_id = next(iter(self._entries))
                 if victim_id == eid:
                     # never evict the entry being admitted
                     self._entries[eid] = self._entries.pop(eid)

@@ -113,7 +113,13 @@ class Key:
     counts), and tuples do not cache their hash — the probe's
     get / pop / insert sequence would rehash it three times.  Wrapping
     computes it once; equality (only reached when hashes already
-    match) delegates to the C tuple compare."""
+    match) delegates to the C tuple compare.
+
+    Executor keys fold in the mesh placement token
+    (``meshexec.placement_token``) so a count computed under one
+    device placement never answers a probe made under another — a
+    mesh reshape (or mesh on/off flip) naturally misses instead of
+    serving a stale single-device result."""
 
     __slots__ = ("k", "h")
 

@@ -290,7 +290,8 @@ class InternalClient:
                    shards: list[int] | None = None, remote: bool = True,
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   notiers: bool = False, partial: bool = False,
+                   notiers: bool = False, novm: bool = False,
+                   partial: bool = False,
                    tenant: str | None = None):
         """POST /index/{i}/query with Remote semantics over the
         protobuf wire — node-to-node RPC speaks protobuf like the
@@ -306,8 +307,10 @@ class InternalClient:
         peer runs its fused dispatches on the pre-mesh single-device
         programs); ``notiers`` rides as ?notiers=1 (the peer bypasses
         its tiered residency: inline rebuilds, drop-not-demote);
-        ``tenant`` rides as ?tenant= so the peer charges the origin's
-        tenant ([tenants] isolation)."""
+        ``novm`` rides as ?novm=1 (the peer routes its coalesced
+        sparse reads through the pre-VM engines); ``tenant`` rides as
+        ?tenant= so the peer charges the origin's tenant ([tenants]
+        isolation)."""
         from pilosa_tpu import proto
 
         body = proto.encode(proto.QUERY_REQUEST, {
@@ -321,6 +324,7 @@ class InternalClient:
                                  ("nocontainers=1", nocontainers),
                                  ("nomesh=1", nomesh),
                                  ("notiers=1", notiers),
+                                 ("novm=1", novm),
                                  ("partial=1", partial)) if on]
         if tenant:
             from urllib.parse import quote
@@ -460,13 +464,15 @@ class HTTPTransport(Transport):
     def query_node(self, node: Node, index: str, pql: str, shards,
                    nocache: bool = False, nodelta: bool = False,
                    nocontainers: bool = False, nomesh: bool = False,
-                   notiers: bool = False, partial: bool = False,
+                   notiers: bool = False, novm: bool = False,
+                   partial: bool = False,
                    tenant: str | None = None):
         # the protobuf client already returns decoded result objects
         return self.client.query_node(node.uri, index, pql, shards,
                                       nocache=nocache, nodelta=nodelta,
                                       nocontainers=nocontainers,
                                       nomesh=nomesh, notiers=notiers,
+                                      novm=novm,
                                       partial=partial, tenant=tenant)
 
     def send_message(self, node: Node, message: dict) -> dict:

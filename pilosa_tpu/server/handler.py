@@ -764,6 +764,11 @@ class Handler:
                 # inline — the pre-tier behavior; results are
                 # byte-identical either way)
                 tiers=params.get("notiers") not in ("1", "true"),
+                # ?novm=1: route coalesced sparse reads through the
+                # pre-VM ragged/fused engines instead of the Pallas
+                # bitmap VM (debugging escape; results are
+                # byte-identical either way)
+                vm=params.get("novm") not in ("1", "true"),
                 partial=partial,
                 partial_meta=partial_meta,
                 # tenant identity (X-Pilosa-Tenant / ?tenant=): rides
@@ -1296,7 +1301,10 @@ class Handler:
         (executions, queries served, per-query fallbacks, shape
         misses), and the interpreter program inventory — which
         (batch, tape-length, leaf-slot, stack-shape) bucket variants
-        this process has lowered."""
+        this process has lowered.  The ``vm`` section covers the
+        Pallas bitmap VM: the [vm] knobs in force, the vm.* counters,
+        and the (batch, tape-length, slot, domain) program variants
+        the scalar-prefetch kernel has lowered."""
         from pilosa_tpu.ops import tape
 
         out = tape.debug()
@@ -1310,6 +1318,9 @@ class Handler:
                 "maxLeaves": co.max_leaves,
                 "windowMs": co.window_s * 1e3,
                 "maxBatch": co.max_batch,
+                "vm": co.vm,
+                "vmMinDomain": co.vm_min_domain,
+                "vmMaxPrefetch": co.vm_max_prefetch,
             })
         self._json(req, out)
 

@@ -59,6 +59,9 @@ class Server:
         ragged_max_tape: int = 32,
         ragged_max_leaves: int = 16,
         ragged_prewarm: bool = True,
+        vm_enabled: bool = True,
+        vm_min_domain: int = 8,
+        vm_max_prefetch: int = 65536,
         observe_enabled: bool = True,
         observe_recent: int = 256,
         observe_long_query_time: float = 0.0,
@@ -206,6 +209,9 @@ class Server:
             ragged=ragged_enabled,
             max_tape=ragged_max_tape,
             max_leaves=ragged_max_leaves,
+            vm=vm_enabled,
+            vm_min_domain=vm_min_domain,
+            vm_max_prefetch=vm_max_prefetch,
         )
         self._ragged_prewarm = ragged_prewarm
         # query flight recorder ([observe] config): /debug/queries,

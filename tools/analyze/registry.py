@@ -195,6 +195,7 @@ MODULE_LOCKS: dict[str, tuple] = {
     "ops/tape.py": (
         ModuleGlobalRule("_counters", "_lock", "rw"),
         ModuleGlobalRule("_lowered", "_lock", "rw"),
+        ModuleGlobalRule("_vm_lowered", "_lock", "rw"),
     ),
     "ops/containers.py": (
         ModuleGlobalRule("_counters", "_lock", "rw"),
@@ -202,6 +203,7 @@ MODULE_LOCKS: dict[str, tuple] = {
         ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
         ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
         ModuleGlobalRule("_stage_memo", "_stage_lock", "w"),
+        ModuleGlobalRule("_megapool_memo", "_mega_lock", "w"),
     ),
     "runtime/resultcache.py": (
         # reads are the lock-free fast path (documented); rebinds only
@@ -330,6 +332,7 @@ CONDITION_ATTRS = ("_snap_done",)
 #: Call suffixes that reach a jitted program whose lowering
 #: specializes on input shape.
 JIT_ENTRY_SUFFIXES = ("expr.evaluate", "tape.execute", "_tape.execute",
+                      "tape.execute_vm", "_tape.execute_vm",
                       "expr.evaluate_gathered")
 #: Batch-stack builders whose output shape tracks their (variable)
 #: input length.
