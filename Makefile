@@ -6,7 +6,7 @@
 
 PY ?= python
 
-.PHONY: test test-paranoia test-shard22 test-matrix analyze typecheck bench measure measure-resize measure-spmd validate-tpu soak soak-spmd check doccheck doccheck-fill native clean
+.PHONY: test test-paranoia test-shard22 test-matrix analyze typecheck bench perfsnapshot measure measure-resize measure-spmd validate-tpu soak soak-spmd check doccheck doccheck-fill native clean
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -54,6 +54,19 @@ doccheck-fill:
 # north-star benchmark: one JSON line (driver artifact)
 bench:
 	$(PY) bench.py
+
+# dated chip capture with measured per-engine bw_util (perfobs), plus
+# a full metric-family sweep against a throwaway live server (usage:
+# make perfsnapshot CAPTURE_ARGS="--profile --compare BENCH_r10.json")
+perfsnapshot:
+	$(PY) -m tools.chipcapture $(CAPTURE_ARGS)
+	$(PY) -c "import tempfile, urllib.request; \
+	from pilosa_tpu.server.server import Server; \
+	from tools import check_metrics as cm; \
+	s = Server(tempfile.mkdtemp() + '/perfsnap'); s.open(); \
+	t = urllib.request.urlopen(s.uri + '/metrics', timeout=10).read().decode(); \
+	cm.check_families(t, cm.ALL_FAMILIES); s.close(); \
+	print('metric families: ok')"
 
 # all BASELINE.md configs, one JSON line each
 measure:

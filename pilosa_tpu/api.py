@@ -169,6 +169,7 @@ class API:
             if res is not None:
                 if rec is not None:
                     rec.note_path("collective")
+                    rec.note_engine("collective")
                     rec.result_sizes = [_observe.result_size(r)
                                         for r in res]
                     recorder.publish(rec)

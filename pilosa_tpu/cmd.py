@@ -409,6 +409,9 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         observe_long_query_time=cfg.observe.long_query_time,
         observe_device_sample_interval=cfg.observe.device_sample_interval,
         observe_fanin_timeout=cfg.observe.fanin_timeout,
+        observe_device_peak_gbps=cfg.observe.device_peak_gbps,
+        observe_profiler_max_seconds=cfg.observe.profiler_max_seconds,
+        cost_shadow=cfg.cost.shadow,
         admission_enabled=cfg.admission.enabled,
         admission_query_cap=cfg.admission.query_cap,
         admission_query_queue=cfg.admission.query_queue,

@@ -214,13 +214,40 @@ class ObserveConfig:
     gauges into the stats backends — pull scrapers get fresh gauges at
     /metrics anyway, so the loop only matters for push (statsd)
     deployments; ``fanin_timeout`` (seconds) bounds each peer fetch of
-    the cluster-wide ``GET /debug/cluster/*`` merge."""
+    the cluster-wide ``GET /debug/cluster/*`` merge.
+
+    Engine observatory (pilosa_tpu.perfobs):
+    ``device_peak_gbps`` is the memory-bandwidth roof the per-engine
+    achieved GB/s is reported against (``bw_util`` on /debug/cost and
+    in chip captures); 0 (the default) picks a datasheet ballpark per
+    jax device kind — set it when the exact part's roof is known.
+    ``profiler_max_seconds`` auto-stops an on-demand device profiler
+    capture (``POST /debug/profiler/start``) that was never stopped
+    (0 disables the deadline — captures then run until the explicit
+    stop)."""
 
     enabled: bool = True
     recent: int = 256
     long_query_time: float = 0.0  # seconds; 0 disables slow-query log
     device_sample_interval: float = 0.0  # seconds; 0 = scrape-time only
     fanin_timeout: float = 2.0  # seconds per peer in /debug/cluster/*
+    device_peak_gbps: float = 0.0  # GB/s roof; 0 = per-device default
+    profiler_max_seconds: float = 30.0  # capture auto-stop; 0 = never
+
+
+@dataclass
+class CostConfig:
+    """[cost] — the shadow cost model (pilosa_tpu.perfobs; no
+    reference analog — the stepping stone to a cost-based planner,
+    ROADMAP item 4).  With ``shadow`` on (the default), the
+    executor/coalescer consult the observed-cost table AFTER choosing
+    an engine: the table's verdict is stamped onto the flight record
+    (``wouldChoose``/``costDisagree``) and ``cost.disagreements``
+    ticks, while routing itself stays byte-identical to a consult-free
+    build — there is no active mode yet.  ``shadow = false`` turns the
+    consult off entirely (per-launch samples still collect)."""
+
+    shadow: bool = True
 
 
 @dataclass
@@ -419,6 +446,7 @@ class Config:
     ragged: RaggedConfig = field(default_factory=RaggedConfig)
     vm: VMConfig = field(default_factory=VMConfig)
     observe: ObserveConfig = field(default_factory=ObserveConfig)
+    cost: CostConfig = field(default_factory=CostConfig)
     admission: AdmissionConfig = field(default_factory=AdmissionConfig)
     cache: CacheConfig = field(default_factory=CacheConfig)
     ingest: IngestConfig = field(default_factory=IngestConfig)
@@ -466,8 +494,8 @@ class Config:
             if key in ("cluster", "anti_entropy", "replication",
                        "metric", "tracing",
                        "profile", "tls", "coalescer", "ragged", "vm",
-                       "observe", "admission", "cache", "ingest",
-                       "containers", "mesh", "residency",
+                       "observe", "cost", "admission", "cache",
+                       "ingest", "containers", "mesh", "residency",
                        "faultinject", "tenants") and isinstance(v, dict):
                 section = getattr(self, key)
                 for sk, sv in v.items():
@@ -486,6 +514,7 @@ class Config:
                                                         RaggedConfig,
                                                         VMConfig,
                                                         ObserveConfig,
+                                                        CostConfig,
                                                         AdmissionConfig,
                                                         CacheConfig,
                                                         IngestConfig,
@@ -503,9 +532,9 @@ class Config:
             if f.name in ("cluster", "anti_entropy", "replication",
                           "metric", "tracing",
                           "profile", "tls", "coalescer", "ragged",
-                          "vm", "observe", "admission", "cache",
-                          "ingest", "containers", "mesh", "residency",
-                          "faultinject", "tenants"):
+                          "vm", "observe", "cost", "admission",
+                          "cache", "ingest", "containers", "mesh",
+                          "residency", "faultinject", "tenants"):
                 section = getattr(self, f.name)
                 for sf in fields(section):
                     key = f"{ENV_PREFIX}{f.name}_{sf.name}".upper()
@@ -595,6 +624,12 @@ class Config:
             f"device-sample-interval = "
             f"{self.observe.device_sample_interval}",
             f"fanin-timeout = {self.observe.fanin_timeout}",
+            f"device-peak-gbps = {self.observe.device_peak_gbps}",
+            f"profiler-max-seconds = "
+            f"{self.observe.profiler_max_seconds}",
+            "",
+            "[cost]",
+            f"shadow = {str(self.cost.shadow).lower()}",
             "",
             "[admission]",
             f"enabled = {str(self.admission.enabled).lower()}",

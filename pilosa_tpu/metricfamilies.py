@@ -156,6 +156,18 @@ FAMILIES: tuple[Family, ...] = (
            "ignored at reload (models/fragment.py)",
            live_prefixes=("wal_",), group="repl",
            doc="administration.md"),
+    Family("engine", "engine_",
+           "engine observatory per-launch accounting: sampled launch/"
+           "byte totals plus per-engine tagged wall/bandwidth/bw_util "
+           "gauges (pilosa_tpu.perfobs)",
+           live_prefixes=("engine_",), group="engine",
+           doc="administration.md"),
+    Family("cost", "cost_",
+           "shadow cost model: cost-table samples/cells, shadow "
+           "consults and disagreements, completed profiler captures "
+           "(pilosa_tpu.perfobs)",
+           live_prefixes=("cost_",), group="engine",
+           doc="administration.md"),
     Family("tenant", "tenant_",
            "per-tenant isolation totals: admission admitted/shed/"
            "waiting, result-cache bytes, residency HBM/host bytes "

@@ -189,7 +189,8 @@ class QueryRecord:
         "launches", "path", "coalesce", "result_sizes", "error", "slow",
         "admission", "outcome", "compiles", "cached", "cache_key",
         "delta_notes", "compacted", "hedged", "hedge_wins",
-        "missing_shards", "tier_notes", "tenant",
+        "missing_shards", "tier_notes", "tenant", "engine",
+        "would_choose",
     )
 
     def __init__(self, qid: int, index: str, pql: str,
@@ -208,6 +209,18 @@ class QueryRecord:
         self.node_ns: list[tuple[str, int, int]] = [] # (node, ns, n_shards)
         self.launches: list[str] = []
         self.path: str | None = None  # fused|per-shard|coalesced|collective
+        # the ONE canonical engine enum (pilosa_tpu.perfobs.ENGINES:
+        # dense|gather|tape|vm|mesh|host|collective) — unifies the
+        # scattered path string + tape/vm booleans; ``path`` stays
+        # populated for compat.  Stamped by perfobs.sample per launch
+        # (last launch wins — the engine that produced the result);
+        # plain attribute store, race-free under the GIL
+        self.engine: str | None = None
+        # SHADOW cost-model verdict ([cost] shadow=true): the engine
+        # the observed-cost table would have picked when it disagrees
+        # with routing (rendered wouldChoose + costDisagree) — routing
+        # itself is never changed by it
+        self.would_choose: str | None = None
         self.coalesce: dict | None = None
         self.result_sizes: list[int] = []
         self.error: str | None = None
@@ -305,6 +318,12 @@ class QueryRecord:
 
     def note_path(self, path: str) -> None:
         self.path = path
+
+    def note_engine(self, engine: str) -> None:
+        """The canonical engine that executed (a perfobs.ENGINES
+        value) — last launch wins, so a fallback ladder ends up
+        attributed to the engine that actually produced the result."""
+        self.engine = engine
 
     def note_tier(self, outcome: str, ns: int = 0) -> None:
         """One tiered stack access: ``hbm`` | ``promoted`` |
@@ -408,6 +427,13 @@ class QueryRecord:
             d["shardTimingsTruncated"] = True
         if self.path is not None:
             d["path"] = self.path
+        if self.engine is not None:
+            d["engine"] = self.engine
+        # shadow cost-model verdict: present only on a disagreement
+        # (the common agreeing record stays small)
+        if self.would_choose is not None:
+            d["wouldChoose"] = self.would_choose
+            d["costDisagree"] = True
         if self.coalesce is not None:
             c = self.coalesce
             d["coalescer"] = {
@@ -533,10 +559,11 @@ class FlightRecorder:
             compile_ms = sum(ns for _, ns in rec.compiles) / 1e6
             self.logger.printf(
                 "slow query (%.3fs) trace=%s on %s: %s | stages=%s "
-                "shards=%d launches=%d path=%s compiled=%s%s%s",
+                "shards=%d launches=%d path=%s engine=%s compiled=%s%s%s",
                 elapsed_s, rec.trace_id, rec.index, rec.pql,
                 ",".join(f"{n}:{v / 1e6:.1f}ms" for n, v in rec.stages),
                 rec.shards_n, len(rec.launches), rec.path or "-",
+                rec.engine or "-",
                 "true" if rec.compiles else "false",
                 f" compile_ms={compile_ms:.1f}" if rec.compiles else "",
                 f" tenant={rec.tenant}" if rec.tenant else "")

@@ -54,6 +54,8 @@ from typing import Any
 
 import numpy as np
 
+from pilosa_tpu import perfobs as _perfobs
+
 #: Container geometry: 2^16 bits = 1024 uint64 = 2048 uint32 words —
 #: the reference's container size and storage/roaring.py's block shape.
 CONTAINER_BITS = 1 << 16
@@ -394,6 +396,12 @@ class Plan:
             bm.note_dispatch("fused_gather")
             return None
         pools = [leaf.pool for leaf in self.leaves]
+        # engine-observatory coordinates for this launch: the dense
+        # stacks the gather replaced (size-class key) and the fraction
+        # of possible containers the directory walk actually touches
+        # (the sparsity the compressed engine exploits)
+        dense_work = len(self.leaves) * len(self.shards) * self.n_words
+        sparsity = total / max(1, len(self.shards) * self.cpr)
         if (counts and mesh is None
                 and self.shape == ("and", ("leaf", 0), ("leaf", 1))
                 and pk.on_tpu() and not isinstance(pools[0], np.ndarray)):
@@ -401,11 +409,18 @@ class Plan:
             # intersects+counts co-present containers in one pass
             # (single-device; the mesh route splits the domain walk
             # across chips through the shard_map gather instead)
-            return pk.gathered_count_and(pools[0], idxs[0],
-                                         pools[1], idxs[1])
-        return expr.evaluate_gathered(self.shape, tuple(pools),
-                                      tuple(idxs), counts=counts,
-                                      mesh=mesh)
+            t0 = _perfobs.t0()
+            out = pk.gathered_count_and(pools[0], idxs[0],
+                                        pools[1], idxs[1])
+            _perfobs.sample("gather", out, t0,
+                            nbytes=(len(idxs[0]) + len(idxs[1]))
+                            * CWORDS * 4,
+                            work=dense_work, sparsity=sparsity)
+            return out
+        with _perfobs.context(sparsity=sparsity, work=dense_work):
+            return expr.evaluate_gathered(self.shape, tuple(pools),
+                                          tuple(idxs), counts=counts,
+                                          mesh=mesh)
 
     # ----------------------------------------------------------- execution
 

@@ -49,9 +49,17 @@ from typing import Any
 
 import numpy as np
 
+from pilosa_tpu import perfobs as _perfobs
 from pilosa_tpu.ops import bitmap as bm
 
 _FOLD_NAMES = ("and", "or", "xor", "andnot")
+
+
+def _touched_bytes(*arrs) -> int:
+    """Analytic bytes one launch touches: operand reads + result
+    writes (perfobs bandwidth accounting) — ``.nbytes`` on every numpy
+    / jax operand, 0 for anything shapeless."""
+    return sum(getattr(a, "nbytes", 0) for a in arrs)
 
 
 def _validate(shape: tuple, n_leaves: int) -> None:
@@ -499,10 +507,16 @@ def evaluate(shape: tuple, leaves: tuple, counts: bool = False,
     if shape[0] == "leaf" and not counts:
         return leaves[shape[1]]  # passthrough: no launch at all
     bm.note_dispatch("fused_expr")
+    t0 = _perfobs.t0()
     if bm._host(*leaves):
-        if counts:
-            return _host_counts(shape, leaves)
-        return _host_tree(shape, leaves)
+        out = (_host_counts(shape, leaves) if counts
+               else _host_tree(shape, leaves))
+        # host fused is still the DENSE engine (same operands, numpy
+        # body); the executor's per-shard map re-attributes via
+        # perfobs.context(engine="host")
+        _perfobs.sample("dense", out, t0,
+                        nbytes=_touched_bytes(*leaves, out))
+        return out
     ndim = leaves[0].ndim
     if mesh is not None:
         from pilosa_tpu.parallel import meshexec
@@ -523,12 +537,19 @@ def evaluate(shape: tuple, leaves: tuple, counts: bool = False,
             # concurrent collective dispatches from different threads
             # can interleave per-device enqueues and deadlock the
             # backend (meshexec.launch_lock); execution pipelines —
-            # the lock covers the enqueue, not the compute
+            # the lock covers the enqueue, not the compute (and the
+            # perfobs block_until_ready waits OUTSIDE the lock)
             with meshexec.launch_lock():
-                return fn(*placed)
+                out = fn(*placed)
+            _perfobs.sample("mesh", out, t0,
+                            nbytes=_touched_bytes(*placed, out))
+            return out
     fn = _compiled(shape, counts)
     _note_program_cache_pressure()
-    return fn(*leaves)
+    out = fn(*leaves)
+    _perfobs.sample("dense", out, t0,
+                    nbytes=_touched_bytes(*leaves, out))
+    return out
 
 
 def evaluate_gathered(shape: tuple, pools: tuple, idxs: tuple,
@@ -551,11 +572,14 @@ def evaluate_gathered(shape: tuple, pools: tuple, idxs: tuple,
     program."""
     _validate(shape, len(pools))
     bm.note_dispatch("fused_gather")
+    t0 = _perfobs.t0()
     if bm._host(*pools):
         leaves = tuple(p[np.asarray(ix)] for p, ix in zip(pools, idxs))
-        if counts:
-            return _host_counts(shape, leaves)
-        return _host_tree(shape, leaves)
+        out = (_host_counts(shape, leaves) if counts
+               else _host_tree(shape, leaves))
+        _perfobs.sample("gather", out, t0,
+                        nbytes=_touched_bytes(*leaves, *idxs, out))
+        return out
     import jax.numpy as jnp
 
     if mesh is not None:
@@ -571,7 +595,20 @@ def evaluate_gathered(shape: tuple, pools: tuple, idxs: tuple,
             _note_program_cache_pressure()
             meshexec.note_launch()
             with meshexec.launch_lock():  # see evaluate's mesh route
-                return fn(*placed_pools, *placed_idxs)
+                out = fn(*placed_pools, *placed_idxs)
+            _perfobs.sample(
+                "mesh", out, t0,
+                nbytes=_touched_bytes(*placed_pools, *placed_idxs,
+                                      out))
+            return out
     fn = _compiled_gather(shape, counts)
     _note_program_cache_pressure()
-    return fn(*pools, *(jnp.asarray(ix) for ix in idxs))
+    out = fn(*pools, *(jnp.asarray(ix) for ix in idxs))
+    # the gathered pool rows are what the launch actually reads — the
+    # whole point of the compressed engine is touching D gathered
+    # container blocks instead of the dense stacks
+    gathered = sum(len(ix) for ix in idxs) * (
+        pools[0].shape[-1] * 4 if pools else 0)
+    _perfobs.sample("gather", out, t0,
+                    nbytes=gathered + _touched_bytes(*idxs, out))
+    return out

@@ -59,6 +59,7 @@ from typing import Any
 
 import numpy as np
 
+from pilosa_tpu import perfobs as _perfobs
 from pilosa_tpu.ops import bitmap as bm
 
 OP_AND, OP_OR, OP_XOR, OP_ANDNOT, OP_COPY = range(5)
@@ -425,8 +426,14 @@ def execute(batch: Sequence[tuple[Tape, tuple]], counts: bool = False,
     bm.note_dispatch("tape")
     bump("tape.executions")
     bump("tape.queries", n)
+    t0 = _perfobs.t0()
     if all(isinstance(lv, np.ndarray) for _, ls in batch for lv in ls):
-        return [_host_exec(tp, ls, counts) for tp, ls in batch]
+        outs = [_host_exec(tp, ls, counts) for tp, ls in batch]
+        _perfobs.sample(
+            "tape", outs, t0,
+            nbytes=sum(lv.nbytes for _, ls in batch for lv in ls)
+            + sum(getattr(o, "nbytes", 0) for o in outs))
+        return outs
 
     import jax.numpy as jnp
 
@@ -476,8 +483,16 @@ def execute(batch: Sequence[tuple[Tape, tuple]], counts: bool = False,
             with meshexec.launch_lock():
                 out = _mesh_program(counts, mesh)(tapes_dev,
                                                   leaves_dev)
+            # the perfobs block waits OUTSIDE the launch lock
+            _perfobs.sample(
+                "mesh", out, t0,
+                nbytes=leaves_arr.nbytes + tape_rows.nbytes
+                + getattr(out, "nbytes", 0))
             return [out[i] for i in range(n)]
     out = _program(counts)(jnp.asarray(tape_rows), leaves_arr)
+    _perfobs.sample("tape", out, t0,
+                    nbytes=leaves_arr.nbytes + tape_rows.nbytes
+                    + getattr(out, "nbytes", 0))
     return [out[i] for i in range(n)]
 
 
@@ -526,6 +541,7 @@ def execute_vm(batch: Sequence[tuple[Tape, list]], pool: Any,
     bm.note_dispatch("vm")
     bump("vm.executions")
     bump("vm.queries", n)
+    t0 = _perfobs.t0()
     prog = np.zeros((b_pad, tape_len, 3), dtype=np.int32)
     prog[:, :, 0] = OP_COPY  # pad rows: COPY of leaf slot 0
     gidx = np.full((slots, b_pad, D), zero_index, dtype=np.int32)
@@ -546,6 +562,14 @@ def execute_vm(batch: Sequence[tuple[Tape, list]], pool: Any,
     cts = np.asarray(pk.vm_counts(pool, prog, gidx,
                                   interpret=interpret),
                      dtype=np.int64)
+    # what the VM launch actually touches: the gathered container
+    # blocks (every directory entry DMAs one pool row), the SMEM
+    # directory + programs, and the count outputs — never the dense
+    # register file (the engine's whole point)
+    cwords = int(pool.shape[-1]) if getattr(pool, "ndim", 0) else 0
+    _perfobs.sample("vm", cts, t0,
+                    nbytes=gidx.size * cwords * 4 + gidx.nbytes
+                    + prog.nbytes + cts.nbytes)
     return [cts[i] for i in range(n)]
 
 

@@ -58,6 +58,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from pilosa_tpu import observe as _observe
+from pilosa_tpu import perfobs as _perfobs
 from pilosa_tpu import stats as _stats
 from pilosa_tpu import tracing
 from pilosa_tpu.ops import containers as _containers
@@ -88,7 +89,7 @@ def resolve_enabled(mode) -> bool:
 class _Bucket:
     __slots__ = ("items", "full", "sealed",
                  "n_final", "shapes_final", "tape_final", "vm_final",
-                 "flush_t0", "launch_ns")
+                 "flush_t0", "launch_ns", "engine", "would_choose")
 
     def __init__(self):
         # _Entry per enqueued query
@@ -107,6 +108,12 @@ class _Bucket:
         self.vm_final = False
         self.flush_t0 = 0
         self.launch_ns = 0
+        # the canonical perfobs engine the flush ran, and the shadow
+        # cost model's verdict when it disagreed — followers stamp both
+        # onto their own flight records (the ops-layer sample only sees
+        # the leader's thread)
+        self.engine: str | None = None
+        self.would_choose: str | None = None
 
 
 class _Entry:
@@ -320,6 +327,10 @@ class Coalescer:
             # the batch context, with ``leader`` saying which record
             # owns the tick.
             rec.note_path("coalesced")
+            if bucket.engine is not None:
+                rec.note_engine(bucket.engine)
+            if bucket.would_choose is not None:
+                rec.would_choose = bucket.would_choose
             rec.coalesce = {
                 "batch": bucket.n_final,
                 "shapes": bucket.shapes_final,
@@ -412,6 +423,16 @@ class Coalescer:
                 t_launch = time.perf_counter_ns()
                 from pilosa_tpu.runtime import residency as _residency
 
+                # the batch's workload signature for the engine
+                # observatory: dense-equivalent uint32 words (the
+                # size-class key every engine's cost-table cell shares)
+                # and bytes-touched / dense-equivalent sparsity — the
+                # perfobs.context scope threads both to the ops-layer
+                # launch sample, and the shadow consult below looks up
+                # candidate engines at the same coordinates
+                sig_work = sum(
+                    int(lv.size) for it in live for lv in it.leaves)
+                sig_sparsity = 1.0
                 if live[0].vm is not None:
                     # bitmap-VM bucket (every entry staged compressed
                     # — the key's "vm" leader guarantees it): the
@@ -440,18 +461,33 @@ class Coalescer:
                             g[:len(ix)] = bases[lf.uid] + ix
                             rows.append(g)
                         vbatch.append((it.tape, rows))
-                    results = _residency.run_with_oom_retry(
-                        lambda: _tape.execute_vm(
-                            vbatch, pool, zero, tape_len=tb, slots=lb,
-                            max_prefetch=self.vm_max_prefetch))
+                    # domain slots holding a real container vs the
+                    # padded directory capacity: the data sparsity the
+                    # compressed engine exploits
+                    cap = sum(len(it.vm.leaves) for it in live) * D
+                    real = sum(len(ix) for it in live
+                               for ix in it.vm.idxs)
+                    sig_work = cap * int(pool.shape[-1])
+                    sig_sparsity = real / cap if cap else 1.0
+                    bucket.engine = "vm"
+                    with _perfobs.context(sparsity=sig_sparsity,
+                                          work=sig_work):
+                        results = _residency.run_with_oom_retry(
+                            lambda: _tape.execute_vm(
+                                vbatch, pool, zero, tape_len=tb,
+                                slots=lb,
+                                max_prefetch=self.vm_max_prefetch))
                 elif n == 1:
                     # single-query passthrough: the identical program
                     # the un-coalesced path would run
-                    results = _residency.run_with_oom_retry(
-                        lambda: [expr.evaluate(live[0].shape,
-                                               live[0].leaves,
-                                               counts=True,
-                                               mesh=live[0].mesh)])
+                    bucket.engine = ("mesh" if live[0].mesh is not None
+                                     else "dense")
+                    with _perfobs.context(work=sig_work):
+                        results = _residency.run_with_oom_retry(
+                            lambda: [expr.evaluate(live[0].shape,
+                                                   live[0].leaves,
+                                                   counts=True,
+                                                   mesh=live[0].mesh)])
                 elif bucket.shapes_final == 1:
                     # same-shape fast path: the specialized fused
                     # program over stacked operands, exactly the
@@ -477,16 +513,19 @@ class Coalescer:
                     if pad and not isinstance(stacked[0], np.ndarray):
                         stacked = tuple(_pad_batch(s, pad)
                                         for s in stacked)
-                    counts = np.asarray(
-                        _residency.run_with_oom_retry(
-                            lambda: expr.evaluate(
-                                shape, stacked, counts=True,
-                                mesh=live[0].mesh,
-                                # live occupancy, not the pow2-
-                                # padded batch rows, feeds the
-                                # mesh.queries counter
-                                mesh_queries=n)),
-                        dtype=np.int64)
+                    bucket.engine = ("mesh" if live[0].mesh is not None
+                                     else "dense")
+                    with _perfobs.context(work=sig_work):
+                        counts = np.asarray(
+                            _residency.run_with_oom_retry(
+                                lambda: expr.evaluate(
+                                    shape, stacked, counts=True,
+                                    mesh=live[0].mesh,
+                                    # live occupancy, not the pow2-
+                                    # padded batch rows, feeds the
+                                    # mesh.queries counter
+                                    mesh_queries=n)),
+                            dtype=np.int64)
                     results = [counts[b] for b in range(n)]
                 else:
                     # heterogeneous bucket: the whole ragged batch as
@@ -499,14 +538,26 @@ class Coalescer:
                     tb, lb = _tape.size_class(
                         max(len(it.tape.instrs) for it in live),
                         max(it.tape.n_leaves for it in live))
-                    results = _residency.run_with_oom_retry(
-                        lambda: _tape.execute(
-                            [(it.tape, it.leaves) for it in live],
-                            counts=True, tape_len=tb, slots=lb,
-                            mesh=live[0].mesh))
+                    bucket.engine = ("mesh" if live[0].mesh is not None
+                                     else "tape")
+                    with _perfobs.context(work=sig_work):
+                        results = _residency.run_with_oom_retry(
+                            lambda: _tape.execute(
+                                [(it.tape, it.leaves) for it in live],
+                                counts=True, tape_len=tb, slots=lb,
+                                mesh=live[0].mesh))
                 bucket.launch_ns = time.perf_counter_ns() - t_launch
                 self.stats.timing("coalescer.launch_ns",
                                   bucket.launch_ns)
+                # SHADOW cost consult ([cost] shadow): would the table
+                # have routed this batch to a different engine at the
+                # same workload coordinates?  Verdict lands on the
+                # flight records only — the launch above already ran
+                # and is byte-identical either way
+                bucket.would_choose = _perfobs.would_choose(
+                    bucket.engine,
+                    {e: (sig_work, sig_sparsity)
+                     for e in ("dense", "tape", "vm", bucket.engine)})
         except BaseException as e:  # noqa: BLE001 — every waiter fails
             for it in live:
                 it.fut.set_exception(e)

@@ -1381,6 +1381,12 @@ class Executor:
         rec = _observe.current()
         if rec is not None:
             rec.note_path("fused" if fused_ok else "per-shard")
+            if not fused_ok:
+                # raw per-shard bm ops never pass an engine sample
+                # site; a fused local_batch_fn group overwrites this
+                # (note_engine is last-launch-wins) with the engine
+                # that actually ran
+                rec.note_engine("host")
         if fused_ok and not self._cluster_active(opt):
             _deadline.check(opt.deadline, "map")
             t_f = _time.perf_counter_ns()
@@ -1651,6 +1657,8 @@ class Executor:
         rec = _observe.current()
         if rec is not None:
             rec.note_path("fused" if fused_ok else "per-shard")
+            if not fused_ok:
+                rec.note_engine("host")
         if fused_ok and not self._cluster_active(opt):
             _deadline.check(opt.deadline, "map")
             # result-cache probe BEFORE the coalescer: a hit answers

@@ -1,0 +1,645 @@
+"""Engine observatory: per-launch wall time + bytes-touched accounting,
+achieved bandwidth per engine, the shadow cost model, and on-demand
+device profiler capture.
+
+The flight recorder (pilosa_tpu.observe) explains where a QUERY spent
+its time and devobs explains compile/transfer/memory events — but
+neither measures what ROADMAP items 1 and 4 need: steady-state
+per-LAUNCH device time and the bytes each engine actually touched, per
+engine and per workload shape.  Roaring itself picks container
+representations by measured cost (PAPERS.md 1709.07821) and TPU kernel
+tuning of exactly our shape — ragged gathers over pooled blocks — is
+driven by achieved-bandwidth accounting (PAPERS.md 2604.15464).  This
+module is that measurement substrate:
+
+- **Per-launch samples** — every engine dispatch site (dense fused
+  ``ops/expr``, container-gather ``expr.evaluate_gathered``, ragged
+  tape ``tape.execute``, Pallas VM ``tape.execute_vm``, the mesh
+  shard_map variants, and the per-shard host path) brackets its launch
+  with :func:`t0` / :func:`sample`.  ``sample`` blocks on the result
+  (``jax.block_until_ready`` — compile time is already split out by
+  devobs, so steady-state walls are clean after the first call) and
+  pairs the wall time with an ANALYTIC bytes-touched estimate from the
+  operand shapes: stack words for the dense engines, pooled container
+  words gathered plus directory scalars for the compressed ones,
+  register files for the interpreters.  bytes/wall yields achieved
+  GB/s; against the configured roof (``[observe] device-peak-gbps``,
+  defaulted per device kind) that is the ``bw_util`` the chip captures
+  report.
+- **Cost table** — samples feed a process-wide EWMA + deviation table
+  keyed (engine, work size-class, sparsity bucket), rendered at
+  ``GET /debug/cost`` and summarized per engine for
+  ``tools/chipcapture.py``.
+- **Shadow cost model** — with ``[cost] shadow=true`` (the default)
+  the executor/coalescer consult :func:`would_choose` AFTER routing:
+  the table's verdict lands on the flight record (``wouldChoose`` /
+  ``costDisagree``) and ticks ``cost.disagreements``, while the launch
+  itself is byte-identical to a consult-free build — the stepping
+  stone to ROADMAP item 4's cost-based planner, never the planner
+  itself.  ``shadow=false`` disables the consult entirely (samples
+  still collect).
+- **Profiler capture** — ``POST /debug/profiler/start|stop`` wraps
+  ``jax.profiler.start_trace``/``stop_trace`` into a dated artifact
+  dir, try-lock 409 on concurrent capture (the /debug/pprof/profile
+  discipline) and auto-stop after ``[observe] profiler-max-seconds``.
+
+Lock discipline: the disarmed fast path is ONE module-bool read
+(:func:`t0` returns 0 and every sample call gates on it); blocking
+(``block_until_ready``) always happens OUTSIDE the module lock, which
+only covers the table/counter writes.  Budget: < 1% of the coalesced
+Count path (bench.py extras.perfobs).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from typing import Any
+
+from pilosa_tpu import observe as _observe
+
+#: The canonical engine taxonomy — the one ``engine`` enum the flight
+#: record, /debug/cost, and the chip captures all share.
+ENGINES = ("dense", "gather", "tape", "vm", "mesh", "host",
+           "collective")
+
+#: Shadow consult requires this many samples in BOTH cells before it
+#: is willing to disagree — a single noisy wall must not tick a
+#: disagreement.
+MIN_SAMPLES = 3
+
+#: EWMA smoothing for wall/bytes/bandwidth per cell.
+ALPHA = 0.2
+
+#: Injectable monotonic clock (tests drive the cost-table math under a
+#: fake clock by monkeypatching this).
+_clock = time.perf_counter_ns
+
+#: HBM roof (GB/s) per jax ``device_kind`` substring, checked in
+#: order — datasheet ballparks, good enough for a utilization ratio
+#: (an operator with exact numbers sets ``[observe] device-peak-gbps``).
+#: The CPU entry is a host-DDR ballpark so the CPU twin's bw_util stays
+#: a meaningful fraction instead of a lie against an HBM roof.
+KIND_PEAKS: tuple[tuple[str, float], ...] = (
+    ("v5e", 819.0), ("v5 lite", 819.0), ("v5p", 2765.0),
+    ("v6", 1640.0), ("v5", 2765.0), ("v4", 1228.0), ("v3", 900.0),
+    ("v2", 700.0), ("cpu", 100.0),
+)
+DEFAULT_PEAK_GBPS = 819.0  # the committed capture's roof (ROADMAP 1)
+
+
+# ---------------------------------------------------------------- runtime cfg
+
+
+class PerfobsRuntimeConfig:
+    """Process-wide observatory knobs (``[observe]`` + ``[cost]``)."""
+
+    __slots__ = ("enabled", "peak_gbps", "shadow",
+                 "profiler_max_seconds")
+
+    def __init__(self, enabled: bool = True, peak_gbps: float = 0.0,
+                 shadow: bool = True,
+                 profiler_max_seconds: float = 30.0):
+        self.enabled = enabled
+        self.peak_gbps = peak_gbps  # 0 = default per device kind
+        self.shadow = shadow
+        self.profiler_max_seconds = profiler_max_seconds
+
+
+_cfg = PerfobsRuntimeConfig()
+_cfg_lock = threading.Lock()
+_baseline: PerfobsRuntimeConfig | None = None
+_refs = 0
+#: Module-bool fast gate mirroring ``config().enabled`` — the per-call
+#: cost of a disabled observatory is one attribute read (the
+#: faultinject.armed discipline).
+enabled = True
+
+
+def config() -> PerfobsRuntimeConfig:
+    with _cfg_lock:
+        return _cfg
+
+
+def configure(enabled_: bool | None = None,
+              peak_gbps: float | None = None,
+              shadow: bool | None = None,
+              profiler_max_seconds: float | None = None) -> None:
+    """Apply explicit values only (the containers.configure rule: an
+    absent kwarg leaves the knob untouched)."""
+    global enabled, _peak_cached
+    with _cfg_lock:
+        if enabled_ is not None:
+            _cfg.enabled = enabled_
+        if peak_gbps is not None:
+            _cfg.peak_gbps = peak_gbps
+        if shadow is not None:
+            _cfg.shadow = shadow
+        if profiler_max_seconds is not None:
+            _cfg.profiler_max_seconds = profiler_max_seconds
+        enabled = _cfg.enabled
+        _peak_cached = None
+
+
+def retain() -> None:
+    """First retain snapshots the baseline config (server open)."""
+    global _refs, _baseline
+    with _cfg_lock:
+        if _refs == 0:
+            _baseline = PerfobsRuntimeConfig(
+                _cfg.enabled, _cfg.peak_gbps, _cfg.shadow,
+                _cfg.profiler_max_seconds)
+        _refs += 1
+
+
+def release() -> None:
+    """Last release restores the baseline (server close) — paired with
+    :func:`retain`."""
+    global _refs, _baseline, enabled, _peak_cached
+    with _cfg_lock:
+        if _refs == 0:
+            return
+        _refs -= 1
+        if _refs == 0 and _baseline is not None:
+            _cfg.enabled = _baseline.enabled
+            _cfg.peak_gbps = _baseline.peak_gbps
+            _cfg.shadow = _baseline.shadow
+            _cfg.profiler_max_seconds = _baseline.profiler_max_seconds
+            _baseline = None
+            enabled = _cfg.enabled
+            _peak_cached = None
+
+
+def reset() -> None:
+    """Restore defaults and drop all samples/counters (tests)."""
+    global _cfg, _baseline, _refs, enabled, _peak_cached
+    with _cfg_lock:
+        _cfg = PerfobsRuntimeConfig()
+        _baseline = None
+        _refs = 0
+        enabled = True
+        _peak_cached = None
+    with _lock:
+        _table.clear()
+        for k in _counters:
+            _counters[k] = 0
+
+
+_peak_cached: float | None = None
+
+
+def device_peak_gbps() -> float:
+    """The configured bandwidth roof, or the per-device-kind default —
+    cached until the next configure/reset (jax device lookup is not
+    free and this is read per sample)."""
+    global _peak_cached
+    p = _peak_cached
+    if p is not None:
+        return p
+    with _cfg_lock:
+        explicit = _cfg.peak_gbps
+    if explicit > 0:
+        _peak_cached = explicit
+        return explicit
+    kind = ""
+    try:
+        import jax
+
+        devs = jax.devices()
+        if devs:
+            kind = (devs[0].device_kind or devs[0].platform or "")
+    except Exception:  # noqa: BLE001 — no backend ≠ no observatory
+        pass
+    kind = kind.lower()
+    peak = DEFAULT_PEAK_GBPS
+    for sub, gbps in KIND_PEAKS:
+        if sub in kind:
+            peak = gbps
+            break
+    _peak_cached = peak
+    return peak
+
+
+# ------------------------------------------------------------------- counters
+
+_lock = threading.Lock()
+_counters = {
+    "engine.launches": 0,       # sampled steady-state launches
+    "engine.bytes": 0,          # analytic bytes across sampled launches
+    "cost.samples": 0,          # cost-table sample insertions
+    "cost.consults": 0,         # shadow-mode comparisons performed
+    "cost.disagreements": 0,    # consults where the table preferred
+                                # a different engine than routing chose
+    "cost.profiles": 0,         # completed profiler captures
+}
+
+
+def bump(name: str, value: int = 1) -> None:
+    with _lock:
+        _counters[name] += value
+
+
+def counters() -> dict[str, int]:
+    with _lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    """Zero counters and the cost table (tests)."""
+    with _lock:
+        for k in _counters:
+            _counters[k] = 0
+        _table.clear()
+
+
+def publish_gauges(stats: Any) -> None:
+    """Push the engine.*/cost.* families into a stats registry at
+    scrape time — cumulative totals as GAUGES (the tape/devobs rule:
+    re-publishing a cumulative value through a counter double-counts).
+    Per-engine achieved bandwidth rides engine tags."""
+    with _lock:
+        snap = dict(_counters)
+        cells = len(_table)
+    for name, value in snap.items():
+        stats.gauge(name, value)
+    stats.gauge("cost.cells", cells)
+    stats.gauge("cost.shadow", 1 if config().shadow else 0)
+    stats.gauge("engine.peak_gbps", device_peak_gbps())
+    for eng, s in engine_summary().items():
+        tagged = stats.with_tags(f"engine:{eng}")
+        tagged.gauge("engine.wall_us", s["wallUs"])
+        tagged.gauge("engine.gbps", s["gbps"])
+        tagged.gauge("engine.bw_util", s["bwUtil"])
+
+
+# ----------------------------------------------------------------- cost table
+
+
+class _Cell:
+    """One (engine, size-class, sparsity-bucket) cost cell: EWMA wall
+    time with an EWMA absolute deviation (the hedging estimator's
+    shape, parallel/executor.py), plus bytes and achieved GB/s."""
+
+    __slots__ = ("count", "ewma_us", "dev_us", "ewma_bytes",
+                 "ewma_gbps", "last_us")
+
+    def __init__(self):
+        self.count = 0
+        self.ewma_us = 0.0
+        self.dev_us = 0.0
+        self.ewma_bytes = 0.0
+        self.ewma_gbps = 0.0
+        self.last_us = 0.0
+
+    def add(self, wall_us: float, nbytes: int, gbps: float) -> None:
+        if self.count == 0:
+            self.ewma_us = wall_us
+            self.ewma_bytes = float(nbytes)
+            self.ewma_gbps = gbps
+        else:
+            self.dev_us += ALPHA * (abs(wall_us - self.ewma_us)
+                                    - self.dev_us)
+            self.ewma_us += ALPHA * (wall_us - self.ewma_us)
+            self.ewma_bytes += ALPHA * (nbytes - self.ewma_bytes)
+            self.ewma_gbps += ALPHA * (gbps - self.ewma_gbps)
+        self.count += 1
+        self.last_us = wall_us
+
+
+_table: dict[tuple[str, str, str], _Cell] = {}
+
+
+def size_class(work: int) -> str:
+    """Pow2 size-class label for a launch's work (uint32 words read by
+    a dense-equivalent evaluation) — "2^14" etc., so similar workloads
+    share a cell instead of every exact shape owning one."""
+    if work <= 1:
+        return "2^0"
+    return f"2^{int(math.ceil(math.log2(work)))}"
+
+
+def sparsity_bucket(sparsity: float) -> str:
+    """Coarse bucket of bytes-touched / dense-equivalent-bytes: the
+    compressed engines win exactly as this falls, so it is the second
+    cost-table axis."""
+    if sparsity <= 0.0:
+        return "0"
+    if sparsity < 0.01:
+        return "<1%"
+    if sparsity < 0.1:
+        return "<10%"
+    if sparsity < 0.5:
+        return "<50%"
+    return ">=50%"
+
+
+# ------------------------------------------------------- launch-scope context
+
+
+_tls = threading.local()
+
+
+class context:
+    """Attribute launches sampled on this thread: the orchestration
+    layer (executor per-shard map, coalescer flush) knows the engine
+    taxonomy slot, the data sparsity, and the dense-equivalent work;
+    the ops layer only knows its own operands.  Scopes nest (inner
+    shadows)."""
+
+    __slots__ = ("engine", "sparsity", "work", "_prev")
+
+    def __init__(self, engine: str | None = None,
+                 sparsity: float | None = None,
+                 work: int | None = None):
+        self.engine = engine
+        self.sparsity = sparsity
+        self.work = work
+
+    def __enter__(self) -> "context":
+        self._prev = getattr(_tls, "ctx", None)
+        _tls.ctx = self
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _tls.ctx = self._prev
+        return False
+
+
+def _ctx() -> "context | None":
+    return getattr(_tls, "ctx", None)
+
+
+# ------------------------------------------------------------------- sampling
+
+
+def t0() -> int:
+    """Launch-bracket start: the clock when the observatory is on,
+    0 when off — call sites gate the sample on the returned value, so
+    a disabled observatory costs one module-bool read per launch."""
+    return _clock() if enabled else 0
+
+
+def sample(engine: str, out: Any, t0_ns: int, nbytes: int,
+           work: int = 0, sparsity: float = 1.0) -> None:
+    """Complete one launch sample: block on ``out`` (OUTSIDE any lock
+    — the P3 rule), then fold wall/bytes/bandwidth into the cost table
+    and stamp the engine onto the active flight record.
+
+    ``nbytes`` — analytic bytes the launch touched (operand reads +
+    result writes); ``work`` — dense-equivalent uint32 words for the
+    size-class key (defaults to nbytes/4); ``sparsity`` — bytes
+    touched / dense-equivalent bytes (1.0 for the dense engines).  A
+    thread-local :class:`context` overrides engine/sparsity when the
+    orchestration layer knows better than the ops layer."""
+    if not t0_ns:
+        return
+    ctx = _ctx()
+    if ctx is not None:
+        if ctx.engine is not None:
+            engine = ctx.engine
+        if ctx.sparsity is not None:
+            sparsity = ctx.sparsity
+        if ctx.work is not None:
+            work = ctx.work
+    try:
+        import jax
+
+        jax.block_until_ready(out)
+    except Exception:  # noqa: BLE001 — telemetry never fails a query
+        pass
+    record_sample(engine, _clock() - t0_ns, nbytes, work, sparsity)
+    rec = _observe.current()
+    if rec is not None:
+        rec.note_engine(engine)
+
+
+def record_sample(engine: str, wall_ns: int, nbytes: int,
+                  work: int = 0, sparsity: float = 1.0) -> None:
+    """Fold one measured launch into the cost table (the pure math
+    under :func:`sample` — tests drive it directly with a fake
+    clock)."""
+    wall_us = wall_ns / 1e3
+    gbps = ((nbytes / (wall_ns / 1e9)) / 1e9) if wall_ns > 0 else 0.0
+    key = (engine, size_class(work if work > 0 else max(1, nbytes // 4)),
+           sparsity_bucket(sparsity))
+    with _lock:
+        cell = _table.get(key)
+        if cell is None:
+            cell = _table[key] = _Cell()
+        cell.add(wall_us, nbytes, gbps)
+        _counters["engine.launches"] += 1
+        _counters["engine.bytes"] += nbytes
+        _counters["cost.samples"] += 1
+
+
+# --------------------------------------------------------------- shadow model
+
+
+def would_choose(chosen: str,
+                 candidates: dict[str, tuple[int, float]]) -> str | None:
+    """SHADOW-mode cost consult: given the engine routing chose and
+    each candidate engine's (work, sparsity) coordinates for THIS
+    batch, return the engine the cost table would have picked instead,
+    or None when it agrees / lacks confident data.  Ticks
+    ``cost.consults`` always and ``cost.disagreements`` on a disagree.
+    Never changes routing — callers only stamp the verdict onto the
+    flight record (``[cost] shadow=false`` turns the consult off
+    entirely)."""
+    if not enabled or not config().shadow:
+        return None
+    with _lock:
+        _counters["cost.consults"] += 1
+        chosen_cell = None
+        best = None
+        best_us = float("inf")
+        for eng, (work, sparsity) in candidates.items():
+            cell = _table.get((eng, size_class(work),
+                               sparsity_bucket(sparsity)))
+            if cell is None or cell.count < MIN_SAMPLES:
+                if eng == chosen:
+                    return None  # no confident baseline to disagree with
+                continue
+            if eng == chosen:
+                chosen_cell = cell
+            if cell.ewma_us < best_us:
+                best, best_us = eng, cell.ewma_us
+        if (best is None or best == chosen or chosen_cell is None
+                or best_us >= chosen_cell.ewma_us):
+            return None
+        _counters["cost.disagreements"] += 1
+        return best
+
+
+# ------------------------------------------------------------------- exports
+
+
+def engine_summary() -> dict[str, dict]:
+    """Per-engine rollup of the cost table (sample-count-weighted):
+    the measured bw_util slice chip captures stamp
+    (tools/chipcapture.py) and the tagged engine.* gauges."""
+    peak = device_peak_gbps()
+    out: dict[str, dict] = {}
+    with _lock:
+        for (eng, _s, _sp), cell in _table.items():
+            agg = out.setdefault(eng, {"launches": 0, "_us": 0.0,
+                                       "_bytes": 0.0, "_gbps": 0.0})
+            agg["launches"] += cell.count
+            agg["_us"] += cell.ewma_us * cell.count
+            agg["_bytes"] += cell.ewma_bytes * cell.count
+            agg["_gbps"] += cell.ewma_gbps * cell.count
+    for eng, agg in out.items():
+        n = max(1, agg["launches"])
+        gbps = agg.pop("_gbps") / n
+        agg["wallUs"] = round(agg.pop("_us") / n, 3)
+        agg["bytes"] = int(agg.pop("_bytes") / n)
+        agg["gbps"] = round(gbps, 3)
+        agg["bwUtil"] = round(gbps / peak, 4) if peak > 0 else 0.0
+    return out
+
+
+def cost_debug() -> dict:
+    """The GET /debug/cost document: config, counters, the per-cell
+    cost table, and the per-engine rollup."""
+    peak = device_peak_gbps()
+    cfg = config()
+    with _lock:
+        rows = [
+            {"engine": eng, "size": size, "sparsity": sp,
+             "samples": c.count, "wallUs": round(c.ewma_us, 3),
+             "devUs": round(c.dev_us, 3),
+             "bytes": int(c.ewma_bytes), "gbps": round(c.ewma_gbps, 3),
+             "bwUtil": (round(c.ewma_gbps / peak, 4)
+                        if peak > 0 else 0.0),
+             "lastUs": round(c.last_us, 3)}
+            for (eng, size, sp), c in sorted(_table.items())
+        ]
+        snap = dict(_counters)
+    return {
+        "enabled": cfg.enabled,
+        "shadow": cfg.shadow,
+        "peakGbps": peak,
+        "counters": snap,
+        "engines": engine_summary(),
+        "table": rows,
+        "profiler": profiler_status(),
+    }
+
+
+def debug() -> dict:
+    """Alias kept symmetric with the other observability modules."""
+    return cost_debug()
+
+
+# ----------------------------------------------------------- profiler capture
+
+
+class ProfilerBusy(RuntimeError):
+    """A device-profiler capture is already active (handler -> 409)."""
+
+
+class ProfilerIdle(RuntimeError):
+    """Stop requested with no active capture (handler -> 409)."""
+
+
+#: Held (non-blocking acquire) for the whole start..stop window — the
+#: /debug/pprof/profile discipline: a concurrent start is a 409, never
+#: a queued second capture.  A plain Lock deliberately: stop may run on
+#: a different HTTP thread (or the auto-stop timer) than start.
+_prof_lock = threading.Lock()
+#: Tiny mutex over the capture bookkeeping (dir/since/timer) so status
+#: reads and the manual-stop/auto-stop race stay consistent.
+_prof_state_lock = threading.Lock()
+_prof: dict[str, Any] = {"active": False, "dir": None, "since": 0.0,
+                         "timer": None, "auto_stopped": False}
+
+
+def profiler_start(base_dir: str,
+                   max_seconds: float | None = None) -> dict:
+    """Begin a device trace into a dated artifact dir under
+    ``base_dir`` (``profiles/trace_<UTCSTAMP>``).  Raises
+    :class:`ProfilerBusy` when a capture is already active; arms an
+    auto-stop timer after ``max_seconds`` (default ``[observe]
+    profiler-max-seconds``; 0 disables) so a forgotten capture cannot
+    trace forever."""
+    if not _prof_lock.acquire(blocking=False):
+        raise ProfilerBusy("a profiler capture is already active")
+    try:
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        out_dir = os.path.join(base_dir, "profiles", f"trace_{stamp}")
+        os.makedirs(out_dir, exist_ok=True)
+        import jax
+
+        jax.profiler.start_trace(out_dir)
+    except BaseException:
+        _prof_lock.release()
+        raise
+    limit = (max_seconds if max_seconds is not None
+             else config().profiler_max_seconds)
+    timer = None
+    if limit and limit > 0:
+        timer = threading.Timer(limit, _profiler_auto_stop)
+        timer.daemon = True
+    with _prof_state_lock:
+        _prof["active"] = True
+        _prof["dir"] = out_dir
+        _prof["since"] = time.time()
+        _prof["timer"] = timer
+        _prof["auto_stopped"] = False
+    if timer is not None:
+        timer.start()
+    return {"dir": out_dir, "maxSeconds": limit}
+
+
+def profiler_stop() -> dict:
+    """End the active capture: stop the jax trace, cancel the
+    auto-stop timer, release the capture lock, and return the artifact
+    dir + duration.  Raises :class:`ProfilerIdle` when nothing is
+    active (the manual-stop/auto-stop race resolves here: whoever
+    flips ``active`` first wins, the loser is told idle)."""
+    with _prof_state_lock:
+        if not _prof["active"]:
+            raise ProfilerIdle("no active profiler capture")
+        _prof["active"] = False
+        out_dir = _prof["dir"]
+        since = _prof["since"]
+        timer = _prof["timer"]
+        _prof["timer"] = None
+    if timer is not None:
+        timer.cancel()
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception:  # noqa: BLE001 — the lock must release regardless
+        pass
+    finally:
+        _prof_lock.release()
+    bump("cost.profiles")
+    return {"dir": out_dir,
+            "seconds": round(time.time() - since, 3)}
+
+
+def _profiler_auto_stop() -> None:
+    """Timer body: stop an over-deadline capture; losing the race to a
+    manual stop is fine (ProfilerIdle swallowed)."""
+    try:
+        profiler_stop()
+        with _prof_state_lock:
+            _prof["auto_stopped"] = True
+    except ProfilerIdle:
+        pass
+    except Exception:  # noqa: BLE001 — a timer thread must not die loud
+        pass
+
+
+def profiler_status() -> dict:
+    """Live capture state for /debug/cost and the profiler routes."""
+    with _prof_state_lock:
+        if not _prof["active"]:
+            return {"active": False,
+                    "autoStopped": _prof["auto_stopped"],
+                    "lastDir": _prof["dir"]}
+        return {"active": True, "dir": _prof["dir"],
+                "seconds": round(time.time() - _prof["since"], 3)}

@@ -1220,6 +1220,55 @@ class Handler:
             self._profile_lock.release()
         self._bytes(req, out.encode(), "text/plain")
 
+    @route("GET", "/debug/cost")
+    def handle_debug_cost(self, req, params, path, body):
+        """Engine observatory state (pilosa_tpu.perfobs): per-launch
+        cost table keyed (engine, work size-class, sparsity bucket)
+        with EWMA wall/bytes/achieved-GB/s per cell, the per-engine
+        bw_util rollup against the configured bandwidth roof, shadow
+        consult counters, and the device-profiler capture status."""
+        from pilosa_tpu import perfobs
+
+        self._json(req, perfobs.cost_debug())
+
+    @route("POST", "/debug/profiler/start")
+    def handle_profiler_start(self, req, params, path, body):
+        """Begin an on-demand device trace (jax.profiler) into a dated
+        dir under the holder's data directory.  ``?seconds=N``
+        overrides the ``[observe] profiler-max-seconds`` auto-stop.
+        409 while a capture is already active (the /debug/pprof/profile
+        discipline: a busy signal beats a queued second capture)."""
+        import tempfile
+
+        from pilosa_tpu import perfobs
+
+        max_seconds = None
+        if "seconds" in params:
+            try:
+                max_seconds = float(params["seconds"])
+            except ValueError:
+                raise ApiError("invalid seconds parameter")
+        base = self.api.holder.path or tempfile.gettempdir()
+        try:
+            out = perfobs.profiler_start(base, max_seconds=max_seconds)
+        except perfobs.ProfilerBusy as e:
+            self._error(req, 409, str(e))
+            return
+        self._json(req, out)
+
+    @route("POST", "/debug/profiler/stop")
+    def handle_profiler_stop(self, req, params, path, body):
+        """End the active device trace and return the artifact dir +
+        capture duration.  409 when no capture is active."""
+        from pilosa_tpu import perfobs
+
+        try:
+            out = perfobs.profiler_stop()
+        except perfobs.ProfilerIdle as e:
+            self._error(req, 409, str(e))
+            return
+        self._json(req, out)
+
     def _debug_queries_payload(self, params) -> dict:
         """The /debug/queries document — factored out so the
         cluster-wide fan-in assembles the LOCAL node's section
@@ -1615,6 +1664,7 @@ class Handler:
         other.  Telemetry never fails a scrape."""
         from pilosa_tpu import devobs
         from pilosa_tpu import faultinject as _faultinject
+        from pilosa_tpu import perfobs as _perfobs
         from pilosa_tpu.ingest import compactor
         from pilosa_tpu.models import fragment as _fragment
         from pilosa_tpu.ops import containers as _containers
@@ -1631,6 +1681,10 @@ class Handler:
             tape.publish_gauges(self.stats)
             _containers.publish_gauges(self.stats)
             _meshexec.publish_gauges(self.stats)
+            # engine observatory: launch/bytes totals, cost-table
+            # size, shadow consult counters, per-engine tagged
+            # bandwidth — zeros on a clean server
+            _perfobs.publish_gauges(self.stats)
             # chaos-round families: breakers, hedged reads, failpoints,
             # partial degradation — zeros on a clean server so the
             # families are alert-able before the first fault

@@ -67,6 +67,9 @@ class Server:
         observe_long_query_time: float = 0.0,
         observe_device_sample_interval: float = 0.0,
         observe_fanin_timeout: float = 2.0,
+        observe_device_peak_gbps: float = 0.0,
+        observe_profiler_max_seconds: float = 30.0,
+        cost_shadow: bool = True,
         admission_enabled: bool = True,
         admission_query_cap: int = 32,
         admission_query_queue: int = 128,
@@ -279,6 +282,20 @@ class Server:
         self._mesh_retained = True
         _meshexec.configure(enabled=mesh_enabled,
                             axis_size=mesh_axis_size)
+        # engine observatory ([observe] device-peak-gbps /
+        # profiler-max-seconds + [cost] shadow): process-wide like
+        # [mesh] — the first server's retain() captures the pre-server
+        # baseline, the LAST release() (in close) restores it
+        from pilosa_tpu import perfobs as _perfobs
+
+        _perfobs.retain()
+        self._perfobs_retained = True
+        self._perfobs_cfg = dict(
+            enabled_=observe_enabled,
+            peak_gbps=observe_device_peak_gbps,
+            shadow=cost_shadow,
+            profiler_max_seconds=observe_profiler_max_seconds)
+        _perfobs.configure(**self._perfobs_cfg)
         # per-tenant isolation ([tenants] config): process-wide like
         # [mesh] — the first server's retain() captures the pre-server
         # baseline, the LAST release() (in close) restores it.  The
@@ -412,6 +429,15 @@ class Server:
 
             _meshexec.retain()
             self._mesh_retained = True
+        if not self._perfobs_retained:
+            # reopened after close(): take the observatory reference
+            # back and RE-APPLY this server's knobs (close() restored
+            # the process baseline)
+            from pilosa_tpu import perfobs as _perfobs
+
+            _perfobs.retain()
+            self._perfobs_retained = True
+            _perfobs.configure(**self._perfobs_cfg)
         if not self._tenants_retained:
             # reopened after close(): take the [tenants] reference
             # back and RE-APPLY this server's configured quotas
@@ -636,6 +662,11 @@ class Server:
         if self._mesh_retained:
             self._mesh_retained = False
             _meshexec.release()
+        from pilosa_tpu import perfobs as _perfobs0
+
+        if self._perfobs_retained:
+            self._perfobs_retained = False
+            _perfobs0.release()
         from pilosa_tpu.runtime import residency as _residency2
 
         if self._residency_retained:

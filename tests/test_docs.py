@@ -63,9 +63,9 @@ def test_every_config_key_documented():
     missing = []
     sections = ("cluster", "anti_entropy", "replication", "metric",
                 "tracing", "profile", "tls", "coalescer", "ragged",
-                "observe", "admission", "cache", "ingest",
-                "containers", "mesh", "residency", "faultinject",
-                "tenants")
+                "vm", "observe", "cost", "admission", "cache",
+                "ingest", "containers", "mesh", "residency",
+                "faultinject", "tenants")
     for f in fields(cfgmod.Config):
         if f.name in sections:
             section = f.name

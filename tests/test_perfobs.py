@@ -1,0 +1,430 @@
+"""Engine observatory (pilosa_tpu.perfobs): per-launch wall/bytes
+accounting, the EWMA cost table under a fake clock, the SHADOW cost
+consult (byte-identical routing + disagreement stamping), on-demand
+profiler capture (roundtrip, busy/idle 409 discipline), the canonical
+``engine`` enum on flight records per routing escape, and the
+/debug/cost + engine_/cost_ metric-family HTTP surface.
+
+The serving-path pins ride the same 16-distinct-shape sparse workload
+as tests/test_vm.py: the one batch that exercises vm, tape, dense and
+host routing under explicit escapes, so ≥3 engines land cost-table
+samples in a single test run (the ISSUE acceptance bar).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import perfobs
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.ops import containers as ct
+from pilosa_tpu.ops import tape
+from pilosa_tpu.parallel.executor import ExecOptions, Executor
+from pilosa_tpu.runtime import resultcache
+from tests.test_vm import (N_SHARDS, NOVM, SHAPES_16, VMOPT, _attach,
+                           _run_concurrent, ex)  # noqa: F401
+
+#: dense fused route: containers AND vm off, single-device.
+DENSE = ExecOptions(mesh=False, containers=False)
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    perfobs.reset()
+    ct.reset()
+    ct.reset_counters()
+    tape.reset_counters()
+    rc = resultcache.cache()
+    was = rc.enabled
+    rc.enabled = False  # pins must reach the engines, not the cache
+    yield
+    rc.enabled = was
+    perfobs.reset()
+    ct.reset()
+
+
+class _FakeClock:
+    """Deterministic perf_counter_ns: each read advances ``step_ns``,
+    so a t0()/sample() bracket measures exactly one step."""
+
+    def __init__(self, step_ns: int):
+        self.now = 0
+        self.step = step_ns
+
+    def __call__(self) -> int:
+        self.now += self.step
+        return self.now
+
+
+def _seed(engine, wall_ns, work, sparsity=1.0, n=perfobs.MIN_SAMPLES):
+    for _ in range(n):
+        perfobs.record_sample(engine, wall_ns, 1024, work=work,
+                              sparsity=sparsity)
+
+
+# ---------------------------------------------------------------------------
+# Cost-table math (fake clock — no device, no timing jitter)
+# ---------------------------------------------------------------------------
+
+
+class TestCostMath:
+    def test_size_class_pow2_labels(self):
+        assert perfobs.size_class(0) == "2^0"
+        assert perfobs.size_class(1) == "2^0"
+        assert perfobs.size_class(2) == "2^1"
+        assert perfobs.size_class(1024) == "2^10"
+        assert perfobs.size_class(1025) == "2^11"
+
+    def test_sparsity_buckets(self):
+        assert perfobs.sparsity_bucket(0.0) == "0"
+        assert perfobs.sparsity_bucket(0.005) == "<1%"
+        assert perfobs.sparsity_bucket(0.05) == "<10%"
+        assert perfobs.sparsity_bucket(0.3) == "<50%"
+        assert perfobs.sparsity_bucket(0.7) == ">=50%"
+        assert perfobs.sparsity_bucket(1.0) == ">=50%"
+
+    def test_first_sample_seeds_second_blends(self):
+        # 1ms over 1MB -> exactly 1.0 GB/s
+        perfobs.record_sample("dense", 1_000_000, 1_000_000, work=1024)
+        perfobs.record_sample("dense", 2_000_000, 1_000_000, work=1024)
+        [row] = perfobs.cost_debug()["table"]
+        assert (row["engine"], row["size"], row["sparsity"]) == \
+            ("dense", "2^10", ">=50%")
+        assert row["samples"] == 2
+        # seed 1000us, then EWMA: 1000 + 0.2 * (2000 - 1000)
+        assert row["wallUs"] == pytest.approx(1200.0)
+        assert row["devUs"] == pytest.approx(200.0)
+        assert row["lastUs"] == pytest.approx(2000.0)
+        # gbps samples 1.0 then 0.5 -> 1.0 + 0.2 * (0.5 - 1.0)
+        assert row["gbps"] == pytest.approx(0.9)
+        snap = perfobs.counters()
+        assert snap["engine.launches"] == 2
+        assert snap["cost.samples"] == 2
+        assert snap["engine.bytes"] == 2_000_000
+
+    def test_fake_clock_drives_sample_bracket(self, monkeypatch):
+        monkeypatch.setattr(perfobs, "_clock", _FakeClock(5_000_000))
+        s0 = perfobs.t0()
+        assert s0 == 5_000_000
+        perfobs.sample("tape", np.zeros(4, dtype=np.uint32), s0,
+                       nbytes=4096, work=4096, sparsity=0.25)
+        [row] = perfobs.cost_debug()["table"]
+        assert (row["engine"], row["size"], row["sparsity"]) == \
+            ("tape", "2^12", "<50%")
+        assert row["wallUs"] == pytest.approx(5000.0)  # one clock step
+
+    def test_disabled_gate_is_free(self):
+        perfobs.configure(enabled_=False)
+        assert perfobs.t0() == 0
+        perfobs.sample("dense", None, 0, nbytes=8)
+        assert perfobs.counters()["engine.launches"] == 0
+        assert perfobs.cost_debug()["enabled"] is False
+
+    def test_context_overrides_ops_layer(self, monkeypatch):
+        monkeypatch.setattr(perfobs, "_clock", _FakeClock(1_000_000))
+        with perfobs.context(engine="vm", sparsity=0.001, work=2):
+            perfobs.sample("dense", np.zeros(1, dtype=np.uint32),
+                           perfobs.t0(), nbytes=64)
+        [row] = perfobs.cost_debug()["table"]
+        assert (row["engine"], row["size"], row["sparsity"]) == \
+            ("vm", "2^1", "<1%")
+
+    def test_engine_summary_and_bw_util_roof(self):
+        perfobs.configure(peak_gbps=10.0)
+        perfobs.record_sample("gather", 1_000_000, 1_000_000)  # 1 GB/s
+        s = perfobs.engine_summary()["gather"]
+        assert s["launches"] == 1
+        assert s["gbps"] == pytest.approx(1.0)
+        assert s["bwUtil"] == pytest.approx(0.1)
+        assert perfobs.device_peak_gbps() == 10.0
+
+
+# ---------------------------------------------------------------------------
+# Shadow cost model
+# ---------------------------------------------------------------------------
+
+
+class TestShadow:
+    def test_disagreement_ticks_and_returns_winner(self):
+        _seed("vm", 50_000_000, work=4096)
+        _seed("tape", 1_000_000, work=4096)
+        got = perfobs.would_choose(
+            "vm", {"vm": (4096, 1.0), "tape": (4096, 1.0)})
+        assert got == "tape"
+        snap = perfobs.counters()
+        assert snap["cost.consults"] == 1
+        assert snap["cost.disagreements"] == 1
+
+    def test_agreement_returns_none(self):
+        _seed("vm", 1_000_000, work=4096)
+        _seed("tape", 50_000_000, work=4096)
+        assert perfobs.would_choose(
+            "vm", {"vm": (4096, 1.0), "tape": (4096, 1.0)}) is None
+        snap = perfobs.counters()
+        assert snap["cost.consults"] == 1
+        assert snap["cost.disagreements"] == 0
+
+    def test_unconfident_chosen_cell_returns_none(self):
+        # the candidate is confidently fast, but routing's own cell
+        # has no baseline -> nothing to disagree WITH
+        _seed("tape", 1_000_000, work=4096)
+        _seed("vm", 50_000_000, work=4096, n=perfobs.MIN_SAMPLES - 1)
+        assert perfobs.would_choose(
+            "vm", {"vm": (4096, 1.0), "tape": (4096, 1.0)}) is None
+        assert perfobs.counters()["cost.disagreements"] == 0
+
+    def test_shadow_off_skips_consult_entirely(self):
+        _seed("vm", 50_000_000, work=4096)
+        _seed("tape", 1_000_000, work=4096)
+        perfobs.configure(shadow=False)
+        assert perfobs.would_choose(
+            "vm", {"vm": (4096, 1.0), "tape": (4096, 1.0)}) is None
+        assert perfobs.counters()["cost.consults"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Profiler capture
+# ---------------------------------------------------------------------------
+
+
+class TestProfiler:
+    def test_roundtrip_writes_dated_artifact_dir(self, tmp_path):
+        info = perfobs.profiler_start(str(tmp_path), max_seconds=0)
+        assert os.path.isdir(info["dir"])
+        assert os.sep + "profiles" + os.sep in info["dir"]
+        assert os.path.basename(info["dir"]).startswith("trace_")
+        st = perfobs.profiler_status()
+        assert st["active"] is True and st["dir"] == info["dir"]
+        out = perfobs.profiler_stop()
+        assert out["dir"] == info["dir"]
+        assert out["seconds"] >= 0
+        assert perfobs.counters()["cost.profiles"] == 1
+        st = perfobs.profiler_status()
+        assert st["active"] is False and st["lastDir"] == info["dir"]
+
+    def test_concurrent_start_is_busy(self, tmp_path):
+        perfobs.profiler_start(str(tmp_path), max_seconds=0)
+        try:
+            with pytest.raises(perfobs.ProfilerBusy):
+                perfobs.profiler_start(str(tmp_path), max_seconds=0)
+        finally:
+            perfobs.profiler_stop()
+
+    def test_stop_when_idle_raises(self):
+        with pytest.raises(perfobs.ProfilerIdle):
+            perfobs.profiler_stop()
+
+
+# ---------------------------------------------------------------------------
+# Serving path: the canonical engine enum, per escape
+# ---------------------------------------------------------------------------
+
+
+def _engines_of(ex, n):
+    return [r.engine for r in ex.recorder.recent_records()[-n:]]
+
+
+class TestEngineAttribution:
+    def test_vm_batch_stamps_vm(self, ex):
+        qs = [f"Count({t})" for t in SHAPES_16]
+        _attach(ex, window_s=2.0, max_batch=16)
+        _, launches = _run_concurrent(ex, qs)
+        assert launches == ["vm"], launches
+        assert _engines_of(ex, len(qs)) == ["vm"] * len(qs)
+
+    def test_novm_batch_stamps_tape(self, ex):
+        qs = [f"Count({t})" for t in SHAPES_16]
+        _attach(ex, window_s=2.0, max_batch=16)
+        _, _ = _run_concurrent(ex, qs, opt=NOVM)
+        assert _engines_of(ex, len(qs)) == ["tape"] * len(qs)
+
+    def test_nocontainers_stamps_dense(self, ex):
+        ex.execute("i", f"Count({SHAPES_16[0]})", opt=DENSE)
+        assert _engines_of(ex, 1) == ["dense"]
+
+    def test_default_mesh_route_stamps_mesh(self, ex):
+        # no ?nomesh escape: the conftest's 8-virtual-device platform
+        # routes the fused dispatch through the mesh shard_map programs
+        ex.execute("i", f"Count({SHAPES_16[0]})")
+        assert _engines_of(ex, 1) == ["mesh"]
+
+    def test_per_shard_path_stamps_host(self, ex):
+        ex.fuse_shards = False
+        try:
+            ex.execute("i", f"Count({SHAPES_16[0]})", opt=VMOPT)
+        finally:
+            ex.fuse_shards = True
+        assert _engines_of(ex, 1) == ["host"]
+
+    def test_three_engines_populate_debug_cost(self, ex):
+        """THE acceptance bar: the 16-distinct-shape sparse workload,
+        run under the vm / novm / nocontainers escapes, leaves
+        /debug/cost holding per-launch samples for >= 3 engines."""
+        qs = [f"Count({t})" for t in SHAPES_16]
+        _attach(ex, window_s=2.0, max_batch=16)
+        _run_concurrent(ex, qs)
+        _run_concurrent(ex, qs, opt=NOVM)
+        ex.coalescer = None
+        for q in qs[:4]:
+            ex.execute("i", q, opt=DENSE)
+        d = perfobs.cost_debug()
+        assert len(d["engines"]) >= 3, d["engines"]
+        assert {"vm", "tape", "dense"} <= set(d["engines"])
+        for s in d["engines"].values():
+            assert s["launches"] >= 1
+            assert set(s) == {"launches", "wallUs", "bytes", "gbps",
+                              "bwUtil"}
+        for row in d["table"]:
+            assert row["engine"] in perfobs.ENGINES
+            assert row["samples"] >= 1 and row["wallUs"] >= 0
+        assert d["counters"]["cost.samples"] == \
+            d["counters"]["engine.launches"]
+
+    def test_shadow_disagreement_lands_on_records(self, ex):
+        """Seed every (size-class, sparsity) cell so the table
+        confidently prefers tape over vm, run a vm batch, and the
+        verdict appears on the flight records — while results stay
+        exactly what routing produced."""
+        for k in range(31):
+            for sp in (0.0, 0.005, 0.05, 0.3, 0.7):
+                _seed("vm", 50_000_000, work=2 ** k, sparsity=sp)
+                _seed("tape", 1_000_000, work=2 ** k, sparsity=sp)
+        qs = [f"Count({t})" for t in SHAPES_16]
+        want = [ex.execute("i", q, opt=VMOPT)[0] for q in qs]
+        _attach(ex, window_s=2.0, max_batch=16)
+        got, launches = _run_concurrent(ex, qs)
+        assert got == want          # shadow never changes routing
+        assert launches == ["vm"], launches
+        recs = ex.recorder.recent_records()[-len(qs):]
+        assert all(r.engine == "vm" for r in recs)
+        assert all(r.would_choose == "tape" for r in recs)
+        d = recs[-1].to_dict()
+        assert d["wouldChoose"] == "tape"
+        assert d["costDisagree"] is True
+        snap = perfobs.counters()
+        assert snap["cost.consults"] >= 1
+        assert snap["cost.disagreements"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface + metric families + config knobs
+# ---------------------------------------------------------------------------
+
+
+def _get(uri, path):
+    with urllib.request.urlopen(f"{uri}{path}", timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _post(uri, path, expect=200):
+    req = urllib.request.Request(f"{uri}{path}", data=b"",
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestHTTP:
+    @pytest.fixture
+    def srv(self, tmp_path):
+        from pilosa_tpu.server.server import Server
+
+        srv = Server(str(tmp_path / "srv"), port=0,
+                     coalescer_enabled=True)
+        srv.open()
+        srv.api.create_index("i")
+        srv.api.create_field("i", "f")
+        # two shards: the fused all-shard path (and its launch
+        # samples) needs a real multi-shard batch
+        from pilosa_tpu.shardwidth import SHARD_WIDTH as W
+
+        srv.api.import_bits("i", "f", [1, 1, 1, 2, 2],
+                            [3, 70, W + 3, 70, W + 3])
+        yield srv
+        srv.close()
+
+    def _query(self, srv, flags=""):
+        req = urllib.request.Request(
+            f"{srv.uri}/index/i/query?nocache=1{flags}",
+            data=b"Count(Intersect(Row(f=1), Row(f=2)))",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.read()
+
+    def test_debug_cost_document_and_engine_field(self, srv):
+        self._query(srv)
+        d = _get(srv.uri, "/debug/cost")
+        assert set(d) == {"enabled", "shadow", "peakGbps", "counters",
+                          "engines", "table", "profiler"}
+        assert d["enabled"] is True and d["shadow"] is True
+        assert d["peakGbps"] > 0
+        assert d["counters"]["engine.launches"] >= 1
+        assert d["engines"], d
+        # the canonical enum renders on the flight record
+        recs = _get(srv.uri, "/debug/queries")["recent"]
+        assert recs and recs[-1]["engine"] in perfobs.ENGINES
+
+    def test_shadow_toggle_is_byte_identical(self, srv):
+        on = self._query(srv)
+        perfobs.configure(shadow=False)
+        off = self._query(srv)
+        assert on == off  # byte-identical body, consult on or off
+
+    def test_profiler_routes_roundtrip_and_409(self, srv):
+        code, out = _post(srv.uri, "/debug/profiler/start?seconds=0")
+        assert code == 200 and os.path.isdir(out["dir"])
+        assert out["dir"].startswith(srv.api.holder.path)
+        code, _ = _post(srv.uri, "/debug/profiler/start?seconds=0")
+        assert code == 409
+        code, out = _post(srv.uri, "/debug/profiler/stop")
+        assert code == 200 and "seconds" in out
+        code, _ = _post(srv.uri, "/debug/profiler/stop")
+        assert code == 409
+        assert _get(srv.uri, "/debug/cost")["profiler"]["active"] \
+            is False
+
+    def test_metrics_render_engine_and_cost_families(self, srv):
+        self._query(srv)
+        with urllib.request.urlopen(f"{srv.uri}/metrics",
+                                    timeout=10) as resp:
+            text = resp.read().decode()
+        for name in ("engine_launches", "engine_bytes",
+                     "engine_peak_gbps", "cost_samples",
+                     "cost_consults", "cost_disagreements",
+                     "cost_cells", "cost_shadow"):
+            assert name in text, name
+
+    def test_families_declared(self):
+        from pilosa_tpu import metricfamilies
+        from tools import check_metrics
+
+        fams = metricfamilies.by_name()
+        assert fams["engine"].rendered == "engine_"
+        assert fams["cost"].rendered == "cost_"
+        assert "engine_" in check_metrics.ALL_FAMILIES
+        assert "cost_" in check_metrics.ALL_FAMILIES
+
+    def test_config_toml_roundtrip(self, tmp_path):
+        from pilosa_tpu.config import Config
+
+        cfg = Config()
+        cfg.observe.device_peak_gbps = 1228.0
+        cfg.observe.profiler_max_seconds = 5.0
+        cfg.cost.shadow = False
+        text = cfg.to_toml()
+        assert "device-peak-gbps = 1228.0" in text
+        assert "[cost]" in text and "shadow = false" in text
+        p = tmp_path / "cfg.toml"
+        p.write_text(text)
+        cfg2 = Config.load(str(p), env={})
+        assert cfg2.observe.device_peak_gbps == 1228.0
+        assert cfg2.observe.profiler_max_seconds == 5.0
+        assert cfg2.cost.shadow is False

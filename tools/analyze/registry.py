@@ -249,6 +249,20 @@ MODULE_LOCKS: dict[str, tuple] = {
     "parallel/syncer.py": (
         ModuleGlobalRule("_counters", "_lock", "rw"),
     ),
+    "perfobs.py": (
+        ModuleGlobalRule("_counters", "_lock", "rw"),
+        ModuleGlobalRule("_table", "_lock", "rw"),
+        ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
+        ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
+        # the module-bool fast gate and the peak cache: rebinds under
+        # the config lock; sites read them lock-free by design (a
+        # stale read drops or takes one sample, never corrupts)
+        ModuleGlobalRule("enabled", "_cfg_lock", "w"),
+        # the profiler capture bookkeeping dict (the _prof_lock is the
+        # start..stop exclusivity latch, not a data guard)
+        ModuleGlobalRule("_prof", "_prof_state_lock", "rw", attrs=True),
+    ),
     "models/fragment.py": (
         # the wal.* replay-health counters (module-level; every
         # fragment's construction-time replay can note a torn tail)
@@ -451,6 +465,18 @@ CONFIG_GUARDS = (
         pair=("release",),
         owner_suffixes=("parallel/meshexec.py",),
         what="the refcounted [mesh] baseline",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("perfobs.configure", "_perfobs.configure"),
+        pair=("retain", "release"),
+        owner_suffixes=("perfobs.py",),
+        what="the process-wide engine-observatory runtime config",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("perfobs.retain", "_perfobs.retain"),
+        pair=("release",),
+        owner_suffixes=("perfobs.py",),
+        what="the refcounted engine-observatory baseline",
     ),
 )
 
