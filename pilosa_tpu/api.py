@@ -446,7 +446,10 @@ class API:
         from pilosa_tpu.parallel.cluster import TransportError
 
         refused = False
-        for n in self.cluster.shard_nodes(index, shard):
+        # write_nodes = serving owners + PENDING owners mid-rebalance:
+        # imports dual-write during a migration so the new owner's
+        # copy converges bit-exact without waiting for anti-entropy
+        for n in self.cluster.write_nodes(index, shard):
             if n.id in applied:
                 continue
             if n.id == self.cluster.local_id:
@@ -593,8 +596,79 @@ class API:
         return removed
 
     def resize_abort(self) -> None:
+        driver = getattr(self.node, "rebalance", None)
+        if driver is not None and driver.active():
+            # an ONLINE rebalance runs with the cluster state NORMAL
+            # (that is the whole point), so the legacy RESIZING-only
+            # state gate must not block its abort
+            self.node.resize_abort()
+            return
         self._validate("resize_abort")
         self.node.resize_abort()
+
+    def cluster_resize(self, body: dict) -> dict:
+        """POST /cluster/resize: node add/remove as a control-plane
+        operation.  ``mode: "online"`` (the default) drives the live
+        per-shard migration (parallel/rebalance.py) — the cluster
+        keeps serving throughout; ``mode: "offline"`` is the legacy
+        stop-the-world resize (byte-identical behavior: the whole
+        cluster goes RESIZING and refuses queries for the duration),
+        kept as an explicit escape hatch.
+
+        Body: ``{"mode": "online"|"offline", "add": {node dict}}`` or
+        ``{"mode": ..., "removeId": "node-id"}`` (exactly one of
+        add/removeId); online accepts ``"background": false`` for
+        synchronous runs (tests)."""
+        mode = (body.get("mode") or "online").lower()
+        if mode not in ("online", "offline"):
+            raise ApiError(
+                f"unknown resize mode {mode!r} (online|offline)")
+        add = body.get("add")
+        remove_id = body.get("removeId") or body.get("remove_id")
+        if (add is None) == (remove_id is None):
+            raise ApiError(
+                "exactly one of 'add' or 'removeId' is required")
+        if mode == "offline":
+            if add is not None:
+                resp = self.node.receive_message(
+                    {"type": "node-join", "node": add})
+                return {"mode": "offline", "applied": True,
+                        "response": resp}
+            self._validate("remove_node")
+            if self.cluster.node(remove_id) is None:
+                raise NotFoundError(f"node not found: {remove_id}")
+            self.node.remove_node(remove_id)
+            return {"mode": "offline", "applied": True}
+        driver = getattr(self.node, "rebalance", None)
+        if driver is None:
+            raise ApiError(
+                "no rebalance driver attached to this node; use "
+                'mode "offline" or target a server-assembled node')
+        from pilosa_tpu.parallel.cluster import Node as _Node
+        from pilosa_tpu.parallel.rebalance import RebalanceError
+
+        try:
+            out = driver.start(
+                add=None if add is None else _Node.from_dict(add),
+                remove_id=remove_id,
+                background=bool(body.get("background", True)))
+        except RebalanceError as e:
+            raise ConflictError(str(e))
+        out["mode"] = "online"
+        return out
+
+    def rebalance_status(self) -> dict:
+        """The /debug/rebalance document (driver status + counters);
+        a bare node without an attached driver reports inactive."""
+        driver = getattr(self.node, "rebalance", None)
+        if driver is None:
+            from pilosa_tpu.parallel import rebalance as _rebalance
+
+            return {"active": False, "attached": False,
+                    "counters": _rebalance.counters()}
+        out = driver.status()
+        out["attached"] = True
+        return out
 
     # ------------------------------------------------------ anti-entropy
 

@@ -159,6 +159,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="total bytes of queued hinted-handoff writes "
                          "([replication] hint-max-bytes; 0 disables "
                          "the hint queue)")
+    ps.add_argument("--rebalance-transfer-budget", type=int,
+                    help="concurrent shard backfills during an online "
+                         "rebalance ([rebalance] transfer-budget)")
+    ps.add_argument("--rebalance-dual-write-policy",
+                    choices=("hint", "strict"),
+                    help="delivery contract for pending shard owners "
+                         "during a migration ([rebalance] "
+                         "dual-write-policy): 'hint' never fails the "
+                         "write over a missed pending copy (queues a "
+                         "hint); 'strict' holds pending owners to the "
+                         "[replication] write-policy")
     ps.add_argument("--anti-entropy-round-budget", type=float,
                     help="seconds per anti-entropy slice before the "
                          "walk parks its cursor ([anti-entropy] "
@@ -310,6 +321,10 @@ def cmd_server(args) -> int:
         cfg.replication.hint_max_bytes = args.hint_max_bytes
     if args.anti_entropy_round_budget is not None:
         cfg.anti_entropy.round_budget = args.anti_entropy_round_budget
+    if args.rebalance_transfer_budget is not None:
+        cfg.rebalance.transfer_budget = args.rebalance_transfer_budget
+    if args.rebalance_dual_write_policy is not None:
+        cfg.rebalance.dual_write_policy = args.rebalance_dual_write_policy
     if args.no_ingest_delta:
         cfg.ingest.delta_enabled = False
     for key in ("delta_budget_bytes", "compact_threshold_bits",
@@ -454,6 +469,12 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         anti_entropy_jitter=cfg.anti_entropy.jitter,
         anti_entropy_round_budget=cfg.anti_entropy.round_budget,
         anti_entropy_peer_timeout=cfg.anti_entropy.peer_timeout,
+        rebalance_transfer_budget=cfg.rebalance.transfer_budget,
+        rebalance_dual_write_policy=cfg.rebalance.dual_write_policy,
+        rebalance_cursor_path=cfg.rebalance.cursor_path or None,
+        rebalance_backoff_base=cfg.rebalance.backoff_base,
+        rebalance_backoff_cap=cfg.rebalance.backoff_cap,
+        rebalance_peer_timeout=cfg.rebalance.peer_timeout,
         tenants_enabled=cfg.tenants.enabled,
         tenants_default_share=cfg.tenants.default_share,
         tenants_default_queue=cfg.tenants.default_queue,

@@ -105,6 +105,30 @@ class ReplicationConfig:
 
 
 @dataclass
+class RebalanceConfig:
+    """[rebalance] — online shard migration (parallel/rebalance.py; no
+    reference analog — Pilosa gates the whole cluster RESIZING).
+    ``transfer-budget`` caps concurrent shard backfills so migration
+    traffic (admission class internal) never starves serving;
+    ``dual-write-policy = "hint"`` (the default) commits writes on the
+    serving owners and never fails a write over an unreachable pending
+    owner (the miss is queued as a [replication] hint), ``"strict"``
+    holds pending owners to the configured write-policy;
+    ``cursor-path`` overrides where the coordinator persists its
+    resumable plan cursor (default ``<data-dir>/.rebalance``);
+    ``backoff-base``/``backoff-cap`` (seconds) shape the exponential
+    pause when a transfer target's breaker opens mid-backfill;
+    ``peer-timeout`` bounds each transfer exchange."""
+
+    transfer_budget: int = 2
+    dual_write_policy: str = "hint"  # hint | strict
+    cursor_path: str = ""
+    backoff_base: float = 0.2
+    backoff_cap: float = 30.0
+    peer_timeout: float = 2.0
+
+
+@dataclass
 class MetricConfig:
     """[metric] (server/config.go:125-133)."""
 
@@ -438,6 +462,7 @@ class Config:
     anti_entropy: AntiEntropyConfig = field(default_factory=AntiEntropyConfig)
     replication: ReplicationConfig = field(
         default_factory=ReplicationConfig)
+    rebalance: RebalanceConfig = field(default_factory=RebalanceConfig)
     metric: MetricConfig = field(default_factory=MetricConfig)
     tracing: TracingConfig = field(default_factory=TracingConfig)
     profile: ProfileConfig = field(default_factory=ProfileConfig)
@@ -492,7 +517,7 @@ class Config:
         for k, v in d.items():
             key = k.replace("-", "_")
             if key in ("cluster", "anti_entropy", "replication",
-                       "metric", "tracing",
+                       "rebalance", "metric", "tracing",
                        "profile", "tls", "coalescer", "ragged", "vm",
                        "observe", "cost", "admission", "cache",
                        "ingest", "containers", "mesh", "residency",
@@ -506,6 +531,7 @@ class Config:
                                                        (ClusterConfig,
                                                         AntiEntropyConfig,
                                                         ReplicationConfig,
+                                                        RebalanceConfig,
                                                         MetricConfig,
                                                         TracingConfig,
                                                         ProfileConfig,
@@ -530,7 +556,7 @@ class Config:
         (the reference's PILOSA_* envs, cmd/root.go:94)."""
         for f in fields(self):
             if f.name in ("cluster", "anti_entropy", "replication",
-                          "metric", "tracing",
+                          "rebalance", "metric", "tracing",
                           "profile", "tls", "coalescer", "ragged",
                           "vm", "observe", "cost", "admission",
                           "cache", "ingest", "containers", "mesh",
@@ -586,6 +612,14 @@ class Config:
             f"hint-max-bytes = {self.replication.hint_max_bytes}",
             f"hint-max-age = {self.replication.hint_max_age}",
             f"replay-interval = {self.replication.replay_interval}",
+            "",
+            "[rebalance]",
+            f"transfer-budget = {self.rebalance.transfer_budget}",
+            f'dual-write-policy = "{self.rebalance.dual_write_policy}"',
+            f'cursor-path = "{self.rebalance.cursor_path}"',
+            f"backoff-base = {self.rebalance.backoff_base}",
+            f"backoff-cap = {self.rebalance.backoff_cap}",
+            f"peer-timeout = {self.rebalance.peer_timeout}",
             "",
             "[metric]",
             f'service = "{self.metric.service}"',

@@ -151,6 +151,13 @@ FAMILIES: tuple[Family, ...] = (
            "(parallel/hints.py)",
            live_prefixes=("hint_",), group="repl",
            doc="administration.md"),
+    Family("rebalance", "rebalance_",
+           "online shard migration: plans/cutovers/aborts/resumes, "
+           "dual-write deliveries, streamed backfill bytes, breaker "
+           "backoffs, live per-state shard gauges "
+           "(parallel/rebalance.py)",
+           live_prefixes=("rebalance_",), group="rebalance",
+           doc="administration.md"),
     Family("wal", "wal_",
            "fragment WAL replay health — torn/corrupt tail records "
            "ignored at reload (models/fragment.py)",

@@ -987,6 +987,42 @@ class Field:
                       devices=devices, token=token, host=host,
                       promote=promote, fallback=fallback)
 
+    def drop_shard_stacks(self, shard: int) -> int:
+        """Drop every field-level stack-cache entry whose shard set
+        covers ``shard`` and release its residency accounting (device
+        placements AND tenant byte-attribution) — the rebalance
+        cutover hook for a node losing the shard.  Generation stamps
+        do not cover an ownership change (nothing local mutated), and
+        close()'s whole-field sweep is too blunt: the node usually
+        keeps serving this field's OTHER shards.  Every stack-cache
+        key embeds the shard tuple (``(row, shards)``, ``("time", row,
+        shards, views)``, the matrix cache's bare ``shards``...), so
+        membership in any int-tuple component identifies coverage."""
+        from pilosa_tpu.runtime import residency
+
+        shard = int(shard)
+
+        def covers(key) -> bool:
+            if not isinstance(key, tuple):
+                return False
+            if key and all(isinstance(x, int) for x in key):
+                return shard in key  # matrix cache: the key IS shards
+            return any(isinstance(x, tuple) and x
+                       and all(isinstance(y, int) for y in x)
+                       and shard in x
+                       for x in key)
+
+        mgr = residency.manager()
+        n = 0
+        with self._lock:
+            for cache in (self._row_stack_cache,
+                          self._matrix_stack_cache):
+                for k in [k for k in cache if covers(k)]:
+                    cache.pop(k, None)
+                    mgr.forget(cache, k)
+                    n += 1
+        return n
+
     #: device-memory budget for concatenated matrix stacks (bytes)
     MATRIX_STACK_CACHE_BYTES = 512 << 20
 

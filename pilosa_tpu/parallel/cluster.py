@@ -580,6 +580,14 @@ class Cluster:
         self._peer_lock = _lockcheck.lock("peers")
         self._breakers: dict[str, CircuitBreaker] = {}
         self._peer_lat: dict[str, _PeerLatency] = {}
+        # per-shard routing overrides installed by the online
+        # rebalance (parallel/rebalance.py): (index, shard) ->
+        # (serving_ids, pending_ids).  Reads resolve to the serving
+        # owners; writes go to serving + pending.  Empty outside a
+        # migration window — placement stays pure ring math.
+        self._route_lock = _lockcheck.lock("shard-routes")
+        self._shard_routes: dict[tuple[str, int],
+                                 tuple[tuple, tuple]] = {}
         if topology_path and os.path.exists(topology_path):
             self._load_topology()
         self.save_topology()
@@ -799,6 +807,65 @@ class Cluster:
         stats.gauge("breaker.fast_fails_total",
                     sum(b.fast_fails for b in breakers))
 
+    # ------------------------------------------------ rebalance routing
+
+    def set_shard_route(self, index: str, shard: int,
+                        serving, pending=()) -> None:
+        """Install (or replace) a per-shard routing override — the
+        online rebalance's dual-write / cutover states.  ``serving``
+        ids answer reads; ``serving + pending`` receive writes."""
+        with self._route_lock:
+            self._shard_routes[(index, int(shard))] = (
+                tuple(serving), tuple(pending))
+
+    def clear_shard_route(self, index: str, shard: int) -> None:
+        with self._route_lock:
+            self._shard_routes.pop((index, int(shard)), None)
+
+    def clear_shard_routes(self) -> list[tuple[str, int]]:
+        """Drop every override (rebalance commit/abort).  Returns the
+        keys that were routed so callers can invalidate caches."""
+        with self._route_lock:
+            keys = list(self._shard_routes)
+            self._shard_routes.clear()
+        return keys
+
+    def shard_route(self, index: str, shard: int
+                    ) -> tuple[tuple, tuple] | None:
+        """(serving_ids, pending_ids) for a mid-migration shard, or
+        None when placement is pure ring math."""
+        with self._route_lock:
+            if not self._shard_routes:
+                return None
+            return self._shard_routes.get((index, int(shard)))
+
+    def shard_routes_snapshot(self) -> dict:
+        """The /debug/rebalance routing table view."""
+        with self._route_lock:
+            return {
+                f"{index}/{shard}": {"serving": list(s),
+                                     "pending": list(p)}
+                for (index, shard), (s, p)
+                in sorted(self._shard_routes.items())
+            }
+
+    def write_nodes(self, index: str, shard: int) -> list[Node]:
+        """All nodes a write to this shard must reach: the serving
+        owners plus, mid-migration, the pending (new) owners — the
+        dual-write set."""
+        nodes = self.shard_nodes(index, shard)
+        route = self.shard_route(index, shard)
+        if route is None:
+            return nodes
+        ids = {n.id for n in nodes}
+        for nid in route[1]:
+            if nid not in ids:
+                n = self._nodes.get(nid)
+                if n is not None:
+                    nodes.append(n)
+                    ids.add(nid)
+        return nodes
+
     # ----------------------------------------------------------- placement
 
     def partition_nodes(self, p: int) -> list[Node]:
@@ -813,13 +880,31 @@ class Cluster:
         return [nodes[(start + i) % len(nodes)] for i in range(k)]
 
     def shard_nodes(self, index: str, shard: int) -> list[Node]:
-        """All owner replicas of a shard (cluster.go:883 shardNodes)."""
+        """All owner replicas of a shard (cluster.go:883 shardNodes).
+        A mid-migration routing override (set_shard_route) takes
+        precedence over ring math: readers keep resolving to the
+        still-authoritative serving owners until that shard's
+        cutover."""
+        route = self.shard_route(index, shard)
+        if route is not None:
+            serving = [self._nodes[nid] for nid in route[0]
+                       if nid in self._nodes]
+            if serving:
+                return serving
         return self.partition_nodes(partition(index, shard, self.partition_n))
 
     def primary_shard_node(self, index: str, shard: int) -> Node:
         return self.shard_nodes(index, shard)[0]
 
     def owns_shard(self, node_id: str, index: str, shard: int) -> bool:
+        """True when the node is a serving owner — or, mid-migration,
+        a pending (dual-write) owner: pending owners must accept
+        replica writes and keep their in-flight copy safe from the
+        unowned-fragment cleaner."""
+        route = self.shard_route(index, shard)
+        if route is not None and (node_id in route[0]
+                                  or node_id in route[1]):
+            return True
         return any(n.id == node_id for n in self.shard_nodes(index, shard))
 
     def local_shards(self, index: str, shards) -> set[int]:

@@ -306,6 +306,16 @@ class Executor:
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index not found: {index_name}")
+        if opt.remote and shards:
+            # receiver-side ownership gate for remote sub-queries
+            # (reads AND replica writes): after an online rebalance
+            # cuts a shard over, an ex-owner still holds the data for
+            # a cleanup-grace window but must refuse to answer for it
+            # — silently serving would hand the origin a soon-stale
+            # copy the anti-entropy/dual-write machinery no longer
+            # maintains here.  The structured marker lets the origin
+            # fail over to the current owners.
+            self._check_remote_shards_owned(idx, shards)
         if opt.partial:
             if opt.missing is None:
                 # a partial request always carries its accounting set
@@ -801,10 +811,21 @@ class Executor:
                     continue  # purged loser of a settled race
                 try:
                     res = fut.result()
-                except TransportError as te:
-                    # breaker/EWMA feedback already ran in the
-                    # flight's _settle callback
-                    cause = _failure_cause(te)
+                except Exception as te:
+                    if isinstance(te, TransportError):
+                        # breaker/EWMA feedback already ran in the
+                        # flight's _settle callback
+                        cause = _failure_cause(te)
+                    elif refusal_is_unowned(te):
+                        # the peer answered (alive) but refused the
+                        # sub-query as non-owner: an online rebalance
+                        # cut the shards over and its view is fresher
+                        # than ours — fail over onto the current
+                        # owners without feeding the peer's breaker
+                        te = TransportError(str(te))
+                        cause = "unowned"
+                    else:
+                        raise
                     race = fl.race
                     if race is None:
                         fail_shards(fl.shards, fl.node_id, te, cause)
@@ -2592,14 +2613,35 @@ class Executor:
         def delivery_pass() -> bool:
             nonlocal changed
             refused = False
-            for n in self.cluster.shard_nodes(idx.name, shard):
+            # mid-rebalance a shard has PENDING owners (backfill
+            # targets, or demoted ex-owners after cutover) on top of
+            # the serving set: they receive every write too
+            # (dual-write), and under the default "hint" policy a
+            # missed pending delivery is always hinted — the migration
+            # must never make writes stricter than steady state.  With
+            # no route override installed, pending is empty and this
+            # loop is byte-identical to the legacy replica fan-out.
+            route = self.cluster.shard_route(idx.name, shard)
+            pending_ids = set(route[1]) if route is not None else set()
+            dual_hint = True
+            if pending_ids:
+                from pilosa_tpu.parallel import rebalance as _rebalance
+                dual_hint = (_rebalance.config().dual_write_policy
+                             == _rebalance.DUAL_WRITE_HINT)
+            for n in self.cluster.write_nodes(idx.name, shard):
                 if n.id in applied or n.id in hinted:
                     continue
+                pending = n.id in pending_ids
+                lenient = available or (pending and dual_hint)
                 if n.id == self.cluster.local_id:
                     changed |= local_fn()
                     applied.add(n.id)
+                    if pending:
+                        from pilosa_tpu.parallel import (
+                            rebalance as _rebalance)
+                        _rebalance.bump("rebalance.dual_writes")
                     continue
-                if available and self.cluster.breaker_open(n.id):
+                if lenient and self.cluster.breaker_open(n.id):
                     # known-dead peer: hint without paying the RPC
                     # timeout (the breaker's half-open trial re-admits
                     # it; the replay worker drains the backlog)
@@ -2620,25 +2662,37 @@ class Executor:
                     if refusal_is_unowned(e):
                         refused = True
                         continue
-                    if available and isinstance(e, ShedByPeerError):
+                    if lenient and isinstance(e, ShedByPeerError):
                         # shed-exhausted: proof of life (never feeds
                         # the breaker), but the delivery did not land
                         self.cluster.note_peer_success(n.id)
                         hint_for(n)
                         continue
                     if isinstance(e, TransportError):
-                        if available:
+                        if lenient:
                             self.cluster.note_peer_failure(n.id)
                             hint_for(n)
                             continue
                         raise ExecutionError(
                             f"write replication to node {n.id} "
                             f"failed: {e}")
+                    if pending and dual_hint:
+                        # the joiner answers 4xx until it applies the
+                        # begin broadcast's schema ("index not found")
+                        # — a missed PENDING delivery hints, it never
+                        # fails the write (the peer is alive: no
+                        # breaker feedback)
+                        hint_for(n)
+                        continue
                     raise
-                if available:
+                if available or pending:
                     self.cluster.note_peer_success(n.id)
                 changed |= bool(res[0])
                 applied.add(n.id)
+                if pending:
+                    from pilosa_tpu.parallel import (
+                        rebalance as _rebalance)
+                    _rebalance.bump("rebalance.dual_writes")
             return refused
 
         def on_timeout() -> None:
@@ -2663,6 +2717,21 @@ class Executor:
                 else:
                     _hints.bump("hint.dropped")
         return changed
+
+    def _check_remote_shards_owned(self, idx, shards) -> None:
+        """Receiver-side ownership gate for WHOLE remote sub-queries
+        (reads included): refuse any shard this node does not own per
+        its current view with the structured ErrClusterDoesNotOwnShard
+        marker, so a stale-view origin fails over instead of reading
+        an unmaintained ex-owner copy (satellite of the online
+        rebalance: nothing refused stale read sub-queries before)."""
+        if (self.cluster is None or self.cluster.transport is None
+                or len(self.cluster.sorted_nodes()) < 2):
+            return
+        for s in shards:
+            if not self.cluster.owns_shard(self.cluster.local_id,
+                                           idx.name, int(s)):
+                raise UnownedShardError(int(s))
 
     def _check_remote_write_owned(self, idx, shard: int,
                                   opt: ExecOptions | None) -> None:

@@ -49,6 +49,9 @@ class ClusterNode:
         # walk cursor and the last round's outcome (/debug/antientropy)
         self.ae_cursor: tuple | None = None
         self.ae_last_round: dict = {}
+        # online rebalance driver (parallel/rebalance.py), attached by
+        # the server on the coordinator; None for bare library use
+        self.rebalance = None
         if cluster.transport is not None and hasattr(cluster.transport, "register"):
             cluster.transport.register(cluster.local_id, self)
 
@@ -289,6 +292,26 @@ class ClusterNode:
             from pilosa_tpu.parallel.resize import follow_resize_instruction
 
             return follow_resize_instruction(self, msg)
+        elif t == "rebalance-begin":
+            from pilosa_tpu.parallel import rebalance as _rebalance
+
+            return _rebalance.apply_begin(self, msg)
+        elif t == "rebalance-transfer":
+            from pilosa_tpu.parallel import rebalance as _rebalance
+
+            return _rebalance.follow_transfer(self, msg)
+        elif t == "rebalance-cutover":
+            from pilosa_tpu.parallel import rebalance as _rebalance
+
+            return _rebalance.apply_cutover(self, msg)
+        elif t == "rebalance-abort":
+            from pilosa_tpu.parallel import rebalance as _rebalance
+
+            return _rebalance.apply_abort(self, msg)
+        elif t == "rebalance-commit":
+            from pilosa_tpu.parallel import rebalance as _rebalance
+
+            return _rebalance.apply_commit(self, msg)
         elif t == "fragment-views":
             idx = self.holder.index(msg["index"])
             f = None if idx is None else idx.field(msg["field"])
@@ -647,7 +670,13 @@ class ClusterNode:
 
     def resize_abort(self) -> None:
         """Abort an in-flight resize job (api.go:1250 ResizeAbort);
-        overridden by the resize subsystem when attached."""
+        overridden by the resize subsystem when attached.  An active
+        ONLINE rebalance aborts through its driver instead — routing
+        reverts to the old topology without gating anything."""
+        driver = getattr(self, "rebalance", None)
+        if driver is not None and driver.active():
+            driver.abort()
+            return
         from pilosa_tpu.parallel.cluster import STATE_NORMAL
 
         self.cluster.set_state(STATE_NORMAL)

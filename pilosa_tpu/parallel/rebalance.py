@@ -1,0 +1,942 @@
+"""Live rebalance: online shard migration under traffic.
+
+The legacy resize protocol (parallel/resize.py, the reference's
+cluster.go:1196-1561) is stop-the-world: the whole cluster goes
+RESIZING and 405s every read and write for the duration.  This module
+is the online replacement — node add/remove as a first-class operation
+that keeps serving.  A coordinator computes the ownership diff per
+shard (reusing ``resize.plan_transfers``) and drives each
+``(index, shard)`` through an explicit per-shard state machine::
+
+    source-serving -> dual-write -> backfill -> cutover -> dropped
+
+instead of one cluster-wide gate:
+
+- **dual-write** — a routing OVERRIDE is installed on every node
+  (``Cluster.set_shard_route``): reads keep resolving to the still-
+  authoritative old owners, while writes commit on old AND new owners
+  (``Cluster.write_nodes``; a missed delivery to a new owner falls
+  back to the hinted-handoff queue, parallel/hints.py, with
+  anti-entropy as the backstop).
+- **backfill** — the destination pulls the fragment via the
+  anti-entropy digest/block machinery (checksum exchange, block-data
+  pulls, positional import) under the admission **internal** class,
+  bounded by a concurrent-transfer budget.  A transfer target whose
+  circuit breaker is open pauses THAT shard's backfill with
+  exponential backoff — the rest of the plan keeps moving, and
+  breakers + hedged reads steer queries around the slow peer.
+- **cutover** — one broadcast atomically flips routing for that shard
+  only (serving=new, pending=old: writes stay dual until commit so an
+  abort can always fall back to the old owners without losing
+  writes), invalidates the affected result-cache entries everywhere,
+  and drops the losing node's device stacks — residency placements
+  and tenant byte-attribution move with them.
+- **dropped** — at commit the membership change is finalized, the
+  overrides are cleared (ring math now equals them), and the old
+  copies age out through the grace-deferred holder cleanup.
+
+The plan and every per-shard state transition persist to a JSON cursor
+(``<data-dir>/.rebalance``, tmp+rename): a coordinator crash or an
+operator ``abort`` leaves the cluster serving on the old topology,
+never half-gated, and a restarted coordinator resumes mid-plan from
+the cursor.  Readers never 405 — a shard mid-migration serves from
+the still-authoritative owner.
+
+Process-wide configuration follows the [replication] shape
+(pilosa-lint P5): ``configure`` applies explicit values in place, the
+FIRST server to ``retain()`` captures the pre-server baseline and the
+LAST ``release()`` restores it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+from pilosa_tpu import lockcheck as _lockcheck
+from pilosa_tpu.parallel.cluster import (
+    Node,
+    ShedByPeerError,
+    TransportError,
+)
+from pilosa_tpu.parallel.resize import plan_transfers
+from pilosa_tpu.serve import deadline as _deadline
+from pilosa_tpu.serve.admission import tagged
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+#: per-shard migration states (the ISSUE's state machine; "source-
+#: serving" is the implicit state before the begin broadcast installs
+#: the dual-write override)
+MOVE_DUAL_WRITE = "dual-write"
+MOVE_BACKFILL = "backfill"
+MOVE_CUTOVER = "cutover"
+MOVE_DROPPED = "dropped"
+
+DUAL_WRITE_HINT = "hint"
+DUAL_WRITE_STRICT = "strict"
+
+#: cursor file name under the coordinator's data dir
+CURSOR_FILENAME = ".rebalance"
+
+
+class RebalanceError(RuntimeError):
+    pass
+
+
+# --------------------------------------------------------------------
+# process-wide [rebalance] runtime config (pilosa-lint P5)
+# --------------------------------------------------------------------
+
+
+@dataclass
+class RebalanceRuntimeConfig:
+    """The [rebalance] knobs in force process-wide."""
+
+    #: max concurrent shard transfers the coordinator drives
+    transfer_budget: int = 2
+    #: "hint" never fails a write on a missed delivery to a PENDING
+    #: (not-yet-cut-over) owner — the hint queue + anti-entropy
+    #: converge it; "strict" holds pending owners to the same
+    #: [replication] write-policy as serving owners.
+    dual_write_policy: str = DUAL_WRITE_HINT
+    #: persisted plan cursor path ("" = <data-dir>/.rebalance)
+    cursor_path: str = ""
+    #: exponential-backoff base for a paused/failed shard transfer
+    backoff_base: float = 0.2
+    #: backoff cap (matches the hint replayer's ceiling)
+    backoff_cap: float = 30.0
+    #: per-block-exchange deadline on the backfill pull path
+    peer_timeout: float = 2.0
+
+
+_cfg = RebalanceRuntimeConfig()
+_cfg_lock = threading.Lock()
+_baseline: tuple | None = None
+_refs = 0
+
+
+def config() -> RebalanceRuntimeConfig:
+    return _cfg
+
+
+def configure(transfer_budget: int | None = None,
+              dual_write_policy: str | None = None,
+              cursor_path: str | None = None,
+              backoff_base: float | None = None,
+              backoff_cap: float | None = None,
+              peer_timeout: float | None = None) -> RebalanceRuntimeConfig:
+    """Apply explicit values in place (None leaves a knob alone)."""
+    if dual_write_policy is not None and dual_write_policy not in (
+            DUAL_WRITE_HINT, DUAL_WRITE_STRICT):
+        raise ValueError(
+            f"unknown dual-write-policy {dual_write_policy!r} "
+            f"(hint|strict)")
+    with _cfg_lock:
+        if transfer_budget is not None:
+            _cfg.transfer_budget = max(1, int(transfer_budget))
+        if dual_write_policy is not None:
+            _cfg.dual_write_policy = dual_write_policy
+        if cursor_path is not None:
+            _cfg.cursor_path = cursor_path
+        if backoff_base is not None:
+            _cfg.backoff_base = float(backoff_base)
+        if backoff_cap is not None:
+            _cfg.backoff_cap = float(backoff_cap)
+        if peer_timeout is not None:
+            _cfg.peer_timeout = float(peer_timeout)
+    return _cfg
+
+
+def retain() -> None:
+    """First retain captures the pre-server baseline config."""
+    global _refs, _baseline
+    with _cfg_lock:
+        if _refs == 0 and _baseline is None:
+            _baseline = (_cfg.transfer_budget, _cfg.dual_write_policy,
+                         _cfg.cursor_path, _cfg.backoff_base,
+                         _cfg.backoff_cap, _cfg.peer_timeout)
+        _refs += 1
+
+
+def release() -> None:
+    """Last release restores the baseline for library users."""
+    global _refs, _baseline
+    with _cfg_lock:
+        if _refs > 0:
+            _refs -= 1
+        if _refs == 0 and _baseline is not None:
+            (_cfg.transfer_budget, _cfg.dual_write_policy,
+             _cfg.cursor_path, _cfg.backoff_base,
+             _cfg.backoff_cap, _cfg.peer_timeout) = _baseline
+            _baseline = None
+
+
+def reset() -> RebalanceRuntimeConfig:
+    """Test hook: defaults, no baseline, zero refs."""
+    global _cfg, _baseline, _refs
+    with _cfg_lock:
+        _cfg = RebalanceRuntimeConfig()
+        _baseline = None
+        _refs = 0
+    return _cfg
+
+
+# --------------------------------------------------------------------
+# rebalance.* counters (published as gauges at scrape time, like ae.*)
+# --------------------------------------------------------------------
+
+_lock = _lockcheck.lock("rebalance-counters")
+_counters = {
+    "rebalance.plans": 0,             # rebalance plans started
+    "rebalance.cutovers": 0,          # shards cut over to new owners
+    "rebalance.bytes_streamed": 0,    # backfill payload bytes applied
+    "rebalance.dual_writes": 0,       # write deliveries to pending owners
+    "rebalance.aborts": 0,            # plans aborted back to old topology
+    "rebalance.resumes": 0,           # plans resumed from the cursor
+    "rebalance.backoffs": 0,          # transfers paused on an open breaker
+    "rebalance.transfer_failures": 0, # failed transfer attempts (retried)
+}
+
+
+def bump(name: str, value: int = 1) -> None:
+    with _lock:
+        _counters[name] += value
+
+
+def counters() -> dict:
+    with _lock:
+        return dict(_counters)
+
+
+def publish_gauges(stats, driver: "RebalanceCoordinator | None" = None
+                   ) -> None:
+    """rebalance.* gauge family for /metrics and /debug/vars —
+    published unconditionally (zeros on a clean server) so the family
+    is alert-able before the first migration."""
+    for name, v in counters().items():
+        stats.gauge(name, v)
+    pending = moving = cutover = 0
+    if driver is not None:
+        pending, moving, cutover = driver.shard_state_counts()
+    stats.gauge("rebalance.shards_pending", pending)
+    stats.gauge("rebalance.shards_moving", moving)
+    stats.gauge("rebalance.shards_cutover", cutover)
+
+
+# --------------------------------------------------------------------
+# destination-side backfill (the AE digest/block pull, one direction)
+# --------------------------------------------------------------------
+
+
+def _exchange(cluster, n: Node, message: dict, timeout: float) -> dict:
+    """One deadline-bounded peer RPC with breaker feedback — the
+    FragmentSyncer._exchange contract (a shed reply is proof of life,
+    a transport error feeds the peer's breaker)."""
+    try:
+        with _deadline.scope(_deadline.Deadline(timeout)):
+            resp = cluster.transport.send_message(n, message)
+    except ShedByPeerError:
+        cluster.note_peer_success(n.id)
+        raise
+    except (TransportError, _deadline.DeadlineExceededError,
+            TimeoutError, OSError):
+        cluster.note_peer_failure(n.id)
+        raise
+    cluster.note_peer_success(n.id)
+    return resp
+
+
+def _pull_view(node, src: Node, index: str, field: str, view: str,
+               shard: int, timeout: float) -> int:
+    """Pull one view of one fragment from `src` into the local holder
+    via the anti-entropy block machinery: exchange checksums, pull
+    only the differing blocks' positions, import.  Dual-written bits
+    already present locally cost nothing.  Returns payload bytes
+    applied (8 bytes per pulled position)."""
+    resp = _exchange(node.cluster, src, {
+        "type": "fragment-blocks",
+        "index": index, "field": field, "view": view, "shard": shard,
+    }, timeout)
+    src_blocks = {b["id"]: b["checksum"] for b in resp.get("blocks", [])}
+    if not src_blocks:
+        return 0
+    frag = node.local_fragment(index, field, view, shard, True)
+    local_blocks = {}
+    if frag is not None:
+        blocks, _hit = frag.blocks_with_flag()
+        local_blocks = {b["id"]: b["checksum"] for b in blocks}
+    dirty = [bid for bid, ck in src_blocks.items()
+             if local_blocks.get(bid) != ck]
+    total = 0
+    for bid in sorted(dirty):
+        data = _exchange(node.cluster, src, {
+            "type": "fragment-block-data",
+            "index": index, "field": field, "view": view,
+            "shard": shard, "block": bid,
+        }, timeout)
+        pairs = list(zip(data.get("rowIDs", []),
+                         data.get("columnIDs", [])))
+        if pairs:
+            frag.import_positions(
+                [r * SHARD_WIDTH + c for r, c in pairs])
+            total += 8 * len(pairs)
+    return total
+
+
+def _pull_field(node, src: Node, index: str, field: str,
+                shard: int, timeout: float) -> int:
+    """Pull every view of one (index, field, shard) from `src`.
+    Raises TransportError when the source holds no data — like the
+    offline path's _fetch_fragment, so the caller falls back to
+    another replica instead of recording an empty transfer as done."""
+    resp = _exchange(node.cluster, src, {
+        "type": "fragment-views",
+        "index": index, "field": field, "shard": shard,
+    }, timeout)
+    views = resp.get("views") or []
+    if not views:
+        raise TransportError(
+            f"source {src.id} has no data for {index}/{field}/shard "
+            f"{shard}")
+    idx = node.holder.index(index)
+    f = None if idx is None else idx.field(field)
+    if f is None:
+        raise RebalanceError(f"field not found locally: {field}")
+    total = 0
+    for vname in views:
+        f.create_view_if_not_exists(vname).create_fragment_if_not_exists(
+            shard)
+        total += _pull_view(node, src, index, field, vname, shard,
+                            timeout)
+    f._note_shard(shard)
+    return total
+
+
+@tagged("internal")
+def follow_transfer(node, msg: dict) -> dict:
+    """Destination-side ``rebalance-transfer``: pull every assigned
+    field of one shard from its source (fallbacks on failure), ack
+    with the payload byte count.  Rides the internal admission class
+    end to end so a backfill can never starve user queries."""
+    index = msg["index"]
+    shard = int(msg["shard"])
+    uris = msg.get("uris", {})
+    timeout = config().peer_timeout
+    total = 0
+    for t in msg.get("fields", []):
+        sources = [t["source"]] + list(t.get("fallbacks", []))
+        last_err = None
+        done = False
+        empty = 0
+        for src_id in sources:
+            src = node.cluster.node(src_id) or Node(
+                id=src_id, uri=uris.get(src_id, ""))
+            if src.uri == "" and src_id in uris:
+                src.uri = uris[src_id]
+            try:
+                total += _pull_field(node, src, index, t["field"],
+                                     shard, timeout)
+                done = True
+                break
+            except (TransportError, _deadline.DeadlineExceededError,
+                    TimeoutError, OSError) as e:
+                last_err = e
+                if "has no data for" in str(e):
+                    empty += 1
+        if not done and empty == len(sources):
+            # every replica is genuinely empty for this field/shard:
+            # nothing to move (dual-writes and AE cover anything new)
+            continue
+        if not done:
+            return {"ok": False,
+                    "error": f"no reachable source for {index}/"
+                             f"{t['field']}/shard {shard}: {last_err}"}
+    return {"ok": True, "bytes": total}
+
+
+# --------------------------------------------------------------------
+# node-side broadcast handlers
+# --------------------------------------------------------------------
+
+
+def apply_begin(node, msg: dict) -> dict:
+    """``rebalance-begin``: adopt the (possibly extended) membership
+    and schema, then install the dual-write routing overrides.  The
+    joining node receives this as its first cluster contact — it is
+    probe-able and breaker-tracked from here on, before it owns
+    anything."""
+    node.holder.apply_schema(msg.get("schema", []))
+    status = msg.get("status")
+    if status:
+        if node.cluster.apply_status(status):
+            node._broadcast_self_alive()
+    for r in msg.get("routes", []):
+        node.cluster.set_shard_route(r["index"], int(r["shard"]),
+                                     r.get("serving", ()),
+                                     r.get("pending", ()))
+    return {"ok": True}
+
+
+def apply_cutover(node, msg: dict) -> dict:
+    """``rebalance-cutover``: flip routing for ONE shard (serving=new,
+    pending=old — writes stay dual until commit so abort can always
+    fall back), invalidate the shard's result-cache entries, and on a
+    node losing ownership drop its device stacks so residency
+    placements and tenant byte-attribution move with the shard."""
+    index = msg["index"]
+    shard = int(msg["shard"])
+    serving = list(msg.get("serving", ()))
+    pending = list(msg.get("pending", ()))
+    node.cluster.set_shard_route(index, shard, serving, pending)
+    _invalidate_shard_local(node, index, shard,
+                            losing=node.cluster.local_id not in serving)
+    return {"ok": True}
+
+
+def apply_abort(node, msg: dict) -> dict:
+    """``rebalance-abort``: clear every routing override (ring math
+    over the OLD membership takes back over) and forget the node that
+    was joining — the cluster serves exactly the old topology."""
+    routed = node.cluster.clear_shard_routes()
+    add_id = msg.get("add_id")
+    if add_id and add_id != node.cluster.local_id:
+        node.cluster.remove_node(add_id)
+    for index, shard in routed:
+        _invalidate_shard_local(node, index, shard, losing=False)
+    return {"ok": True}
+
+
+def apply_commit(node, msg: dict) -> dict:
+    """``rebalance-commit``: adopt the final membership and drop every
+    override — placement math over the new member set now equals the
+    cut-over routes, so routing does not move."""
+    status = msg.get("status")
+    if status:
+        if node.cluster.apply_status(status):
+            node._broadcast_self_alive()
+    node.cluster.clear_shard_routes()
+    return {"ok": True}
+
+
+def _invalidate_shard_local(node, index: str, shard: int,
+                            losing: bool) -> None:
+    """Cutover-time local invalidation: the shard's result-cache
+    entries everywhere (a stale remote-map entry on an ex-owner would
+    otherwise serve frozen results forever — its generation stamps
+    stop moving once writes stop arriving), plus the losing node's
+    per-shard device stacks (residency forget reverses the tenant
+    byte charges, so attribution moves with the data)."""
+    from pilosa_tpu.runtime import resultcache
+
+    resultcache.cache().invalidate_shard(index, shard)
+    if not losing:
+        return
+    idx = node.holder.index(index)
+    if idx is None:
+        return
+    for f in idx.all_fields():
+        f.drop_shard_stacks(shard)
+
+
+# --------------------------------------------------------------------
+# coordinator
+# --------------------------------------------------------------------
+
+
+class RebalanceCoordinator:
+    """Coordinator-side online rebalance driver.
+
+    One plan at a time: ``start`` computes the ownership diff, installs
+    dual-write overrides cluster-wide, then a bounded worker pool
+    drives each shard through backfill -> cutover; ``commit`` finalizes
+    membership.  The plan persists to a JSON cursor after every state
+    transition — ``resume()`` (called from Server.open) picks a crashed
+    plan back up; ``abort()`` reverts to the old topology.
+    """
+
+    def __init__(self, node, cursor_path: str | None = None):
+        self.node = node
+        self.cluster = node.cluster
+        self._explicit_cursor = cursor_path
+        self._plan_lock = _lockcheck.lock("rebalance-driver")
+        self._plan: dict | None = None
+        self._thread: threading.Thread | None = None
+        self._halt = threading.Event()
+        self._abort_requested = False
+        self._last: dict | None = None
+        # serializes cursor writes: concurrent workers persist after
+        # every state transition, and the tmp+rename pair is not safe
+        # to interleave (the loser's os.replace finds no tmp file)
+        self._persist_lock = threading.Lock()
+
+    # ------------------------------------------------------------ paths
+
+    @property
+    def cursor_path(self) -> str:
+        if self._explicit_cursor:
+            return self._explicit_cursor
+        cfg_path = config().cursor_path
+        if cfg_path:
+            return cfg_path
+        return os.path.join(str(self.node.holder.path), CURSOR_FILENAME)
+
+    # ----------------------------------------------------------- status
+
+    def active(self) -> bool:
+        with self._plan_lock:
+            return self._plan is not None
+
+    def shard_state_counts(self) -> tuple[int, int, int]:
+        """(pending, moving, cutover) shard counts of the active plan
+        — the rebalance.shards_* gauges."""
+        with self._plan_lock:
+            plan = self._plan
+            if plan is None:
+                return (0, 0, 0)
+            pending = moving = cut = 0
+            for m in plan["shards"]:
+                if m["state"] == MOVE_DUAL_WRITE:
+                    pending += 1
+                elif m["state"] == MOVE_BACKFILL:
+                    moving += 1
+                elif m["state"] in (MOVE_CUTOVER, MOVE_DROPPED):
+                    cut += 1
+            return (pending, moving, cut)
+
+    def status(self) -> dict:
+        """The /debug/rebalance document."""
+        with self._plan_lock:
+            plan = self._plan
+            doc: dict = {
+                "active": plan is not None,
+                "counters": counters(),
+                "cursorPath": self.cursor_path,
+            }
+            if plan is not None:
+                doc["plan"] = {
+                    "add": plan.get("add"),
+                    "removeId": plan.get("remove_id"),
+                    "startedAt": plan.get("started_at"),
+                    "shards": [
+                        {"index": m["index"], "shard": m["shard"],
+                         "state": m["state"],
+                         "old": m["old"], "new": m["new"]}
+                        for m in plan["shards"]
+                    ],
+                }
+            if self._last is not None:
+                doc["last"] = self._last
+        pending, moving, cut = self.shard_state_counts()
+        doc["shardsPending"] = pending
+        doc["shardsMoving"] = moving
+        doc["shardsCutover"] = cut
+        return doc
+
+    # ------------------------------------------------------------ start
+
+    def start(self, add: Node | None = None,
+              remove_id: str | None = None,
+              background: bool = True) -> dict:
+        """Begin an online rebalance.  Returns the plan summary
+        immediately; transfers run on a background worker pool unless
+        ``background=False`` (tests)."""
+        c = self.cluster
+        if not c.is_coordinator:
+            raise RebalanceError("rebalance must run on the coordinator")
+        if remove_id == c.local_id:
+            raise RebalanceError(
+                "cannot remove the coordinator: move the role first "
+                "(POST /cluster/resize/set-coordinator)")
+        with self._plan_lock:
+            if self._plan is not None:
+                raise RebalanceError("a rebalance is already running")
+            from pilosa_tpu.parallel.cluster import STATE_RESIZING
+
+            if c.state == STATE_RESIZING:
+                raise RebalanceError("an offline resize is running")
+            old_ids = [n.id for n in c.sorted_nodes()]
+            new_ids = list(old_ids)
+            if add is not None and add.id not in new_ids:
+                new_ids.append(add.id)
+            if remove_id is not None:
+                if remove_id not in new_ids:
+                    raise RebalanceError(f"node not found: {remove_id}")
+                new_ids.remove(remove_id)
+            if sorted(new_ids) == sorted(old_ids):
+                return {"started": False, "shards": 0,
+                        "nodes": sorted(new_ids)}
+            plan = self._build_plan(add, remove_id, old_ids, new_ids)
+            self._plan = plan
+            self._abort_requested = False
+            self._halt.clear()
+        bump("rebalance.plans")
+        self._persist()
+        self._broadcast_begin(plan)
+        summary = {"started": True, "shards": len(plan["shards"]),
+                   "nodes": sorted(new_ids),
+                   "add": plan.get("add"),
+                   "removeId": plan.get("remove_id")}
+        if background:
+            self._spawn()
+        else:
+            self._run()
+        return summary
+
+    def _build_plan(self, add: Node | None, remove_id: str | None,
+                    old_ids: list[str], new_ids: list[str]) -> dict:
+        c = self.cluster
+        raw = plan_transfers(self.node.holder, old_ids, new_ids,
+                             c.replica_n, c.partition_n, c.hasher)
+        from pilosa_tpu.parallel.cluster import shard_owners
+
+        moves: dict[tuple[str, int], dict] = {}
+        for dest_id, transfers in raw.items():
+            for t in transfers:
+                key = (t["index"], t["shard"])
+                m = moves.get(key)
+                if m is None:
+                    m = moves[key] = {
+                        "index": t["index"], "shard": t["shard"],
+                        "old": shard_owners(sorted(old_ids), t["index"],
+                                            t["shard"], c.replica_n,
+                                            c.partition_n, c.hasher),
+                        "new": shard_owners(sorted(new_ids), t["index"],
+                                            t["shard"], c.replica_n,
+                                            c.partition_n, c.hasher),
+                        "state": MOVE_DUAL_WRITE,
+                        "dests": {},
+                    }
+                m["dests"].setdefault(dest_id, []).append(
+                    {"field": t["field"], "source": t["source"],
+                     "fallbacks": t.get("fallbacks", [])})
+        ordered = sorted(moves.values(),
+                         key=lambda m: (m["index"], m["shard"]))
+        return {
+            "add": add.to_dict() if add is not None else None,
+            "remove_id": remove_id,
+            "old_ids": sorted(old_ids),
+            "new_ids": sorted(new_ids),
+            "shards": ordered,
+            "started_at": time.time(),
+            "done": False,
+        }
+
+    # ------------------------------------------------------ persistence
+
+    def _persist(self) -> None:
+        """Write the plan cursor atomically (tmp+rename, the topology
+        file discipline) so every state transition survives a crash."""
+        with self._plan_lock:
+            plan = self._plan
+            if plan is None:
+                return
+            data = json.dumps(plan)
+        path = self.cursor_path
+        tmp = path + ".tmp"
+        with self._persist_lock:
+            with open(tmp, "w") as f:
+                f.write(data)
+            os.replace(tmp, path)
+
+    def _clear_cursor(self) -> None:
+        try:
+            os.remove(self.cursor_path)
+        except FileNotFoundError:
+            pass
+
+    def resume(self) -> bool:
+        """Pick an interrupted plan back up from the persisted cursor
+        (Server.open on the coordinator).  Re-broadcasts membership
+        and routes (idempotent on nodes that never lost them), then
+        continues transfers for shards not yet cut over."""
+        path = self.cursor_path
+        if not os.path.exists(path):
+            return False
+        try:
+            with open(path) as f:
+                plan = json.load(f)
+        except (OSError, ValueError):
+            return False
+        if plan.get("done"):
+            self._clear_cursor()
+            return False
+        with self._plan_lock:
+            if self._plan is not None:
+                return False
+            self._plan = plan
+            self._abort_requested = False
+            self._halt.clear()
+        bump("rebalance.resumes")
+        self._broadcast_begin(plan)
+        # shards already cut over flipped their routes in
+        # _broadcast_begin (route derivation is state-aware); resume
+        # the rest
+        self._spawn()
+        return True
+
+    # -------------------------------------------------------- broadcast
+
+    def _route_for(self, m: dict) -> dict:
+        if m["state"] in (MOVE_CUTOVER, MOVE_DROPPED):
+            serving = m["new"]
+            pending = [i for i in m["old"] if i not in m["new"]]
+        else:
+            serving = m["old"]
+            pending = [i for i in m["new"] if i not in m["old"]]
+        return {"index": m["index"], "shard": m["shard"],
+                "serving": serving, "pending": pending}
+
+    def _broadcast_begin(self, plan: dict) -> None:
+        c = self.cluster
+        add = plan.get("add")
+        if add is not None:
+            c.add_node(Node.from_dict(add))
+        status = c.to_status()
+        msg = {
+            "type": "rebalance-begin",
+            "schema": self.node.holder.schema(),
+            "status": status,
+            "routes": [self._route_for(m) for m in plan["shards"]],
+        }
+        self.node.receive_message(msg)
+        self.node.broadcast(msg)
+
+    def _send(self, node_id: str, msg: dict) -> dict:
+        if node_id == self.cluster.local_id:
+            return self.node.receive_message(msg)
+        dest = self.cluster.node(node_id)
+        if dest is None:
+            raise TransportError(f"node not found: {node_id}")
+        return self.cluster.transport.send_message(dest, msg)
+
+    def _broadcast_and_local(self, msg: dict) -> None:
+        self.node.receive_message(msg)
+        self.node.broadcast(msg)
+
+    # ----------------------------------------------------------- driver
+
+    def _spawn(self) -> None:
+        t = threading.Thread(target=self._run,
+                             name="rebalance-coordinator", daemon=True)
+        self._thread = t
+        t.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        """Server shutdown: halt the driver WITHOUT aborting the plan
+        — the persisted cursor resumes it on the next open (the
+        crash-and-resume contract, exercised by the acceptance
+        soak)."""
+        self._halt.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        with self._plan_lock:
+            self._plan = None
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the driver thread finishes (tests)."""
+        t = self._thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
+
+    def abort(self) -> None:
+        """Operator abort: revert routing to the old topology.  Safe
+        at ANY point in the plan — writes stay dual (old owners keep
+        committing) until commit, so falling back never loses data."""
+        with self._plan_lock:
+            if self._plan is None:
+                return
+        self._abort_requested = True
+        self._halt.set()
+        t = self._thread
+        if t is None or not t.is_alive():
+            self._finish_abort()
+
+    def _run(self) -> None:
+        with self._plan_lock:
+            plan = self._plan
+        if plan is None:
+            return
+        work = [m for m in plan["shards"]
+                if m["state"] in (MOVE_DUAL_WRITE, MOVE_BACKFILL)]
+        budget = max(1, int(config().transfer_budget))
+        qlock = threading.Lock()
+        queue = list(work)
+
+        def worker():
+            while not self._halt.is_set():
+                with qlock:
+                    if not queue:
+                        return
+                    m = queue.pop(0)
+                try:
+                    self._move_shard(m)
+                except Exception:  # noqa: BLE001 — keep plan resumable
+                    bump("rebalance.transfer_failures")
+                    # requeue: a shard that did not reach cutover must
+                    # NEVER be committed past — retry until it lands
+                    # or the operator halts/aborts the plan
+                    with qlock:
+                        queue.append(m)
+                    self._sleep(self._backoff(0))
+
+        threads = [threading.Thread(target=worker,
+                                    name=f"rebalance-worker-{i}",
+                                    daemon=True)
+                   for i in range(min(budget, max(1, len(queue))))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if self._abort_requested:
+            self._finish_abort()
+            return
+        if self._halt.is_set():
+            return  # server shutdown: cursor persists, resume later
+        with self._plan_lock:
+            plan = self._plan
+            stuck = [] if plan is None else [
+                m for m in plan["shards"]
+                if m["state"] not in (MOVE_CUTOVER, MOVE_DROPPED)]
+        if stuck:
+            # paranoia gate: committing would finalize ownership for a
+            # shard whose data never landed — leave the plan live (the
+            # cursor persists; resume or abort recovers it)
+            bump("rebalance.transfer_failures")
+            return
+        self._commit()
+
+    def _sleep(self, seconds: float) -> None:
+        self._halt.wait(seconds)
+
+    def _backoff(self, attempt: int) -> float:
+        cfg = config()
+        return min(cfg.backoff_cap, cfg.backoff_base * (2 ** attempt))
+
+    def _move_shard(self, m: dict) -> None:
+        """Drive one (index, shard) through backfill -> cutover.  A
+        breaker-open transfer target pauses THIS shard with
+        exponential backoff; the worker pool keeps other shards
+        moving."""
+        with self._plan_lock:
+            m["state"] = MOVE_BACKFILL
+        self._persist()
+        uris = {n.id: n.uri for n in self.cluster.sorted_nodes()}
+        for dest_id, fields in m["dests"].items():
+            attempt = 0
+            while not self._halt.is_set():
+                if self.cluster.breaker_open(dest_id):
+                    # the target is known-bad: pause this shard, let
+                    # the breaker's half-open trial (or a heartbeat
+                    # probe) re-admit it — never abort the plan
+                    bump("rebalance.backoffs")
+                    self._sleep(self._backoff(attempt))
+                    attempt += 1
+                    continue
+                try:
+                    resp = self._send(dest_id, {
+                        "type": "rebalance-transfer",
+                        "index": m["index"], "shard": m["shard"],
+                        "fields": fields, "uris": uris,
+                    })
+                except ShedByPeerError:
+                    self.cluster.note_peer_success(dest_id)
+                    bump("rebalance.transfer_failures")
+                    self._sleep(self._backoff(attempt))
+                    attempt += 1
+                    continue
+                except (TransportError, OSError):
+                    self.cluster.note_peer_failure(dest_id)
+                    bump("rebalance.transfer_failures")
+                    self._sleep(self._backoff(attempt))
+                    attempt += 1
+                    continue
+                self.cluster.note_peer_success(dest_id)
+                if not resp.get("ok"):
+                    bump("rebalance.transfer_failures")
+                    self._sleep(self._backoff(attempt))
+                    attempt += 1
+                    continue
+                bump("rebalance.bytes_streamed",
+                     int(resp.get("bytes", 0)))
+                break
+        if self._halt.is_set():
+            return
+        with self._plan_lock:
+            m["state"] = MOVE_CUTOVER
+        self._broadcast_and_local(self._route_for(m) | {
+            "type": "rebalance-cutover"})
+        bump("rebalance.cutovers")
+        self._persist()
+
+    # ----------------------------------------------------- commit/abort
+
+    def _commit(self) -> None:
+        """All shards cut over: finalize membership, clear overrides
+        everywhere, grace-deferred cleanup of the old copies."""
+        c = self.cluster
+        with self._plan_lock:
+            plan = self._plan
+            if plan is None:
+                return
+            for m in plan["shards"]:
+                m["state"] = MOVE_DROPPED
+            plan["done"] = True
+            remove_id = plan.get("remove_id")
+        removed_node = None
+        if remove_id is not None:
+            removed_node = c.node(remove_id)
+            c.remove_node(remove_id)
+        status = c.to_status()
+        self._broadcast_and_local({"type": "rebalance-commit",
+                                   "status": status})
+        if removed_node is not None:
+            try:
+                c.transport.send_message(removed_node,
+                                         {"type": "node-removed"})
+            except TransportError:
+                pass
+        c._update_cluster_state()
+        # propagate global shard availability so the joiner fans
+        # queries out over shards it doesn't hold locally, then let
+        # the grace-deferred cleaner age out the old copies
+        self.node.broadcast_node_status()
+        self.node.broadcast({"type": "holder-cleanup"})
+        self.node.request_cleanup()
+        self._clear_cursor()
+        with self._plan_lock:
+            self._last = {
+                "outcome": "committed",
+                "shards": len(plan["shards"]),
+                "nodes": plan["new_ids"],
+                "at": time.time(),
+            }
+            self._plan = None
+        self._halt.set()
+
+    def _finish_abort(self) -> None:
+        with self._plan_lock:
+            plan = self._plan
+            if plan is None:
+                return
+            add = plan.get("add")
+        msg = {"type": "rebalance-abort",
+               "add_id": add["id"] if add else None}
+        self.node.receive_message(msg)
+        self.node.broadcast(msg)
+        bump("rebalance.aborts")
+        self._clear_cursor()
+        with self._plan_lock:
+            self._last = {
+                "outcome": "aborted",
+                "shards": len(plan["shards"]),
+                "nodes": plan["old_ids"],
+                "at": time.time(),
+            }
+            self._plan = None
+        self.node.broadcast_node_status()

@@ -451,6 +451,33 @@ class ResultCache:
         if fl is not None:
             fl.event.set()
 
+    def invalidate_shard(self, index: str, shard: int) -> int:
+        """Drop every entry whose key covers ``shard`` of ``index`` —
+        the rebalance cutover hook.  Generation stamps alone do NOT
+        cover an ownership change: the local fragments never mutated,
+        so a node that just lost (or gained) a shard would keep
+        serving its remote-map entries verbatim.  Executor keys are
+        ``(holder_uid, index, kind, sig, extra, shards, placement)``
+        (see Executor._rc_probe); foreign key shapes are left alone.
+        Dropped keys resolve their open flights so waiters recompute
+        instead of waiting on a fill for an evicted key."""
+        shard = int(shard)
+        with self._lock:
+            victims = []
+            for key, e in self._entries.items():
+                k = getattr(key, "k", key)
+                if (isinstance(k, tuple) and len(k) >= 6
+                        and k[1] == index
+                        and isinstance(k[5], tuple) and shard in k[5]):
+                    victims.append((key, e))
+            for key, e in victims:
+                del self._entries[key]
+                self.bytes -= e.nbytes
+                self._tenant_untrack_locked(key, e)
+                self._resolve_flight_locked(key)
+            self.invalidations += len(victims)
+            return len(victims)
+
     def invalidate_all(self) -> int:
         """Drop everything (operator escape hatch / tests).  Counted
         as invalidations.  Open flights resolve (waiters wake, miss,

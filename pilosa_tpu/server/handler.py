@@ -305,6 +305,9 @@ class Handler:
         # sampler would double-count stacks and burn CPU for up to 30 s
         # while holding an HTTP worker thread; try-lock -> 409
         self._profile_lock = threading.Lock()
+        # set by close(): surviving keep-alive worker threads refuse
+        # (503) instead of serving from a closed holder
+        self._draining = False
 
     @property
     def uri(self) -> str:
@@ -312,6 +315,7 @@ class Handler:
         return f"{scheme}://{self.host}:{self.port}"
 
     def serve_background(self) -> None:
+        self._draining = False
         if self.httpd.fileno() == -1:
             # reopened after close(): rebuild the listener on the SAME
             # port (server_close() closed the old socket; serving the
@@ -334,6 +338,7 @@ class Handler:
         self._thread.start()
 
     def close(self) -> None:
+        self._draining = True
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
@@ -395,6 +400,20 @@ class Handler:
     # ------------------------------------------------------------ plumbing
 
     def _handle(self, req: BaseHTTPRequestHandler, method: str) -> None:
+        if self._draining:
+            # close() ran, but a pooled keep-alive connection's worker
+            # thread outlives httpd.shutdown(): refuse instead of
+            # answering from a closed holder (an empty fragment set
+            # would serve WRONG results, not an error)
+            try:
+                req.send_response(503)
+                req.send_header("Retry-After", "1")
+                req.send_header("Content-Length", "0")
+                req.send_header("Connection", "close")
+                req.end_headers()
+            except OSError:
+                pass
+            return
         parsed = urlparse(req.path)
         path = parsed.path.rstrip("/") or "/"
         params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
@@ -1057,6 +1076,16 @@ class Handler:
         self.api.resize_abort()
         self._json(req, {})
 
+    @route("POST", "/cluster/resize")
+    def handle_cluster_resize(self, req, params, path, body):
+        """Node add/remove control route: ``mode=online`` (default)
+        drives the live rebalance, ``mode=offline`` the legacy
+        stop-the-world resize (see API.cluster_resize)."""
+        d = json.loads(body or b"{}")
+        if "mode" not in d and params.get("mode"):
+            d["mode"] = params["mode"]
+        self._json(req, self.api.cluster_resize(d))
+
     # ------------------------------------------------------- infra routes
 
     @route("GET", "/metrics")
@@ -1563,6 +1592,15 @@ class Handler:
             "hintCounters": _hints.counters(),
         })
 
+    @route("GET", "/debug/rebalance")
+    def handle_debug_rebalance(self, req, params, path, body):
+        """Online rebalance state (parallel/rebalance.py): whether a
+        plan is active, the per-shard state machine (dual-write /
+        backfill / cutover / dropped with old and new owner sets), the
+        cumulative rebalance.* counters, the persisted cursor path,
+        and the last finished plan's outcome."""
+        self._json(req, self.api.rebalance_status())
+
     @route("GET", "/debug/failpoints")
     def handle_debug_failpoints(self, req, params, path, body):
         """Failpoint registry state (pilosa_tpu.faultinject): armed
@@ -1671,6 +1709,7 @@ class Handler:
         from pilosa_tpu.ops import tape
         from pilosa_tpu.parallel import hints as _hints
         from pilosa_tpu.parallel import meshexec as _meshexec
+        from pilosa_tpu.parallel import rebalance as _rebalance
         from pilosa_tpu.parallel import syncer as _syncer
         from pilosa_tpu.runtime import resultcache
 
@@ -1696,6 +1735,11 @@ class Handler:
             # WAL replay health — zeros on a clean server
             _syncer.publish_gauges(self.stats)
             _hints.publish_gauges(self.stats, self.api.node.hints)
+            # online-rebalance families: plan/shard-state gauges plus
+            # dual-write / bytes-streamed / abort totals — zeros on a
+            # clean server (and on non-coordinator nodes)
+            _rebalance.publish_gauges(
+                self.stats, getattr(self.api.node, "rebalance", None))
             _fragment.publish_wal_gauges(self.stats)
             # per-tenant isolation totals (zeros while [tenants] is
             # off — the family stays alert-able before the first

@@ -112,6 +112,12 @@ class Server:
         anti_entropy_jitter: float = 0.1,
         anti_entropy_round_budget: float = 0.0,
         anti_entropy_peer_timeout: float = 2.0,
+        rebalance_transfer_budget: int | None = None,
+        rebalance_dual_write_policy: str | None = None,
+        rebalance_cursor_path: str | None = None,
+        rebalance_backoff_base: float | None = None,
+        rebalance_backoff_cap: float | None = None,
+        rebalance_peer_timeout: float | None = None,
         tenants_enabled: bool = False,
         tenants_default_share: int | None = None,
         tenants_default_queue: int | None = None,
@@ -183,6 +189,26 @@ class Server:
             replay_interval=hint_replay_interval)
         _hints.configure(**self._replication_cfg)
         self.hint_replayer = HintReplayer(self.node)
+        # [rebalance] — online shard migration; process-wide config is
+        # refcounted like [replication], the coordinator DRIVER is
+        # per-node (attached here so /cluster/resize can reach it)
+        from pilosa_tpu.parallel import rebalance as _rebalance
+
+        _rebalance.retain()
+        self._rebalance_retained = True
+        self._rebalance_cfg = {
+            k: v for k, v in dict(
+                transfer_budget=rebalance_transfer_budget,
+                dual_write_policy=rebalance_dual_write_policy,
+                cursor_path=rebalance_cursor_path,
+                backoff_base=rebalance_backoff_base,
+                backoff_cap=rebalance_backoff_cap,
+                peer_timeout=rebalance_peer_timeout,
+            ).items() if v is not None}
+        if self._rebalance_cfg:
+            _rebalance.configure(**self._rebalance_cfg)
+        self.node.rebalance = _rebalance.RebalanceCoordinator(
+            self.node, cursor_path=rebalance_cursor_path)
         self.node.executor.stats = self.stats
         self.node.executor.logger = self.logger
         self.node.executor.long_query_time = long_query_time
@@ -482,6 +508,13 @@ class Server:
             self.node.hints = HintStore(
                 _os.path.join(self.holder.path, "hints")
                 if getattr(self.holder, "path", None) else None)
+        if not self._rebalance_retained:
+            from pilosa_tpu.parallel import rebalance as _rebalance1
+
+            _rebalance1.retain()
+            self._rebalance_retained = True
+            if self._rebalance_cfg:
+                _rebalance1.configure(**self._rebalance_cfg)
         self.handler.serve_background()
         self.cluster.save_topology()
         if self.seeds:
@@ -494,6 +527,15 @@ class Server:
             self.cluster.coordinator_id = self.cluster.local_id
             self.cluster.local_node.is_coordinator = True
             self.cluster.set_state(STATE_NORMAL)
+        try:
+            # crash mid-rebalance leaves a persisted cursor: pick the
+            # migration back up from the last completed shard (no-op
+            # when no cursor file exists or we are not the coordinator)
+            if self.cluster.is_coordinator:
+                self.node.rebalance.resume()
+        except Exception as e:  # noqa: BLE001 — resume must not block
+            # serving; the cluster keeps the old topology either way
+            self.logger.printf("rebalance resume skipped: %r", e)
         if self.anti_entropy_interval > 0:
             t = threading.Thread(target=self._anti_entropy_loop, daemon=True)
             t.start()
@@ -625,8 +667,19 @@ class Server:
         self.device_sampler.stop()
         self.prefetcher.stop()
         self.hint_replayer.stop()
-        from pilosa_tpu.parallel import hints as _hints0
+        # halt (not abort) any in-flight rebalance: the persisted
+        # cursor survives so a restarted coordinator resumes the
+        # migration instead of stranding the cluster mid-plan
+        try:
+            self.node.rebalance.stop()
+        except Exception:  # noqa: BLE001 — close() must stay idempotent
+            pass
+        from pilosa_tpu.parallel import hints as _hints0, \
+            rebalance as _rebalance0
 
+        if self._rebalance_retained:
+            self._rebalance_retained = False
+            _rebalance0.release()
         if self._hints_retained:
             self._hints_retained = False
             _hints0.release()

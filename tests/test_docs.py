@@ -61,10 +61,10 @@ def test_every_config_key_documented():
 
     text = open(os.path.join(DOCS, "configuration.md")).read()
     missing = []
-    sections = ("cluster", "anti_entropy", "replication", "metric",
-                "tracing", "profile", "tls", "coalescer", "ragged",
-                "vm", "observe", "cost", "admission", "cache",
-                "ingest", "containers", "mesh", "residency",
+    sections = ("cluster", "anti_entropy", "replication", "rebalance",
+                "metric", "tracing", "profile", "tls", "coalescer",
+                "ragged", "vm", "observe", "cost", "admission",
+                "cache", "ingest", "containers", "mesh", "residency",
                 "faultinject", "tenants")
     for f in fields(cfgmod.Config):
         if f.name in sections:

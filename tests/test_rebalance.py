@@ -1,0 +1,881 @@
+"""Online shard migration tests (parallel/rebalance.py): node
+add/remove as a first-class online operation — per-shard
+dual-write -> backfill -> cutover instead of the cluster-wide RESIZING
+gate (which remains as the ``mode=offline`` escape hatch).
+
+The acceptance soak drives a real HTTP cluster 3 -> 5 -> 3 nodes under
+sustained mixed traffic via the loadgen ``--scale-schedule`` driver and
+pins: zero failed queries (readers never see 405), migration-window
+read p99 bounded against steady state, bit-exact convergence of every
+replica against a write oracle, and coordinator kill + restart
+resuming from the persisted cursor.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.models.holder import Holder
+from pilosa_tpu.parallel import rebalance as _rebalance
+from pilosa_tpu.parallel.cluster import (
+    UNOWNED_MARKER,
+    Cluster,
+    Node,
+    shard_owners,
+)
+from pilosa_tpu.parallel.node import ClusterNode
+from pilosa_tpu.parallel.rebalance import (
+    RebalanceCoordinator,
+    RebalanceError,
+)
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+from tests.test_cluster import make_cluster
+
+
+@pytest.fixture(autouse=True)
+def _fresh_rebalance_config():
+    _rebalance.reset()
+    yield
+    _rebalance.reset()
+
+
+def _cols(frag, row) -> set[int]:
+    words = frag.row(row)
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return {int(x) for x in np.nonzero(bits)[0]}
+
+
+def _seed(coord, n_shards=6, row=1) -> set[int]:
+    coord.create_index("i")
+    coord.create_field("i", "f")
+    truth = set()
+    for s in range(n_shards):
+        for k in range(3 + s):
+            col = s * SHARD_WIDTH + k
+            coord.executor.execute("i", f"Set({col}, f={row})")
+            truth.add(col)
+    return truth
+
+
+def _boot_joiner(tmp_path, transport, nid="node9", replica_n=1):
+    """A running node OUTSIDE the ring (its own standalone cluster on
+    the shared transport) — what a freshly started server looks like
+    to the coordinator before the rebalance begins."""
+    holder = Holder(str(tmp_path / nid))
+    cluster = Cluster(nid, nodes=[Node(id=nid)], replica_n=replica_n,
+                      transport=transport.bind(nid))
+    cluster.set_state("NORMAL")
+    joiner = ClusterNode(holder, cluster)
+    joiner.rebalance = RebalanceCoordinator(joiner)
+    return joiner
+
+
+def _attach_drivers(nodes):
+    for n in nodes:
+        n.rebalance = RebalanceCoordinator(n)
+    return nodes[0].rebalance
+
+
+def _assert_bit_exact(nodes, truth, row=1, field="f"):
+    """Every replica of every shard holds EXACTLY the oracle's bits
+    for that shard — convergence is bit-for-bit, not just count."""
+    c0 = nodes[0].cluster
+    ids = sorted(n.cluster.local_id for n in nodes)
+    by_id = {n.cluster.local_id: n for n in nodes}
+    shards = sorted({col // SHARD_WIDTH for col in truth})
+    for shard in shards:
+        want = {col for col in truth if col // SHARD_WIDTH == shard}
+        owners = shard_owners(ids, "i", shard, c0.replica_n,
+                              c0.partition_n, c0.hasher)
+        for oid in owners:
+            idx = by_id[oid].holder.index("i")
+            frag = idx.field(field).view("standard").fragment(shard)
+            got = _cols(frag, row) if frag is not None else set()
+            got = {shard * SHARD_WIDTH + c for c in got}
+            assert got == want, (oid, shard, got ^ want)
+
+
+class TestStartValidation:
+    def test_noop_diff_does_not_start(self, tmp_path):
+        _, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        driver = _attach_drivers(nodes)
+        out = driver.start(add=nodes[1].cluster.local_node)
+        assert out["started"] is False
+
+    def test_non_coordinator_refuses(self, tmp_path):
+        _, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        _attach_drivers(nodes)
+        with pytest.raises(RebalanceError, match="coordinator"):
+            nodes[1].rebalance.start(remove_id="node0")
+
+    def test_cannot_remove_coordinator(self, tmp_path):
+        _, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        driver = _attach_drivers(nodes)
+        with pytest.raises(RebalanceError, match="move the role"):
+            driver.start(remove_id="node0")
+
+    def test_unknown_remove_target(self, tmp_path):
+        _, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        driver = _attach_drivers(nodes)
+        with pytest.raises(RebalanceError, match="not found"):
+            driver.start(remove_id="nope")
+
+
+class TestOnlineAddRemove:
+    def test_add_converges_bit_exact_and_clears_routes(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        driver = _attach_drivers(nodes)
+        truth = _seed(nodes[0])
+        joiner = _boot_joiner(tmp_path, transport, "node2")
+        c0 = _rebalance.counters()
+
+        out = driver.start(add=joiner.cluster.local_node,
+                           background=False)
+        assert out["started"] is True and out["shards"] > 0
+
+        all_nodes = nodes + [joiner]
+        for n in all_nodes:
+            ids = sorted(x.id for x in n.cluster.sorted_nodes())
+            assert ids == ["node0", "node1", "node2"]
+            assert n.cluster.state == "NORMAL"  # never gated RESIZING
+            assert n.cluster.shard_routes_snapshot() == {}
+            got = n.executor.execute("i", "Count(Row(f=1))")[0]
+            assert got == len(truth)
+        _assert_bit_exact(all_nodes, truth)
+        c1 = _rebalance.counters()
+        assert c1["rebalance.plans"] - c0["rebalance.plans"] == 1
+        assert c1["rebalance.cutovers"] > c0["rebalance.cutovers"]
+        assert not os.path.exists(driver.cursor_path)
+
+    def test_remove_rehomes_and_detaches(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=1)
+        driver = _attach_drivers(nodes)
+        truth = _seed(nodes[0])
+        out = driver.start(remove_id="node2", background=False)
+        assert out["started"] is True
+        for n in nodes[:2]:
+            ids = sorted(x.id for x in n.cluster.sorted_nodes())
+            assert ids == ["node0", "node1"]
+            got = n.executor.execute("i", "Count(Row(f=1))")[0]
+            assert got == len(truth)
+        _assert_bit_exact(nodes[:2], truth)
+        # the removed node detached into a standalone cluster
+        assert [x.id for x in nodes[2].cluster.sorted_nodes()] == \
+            ["node2"]
+
+    def test_replicated_add_converges_every_replica(self, tmp_path):
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=2)
+        driver = _attach_drivers(nodes)
+        truth = _seed(nodes[0], n_shards=5)
+        joiner = _boot_joiner(tmp_path, transport, "node3",
+                              replica_n=2)
+        out = driver.start(add=joiner.cluster.local_node,
+                           background=False)
+        assert out["started"] is True
+        _assert_bit_exact(nodes + [joiner], truth)
+
+
+class TestDualWrite:
+    def test_write_during_migration_reaches_pending_owner(
+            self, tmp_path):
+        """A write landing while a shard is in the dual-write window
+        commits on the serving owners AND the pending (new) owner —
+        the missed-delivery -> hint contract means the cutover never
+        loses a racing write."""
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        _attach_drivers(nodes)
+        _seed(nodes[0], n_shards=2)
+        joiner = _boot_joiner(tmp_path, transport, "node2")
+        # hand-install the dual-write window the coordinator would:
+        # shard 0 serving on its ring owner, pending on the joiner
+        ids = ["node0", "node1"]
+        serving = shard_owners(ids, "i", 0, 1,
+                               nodes[0].cluster.partition_n,
+                               nodes[0].cluster.hasher)
+        for n in nodes:
+            n.cluster.add_node(joiner.cluster.local_node)
+            n.cluster.set_shard_route("i", 0, serving, ["node2"])
+        joiner.cluster.add_node(nodes[0].cluster.local_node)
+        joiner.cluster.add_node(nodes[1].cluster.local_node)
+        joiner.create_index("i")
+        joiner.create_field("i", "f")
+        joiner.cluster.set_shard_route("i", 0, serving, ["node2"])
+        c0 = _rebalance.counters()
+
+        nodes[0].executor.execute("i", "Set(7, f=1)")
+        frag = (joiner.holder.index("i").field("f")
+                .view("standard").fragment(0))
+        assert frag is not None and 7 in _cols(frag, 1)
+        c1 = _rebalance.counters()
+        assert c1["rebalance.dual_writes"] > c0["rebalance.dual_writes"]
+
+    def test_hint_policy_survives_unreachable_pending_owner(
+            self, tmp_path):
+        """dual-write-policy=hint: the pending owner being down must
+        NOT fail the write — the miss is hinted and the serving owners
+        commit (policy=strict would hold it to [replication])."""
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        _attach_drivers(nodes)
+        _seed(nodes[0], n_shards=1)
+        ids = ["node0", "node1"]
+        serving = shard_owners(ids, "i", 0, 1,
+                               nodes[0].cluster.partition_n,
+                               nodes[0].cluster.hasher)
+        ghost = Node(id="node2", uri="")
+        for n in nodes:
+            n.cluster.add_node(ghost)  # registered but NOT running
+            n.cluster.set_shard_route("i", 0, serving, ["node2"])
+        assert nodes[0].executor.execute("i", "Set(9, f=1)")[0] is True
+        owner = nodes[0] if serving[0] == "node0" else nodes[1]
+        frag = (owner.holder.index("i").field("f")
+                .view("standard").fragment(0))
+        assert 9 in _cols(frag, 1)
+        # the miss was queued as a hint for the pending owner so the
+        # write replays once it comes up (strict would have raised)
+        assert any(n.hints.depth("node2") > 0 for n in nodes)
+
+
+class TestOwnershipGate:
+    def test_remote_subquery_refused_with_marker(self, tmp_path):
+        """A node that does not own a shard refuses the remote
+        sub-query with the structured ErrClusterDoesNotOwnShard
+        marker instead of serving a stale (possibly dropped) copy."""
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        _seed(nodes[0], n_shards=2)
+        from pilosa_tpu.parallel.executor import (
+            ExecOptions,
+            UnownedShardError,
+        )
+        # find a shard node1 does NOT own and ask it remotely
+        ids = ["node0", "node1"]
+        c = nodes[0].cluster
+        unowned = [s for s in (0, 1)
+                   if "node1" not in shard_owners(
+                       ids, "i", s, 1, c.partition_n, c.hasher)]
+        assert unowned, "need a shard node1 does not own"
+        with pytest.raises(UnownedShardError) as ei:
+            nodes[1].executor.execute(
+                "i", "Count(Row(f=1))", shards=[unowned[0]],
+                opt=ExecOptions(remote=True))
+        assert UNOWNED_MARKER in str(ei.value)
+        assert getattr(ei.value, "unowned", False) is True
+
+    def test_origin_fails_over_on_unowned_refusal(self, tmp_path):
+        """An origin holding a stale view fans a sub-query to the old
+        owner; the refusal marker makes it fail over to the current
+        owner rather than surface an error to the reader."""
+        transport, nodes = make_cluster(tmp_path, n=3, replica_n=2)
+        truth = _seed(nodes[0], n_shards=3)
+        # flip one shard's serving set away from its ring owners on
+        # the RECEIVING nodes only: the origin (node0) still routes by
+        # ring, the old owner refuses, and the query must still answer
+        ids = sorted(n.cluster.local_id for n in nodes)
+        c = nodes[0].cluster
+        owners = shard_owners(ids, "i", 0, 2, c.partition_n, c.hasher)
+        others = [i for i in ids if i not in owners]
+        new_serving = ([others[0]] if others else owners[-1:]) \
+            + owners[1:]
+        for n in nodes:
+            if n.cluster.local_id != "node0":
+                n.cluster.set_shard_route("i", 0, new_serving, [])
+        got = nodes[0].executor.execute("i", "Count(Row(f=1))")[0]
+        assert got == len(truth)
+
+
+class TestCutoverInvalidation:
+    def test_cutover_drops_result_cache_for_that_shard_only(
+            self, tmp_path):
+        from pilosa_tpu.runtime import resultcache
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        _attach_drivers(nodes)
+        # enough shards that node0 owns a fused local group (>1 shard:
+        # the cache fills on fused local-group reads)
+        _seed(nodes[0], n_shards=8)
+        resultcache.configure(enabled=True)
+        try:
+            cache = resultcache.cache()
+            n0 = nodes[0]
+            n0.executor.execute("i", "Count(Row(f=1))")
+            assert len(cache._entries) > 0
+            c = n0.cluster
+            mine = [s for s in range(8)
+                    if "node0" in shard_owners(
+                        ["node0", "node1"], "i", s, 1,
+                        c.partition_n, c.hasher)]
+            victim = mine[0]
+            n0.receive_message({
+                "type": "rebalance-cutover", "index": "i",
+                "shard": victim, "serving": ["node1"],
+                "pending": ["node0"]})
+            # every cached result whose shard set covers the cutover
+            # shard is gone; the route override is installed
+            for key in list(cache._entries):
+                k = getattr(key, "k", key)
+                assert not (k[1] == "i" and victim in k[5]), k
+            assert n0.cluster.shard_route("i", victim) == \
+                (("node1",), ("node0",))
+        finally:
+            resultcache.reset()
+
+
+class TestAbort:
+    def _paused_plan(self, tmp_path):
+        """A plan whose backfill is parked on an open breaker — the
+        controllable mid-migration state."""
+        transport, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        driver = _attach_drivers(nodes)
+        truth = _seed(nodes[0])
+        joiner = _boot_joiner(tmp_path, transport, "node2")
+        _rebalance.configure(backoff_base=0.05, backoff_cap=0.2)
+        for _ in range(20):
+            nodes[0].cluster.note_peer_failure("node2")
+        assert nodes[0].cluster.breaker_open("node2")
+        c0 = _rebalance.counters()
+        driver.start(add=joiner.cluster.local_node, background=True)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            c = _rebalance.counters()
+            if c["rebalance.backoffs"] > c0["rebalance.backoffs"]:
+                break
+            time.sleep(0.02)
+        else:
+            pytest.fail("backfill never parked on the open breaker")
+        return transport, nodes, joiner, driver, truth, c0
+
+    def test_abort_mid_backfill_reverts_to_old_topology(self, tmp_path):
+        transport, nodes, joiner, driver, truth, c0 = \
+            self._paused_plan(tmp_path)
+        driver.abort()
+        assert driver.wait(timeout=10)
+        c1 = _rebalance.counters()
+        assert c1["rebalance.aborts"] - c0["rebalance.aborts"] == 1
+        for n in nodes:
+            ids = sorted(x.id for x in n.cluster.sorted_nodes())
+            assert ids == ["node0", "node1"]  # joiner backed out
+            assert n.cluster.shard_routes_snapshot() == {}
+            got = n.executor.execute("i", "Count(Row(f=1))")[0]
+            assert got == len(truth)
+        assert not os.path.exists(driver.cursor_path)
+        _assert_bit_exact(nodes, truth)
+
+    def test_breaker_flap_pauses_shard_then_completes(self, tmp_path):
+        """A mid-migration target flap (breaker opens) pauses THAT
+        shard's backfill with exponential backoff — the plan is not
+        aborted, and once the target recovers the migration finishes
+        and converges."""
+        transport, nodes, joiner, driver, truth, c0 = \
+            self._paused_plan(tmp_path)
+        assert driver.active()  # still running, not aborted
+        nodes[0].cluster.note_peer_success("node2")  # target recovers
+        assert driver.wait(timeout=30)
+        c1 = _rebalance.counters()
+        assert c1["rebalance.backoffs"] > c0["rebalance.backoffs"]
+        assert c1["rebalance.aborts"] == c0["rebalance.aborts"]
+        all_nodes = nodes + [joiner]
+        for n in all_nodes:
+            ids = sorted(x.id for x in n.cluster.sorted_nodes())
+            assert ids == ["node0", "node1", "node2"]
+        _assert_bit_exact(all_nodes, truth)
+
+    def test_joiner_probeable_and_breaker_tracked_before_owning(
+            self, tmp_path):
+        """SWIM-side contract: the joining node is a first-class peer
+        (probe-able, breaker-tracked, receives dual writes) BEFORE it
+        serves anything — reads still route to the old owners."""
+        transport, nodes, joiner, driver, truth, c0 = \
+            self._paused_plan(tmp_path)
+        c = nodes[0].cluster
+        assert c.node("node2") is not None
+        assert c.breaker_open("node2")  # breaker-tracked (we opened it)
+        # reads: no shard serves from the joiner yet
+        for key, r in c.shard_routes_snapshot().items():
+            assert "node2" not in r["serving"], (key, r)
+            assert "node2" in r["pending"], (key, r)
+        # writes: the joiner IS in the write set of routed shards
+        routed = list(c.shard_routes_snapshot())
+        assert routed, "plan should have installed routes"
+        idx_shard = routed[0].split("/")
+        wn = [n.id for n in c.write_nodes(idx_shard[0],
+                                          int(idx_shard[1]))]
+        assert "node2" in wn
+        # probe path: heartbeat bookkeeping accepts the joiner
+        c.note_probe("node2", True)
+        driver.abort()
+        driver.wait(timeout=10)
+
+
+class TestCursorResume:
+    def test_stop_persists_cursor_and_resume_converges(self, tmp_path):
+        """Coordinator crash mid-migration: stop() (the close() path)
+        leaves the cursor on disk; a NEW driver instance — what a
+        restarted server constructs — resumes from it and the cluster
+        still converges bit-exact."""
+        transport, nodes, joiner, driver, truth, c0 = \
+            TestAbort()._paused_plan(tmp_path)
+        driver.stop(timeout=5)
+        assert os.path.exists(driver.cursor_path)  # plan survives
+        # old topology still serves while the coordinator is "down"
+        got = nodes[1].executor.execute("i", "Count(Row(f=1))")[0]
+        assert got == len(truth)
+
+        nodes[0].cluster.note_peer_success("node2")  # target is back
+        fresh = RebalanceCoordinator(nodes[0])  # the restarted server
+        nodes[0].rebalance = fresh
+        assert fresh.resume() is True
+        assert fresh.wait(timeout=30)
+        c1 = _rebalance.counters()
+        assert c1["rebalance.resumes"] - c0["rebalance.resumes"] == 1
+        all_nodes = nodes + [joiner]
+        for n in all_nodes:
+            ids = sorted(x.id for x in n.cluster.sorted_nodes())
+            assert ids == ["node0", "node1", "node2"]
+        _assert_bit_exact(all_nodes, truth)
+        assert not os.path.exists(fresh.cursor_path)
+
+    def test_resume_without_cursor_is_noop(self, tmp_path):
+        _, nodes = make_cluster(tmp_path, n=2, replica_n=1)
+        driver = _attach_drivers(nodes)
+        assert driver.resume() is False
+
+
+class TestConfig:
+    def test_configure_validates_policy(self):
+        with pytest.raises(ValueError, match="dual-write-policy"):
+            _rebalance.configure(dual_write_policy="yolo")
+
+    def test_retain_release_restores_baseline(self):
+        _rebalance.retain()
+        _rebalance.configure(transfer_budget=7,
+                             dual_write_policy="strict")
+        assert _rebalance.config().transfer_budget == 7
+        _rebalance.release()
+        assert _rebalance.config().transfer_budget == 2
+        assert _rebalance.config().dual_write_policy == "hint"
+
+    def test_toml_env_plumbing(self):
+        from pilosa_tpu.config import Config
+        cfg = Config.load(env={
+            "PILOSA_TPU_REBALANCE_TRANSFER_BUDGET": "5",
+            "PILOSA_TPU_REBALANCE_DUAL_WRITE_POLICY": "strict"})
+        assert cfg.rebalance.transfer_budget == 5
+        assert cfg.rebalance.dual_write_policy == "strict"
+        assert "[rebalance]" in cfg.to_toml()
+        assert 'dual-write-policy = "strict"' in cfg.to_toml()
+
+
+# ------------------------------------------------------------ HTTP tier
+
+
+def _post(uri, path, obj=None):
+    req = urllib.request.Request(
+        uri + path, data=json.dumps(obj or {}).encode(), method="POST")
+    req.add_header("Content-Type", "application/json")
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read() or b"null")
+
+
+def _get(uri, path):
+    with urllib.request.urlopen(uri + path, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_settled(uri, deadline_s=60.0):
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        if not _get(uri, "/debug/rebalance")["active"]:
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def _assert_servers_bit_exact(servers, truth, field="f", row=1):
+    """Every owning replica across the HTTP cluster holds exactly the
+    oracle's bits (settle loop: dual-write hints may still drain)."""
+    by_id = {s.cluster.local_id: s for s in servers}
+    ids = sorted(by_id)
+    c0 = servers[0].cluster
+    shards = sorted({col // SHARD_WIDTH for col in truth})
+    deadline = time.time() + 30
+    while True:
+        bad = []
+        for shard in shards:
+            want = {c for c in truth if c // SHARD_WIDTH == shard}
+            owners = shard_owners(ids, "i", shard, c0.replica_n,
+                                  c0.partition_n, c0.hasher)
+            for oid in owners:
+                idx = by_id[oid].holder.index("i")
+                f = idx.field(field) if idx else None
+                frag = (f.view("standard").fragment(shard)
+                        if f else None)
+                got = _cols(frag, row) if frag is not None else set()
+                got = {shard * SHARD_WIDTH + c for c in got}
+                if got != want:
+                    bad.append((oid, shard, sorted(got ^ want)[:8]))
+        if not bad:
+            return
+        if time.time() > deadline:
+            pytest.fail(f"replicas diverged from oracle: {bad}")
+        time.sleep(0.25)
+
+
+class TestScaleScheduleSoak:
+    def test_soak_3_to_5_to_3_under_traffic(self, tmp_path):
+        """THE acceptance soak: grow 3 -> 5 nodes and shrink back to 3
+        while mixed traffic flows, driven end-to-end by the loadgen
+        --scale-schedule driver against the online control route.
+        Pins: zero failed queries, bounded migration-window read p99,
+        rebalance.* counters moved, and bit-exact convergence of every
+        replica against the write oracle."""
+        from pilosa_tpu.server.server import Server
+        from tools.loadgen import (
+            _ScaleDriver,
+            parse_scale_schedule,
+            run_load,
+        )
+
+        servers = []
+        s0 = Server(str(tmp_path / "n0"), name="node0", replica_n=2)
+        s0.open()
+        servers.append(s0)
+        for i in (1, 2):
+            s = Server(str(tmp_path / f"n{i}"), name=f"node{i}",
+                       replica_n=2, seeds=[s0.uri])
+            s.open()
+            servers.append(s)
+        # the two growth targets run standalone until the schedule
+        # adds them — started up front so add=<id>=<uri> has a URI
+        extras = []
+        for i in (3, 4):
+            s = Server(str(tmp_path / f"n{i}"), name=f"node{i}",
+                       replica_n=2)
+            s.open()
+            extras.append(s)
+        try:
+            _post(s0.uri, "/index/i")
+            _post(s0.uri, "/index/i/field/f")
+            _post(s0.uri, "/index/i/field/lg")
+            truth = set()
+            for sh in range(4):
+                for k in range(4):
+                    col = sh * SHARD_WIDTH + k
+                    _post(s0.uri, "/index/i/query",
+                          {"query": f"Set({col}, f=1)"})
+                    truth.add(col)
+
+            # unmeasured warmup: run the same grow/shrink cycle once
+            # with light traffic so every topology's fused shard-group
+            # shape is XLA-compiled BEFORE the measured run — the p99
+            # pin below must measure rebalance overhead, not
+            # first-compile spikes (seconds each on CPU)
+            warm_stop = threading.Event()
+
+            def warm_reader():
+                while not warm_stop.is_set():
+                    try:
+                        _post(s0.uri, "/index/i/query",
+                              {"query": "Count(Row(f=1))"})
+                    except Exception:  # noqa: BLE001 — warmup only
+                        pass
+                    time.sleep(0.02)
+
+            rt = threading.Thread(target=warm_reader, daemon=True)
+            rt.start()
+            for action in (
+                    {"add": {"id": "node3", "uri": extras[0].uri}},
+                    {"add": {"id": "node4", "uri": extras[1].uri}},
+                    {"removeId": "node3"}, {"removeId": "node4"}):
+                _post(s0.uri, "/cluster/resize", action)
+                assert _wait_settled(s0.uri, 60.0)
+            warm_stop.set()
+            rt.join(timeout=10)
+
+            # write oracle: a background thread keeps Set()ing known
+            # bits while the topology churns — convergence is checked
+            # bit-for-bit against exactly these
+            stop_writes = threading.Event()
+            write_errors: list = []
+
+            def oracle_writer():
+                k = 100
+                while not stop_writes.is_set():
+                    sh = k % 4
+                    col = sh * SHARD_WIDTH + 1000 + k
+                    try:
+                        _post(s0.uri, "/index/i/query",
+                              {"query": f"Set({col}, f=1)"})
+                        truth.add(col)
+                    except urllib.error.HTTPError as e:
+                        write_errors.append(
+                            (col, e.code, e.read().decode()[:500]))
+                    except Exception as e:  # noqa: BLE001
+                        write_errors.append((col, None, repr(e)))
+                    k += 1
+                    time.sleep(0.02)
+
+            wt = threading.Thread(target=oracle_writer, daemon=True)
+            wt.start()
+
+            sched = parse_scale_schedule(
+                f"0.5:add=node3={extras[0].uri};"
+                f"1.0:add=node4={extras[1].uri};"
+                f"2.0:remove=node3;"
+                f"2.5:remove=node4")
+            scale = _ScaleDriver(s0.uri, sched, settle_timeout=60.0)
+            report = run_load(
+                s0.uri, "i", qps=40.0, seconds=8.0,
+                query="Count(Row(f=1))",
+                mix={"query": 0.85, "ingest": 0.15},
+                ingest_field="lg", ingest_bits=8,
+                # keep ingest inside the 4 seeded shards: the default
+                # 1M-column space materializes 12 NEW shards mid-run,
+                # so every Count refans over unwarmed 16-shard fused
+                # shapes — an XLA compile storm (seconds each on CPU)
+                # that wedges the single-process cluster under load
+                ingest_cols=4 * SHARD_WIDTH,
+                scale=scale)
+            stop_writes.set()
+            wt.join(timeout=10)
+
+            # 1) zero failed queries: readers never saw a 405/refusal
+            assert report["errors"] == 0, report
+            assert report["ok"] == report["sent"], report
+            assert not write_errors, write_errors[:5]
+
+            # 2) the schedule actually ran: 4 actions, all applied and
+            # settled, and the rebalance counters moved
+            acts = report["scale"]["actions"]
+            assert len(acts) == 4, acts
+            assert all("response" in a and a["settled"]
+                       for a in acts), acts
+            reb = report["scale"]["rebalance"]
+            assert reb["rebalance_plans"] >= 4, reb
+            assert reb["rebalance_cutovers"] >= 1, reb
+            assert reb["rebalance_aborts"] == 0, reb
+
+            # 3) migration-window read latency bounded vs steady
+            # state.  The median carries the <=2x pin (with a small
+            # absolute floor so 2ms-vs-5ms localhost jitter cannot
+            # flake); the tail gets a bounded allowance on top: a
+            # cutover drops the shard's device stacks, so the next
+            # read over it pays one re-upload/re-JIT — a single such
+            # sample IS the p99 of a ~1s window at this qps.  A
+            # cluster-wide gate (the regression this pin exists for)
+            # still fails loudly: gated reads 405 (errors pin above)
+            # and the window's goodput collapses.
+            phases = report["scale"]["phases"]
+            steady = phases.get("steady", {})
+            steady_p50 = steady.get("p50_ms") or 0.0
+            steady_p99 = steady.get("p99_ms") or 0.0
+            mig = report["scale"]["migration"]
+            # reads DID overlap the migrations (a settle-then-measure
+            # test would vacuously pass every latency pin below)
+            assert mig["ok"] >= 10, mig
+            if mig["ok"] >= 20:  # percentiles need samples to mean it
+                # p50 floor sized for this suite's worst honest case:
+                # the whole cluster shares ONE Python process, so
+                # backfill streaming steals the GIL from concurrent
+                # reads — a few hundred ms of median inflation that
+                # would spread across machines in a real deployment.
+                # The floor only needs to catch second-scale
+                # serialization (a cluster-wide gate); sub-second
+                # medians under migration are environment noise here,
+                # not a product regression
+                assert mig["p50_ms"] <= max(2.0 * steady_p50,
+                                            steady_p50 + 600.0), \
+                    (mig, steady)
+                assert mig["p99_ms"] <= max(2.0 * steady_p99,
+                                            steady_p99 + 50.0,
+                                            2500.0), (mig, steady)
+            # no migration window may collapse: goodput stays up in
+            # every one (a cluster-wide gate would zero these out)
+            for label, ph in phases.items():
+                if label == "steady" or ph["ok"] < 20:
+                    continue
+                assert ph["goodput_qps"] >= 10.0, (label, ph)
+
+            # 4) back to the original 3 nodes everywhere, and every
+            # replica is bit-exact against the write oracle
+            for s in servers:
+                ids = sorted(n.id for n in s.cluster.sorted_nodes())
+                assert ids == ["node0", "node1", "node2"], \
+                    (s.name, ids)
+                assert s.cluster.shard_routes_snapshot() == {}
+            _assert_servers_bit_exact(servers, truth)
+            r = _post(s0.uri, "/index/i/query",
+                      {"query": "Count(Row(f=1))"})
+            assert r["results"] == [len(truth)]
+        finally:
+            for s in extras + servers[::-1]:
+                s.close()
+
+    def test_coordinator_kill_and_restart_resumes(self, tmp_path):
+        """Mid-migration coordinator death: close() halts WITHOUT
+        aborting, the cursor persists, and the restarted server's
+        open() resumes the plan from it — the cluster converges
+        instead of stranding half-gated."""
+        from pilosa_tpu.server.server import Server
+
+        data0 = str(tmp_path / "n0")
+        s0 = Server(data0, name="node0", replica_n=1)
+        s0.open()
+        s1 = Server(str(tmp_path / "n1"), name="node1", replica_n=1,
+                    seeds=[s0.uri])
+        s1.open()
+        s2 = Server(str(tmp_path / "n2"), name="node2", replica_n=1)
+        s2.open()
+        try:
+            _post(s0.uri, "/index/i")
+            _post(s0.uri, "/index/i/field/f")
+            truth = set()
+            for sh in range(4):
+                for k in range(3):
+                    col = sh * SHARD_WIDTH + k
+                    _post(s0.uri, "/index/i/query",
+                          {"query": f"Set({col}, f=1)"})
+                    truth.add(col)
+
+            # park the backfill: the transfer target's breaker is open
+            _rebalance.configure(backoff_base=0.2, backoff_cap=1.0)
+            for _ in range(20):
+                s0.cluster.note_peer_failure("node2")
+            c0 = _rebalance.counters()
+            resp = _post(s0.uri, "/cluster/resize",
+                         {"add": {"id": "node2", "uri": s2.uri}})
+            assert resp["started"] is True
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                c = _rebalance.counters()
+                if c["rebalance.backoffs"] > c0["rebalance.backoffs"]:
+                    break
+                time.sleep(0.05)
+            else:
+                pytest.fail("backfill never parked")
+            cursor = s0.node.rebalance.cursor_path
+
+            s0.close()  # the kill: halt without abort
+            assert os.path.exists(cursor)
+            # the survivors keep the OLD topology (serving owners
+            # unchanged, joiner still pending-only — not half-gated);
+            # with replica_n=1 the dead coordinator's shards are
+            # unavailable, and the read REFUSES (5xx) rather than
+            # serving a silent undercount from the pending copy
+            for r in s1.cluster.shard_routes_snapshot().values():
+                assert "node2" not in r["serving"]
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(s1.uri, "/index/i/query",
+                      {"query": "Count(Row(f=1))"})
+            assert ei.value.code >= 500
+
+            s0b = Server(data0, name="node0", replica_n=1)
+            s0b.open()  # resume() fires here (fresh breakers)
+            try:
+                assert _wait_settled(s0b.uri, 60.0)
+                c1 = _rebalance.counters()
+                assert c1["rebalance.resumes"] > c0["rebalance.resumes"]
+                for s in (s0b, s1, s2):
+                    ids = sorted(n.id for n in s.cluster.sorted_nodes())
+                    assert ids == ["node0", "node1", "node2"], \
+                        (s.name, ids)
+                    r = _post(s.uri, "/index/i/query",
+                              {"query": "Count(Row(f=1))"})
+                    assert r["results"] == [len(truth)], (s.name, r)
+                assert not os.path.exists(cursor)
+            finally:
+                s0b.close()
+        finally:
+            for s in (s2, s1):
+                s.close()
+            try:
+                s0.close()
+            except Exception:
+                pass
+
+
+class TestOfflineEscape:
+    def test_offline_mode_rides_legacy_node_join(self, tmp_path):
+        """mode=offline is the stop-the-world escape hatch: the exact
+        legacy node-join/RESIZING path, byte-identical — pinned so the
+        online tentpole cannot silently change it."""
+        from pilosa_tpu.server.server import Server
+
+        s0 = Server(str(tmp_path / "n0"), name="node0", replica_n=1)
+        s0.open()
+        s1 = Server(str(tmp_path / "n1"), name="node1", replica_n=1)
+        s1.open()
+        try:
+            _post(s0.uri, "/index/i")
+            _post(s0.uri, "/index/i/field/f")
+            _post(s0.uri, "/index/i/query", {"query": "Set(1, f=1)"})
+            resp = _post(s0.uri, "/cluster/resize", {
+                "mode": "offline",
+                "add": {"id": "node1", "uri": s1.uri}})
+            assert resp["mode"] == "offline" and resp["applied"]
+            # the legacy response shape: the node-join broadcast's
+            # status document came back verbatim
+            assert resp["response"]["ok"] is True
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if len(_get(s0.uri, "/status")["nodes"]) == 2:
+                    break
+                time.sleep(0.1)
+            assert len(_get(s0.uri, "/status")["nodes"]) == 2
+            r = _post(s0.uri, "/index/i/query",
+                      {"query": "Count(Row(f=1))"})
+            assert r["results"] == [1]
+        finally:
+            s1.close()
+            s0.close()
+
+    def test_resize_body_validation(self, tmp_path):
+        from pilosa_tpu.server.server import Server
+
+        s0 = Server(str(tmp_path / "n0"), name="node0")
+        s0.open()
+        try:
+            for bad in ({}, {"add": {"id": "x", "uri": ""},
+                             "removeId": "y"},
+                        {"mode": "sideways", "removeId": "y"}):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    _post(s0.uri, "/cluster/resize", bad)
+                assert ei.value.code == 400
+            # online remove of an unknown node is a 409 (RebalanceError
+            # -> ConflictError), not a silent no-op
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(s0.uri, "/cluster/resize", {"removeId": "ghost"})
+            assert ei.value.code == 409
+        finally:
+            s0.close()
+
+    def test_debug_rebalance_renders_idle(self, tmp_path):
+        from pilosa_tpu.server.server import Server
+
+        s0 = Server(str(tmp_path / "n0"), name="node0")
+        s0.open()
+        try:
+            doc = _get(s0.uri, "/debug/rebalance")
+            assert doc["active"] is False and doc["attached"] is True
+            assert "rebalance.plans" in doc["counters"]
+            # the rebalance_* family renders on /metrics (zeros on a
+            # clean server — alert-able before the first migration)
+            with urllib.request.urlopen(s0.uri + "/metrics",
+                                        timeout=10) as resp:
+                text = resp.read().decode()
+            assert "rebalance_plans" in text
+            assert "rebalance_shards_pending" in text
+            # strict-parse + at-least-one-sample under the prefix
+            # (the live-validation contract every family group has)
+            from tools import check_metrics
+            fams = check_metrics.check_families(
+                text, check_metrics.REBALANCE_FAMILIES)
+            assert set(fams) == {"rebalance_"}
+        finally:
+            s0.close()

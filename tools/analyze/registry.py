@@ -21,7 +21,8 @@ from dataclasses import dataclass, field
 #: marks a held-lock region for receiver ``<recv>``, and a bare
 #: ``with <name>:`` where <name> ends in ``_lock`` marks a module-level
 #: region.
-LOCK_ATTR_NAMES = ("_lock", "_global_lock", "_cfg_lock", "_graph_lock")
+LOCK_ATTR_NAMES = ("_lock", "_global_lock", "_cfg_lock", "_graph_lock",
+                   "_plan_lock", "_route_lock")
 
 
 @dataclass(frozen=True)
@@ -135,6 +136,18 @@ CLASS_LOCKS: dict[tuple, ClassLockRule] = {
             "_rewrite_locked": "callers hold self._lock",
         },
     ),
+    ("parallel/rebalance.py", "RebalanceCoordinator"): ClassLockRule(
+        lock="_plan_lock",
+        attrs=frozenset({"_plan", "_last"}),
+        # _abort_requested/_thread/_halt are deliberately
+        # UNREGISTERED: a bool flag checked once after the worker
+        # join, a thread handle, and an Event — single-writer
+        # signals, not shared mutable state
+    ),
+    ("parallel/cluster.py", "Cluster"): ClassLockRule(
+        lock="_route_lock",
+        attrs=frozenset({"_shard_routes"}),
+    ),
     ("serve/admission.py", "AdmissionController"): ClassLockRule(
         lock="_lock",
         # ``_gates`` itself is immutable after construction (the dict
@@ -241,6 +254,12 @@ MODULE_LOCKS: dict[str, tuple] = {
         ModuleGlobalRule("_global", "_global_lock", "w"),
     ),
     "parallel/hints.py": (
+        ModuleGlobalRule("_counters", "_lock", "rw"),
+        ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
+        ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
+    ),
+    "parallel/rebalance.py": (
         ModuleGlobalRule("_counters", "_lock", "rw"),
         ModuleGlobalRule("_cfg", "_cfg_lock", "w", attrs=True),
         ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
@@ -439,6 +458,20 @@ CONFIG_GUARDS = (
         pair=("release",),
         owner_suffixes=("parallel/hints.py",),
         what="the refcounted [replication] baseline",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("rebalance.configure", "_rebalance.configure",
+                          "_rebalance1.configure"),
+        pair=("retain", "release"),
+        owner_suffixes=("parallel/rebalance.py",),
+        what="the process-wide [rebalance] runtime config",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("rebalance.retain", "_rebalance.retain",
+                          "_rebalance1.retain"),
+        pair=("release",),
+        owner_suffixes=("parallel/rebalance.py",),
+        what="the refcounted [rebalance] baseline",
     ),
     ConfigGuardRule(
         mutator_suffixes=("tenant.configure", "_tenant.configure",

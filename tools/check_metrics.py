@@ -282,6 +282,11 @@ TIER_FAMILIES = _mf.live_prefixes("tier")
 #: replay health), rendered as ae_* / hint_* / wal_*.
 REPL_FAMILIES = _mf.live_prefixes("repl")
 
+#: Online shard-migration families (parallel/rebalance.py
+#: publish_gauges at scrape), rendered as rebalance_* — published
+#: (zeros) even on a node that never ran a plan.
+REBALANCE_FAMILIES = _mf.live_prefixes("rebalance")
+
 #: Per-tenant isolation families (serve/tenant.publish_gauges),
 #: rendered as tenant_* — published (zeros) even with [tenants] off.
 TENANT_FAMILIES = _mf.live_prefixes("tenant")

@@ -373,6 +373,145 @@ class _ChaosDriver:
             return "fault" if self._fault_now else "clear"
 
 
+def parse_scale_schedule(spec: str) -> list[tuple[float, dict, str]]:
+    """``t:action[;t:action...]`` -> [(offset_s, resize_body, label)].
+    Actions: ``add=<id>=<uri>`` (join a running node) and
+    ``remove=<id>`` — e.g.
+    ``"2:add=n4=http://127.0.0.1:10104;8:remove=n4"``.  Entries are
+    ``;``-separated because URIs carry ``,``-adjacent characters;
+    offsets are seconds from run start and must be ascending."""
+    out: list[tuple[float, dict, str]] = []
+    last = -1.0
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        t_txt, _, action = part.partition(":")
+        try:
+            t = float(t_txt)
+        except ValueError:
+            raise ValueError(f"bad --scale-schedule offset in {part!r}")
+        if t < last:
+            raise ValueError("--scale-schedule offsets must ascend")
+        last = t
+        if action.startswith("add="):
+            bits = action[len("add="):].split("=", 1)
+            if len(bits) != 2 or not bits[0] or not bits[1]:
+                raise ValueError(
+                    f"bad add action {action!r} (add=<id>=<uri>)")
+            out.append((t, {"add": {"id": bits[0], "uri": bits[1]}},
+                        f"add:{bits[0]}"))
+        elif action.startswith("remove="):
+            nid = action[len("remove="):]
+            if not nid:
+                raise ValueError(
+                    f"bad remove action {action!r} (remove=<id>)")
+            out.append((t, {"removeId": nid}, f"remove:{nid}"))
+        else:
+            raise ValueError(f"unknown scale action {action!r}")
+    if not out:
+        raise ValueError("empty --scale-schedule")
+    return out
+
+
+#: rebalance.* gauges the scale-schedule report deltas over the run —
+#: the migration-cost evidence next to the per-phase latency numbers.
+_REBALANCE_VARS = (
+    "rebalance.plans", "rebalance.cutovers", "rebalance.bytes_streamed",
+    "rebalance.dual_writes", "rebalance.aborts", "rebalance.resumes",
+    "rebalance.backoffs", "rebalance.transfer_failures",
+)
+
+
+class _ScaleDriver:
+    """Timed node add/remove against the online-resize control route
+    (``--scale-schedule``): a background thread POSTs each scheduled
+    action to the coordinator's ``/cluster/resize``, then polls
+    ``/debug/rebalance`` until the migration settles before relabeling
+    traffic ``steady``.  Requests are labeled by FIRE time with the
+    active phase (``steady`` / ``add:<id>`` / ``remove:<id>``) so the
+    report separates goodput/p50/p99 during each migration window from
+    steady state — the read-p99-under-rebalance acceptance number."""
+
+    def __init__(self, host: str, schedule: list, poll_s: float = 0.2,
+                 settle_timeout: float = 120.0):
+        self.host = host
+        self.schedule = schedule
+        self.poll_s = poll_s
+        self.settle_timeout = settle_timeout
+        self.actions: list[dict] = []
+        self.durations: dict[str, float] = {}
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._label = "steady"
+        self._label_t0 = time.perf_counter()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _set_label(self, label: str) -> None:
+        now = time.perf_counter()
+        with self._lock:
+            self.durations[self._label] = (
+                self.durations.get(self._label, 0.0)
+                + (now - self._label_t0))
+            self._label = label
+            self._label_t0 = now
+
+    def _resize(self, body: dict) -> dict:
+        req = urllib.request.Request(
+            f"{self.host}/cluster/resize",
+            data=json.dumps(body).encode(), method="POST")
+        req.add_header("Content-Type", "application/json")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def _rebalance_active(self) -> bool:
+        try:
+            with urllib.request.urlopen(
+                    f"{self.host}/debug/rebalance", timeout=5) as resp:
+                return bool(json.loads(resp.read()).get("active"))
+        except Exception:
+            return False  # unreachable debug surface: don't spin
+
+    def _wait_settled(self) -> bool:
+        deadline = time.perf_counter() + self.settle_timeout
+        while time.perf_counter() < deadline:
+            if not self._rebalance_active():
+                return True
+            if self._stop.wait(self.poll_s):
+                return False
+        return False
+
+    def _run(self) -> None:
+        start = time.perf_counter()
+        for offset, body, label in self.schedule:
+            delay = start + offset - time.perf_counter()
+            if delay > 0 and self._stop.wait(delay):
+                break
+            if self._stop.is_set():
+                break
+            self._set_label(label)
+            entry = {"offset": offset, "label": label}
+            try:
+                entry["response"] = self._resize(body)
+                entry["settled"] = self._wait_settled()
+            except Exception as e:
+                entry["error"] = repr(e)
+            self.actions.append(entry)
+            self._set_label("steady")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=self.settle_timeout + 30)
+        self._set_label(self.label())  # flush the final window
+
+    def label(self) -> str:
+        with self._lock:
+            return self._label
+
+
 def run_load(host: str, index: str, qps: float, seconds: float,
              query: str = "Count(Row(f=1))",
              mix: dict[str, float] | None = None,
@@ -385,7 +524,8 @@ def run_load(host: str, index: str, qps: float, seconds: float,
              sparsity_mix: dict[str, int] | None = None,
              sparsity_field: str = "f",
              chaos: "_ChaosDriver | None" = None,
-             tenant_mix: list | None = None) -> dict:
+             tenant_mix: list | None = None,
+             scale: "_ScaleDriver | None" = None) -> dict:
     """Drive ``host`` open-loop at ``qps`` for ``seconds``; returns the
     report dict.  ``mix`` maps class -> weight; ``deadline_s`` is a
     (lo, hi) uniform range for the per-request deadline header (None =
@@ -470,7 +610,10 @@ def run_load(host: str, index: str, qps: float, seconds: float,
             elif delay < -0.05:
                 with late_lock:
                     late[0] += 1
-            if chaos is not None and bucket is None:
+            if scale is not None and bucket is None:
+                # label by FIRE time: which rebalance phase is running
+                bucket = scale.label()
+            elif chaos is not None and bucket is None:
                 # label by FIRE time: is a fault window armed right now
                 bucket = chaos.label()
             _fire(req, timeout, stats, klass, bits, bucket,
@@ -486,8 +629,12 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     hint0 = {n_: _vars_counter(host, n_)
              for n_ in ("hint.queued", "hint.replayed", "hint.dropped",
                         "ae.reconciled")}
+    reb0 = ({n_: _vars_counter(host, n_) for n_ in _REBALANCE_VARS}
+            if scale is not None else None)
     if chaos is not None:
         chaos.start()
+    if scale is not None:
+        scale.start()
     workers = [threading.Thread(target=worker, daemon=True)
                for _ in range(pool)]
     for w in workers:
@@ -520,6 +667,10 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     elapsed = time.perf_counter() - start
     if chaos is not None:
         chaos.stop()
+    if scale is not None:
+        scale.stop()
+    reb1 = ({n_: _vars_counter(host, n_) for n_ in _REBALANCE_VARS}
+            if scale is not None else None)
     cache1 = _cache_counters(host)
     disp1 = _vars_counter(host, "coalescer.dispatches")
     hedge1 = _vars_counter(host, "hedge.issued")
@@ -630,6 +781,63 @@ def run_load(host: str, index: str, qps: float, seconds: float,
                     stats.tenant_latencies.get(t, [])), 0.99) * 1e3, 2),
             }
             for t in sorted({t_ for t_, _, _ in tenant_mix})
+        }),
+        # --scale-schedule view: each control action's outcome, the
+        # server's rebalance.* counter deltas over the run, and
+        # per-phase goodput/p50/p99 — migration windows (add:<id> /
+        # remove:<id>) vs steady state, the read-p99-under-rebalance
+        # acceptance evidence
+        "scale": (None if scale is None else {
+            "actions": scale.actions,
+            "rebalance": {
+                n_.replace(".", "_"): (
+                    None if reb1[n_] is None
+                    else reb1[n_] - (reb0[n_] or 0.0))
+                for n_ in _REBALANCE_VARS
+            },
+            "phases": {
+                label: {
+                    **stats.bucket_outcomes.get(
+                        label, {"ok": 0, "shed": 0, "expired": 0,
+                                "error": 0}),
+                    "seconds": round(
+                        scale.durations.get(label, 0.0), 3),
+                    "goodput_qps": round(
+                        stats.bucket_outcomes.get(label, {}).get(
+                            "ok", 0)
+                        / max(0.001, scale.durations.get(label, 0.0)),
+                        2),
+                    "p50_ms": round(_percentile(sorted(
+                        stats.bucket_latencies.get(label, [])),
+                        0.50) * 1e3, 2),
+                    "p99_ms": round(_percentile(sorted(
+                        stats.bucket_latencies.get(label, [])),
+                        0.99) * 1e3, 2),
+                }
+                for label in sorted(
+                    set(scale.durations)
+                    | set(stats.bucket_outcomes) | {"steady"})
+            },
+            # every migration window POOLED: per-window percentiles
+            # over a sub-second window are one-outlier-dominated, the
+            # pooled view is the statistically usable latency evidence
+            "migration": {
+                "ok": sum(
+                    oc.get("ok", 0)
+                    for label, oc in stats.bucket_outcomes.items()
+                    if label != "steady"),
+                "seconds": round(sum(
+                    s for label, s in scale.durations.items()
+                    if label != "steady"), 3),
+                "p50_ms": round(_percentile(sorted(
+                    lat for label, ls in stats.bucket_latencies.items()
+                    if label != "steady" for lat in ls),
+                    0.50) * 1e3, 2),
+                "p99_ms": round(_percentile(sorted(
+                    lat for label, ls in stats.bucket_latencies.items()
+                    if label != "steady" for lat in ls),
+                    0.99) * 1e3, 2),
+            },
         }),
         # sparsity-mix view: per-bucket read latency percentiles
         "sparsity": (None if buckets is None else {
@@ -930,6 +1138,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--chaos-hosts", default=None,
                    help="comma-separated extra hosts to arm (default: "
                         "--host only)")
+    p.add_argument("--scale-schedule", default=None,
+                   help="timed node add/remove against the online "
+                        "resize control route while traffic flows "
+                        "(e.g. '2:add=n4=http://127.0.0.1:10104;"
+                        "8:remove=n4'); the report adds per-phase "
+                        "goodput/p50/p99 and the server's rebalance_* "
+                        "counter deltas")
+    p.add_argument("--scale-settle-timeout", type=float, default=120.0,
+                   help="seconds to wait for each migration to settle "
+                        "(/debug/rebalance active=false) before the "
+                        "next phase")
     p.add_argument("--tenant-mix", default=None,
                    help="tenant:weight[:class][,tenant:weight...] — "
                         "draw each arrival from a weighted tenant "
@@ -969,9 +1188,15 @@ def main(argv: list[str] | None = None) -> int:
         chaos = _ChaosDriver(hosts, args.chaos,
                              period_s=args.chaos_period,
                              duty=args.chaos_duty)
+    scale = None
+    if args.scale_schedule:
+        scale = _ScaleDriver(
+            args.host.rstrip("/"),
+            parse_scale_schedule(args.scale_schedule),
+            settle_timeout=args.scale_settle_timeout)
     report = run_load(args.host.rstrip("/"), args.index, args.qps,
                       args.seconds, query=args.query, mix=mix,
-                      chaos=chaos,
+                      chaos=chaos, scale=scale,
                       deadline_s=deadline_s, timeout=args.timeout,
                       ingest_field=args.ingest_field,
                       ingest_bits=args.ingest_bits,
