@@ -1829,6 +1829,52 @@ def bench_faultinject() -> dict:
     }
 
 
+def bench_traceasm() -> dict:
+    """Disarmed event-journal A/B (the autopsy round's <1% budget,
+    same discipline as extras.faultinject): the per-site disarmed
+    cost is one module-bool read, measured against an empty-body
+    baseline loop and expressed against the ~20 us dispatch floor.
+    The armed-emit cost (lock + ring append) is reported for context
+    — it is paid only at state transitions (breaker flips, hedge
+    fires), never per query on the coalesced Count path."""
+    import time
+
+    from pilosa_tpu import observe as obs
+
+    n = 200000
+
+    def loop(body) -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            body()
+        return (time.perf_counter() - t0) / n * 1e9  # ns/op
+
+    def gated():
+        if obs.journal_on:
+            pass
+
+    obs.retain()
+    try:
+        obs.configure(enabled=False)
+        base_ns = loop(lambda: None)
+        off_ns = loop(gated)
+        obs.configure(enabled=True)
+        emit_ns = loop(lambda: obs.emit("bench.tick"))
+    finally:
+        obs.release()  # restores the pre-bench journal baseline
+        obs.reset_journal()
+    gate_ns = max(0.0, off_ns - base_ns)
+    return {
+        "disarmed_gate_ns": round(gate_ns, 2),
+        "armed_emit_ns": round(max(0.0, emit_ns - base_ns), 2),
+        # share of the 20 us trivial-dispatch floor — the budget the
+        # acceptance criterion pins (<1% on the coalesced Count path)
+        "disarmed_pct_of_dispatch_floor": round(
+            gate_ns / 20_000 * 100.0, 4),
+        "budget_pct": 1.0,
+    }
+
+
 def main():
     import os
 
@@ -1875,6 +1921,7 @@ def main():
     if vmab is not None:
         extras["vm"] = vmab
     extras["faultinject"] = bench_faultinject()
+    extras["traceasm"] = bench_traceasm()
     extras["tenants"] = bench_tenants(co)
     msh = bench_mesh()
     if msh is not None:

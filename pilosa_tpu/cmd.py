@@ -426,6 +426,9 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         observe_fanin_timeout=cfg.observe.fanin_timeout,
         observe_device_peak_gbps=cfg.observe.device_peak_gbps,
         observe_profiler_max_seconds=cfg.observe.profiler_max_seconds,
+        observe_journal=cfg.observe.journal,
+        observe_journal_size=cfg.observe.journal_size,
+        observe_journal_kinds=cfg.observe.journal_kinds,
         cost_shadow=cfg.cost.shadow,
         admission_enabled=cfg.admission.enabled,
         admission_query_cap=cfg.admission.query_cap,

@@ -248,7 +248,15 @@ class ObserveConfig:
     ``profiler_max_seconds`` auto-stops an on-demand device profiler
     capture (``POST /debug/profiler/start``) that was never stopped
     (0 disables the deadline — captures then run until the explicit
-    stop)."""
+    stop).
+
+    Cluster event journal (pilosa_tpu.observe.EventJournal):
+    ``journal`` keeps the structured state-transition ring behind
+    ``GET /debug/events`` on (disarmed cost is one module-bool read,
+    benchmarked in bench.py extras.traceasm); ``journal_size`` is the
+    ring depth; ``journal_kinds`` is a comma-separated kind-prefix
+    allowlist (empty = keep every kind) — filtered emissions tick the
+    drop counter so a too-narrow filter is visible."""
 
     enabled: bool = True
     recent: int = 256
@@ -257,6 +265,9 @@ class ObserveConfig:
     fanin_timeout: float = 2.0  # seconds per peer in /debug/cluster/*
     device_peak_gbps: float = 0.0  # GB/s roof; 0 = per-device default
     profiler_max_seconds: float = 30.0  # capture auto-stop; 0 = never
+    journal: bool = True  # the cluster event journal ring
+    journal_size: int = 2048  # event ring depth
+    journal_kinds: str = ""  # comma-separated kind prefixes; "" = all
 
 
 @dataclass
@@ -661,6 +672,9 @@ class Config:
             f"device-peak-gbps = {self.observe.device_peak_gbps}",
             f"profiler-max-seconds = "
             f"{self.observe.profiler_max_seconds}",
+            f"journal = {str(self.observe.journal).lower()}",
+            f"journal-size = {self.observe.journal_size}",
+            f'journal-kinds = "{self.observe.journal_kinds}"',
             "",
             "[cost]",
             f"shadow = {str(self.cost.shadow).lower()}",

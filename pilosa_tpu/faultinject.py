@@ -226,6 +226,7 @@ def arm(spec: str) -> list[str]:
     with _lock:
         _points.update(parsed)
         armed = bool(_points)
+    _journal("failpoint.arm", points=sorted(parsed))
     return sorted(parsed)
 
 
@@ -238,6 +239,19 @@ def disarm(name: str | None = None) -> None:
         else:
             _points.pop(name, None)
         armed = bool(_points)
+    _journal("failpoint.disarm",
+             points=[name] if name is not None else [])
+
+
+def _journal(kind: str, **fields) -> None:
+    """Arming/disarming chaos is exactly the state change a merged
+    cluster timeline must show next to the failures it caused.  Lazy
+    import (observe is a higher layer) and AFTER ``_lock`` is released
+    — the journal takes its own lock."""
+    from pilosa_tpu import observe as _observe
+
+    if _observe.journal_on:
+        _observe.emit(kind, **fields)
 
 
 def hit(name: str) -> None:

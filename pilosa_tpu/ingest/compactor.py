@@ -165,6 +165,12 @@ class Compactor:
                             self.forget(frag)
                 else:
                     flushed += 1
+        if flushed:
+            from pilosa_tpu import observe as _observe
+
+            if _observe.journal_on:
+                _observe.emit("compaction.run", flushed=flushed,
+                              forced=bool(force))
         return flushed
 
     # ------------------------------------------------------------- thread

@@ -181,6 +181,20 @@ FAMILIES: tuple[Family, ...] = (
            "(serve/tenant.py; zeros while [tenants] is off)",
            live_prefixes=("tenant_",), group="tenant",
            doc="administration.md"),
+    Family("event", "event_",
+           "cluster event journal: structured state-transition events "
+           "(breaker/hedge/rebalance/AE/compaction/residency/"
+           "failpoint), ring depth and drop accounting "
+           "(pilosa_tpu.observe.EventJournal)",
+           live_prefixes=("event_",), group="trace",
+           doc="administration.md"),
+    Family("trace", "trace_",
+           "cross-node trace assembly: /debug/trace/{id} trees "
+           "assembled, per-node record fan-ins, fan-in errors, "
+           "origin-less assemblies (pilosa_tpu.traceasm + "
+           "server/handler.py)",
+           live_prefixes=("trace_",), group="trace",
+           doc="administration.md"),
     Family("http", "http_",
            "per-route request counters (server/handler.py)"),
     Family("gc", "gc_",

@@ -41,6 +41,8 @@ import threading
 import time
 from collections import Counter, deque
 
+from pilosa_tpu import lockcheck as _lockcheck
+from pilosa_tpu import tracing as _tracing
 from pilosa_tpu.serve.deadline import tls_scope as _tls_scope
 
 _tls = threading.local()  # .rec: active QueryRecord; .last: last published
@@ -189,8 +191,8 @@ class QueryRecord:
         "launches", "path", "coalesce", "result_sizes", "error", "slow",
         "admission", "outcome", "compiles", "cached", "cache_key",
         "delta_notes", "compacted", "hedged", "hedge_wins",
-        "missing_shards", "tier_notes", "tenant", "engine",
-        "would_choose",
+        "hedge_losers", "missing_shards", "tier_notes", "tenant",
+        "engine", "would_choose", "remote",
     )
 
     def __init__(self, qid: int, index: str, pql: str,
@@ -263,7 +265,17 @@ class QueryRecord:
         # it).  All touched only by the origin map thread.
         self.hedged = 0
         self.hedge_wins = 0
+        # the LOSING side of each settled hedge race: (node, ns the
+        # abandoned flight had been in the air when the race committed)
+        # — cross-node trace assembly shows the loser's spans too, so
+        # "we paid for two flights" is visible on the origin record.
+        # List appends from the map loop thread only.
+        self.hedge_losers: list[tuple[str, int]] = []
         self.missing_shards: list[int] = []
+        # True for remote sub-executions (ExecOptions.remote): the
+        # trace assembler tells origin records from per-node remote
+        # map records by this flag when both share a trace id
+        self.remote = False
         # the request's tenant id ([tenants] isolation; None for
         # anonymous/default-tier traffic) — stamped by the executor
         # from ExecOptions.tenant, rendered on /debug/queries and the
@@ -396,6 +408,11 @@ class QueryRecord:
         if self.hedged:
             d["hedged"] = self.hedged
             d["hedgeWins"] = self.hedge_wins
+        if self.hedge_losers:
+            d["hedgeLosers"] = [{"node": n, "ms": round(ns / ms, 3)}
+                                for n, ns in self.hedge_losers]
+        if self.remote:
+            d["remote"] = True
         if self.missing_shards:
             d["missingShards"] = sorted(self.missing_shards)
         # tiered-residency attribution: present only when the query
@@ -449,6 +466,10 @@ class QueryRecord:
                 "launchMs": round(c["launch_ns"] / ms, 3),
                 "leader": c.get("leader", True),
             }
+            if c.get("launch_trace"):
+                # a follower names the batch leader's trace — the
+                # span that owns the shared device launch
+                d["coalescer"]["launchTrace"] = c["launch_trace"]
         if self.error is not None:
             d["error"] = self.error
         if self.slow:
@@ -496,16 +517,21 @@ class FlightRecorder:
     def record_shed(self, index: str, pql: str, klass: str,
                     outcome: str, reason: str,
                     wait_ns: int = 0,
-                    tenant: str | None = None) -> None:
+                    tenant: str | None = None,
+                    trace_id: str | None = None) -> None:
         """A request refused at the admission gate never executes, so
         no record is begun for it — synthesize one straight into the
         ring buffer (outcome ``shed``/``expired``) so /debug/queries
         and the slow-query log tell the overload story, and skip the
         latency histogram (a refusal's sub-millisecond turnaround
-        would drag the admitted-query percentiles down)."""
+        would drag the admitted-query percentiles down).  ``trace_id``
+        (extracted from the refused request's traceparent — the shed
+        happens before any span opens) links the refusal to the
+        client's trace: a logged shed is one /debug/trace/{id} away."""
         if not self.enabled:
             return
-        rec = QueryRecord(next(self._seq), index, pql)
+        rec = QueryRecord(next(self._seq), index, pql,
+                          trace_id=trace_id)
         rec.admission = {"class": klass, "queue_wait_ns": wait_ns}
         rec.tenant = tenant
         rec.outcome = outcome
@@ -526,9 +552,10 @@ class FlightRecorder:
             # shed events ride the slow-query log: overload must be
             # diagnosable from the same place slow queries are
             self.logger.printf(
-                "%s query (class=%s, waited %.1fms) on %s: %s"
+                "%s query (class=%s, waited %.1fms, trace=%s) on %s: %s"
                 "%s",
-                outcome, klass, wait_ns / 1e6, index or "-", reason,
+                outcome, klass, wait_ns / 1e6, rec.trace_id,
+                index or "-", reason,
                 f" (+{suppressed} more shed in the last second)"
                 if suppressed else "")
 
@@ -577,3 +604,234 @@ class FlightRecorder:
     def recent_records(self) -> list[QueryRecord]:
         with self._lock:
             return list(self._recent)
+
+    def records_for_trace(self, trace_id: str) -> list[QueryRecord]:
+        """Every record (in-flight AND recent) linked to ``trace_id``
+        — the per-node section of cross-node trace assembly.  Active
+        records matter: the hedge LOSER's remote execution may still
+        be running on its node when the origin assembles the tree.
+        Matching is on normalized ids (records may carry the 20-hex
+        self-generated fallback; headers zero-pad to 32)."""
+        want = _tracing.normalize_trace_id(trace_id)
+        with self._lock:
+            recs = list(self._active.values()) + list(self._recent)
+        return [r for r in recs
+                if _tracing.normalize_trace_id(r.trace_id) == want]
+
+
+# --------------------------------------------------------------------
+# cluster event journal
+# --------------------------------------------------------------------
+
+
+class EventJournal:
+    """Process-wide ring of structured events at the state transitions
+    that previously only ticked counters — breaker open/close, hedge
+    fired/won, rebalance shard transitions, AE round lifecycle,
+    compaction runs, OOM evict-and-retry, residency demote/promote,
+    failpoint arm/disarm, config baseline changes.  Each event is
+    stamped with a monotonically increasing ``seq``, wall + monotonic
+    time, the node id, and the active trace id when one is in scope —
+    so a trace view can answer "p99 spiked because node2's breaker
+    opened mid-backfill".
+
+    Exposure: ``GET /debug/events`` per node (``?since=``/``?kind=``)
+    plus the fanned-in ``GET /debug/cluster/events`` merged timeline.
+
+    Lock discipline: one short lock per emit (append + counter tick);
+    NEVER emit while holding another subsystem's lock — every
+    emission site releases its own lock first (the breaker/faultinject
+    discipline).  Disarmed cost (``journal_on`` false) is one module
+    bool read at each site, the faultinject gate shape."""
+
+    def __init__(self, size: int = 2048, node_id: str = "",
+                 kinds: frozenset | None = None):
+        self._lock = _lockcheck.lock("eventjournal")
+        self._ring: deque[dict] = deque(maxlen=max(1, int(size)))
+        self._seq = 0
+        self._by_kind: Counter = Counter()
+        self._dropped = 0
+        self.node_id = node_id
+        # empty/None = every kind; a non-empty set filters at emit
+        # (the dropped counter keeps the suppression visible)
+        self.kinds = frozenset(kinds) if kinds else frozenset()
+
+    def emit(self, kind: str, trace_id: str | None = None,
+             **fields) -> None:
+        ev = {"t": time.time(), "mono": time.perf_counter_ns(),
+              "kind": kind}
+        if trace_id:
+            ev["traceId"] = _tracing.normalize_trace_id(trace_id)
+        if fields:
+            ev.update(fields)
+        with self._lock:
+            # prefix allowlist, same contract as the events() filter:
+            # kinds={"breaker"} keeps breaker.open AND breaker.close
+            if self.kinds and not any(kind.startswith(k)
+                                      for k in self.kinds):
+                self._dropped += 1
+                return
+            self._seq += 1
+            ev["seq"] = self._seq
+            ev["node"] = self.node_id
+            self._ring.append(ev)
+            self._by_kind[kind] += 1
+
+    def events(self, since: int = 0, kind: str | None = None,
+               trace_id: str | None = None,
+               limit: int = 512) -> list[dict]:
+        """Ring contents, oldest first.  ``since`` keeps events with
+        seq strictly greater (the incremental-poll cursor); ``kind``
+        is a prefix match (``kind=breaker`` covers breaker.open /
+        breaker.close); ``trace_id`` keeps events stamped with that
+        trace; ``limit`` keeps the NEWEST matches."""
+        want = (_tracing.normalize_trace_id(trace_id)
+                if trace_id else None)
+        with self._lock:
+            evs = list(self._ring)
+        out = [e for e in evs
+               if e["seq"] > since
+               and (kind is None or e["kind"].startswith(kind))
+               and (want is None or e.get("traceId") == want)]
+        return out[-max(0, int(limit)):]
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"total": self._seq, "dropped": self._dropped,
+                    "depth": len(self._ring),
+                    "kinds": dict(self._by_kind)}
+
+
+#: The one-word fast gate every emission site reads FIRST:
+#: ``if observe.journal_on: observe.emit(kind, ...)`` — the
+#: faultinject ``armed`` discipline, so the disarmed journal costs
+#: one module-bool read on the hot path.
+journal_on = True
+_journal = EventJournal()
+_cfg_lock = threading.Lock()
+_baseline: tuple | None = None
+_refs = 0
+
+
+def journal() -> EventJournal:
+    return _journal
+
+
+def emit(kind: str, trace_id: str | None = None, **fields) -> None:
+    """Emit one journal event.  ``trace_id=None`` auto-captures the
+    thread's active trace id (``tracing.active_trace_id``) so events
+    emitted inside a traced request link the trace for free."""
+    if not journal_on:
+        return
+    if trace_id is None:
+        trace_id = _tracing.active_trace_id()
+    _journal.emit(kind, trace_id=trace_id, **fields)
+
+
+def configure(node_id: str | None = None, size: int | None = None,
+              kinds: str | None = None,
+              enabled: bool | None = None) -> EventJournal:
+    """Apply explicit journal settings in place (None leaves a knob
+    alone).  ``kinds`` is a comma-separated prefix list ("" = every
+    kind).  Emits a ``config.applied`` event — config baseline
+    changes are themselves journal-worthy state transitions."""
+    global journal_on, _journal
+    with _cfg_lock:
+        j = _journal
+        if size is not None and int(size) != j._ring.maxlen:
+            nj = EventJournal(size=int(size), node_id=j.node_id,
+                              kinds=j.kinds)
+            with j._lock:
+                nj._seq = j._seq
+                nj._by_kind = j._by_kind
+                nj._dropped = j._dropped
+                for ev in j._ring:
+                    nj._ring.append(ev)
+            _journal = j = nj
+        if node_id is not None:
+            j.node_id = node_id
+        if kinds is not None:
+            j.kinds = frozenset(
+                k.strip() for k in kinds.split(",") if k.strip())
+        if enabled is not None:
+            journal_on = bool(enabled)
+    if journal_on:
+        emit("config.applied", section="observe.journal",
+             node=node_id or _journal.node_id)
+    return _journal
+
+
+def retain() -> None:
+    """First retain captures the pre-server journal baseline (the
+    hints/perfobs P5 refcount idiom)."""
+    global _refs, _baseline
+    with _cfg_lock:
+        if _refs == 0 and _baseline is None:
+            _baseline = (journal_on, _journal.node_id, _journal.kinds)
+        _refs += 1
+
+
+def release() -> None:
+    """Last release restores the baseline for library users."""
+    global _refs, _baseline, journal_on
+    restored = False
+    with _cfg_lock:
+        if _refs > 0:
+            _refs -= 1
+        if _refs == 0 and _baseline is not None:
+            on, node_id, kinds = _baseline
+            journal_on = on
+            _journal.node_id = node_id
+            _journal.kinds = kinds
+            _baseline = None
+            restored = True
+    if restored and journal_on:
+        emit("config.restored", section="observe.journal")
+
+
+def reset_journal() -> EventJournal:
+    """Test hook: a fresh default journal, no baseline, zero refs."""
+    global _journal, _baseline, _refs, journal_on
+    with _cfg_lock:
+        _journal = EventJournal()
+        _baseline = None
+        _refs = 0
+        journal_on = True
+    return _journal
+
+
+# trace-assembly counters (pilosa_tpu.traceasm ticks these): rendered
+# as the trace_* gauge family next to the journal's event_* family
+_trace_lock = _lockcheck.lock("trace-counters")
+_trace_counters = {
+    "trace.assemblies": 0,   # /debug/trace/{id} trees assembled
+    "trace.fanins": 0,       # peer record fetches issued
+    "trace.errors": 0,       # peers that failed/timed out in a fan-in
+    "trace.orphans": 0,      # assemblies that found no origin record
+}
+
+
+def bump_trace(name: str, value: int = 1) -> None:
+    with _trace_lock:
+        _trace_counters[name] += value
+
+
+def trace_counters() -> dict:
+    with _trace_lock:
+        return dict(_trace_counters)
+
+
+def publish_journal_gauges(stats) -> None:
+    """event.* + trace.* gauge families for /metrics and /debug/vars —
+    published unconditionally (zeros on a clean server) so both
+    families are scrape-visible before the first event or assembly."""
+    c = _journal.counters()
+    stats.gauge("event.total", c["total"])
+    stats.gauge("event.dropped", c["dropped"])
+    stats.gauge("event.depth", c["depth"])
+    stats.gauge("event.kinds", len(c["kinds"]))
+    stats.gauge("trace.assemblies",
+                trace_counters()["trace.assemblies"])
+    stats.gauge("trace.fanins", trace_counters()["trace.fanins"])
+    stats.gauge("trace.errors", trace_counters()["trace.errors"])
+    stats.gauge("trace.orphans", trace_counters()["trace.orphans"])

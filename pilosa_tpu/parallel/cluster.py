@@ -195,13 +195,20 @@ def fan_in(nodes: list, fetch, timeout: float) -> tuple[dict, dict]:
     its own section, never the operator's merged view."""
     import time
 
+    from pilosa_tpu import tracing as _tracing
+
     results: dict = {}
     errors: dict = {}
     lock = threading.Lock()
+    # fan-in worker threads re-attach the caller's trace so the peer
+    # fetches carry traceparent (a /debug/trace fan-in is itself part
+    # of the trace's causal record)
+    tid = _tracing.active_trace_id()
 
     def run(node):
         try:
-            out = fetch(node)
+            with _tracing.propagate(tid):
+                out = fetch(node)
             with lock:
                 results[node.id] = out
         except Exception as e:  # noqa: BLE001 — per-node best effort
@@ -430,6 +437,7 @@ class CircuitBreaker:
         self.threshold = max(1, int(threshold))
         self.cooldown_s = float(cooldown_s)
         self.clock = clock
+        self.peer = ""  # stamped by Cluster.breaker for journal events
         self._lock = _lockcheck.lock("breaker")
         self._state = BREAKER_CLOSED
         self._failures = 0      # consecutive failures while CLOSED
@@ -442,11 +450,21 @@ class CircuitBreaker:
         self.half_opens = 0
         self.fast_fails = 0
 
+    def _journal(self, kind: str) -> None:
+        """Journal a state transition.  Called AFTER ``self._lock`` is
+        released — the journal takes its own lock and an emission site
+        must never nest it under a subsystem lock."""
+        from pilosa_tpu import observe as _observe
+
+        if _observe.journal_on:
+            _observe.emit(kind, peer=self.peer)
+
     def allow(self) -> bool:
         """True when a request may be sent to this peer.  While OPEN,
         the first call past the cooldown flips to HALF_OPEN and is
         admitted as the trial; concurrent calls during the trial keep
         fast-failing."""
+        ev = None
         with self._lock:
             if self._state == BREAKER_CLOSED:
                 return True
@@ -456,31 +474,41 @@ class CircuitBreaker:
                     self._probing = True
                     self._probe_t = self.clock()
                     self.half_opens += 1
-                    return True
-                self.fast_fails += 1
-                return False
+                    ev, out = "breaker.half_open", True
+                else:
+                    self.fast_fails += 1
+                    out = False
             # HALF_OPEN: one trial at a time — but a trial whose
             # outcome never arrived (caller crashed before noting)
             # must not wedge the breaker refusing forever: after one
             # more cooldown, admit a fresh trial
-            if (not self._probing
+            elif (not self._probing
                     or self.clock() - self._probe_t >= self.cooldown_s):
                 self._probing = True
                 self._probe_t = self.clock()
                 self.half_opens += 1
-                return True
-            self.fast_fails += 1
-            return False
+                ev, out = "breaker.half_open", True
+            else:
+                self.fast_fails += 1
+                out = False
+        if ev is not None:
+            self._journal(ev)
+        return out
 
     def note_success(self) -> None:
+        ev = None
         with self._lock:
             if self._state != BREAKER_CLOSED:
                 self.closed += 1
+                ev = "breaker.close"
             self._state = BREAKER_CLOSED
             self._failures = 0
             self._probing = False
+        if ev is not None:
+            self._journal(ev)
 
     def note_failure(self) -> None:
+        ev = None
         with self._lock:
             if self._state == BREAKER_HALF_OPEN:
                 # the trial failed: straight back to OPEN
@@ -488,14 +516,16 @@ class CircuitBreaker:
                 self._opened_t = self.clock()
                 self._probing = False
                 self.opened += 1
-                return
-            if self._state == BREAKER_OPEN:
-                return
-            self._failures += 1
-            if self._failures >= self.threshold:
-                self._state = BREAKER_OPEN
-                self._opened_t = self.clock()
-                self.opened += 1
+                ev = "breaker.open"
+            elif self._state != BREAKER_OPEN:
+                self._failures += 1
+                if self._failures >= self.threshold:
+                    self._state = BREAKER_OPEN
+                    self._opened_t = self.clock()
+                    self.opened += 1
+                    ev = "breaker.open"
+        if ev is not None:
+            self._journal(ev)
 
     @property
     def state(self) -> str:
@@ -703,6 +733,7 @@ class Cluster:
             if b is None:
                 b = self._breakers[node_id] = CircuitBreaker(
                     self.breaker_threshold, self.breaker_cooldown_s)
+                b.peer = node_id
             return b
 
     def peer_allows(self, node_id: str) -> bool:

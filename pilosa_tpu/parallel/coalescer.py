@@ -89,7 +89,8 @@ def resolve_enabled(mode) -> bool:
 class _Bucket:
     __slots__ = ("items", "full", "sealed",
                  "n_final", "shapes_final", "tape_final", "vm_final",
-                 "flush_t0", "launch_ns", "engine", "would_choose")
+                 "flush_t0", "launch_ns", "engine", "would_choose",
+                 "flush_trace")
 
     def __init__(self):
         # _Entry per enqueued query
@@ -114,6 +115,10 @@ class _Bucket:
         # the leader's thread)
         self.engine: str | None = None
         self.would_choose: str | None = None
+        # the LEADER's trace id at flush: batchmates inherit the
+        # batch's launch span — a follower's /debug/trace tree can
+        # point at the trace that actually owns the shared launch
+        self.flush_trace: str | None = None
 
 
 class _Entry:
@@ -340,6 +345,10 @@ class Coalescer:
                 "launch_ns": bucket.launch_ns,
                 "leader": leader,
             }
+            if bucket.flush_trace and not leader:
+                # a follower's record names the batch leader's trace —
+                # the span that owns the shared device launch
+                rec.coalesce["launch_trace"] = bucket.flush_trace
         arr = np.asarray(counts, dtype=np.int64)
         if entry.vm is not None:
             # VM results are per-domain-slot counts over the bucket's
@@ -420,6 +429,7 @@ class Coalescer:
             with tracing.start_span("coalescer.flush") as span:
                 span.set_tag("batch", n)
                 span.set_tag("shapes", bucket.shapes_final)
+                bucket.flush_trace = tracing.active_trace_id()
                 t_launch = time.perf_counter_ns()
                 from pilosa_tpu.runtime import residency as _residency
 

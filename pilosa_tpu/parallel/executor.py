@@ -343,10 +343,20 @@ class Executor:
             with _observe.attach(rec), \
                     _residency.no_tiers(not opt.tiers), \
                     _tenantmod.scope(opt.tenant), \
-                    tracing.start_span("executor.Execute") as span:
+                    tracing.start_span("executor.Execute") as span, \
+                    tracing.propagate(rec.trace_id
+                                      if rec is not None
+                                      and not span.trace_id
+                                      else None):
+                # the propagate fallback: under the nop tracer with no
+                # inbound traceparent the record's self-generated id
+                # becomes the active trace, so downstream RPCs (shard
+                # map, hedges) still carry a joinable traceparent and
+                # /debug/trace/{id} can assemble the cross-node tree
                 span.set_tag("index", index_name)
                 if rec is not None:
                     rec.tenant = opt.tenant
+                    rec.remote = bool(opt.remote)
                 if rec is not None:
                     # span -> record linkage: the record carries the
                     # exported trace id, the span the record id
@@ -373,10 +383,16 @@ class Executor:
                     # (exception-safe: failed calls record too)
                     tc = _time.perf_counter_ns()
                     try:
+                        # implicit parenting on purpose: under the nop
+                        # tracer the active span here is the propagate
+                        # fallback's ContextSpan, not the bare Execute
+                        # span — an explicit traceless parent would
+                        # bury the trace for the whole call (map
+                        # fan-out RPCs, replica writes, hint stamps)
                         with _stats.Timer(self.stats,
                                           f"execute.{call.name}"), \
                                 tracing.start_span(
-                                    f"executor.execute{call.name}", span):
+                                    f"executor.execute{call.name}"):
                             results.append(
                                 self._execute_call(idx, call, shards, opt))
                     finally:
@@ -414,9 +430,12 @@ class Executor:
         if (self.long_query_time > 0 and elapsed > self.long_query_time
                 and self.logger is not None):
             # slow-query log (reference cluster.long-query-time,
-            # api.go:1157)
-            self.logger.printf("slow query (%.3fs) on %s: %s",
-                               elapsed, index_name, query)
+            # api.go:1157); the trace id makes a logged outlier one
+            # /debug/trace/{id} away
+            self.logger.printf("slow query (%.3fs) trace=%s on %s: %s",
+                               elapsed,
+                               rec.trace_id if rec is not None else "-",
+                               index_name, query)
         return results
 
     # ----------------------------------------------------------- dispatch
@@ -748,6 +767,10 @@ class Executor:
                 self._hedge_issued += 1
             if rec is not None:
                 rec.hedged += 1
+            if _observe.journal_on:
+                _observe.emit("hedge.fired", node=fl.node_id,
+                              shards=len(fl.shards),
+                              replicas=sorted(groups))
 
         while pending or inflight:
             # fan out every remote group concurrently, then run local
@@ -881,14 +904,44 @@ class Executor:
                             self._hedge_wins += 1
                         if rec is not None:
                             rec.hedge_wins += 1
+                            # the abandoned original is the hedge
+                            # loser: note who and how long its side
+                            # had been in flight when the race settled
+                            # — the /debug/trace/{id} tree shows the
+                            # loser's side from this
+                            now_ns = _time.perf_counter_ns()
+                            for fl2 in inflight.values():
+                                if fl2.race is race:
+                                    rec.hedge_losers.append(
+                                        (fl2.node_id,
+                                         now_ns - fl2.t0))
+                        if _observe.journal_on:
+                            _observe.emit(
+                                "hedge.won", side="hedge",
+                                winner=sorted({hfl.node_id for hfl, _
+                                               in race.hedge_results}),
+                                losers=[race.node_id])
                         purge_race(race)
                 else:
                     if race.committed is None:
                         race.committed = "orig"
+                        now_ns = _time.perf_counter_ns()
+                        losers = sorted({fl2.node_id
+                                         for fl2 in inflight.values()
+                                         if fl2.race is race})
                         if rec is not None:
                             rec.note_node(fl.node_id, lat_ns,
                                           len(fl.shards))
+                            for fl2 in inflight.values():
+                                if fl2.race is race:
+                                    rec.hedge_losers.append(
+                                        (fl2.node_id,
+                                         now_ns - fl2.t0))
                         partials.extend(adapt(res[0]))
+                        if _observe.journal_on:
+                            _observe.emit("hedge.won", side="orig",
+                                          winner=fl.node_id,
+                                          losers=losers)
                         purge_race(race)
         return partials
 

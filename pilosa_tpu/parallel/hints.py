@@ -41,6 +41,7 @@ from dataclasses import dataclass
 
 from pilosa_tpu import faultinject as _fi
 from pilosa_tpu import lockcheck as _lockcheck
+from pilosa_tpu import tracing as _tracing
 from pilosa_tpu.runtime import filebudget
 
 #: hint record framing — the fragment WAL's blob-record shape
@@ -191,15 +192,19 @@ class HintRecord:
     blob carries the REAL peer id — filenames are sanitized, so the
     file name alone cannot round-trip arbitrary node names."""
 
-    __slots__ = ("ts_ms", "peer", "index", "pql", "shard", "raw")
+    __slots__ = ("ts_ms", "peer", "index", "pql", "shard", "trace",
+                 "raw")
 
     def __init__(self, ts_ms: int, peer: str, index: str, pql: str,
-                 shard: int, raw: bytes):
+                 shard: int, raw: bytes, trace: str = ""):
         self.ts_ms = ts_ms
         self.peer = peer
         self.index = index
         self.pql = pql
         self.shard = shard
+        # the write's trace id at queue time: replay re-attaches it so
+        # the delivery RPC joins the original write's trace
+        self.trace = trace
         self.raw = raw  # the exact appended bytes, for file rewrites
 
     @property
@@ -208,12 +213,15 @@ class HintRecord:
 
     @classmethod
     def make(cls, peer: str, index: str, pql: str, shard: int,
-             ts_ms: int | None = None) -> "HintRecord":
+             ts_ms: int | None = None,
+             trace: str = "") -> "HintRecord":
         ts = int(time.time() * 1e3) if ts_ms is None else ts_ms
-        blob = json.dumps({"p": peer, "i": index, "q": pql, "s": shard},
-                          separators=(",", ":")).encode()
+        d = {"p": peer, "i": index, "q": pql, "s": shard}
+        if trace:
+            d["t"] = trace
+        blob = json.dumps(d, separators=(",", ":")).encode()
         raw = _HINT_HDR.pack(_HINT_OP, len(blob), ts) + blob
-        return cls(ts, peer, index, pql, shard, raw)
+        return cls(ts, peer, index, pql, shard, raw, trace=trace)
 
 
 class _PeerQueue:
@@ -330,7 +338,8 @@ class HintStore:
             try:
                 d = json.loads(blob)
                 rec = HintRecord(ts_ms, str(d["p"]), d["i"], d["q"],
-                                 int(d["s"]), bytes(buf[start:off]))
+                                 int(d["s"]), bytes(buf[start:off]),
+                                 trace=str(d.get("t", "")))
             except Exception:  # noqa: BLE001 — corrupt blob: stop
                 return out, 1
             out.append(rec)
@@ -348,7 +357,8 @@ class HintStore:
         if cfg.hint_max_bytes <= 0:
             bump("hint.dropped")
             return False
-        rec = HintRecord.make(peer_id, index, pql, shard)
+        rec = HintRecord.make(peer_id, index, pql, shard,
+                              trace=_tracing.active_trace_id() or "")
         with self._lock:
             if self._total_bytes + rec.nbytes > cfg.hint_max_bytes:
                 over = True
@@ -405,7 +415,12 @@ class HintStore:
                     consumed += 1
                     continue
                 try:
-                    deliver(rec)
+                    # re-attach the queued write's trace (or mint one
+                    # for pre-trace records) so the replay RPC carries
+                    # traceparent and joins the original write's trace
+                    with _tracing.propagate(rec.trace
+                                            or _tracing.new_trace_id()):
+                        deliver(rec)
                 except Exception as e:  # noqa: BLE001 — classified below
                     if refusal_is_unowned(e):
                         out["discarded"] += 1

@@ -57,6 +57,8 @@ import time
 from dataclasses import dataclass
 
 from pilosa_tpu import lockcheck as _lockcheck
+from pilosa_tpu import observe as _observe
+from pilosa_tpu import tracing as _tracing
 from pilosa_tpu.parallel.cluster import (
     Node,
     ShedByPeerError,
@@ -573,8 +575,13 @@ class RebalanceCoordinator:
             self._abort_requested = False
             self._halt.clear()
         bump("rebalance.plans")
-        self._persist()
-        self._broadcast_begin(plan)
+        if _observe.journal_on:
+            _observe.emit("rebalance.plan", trace_id=plan["trace"],
+                          shards=len(plan["shards"]),
+                          nodes=sorted(new_ids))
+        with _tracing.propagate(plan["trace"]):
+            self._persist()
+            self._broadcast_begin(plan)
         summary = {"started": True, "shards": len(plan["shards"]),
                    "nodes": sorted(new_ids),
                    "add": plan.get("add"),
@@ -622,6 +629,10 @@ class RebalanceCoordinator:
             "shards": ordered,
             "started_at": time.time(),
             "done": False,
+            # one trace id for the plan's lifetime: every backfill
+            # transfer, cutover broadcast, and journal event this plan
+            # produces (on any worker thread, across resume) joins it
+            "trace": _tracing.new_trace_id(),
         }
 
     # ------------------------------------------------------ persistence
@@ -769,21 +780,25 @@ class RebalanceCoordinator:
         queue = list(work)
 
         def worker():
-            while not self._halt.is_set():
-                with qlock:
-                    if not queue:
-                        return
-                    m = queue.pop(0)
-                try:
-                    self._move_shard(m)
-                except Exception:  # noqa: BLE001 — keep plan resumable
-                    bump("rebalance.transfer_failures")
-                    # requeue: a shard that did not reach cutover must
-                    # NEVER be committed past — retry until it lands
-                    # or the operator halts/aborts the plan
+            # re-attach the plan's trace on the worker thread: backfill
+            # transfers and cutover broadcasts carry its traceparent
+            # (resumed pre-trace plans propagate nothing)
+            with _tracing.propagate(plan.get("trace")):
+                while not self._halt.is_set():
                     with qlock:
-                        queue.append(m)
-                    self._sleep(self._backoff(0))
+                        if not queue:
+                            return
+                        m = queue.pop(0)
+                    try:
+                        self._move_shard(m)
+                    except Exception:  # noqa: BLE001 — keep resumable
+                        bump("rebalance.transfer_failures")
+                        # requeue: a shard that did not reach cutover
+                        # must NEVER be committed past — retry until it
+                        # lands or the operator halts/aborts the plan
+                        with qlock:
+                            queue.append(m)
+                        self._sleep(self._backoff(0))
 
         threads = [threading.Thread(target=worker,
                                     name=f"rebalance-worker-{i}",
@@ -825,6 +840,9 @@ class RebalanceCoordinator:
         moving."""
         with self._plan_lock:
             m["state"] = MOVE_BACKFILL
+        if _observe.journal_on:
+            _observe.emit("rebalance.shard", index=m["index"],
+                          shard=m["shard"], state=MOVE_BACKFILL)
         self._persist()
         uris = {n.id: n.uri for n in self.cluster.sorted_nodes()}
         for dest_id, fields in m["dests"].items():
@@ -869,6 +887,9 @@ class RebalanceCoordinator:
             return
         with self._plan_lock:
             m["state"] = MOVE_CUTOVER
+        if _observe.journal_on:
+            _observe.emit("rebalance.shard", index=m["index"],
+                          shard=m["shard"], state=MOVE_CUTOVER)
         self._broadcast_and_local(self._route_for(m) | {
             "type": "rebalance-cutover"})
         bump("rebalance.cutovers")
@@ -888,6 +909,10 @@ class RebalanceCoordinator:
                 m["state"] = MOVE_DROPPED
             plan["done"] = True
             remove_id = plan.get("remove_id")
+        if _observe.journal_on:
+            _observe.emit("rebalance.commit",
+                          trace_id=plan.get("trace"),
+                          shards=len(plan["shards"]))
         removed_node = None
         if remove_id is not None:
             removed_node = c.node(remove_id)
@@ -930,6 +955,10 @@ class RebalanceCoordinator:
         self.node.receive_message(msg)
         self.node.broadcast(msg)
         bump("rebalance.aborts")
+        if _observe.journal_on:
+            _observe.emit("rebalance.abort",
+                          trace_id=plan.get("trace"),
+                          shards=len(plan["shards"]))
         self._clear_cursor()
         with self._plan_lock:
             self._last = {

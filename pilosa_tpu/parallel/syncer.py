@@ -49,6 +49,8 @@ from __future__ import annotations
 import time
 
 from pilosa_tpu import lockcheck as _lockcheck
+from pilosa_tpu import observe as _observe
+from pilosa_tpu import tracing as _tracing
 from pilosa_tpu.parallel.cluster import ShedByPeerError, TransportError
 from pilosa_tpu.serve import deadline as _deadline
 from pilosa_tpu.serve.admission import tagged
@@ -389,9 +391,19 @@ class HolderSyncer:
 
         if self.cluster.state == STATE_RESIZING:
             return 0  # skipped mid-resize (server.go:514)
+        # AE originates inside the cluster: mint a round trace so every
+        # checksum/pull/push exchange this slice issues carries ONE
+        # joinable traceparent across the peers it touches
+        with _tracing.propagate(_tracing.active_trace_id()
+                                or _tracing.new_trace_id()):
+            return self._sync_holder_traced(budget_s)
+
+    def _sync_holder_traced(self, budget_s: float | None) -> int:
         t0 = time.monotonic()
         stats = SyncStats()
         bump("ae.slices")
+        if _observe.journal_on:
+            _observe.emit("ae.round.start")
         cursor = getattr(self.node, "ae_cursor", None)
         fresh = cursor is None
         if fresh:
@@ -436,8 +448,18 @@ class HolderSyncer:
         if completed:
             self.node.ae_cursor = None
             bump("ae.rounds")
+            if _observe.journal_on:
+                _observe.emit("ae.round.converge",
+                              reconciled=total,
+                              dirty=stats.dirty)
         else:
             self.node.ae_cursor = last_key
+            if _observe.journal_on:
+                # budget spent mid-walk: the cursor parks for the next
+                # slice to resume from
+                _observe.emit("ae.round.park",
+                              cursor=list(last_key or []),
+                              reconciled=total)
         # cleanup + translate tailing run on EVERY slice, not just a
         # completed round: neither is part of the reconcile walk being
         # sliced, and deferring them to round completion would

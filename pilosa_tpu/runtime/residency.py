@@ -904,7 +904,12 @@ class ResidencyManager:
             # _evict_one_locked counts an eviction; re-classify: this
             # was an explicit demotion decision, not budget pressure
             self.evictions -= 1
-            return best_score
+        from pilosa_tpu import observe as _observe
+
+        if _observe.journal_on:
+            # after self._lock: the journal takes its own lock
+            _observe.emit("residency.demote", score=best_score)
+        return best_score
 
     def host_candidates(self, limit: int = 64) -> list[HostEntry]:
         """Host-tier entries whose owner cache currently lacks them —
@@ -1152,6 +1157,12 @@ class Promoter:
                     self.promotions += 1
                     if fl.prefetch:
                         self.prefetch_completed += 1
+                from pilosa_tpu import observe as _observe
+
+                if _observe.journal_on:
+                    _observe.emit("residency.promote",
+                                  bytes=int(ent.nbytes),
+                                  prefetch=bool(fl.prefetch))
                 self._resolve(ent, fl, None)
             except BaseException as e:  # noqa: BLE001 — injected
                 # failures (residency.promote failpoint) and real
@@ -1232,8 +1243,11 @@ def run_with_oom_retry(fn):
         if "RESOURCE_EXHAUSTED" not in str(e):
             raise
         from pilosa_tpu import devobs as _devobs
+        from pilosa_tpu import observe as _observe
 
         _devobs.observer().note_oom_retry()
+        if _observe.journal_on:
+            _observe.emit("oom.retry")
         mgr = manager()
         mgr.note_oom_feedback()
         mgr.evict_all()

@@ -477,7 +477,8 @@ class Handler:
                                                     tenant=tenant)
                 except _admission.ShedError as e:
                     self._record_shed(
-                        match.groupdict().get("index", path), k, e)
+                        match.groupdict().get("index", path), k, e,
+                        headers=req.headers)
                     req.close_connection = True
                     # structured shed body: ``reason`` + the tenant id
                     # let a client tell "I am over quota"
@@ -579,15 +580,25 @@ class Handler:
         self._error(req, 404, "not found")
 
     def _record_shed(self, index: str, klass: str,
-                     e: "_admission.ShedError") -> None:
+                     e: "_admission.ShedError", headers=None) -> None:
         """Shed requests never execute, so the flight recorder is told
         directly — /debug/queries and the slow-query log must show the
         overload story (outcome ``shed``/``expired``, with the queue
-        wait the request burned before the refusal)."""
+        wait the request burned before the refusal).  The shed happens
+        BEFORE the handler span opens, so the caller's traceparent is
+        extracted here — a shed record must still be one
+        /debug/trace/{id} away."""
         recorder = getattr(self.api.executor, "recorder", None)
         if recorder is not None:
-            recorder.record_shed(index, "", klass, e.outcome, str(e),
-                                 wait_ns=e.wait_ns, tenant=e.tenant)
+            from pilosa_tpu import tracing
+
+            parent = (tracing.extract_headers(headers)
+                      if headers is not None else None)
+            recorder.record_shed(
+                index, "", klass, e.outcome, str(e),
+                wait_ns=e.wait_ns, tenant=e.tenant,
+                trace_id=parent.trace_id if parent is not None
+                else None)
 
     def _json(self, req, obj, status: int = 200,
               headers: dict | None = None) -> None:
@@ -1538,6 +1549,114 @@ class Handler:
             "totals": totals,
         })
 
+    # ------------------------------------------- trace autopsy + journal
+
+    def _local_trace_payload(self, trace_id: str) -> dict:
+        """This node's contribution to a trace: flight records whose
+        (normalized) trace id matches, plus journal events stamped
+        with it."""
+        from pilosa_tpu import observe
+
+        records = []
+        recorder = getattr(self.api.executor, "recorder", None)
+        if recorder is not None:
+            records = [r.to_dict()
+                       for r in recorder.records_for_trace(trace_id)]
+        return {
+            "records": records,
+            "events": observe.journal().events(trace_id=trace_id,
+                                               limit=256),
+        }
+
+    @route("GET", "/debug/trace/{id}")
+    def handle_debug_trace(self, req, params, path, body):
+        """Distributed query autopsy: fan per-node flight records in
+        from every peer and assemble ONE causal span tree for the
+        trace — admission wait, coalescer window, stages, per-node
+        remote maps (the hedge loser's side included), reduce — with
+        per-span walls that sum to the observed latency
+        (pilosa_tpu.traceasm).  ``?local=1`` returns just this node's
+        records + events (the fan-in target).  Dead peers degrade to
+        ``errors``, the /debug/cluster/* contract."""
+        import re as _re
+
+        from pilosa_tpu import observe, traceasm
+
+        trace_id = path["id"]
+        if not _re.fullmatch(r"[0-9a-fA-F]{1,64}", trace_id):
+            raise ValueError(f"malformed trace id: {trace_id!r}")
+        local = self._local_trace_payload(trace_id)
+        if params.get("local"):
+            self._json(req, local)
+            return
+        local_id, sections, errors = self._fan_in(
+            f"/debug/trace/{trace_id}?local=1")
+        sections[local_id] = local
+        observe.bump_trace("trace.fanins", max(0, len(sections) - 1))
+        if errors:
+            observe.bump_trace("trace.errors", len(errors))
+        out = traceasm.assemble_trace(sections, errors, trace_id)
+        observe.bump_trace("trace.assemblies")
+        if out["root"] is None:
+            observe.bump_trace("trace.orphans")
+        self._json(req, out)
+
+    @route("GET", "/debug/events")
+    def handle_debug_events(self, req, params, path, body):
+        """This node's event journal (pilosa_tpu.observe.EventJournal):
+        structured state-transition events, oldest first.  ``?since=N``
+        keeps events with seq > N (the incremental-poll cursor);
+        ``?kind=prefix`` filters by kind prefix (``kind=breaker``
+        covers open/half-open/close); ``?trace=id`` keeps events
+        stamped with that trace; ``?limit=N`` keeps the newest N."""
+        from pilosa_tpu import observe
+
+        j = observe.journal()
+        self._json(req, {
+            "node": j.node_id,
+            "events": j.events(
+                since=int(params.get("since", 0) or 0),
+                kind=params.get("kind") or None,
+                trace_id=params.get("trace") or None,
+                limit=int(params.get("limit", 512) or 512)),
+            "counters": j.counters(),
+        })
+
+    @route("GET", "/debug/cluster/events")
+    def handle_debug_cluster_events(self, req, params, path, body):
+        """The merged cluster timeline: every node's journal slice,
+        wall-clock ordered, so "p99 spiked because node2's breaker
+        opened mid-backfill" is one request.  Same ``?since=``/
+        ``?kind=``/``?trace=``/``?limit=`` filters as /debug/events
+        (applied per node before the merge); dead peers degrade to
+        ``errors``."""
+        from urllib.parse import urlencode
+
+        from pilosa_tpu import traceasm
+
+        passthrough = {k: v for k, v in params.items()
+                       if k in ("since", "kind", "trace", "limit")}
+        qs = "?" + urlencode(passthrough) if passthrough else ""
+        # local section FIRST: it validates the params, so a bad
+        # since/limit 400s before any peer traffic is spent
+        since = int(params.get("since", 0) or 0)
+        kind = params.get("kind") or None
+        from pilosa_tpu import observe
+
+        j = observe.journal()
+        local_section = {
+            "node": j.node_id,
+            "events": j.events(
+                since=since, kind=kind,
+                trace_id=params.get("trace") or None,
+                limit=int(params.get("limit", 512) or 512)),
+            "counters": j.counters(),
+        }
+        local_id, sections, errors = self._fan_in("/debug/events" + qs)
+        sections[local_id] = local_section
+        self._json(req, traceasm.merge_events(sections, errors,
+                                              since=since, kind=kind))
+
     @route("GET", "/debug/peers")
     def handle_debug_peers(self, req, params, path, body):
         """Per-peer failure-handling state (parallel/cluster.py): each
@@ -1702,6 +1821,7 @@ class Handler:
         other.  Telemetry never fails a scrape."""
         from pilosa_tpu import devobs
         from pilosa_tpu import faultinject as _faultinject
+        from pilosa_tpu import observe as _observe_mod
         from pilosa_tpu import perfobs as _perfobs
         from pilosa_tpu.ingest import compactor
         from pilosa_tpu.models import fragment as _fragment
@@ -1745,6 +1865,10 @@ class Handler:
             # off — the family stays alert-able before the first
             # isolated tenant)
             _tenant.publish_gauges(self.stats, self.admission)
+            # event journal + trace-assembly families — zeros on a
+            # clean server so both are scrape-visible before the
+            # first event or /debug/trace fan-in
+            _observe_mod.publish_journal_gauges(self.stats)
         except Exception:  # noqa: BLE001
             pass
 

@@ -69,6 +69,9 @@ class Server:
         observe_fanin_timeout: float = 2.0,
         observe_device_peak_gbps: float = 0.0,
         observe_profiler_max_seconds: float = 30.0,
+        observe_journal: bool = True,
+        observe_journal_size: int = 2048,
+        observe_journal_kinds: str = "",
         cost_shadow: bool = True,
         admission_enabled: bool = True,
         admission_query_cap: int = 32,
@@ -254,6 +257,19 @@ class Server:
             logger=self.logger,
             stats=self.stats,
         )
+        # cluster event journal ([observe] journal keys): process-wide
+        # like [mesh] — the first server's retain() captures the
+        # pre-server baseline, the LAST release() (in close) restores
+        # it for library users sharing the process
+        _observe.retain()
+        self._journal_retained = True
+        self._journal_cfg = dict(
+            node_id=node_id,
+            size=observe_journal_size,
+            kinds=observe_journal_kinds,
+            enabled=observe_journal,
+        )
+        _observe.configure(**self._journal_cfg)
         # generation-stamped query result cache ([cache] config):
         # process-wide like the residency manager — configure in place
         # so a second in-process server cannot wipe the first's warm
@@ -515,6 +531,15 @@ class Server:
             self._rebalance_retained = True
             if self._rebalance_cfg:
                 _rebalance1.configure(**self._rebalance_cfg)
+        if not self._journal_retained:
+            # reopened after close(): take the event-journal reference
+            # back and RE-APPLY this server's node id / ring sizing
+            # (close() restored the process baseline)
+            from pilosa_tpu import observe as _observe1
+
+            _observe1.retain()
+            self._journal_retained = True
+            _observe1.configure(**self._journal_cfg)
         self.handler.serve_background()
         self.cluster.save_topology()
         if self.seeds:
@@ -730,6 +755,11 @@ class Server:
         if self._tenants_retained:
             self._tenants_retained = False
             _tenantcfg2.release()
+        from pilosa_tpu import observe as _observe2
+
+        if self._journal_retained:
+            self._journal_retained = False
+            _observe2.release()
         if self._faultinject_armed:
             # config-armed failpoints are process-wide: the arming
             # server disarms everything on close so library users

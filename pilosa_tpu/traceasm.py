@@ -1,0 +1,244 @@
+"""Cross-node trace assembly: one causal span tree per query.
+
+``GET /debug/trace/{id}`` fans flight records in from every node
+(``parallel/cluster.fan_in`` + ``client.debug_json``, the
+``/debug/cluster/*`` machinery) and this module joins them on the
+normalized trace id into ONE tree:
+
+    query (origin node)
+      admission.wait
+      coalescer.window
+      stage:translate
+      stage:execute            <- engine enum, launch count, tier notes
+        map                    <- per-node children from nodeTimings
+          node/node1  — remote subtree attached when that node's own
+          node/node2    flight record arrived in the fan-in
+          node/node2 (hedge loser) — the abandoned side of a hedge race
+        reduce                 <- execute minus map
+      stage:translateResults
+      (unattributed)           <- filler so child walls sum EXACTLY
+
+Per-span wall times add up to the observed latency by construction:
+each level carries an explicit ``(unattributed)`` child absorbing the
+gap between the parent's wall and the sum of its measured children, so
+the accounting identity ``observedMs == sum(leaf walls)`` holds and a
+triage reader can see exactly how much time the recorder could NOT
+attribute.  Dead peers degrade to an ``errors`` entry, same contract
+as ``/debug/cluster/*``.
+
+Pure functions over already-fetched JSON sections — no I/O here; the
+handler owns the fan-in and ticks ``observe.bump_trace`` counters.
+"""
+
+from __future__ import annotations
+
+from pilosa_tpu import tracing as _tracing
+
+#: Below this a filler span is measurement noise, not information.
+_MIN_FILLER_MS = 0.005
+
+
+def _span(name: str, ms: float, node: str = "", **attrs) -> dict:
+    d = {"name": name, "ms": round(max(0.0, ms), 3)}
+    if node:
+        d["node"] = node
+    d.update(attrs)
+    d["children"] = []
+    return d
+
+
+def _fill(parent: dict) -> None:
+    """Append the ``(unattributed)`` child absorbing the gap between
+    the parent wall and its children's summed walls — the invariant
+    that makes every level's walls add up."""
+    accounted = sum(c["ms"] for c in parent["children"])
+    gap = parent["ms"] - accounted
+    if gap > _MIN_FILLER_MS:
+        parent["children"].append(
+            _span("(unattributed)", gap, parent.get("node", "")))
+
+
+def _leaf_sum(span: dict) -> float:
+    if not span["children"]:
+        return span["ms"]
+    return sum(_leaf_sum(c) for c in span["children"])
+
+
+def _remote_subtree(rec: dict, node: str) -> dict:
+    """A remote node's own flight record rendered as the subtree under
+    the origin's per-node map span."""
+    sub = _span("remote/" + rec.get("index", ""),
+                rec.get("elapsedMs", 0.0), node,
+                pql=rec.get("pql", ""))
+    if rec.get("engine"):
+        sub["engine"] = rec["engine"]
+    sub["children"].extend(_stage_spans(rec, node, {}))
+    if rec.get("deviceLaunches"):
+        sub["launches"] = rec["deviceLaunches"]
+    _fill(sub)
+    return sub
+
+
+def _stage_spans(rec: dict, node: str,
+                 remote_by_node: dict[str, list[dict]]) -> list[dict]:
+    """The record's stage list as sibling spans, order-aware: the
+    recorder appends stages as they FINISH, and the shard fan-out runs
+    inside its execute call — so a ``map``/``map.fused`` entry belongs
+    to the next ``execute.*`` entry and must nest under it (rendering
+    both at the top level would double-count the map wall and break
+    the accounting identity)."""
+    out: list[dict] = []
+    pending_map: dict | None = None
+    for st in rec.get("stages", []):
+        name = st.get("name", "?")
+        if name in ("map", "map.fused"):
+            pending_map = st
+            continue
+        if name.startswith("execute"):
+            out.append(_execute_span(st, pending_map, rec, node,
+                                     remote_by_node))
+            pending_map = None
+        else:
+            out.append(_span("stage:" + name, st.get("ms", 0.0), node))
+    if pending_map is not None:  # map without an execute parent: keep
+        out.append(_span("stage:" + pending_map.get("name", "map"),
+                         pending_map.get("ms", 0.0), node))
+    return out
+
+
+def _execute_span(st: dict, map_st: dict | None, rec: dict, node: str,
+                  remote_by_node: dict[str, list[dict]]) -> dict:
+    """One execute stage: the shard map (per-node children off
+    nodeTimings, remote subtrees attached) plus the derived reduce
+    tail (execute minus map)."""
+    sp = _span("stage:" + st.get("name", "?"), st.get("ms", 0.0), node)
+    sp["engine"] = rec.get("engine", "")
+    if rec.get("deviceLaunches"):
+        sp["launches"] = rec["deviceLaunches"]
+    if rec.get("tier"):
+        sp["tier"] = rec["tier"]
+    timings = rec.get("nodeTimings", [])
+    # map wall: the recorded map stage when present (covers local
+    # shard work too), else the slowest node group (the scatter-gather
+    # critical path)
+    map_ms = (map_st.get("ms", 0.0) if map_st is not None
+              else max((t.get("ms", 0.0) for t in timings),
+                       default=0.0))
+    if map_st is not None or timings:
+        mp = _span(map_st.get("name", "map") if map_st is not None
+                   else "map", map_ms, node)
+        for t in timings:
+            peer = t.get("node", "?")
+            child = _span("node/" + peer, t.get("ms", 0.0), node,
+                          shards=t.get("shards"))
+            pool = remote_by_node.get(peer)
+            if pool:
+                child["children"].append(_remote_subtree(pool.pop(0),
+                                                         peer))
+                _fill(child)
+            mp["children"].append(child)
+        if mp["children"]:
+            _fill(mp)
+        sp["children"].append(mp)
+        sp["children"].append(
+            _span("reduce", sp["ms"] - map_ms, node))
+    for loser in rec.get("hedgeLosers", []):
+        peer = loser.get("node", "?")
+        lost = _span("node/" + peer + " (hedge loser)",
+                     loser.get("ms", 0.0), node)
+        pool = remote_by_node.get(peer)
+        if pool:
+            lost["children"].append(_remote_subtree(pool.pop(0), peer))
+            _fill(lost)
+        # abandoned work is OFF the critical path: report it under the
+        # execute span but exclude it from the wall accounting
+        lost["offCriticalPath"] = True
+        sp.setdefault("abandoned", []).append(lost)
+    return sp
+
+
+def assemble_trace(sections: dict, errors: dict,
+                   trace_id: str) -> dict:
+    """Join per-node ``{"records": [...], "events": [...]}`` sections
+    (keyed by node id, from the fan-in) into one causal span tree.
+
+    Returns ``{"traceId", "origin", "root", "records", "events",
+    "accounting", "errors"}``; ``root`` is None when no node holds an
+    origin (non-remote) record for the trace."""
+    want = _tracing.normalize_trace_id(trace_id)
+    all_recs: list[tuple[str, dict]] = []
+    all_events: list[dict] = []
+    for node, sec in sections.items():
+        for rec in (sec or {}).get("records", []):
+            all_recs.append((node, rec))
+        all_events.extend((sec or {}).get("events", []))
+
+    origin_node, origin = None, None
+    remote_by_node: dict[str, list[dict]] = {}
+    for node, rec in all_recs:
+        if rec.get("remote"):
+            remote_by_node.setdefault(node, []).append(rec)
+        elif origin is None:
+            origin_node, origin = node, rec
+
+    out = {
+        "traceId": want,
+        "origin": origin_node,
+        "root": None,
+        "records": [dict(r, node=n) for n, r in all_recs],
+        "events": sorted(all_events, key=lambda e: e.get("t", 0)),
+        "errors": errors,
+    }
+    if origin is None:
+        out["accounting"] = {"observedMs": 0.0, "accountedMs": 0.0,
+                             "unaccountedMs": 0.0}
+        return out
+
+    root = _span("query/" + origin.get("index", ""),
+                 origin.get("elapsedMs", 0.0), origin_node,
+                 pql=origin.get("pql", ""))
+    adm = origin.get("admission", {})
+    if adm.get("queueWaitMs"):
+        root["children"].append(
+            _span("admission.wait", adm["queueWaitMs"], origin_node,
+                  **{"class": adm.get("class", "")}))
+    co = origin.get("coalescer", {})
+    if co:
+        root["children"].append(
+            _span("coalescer.window", co.get("queueWaitMs", 0.0),
+                  origin_node, batch=co.get("batch"),
+                  leader=co.get("leader")))
+    root["children"].extend(
+        _stage_spans(origin, origin_node, remote_by_node))
+    _fill(root)
+    for child in root["children"]:
+        if child["children"]:
+            _fill(child)
+
+    out["root"] = root
+    observed = root["ms"]
+    accounted = _leaf_sum(root)
+    out["accounting"] = {
+        "observedMs": round(observed, 3),
+        "accountedMs": round(accounted, 3),
+        "unaccountedMs": round(max(0.0, observed - accounted), 3),
+    }
+    return out
+
+
+def merge_events(sections: dict, errors: dict, since: int = 0,
+                 kind: str | None = None) -> dict:
+    """The fanned-in cluster timeline for ``/debug/cluster/events``:
+    every node's journal slice merged, wall-clock ordered.  ``seq`` is
+    per-node, so the merged order key is the emit wall time (nodes'
+    clocks; good enough for triage, same caveat as /debug/cluster/*)."""
+    merged: list[dict] = []
+    counters: dict[str, dict] = {}
+    for node, sec in sections.items():
+        merged.extend((sec or {}).get("events", []))
+        if (sec or {}).get("counters"):
+            counters[node] = sec["counters"]
+    merged.sort(key=lambda e: (e.get("t", 0), e.get("node", ""),
+                               e.get("seq", 0)))
+    return {"events": merged, "counters": counters, "errors": errors,
+            "since": since, "kind": kind}

@@ -20,12 +20,28 @@ background thread.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
 import uuid
 
 _active = threading.local()  # .stack: list of active spans (innermost last)
+
+
+def new_trace_id() -> str:
+    """A fresh 32-hex W3C trace id — for work that originates inside
+    the cluster (anti-entropy rounds, hint replay, rebalance plans)
+    rather than behind an instrumented client."""
+    return uuid.uuid4().hex
+
+
+def normalize_trace_id(tid) -> str:
+    """Canonical 32-hex lowercase form.  Flight records may carry a
+    short self-generated id (observe.QueryRecord's 20-hex fallback)
+    while traceparent headers zero-pad to 32 — every cross-node match
+    on trace id must compare normalized forms."""
+    return f"{tid:0>32}".lower()
 
 
 def current_span() -> "Span | None":
@@ -78,6 +94,22 @@ class RemoteParent(Span):
         self.name = "remote"
 
 
+class ContextSpan(Span):
+    """Trace identity WITHOUT recording: the nop tracer's propagation
+    vehicle.  Before this class the default ``Tracer`` returned a bare
+    ``Span()`` for every start_span call, which silently DROPPED an
+    inbound RemoteParent — a remote node under the nop tracer
+    self-generated a fresh record id and cross-node trace assembly had
+    nothing to join on.  A ContextSpan inherits the ids (so
+    ``inject_headers``/``active_trace_id`` keep working downstream)
+    and records nothing; when no trace is in scope the nop tracer
+    still returns the zero-cost bare ``Span()``."""
+
+    def __init__(self, trace_id: str, span_id: str | None = None):
+        self.trace_id = trace_id
+        self.span_id = span_id or uuid.uuid4().hex[:16]
+
+
 def inject_headers(span: Span | None = None) -> dict[str, str]:
     """W3C trace-context header for an outgoing request (reference
     middleware inject, http/handler.go:321).  Empty when no recorded
@@ -113,6 +145,14 @@ def extract_headers(headers) -> RemoteParent | None:
 
 class Tracer:
     def start_span(self, name: str, parent: "Span | None" = None) -> Span:
+        if parent is None:
+            parent = current_span()
+        if parent is not None and parent.trace_id:
+            # keep a propagated trace alive through the nop tracer:
+            # server-side spans of a traced query must carry the ids
+            # forward (records, downstream RPC headers) even when
+            # nothing is being recorded locally
+            return ContextSpan(parent.trace_id)
         return Span()
 
 
@@ -281,6 +321,33 @@ def set_global_tracer(t: Tracer) -> None:
 def start_span(name: str, parent: Span | None = None) -> Span:
     """(reference tracing.StartSpanFromContext, tracing/tracing.go:60)"""
     return _global.start_span(name, parent)
+
+
+@contextlib.contextmanager
+def propagate(trace_id):
+    """Make ``trace_id`` this thread's active trace for the scope —
+    the cross-thread/cross-subsystem re-attach primitive.  Worker
+    threads (hedge IO, hint replay, AE rounds, rebalance transfers,
+    debug fan-in) run outside the request thread's span stack; wrapping
+    their work in ``propagate(tid)`` makes every RPC they issue carry
+    ``traceparent`` and every record they produce link the trace.
+
+    No-ops (zero allocation) for a falsy id, and defers to an already-
+    active traced span — an explicit propagate never clobbers real
+    span parentage established by a recording tracer."""
+    if not trace_id:
+        yield None
+        return
+    span = current_span()
+    if span is not None and span.trace_id:
+        yield span
+        return
+    cs = ContextSpan(normalize_trace_id(trace_id))
+    _push(cs)
+    try:
+        yield cs
+    finally:
+        _pop(cs)
 
 
 def active_trace_id() -> str | None:

@@ -494,7 +494,7 @@ class TestCoalescerObservability:
         d = recs[-1].to_dict()
         assert set(d["coalescer"]) == {
             "batch", "shapes", "tape", "queueWaitMs", "launchMs",
-            "leader"}
+            "leader", "launchTrace"}
         assert d["coalescer"]["queueWaitMs"] >= 0
         # exactly one record per flush owns the shared launch
         assert sum(1 for r in recs if r.coalesce["leader"]) >= 1

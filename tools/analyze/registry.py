@@ -163,6 +163,14 @@ CLASS_LOCKS: dict[tuple, ClassLockRule] = {
             "_tenant_dict_locked": "callers hold self._lock",
         },
     ),
+    ("observe.py", "EventJournal"): ClassLockRule(
+        lock="_lock",
+        attrs=frozenset({"_ring", "_seq", "_by_kind", "_dropped"}),
+        # node_id / kinds are deliberately UNREGISTERED: operator
+        # knobs rebound under the module _cfg_lock and read at emit
+        # time (a momentarily stale read stamps one event with the
+        # old node id / filter, never corrupts the ring)
+    ),
     ("parallel/cluster.py", "CircuitBreaker"): ClassLockRule(
         lock="_lock",
         attrs=frozenset({"_state", "_failures", "_opened_t",
@@ -286,6 +294,18 @@ MODULE_LOCKS: dict[str, tuple] = {
         # the wal.* replay-health counters (module-level; every
         # fragment's construction-time replay can note a torn tail)
         ModuleGlobalRule("_counters", "_wal_counter_lock", "rw"),
+    ),
+    "observe.py": (
+        # the event-journal fast gate and the journal handle itself:
+        # rebinds only under the config lock; emission sites read both
+        # lock-free by design (the faultinject `armed` discipline — a
+        # stale read drops or keeps one event, never corrupts)
+        ModuleGlobalRule("journal_on", "_cfg_lock", "w"),
+        ModuleGlobalRule("_journal", "_cfg_lock", "w"),
+        ModuleGlobalRule("_baseline", "_cfg_lock", "rw"),
+        ModuleGlobalRule("_refs", "_cfg_lock", "rw"),
+        # trace-assembly counters behind bump_trace/trace_counters
+        ModuleGlobalRule("_trace_counters", "_trace_lock", "rw"),
     ),
     "faultinject.py": (
         # the failpoint registry: every read AND write of the armed
@@ -498,6 +518,20 @@ CONFIG_GUARDS = (
         pair=("release",),
         owner_suffixes=("parallel/meshexec.py",),
         what="the refcounted [mesh] baseline",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("observe.configure", "_observe.configure",
+                          "_observe1.configure"),
+        pair=("retain", "release"),
+        owner_suffixes=("observe.py",),
+        what="the process-wide [observe] event-journal config",
+    ),
+    ConfigGuardRule(
+        mutator_suffixes=("observe.retain", "_observe.retain",
+                          "_observe1.retain"),
+        pair=("release",),
+        owner_suffixes=("observe.py",),
+        what="the refcounted [observe] journal baseline",
     ),
     ConfigGuardRule(
         mutator_suffixes=("perfobs.configure", "_perfobs.configure"),

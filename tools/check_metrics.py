@@ -291,6 +291,11 @@ REBALANCE_FAMILIES = _mf.live_prefixes("rebalance")
 #: rendered as tenant_* — published (zeros) even with [tenants] off.
 TENANT_FAMILIES = _mf.live_prefixes("tenant")
 
+#: Query-autopsy families (observe.publish_journal_gauges): the
+#: cluster event journal event_* and the trace-assembly trace_* —
+#: published (zeros) even before the first event or assembly.
+TRACE_FAMILIES = _mf.live_prefixes("trace")
+
 #: Everything the ``--families`` CLI mode requires of a live server.
 ALL_FAMILIES = _mf.live_prefixes()
 

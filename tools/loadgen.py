@@ -229,6 +229,50 @@ def _vars_counter(host: str, name: str) -> float | None:
         return None
 
 
+def _journal_counters(host: str) -> dict | None:
+    """The event journal's per-kind counters off /debug/events, or
+    None — the report's ``events`` section is the DELTA over the run
+    window, so a long-lived server's history doesn't pollute it."""
+    try:
+        with urllib.request.urlopen(f"{host}/debug/events?limit=0",
+                                    timeout=5) as resp:
+            d = json.loads(resp.read())
+        return d.get("counters")
+    except Exception:
+        return None
+
+
+def _slowest_trace(host: str) -> dict | None:
+    """The assembled span tree for the slowest recent query: the
+    report's worked autopsy example — /debug/queries picks the
+    slowest completed record, /debug/trace/{id} fans its records in
+    and assembles the causal tree (admission wait -> coalescer window
+    -> stage/launch -> per-node remote -> reduce)."""
+    try:
+        with urllib.request.urlopen(f"{host}/debug/queries",
+                                    timeout=5) as resp:
+            d = json.loads(resp.read())
+        recent = [r for r in (d.get("recent") or [])
+                  if r.get("traceID") and not r.get("active")]
+        if not recent:
+            return None
+        slowest = max(recent, key=lambda r: r.get("elapsedMs", 0.0))
+        tid = slowest["traceID"]
+        with urllib.request.urlopen(f"{host}/debug/trace/{tid}",
+                                    timeout=10) as resp:
+            tree = json.loads(resp.read())
+        return {
+            "traceId": tree.get("traceId"),
+            "pql": slowest.get("pql"),
+            "elapsedMs": slowest.get("elapsedMs"),
+            "accounting": tree.get("accounting"),
+            "root": tree.get("root"),
+            "errors": tree.get("errors") or None,
+        }
+    except Exception:
+        return None
+
+
 def shape_mix_queries(n: int, field: str = "f", rows: int = 6,
                       seed: int = 7) -> list[str]:
     """``n`` structurally DISTINCT fused-eligible Count trees over
@@ -620,6 +664,7 @@ def run_load(host: str, index: str, qps: float, seconds: float,
                   tenant=tenant)
 
     cache0 = _cache_counters(host)
+    ev0 = _journal_counters(host)
     disp0 = _vars_counter(host, "coalescer.dispatches")
     hedge0 = _vars_counter(host, "hedge.issued")
     hrpcs0 = _vars_counter(host, "hedge.rpcs")
@@ -672,6 +717,7 @@ def run_load(host: str, index: str, qps: float, seconds: float,
     reb1 = ({n_: _vars_counter(host, n_) for n_ in _REBALANCE_VARS}
             if scale is not None else None)
     cache1 = _cache_counters(host)
+    ev1 = _journal_counters(host)
     disp1 = _vars_counter(host, "coalescer.dispatches")
     hedge1 = _vars_counter(host, "hedge.issued")
     hrpcs1 = _vars_counter(host, "hedge.rpcs")
@@ -839,6 +885,24 @@ def run_load(host: str, index: str, qps: float, seconds: float,
                     0.99) * 1e3, 2),
             },
         }),
+        # event-journal view: per-kind journal deltas over the run
+        # window (hedges fired, breakers opened, rebalance shard
+        # transitions ...) — the cluster's state-transition story next
+        # to the latency numbers it explains
+        "events": (None if ev1 is None else {
+            "total": int(ev1.get("total", 0)
+                         - (ev0 or {}).get("total", 0)),
+            "dropped": int(ev1.get("dropped", 0)
+                           - (ev0 or {}).get("dropped", 0)),
+            "by_kind": {
+                k: int(v - (ev0 or {}).get("kinds", {}).get(k, 0))
+                for k, v in sorted(ev1.get("kinds", {}).items())
+                if v - (ev0 or {}).get("kinds", {}).get(k, 0)
+            },
+        }),
+        # the slowest recent query's assembled causal span tree —
+        # the worked /debug/trace/{id} autopsy for this run
+        "slowest_trace": _slowest_trace(host),
         # sparsity-mix view: per-bucket read latency percentiles
         "sparsity": (None if buckets is None else {
             name: {
