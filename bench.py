@@ -639,18 +639,23 @@ def bench_coalescer(a_np: np.ndarray,
     obs = {
         "qps_recorder_on": round(qps_on, 2),
         "qps_recorder_off": round(qps_off, 2),
-        # medians of warmed, order-alternated windows, floored at 0:
-        # a negative delta is measurement noise, not a speedup, and
-        # the artifact's overhead figure must stay meaningful (the raw
-        # qps pair above carries the unclamped evidence)
-        "overhead_pct": round(
-            max(0.0, (qps_off - qps_on) / qps_off * 100.0), 2),
-        # per-query recorder cost as a share of the measured per-query
-        # service time — the number the <1% budget is judged on
+        # the qps A/B is EVIDENCE, not the budget pin: even
+        # order-alternated median windows swing by double digits on a
+        # busy host (23.58% in BENCH_r10 with a 9us direct cost —
+        # three orders of magnitude apart), so the delta mostly
+        # measures ambient load, and it reports unclamped under a
+        # name that says so
+        "ab_overhead_pct_noisy": round(
+            (qps_off - qps_on) / qps_off * 100.0, 2),
+        # per-query recorder cost measured directly (begin+publish
+        # bracket), as a share of the measured per-query service time
+        # — THE number the <1% budget is judged on
         "record_cost_us": round(record_cost_us, 2),
         "record_cost_pct_of_query": round(
             record_cost_us / (THREADS / qps * 1e6) * 100.0, 3),
         "budget_pct": 1.0,
+        "within_budget": bool(
+            record_cost_us / (THREADS / qps * 1e6) * 100.0 < 1.0),
     }
     dv = {
         "qps_devobs_on": round(dv_qps_on, 2),
@@ -1122,7 +1127,11 @@ def bench_containers() -> dict | None:
     - reports resident device bytes both ways (dense stacks vs pooled
       container blocks, from the residency manager's kind split) and
       the achieved streaming rates, every sample verified against a
-      host-computed expected count.
+      host-computed expected count,
+    - adds an ultra-sparse (~0.1% fill) leg A/Bing the per-kind pools
+      (``[containers] kinds``) against the dense-kind compressed path
+      — the array-kind capacity pin (>=5x lower resident bytes) plus
+      a kinds-dispatch no-regression qps pin on the 1%-fill leg.
 
     Returns None under a non-default shard width (the container
     geometry assumes 2^20-column shards here).  CPU-fallback numbers
@@ -1147,7 +1156,13 @@ def bench_containers() -> dict | None:
     idx = holder.create_index("i")
     f = idx.create_field("f")
     view = f.create_view_if_not_exists("standard")
-    truth: dict[int, set] = {10: set(), 11: set()}
+    # ~0.09% fill, sized so per-container cardinality (~460) sits
+    # under the 512 pow2 size class — device array-pool rows pad to
+    # powers of two, and a card just past a boundary doubles the row
+    us_bits = 920
+    FILL_US = us_bits / SHARD_WIDTH
+    truth: dict[int, set] = {10: set(), 11: set(),
+                             20: set(), 21: set()}
     for s in range(CT_SHARDS):
         frag = view.create_fragment_if_not_exists(s)
         # clustered: all bits inside containers 0-1 (128Ki bits); the
@@ -1164,6 +1179,18 @@ def bench_containers() -> dict | None:
             frag.import_positions((r * SHARD_WIDTH + pos)
                                   .astype(np.uint64))
             truth[r].update((s * SHARD_WIDTH + pos).tolist())
+        # ultra-sparse rows (~0.1% fill, same clustering): each
+        # non-empty container holds a few hundred bits — exactly the
+        # array-kind regime the per-kind pools exist for
+        us_shared = rng.choice(1 << 17, size=us_bits // 2,
+                               replace=False)
+        for r in (20, 21):
+            own = rng.choice(1 << 17, size=us_bits // 2,
+                             replace=False)
+            pos = np.unique(np.concatenate([us_shared, own]))
+            frag.import_positions((r * SHARD_WIDTH + pos)
+                                  .astype(np.uint64))
+            truth[r].update((s * SHARD_WIDTH + pos).tolist())
         f._note_shard(s)
     ex = Executor(holder)
     from pilosa_tpu.runtime import resultcache as _resultcache
@@ -1173,21 +1200,25 @@ def bench_containers() -> dict | None:
     _resultcache.cache().enabled = False  # measure the dispatch path
     q = "Count(Intersect(Row(f=10), Row(f=11)))"
     expect = len(truth[10] & truth[11])
+    q_us = "Count(Intersect(Row(f=20), Row(f=21)))"
+    expect_us = len(truth[20] & truth[21])
 
-    def timed(seconds: float) -> float:
-        got = int(ex.execute("i", q)[0])  # warm + verify
-        if got != expect:
-            raise AssertionError(f"containers bench: {got} != {expect}")
+    def timed(seconds: float, query: str = q,
+              want: int | None = None) -> float:
+        want = expect if want is None else want
+        got = int(ex.execute("i", query)[0])  # warm + verify
+        if got != want:
+            raise AssertionError(f"containers bench: {got} != {want}")
         n = 0
         t0 = time.perf_counter()
         while time.perf_counter() - t0 < seconds:
-            if int(ex.execute("i", q)[0]) != expect:
+            if int(ex.execute("i", query)[0]) != want:
                 raise AssertionError("containers bench: drift mid-run")
             n += 1
         return n / (time.perf_counter() - t0)
 
     try:
-        ct.configure(enabled=True)
+        ct.configure(enabled=True, kinds=True)
         ct.reset_counters()
         qps_compressed = timed(1.0)
         gathered = ct.counters()["container.containers_gathered"]
@@ -1201,6 +1232,22 @@ def bench_containers() -> dict | None:
             for r in (10, 11))
         assert (residency.manager().stats().get("kinds") or {}).get(
             "compressed", 0) >= compressed_bytes
+        # ultra-sparse leg (~0.1% fill): per-kind pools vs the
+        # dense-kind compressed path (kinds=false — every non-empty
+        # container a full 2048-word block).  The bytes ratio is the
+        # array-kind capacity story; the 1%-leg qps pin below guards
+        # against the kinds dispatch costing throughput
+        qps_us_kinds = timed(1.0, q_us, expect_us)
+        us_kinds_bytes = sum(
+            f.device_container_leaf(r, tuple(range(CT_SHARDS))).nbytes
+            for r in (20, 21))
+        ct.configure(kinds=False)
+        qps_nokinds = timed(1.0)           # 1%-fill leg, kinds off
+        qps_us_nokinds = timed(1.0, q_us, expect_us)
+        us_nokinds_bytes = sum(
+            f.device_container_leaf(r, tuple(range(CT_SHARDS))).nbytes
+            for r in (20, 21))
+        ct.configure(kinds=True)
         ct.configure(enabled=False)
         qps_dense = timed(1.0)
     finally:
@@ -1232,6 +1279,24 @@ def bench_containers() -> dict | None:
         # the sparse workload at least matching the dense path
         "pin_bytes_ok": dense_bytes >= 4 * max(1, compressed_bytes),
         "pin_qps_ok": qps_compressed >= 0.95 * qps_dense,
+        # ---- per-kind pools (ultra-sparse ~0.1% fill leg) ----
+        "ultra_sparse": {
+            "fill": FILL_US,
+            "qps_kinds": round(qps_us_kinds, 2),
+            "qps_nokinds": round(qps_us_nokinds, 2),
+            "resident_bytes_kinds": us_kinds_bytes,
+            "resident_bytes_nokinds": us_nokinds_bytes,
+            "bytes_ratio": round(
+                us_nokinds_bytes / max(1, us_kinds_bytes), 1),
+            # acceptance pins: array/run pools >=5x smaller than the
+            # dense-kind compressed path at ~0.1% fill, and kinds
+            # dispatch not costing throughput on the 1%-fill leg
+            "pin_bytes_ok": us_nokinds_bytes >= 5 * max(
+                1, us_kinds_bytes),
+            "pin_qps_ok": qps_compressed >= 0.95 * qps_nokinds,
+            "qps_1pct_kinds": round(qps_compressed, 2),
+            "qps_1pct_nokinds": round(qps_nokinds, 2),
+        },
     }
     return out
 

@@ -91,6 +91,19 @@ def main(argv: list[str] | None = None) -> int:
                          "compressed execution ([containers] "
                          "threshold); rows denser than this stay on "
                          "the dense path")
+    ps.add_argument("--no-container-kinds", action="store_true",
+                    help="disable per-container kind specialization "
+                         "([containers] kinds=false): every container "
+                         "stays a dense 2048-word bitmap block")
+    ps.add_argument("--containers-array-max", type=int,
+                    help="cardinality ceiling for the array container "
+                         "kind ([containers] array-max, canonical "
+                         "4096); lower values only narrow the device "
+                         "pick")
+    ps.add_argument("--containers-run-cap", type=int,
+                    help="most intervals a run container may carry on "
+                         "device ([containers] run-cap); noisier "
+                         "containers demote to array/bitmap")
     ps.add_argument("--no-mesh", action="store_true",
                     help="disable mesh-native SPMD execution ([mesh] "
                          "enabled=false): fused dispatches run the "
@@ -293,6 +306,12 @@ def cmd_server(args) -> int:
         cfg.containers.enabled = False
     if args.containers_threshold is not None:
         cfg.containers.threshold = args.containers_threshold
+    if args.no_container_kinds:
+        cfg.containers.kinds = False
+    if args.containers_array_max is not None:
+        cfg.containers.array_max = args.containers_array_max
+    if args.containers_run_cap is not None:
+        cfg.containers.run_cap = args.containers_run_cap
     if args.no_mesh:
         cfg.mesh.enabled = "false"
     if args.mesh_axis_size is not None:
@@ -445,6 +464,9 @@ def run_server(cfg: Config, ready_event: threading.Event | None = None,
         ingest_delta_enabled=cfg.ingest.delta_enabled,
         containers_enabled=cfg.containers.enabled,
         containers_threshold=cfg.containers.threshold,
+        containers_kinds=cfg.containers.kinds,
+        containers_array_max=cfg.containers.array_max,
+        containers_run_cap=cfg.containers.run_cap,
         mesh_enabled=cfg.mesh.enabled,
         mesh_axis_size=cfg.mesh.axis_size,
         residency_host_budget_bytes=cfg.residency.host_budget_bytes,

@@ -343,10 +343,23 @@ class ContainersConfig:
     the query keeps the dense fused path (the dense layout is the
     right engine for hot rows).  Per-request escape:
     ``?nocontainers=1`` on the query route — results are bit-identical
-    either way."""
+    either way.
+
+    ``kinds`` turns on per-container kind specialization (bitmap vs
+    sorted-array vs run-interval pools — the full roaring triple on
+    device); ``array-max`` is the cardinality ceiling for the array
+    kind (canonical roaring uses 4096; lower values only NARROW the
+    device pick — serialization always uses the canonical constant);
+    ``run-cap`` caps how many intervals a run container may carry
+    before it demotes to array/bitmap on device.  With ``kinds`` off
+    every container stays a dense 2048-word block — results are
+    bit-identical either way."""
 
     enabled: bool = True
     threshold: float = 0.25
+    kinds: bool = True
+    array_max: int = 4096
+    run_cap: int = 256
 
 
 @dataclass
@@ -705,6 +718,9 @@ class Config:
             "[containers]",
             f"enabled = {str(self.containers.enabled).lower()}",
             f"threshold = {self.containers.threshold}",
+            f"kinds = {str(self.containers.kinds).lower()}",
+            f"array-max = {self.containers.array_max}",
+            f"run-cap = {self.containers.run_cap}",
             "",
             "[mesh]",
             f'enabled = "{self.mesh.enabled}"',

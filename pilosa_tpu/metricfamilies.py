@@ -97,12 +97,14 @@ FAMILIES: tuple[Family, ...] = (
     Family("vm", "vm_",
            "Pallas bitmap VM: one scalar-prefetch kernel for ragged "
            "tapes over compressed containers (ops/pallas_kernels.py "
-           "+ ops/tape.py)",
+           "+ ops/tape.py); vm_fallbacks_* is the per-reason "
+           "breakdown of dense-path fallbacks",
            live_prefixes=("vm_",), group="tape",
            doc="architecture.md"),
     Family("container", "container_",
-           "compressed container-directory execution engine "
-           "(ops/containers.py)",
+           "compressed container-directory execution engine with "
+           "per-kind bitmap/array/run pools (ops/containers.py); "
+           "container_*_gathered breaks gathers out per kind",
            live_prefixes=("container_",), group="container",
            doc="architecture.md"),
     Family("mesh", "mesh_",

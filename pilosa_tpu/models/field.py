@@ -94,6 +94,15 @@ def _live(dev) -> bool:
     return residency.live(dev)
 
 
+def _leaf_live(leaf) -> bool:
+    """Every pool of a container leaf still device-resident (a kinds
+    leaf carries three; a deleted buffer in ANY of them invalidates)."""
+    if not _live(leaf.pool):
+        return False
+    return all(_live(p) for p in (leaf.apool, leaf.acard, leaf.rpool)
+               if p is not None)
+
+
 def _placement_token():
     """The [mesh] placement flavor in force (parallel/meshexec.py),
     joined into every device-stack cache's invalidation tuple: a mesh
@@ -833,24 +842,33 @@ class Field:
         view = self.view(VIEW_STANDARD)
         frags = [None if view is None else view.fragment(s)
                  for s in shards]
+        from pilosa_tpu.parallel import meshexec
+
         # the fill-ratio threshold joins the token: a cached leaf
         # froze each fragment's sparse-vs-hot verdict, so a runtime
         # [containers] threshold change must miss and re-evaluate —
-        # not wait for the next base mutation
-        gens = (ct.config().threshold, _placement_token(),
+        # not wait for the next base mutation.  The effective
+        # kind-selection knobs join it too (they decide the pool
+        # layout), and kinds switch off entirely while a mesh is
+        # active: the kind-dispatched programs are single-device, so
+        # mesh-routed queries keep the exact legacy all-bitmap leaves
+        cfg = ct.config()
+        eff_kinds = bool(cfg.kinds) and not meshexec.active()
+        gens = (cfg.threshold, eff_kinds, cfg.array_max, cfg.run_cap,
+                _placement_token(),
                 *(_frag_base_gen(fr) for fr in frags))
         key = ("cont", row_id, shards)
         self._note_access(self._row_stack_cache, key)
         with self._lock:
             hit = self._row_stack_cache.get(key)
             if (hit is not None and hit[0] == gens
-                    and _live(hit[1].pool)):
+                    and _leaf_live(hit[1])):
                 self._touch(self._row_stack_cache, key)
                 self._note_tier("hbm")
                 return hit[1]
         tiered = self._tier_consult(
             self._row_stack_cache, key, gens,
-            lambda h: h[0] == gens and _live(h[1].pool))
+            lambda h: h[0] == gens and _leaf_live(h[1]))
         if tiered is not None:
             return tiered[1][1] if tiered[0] == "dev" else tiered[1]
         t_build = _time.perf_counter_ns()
@@ -858,62 +876,165 @@ class Field:
         starts: list[int] = []
         kinds: list = []
         blocks_list: list[np.ndarray] = []
-        n = 0
+        kinds_list: list[np.ndarray] = []
+        n_dir = 0
         for fr in frags:
-            starts.append(n)
+            starts.append(n_dir)
             if fr is None:
                 entries.append(np.empty(0, dtype=np.int64))
                 kinds.append(np.empty(0, dtype=np.uint8))
                 continue
-            rc = fr.row_containers(row_id)
+            rc = (fr.row_container_kinds(row_id) if eff_kinds
+                  else fr.row_containers(row_id))
             if rc is None:
                 # hot row in this fragment: dense-fallback evidence
                 entries.append(None)
                 kinds.append(None)
                 continue
-            keys, blocks, _bits = rc
+            if eff_kinds:
+                keys, blocks, _bits, ks = rc
+            else:
+                keys, blocks, _bits = rc
+                # kind 1 = dense bitmap block
+                ks = np.ones(len(keys), dtype=np.uint8)
             entries.append(keys)
-            # kind 1 = dense bitmap block (array/run kinds reserved)
-            kinds.append(np.ones(len(keys), dtype=np.uint8))
+            kinds.append(ks)
             if len(keys):
                 blocks_list.append(blocks)
-                n += len(keys)
-        # >= 1 zero tail row: gather index n is the canonical
-        # absent-container block.  On device the row count pads to
-        # pow2 so the gather programs lower O(log) distinct shapes; in
-        # host mode there is no jit specialization to bound, and the
-        # tight pool keeps resident bytes equal to real data
+                kinds_list.append(ks)
+                n_dir += len(keys)
         from pilosa_tpu.ops import bitmap as bm
 
-        rows = n + 1 if bm.host_mode() else ct._pow2(n + 1)
-        pool = np.zeros((rows, ct.CWORDS), dtype=np.uint32)
-        if blocks_list:
-            pool[:n] = np.concatenate(blocks_list, axis=0)
-        leaf = ct.ContainerLeaf(shards, entries, starts, kinds,
-                                self._place_pool(pool), n, pool.nbytes)
+        flat_kinds = (np.concatenate(kinds_list) if kinds_list
+                      else np.empty(0, dtype=np.uint8))
+        if eff_kinds and bool((flat_kinds != 1).any()):
+            leaf, host_payload = self._build_kinds_leaf(
+                shards, entries, starts, kinds, blocks_list,
+                flat_kinds)
+        else:
+            # all-bitmap directory (or kinds disabled): the exact
+            # legacy layout, byte-identical pools and indices.
+            # >= 1 zero tail row: gather index n is the canonical
+            # absent-container block.  On device the row count pads to
+            # pow2 so the gather programs lower O(log) distinct
+            # shapes; in host mode there is no jit specialization to
+            # bound, and the tight pool keeps resident bytes equal to
+            # real data
+            n = n_dir
+            rows = n + 1 if bm.host_mode() else ct._pow2(n + 1)
+            pool = np.zeros((rows, ct.CWORDS), dtype=np.uint32)
+            if blocks_list:
+                pool[:n] = np.concatenate(blocks_list, axis=0)
+            # a kinds-eligible all-bitmap row rebuilds plain uint8 ones
+            # so stale array/run kind bytes can never leak through
+            if eff_kinds:
+                kinds = [None if k is None
+                         else np.ones(len(k), dtype=np.uint8)
+                         for k in kinds]
+            leaf = ct.ContainerLeaf(shards, entries, starts, kinds,
+                                    self._place_pool(pool), n,
+                                    pool.nbytes)
+            host_payload = pool
         self._note_tier("cold", _time.perf_counter_ns() - t_build)
-        if pool.nbytes <= self._entry_cap(self.ROW_STACK_CACHE_BYTES):
+        if leaf.nbytes <= self._entry_cap(self.ROW_STACK_CACHE_BYTES):
             place_pool = self._place_pool
+            kd = None
+            if leaf.has_kinds:
+                kd = {"array": int(leaf.apool.nbytes)
+                      + int(leaf.acard.nbytes),
+                      "run": int(leaf.rpool.nbytes)}
 
-            def _promote_leaf(p, _g=gens, _e=entries, _s=starts,
-                              _k=kinds, _n=n, _sh=shards):
-                return (_g, ct.ContainerLeaf(_sh, _e, _s, _k,
-                                             place_pool(p), _n,
-                                             p.nbytes))
+            def _promote_leaf(p, _g=gens, _leaf=leaf, _sh=shards):
+                if isinstance(p, tuple):
+                    pool_h, apool_h, acard_h, rpool_h = p
+                    return (_g, ct.ContainerLeaf(
+                        _sh, _leaf.entries, _leaf.starts, _leaf.kinds,
+                        place_pool(pool_h), _leaf.n, _leaf.nbytes,
+                        slots=_leaf.slots,
+                        apool=place_pool(apool_h),
+                        acard=place_pool(acard_h),
+                        rpool=place_pool(rpool_h),
+                        an=_leaf.an, rn=_leaf.rn))
+                return (_g, ct.ContainerLeaf(
+                    _sh, _leaf.entries, _leaf.starts, _leaf.kinds,
+                    place_pool(p), _leaf.n, p.nbytes))
 
-            def _leaf_host(p, _e=entries, _s=starts, _k=kinds,
-                           _n=n, _sh=shards):
+            def _leaf_host(p, _leaf=leaf, _sh=shards):
+                if isinstance(p, tuple):
+                    pool_h, apool_h, acard_h, rpool_h = p
+                    return ct.ContainerLeaf(
+                        _sh, _leaf.entries, _leaf.starts, _leaf.kinds,
+                        np.ascontiguousarray(pool_h), _leaf.n,
+                        _leaf.nbytes, slots=_leaf.slots,
+                        apool=np.ascontiguousarray(apool_h),
+                        acard=np.ascontiguousarray(acard_h),
+                        rpool=np.ascontiguousarray(rpool_h),
+                        an=_leaf.an, rn=_leaf.rn)
                 return ct.ContainerLeaf(
-                    _sh, _e, _s, _k, np.ascontiguousarray(p), _n,
-                    p.nbytes)
+                    _sh, _leaf.entries, _leaf.starts, _leaf.kinds,
+                    np.ascontiguousarray(p), _leaf.n, p.nbytes)
 
             self._evict_and_insert(self._row_stack_cache, key,
-                                   (gens, leaf), pool.nbytes,
+                                   (gens, leaf), leaf.nbytes,
                                    max_entries=64, kind="compressed",
-                                   token=gens, host=pool,
+                                   token=gens, host=host_payload,
                                    promote=_promote_leaf,
-                                   fallback=_leaf_host)
+                                   fallback=_leaf_host,
+                                   kind_detail=kd)
         return leaf
+
+    def _build_kinds_leaf(self, shards, entries, starts, kinds,
+                          blocks_list, flat_kinds):
+        """Split a mixed-kind container directory into the per-kind
+        compact pools (ops/kindpools.split_pools) and assemble the
+        kinds ContainerLeaf.  Every pool keeps >= 1 canonical zero
+        tail row (empty bitmap block / card-0 array / all-invalid run
+        pairs) — the absent-container gather targets — and device row
+        counts pad to pow2 per pool (host pools stay tight)."""
+        from pilosa_tpu.ops import bitmap as bm
+        from pilosa_tpu.ops import containers as ct
+        from pilosa_tpu.ops import kindpools as kp
+
+        flat_blocks = (np.concatenate(blocks_list, axis=0)
+                       if blocks_list
+                       else np.empty((0, ct.CWORDS), dtype=np.uint32))
+        slots_flat, bblocks, apool_t, acard_t, rpool_t = \
+            kp.split_pools(flat_blocks, flat_kinds)
+        # re-slice the flat kind-local slots back per shard (starts[]
+        # indexes the flat directory order)
+        slots = []
+        off = 0
+        for ks in kinds:
+            if ks is None:
+                slots.append(None)
+                continue
+            slots.append(slots_flat[off:off + len(ks)])
+            off += len(ks)
+        host = bm.host_mode()
+        bn = int(bblocks.shape[0])
+        an = int(apool_t.shape[0])
+        rn = int(rpool_t.shape[0])
+        brows = bn + 1 if host else ct._pow2(bn + 1)
+        pool = np.zeros((brows, ct.CWORDS), dtype=np.uint32)
+        pool[:bn] = bblocks
+        arows = an + 1 if host else ct._pow2(an + 1)
+        apool = np.full((arows, apool_t.shape[1]), kp.ARRAY_PAD,
+                        dtype=np.uint16)
+        apool[:an] = apool_t
+        acard = np.zeros(arows, dtype=np.int32)
+        acard[:an] = acard_t
+        rrows = rn + 1 if host else ct._pow2(rn + 1)
+        rpool = np.zeros((rrows, rpool_t.shape[1]), dtype=np.uint16)
+        rpool[:, 0::2] = 1  # (1, 0): the canonical invalid pair
+        rpool[:rn] = rpool_t
+        nbytes = (pool.nbytes + apool.nbytes + acard.nbytes
+                  + rpool.nbytes)
+        leaf = ct.ContainerLeaf(
+            shards, entries, starts, kinds, self._place_pool(pool),
+            bn, nbytes, slots=slots, apool=self._place_pool(apool),
+            acard=self._place_pool(acard),
+            rpool=self._place_pool(rpool), an=an, rn=rn)
+        return leaf, (pool, apool, acard, rpool)
 
     @staticmethod
     def _place_pool(pool: np.ndarray):
@@ -956,7 +1077,8 @@ class Field:
     def _evict_and_insert(self, cache: dict, key, entry, entry_bytes: int,
                           max_entries: int, kind: str = "dense",
                           devices: int = 1, token=None, host=None,
-                          promote=None, fallback=None) -> None:
+                          promote=None, fallback=None,
+                          kind_detail=None) -> None:
         """Insert under the entry cap; BYTE budgeting is global — the
         process-wide residency manager sees every owner's device caches
         and LRU-evicts across all of them, so the true device total is
@@ -985,7 +1107,8 @@ class Field:
             cache[key] = entry
             mgr.admit(cache, key, entry_bytes, kind=kind,
                       devices=devices, token=token, host=host,
-                      promote=promote, fallback=fallback)
+                      promote=promote, fallback=fallback,
+                      kind_detail=kind_detail)
 
     def drop_shard_stacks(self, shard: int) -> int:
         """Drop every field-level stack-cache entry whose shard set

@@ -1365,6 +1365,27 @@ class Fragment:
                                               bits)
             return keys, blocks, bits
 
+    def row_container_kinds(self, row: int):
+        """``(keys, blocks, bits, kinds uint8[n])`` for one BASE row:
+        ``row_containers`` plus the cheapest storage kind per container
+        (ops/kindpools.pick_kinds — the serializer's own cost rule
+        under the configured [containers] array-max / run-cap), picked
+        at directory-build time.  Compaction bumps the base generation,
+        which rebuilds the directory and re-picks — ingest churn
+        promotes/demotes kinds for free.  ``None`` exactly when
+        ``row_containers`` is ``None`` (hot rows stay dense)."""
+        trio = self.row_containers(row)
+        if trio is None:
+            return None
+        keys, blocks, bits = trio
+        from pilosa_tpu.ops import containers as ct
+        from pilosa_tpu.ops import kindpools as kp
+
+        cfg = ct.config()
+        kinds = kp.pick_kinds(blocks, array_max=cfg.array_max,
+                              run_cap=cfg.run_cap)
+        return keys, blocks, bits, kinds
+
     def device_planes(self, depth: int):
         """BSI plane stack uint32[2 + depth, words] resident on device;
         accounted by the process-wide residency manager.  Tiered: the

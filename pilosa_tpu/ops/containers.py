@@ -63,6 +63,16 @@ CWORDS = CONTAINER_BITS // 32
 
 DEFAULT_THRESHOLD = 0.25
 
+#: Kind-selection defaults ([containers] kinds / array-max / run-cap):
+#: the device pick mirrors the serializer's cost rule
+#: (storage/roaring.pick_kind); ``array_max`` narrows the array-kind
+#: cardinality ceiling below the canonical 4096 and ``run_cap`` bounds
+#: the run pool's interval size class (a container with more maximal
+#: runs re-picks array/bitmap).
+DEFAULT_KINDS = True
+DEFAULT_ARRAY_MAX = 4096
+DEFAULT_RUN_CAP = 256
+
 
 def _pow2(n: int) -> int:
     """Smallest power of two >= n (domain/pool padding so the gather
@@ -80,11 +90,15 @@ class ContainersRuntimeConfig:
     """The process-wide [containers] knobs (one per process, like the
     residency budget and the [ingest] runtime config)."""
 
-    __slots__ = ("enabled", "threshold")
+    __slots__ = ("enabled", "threshold", "kinds", "array_max",
+                 "run_cap")
 
     def __init__(self) -> None:
         self.enabled = True
         self.threshold = DEFAULT_THRESHOLD
+        self.kinds = DEFAULT_KINDS
+        self.array_max = DEFAULT_ARRAY_MAX
+        self.run_cap = DEFAULT_RUN_CAP
 
 
 _cfg = ContainersRuntimeConfig()
@@ -98,7 +112,10 @@ def config() -> ContainersRuntimeConfig:
 
 
 def configure(enabled: bool | None = None,
-              threshold: float | None = None) -> ContainersRuntimeConfig:
+              threshold: float | None = None,
+              kinds: bool | None = None,
+              array_max: int | None = None,
+              run_cap: int | None = None) -> ContainersRuntimeConfig:
     """Apply [containers] config in place — only explicit values land,
     so a second in-process server cannot wipe the first's settings
     with defaults (same contract as ingest.configure)."""
@@ -107,6 +124,12 @@ def configure(enabled: bool | None = None,
             _cfg.enabled = bool(enabled)
         if threshold is not None:
             _cfg.threshold = float(threshold)
+        if kinds is not None:
+            _cfg.kinds = bool(kinds)
+        if array_max is not None:
+            _cfg.array_max = int(array_max)
+        if run_cap is not None:
+            _cfg.run_cap = int(run_cap)
     return _cfg
 
 
@@ -117,7 +140,8 @@ def retain() -> None:
     global _refs, _baseline
     with _cfg_lock:
         if _refs == 0 and _baseline is None:
-            _baseline = (_cfg.enabled, _cfg.threshold)
+            _baseline = (_cfg.enabled, _cfg.threshold, _cfg.kinds,
+                         _cfg.array_max, _cfg.run_cap)
         _refs += 1
 
 
@@ -129,7 +153,8 @@ def release() -> None:
         if _refs > 0:
             _refs -= 1
         if _refs == 0 and _baseline is not None:
-            _cfg.enabled, _cfg.threshold = _baseline
+            (_cfg.enabled, _cfg.threshold, _cfg.kinds,
+             _cfg.array_max, _cfg.run_cap) = _baseline
             _baseline = None
 
 
@@ -154,6 +179,11 @@ _counters = {
     "container.containers_skipped": 0,   # dense-layout containers the
                                          # directory walk never touched
     "container.empty_domains": 0,       # whole-query zero-work answers
+    # per-kind breakout of containers_gathered (kind-specialized
+    # algebra: which layouts the domain walks actually touch)
+    "container.bitmap_gathered": 0,
+    "container.array_gathered": 0,
+    "container.run_gathered": 0,
 }
 
 
@@ -189,6 +219,9 @@ def debug() -> dict[str, Any]:
     return {
         "enabled": _cfg.enabled,
         "threshold": _cfg.threshold,
+        "kinds": _cfg.kinds,
+        "arrayMax": _cfg.array_max,
+        "runCap": _cfg.run_cap,
         "counters": counters(),
     }
 
@@ -213,16 +246,29 @@ class ContainerLeaf:
     in host mode, device array otherwise) whose rows [n:] are zeros —
     gather index ``n`` is the canonical absent-container row.  ``kinds``
     mirrors the directory's per-container kind byte (1 = dense bitmap
-    block; array/run specializations are future kinds — the directory
-    carries the slot from day one so the layout doesn't change when
-    they land).
+    block, 2 = sorted-uint16 array, 3 = interval-list run).
+
+    A KINDS leaf (``slots`` non-None) splits its containers across
+    three pools: ``pool`` holds only the kind-1 dense blocks (``n`` is
+    the bitmap count, row ``n`` still the canonical zero), ``apool`` /
+    ``acard`` the array kind (uint16[Pa, acap] + int32[Pa], row ``an``
+    the canonical empty array), ``rpool`` the run kind (uint16[Pr,
+    2*rcap] interleaved (start, last), row ``rn`` all invalid pairs).
+    ``slots[i]`` gives each directory container its kind-LOCAL pool
+    row.  A legacy all-bitmap leaf keeps ``slots`` None and the other
+    pools empty — every pre-kinds code path sees exactly the old
+    layout.
     """
 
     __slots__ = ("shards", "entries", "starts", "kinds", "pool", "n",
-                 "nbytes", "uid")
+                 "nbytes", "uid", "slots", "apool", "acard", "rpool",
+                 "an", "rn")
 
     def __init__(self, shards: tuple, entries: list, starts: list,
-                 kinds: list, pool: Any, n: int, nbytes: int) -> None:
+                 kinds: list, pool: Any, n: int, nbytes: int,
+                 slots: list | None = None, apool: Any = None,
+                 acard: Any = None, rpool: Any = None,
+                 an: int = 0, rn: int = 0) -> None:
         self.shards = shards
         self.entries = entries
         self.starts = starts
@@ -230,6 +276,12 @@ class ContainerLeaf:
         self.pool = pool
         self.n = n
         self.nbytes = nbytes
+        self.slots = slots
+        self.apool = apool
+        self.acard = acard
+        self.rpool = rpool
+        self.an = an
+        self.rn = rn
         # identity for the staging memo: a rebuilt leaf (any base
         # mutation) is a NEW object with a fresh uid, so stale staged
         # gathers can never be addressed
@@ -238,6 +290,12 @@ class ContainerLeaf:
     def dense_slots(self) -> list[int]:
         """Shard positions whose fragment row is too hot to compress."""
         return [i for i, e in enumerate(self.entries) if e is None]
+
+    @property
+    def has_kinds(self) -> bool:
+        """True when this leaf carries array/run containers (the
+        kind-dispatched execution protocol applies)."""
+        return self.slots is not None
 
 
 # ------------------------------------------------------------ domain algebra
@@ -304,6 +362,46 @@ def _leaf_indices(leaf: ContainerLeaf, domains: list[np.ndarray],
     return out
 
 
+def _leaf_kind_indices(leaf: ContainerLeaf, domains: list[np.ndarray],
+                       pad_to: int) -> tuple:
+    """Kind-dispatched gather rows for the concatenated per-shard
+    domains: ``(kv, ib, ia, ir)`` — per-lane kind byte (0 = absent /
+    pad) plus per-kind-pool row indices.  Lanes whose kind differs
+    from a pool point at that pool's canonical zero row (bitmap row
+    ``n``, empty-array row ``an``, invalid-pairs row ``rn``), so a
+    gather-then-OR across the three decoded pools reconstructs each
+    lane's dense block exactly.  A legacy all-bitmap leaf yields kv in
+    {0, 1} with ``ib`` identical to ``_leaf_indices``."""
+    kv = np.zeros(pad_to, dtype=np.uint8)
+    ib = np.full(pad_to, leaf.n, dtype=np.int32)
+    ia = np.full(pad_to, leaf.an, dtype=np.int32)
+    ir = np.full(pad_to, leaf.rn, dtype=np.int32)
+    off = 0
+    for i, dom in enumerate(domains):
+        if len(dom) == 0:
+            continue
+        keys = leaf.entries[i]
+        if keys is None or len(keys) == 0:
+            off += len(dom)
+            continue
+        pos = np.searchsorted(keys, dom)
+        pos_c = np.minimum(pos, len(keys) - 1)
+        hit = keys[pos_c] == dom
+        if leaf.slots is None:
+            k = np.where(hit, 1, 0).astype(np.uint8)
+            loc = (leaf.starts[i] + pos_c).astype(np.int32)
+        else:
+            k = np.where(hit, leaf.kinds[i][pos_c], 0).astype(np.uint8)
+            loc = leaf.slots[i][pos_c].astype(np.int32)
+        seg = slice(off, off + len(dom))
+        kv[seg] = k
+        ib[seg] = np.where(k == 1, loc, leaf.n)
+        ia[seg] = np.where(k == 2, loc, leaf.an)
+        ir[seg] = np.where(k == 3, loc, leaf.rn)
+        off += len(dom)
+    return kv, ib, ia, ir
+
+
 # Staged-gather memo: (shape, leaf uids) -> (domains, bounds, total,
 # idxs).  The domain algebra and searchsorted index builds are pure
 # functions of the leaf directories, which are themselves cached per
@@ -314,6 +412,32 @@ def _leaf_indices(leaf: ContainerLeaf, domains: list[np.ndarray],
 _stage_lock = threading.Lock()
 _stage_memo: dict = {}
 _STAGE_MEMO_CAP = 256
+
+
+def _apool_row_bytes(leaf: ContainerLeaf) -> int:
+    """Gathered bytes per array-pool lane (values + cardinality)."""
+    return int(leaf.apool.shape[-1]) * 2 + 4
+
+
+def _bump_kind_gathers(idxs: list, total: int) -> None:
+    """Per-kind breakout of containers_gathered from the staged gather
+    rows (the live lanes only — the pow2 tail is kind 0)."""
+    bm = ar = rn = 0
+    for ix in idxs:
+        if isinstance(ix, tuple):
+            kv = ix[0][:total]
+            bm += int((kv == 1).sum())
+            ar += int((kv == 2).sum())
+            rn += int((kv == 3).sum())
+        else:
+            # legacy all-bitmap staging: every present lane is kind 1
+            bm += total
+    if bm:
+        bump("container.bitmap_gathered", bm)
+    if ar:
+        bump("container.array_gathered", ar)
+    if rn:
+        bump("container.run_gathered", rn)
 
 
 # ------------------------------------------------------------------ planning
@@ -361,8 +485,16 @@ class Plan:
             from pilosa_tpu.parallel import meshexec
 
             pad = meshexec.pad_domain(total) if total else 0
-            idxs = [_leaf_indices(leaf, domains, pad)
-                    for leaf in self.leaves]
+            # any array/run leaf switches the WHOLE query to the
+            # kind-dispatched gather protocol (uniform per-lane
+            # (kv, ib, ia, ir) tuples); all-bitmap queries keep the
+            # exact legacy index arrays
+            if any(leaf.has_kinds for leaf in self.leaves):
+                idxs = [_leaf_kind_indices(leaf, domains, pad)
+                        for leaf in self.leaves]
+            else:
+                idxs = [_leaf_indices(leaf, domains, pad)
+                        for leaf in self.leaves]
             hit = (domains, bounds, total, idxs)
             with _stage_lock:
                 _stage_memo[mkey] = hit
@@ -371,6 +503,7 @@ class Plan:
         domains, bounds, total, idxs = hit
         n_leaves = len(self.leaves)
         bump("container.containers_gathered", total * n_leaves)
+        _bump_kind_gathers(idxs, total)
         # what the dense layout would have streamed vs what the
         # directory walk actually touches — the bandwidth story
         bump("container.containers_skipped",
@@ -402,6 +535,15 @@ class Plan:
         # (the sparsity the compressed engine exploits)
         dense_work = len(self.leaves) * len(self.shards) * self.n_words
         sparsity = total / max(1, len(self.shards) * self.cpr)
+        if any(isinstance(ix, tuple) for ix in idxs):
+            # kind-dispatched protocol: pair-matrix arms for the
+            # homogeneous AND pair, else the generic decode-at-gather
+            # program.  Always single-device — plan_fused builds
+            # legacy all-bitmap leaves while a mesh is active, so a
+            # non-None mesh here can only be a toggle race; the
+            # single-device program stays bit-exact regardless.
+            return self._gathered_kinds(counts, idxs, total,
+                                        dense_work, sparsity)
         if (counts and mesh is None
                 and self.shape == ("and", ("leaf", 0), ("leaf", 1))
                 and pk.on_tpu() and not isinstance(pools[0], np.ndarray)):
@@ -421,6 +563,73 @@ class Plan:
             return expr.evaluate_gathered(self.shape, tuple(pools),
                                           tuple(idxs), counts=counts,
                                           mesh=mesh)
+
+    def _gathered_kinds(self, counts: bool, idxs: list, total: int,
+                        dense_work: int, sparsity: float) -> Any:
+        """The kind-dispatched launch: host directory algebra has
+        already resolved every lane's (kind, pool-row) pair, so this
+        picks the cheapest ARM for the query — the Roaring pair
+        matrix's array∩array (galloping membership) and array∩bitmap
+        (gather-test) specializations for the homogeneous counts-root
+        AND pair, else the generic decode-at-gather program (gather
+        compact rows, decode to dense blocks, fold the tree — still
+        ONE launch).  Bit-exact with the dense route by construction:
+        every arm computes the same container algebra."""
+        from pilosa_tpu.ops import bitmap as bm
+        from pilosa_tpu.ops import expr
+        from pilosa_tpu.ops import pallas_kernels as pk
+
+        if counts and self.shape == ("and", ("leaf", 0), ("leaf", 1)):
+            # an AND domain is the keyset intersection, so every live
+            # lane is present in BOTH leaves: the lane kinds alone
+            # decide the arm
+            kv0 = idxs[0][0][:total]
+            kv1 = idxs[1][0][:total]
+            l0, l1 = self.leaves[0], self.leaves[1]
+            if (kv0 == 2).all() and (kv1 == 2).all():
+                bm.note_dispatch("fused_gather")
+                t0 = _perfobs.t0()
+                out = pk.gathered_count_array_array(
+                    l0.apool, l0.acard, idxs[0][2],
+                    l1.apool, l1.acard, idxs[1][2])
+                _perfobs.sample(
+                    "gather_aa", out, t0,
+                    nbytes=(len(idxs[0][2]) * _apool_row_bytes(l0)
+                            + len(idxs[1][2]) * _apool_row_bytes(l1)),
+                    work=dense_work, sparsity=sparsity)
+                return out
+            pair = None
+            if (kv0 == 2).all() and (kv1 == 1).all():
+                pair = (l0, idxs[0], l1, idxs[1])
+            elif (kv0 == 1).all() and (kv1 == 2).all():
+                pair = (l1, idxs[1], l0, idxs[0])
+            if pair is not None:
+                al, aix, bl, bix = pair
+                bm.note_dispatch("fused_gather")
+                t0 = _perfobs.t0()
+                out = pk.gathered_count_array_bitmap(
+                    al.apool, al.acard, aix[2], bl.pool, bix[1])
+                _perfobs.sample(
+                    "gather_ab", out, t0,
+                    nbytes=(len(aix[2]) * _apool_row_bytes(al)
+                            + len(bix[1]) * CWORDS * 4),
+                    work=dense_work, sparsity=sparsity)
+                return out
+        leafops = []
+        for leaf, ix in zip(self.leaves, idxs):
+            _kv, ib, ia, ir = ix
+            if leaf.has_kinds:
+                leafops.append(("k", leaf.pool, leaf.apool, leaf.acard,
+                                leaf.rpool, ib, ia, ir))
+            else:
+                # legacy all-bitmap leaf inside a kinds query: plain
+                # gather (kv is {0, 1} and ib already routes absents
+                # at the zero row)
+                leafops.append(("b", leaf.pool, ib))
+        with _perfobs.context(sparsity=sparsity, work=dense_work):
+            return expr.evaluate_gathered_kinds(self.shape,
+                                                tuple(leafops),
+                                                counts=counts)
 
     # ----------------------------------------------------------- execution
 
@@ -509,10 +718,12 @@ def stage_vm(idx: Any, call: Any, shards: tuple,
     from pilosa_tpu.ops import tape as _tp
 
     if not _cfg.enabled or not shards:
+        _tp.bump("vm.fallbacks.disabled")
         return None
     leaf_descs: list = []
     shape = _walk(idx, call, leaf_descs)
     if shape is None or not leaf_descs:
+        _tp.bump("vm.fallbacks.ineligible_leaf")
         return None
     nodemap: dict = {}
     leaves: list[ContainerLeaf] = []
@@ -527,6 +738,14 @@ def stage_vm(idx: Any, call: Any, shards: tuple,
         base = f.device_container_leaf(row_id, shards)
         if base.dense_slots():
             bump("container.fallbacks")
+            _tp.bump("vm.fallbacks.ineligible_leaf")
+            return None
+        if base.has_kinds and any(
+                k is not None and len(k) and int(k.max()) > 3
+                for k in base.kinds):
+            # a kind byte this VM has no decode arm for (forward
+            # compatibility: directories may carry future kinds)
+            _tp.bump("vm.fallbacks.kind_unsupported")
             return None
         bi = len(leaves)
         leaves.append(base)
@@ -548,9 +767,11 @@ def stage_vm(idx: Any, call: Any, shards: tuple,
     vshape = subst(shape)
     if max_leaves is not None and len(leaves) > max_leaves:
         _tp.bump("tape.oversize_fallbacks")
+        _tp.bump("vm.fallbacks.oversize")
         return None
     tp = _tp.try_compile(vshape, len(leaves), max_tape)
     if tp is None:
+        _tp.bump("vm.fallbacks.oversize")
         return None
     mkey = ("vm", vshape, tuple(leaf.uid for leaf in leaves),
             int(min_domain))
@@ -565,7 +786,12 @@ def stage_vm(idx: Any, call: Any, shards: tuple,
             domains.append(_domain(vshape, keysets))
         total = int(sum(len(d) for d in domains))
         pad = max(int(min_domain), _pow2(max(1, total)))
-        idxs = [_leaf_indices(leaf, domains, pad) for leaf in leaves]
+        if any(leaf.has_kinds for leaf in leaves):
+            idxs = [_leaf_kind_indices(leaf, domains, pad)
+                    for leaf in leaves]
+        else:
+            idxs = [_leaf_indices(leaf, domains, pad)
+                    for leaf in leaves]
         hit = (total, pad, idxs)
         with _stage_lock:
             _stage_memo[mkey] = hit
@@ -574,13 +800,20 @@ def stage_vm(idx: Any, call: Any, shards: tuple,
     total, pad, idxs = hit
     if max_prefetch is not None and len(leaves) * pad > max_prefetch:
         # a single query's directory would blow the per-launch scalar
-        # budget even unbatched — the dense engines take it
+        # budget even unbatched — the dense engines take it.  When the
+        # plain pow2 pad would have fit, the configured min-domain
+        # floor itself blew the budget — its own reason cell
+        if len(leaves) * _pow2(max(1, total)) <= max_prefetch:
+            _tp.bump("vm.fallbacks.min_domain")
+        else:
+            _tp.bump("vm.fallbacks.max_prefetch")
         return None
     from pilosa_tpu.shardwidth import SHARD_WIDTH
 
     cpr = SHARD_WIDTH // CONTAINER_BITS
     n_leaves = len(leaves)
     bump("container.containers_gathered", total * n_leaves)
+    _bump_kind_gathers(idxs, total)
     bump("container.containers_skipped",
          n_leaves * (len(shards) * cpr - total))
     if total == 0:
@@ -602,13 +835,56 @@ _megapool_memo: dict = {}
 _MEGAPOOL_MEMO_CAP = 8
 
 
+class MegaPools:
+    """A VM bucket's per-kind megapools: the bitmap rows plus the
+    compact array/run pools whose DECODED dense rows conceptually
+    append after them — one virtual dense pool of ``shape[0]`` rows
+    the combined gather index addresses (``[0, Rb)`` bitmap, ``[Rb,
+    Rb + Ra)`` array, ``[Rb + Ra, Rb + Ra + Rr)`` run).  The decode
+    happens INSIDE the one jitted VM launch
+    (ops/pallas_kernels.vm_counts), so resident and transferred bytes
+    stay compact.  ``shape``/``ndim`` quack like the plain dense pool
+    for the tape's size accounting; ``nbytes`` is the real compact
+    total."""
+
+    __slots__ = ("bpool", "apool", "acard", "rpool")
+
+    def __init__(self, bpool: Any, apool: Any, acard: Any,
+                 rpool: Any) -> None:
+        self.bpool = bpool
+        self.apool = apool
+        self.acard = acard
+        self.rpool = rpool
+
+    @property
+    def ndim(self) -> int:
+        return 2
+
+    @property
+    def shape(self) -> tuple:
+        rows = (int(self.bpool.shape[0]) + int(self.apool.shape[0])
+                + int(self.rpool.shape[0]))
+        return (rows, CWORDS)
+
+    @property
+    def nbytes(self) -> int:
+        return (int(self.bpool.nbytes) + int(self.apool.nbytes)
+                + int(self.acard.nbytes) + int(self.rpool.nbytes))
+
+
 def megapool(leaves: list) -> tuple:
     """(pool, bases, zero_index) for a set of container leaves: the
     concatenated word pool a VM bucket gathers from, each leaf's row
     offset keyed by uid, and a canonical all-zero row (the first
     leaf's own zero tail).  Device megapools pad their row count to
     pow2 with zero rows so the gather programs keep lowering O(log)
-    distinct shapes (the P4 rule); host pools stay tight."""
+    distinct shapes (the P4 rule); host pools stay tight.
+
+    When any leaf carries array/run containers the pool is a
+    ``MegaPools`` bundle and ``bases[uid]`` is the per-kind offset
+    triple ``(bb, ab, rb)`` into the bundle's virtual dense row space;
+    otherwise the legacy scalar-base dense pool is returned
+    byte-identically."""
     order = sorted({leaf.uid: leaf for leaf in leaves}.values(),
                    key=lambda leaf: leaf.uid)
     key = tuple(leaf.uid for leaf in order)
@@ -617,6 +893,18 @@ def megapool(leaves: list) -> tuple:
         if hit is not None:
             _megapool_memo[key] = _megapool_memo.pop(key)  # LRU touch
             return hit
+    if any(leaf.has_kinds for leaf in order):
+        hit = _megapool_kinds(order)
+    else:
+        hit = _megapool_plain(order)
+    with _mega_lock:
+        _megapool_memo[key] = hit
+        while len(_megapool_memo) > _MEGAPOOL_MEMO_CAP:
+            _megapool_memo.pop(next(iter(_megapool_memo)))
+    return hit
+
+
+def _megapool_plain(order: list) -> tuple:
     bases: dict = {}
     off = 0
     for leaf in order:
@@ -637,12 +925,101 @@ def megapool(leaves: list) -> tuple:
             parts.append(jnp.zeros((rows - off, CWORDS),
                                    dtype=jnp.uint32))
         pool = jnp.concatenate(parts, axis=0)
-    hit = (pool, bases, zero_index)
-    with _mega_lock:
-        _megapool_memo[key] = hit
-        while len(_megapool_memo) > _MEGAPOOL_MEMO_CAP:
-            _megapool_memo.pop(next(iter(_megapool_memo)))
-    return hit
+    return (pool, bases, zero_index)
+
+
+def _megapool_kinds(order: list) -> tuple:
+    """Concatenate per-kind pools across leaves into one MegaPools
+    bundle.  Column widths re-pad to the cross-leaf pow2 maximum and
+    device row counts pad to pow2 per kind pool (array tails with the
+    sorted-safe 0xFFFF pad, run tails with the invalid (1, 0) pair —
+    both decode to nothing); a leaf without a kind contributes zero
+    rows to that pool."""
+    from pilosa_tpu.ops import kindpools as kp
+
+    host = all(isinstance(leaf.pool, np.ndarray) for leaf in order)
+    acap = max([int(leaf.apool.shape[-1]) for leaf in order
+                if leaf.apool is not None] or [1])
+    rcap = max([int(leaf.rpool.shape[-1]) for leaf in order
+                if leaf.rpool is not None] or [2])
+    boffs: dict = {}
+    aoffs: dict = {}
+    roffs: dict = {}
+    boff = aoff = roff = 0
+    bparts: list = []
+    aparts: list = []
+    cparts: list = []
+    rparts: list = []
+    for leaf in order:
+        boffs[leaf.uid] = boff
+        aoffs[leaf.uid] = aoff
+        roffs[leaf.uid] = roff
+        boff += int(leaf.pool.shape[0])
+        bparts.append(leaf.pool)
+        if leaf.apool is not None and int(leaf.apool.shape[0]):
+            rows = int(leaf.apool.shape[0])
+            aparts.append((leaf.apool, rows, int(leaf.apool.shape[-1])))
+            cparts.append(leaf.acard)
+            aoff += rows
+        if leaf.rpool is not None and int(leaf.rpool.shape[0]):
+            rows = int(leaf.rpool.shape[0])
+            rparts.append((leaf.rpool, rows, int(leaf.rpool.shape[-1])))
+            roff += rows
+
+    def _apad(rows: int, cols: int) -> np.ndarray:
+        return np.full((rows, cols), kp.ARRAY_PAD, dtype=np.uint16)
+
+    def _rpad(rows: int, cols: int) -> np.ndarray:
+        out = np.zeros((rows, cols), dtype=np.uint16)
+        out[:, 0::2] = 1  # (1, 0): the canonical invalid pair
+        return out
+
+    if host:
+        xp = np
+    else:
+        import jax.numpy as jnp
+
+        xp = jnp
+    # row counts: pow2 per kind pool on device (the P4 O(log)-shapes
+    # rule for the decode program); tight on host
+    rb = boff if host else _pow2(max(1, boff))
+    ra = max(1, aoff) if host else _pow2(max(1, aoff))
+    rr = max(1, roff) if host else _pow2(max(1, roff))
+    bits = [xp.asarray(p) for p in bparts]
+    if rb > boff:
+        bits.append(xp.zeros((rb - boff, CWORDS), dtype=xp.uint32))
+    bpool = bits[0] if len(bits) == 1 else xp.concatenate(bits, axis=0)
+    avs: list = []
+    for p, rows, cols in aparts:
+        p = xp.asarray(p)
+        if cols < acap:
+            p = xp.concatenate([p, xp.asarray(_apad(rows, acap - cols))],
+                               axis=1)
+        avs.append(p)
+    if ra > aoff:
+        avs.append(xp.asarray(_apad(ra - aoff, acap)))
+    apool = avs[0] if len(avs) == 1 else xp.concatenate(avs, axis=0)
+    cvs = [xp.asarray(c) for c in cparts]
+    if ra > aoff:
+        cvs.append(xp.zeros(ra - aoff, dtype=xp.int32))
+    acard = cvs[0] if len(cvs) == 1 else xp.concatenate(cvs, axis=0)
+    rvs: list = []
+    for p, rows, cols in rparts:
+        p = xp.asarray(p)
+        if cols < rcap:
+            p = xp.concatenate([p, xp.asarray(_rpad(rows, rcap - cols))],
+                               axis=1)
+        rvs.append(p)
+    if rr > roff:
+        rvs.append(xp.asarray(_rpad(rr - roff, rcap)))
+    rpool = rvs[0] if len(rvs) == 1 else xp.concatenate(rvs, axis=0)
+    # bases address the VIRTUAL dense row space: bitmap rows first,
+    # then the decoded array rows, then the decoded run rows
+    bases = {leaf.uid: (boffs[leaf.uid], rb + aoffs[leaf.uid],
+                        rb + ra + roffs[leaf.uid])
+             for leaf in order}
+    zero_index = boffs[order[0].uid] + order[0].n
+    return (MegaPools(bpool, apool, acard, rpool), bases, zero_index)
 
 
 def _walk(idx: Any, call: Any, leaves: list) -> tuple | None:

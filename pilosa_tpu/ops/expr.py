@@ -209,6 +209,57 @@ def _build_gather_program(shape: tuple, counts: bool) -> Callable[..., Any]:
     return _devobs.instrument(name, jax.jit(run))
 
 
+def _build_gather_kinds_program(key: tuple,
+                                counts: bool) -> Callable[..., Any]:
+    """The kind-dispatched variant of ``_build_gather_program``
+    (roaring array/run parity, ops/kindpools.py): each leaf gathers
+    compact rows from its per-kind pools and DECODES them to dense
+    2048-word blocks inside the same launch — a lane's three gathers
+    hit its own kind's row and the other kinds' canonical zero rows,
+    so an OR reconstructs the block exactly and resident/transferred
+    bytes stay compact.  ``key`` is ``(shape, spec)`` where ``spec``
+    tags each leaf ``"b"`` (plain bitmap pool + index) or ``"k"``
+    (bpool, apool, acard, rpool, ib, ia, ir); arguments flatten in
+    leaf order."""
+    shape, spec = key
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from pilosa_tpu.ops import kindpools as kp
+
+    ev = _build_jnp(shape)
+
+    def run(*args: Any) -> Any:
+        leaves = []
+        i = 0
+        for tag in spec:
+            if tag == "b":
+                pool, ib = args[i:i + 2]
+                i += 2
+                leaves.append(jnp.take(pool, ib, axis=0, mode="clip"))
+                continue
+            bpool, apool, acard, rpool, ib, ia, ir = args[i:i + 7]
+            i += 7
+            dense = jnp.take(bpool, ib, axis=0, mode="clip")
+            av = jnp.take(apool, ia, axis=0, mode="clip")
+            ac = jnp.take(acard, ia, axis=0, mode="clip")
+            rv = jnp.take(rpool, ir, axis=0, mode="clip")
+            leaves.append(dense | kp.decode_array_jnp(av, ac)
+                          | kp.decode_runs_jnp(rv))
+        out = ev(tuple(leaves))
+        if counts:
+            return jnp.sum(lax.population_count(out),
+                           axis=-1, dtype=jnp.int32)
+        return out
+
+    from pilosa_tpu import devobs as _devobs
+
+    name = ("expr.fused_gather_kinds_counts" if counts
+            else "expr.fused_gather_kinds")
+    return _devobs.instrument(name, jax.jit(run))
+
+
 def _build_mesh_program(meshkey: tuple, counts: bool) -> Callable[..., Any]:
     """The mesh-native variant of ``_build_program``: the same tree
     body runs per-device on shard-axis blocks under ``shard_map``
@@ -382,6 +433,10 @@ _compiled = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE)
 #: variants of one shape are two entries — sized accordingly
 _compiled_gather = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE,
                                   build=_build_gather_program)
+#: kind-dispatched gather programs (array/run container parity): keyed
+#: on (shape, per-leaf kind spec) composites
+_compiled_gather_kinds = _make_compiled(DEFAULT_PROGRAM_CACHE_SIZE,
+                                        build=_build_gather_kinds_program)
 #: mesh-program caches (parallel/meshexec.py): keyed on the composite
 #: (shape, n_leaves, ndim, mesh) — the Mesh is a cached singleton, so
 #: one config's programs stay warm across queries and an axis resize
@@ -400,6 +455,7 @@ def program_evictions() -> int:
     builds never inflate it."""
     return (_compiled.cache_evictions()
             + _compiled_gather.cache_evictions()
+            + _compiled_gather_kinds.cache_evictions()
             + _compiled_mesh.cache_evictions()
             + _compiled_mesh_gather.cache_evictions())
 
@@ -410,9 +466,12 @@ def set_program_cache_size(maxsize: int) -> None:
     test run with tracing)."""
     global _compiled, _compiled_gather, _eviction_warned
     global _compiled_mesh, _compiled_mesh_gather
+    global _compiled_gather_kinds
     _compiled = _make_compiled(maxsize)
     _compiled_gather = _make_compiled(maxsize,
                                       build=_build_gather_program)
+    _compiled_gather_kinds = _make_compiled(
+        maxsize, build=_build_gather_kinds_program)
     _compiled_mesh = _make_compiled(maxsize,
                                     build=_build_mesh_program)
     _compiled_mesh_gather = _make_compiled(
@@ -611,4 +670,68 @@ def evaluate_gathered(shape: tuple, pools: tuple, idxs: tuple,
         pools[0].shape[-1] * 4 if pools else 0)
     _perfobs.sample("gather", out, t0,
                     nbytes=gathered + _touched_bytes(*idxs, out))
+    return out
+
+
+def evaluate_gathered_kinds(shape: tuple, leafops: tuple,
+                            counts: bool = False) -> Any:
+    """Evaluate one compiled tree over KIND-SPLIT container operands in
+    ONE launch (roaring array/run parity; ops/kindpools.py holds the
+    layouts, ops/containers.py stages the indices).
+
+    ``leafops[i]`` is either ``("b", pool, ib)`` — a legacy all-bitmap
+    leaf, gathered exactly like ``evaluate_gathered`` — or ``("k",
+    bpool, apool, acard, rpool, ib, ia, ir)`` — a kind-split leaf whose
+    three index vectors each point at the lane's own row in its kind's
+    pool and at the OTHER pools' canonical zero rows, so gather +
+    decode + OR reconstructs the lane's dense block inside the launch.
+    Mesh execution never reaches here (ops/containers.py builds legacy
+    leaves while a mesh is active)."""
+    _validate(shape, len(leafops))
+    bm.note_dispatch("fused_gather")
+    t0 = _perfobs.t0()
+    from pilosa_tpu.ops import kindpools as kp
+
+    if bm._host(*(op[1] for op in leafops)):
+        leaves = []
+        for op in leafops:
+            if op[0] == "b":
+                _, pool, ib = op
+                leaves.append(pool[np.asarray(ib)])
+                continue
+            _, bpool, apool, acard, rpool, ib, ia, ir = op
+            ib, ia, ir = (np.asarray(v) for v in (ib, ia, ir))
+            leaves.append(bpool[ib]
+                          | kp.decode_array_np(apool[ia], acard[ia])
+                          | kp.decode_runs_np(rpool[ir]))
+        leaves = tuple(leaves)
+        out = (_host_counts(shape, leaves) if counts
+               else _host_tree(shape, leaves))
+        _perfobs.sample("gather_kinds", out, t0,
+                        nbytes=_touched_bytes(*leaves, out))
+        return out
+    import jax.numpy as jnp
+
+    spec = tuple(op[0] for op in leafops)
+    args: list[Any] = []
+    gathered = 0
+    for op in leafops:
+        if op[0] == "b":
+            _, pool, ib = op
+            args.extend((pool, jnp.asarray(ib)))
+            gathered += len(ib) * pool.shape[-1] * 4
+            continue
+        _, bpool, apool, acard, rpool, ib, ia, ir = op
+        args.extend((bpool, apool, acard, rpool,
+                     jnp.asarray(ib), jnp.asarray(ia), jnp.asarray(ir)))
+        # the launch reads one compact row per lane per pool — the
+        # whole point of the kind split is that those rows are small
+        gathered += len(ib) * (bpool.shape[-1] * 4
+                               + apool.shape[-1] * 2 + 4
+                               + rpool.shape[-1] * 2)
+    fn = _compiled_gather_kinds((shape, spec), counts)
+    _note_program_cache_pressure()
+    out = fn(*args)
+    _perfobs.sample("gather_kinds", out, t0,
+                    nbytes=gathered + _touched_bytes(out))
     return out

@@ -1,0 +1,239 @@
+"""Per-kind container pools: the array/run compact layouts behind the
+compressed container directory (ops/containers.py).
+
+PR 10 put roaring's *directory* on device but kept every container a
+kind-1 dense block: a 100-bit container still costs 2048 pool words.
+This module supplies the other two reference kinds (Chambi et al. /
+Lemire et al.; PAPERS.md 1402.6407, 1603.06549) as DEVICE layouts:
+
+- **array** (kind 2) — sorted uint16 values, cardinality <= 4096,
+  packed ``uint16[n, acap]`` with per-container cardinality; ``acap``
+  is the pow2 size class of the pool's largest card, so megapool bytes
+  track real cardinality instead of 8 KiB per container.
+- **run** (kind 3) — maximal ``(start, last)`` inclusive intervals,
+  packed ``uint16[n, 2*rcap]`` interleaved; padding pairs are the
+  canonical invalid interval ``(1, 0)``.
+
+Kind selection is ``storage/roaring.pick_kind`` — the SAME cost rule
+the serializer uses, so wire and device kinds cannot drift.  Decoders
+come in numpy and jnp twins that are bit-exact by construction: pure
+integer scatter/shift algebra, no floats —
+
+- array decode scatters ``1 << (v & 31)`` at word ``v >> 5``; values
+  are sorted-unique so in-word contributions are distinct powers of
+  two and add == or, and the cardinality mask zeroes the padding tail;
+- run decode scatters XOR toggles at ``start`` and ``last + 1`` (runs
+  are maximal, so toggle positions are strictly increasing and add ==
+  xor), then a log-shift in-word prefix-XOR plus a word-level carry
+  parity turns toggles into coverage — O(words) with no 2^16-wide
+  temporary.
+
+Everything here is a pure function of its inputs (no module state);
+jax imports are lazy so host-mode paths never touch the device stack.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pilosa_tpu.storage.roaring import (ARRAY_MAX_CARD, KIND_ARRAY,
+                                        KIND_BITMAP, KIND_RUN)
+
+#: Container geometry (must match ops/containers.py).
+CONTAINER_BITS = 1 << 16
+CWORDS = CONTAINER_BITS // 32
+
+#: Default ceiling on interval count for the run kind: a container
+#: whose maximal-run count exceeds this re-picks array/bitmap, so the
+#: run pool's pow2 size class stays bounded ([containers] run-cap).
+DEFAULT_RUN_CAP = 256
+
+#: Array-pool padding value: >= every real uint16, so padded rows stay
+#: sorted for the galloping/binary-search intersection arms.
+ARRAY_PAD = 0xFFFF
+
+_PICK_CHUNK = 256  # containers unpacked per chunk (bounds the 2^16-bit
+                   # temporary at ~16 MiB)
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+def pick_kinds(blocks: np.ndarray, array_max: int = ARRAY_MAX_CARD,
+               run_cap: int = DEFAULT_RUN_CAP) -> np.ndarray:
+    """Cheapest kind per dense container block (uint32[n, CWORDS]) by
+    the serializer's cost rule, with the device-only ``run_cap``
+    demotion (too many intervals -> array/bitmap) applied."""
+    cards, runs = block_stats(blocks)
+    eff_runs = np.where(runs <= run_cap, runs, ARRAY_MAX_CARD)
+    run_size = 2 + 4 * eff_runs
+    array_size = np.where(cards <= array_max, 2 * cards, np.int64(1) << 40)
+    kinds = np.where(
+        (run_size < array_size) & (run_size < 8192), KIND_RUN,
+        np.where(array_size <= 8192, KIND_ARRAY, KIND_BITMAP))
+    return kinds.astype(np.uint8)
+
+
+def block_stats(blocks: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(cardinality int64[n], maximal-run count int64[n]) per dense
+    container block — vectorized twin of roaring.container_stats."""
+    n = len(blocks)
+    cards = np.zeros(n, dtype=np.int64)
+    runs = np.zeros(n, dtype=np.int64)
+    for lo in range(0, n, _PICK_CHUNK):
+        chunk = np.ascontiguousarray(blocks[lo:lo + _PICK_CHUNK])
+        bits = np.unpackbits(chunk.view(np.uint8), axis=1,
+                             bitorder="little")
+        cards[lo:lo + len(chunk)] = bits.sum(axis=1, dtype=np.int64)
+        first = bits[:, :1].astype(np.int64)
+        rises = (np.diff(bits.astype(np.int8), axis=1) == 1)
+        runs[lo:lo + len(chunk)] = (first[:, 0]
+                                    + rises.sum(axis=1, dtype=np.int64))
+    return cards, runs
+
+
+def split_pools(blocks: np.ndarray, kinds: np.ndarray) -> tuple:
+    """Split a directory's dense blocks into per-kind compact pools.
+
+    Returns ``(slots, bblocks, apool, acard, rpool)``: ``slots`` is
+    the kind-LOCAL row of each container (int32[n], numbering within
+    its own kind pool, directory order preserved per kind);
+    ``bblocks`` the kind-1 dense rows uint32[bn, CWORDS]; ``apool`` /
+    ``acard`` the array pool uint16[an, acap] + int32[an]; ``rpool``
+    the run pool uint16[rn, 2*rcap].  Pool column widths are pow2 size
+    classes of the pool's own maxima (the P4 O(log)-shapes rule)."""
+    n = len(kinds)
+    slots = np.zeros(n, dtype=np.int32)
+    for k in (KIND_BITMAP, KIND_ARRAY, KIND_RUN):
+        sel = kinds == k
+        slots[sel] = np.arange(int(sel.sum()), dtype=np.int32)
+    bblocks = np.ascontiguousarray(blocks[kinds == KIND_BITMAP])
+
+    avals: list[np.ndarray] = []
+    rpairs: list[np.ndarray] = []
+    for i in range(n):
+        if kinds[i] == KIND_BITMAP:
+            continue
+        bits = np.unpackbits(
+            np.ascontiguousarray(blocks[i]).view(np.uint8),
+            bitorder="little")
+        if kinds[i] == KIND_ARRAY:
+            avals.append(np.flatnonzero(bits).astype(np.uint16))
+        else:
+            starts = np.flatnonzero(
+                np.diff(np.concatenate(([0], bits))) == 1)
+            ends = np.flatnonzero(
+                np.diff(np.concatenate((bits, [0]))) == -1)
+            pr = np.empty((len(starts), 2), dtype=np.uint16)
+            pr[:, 0] = starts
+            pr[:, 1] = ends
+            rpairs.append(pr)
+
+    acap = _pow2(max([len(v) for v in avals], default=0) or 1)
+    apool = np.full((len(avals), acap), ARRAY_PAD, dtype=np.uint16)
+    acard = np.zeros(len(avals), dtype=np.int32)
+    for i, v in enumerate(avals):
+        apool[i, :len(v)] = v
+        acard[i] = len(v)
+
+    rcap = _pow2(max([len(p) for p in rpairs], default=0) or 1)
+    rpool = np.zeros((len(rpairs), 2 * rcap), dtype=np.uint16)
+    rpool[:, 0::2] = 1  # (1, 0): the canonical invalid padding pair
+    for i, p in enumerate(rpairs):
+        rpool[i, :2 * len(p)] = p.reshape(-1)
+    return slots, bblocks, apool, acard, rpool
+
+
+# ------------------------------------------------------------- decoders
+#
+# numpy and jnp twins of the same integer algebra — bit-exact by
+# construction (see module docstring).  Both accept a zero-row pool
+# (n == 0) and return uint32[n, CWORDS].
+
+
+def decode_array_np(apool: np.ndarray, acard: np.ndarray) -> np.ndarray:
+    n, cap = apool.shape
+    out = np.zeros((n, CWORDS), dtype=np.uint32)
+    if n == 0:
+        return out
+    vals = apool.astype(np.int64)
+    valid = np.arange(cap, dtype=np.int64)[None, :] < acard[:, None]
+    contrib = np.where(valid, np.int64(1) << (vals & 31),
+                       0).astype(np.uint32)
+    rows = np.broadcast_to(np.arange(n)[:, None], vals.shape)
+    word = np.where(valid, vals >> 5, 0)
+    np.bitwise_or.at(out, (rows, word), contrib)
+    return out
+
+
+def decode_runs_np(rpool: np.ndarray) -> np.ndarray:
+    n = rpool.shape[0]
+    if n == 0:
+        return np.zeros((0, CWORDS), dtype=np.uint32)
+    pairs = rpool.reshape(n, -1, 2).astype(np.int64)
+    s, l = pairs[..., 0], pairs[..., 1]
+    valid = l >= s
+    rows = np.broadcast_to(np.arange(n)[:, None], s.shape)
+    t = np.zeros((n, CWORDS + 1), dtype=np.uint32)
+    for pos in (s, l + 1):
+        p = np.where(valid, pos, 0)
+        contrib = np.where(valid, np.int64(1) << (p & 31),
+                           0).astype(np.uint32)
+        np.add.at(t, (rows, p >> 5), contrib)
+    x = t[:, :CWORDS]  # a toggle at bit 2^16 covers nothing in-range
+    for sh in (1, 2, 4, 8, 16):
+        x = x ^ (x << np.uint32(sh))
+    wordpar = (x >> np.uint32(31)).astype(np.int64)
+    carry = ((np.cumsum(wordpar, axis=1) - wordpar) & 1).astype(np.uint32)
+    return x ^ (carry * np.uint32(0xFFFFFFFF))
+
+
+def decode_array_jnp(apool, acard):
+    import jax.numpy as jnp
+
+    n, cap = apool.shape
+    if n == 0:
+        return jnp.zeros((0, CWORDS), dtype=jnp.uint32)
+    vals = apool.astype(jnp.int32)
+    valid = jnp.arange(cap, dtype=jnp.int32)[None, :] < acard[:, None]
+    contrib = jnp.where(valid,
+                        jnp.uint32(1) << (vals & 31).astype(jnp.uint32),
+                        jnp.uint32(0))
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], vals.shape)
+    word = jnp.where(valid, vals >> 5, 0)
+    out = jnp.zeros((n, CWORDS), dtype=jnp.uint32)
+    # sorted-unique values: in-word contributions are distinct powers
+    # of two, so scatter-add == scatter-or (no carries)
+    return out.at[rows, word].add(contrib)
+
+
+def decode_runs_jnp(rpool):
+    import jax.numpy as jnp
+
+    n = rpool.shape[0]
+    if n == 0:
+        return jnp.zeros((0, CWORDS), dtype=jnp.uint32)
+    pairs = rpool.reshape(n, -1, 2).astype(jnp.int32)
+    s, l = pairs[..., 0], pairs[..., 1]
+    valid = l >= s
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], s.shape)
+    t = jnp.zeros((n, CWORDS + 1), dtype=jnp.uint32)
+    for pos in (s, l + 1):
+        p = jnp.where(valid, pos, 0)
+        contrib = jnp.where(valid,
+                            jnp.uint32(1) << (p & 31).astype(jnp.uint32),
+                            jnp.uint32(0))
+        # maximal runs: toggle positions strictly increase, so in-word
+        # contributions are distinct powers of two and add == xor
+        t = t.at[rows, p >> 5].add(contrib)
+    x = t[:, :CWORDS]
+    for sh in (1, 2, 4, 8, 16):
+        x = x ^ (x << jnp.uint32(sh))
+    wordpar = (x >> jnp.uint32(31)).astype(jnp.int32)
+    carry = ((jnp.cumsum(wordpar, axis=1) - wordpar)
+             & 1).astype(jnp.uint32)
+    return x ^ (carry * jnp.uint32(0xFFFFFFFF))

@@ -285,6 +285,121 @@ def gathered_count_and(a_pool, ai, b_pool, bi, interpret: bool = False):
 
 
 # ---------------------------------------------------------------------------
+# kind-specialized pair counts (roaring pair-matrix arms, ops/kindpools.py
+# layouts).  array∩array runs a vectorized binary-search membership test
+# (the galloping/binary-search hybrid of roaring's array-array intersect,
+# roaring/arraycontainer.go) over the compact uint16 pools; array∩bitmap
+# gather-tests each value's word/bit.  Both touch ONLY compact rows —
+# no dense 2048-word block exists anywhere on these arms — and both have
+# numpy twins that are bit-exact by construction (same integer algebra).
+# The caller (containers.Plan._gathered_kinds) owns the dispatch tick.
+# ---------------------------------------------------------------------------
+
+
+def _count_aa_one(v0, c0, v1, c1):
+    import jax.numpy as jnp  # shadows module alias inside vmap trace
+
+    pos = jnp.searchsorted(v1, v0)
+    probe = jnp.take(v1, jnp.minimum(pos, v1.shape[0] - 1))
+    # pos < c1 rejects pad hits: padding is 0xFFFF, so a REAL 65535 in
+    # v1 sits at pos c1-1 and still passes
+    hit = (pos < c1) & (probe == v0)
+    valid = jnp.arange(v0.shape[0], dtype=jnp.int32) < c0
+    return jnp.sum((hit & valid).astype(jnp.int32), dtype=jnp.int32)
+
+
+@jax.jit
+def _count_aa_jnp(apool0, acard0, ia0, apool1, acard1, ia1):
+    v0 = jnp.take(apool0, ia0, axis=0, mode="clip")
+    c0 = jnp.take(acard0, ia0, mode="clip")
+    v1 = jnp.take(apool1, ia1, axis=0, mode="clip")
+    c1 = jnp.take(acard1, ia1, mode="clip")
+    return jax.vmap(_count_aa_one)(v0, c0, v1, c1)
+
+
+def _count_aa_np(apool0, acard0, ia0, apool1, acard1, ia1):
+    # sort-and-count-duplicates, vectorized over all pairs: each side's
+    # values are unique within a row, so after sorting the two rows
+    # together every intersection element appears as exactly one
+    # adjacent equal pair.  ~4x faster than per-element binary search
+    # on host (row-local sorts are cache-resident; searchsorted pays a
+    # cache miss per probe).  Pad slots get side- AND slot-distinct
+    # sentinels above the uint16 range so they never pair up
+    ia0 = np.asarray(ia0)
+    ia1 = np.asarray(ia1)
+    v0 = apool0[ia0].astype(np.int32)
+    v1 = apool1[ia1].astype(np.int32)
+    c0 = acard0[ia0].astype(np.int32)[:, None]
+    c1 = acard1[ia1].astype(np.int32)[:, None]
+    slot0 = np.arange(v0.shape[1], dtype=np.int32)[None, :]
+    slot1 = np.arange(v1.shape[1], dtype=np.int32)[None, :]
+    v0 = np.where(slot0 < c0, v0, 0x10000 + slot0)
+    v1 = np.where(slot1 < c1, v1, 0x20000 + slot1)
+    m = np.sort(np.concatenate([v0, v1], axis=1), axis=1)
+    return (m[:, 1:] == m[:, :-1]).sum(axis=1, dtype=np.int32)
+
+
+def gathered_count_array_array(apool0, acard0, ia0, apool1, acard1, ia1):
+    """Per-pair |A0[ia0[p]] ∩ A1[ia1[p]]| -> int32[P] over two array
+    pools: binary-search membership of the smaller-capacity side's
+    values in the other's sorted row.  Pad lanes point at the pools'
+    zero rows (card 0) and count 0."""
+    if isinstance(apool0, np.ndarray) and isinstance(apool1, np.ndarray):
+        return _count_aa_np(apool0, acard0, ia0, apool1, acard1, ia1)
+    return _count_aa_jnp(
+        jnp.asarray(apool0), jnp.asarray(acard0),
+        jnp.asarray(ia0, dtype=jnp.int32),
+        jnp.asarray(apool1), jnp.asarray(acard1),
+        jnp.asarray(ia1, dtype=jnp.int32))
+
+
+def _count_ab_one(v, c, brow):
+    import jax.numpy as jnp
+
+    word = jnp.take(brow, (v >> 5).astype(jnp.int32), mode="clip")
+    bit = (word >> (v & 31).astype(jnp.uint32)) & jnp.uint32(1)
+    valid = jnp.arange(v.shape[0], dtype=jnp.int32) < c
+    return jnp.sum(jnp.where(valid, bit, 0).astype(jnp.int32),
+                   dtype=jnp.int32)
+
+
+@jax.jit
+def _count_ab_jnp(apool, acard, ia, bpool, ib):
+    v = jnp.take(apool, ia, axis=0, mode="clip")
+    c = jnp.take(acard, ia, mode="clip")
+    b = jnp.take(bpool, ib, axis=0, mode="clip")
+    return jax.vmap(_count_ab_one)(v, c, b)
+
+
+def _count_ab_np(apool, acard, ia, bpool, ib):
+    # vectorized over all pairs (the aa twin's discipline): one fancy
+    # word gather per batch; pad values (0xFFFF -> word 2047) stay in
+    # range and the validity mask zeroes them
+    ia = np.asarray(ia)
+    ib = np.asarray(ib)
+    v = apool[ia].astype(np.int64)
+    c = acard[ia].astype(np.int64)[:, None]
+    b = bpool[ib]
+    rows = np.arange(v.shape[0], dtype=np.int64)[:, None]
+    bits = (b[rows, v >> 5] >> (v & 31).astype(np.uint32)) & 1
+    valid = np.arange(v.shape[1], dtype=np.int64)[None, :] < c
+    return np.where(valid, bits, 0).sum(axis=1).astype(np.int32)
+
+
+def gathered_count_array_bitmap(apool, acard, ia, bpool, ib):
+    """Per-pair |A[ia[p]] ∩ B[ib[p]]| -> int32[P], array values
+    gather-tested against the bitmap row's words (roaring's
+    array-bitmap intersect).  Only the array side's compact rows and
+    the bitmap rows the directory matched are touched."""
+    if isinstance(apool, np.ndarray) and isinstance(bpool, np.ndarray):
+        return _count_ab_np(apool, acard, ia, bpool, ib)
+    return _count_ab_jnp(
+        jnp.asarray(apool), jnp.asarray(acard),
+        jnp.asarray(ia, dtype=jnp.int32),
+        jnp.asarray(bpool), jnp.asarray(ib, dtype=jnp.int32))
+
+
+# ---------------------------------------------------------------------------
 # bitmap VM: ONE scalar-prefetch kernel for a megabatch of ragged op-tapes
 # over compressed container pools.  Each grid step (q, d) interprets query
 # q's flat register program (ops/tape.py grammar: AND/OR/XOR/ANDNOT/COPY
@@ -336,8 +451,7 @@ def _vm_counts_kernel(prog_ref, gidx_ref, *refs, slots: int,
         dtype=jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _vm_counts_pallas(pool, prog, gidx, interpret: bool = False):
+def _vm_counts_pallas_body(pool, prog, gidx, interpret: bool):
     """grid (B, D): every query x domain-slot cell is one step whose
     ``slots`` leaf blocks DMA from the ONE megapool through per-slot
     index maps over the scalar-prefetched directory — the same buffer
@@ -365,6 +479,29 @@ def _vm_counts_pallas(pool, prog, gidx, interpret: bool = False):
         interpret=interpret,
     )(prog, gidx, *([pool] * L))
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _vm_counts_pallas(pool, prog, gidx, interpret: bool = False):
+    return _vm_counts_pallas_body(pool, prog, gidx, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _vm_counts_kinds_pallas(bpool, apool, acard, rpool, prog, gidx,
+                            interpret: bool = False):
+    """Kind-split megapool variant: decode the compact array/run pools
+    to dense blocks and concatenate behind the bitmap rows INSIDE the
+    same launch, reproducing the virtual dense row space the
+    coalescer's global indices address ([0, Rb) bitmap, [Rb, Rb+Ra)
+    array, the rest run — ops/containers.MegaPools), then run the
+    UNCHANGED VM kernel over it.  Resident and transferred bytes stay
+    compact; only this launch's VMEM/HBM scratch is dense."""
+    from pilosa_tpu.ops import kindpools as kp
+
+    pool = jnp.concatenate(
+        [bpool, kp.decode_array_jnp(apool, acard),
+         kp.decode_runs_jnp(rpool)], axis=0)
+    return _vm_counts_pallas_body(pool, prog, gidx, interpret)
 
 
 def _vm_counts_host(pool, prog, gidx):
@@ -400,13 +537,7 @@ def _vm_counts_host(pool, prog, gidx):
     return out
 
 
-@jax.jit
-def _vm_counts_jnp(pool, prog, gidx):
-    """Jitted XLA twin: gather every leaf block from the pool, then
-    run the EXACT tape-interpreter closure (ops/tape._one_query) per
-    query over [slots, D, W] leaf stacks — the two engines cannot
-    drift because they trace the same scan/switch body.  Re-lowers
-    per (B, T, L, D) bucket shape, which pow2 bucketing bounds."""
+def _vm_counts_jnp_body(pool, prog, gidx):
     from pilosa_tpu.ops import tape as _tape_mod
 
     leaves = jnp.take(pool, gidx, axis=0)   # [L, B, D, W]
@@ -415,16 +546,72 @@ def _vm_counts_jnp(pool, prog, gidx):
     return jax.vmap(one)(prog, leaves)      # [B, D] int32
 
 
+@jax.jit
+def _vm_counts_jnp(pool, prog, gidx):
+    """Jitted XLA twin: gather every leaf block from the pool, then
+    run the EXACT tape-interpreter closure (ops/tape._one_query) per
+    query over [slots, D, W] leaf stacks — the two engines cannot
+    drift because they trace the same scan/switch body.  Re-lowers
+    per (B, T, L, D) bucket shape, which pow2 bucketing bounds."""
+    return _vm_counts_jnp_body(pool, prog, gidx)
+
+
+@jax.jit
+def _vm_counts_kinds_jnp(bpool, apool, acard, rpool, prog, gidx):
+    """XLA twin of the kind-split VM: same decode + concatenate as the
+    Pallas wrapper, same interpreter body — one launch either way."""
+    from pilosa_tpu.ops import kindpools as kp
+
+    pool = jnp.concatenate(
+        [bpool, kp.decode_array_jnp(apool, acard),
+         kp.decode_runs_jnp(rpool)], axis=0)
+    return _vm_counts_jnp_body(pool, prog, gidx)
+
+
+def _vm_counts_kinds(bundle, prog, gidx, interpret: bool):
+    """Dispatch the kind-split megapool bundle (containers.MegaPools):
+    host pools decode eagerly in numpy and reuse the eager twin; on
+    device the decode happens inside the single jitted launch."""
+    B, T, _ = prog.shape
+    _L, _, D = gidx.shape
+    if isinstance(bundle.bpool, np.ndarray):
+        from pilosa_tpu.ops import kindpools as kp
+
+        pool = np.concatenate(
+            [np.asarray(bundle.bpool),
+             kp.decode_array_np(np.asarray(bundle.apool),
+                                np.asarray(bundle.acard)),
+             kp.decode_runs_np(np.asarray(bundle.rpool))], axis=0)
+        return _vm_counts_host(pool, prog, gidx)
+    progj = jnp.asarray(prog)
+    gidxj = jnp.asarray(gidx)
+    if _use_pallas(interpret, B * D * CONTAINER_WORDS,
+                   kernel="vm_counts"):
+        return _vm_counts_kinds_pallas(bundle.bpool, bundle.apool,
+                                       bundle.acard, bundle.rpool,
+                                       progj, gidxj,
+                                       interpret=interpret)
+    return _vm_counts_kinds_jnp(bundle.bpool, bundle.apool,
+                                bundle.acard, bundle.rpool,
+                                progj, gidxj)
+
+
 def vm_counts(pool, prog, gidx, interpret: bool = False):
     """Per-cell popcounts int32[B, D] of a batch of op-tapes over one
     pooled compressed operand: the Pallas VM on TPU, the jitted
     gather+interpret twin elsewhere, eager numpy for host pools —
-    bit-identical counts on every route.  The caller
-    (ops/tape.execute_vm) owns the single dispatch tick."""
+    bit-identical counts on every route.  ``pool`` may also be a
+    kind-split ``containers.MegaPools`` bundle, which decodes inside
+    the launch.  The caller (ops/tape.execute_vm) owns the single
+    dispatch tick."""
     prog = np.ascontiguousarray(prog, dtype=np.int32)
     gidx = np.ascontiguousarray(gidx, dtype=np.int32)
     B, T, _ = prog.shape
     _L, _, D = gidx.shape
+    from pilosa_tpu.ops import containers as _containers
+
+    if isinstance(pool, _containers.MegaPools):
+        return _vm_counts_kinds(pool, prog, gidx, interpret)
     if isinstance(pool, np.ndarray):
         return _vm_counts_host(pool, prog, gidx)
     progj = jnp.asarray(prog)
@@ -616,8 +803,9 @@ from pilosa_tpu import devobs as _devobs  # noqa: E402
 
 for _n in ("_row_counts_masked_pallas", "_count_and_pallas",
            "_gathered_count_and_pallas", "_vm_counts_pallas",
-           "_vm_counts_jnp", "_mmc_pallas",
-           "_bsi_compare_pallas"):
+           "_vm_counts_jnp", "_vm_counts_kinds_pallas",
+           "_vm_counts_kinds_jnp", "_count_aa_jnp", "_count_ab_jnp",
+           "_mmc_pallas", "_bsi_compare_pallas"):
     globals()[_n] = _devobs.instrument(f"pallas.{_n.strip('_')}",
                                        globals()[_n])
 del _n

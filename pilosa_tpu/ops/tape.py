@@ -192,6 +192,20 @@ _counters = {
     "vm.queries": 0,              # queries served through those launches
     "vm.fallbacks": 0,            # VM-gated queries routed to the dense
                                   # ragged/fused engines instead
+    # per-reason breakout of WHY a VM-gated query fell back (the
+    # central vm.fallbacks stays the authoritative total; mesh_active
+    # is informational only — a mesh route is not a degradation)
+    "vm.fallbacks.disabled": 0,       # containers runtime disabled
+    "vm.fallbacks.ineligible_leaf": 0,  # non-container-eligible leaf /
+                                        # dense-slot directory
+    "vm.fallbacks.kind_unsupported": 0,  # directory carries a kind
+                                         # byte with no VM decode arm
+    "vm.fallbacks.oversize": 0,       # tape/leaf caps exceeded
+    "vm.fallbacks.max_prefetch": 0,   # single query blows the scalar
+                                      # prefetch budget
+    "vm.fallbacks.min_domain": 0,     # ...and only because of the
+                                      # configured min-domain floor
+    "vm.fallbacks.mesh_active": 0,    # mesh routing took the query
 }
 #: (counts, B, tape_len, slots, *stack_shape) combos the interpreter
 #: has lowered — the /debug/ragged program inventory.
@@ -240,8 +254,11 @@ def debug() -> dict[str, Any]:
                  for (c, b, t, s, *shape) in sorted(_lowered)]
         vm_progs = [{"batch": b, "tapeLen": t, "slots": s, "domain": d}
                     for (b, t, s, d) in sorted(_vm_lowered)]
+        reasons = {k.split(".", 2)[2]: v for k, v in _counters.items()
+                   if k.startswith("vm.fallbacks.")}
         return {"counters": dict(_counters), "programs": progs,
-                "vm": {"programs": vm_progs}}
+                "vm": {"programs": vm_progs,
+                       "fallbackReasons": reasons}}
 
 
 # ------------------------------------------------------------ interpreter
@@ -565,10 +582,21 @@ def execute_vm(batch: Sequence[tuple[Tape, list]], pool: Any,
     # what the VM launch actually touches: the gathered container
     # blocks (every directory entry DMAs one pool row), the SMEM
     # directory + programs, and the count outputs — never the dense
-    # register file (the engine's whole point)
-    cwords = int(pool.shape[-1]) if getattr(pool, "ndim", 0) else 0
-    _perfobs.sample("vm", cts, t0,
-                    nbytes=gidx.size * cwords * 4 + gidx.nbytes
+    # register file (the engine's whole point).  A kind-split megapool
+    # bundle (containers.MegaPools) samples as its own engine cell —
+    # the launch's decode arms are a different cost shape than the
+    # plain dense-pool gather
+    from pilosa_tpu.ops import containers as _containers
+
+    if isinstance(pool, _containers.MegaPools):
+        engine = "vm_kinds"
+        touched = int(pool.nbytes)
+    else:
+        engine = "vm"
+        cwords = int(pool.shape[-1]) if getattr(pool, "ndim", 0) else 0
+        touched = gidx.size * cwords * 4
+    _perfobs.sample(engine, cts, t0,
+                    nbytes=touched + gidx.nbytes
                     + prog.nbytes + cts.nbytes)
     return [cts[i] for i in range(n)]
 

@@ -287,6 +287,11 @@ class Coalescer:
                 max_prefetch=self.vm_max_prefetch)
             if vmstage is None:
                 _tape.bump("vm.fallbacks")
+        elif self.vm and self.ragged and use_vm:
+            # mesh-routed query: informational reason cell ONLY — the
+            # shard_map interpreter is a route, not a degradation, so
+            # the central vm.fallbacks total stays untouched
+            _tape.bump("vm.fallbacks.mesh_active")
         if vmstage is not None:
             tb, lb = _tape.size_class(len(vmstage.tape.instrs),
                                       len(vmstage.leaves))
@@ -468,15 +473,36 @@ class Coalescer:
                         rows = []
                         for lf, ix in zip(it.vm.leaves, it.vm.idxs):
                             g = np.full(D, zero, dtype=np.int32)
-                            g[:len(ix)] = bases[lf.uid] + ix
+                            if isinstance(ix, tuple):
+                                # kind-split staging: combine the
+                                # per-kind rows into the bundle's
+                                # virtual dense row space ([0, Rb)
+                                # bitmap, then arrays, then runs —
+                                # containers.MegaPools); kv 0/1 both
+                                # route through the bitmap base (an
+                                # absent lane's ib is the leaf's zero
+                                # row)
+                                kv, ib, ia, ir = ix
+                                bb, ab, rb = bases[lf.uid]
+                                g[:len(ib)] = np.where(
+                                    kv == 2, ab + ia,
+                                    np.where(kv == 3, rb + ir,
+                                             bb + ib)).astype(np.int32)
+                            else:
+                                base = bases[lf.uid]
+                                if isinstance(base, tuple):
+                                    base = base[0]  # legacy leaf in a
+                                    # kinds megapool: bitmap rows only
+                                g[:len(ix)] = base + ix
                             rows.append(g)
                         vbatch.append((it.tape, rows))
                     # domain slots holding a real container vs the
                     # padded directory capacity: the data sparsity the
                     # compressed engine exploits
                     cap = sum(len(it.vm.leaves) for it in live) * D
-                    real = sum(len(ix) for it in live
-                               for ix in it.vm.idxs)
+                    real = sum(len(ix[1] if isinstance(ix, tuple)
+                                   else ix)
+                               for it in live for ix in it.vm.idxs)
                     sig_work = cap * int(pool.shape[-1])
                     sig_sparsity = real / cap if cap else 1.0
                     bucket.engine = "vm"

@@ -63,7 +63,8 @@ from pilosa_tpu import observe as _observe
 #: The canonical engine taxonomy — the one ``engine`` enum the flight
 #: record, /debug/cost, and the chip captures all share.
 ENGINES = ("dense", "gather", "tape", "vm", "mesh", "host",
-           "collective")
+           "collective", "gather_aa", "gather_ab", "gather_kinds",
+           "vm_kinds")
 
 #: Shadow consult requires this many samples in BOTH cells before it
 #: is willing to disagree — a single noisy wall must not tick a

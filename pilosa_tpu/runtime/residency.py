@@ -271,11 +271,11 @@ class HostEntry:
 
     __slots__ = ("cache", "key", "token", "payload", "promote",
                  "fallback", "nbytes", "kind", "devices", "spilled",
-                 "tenant")
+                 "tenant", "kind_detail")
 
     def __init__(self, cache: dict, key, token, payload, promote,
                  nbytes: int, kind: str, devices: int, fallback=None,
-                 tenant: str | None = None):
+                 tenant: str | None = None, kind_detail=None):
         self.cache = cache
         self.key = key
         self.token = token
@@ -292,6 +292,9 @@ class HostEntry:
         # the tenant whose query assembled these bytes ([tenants]
         # isolation; None while off) — host-tier byte attribution
         self.tenant = tenant
+        # per-kind byte breakout ({"array": n, "run": n}) restored on
+        # re-promotion so stats()["kinds"] survives a demote cycle
+        self.kind_detail = kind_detail
 
     def host_value(self):
         """The host-compute fallback value for this entry."""
@@ -337,6 +340,10 @@ class ResidencyManager:
         # roaring-on-TPU "compressed" container pools) — the
         # /debug/devices compressed-vs-dense split
         self._by_kind: dict[str, int] = {}
+        # eid -> {"array": n, "run": n}: sub-kind byte breakout for
+        # kinds-split container leaves, charged ADDITIVELY into
+        # _by_kind ("compressed" stays the pool total)
+        self._kind_detail: dict[tuple, dict] = {}
         self.evictions = 0
         self.admits = 0
         # max SETTLED bytes (post-eviction; the mid-admit transient
@@ -410,12 +417,20 @@ class ResidencyManager:
             self._tenant_host_bytes[t] = \
                 self._tenant_host_bytes.get(t, 0) + n
 
+    def _kind_detail_drop_locked(self, eid: tuple) -> None:
+        """Un-charge an entry's sub-kind byte breakout from
+        ``_by_kind`` (eviction/forget/demote/overwrite)."""
+        d = self._kind_detail.pop(eid, None)
+        if d:
+            for k, v in d.items():
+                self._by_kind[k] = self._by_kind.get(k, 0) - v
+
     # ---------------------------------------------------------- admit
 
     def admit(self, cache: dict, key, nbytes: int,
               kind: str = "dense", devices: int = 1,
               token=None, host=None, promote=None, fallback=None,
-              prefetched: bool = False) -> None:
+              prefetched: bool = False, kind_detail=None) -> None:
         """Track an entry just inserted into ``cache`` under ``key``;
         evict least-recently-used entries (from any owner) until the
         total fits the budget.  The entry being admitted is never its
@@ -444,11 +459,19 @@ class ResidencyManager:
                     self._by_kind.get(old[3], 0) - old[2]
                 self._per_device -= -(-old[2] // old[4])
                 self._tenant_charge_locked(old[5], -old[2])
+            self._kind_detail_drop_locked(eid)
             self._entries[eid] = (cache, key, nbytes, kind,
                                   max(1, devices), ten)
             self.total += nbytes
             self._per_device += -(-nbytes // max(1, devices))
             self._by_kind[kind] = self._by_kind.get(kind, 0) + nbytes
+            if kind_detail:
+                # sub-kind breakout ("array"/"run" pool bytes inside a
+                # "compressed" leaf) — additive, so the parent kind
+                # remains the authoritative total
+                self._kind_detail[eid] = dict(kind_detail)
+                for k, v in kind_detail.items():
+                    self._by_kind[k] = self._by_kind.get(k, 0) + v
             self._tenant_charge_locked(ten, nbytes)
             self.admits += 1
             if prefetched:
@@ -463,7 +486,8 @@ class ResidencyManager:
                 spill = self._host_put_locked(HostEntry(
                     cache, key, token, host, promote,
                     _payload_nbytes(host), kind, max(1, devices),
-                    fallback=fallback, tenant=ten))
+                    fallback=fallback, tenant=ten,
+                    kind_detail=kind_detail))
             if ten is not None:
                 # per-tenant HBM quota ([tenants] residency-share):
                 # an over-quota tenant demotes its OWN coldest stacks,
@@ -516,6 +540,7 @@ class ResidencyManager:
         self.total -= vbytes
         self._per_device -= -(-vbytes // vdev)
         self._by_kind[vkind] = self._by_kind.get(vkind, 0) - vbytes
+        self._kind_detail_drop_locked(victim_id)
         self._tenant_charge_locked(vtenant, -vbytes)
         self.evictions += 1
         self._prefetched.discard(victim_id)
@@ -595,7 +620,8 @@ class ResidencyManager:
                 continue
             d = HostEntry(v.cache, v.key, v.token, None, v.promote,
                           v.nbytes, v.kind, v.devices,
-                          fallback=v.fallback, tenant=v.tenant)
+                          fallback=v.fallback, tenant=v.tenant,
+                          kind_detail=v.kind_detail)
             d.spilled = path
             with self._lock:
                 eid = v.eid
@@ -668,7 +694,8 @@ class ResidencyManager:
                                       loaded.promote, loaded.nbytes,
                                       loaded.kind, loaded.devices,
                                       fallback=loaded.fallback,
-                                      tenant=loaded.tenant)
+                                      tenant=loaded.tenant,
+                                      kind_detail=loaded.kind_detail)
                     spill = self._host_put_locked(fresh)
                     self.disk_hits += 1
             if spill:
@@ -730,6 +757,7 @@ class ResidencyManager:
                 self.total -= e[2]
                 self._per_device -= -(-e[2] // e[4])
                 self._by_kind[e[3]] = self._by_kind.get(e[3], 0) - e[2]
+                self._kind_detail_drop_locked(eid)
                 self._tenant_charge_locked(e[5], -e[2])
             h = self._host.pop(eid, None)
             if h is not None:
@@ -753,6 +781,7 @@ class ResidencyManager:
                 self.total -= e[2]
                 self._per_device -= -(-e[2] // e[4])
                 self._by_kind[e[3]] = self._by_kind.get(e[3], 0) - e[2]
+                self._kind_detail_drop_locked(eid)
                 self._tenant_charge_locked(e[5], -e[2])
                 if eid in self._host or eid in self._disk:
                     self.demotions += 1
@@ -773,6 +802,7 @@ class ResidencyManager:
             self.total = 0
             self._per_device = 0
             self._by_kind.clear()
+            self._kind_detail.clear()
             self._tenant_bytes.clear()
             self._prefetched.clear()
             self.evictions += len(victims)
@@ -1151,7 +1181,8 @@ class Promoter:
                                 token=ent.token, host=ent.payload,
                                 promote=ent.promote,
                                 fallback=ent.fallback,
-                                prefetched=fl.prefetch)
+                                prefetched=fl.prefetch,
+                                kind_detail=ent.kind_detail)
                 fl.ok = True
                 with self._lock:
                     self.promotions += 1

@@ -1373,11 +1373,13 @@ class Handler:
     def handle_debug_containers(self, req, params, path, body):
         """Compressed container-directory engine state
         (ops/containers.py): the [containers] config in force
-        (enabled/threshold) and the container.* counters (queries
+        (enabled/threshold plus the kind-specialization knobs
+        kinds/arrayMax/runCap) and the container.* counters (queries
         served compressed, dense fallbacks, containers gathered vs
-        skipped, empty-domain zero-work answers).  The
-        compressed-vs-dense resident-byte split is on /debug/devices
-        (residency.kinds)."""
+        skipped broken out per kind — bitmap/array/run_gathered —
+        and empty-domain zero-work answers).  The per-kind
+        resident-byte split (compressed total plus its array/run
+        sub-pools vs dense) is on /debug/devices (residency.kinds)."""
         from pilosa_tpu.ops import containers
 
         self._json(req, containers.debug())
@@ -1392,8 +1394,12 @@ class Handler:
         (batch, tape-length, leaf-slot, stack-shape) bucket variants
         this process has lowered.  The ``vm`` section covers the
         Pallas bitmap VM: the [vm] knobs in force, the vm.* counters,
-        and the (batch, tape-length, slot, domain) program variants
-        the scalar-prefetch kernel has lowered."""
+        the (batch, tape-length, slot, domain) program variants
+        the scalar-prefetch kernel has lowered, and
+        ``fallbackReasons`` — the per-reason breakdown of dense-path
+        fallbacks (disabled / ineligible_leaf / kind_unsupported /
+        oversize / max_prefetch / min_domain, plus the informational
+        mesh_active count)."""
         from pilosa_tpu.ops import tape
 
         out = tape.debug()

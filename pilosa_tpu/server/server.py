@@ -91,6 +91,9 @@ class Server:
         ingest_compact_interval: float | None = None,
         containers_enabled: bool | None = None,
         containers_threshold: float | None = None,
+        containers_kinds: bool | None = None,
+        containers_array_max: int | None = None,
+        containers_run_cap: int | None = None,
         mesh_enabled=None,
         mesh_axis_size: int | None = None,
         residency_host_budget_bytes: int | None = None,
@@ -313,7 +316,10 @@ class Server:
         _containers.retain()
         self._containers_retained = True
         _containers.configure(enabled=containers_enabled,
-                              threshold=containers_threshold)
+                              threshold=containers_threshold,
+                              kinds=containers_kinds,
+                              array_max=containers_array_max,
+                              run_cap=containers_run_cap)
         # mesh-native SPMD execution ([mesh] config): process-wide
         # like [containers] — the first server's retain() captures the
         # pre-server baseline, the LAST release() (in close) restores

@@ -29,6 +29,53 @@ MAGIC = 12348
 COOKIE_OFFICIAL = 12346       # official roaring, no run containers
 COOKIE_OFFICIAL_RUNS = 12347  # official roaring + run-flag bitset
 
+#: Container kinds as the DEVICE directory numbers them (the kind byte
+#: in ops/containers.ContainerLeaf; the wire format's type field uses
+#: 1=array/2=bitmap/3=run instead — see ``_WIRE_TYPE``).
+KIND_BITMAP = 1
+KIND_ARRAY = 2
+KIND_RUN = 3
+
+#: The reference's array-container cardinality ceiling: above this a
+#: sorted-uint16 array costs more than the 8 KiB bitmap.
+ARRAY_MAX_CARD = 4096
+
+#: Device kind -> serialized container type (roaring/roaring.go
+#: containerArray/containerBitmap/containerRun).
+_WIRE_TYPE = {KIND_ARRAY: 1, KIND_BITMAP: 2, KIND_RUN: 3}
+
+
+def pick_kind(card: int, n_runs: int,
+              array_max: int = ARRAY_MAX_CARD) -> int:
+    """The roaring cost rule: cheapest of bitmap (8192 B), sorted
+    uint16 array (2*card B, card <= array_max), interval-list run
+    (2 + 4*n_runs B) — byte-for-byte the serializer's choice
+    (roaring/roaring.go optimize()), shared by ``_encode_py`` and the
+    device directory build so wire and device kinds can never drift.
+    ``array_max`` only narrows the device pick (size-class packing
+    caps); serialization always passes the canonical 4096."""
+    array_size = 2 * card if card <= array_max else 1 << 62
+    run_size = 2 + 4 * n_runs
+    if run_size < array_size and run_size < 8192:
+        return KIND_RUN
+    if array_size <= 8192:
+        return KIND_ARRAY
+    return KIND_BITMAP
+
+
+def container_stats(words: np.ndarray) -> tuple[int, int]:
+    """(cardinality, interval-run count) of one dense container given
+    as uint64[1024] or uint32[2048] words — the two inputs of
+    ``pick_kind``."""
+    w = np.ascontiguousarray(words)
+    card = int(np.bitwise_count(w).sum(dtype=np.uint64))
+    if card == 0:
+        return 0, 0
+    bits = np.unpackbits(w.view(np.uint8), bitorder="little")
+    runs = int(np.count_nonzero(
+        np.diff(np.concatenate(([0], bits))) == 1))
+    return card, runs
+
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _SRC = os.path.join(_NATIVE_DIR, "roaring_codec.cpp")
 _SO = os.path.join(_NATIVE_DIR, "build", "libpilosa_native.so")
@@ -300,14 +347,7 @@ def _encode_py(keys: np.ndarray, words: np.ndarray, flags: int) -> bytes:
         starts = np.nonzero(np.diff(np.concatenate(([0], bits))) == 1)[0]
         ends = np.nonzero(np.diff(np.concatenate((bits, [0]))) == -1)[0]
         runs = len(starts)
-        array_size = 2 * card if card <= 4096 else 1 << 62
-        run_size = 2 + 4 * runs
-        if run_size < array_size and run_size < 8192:
-            typ = 3
-        elif array_size <= 8192:
-            typ = 1
-        else:
-            typ = 2
+        typ = _WIRE_TYPE[pick_kind(card, runs)]
         plans.append((int(keys[i]), card, typ, runs, w, bits, starts, ends))
 
     out = bytearray()

@@ -1,0 +1,142 @@
+"""Serialization parity for the three container kinds: wire-type
+headers must match ``pick_kind`` exactly (the same rule the device
+directory uses, so wire and device kinds can never drift), the 4096
+array->bitmap cardinality boundary must flip the header type, run
+containers must carry the reference interval payload byte-for-byte,
+and the 65535/65536 container-boundary bits must survive round trips
+through all three kinds (roaring/roaring.go optimize() +
+containerArray/containerBitmap/containerRun)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.storage import roaring as rc
+
+FULL = 65536
+
+
+def dense(offsets):
+    """One dense container (uint64[1024]) with the given bit offsets."""
+    w = np.zeros(1024, dtype=np.uint64)
+    offs = np.asarray(offsets, dtype=np.int64)
+    np.bitwise_or.at(w, offs // 64, np.uint64(1) << (offs % 64).astype(np.uint64))
+    return w
+
+
+def wire_headers(blob):
+    """Parse the descriptive-header section -> [(key, typ, card)]."""
+    assert int.from_bytes(blob[0:2], "little") == rc.MAGIC
+    n = int.from_bytes(blob[4:8], "little")
+    out = []
+    for i in range(n):
+        off = 8 + i * 12
+        key = int.from_bytes(blob[off : off + 8], "little")
+        typ = int.from_bytes(blob[off + 8 : off + 10], "little")
+        card = int.from_bytes(blob[off + 10 : off + 12], "little") + 1
+        out.append((key, typ, card))
+    return out
+
+
+def roundtrip_both(keys, words):
+    """Encode with native and python, decode each with both decoders,
+    assert everything agrees, and return the blob + decoded state."""
+    keys = np.asarray(keys, dtype=np.uint64)
+    blob = rc.encode(keys, words)
+    assert blob == rc._encode_py(keys, np.asarray(words), 0)
+    k_n, w_n, _ = rc.decode(blob)
+    k_p, w_p, _ = rc._decode_py(blob)
+    np.testing.assert_array_equal(k_n, k_p)
+    np.testing.assert_array_equal(w_n, w_p)
+    return blob, k_n, w_n
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_wire_headers_match_pick_kind(seed):
+    rng = np.random.default_rng(seed)
+    rows = []
+    for card in rng.choice([1, 7, 100, 3000, 4096, 4097, 20000, FULL], 6, replace=False):
+        rows.append(dense(np.sort(rng.choice(FULL, int(card), replace=False))))
+    keys = np.arange(len(rows), dtype=np.uint64) * 3
+    words = np.stack(rows)
+    blob, k2, w2 = roundtrip_both(keys, words)
+    np.testing.assert_array_equal(k2, keys)
+    np.testing.assert_array_equal(w2, words)
+    for (key, typ, card), w in zip(wire_headers(blob), words):
+        c, runs = rc.container_stats(w)
+        assert card == c, key
+        assert typ == rc._WIRE_TYPE[rc.pick_kind(c, runs)], key
+
+
+def test_array_bitmap_boundary_wire_types():
+    # Even offsets make every bit its own run, so the run kind can never
+    # undercut the array/bitmap choice: the header type isolates the
+    # 2*card <= 8192 rule (ArrayMaxSize).
+    at_max = dense(np.arange(0, 2 * 4096, 2))       # card 4096 -> array
+    over = dense(np.arange(0, 2 * 4097, 2))         # card 4097 -> bitmap
+    blob, _, w2 = roundtrip_both([0, 1], np.stack([at_max, over]))
+    assert [t for _, t, _ in wire_headers(blob)] == [1, 2]
+    assert [c for _, _, c in wire_headers(blob)] == [4096, 4097]
+    np.testing.assert_array_equal(w2[0], at_max)
+    np.testing.assert_array_equal(w2[1], over)
+    assert rc.pick_kind(4096, 4096) == rc.KIND_ARRAY
+    assert rc.pick_kind(4097, 4097) == rc.KIND_BITMAP
+
+
+def test_full_container_run_payload_golden():
+    # All 65536 bits = one run (0, 65535): 6-byte payload beats both the
+    # bitmap and the (out-of-range) array.  Pin the exact bytes.
+    keys = np.array([5], dtype=np.uint64)
+    words = dense(np.arange(FULL)).reshape(1, -1)
+    blob, _, w2 = roundtrip_both(keys, words)
+    assert wire_headers(blob) == [(5, 3, FULL)]
+    want = bytearray()
+    want += (12348).to_bytes(2, "little") + bytes([0, 0])
+    want += (1).to_bytes(4, "little")
+    want += (5).to_bytes(8, "little") + (3).to_bytes(2, "little") + (FULL - 1).to_bytes(2, "little")
+    want += (8 + 12 + 4).to_bytes(4, "little")
+    want += (1).to_bytes(2, "little")                        # run count
+    want += (0).to_bytes(2, "little") + (FULL - 1).to_bytes(2, "little")
+    assert blob == bytes(want)
+    np.testing.assert_array_equal(w2[0], words[0])
+
+
+def test_single_bit_and_small_run_kinds():
+    # A single bit is one run, but the 6-byte run payload loses to the
+    # 2-byte array (the reference picks array too); a long single run
+    # wins against both.
+    single = dense([12345])
+    long_run = dense(np.arange(100, 10100))
+    blob, _, _ = roundtrip_both([0, 1], np.stack([single, long_run]))
+    assert [t for _, t, _ in wire_headers(blob)] == [1, 3]
+    assert rc.pick_kind(1, 1) == rc.KIND_ARRAY
+    assert rc.pick_kind(10000, 1) == rc.KIND_RUN
+
+
+def test_boundary_bits_through_all_kinds():
+    # Bits 65535 (last of container 0) and 65536 (first of container 1)
+    # must survive round trips no matter which kind each container lands
+    # in.  Build the pair so container 0 / container 1 each take on all
+    # three kinds across the cases.
+    rng = np.random.default_rng(7)
+
+    def as_array(offsets_extra):
+        return sorted(set(offsets_extra) | set(rng.choice(FULL, 50, replace=False).tolist()))
+
+    cases = {
+        "array": (dense(as_array([FULL - 1])), dense(as_array([0])), 1),
+        "bitmap": (
+            dense(sorted(set(np.arange(0, FULL, 2).tolist()) | {FULL - 1})),
+            dense(np.arange(0, FULL, 2)),  # bit 0 is even, already present
+            2,
+        ),
+        "run": (dense(np.arange(60000, FULL)), dense(np.arange(0, 9000)), 3),
+    }
+    for name, (c0, c1, want_typ) in cases.items():
+        blob, k2, w2 = roundtrip_both([0, 1], np.stack([c0, c1]))
+        assert [t for _, t, _ in wire_headers(blob)] == [want_typ, want_typ], name
+        pos = rc.containers_to_positions(k2, w2)
+        assert FULL - 1 in pos and FULL in pos, name
+        np.testing.assert_array_equal(w2[0], c0, err_msg=name)
+        np.testing.assert_array_equal(w2[1], c1, err_msg=name)

@@ -386,7 +386,10 @@ CONDITION_ATTRS = ("_snap_done",)
 #: specializes on input shape.
 JIT_ENTRY_SUFFIXES = ("expr.evaluate", "tape.execute", "_tape.execute",
                       "tape.execute_vm", "_tape.execute_vm",
-                      "expr.evaluate_gathered")
+                      "expr.evaluate_gathered",
+                      "expr.evaluate_gathered_kinds",
+                      "gathered_count_array_array",
+                      "gathered_count_array_bitmap")
 #: Batch-stack builders whose output shape tracks their (variable)
 #: input length.
 STACK_BUILDER_SUFFIXES = ("jnp.stack", "jnp.concatenate", "np.stack",
